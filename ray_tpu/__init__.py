@@ -1,0 +1,61 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Tasks/actors/objects core (reference capability: Ray Core) with a JAX/XLA/
+Pallas ML stack — collectives over ICI/DCN via shard_map, TPU chips and
+slices as first-class schedulable resources, Train/Serve/Data/Tune on top.
+"""
+
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    put,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    OutOfMemoryError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import remote
+from ray_tpu.core.worker import get_runtime_context
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "ObjectRef",
+    "RayTpuError",
+    "TaskError",
+    "TaskCancelledError",
+    "ActorDiedError",
+    "ActorUnavailableError",
+    "ObjectLostError",
+    "OutOfMemoryError",
+    "GetTimeoutError",
+    "__version__",
+]

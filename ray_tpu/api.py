@@ -1,0 +1,137 @@
+"""Top-level public API: init/shutdown, get/put/wait, remote, actors.
+
+Capability parity with the reference's driver API (reference:
+python/ray/_private/worker.py — ray.init :1406, ray.get/put/wait/kill/cancel,
+ray.get_actor): ``init`` with no address starts a standalone runtime;
+``init(address=...)`` connects to a running cluster head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from ray_tpu.core.actor import ActorHandle
+from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.worker import global_worker
+from ray_tpu.utils.ids import JobID, NodeID, WorkerID
+
+
+def init(
+    address: str | None = None,
+    *,
+    num_cpus: float | None = None,
+    resources: dict[str, float] | None = None,
+    ignore_reinit_error: bool = True,
+    _node_id: NodeID | None = None,
+) -> None:
+    """Start (or connect to) the runtime.
+
+    - ``address=None``: in-process runtime (full semantics, threads as workers).
+    - ``address="local-cluster"``: start a head + node daemon on this host and
+      connect (multiprocess).
+    - ``address="host:port"``: connect to an existing head.
+    """
+    if global_worker.connected:
+        if ignore_reinit_error:
+            return
+        raise RayTpuError("already initialized; call shutdown() first")
+
+    global_worker.job_id = JobID.from_random()
+    if address is None:
+        from ray_tpu.core.local_runtime import LocalRuntime
+
+        cpus = num_cpus if num_cpus is not None else 8
+        global_worker.runtime = LocalRuntime(num_cpus=cpus, resources=resources)
+        global_worker.worker_id = global_worker.runtime.worker_id
+        global_worker.node_id = _node_id or NodeID.from_random()
+        global_worker.mode = "local"
+    else:
+        try:
+            from ray_tpu.core.cluster.client import connect_cluster
+        except ImportError as e:
+            raise NotImplementedError(
+                "cluster mode is not available in this build"
+            ) from e
+
+        global_worker.runtime = connect_cluster(
+            address, num_cpus=num_cpus, resources=resources
+        )
+        global_worker.worker_id = global_worker.runtime.worker_id
+        global_worker.node_id = global_worker.runtime.node_id
+        global_worker.mode = "cluster"
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+def shutdown() -> None:
+    if global_worker.runtime is not None:
+        global_worker.runtime.shutdown()
+    global_worker.runtime = None
+    global_worker.mode = None
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return global_worker.put(value)
+
+
+def get(refs: ObjectRef | Sequence[ObjectRef], *, timeout: float | None = None):
+    single = isinstance(refs, ObjectRef)
+    try:
+        ref_list = [refs] if single else list(refs)
+    except TypeError:
+        raise TypeError(
+            f"get() expects an ObjectRef or a sequence of ObjectRefs, got {type(refs).__name__}"
+        ) from None
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+    values = global_worker.get(ref_list, timeout=timeout)
+    return values[0] if single else values
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: float | None = None,
+    fetch_local: bool = True,
+):
+    global_worker.check_connected()
+    if num_returns > len(refs):
+        raise ValueError("num_returns cannot exceed the number of refs")
+    return global_worker.runtime.wait(
+        list(refs), num_returns=num_returns, timeout=timeout, fetch_local=fetch_local
+    )
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    global_worker.check_connected()
+    global_worker.runtime.kill_actor(actor.actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    global_worker.check_connected()
+    global_worker.runtime.cancel(ref)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    global_worker.check_connected()
+    actor_id = global_worker.runtime.get_named_actor(name, namespace)
+    if actor_id is None:
+        raise ValueError(f"no actor named {name!r} in namespace {namespace!r}")
+    return ActorHandle(actor_id)
+
+
+def cluster_resources() -> dict[str, float]:
+    global_worker.check_connected()
+    return global_worker.runtime.cluster_resources()
+
+
+def available_resources() -> dict[str, float]:
+    global_worker.check_connected()
+    return global_worker.runtime.available_resources()

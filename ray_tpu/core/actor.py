@@ -1,0 +1,150 @@
+"""Actor classes and handles.
+
+Capability parity with the reference's actor API (reference:
+python/ray/actor.py ActorClass/ActorHandle :92-240; creation via
+_raylet.pyx:3590 create_actor → gcs actor FSM): ``@remote`` on a class yields
+an ActorClass; ``.remote(...)`` creates the actor and returns an ActorHandle
+whose method accessors submit ordered actor tasks. Named/detached actors,
+max_restarts, max_concurrency, and options() per-instantiation overrides.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.core.remote_function import _build_resources, extract_arg_refs
+from ray_tpu.core.task_spec import ActorCreationSpec, SchedulingStrategy, TaskSpec
+from ray_tpu.core.worker import global_worker
+from ray_tpu.utils import serialization
+from ray_tpu.utils.ids import ActorID, TaskID
+
+
+_DEFAULT_ACTOR_OPTIONS = dict(
+    num_cpus=1,
+    num_tpus=0,
+    resources=None,
+    max_restarts=0,
+    max_task_retries=0,
+    max_concurrency=1,
+    name=None,
+    namespace="default",
+    lifetime="non_detached",
+    scheduling_strategy=None,
+    runtime_env=None,
+)
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(
+            self._method_name, args, kwargs, num_returns=self._num_returns
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} cannot be called directly; use .remote()"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_names: list[str] | None = None):
+        import os as _os
+
+        self._actor_id = actor_id
+        self._method_names = method_names or []
+        self._seq_no = 0
+        # Distinguishes task ids from different handles to the same actor
+        # (each handle has its own ordered call sequence).
+        self._handle_nonce = _os.urandom(4)
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def _submit_method(self, method_name: str, args: tuple, kwargs: dict, num_returns: int = 1):
+        worker = global_worker
+        worker.check_connected()
+        self._seq_no += 1
+        arg_refs = extract_arg_refs(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(self._actor_id, self._seq_no, self._handle_nonce),
+            job_id=worker.job_id,
+            fn_blob=b"",
+            args_blob=serialization.serialize((args, kwargs)),
+            arg_ref_ids=[r.id for r in arg_refs],
+            arg_owner_ids=[r.owner_id for r in arg_refs],
+            num_returns=num_returns,
+            actor_id=self._actor_id,
+            method_name=method_name,
+            seq_no=self._seq_no,
+            name=f"{method_name}",
+            owner_id=worker.worker_id,
+        )
+        refs = worker.runtime.submit_actor_task(spec)
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_names))
+
+    def __repr__(self) -> str:
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: dict[str, Any]):
+        self._cls = cls
+        self._options = {**_DEFAULT_ACTOR_OPTIONS, **options}
+        self._cls_blob: bytes | None = None
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote(...)"
+        )
+
+    def options(self, **overrides) -> "ActorClass":
+        new = ActorClass(self._cls, {**self._options, **overrides})
+        new._cls_blob = self._cls_blob
+        return new
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = global_worker
+        worker.check_connected()
+        if self._cls_blob is None:
+            self._cls_blob = serialization.dumps_function(self._cls)
+        opts = self._options
+        actor_id = ActorID.of(worker.job_id)
+        arg_refs = extract_arg_refs(args, kwargs)
+        spec = ActorCreationSpec(
+            actor_id=actor_id,
+            job_id=worker.job_id,
+            cls_blob=self._cls_blob,
+            args_blob=serialization.serialize((args, kwargs)),
+            arg_ref_ids=[r.id for r in arg_refs],
+            resources=_build_resources(opts),
+            max_restarts=opts["max_restarts"],
+            max_task_retries=opts["max_task_retries"],
+            max_concurrency=opts["max_concurrency"],
+            name=opts["name"],
+            namespace=opts["namespace"],
+            lifetime=opts["lifetime"],
+            scheduling_strategy=opts["scheduling_strategy"] or SchedulingStrategy(),
+            runtime_env=opts["runtime_env"],
+            owner_id=worker.worker_id,
+        )
+        worker.runtime.create_actor(spec)
+        method_names = [m for m in dir(self._cls) if not m.startswith("_")]
+        return ActorHandle(actor_id, method_names)

@@ -1,0 +1,104 @@
+"""ObjectRef: a first-class distributed future.
+
+Capability parity with the reference's ObjectRef (reference:
+python/ray/includes/object_ref.pxi + src/ray/core_worker/reference_counter.h):
+a ref names an object owned by exactly one worker; refs are cheap to copy and
+pickle; passing a ref across process boundaries registers a *borrow* with the
+owner so distributed refcounting keeps the value alive.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any
+
+from ray_tpu.utils.ids import ObjectID, WorkerID
+
+if TYPE_CHECKING:
+    pass
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_id", "_worker")
+
+    def __init__(self, object_id: ObjectID, owner_id: WorkerID | None = None):
+        self.id = object_id
+        self.owner_id = owner_id
+        self._worker = None  # bound lazily to the current worker
+        # Distributed GC: every live ObjectRef instance holds one local ref;
+        # release in __del__ (reference: _raylet ObjectRef dealloc decrements
+        # the local count in the reference counter).
+        try:
+            from ray_tpu.core.worker import global_worker
+
+            rt = global_worker.runtime
+            if rt is not None:
+                rt.refs.add_local_ref(object_id)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            from ray_tpu.core.worker import global_worker
+
+            rt = global_worker.runtime
+            if rt is not None:
+                rt.refs.remove_local_ref(self.id)
+        except Exception:
+            pass
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    # -- future-like sugar -------------------------------------------------
+    def get(self, timeout: float | None = None) -> Any:
+        import ray_tpu
+
+        return ray_tpu.get(self, timeout=timeout)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait([self], num_returns=1, timeout=timeout)
+        return bool(ready)
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+
+        import ray_tpu
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(ray_tpu.get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        import threading
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, self.get).__await__()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __hash__(self) -> int:
+        return hash(self.id)
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.id.hex()[:16]})"
+
+    def __reduce__(self):
+        # Crossing a process boundary: the deserializing side becomes a
+        # borrower (registered on arrival by the worker's deserializer).
+        return (ObjectRef, (self.id, self.owner_id))

@@ -1,0 +1,120 @@
+"""`@remote` functions.
+
+Capability parity with the reference's RemoteFunction (reference:
+python/ray/remote_function.py:41, `._remote` :314 → core_worker.submit_task
+:487): decorating a function yields a handle whose ``.remote(...)`` submits a
+task and returns ObjectRef(s); ``.options(...)`` overrides resources,
+num_returns, retries, scheduling strategy per call site.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec
+from ray_tpu.core.worker import global_worker
+from ray_tpu.utils import serialization
+from ray_tpu.utils.ids import TaskID
+
+
+_DEFAULT_TASK_OPTIONS = dict(
+    num_cpus=1,
+    num_tpus=0,
+    resources=None,
+    num_returns=1,
+    max_retries=3,
+    retry_exceptions=False,
+    scheduling_strategy=None,
+    runtime_env=None,
+    name=None,
+)
+
+
+def _build_resources(opts: dict[str, Any]) -> dict[str, float]:
+    res: dict[str, float] = {}
+    if opts.get("num_cpus"):
+        res["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    for k, v in (opts.get("resources") or {}).items():
+        res[k] = float(v)
+    return res
+
+
+def extract_arg_refs(args: tuple, kwargs: dict) -> list[ObjectRef]:
+    refs = [a for a in args if isinstance(a, ObjectRef)]
+    refs += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+    refs += serialization.find_nested_refs(
+        [a for a in args if not isinstance(a, ObjectRef)]
+        + [v for v in kwargs.values() if not isinstance(v, ObjectRef)]
+    )
+    return refs
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: dict[str, Any]):
+        self._fn = fn
+        self._options = {**_DEFAULT_TASK_OPTIONS, **options}
+        self._fn_blob: bytes | None = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._fn.__name__!r} cannot be called directly; "
+            f"use {self._fn.__name__}.remote(...)"
+        )
+
+    def options(self, **overrides) -> "RemoteFunction":
+        new = RemoteFunction(self._fn, {**self._options, **overrides})
+        new._fn_blob = self._fn_blob
+        return new
+
+    def remote(self, *args, **kwargs):
+        worker = global_worker
+        worker.check_connected()
+        if self._fn_blob is None:
+            self._fn_blob = serialization.dumps_function(self._fn)
+        opts = self._options
+        arg_refs = extract_arg_refs(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.of(worker.job_id),
+            job_id=worker.job_id,
+            fn_blob=self._fn_blob,
+            args_blob=serialization.serialize((args, kwargs)),
+            arg_ref_ids=[r.id for r in arg_refs],
+            arg_owner_ids=[r.owner_id for r in arg_refs],
+            num_returns=opts["num_returns"],
+            resources=_build_resources(opts),
+            max_retries=opts["max_retries"],
+            retry_exceptions=bool(opts["retry_exceptions"]),
+            scheduling_strategy=opts["scheduling_strategy"] or SchedulingStrategy(),
+            runtime_env=opts["runtime_env"],
+            name=opts["name"] or self._fn.__name__,
+            owner_id=worker.worker_id,
+        )
+        refs = worker.runtime.submit_task(spec)
+        if opts["num_returns"] == 1:
+            return refs[0]
+        return refs
+
+
+def remote(*args, **kwargs):
+    """`@remote` / `@remote(num_cpus=2, ...)` for functions and classes."""
+    from ray_tpu.core.actor import ActorClass
+
+    def decorate(target, options):
+        if isinstance(target, type):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return decorate(args[0], {})
+    if args:
+        raise TypeError("remote() takes keyword options only, e.g. @remote(num_cpus=2)")
+
+    def wrapper(target):
+        return decorate(target, kwargs)
+
+    return wrapper

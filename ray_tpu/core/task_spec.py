@@ -1,0 +1,81 @@
+"""Task/actor specifications — the unit of scheduling.
+
+Capability parity with the reference's TaskSpecification / lease specs
+(reference: src/ray/common/lease/ + protobuf common.proto TaskSpec): a task
+names a serialized function, serialized args with out-of-band ObjectRefs,
+a resource-shape demand, retry policy, and a scheduling strategy. The
+(resources × function × runtime-env) tuple forms the SchedulingKey used for
+worker-lease reuse (reference: normal_task_submitter.h:52).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.utils.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+
+
+@dataclass
+class SchedulingStrategy:
+    """DEFAULT (hybrid pack/spread), SPREAD, node-affinity, or PG bundle."""
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id_hex: str | None = None
+    soft: bool = False
+    placement_group_id_hex: str | None = None
+    bundle_index: int = -1
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    fn_blob: bytes  # cloudpickled callable (or method name for actor tasks)
+    args_blob: bytes  # serialized (args, kwargs) with refs replaced by markers
+    arg_ref_ids: list[ObjectID] = field(default_factory=list)
+    arg_owner_ids: list[WorkerID | None] = field(default_factory=list)
+    num_returns: int = 1
+    resources: dict[str, float] = field(default_factory=dict)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    runtime_env: dict[str, Any] | None = None
+    name: str = ""
+    owner_id: WorkerID | None = None
+
+    # actor-task fields
+    actor_id: ActorID | None = None
+    method_name: str | None = None
+    seq_no: int = -1
+
+    @property
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None and self.method_name is not None
+
+    def return_ids(self) -> list[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def scheduling_key(self) -> tuple:
+        env_key = tuple(sorted((self.runtime_env or {}).items())) if self.runtime_env else ()
+        res_key = tuple(sorted(self.resources.items()))
+        return (res_key, env_key)
+
+
+@dataclass
+class ActorCreationSpec:
+    actor_id: ActorID
+    job_id: JobID
+    cls_blob: bytes  # cloudpickled class
+    args_blob: bytes
+    arg_ref_ids: list[ObjectID] = field(default_factory=list)
+    resources: dict[str, float] = field(default_factory=dict)
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    name: str | None = None  # named-actor registration
+    namespace: str = "default"
+    lifetime: str = "non_detached"  # or "detached"
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    runtime_env: dict[str, Any] | None = None
+    owner_id: WorkerID | None = None

@@ -1,0 +1,103 @@
+"""Typed, env-overridable runtime configuration flags.
+
+Same capability as the reference's RAY_CONFIG X-macro table
+(reference: src/ray/common/ray_config_def.h — 233 flags, overridable via
+``RAY_<name>`` env vars or a system-config JSON): a single registry of typed
+flags with defaults, overridable per-process via ``RTPU_<NAME>`` environment
+variables or a dict passed to ``Config.load(overrides=...)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RTPU_"
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    return value
+
+
+@dataclass
+class Config:
+    """Runtime flags. Add new flags as dataclass fields; env var = RTPU_<UPPER_NAME>."""
+
+    # --- scheduling (reference: raylet scheduling policy knobs) ---
+    scheduler_spread_threshold: float = 0.5  # hybrid policy: local-first until this load
+    worker_lease_timeout_s: float = 30.0
+    max_workers_per_node: int = 64
+    worker_idle_ttl_s: float = 60.0  # idle pooled workers are reaped after this
+    worker_startup_concurrency: int = 8
+
+    # --- object store (reference: plasma + spilling thresholds, ray_config_def.h:680-697) ---
+    object_store_memory_bytes: int = 2 * 1024**3
+    object_spilling_threshold: float = 0.8
+    min_spilling_size_bytes: int = 100 * 1024**2
+    max_fused_object_count: int = 2000
+    inline_object_max_bytes: int = 100 * 1024  # small results ride in RPC replies
+
+    # --- control plane ---
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 5.0
+    health_check_failure_threshold: int = 5
+    gcs_pubsub_poll_timeout_s: float = 30.0
+    actor_max_restarts_default: int = 0
+
+    # --- core worker ---
+    task_retry_delay_s: float = 0.1
+    max_lineage_bytes: int = 64 * 1024**2
+    max_direct_call_object_size: int = 100 * 1024
+    task_events_buffer_size: int = 10000
+
+    # --- tpu ---
+    tpu_visible_chips_env: str = "TPU_VISIBLE_CHIPS"
+    tpu_premapped_buffer_bytes: int = 0  # 0 = library default
+
+    # --- misc ---
+    temp_dir: str = field(default_factory=lambda: os.environ.get("RTPU_TEMP_DIR", "/tmp/ray_tpu"))
+    log_level: str = "INFO"
+
+    @classmethod
+    def load(cls, overrides: dict[str, Any] | None = None) -> "Config":
+        cfg = cls()
+        for f in fields(cls):
+            env_key = _ENV_PREFIX + f.name.upper()
+            if env_key in os.environ:
+                typ = type(getattr(cfg, f.name))
+                setattr(cfg, f.name, _coerce(os.environ[env_key], typ))
+        for k, v in (overrides or {}).items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown config flag: {k}")
+            setattr(cfg, k, v)
+        return cfg
+
+    @classmethod
+    def from_json(cls, payload: str) -> "Config":
+        return cls.load(json.loads(payload))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.load()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg
