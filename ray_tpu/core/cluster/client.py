@@ -1,0 +1,91 @@
+"""Driver-side cluster bootstrap.
+
+Capability parity with the reference's driver connect path (reference:
+python/ray/_private/worker.py connect :2476 + node.py start_ray_processes
+:1351 for standalone `ray.init()` which launches gcs + raylet): connecting
+with ``address="local-cluster"`` boots an in-process head + node daemon (the
+daemon still forks real worker subprocesses); ``address="host:port"``
+attaches to a running head and adopts one of its nodes as the local lease
+target.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from ray_tpu.core.cluster.head import HeadServer
+from ray_tpu.core.cluster.node_daemon import NodeDaemon
+from ray_tpu.core.cluster.protocol import EventLoopThread, RpcClient
+from ray_tpu.core.cluster.runtime import ClusterRuntime
+
+
+class _LocalClusterHandles:
+    """Keeps head/daemon alive for a driver-embedded cluster; torn down on
+    runtime.shutdown()."""
+
+    def __init__(self, head: HeadServer, daemons: list[NodeDaemon]):
+        self.head = head
+        self.daemons = daemons
+
+
+def start_head(host: str = "127.0.0.1", port: int = 0) -> HeadServer:
+    io = EventLoopThread.get()
+    head = HeadServer(host, port)
+    io.run(head.start())
+    return head
+
+
+def start_node(head_host: str, head_port: int, resources: dict[str, float],
+               labels: dict[str, str] | None = None,
+               node_id: str | None = None) -> NodeDaemon:
+    io = EventLoopThread.get()
+    daemon = NodeDaemon(head_host, head_port, node_id or uuid.uuid4().hex,
+                        resources, labels)
+    io.run(daemon.start())
+    return daemon
+
+
+def connect_cluster(address: str, num_cpus: float | None = None,
+                    resources: dict[str, float] | None = None) -> ClusterRuntime:
+    if address == "local-cluster":
+        totals = {"CPU": float(num_cpus if num_cpus is not None else 8)}
+        totals.update(resources or {})
+        head = start_head()
+        daemon = start_node(head.rpc.host, head.rpc.port, totals)
+        rt = ClusterRuntime(head.rpc.host, head.rpc.port,
+                            node_daemon_addr=(daemon.rpc.host, daemon.rpc.port))
+        rt._local_cluster = _LocalClusterHandles(head, [daemon])
+        _wrap_shutdown(rt)
+        return rt
+    host, port = address.rsplit(":", 1)
+    # Adopt the first alive node as the local lease target.
+    probe = RpcClient(host, int(port))
+    nodes = probe.call("list_nodes")
+    probe.close()
+    daemon_addr = None
+    for info in nodes.values():
+        if info["alive"]:
+            daemon_addr = tuple(info["addr"])
+            break
+    rt = ClusterRuntime(host, int(port), node_daemon_addr=daemon_addr)
+    return rt
+
+
+def _wrap_shutdown(rt: ClusterRuntime):
+    io = EventLoopThread.get()
+    handles: _LocalClusterHandles = rt._local_cluster
+    orig = rt.shutdown
+
+    def shutdown():
+        orig()
+        for d in handles.daemons:
+            try:
+                io.run(d.stop())
+            except Exception:
+                pass
+        try:
+            io.run(handles.head.stop())
+        except Exception:
+            pass
+
+    rt.shutdown = shutdown

@@ -1,0 +1,362 @@
+"""Head control plane: node registry, actor directory/FSM, KV, pubsub,
+cluster resource view, worker directory.
+
+Capability parity with the reference's GCS server (reference:
+src/ray/gcs/gcs_server.cc GcsServer::DoStart :267 wiring GcsNodeManager,
+GcsActorManager (actor FSM, gcs_actor_manager.cc:308 HandleRegisterActor),
+GcsHealthCheckManager (gcs_health_check_manager.h:45), internal KV
+(gcs_kv_manager.cc), pubsub, GcsResourceManager): one asyncio process that is
+the source of truth for cluster membership, actor placement/lifetime, and
+named entities. Fault-tolerance backing store is pluggable later (the
+reference optionally persists to Redis); this build keeps tables in memory.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.core.cluster.protocol import RpcServer, ServerConnection
+from ray_tpu.utils.config import get_config
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    addr: tuple[str, int]  # node daemon RPC address
+    resources: dict[str, float]
+    labels: dict[str, str] = field(default_factory=dict)
+    available: dict[str, float] = field(default_factory=dict)
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    alive: bool = True
+
+
+@dataclass
+class ActorInfo:
+    actor_id: str
+    state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+    node_id: str | None = None
+    worker_addr: tuple[str, int] | None = None
+    name: str | None = None
+    namespace: str = "default"
+    spec_blob: bytes | None = None
+    resources: dict[str, float] = field(default_factory=dict)
+    max_restarts: int = 0
+    restarts_used: int = 0
+    death_reason: str = ""
+    owner_node: str | None = None
+    lifetime: str = "non_detached"
+
+
+class HeadServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.rpc = RpcServer(host, port)
+        self.nodes: dict[str, NodeInfo] = {}
+        self.actors: dict[str, ActorInfo] = {}
+        self.named_actors: dict[tuple[str, str], str] = {}
+        self.kv: dict[str, dict[str, bytes]] = {}  # namespace -> key -> value
+        self.workers: dict[str, tuple[str, int]] = {}  # worker_id -> rpc addr
+        self._subs: dict[str, set[ServerConnection]] = {}  # channel -> conns
+        self._node_conns: dict[str, ServerConnection] = {}
+        self._register_handlers()
+        self._health_task: asyncio.Task | None = None
+        self.placement_groups = None  # attached by placement_group module
+
+    # ------------------------------------------------------------------ wiring
+    def _register_handlers(self):
+        r = self.rpc.register
+        r("register_node", self._register_node)
+        r("heartbeat", self._heartbeat)
+        r("drain_node", self._drain_node)
+        r("list_nodes", self._list_nodes)
+        r("register_worker", self._register_worker)
+        r("resolve_worker", self._resolve_worker)
+        r("register_actor", self._register_actor)
+        r("actor_ready", self._actor_ready)
+        r("actor_failed", self._actor_failed)
+        r("get_actor_info", self._get_actor_info)
+        r("get_named_actor", self._get_named_actor)
+        r("kill_actor", self._kill_actor)
+        r("kv_put", self._kv_put)
+        r("kv_get", self._kv_get)
+        r("kv_del", self._kv_del)
+        r("kv_keys", self._kv_keys)
+        r("subscribe", self._subscribe)
+        r("cluster_resources", self._cluster_resources)
+        r("available_resources", self._available_resources)
+        self.rpc.on_disconnect = self._on_disconnect
+
+    async def start(self) -> tuple[str, int]:
+        addr = await self.rpc.start()
+        self._health_task = asyncio.get_running_loop().create_task(self._health_loop())
+        return addr
+
+    async def stop(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self.rpc.stop()
+
+    # ------------------------------------------------------------------ pubsub
+    # (reference: src/ray/pubsub long-poll channels; here: server-push over the
+    # persistent connection — same delivery guarantees for connected subs)
+    async def _subscribe(self, conn: ServerConnection, channel: str):
+        self._subs.setdefault(channel, set()).add(conn)
+        return True
+
+    async def publish(self, channel: str, **payload):
+        dead = []
+        for conn in self._subs.get(channel, ()):  # snapshot-free: set is small
+            try:
+                await conn.notify("pub", channel=channel, payload=payload)
+            except Exception:
+                dead.append(conn)
+        for c in dead:
+            self._subs.get(channel, set()).discard(c)
+
+    def _on_disconnect(self, conn: ServerConnection):
+        for subs in self._subs.values():
+            subs.discard(conn)
+        node_id = conn.meta.get("node_id")
+        if node_id and self._node_conns.get(node_id) is conn:
+            # Node daemon connection dropped: mark suspect; health loop decides.
+            info = self.nodes.get(node_id)
+            if info:
+                info.last_heartbeat = -1e18  # force failure at next check
+
+    # ------------------------------------------------------------------ nodes
+    async def _register_node(
+        self, conn: ServerConnection, node_id: str, host: str, port: int,
+        resources: dict, labels: dict | None = None,
+    ):
+        self.nodes[node_id] = NodeInfo(
+            node_id=node_id, addr=(host, port), resources=dict(resources),
+            available=dict(resources), labels=labels or {},
+        )
+        conn.meta["node_id"] = node_id
+        self._node_conns[node_id] = conn
+        await self.publish("node_events", event="added", node_id=node_id)
+        return {"ok": True}
+
+    async def _heartbeat(self, conn: ServerConnection, node_id: str, available: dict):
+        info = self.nodes.get(node_id)
+        if info is None:
+            return {"ok": False, "reregister": True}
+        info.last_heartbeat = time.monotonic()
+        info.available = available
+        return {"ok": True}
+
+    async def _drain_node(self, conn: ServerConnection, node_id: str):
+        # Graceful removal (reference: NodeManager::HandleDrainRaylet :2129).
+        info = self.nodes.get(node_id)
+        if info:
+            info.alive = False
+            await self.publish("node_events", event="removed", node_id=node_id)
+        return {"ok": True}
+
+    async def _list_nodes(self, conn: ServerConnection):
+        return {
+            nid: {
+                "addr": list(n.addr), "resources": n.resources,
+                "available": n.available, "alive": n.alive, "labels": n.labels,
+            }
+            for nid, n in self.nodes.items()
+        }
+
+    async def _health_loop(self):
+        # reference: GcsHealthCheckManager periodic pings; here heartbeat ages.
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.health_check_period_s)
+            now = time.monotonic()
+            threshold = cfg.health_check_period_s * cfg.health_check_failure_threshold
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > threshold:
+                    node.alive = False
+                    await self.publish("node_events", event="died", node_id=node.node_id)
+                    await self._fail_actors_on_node(node.node_id)
+
+    async def _fail_actors_on_node(self, node_id: str):
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in ("ALIVE", "PENDING"):
+                await self._handle_actor_death(actor, f"node {node_id[:8]} died")
+
+    # ------------------------------------------------------------------ workers
+    async def _register_worker(self, conn: ServerConnection, worker_id: str, host: str, port: int):
+        self.workers[worker_id] = (host, port)
+        return {"ok": True}
+
+    async def _resolve_worker(self, conn: ServerConnection, worker_id: str):
+        addr = self.workers.get(worker_id)
+        return {"addr": list(addr) if addr else None}
+
+    # ------------------------------------------------------------------ actors
+    # FSM parity: reference gcs_actor_manager.cc — REGISTER → schedule (lease
+    # on a node) → ALIVE; on failure RESTARTING (≤ max_restarts) or DEAD.
+    async def _register_actor(
+        self, conn: ServerConnection, actor_id: str, spec_blob: bytes,
+        resources: dict, name: str | None, namespace: str, max_restarts: int,
+        lifetime: str = "non_detached",
+        node_affinity: str | None = None, labels: dict | None = None,
+    ):
+        if name:
+            key = (namespace, name)
+            if key in self.named_actors:
+                return {"ok": False, "error": f"name {name!r} taken in {namespace!r}"}
+        info = ActorInfo(
+            actor_id=actor_id, spec_blob=spec_blob, resources=dict(resources),
+            name=name, namespace=namespace, max_restarts=max_restarts,
+            lifetime=lifetime,
+        )
+        self.actors[actor_id] = info
+        if name:
+            self.named_actors[(namespace, name)] = actor_id
+        ok = await self._schedule_actor(info, node_affinity=node_affinity, labels=labels)
+        if not ok:
+            info.state = "DEAD"
+            info.death_reason = "no feasible node"
+            return {"ok": False, "error": "no feasible node for actor resources"}
+        return {"ok": True}
+
+    def _pick_node(self, resources: dict[str, float], node_affinity: str | None = None,
+                   labels: dict | None = None) -> NodeInfo | None:
+        # Least-loaded feasible node (reference default is hybrid pack/spread;
+        # actors spread by load — gcs_actor_scheduler picks via cluster view).
+        candidates = []
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            if node_affinity and n.node_id != node_affinity:
+                continue
+            if labels and any(n.labels.get(k) != v for k, v in labels.items()):
+                continue
+            if all(n.resources.get(k, 0.0) >= v for k, v in resources.items()):
+                free = sum(n.available.get(k, 0.0) for k in ("CPU",))
+                candidates.append((-free, n.node_id, n))
+        if not candidates:
+            return None
+        candidates.sort()
+        return candidates[0][2]
+
+    async def _schedule_actor(self, info: ActorInfo, node_affinity: str | None = None,
+                              labels: dict | None = None) -> bool:
+        node = self._pick_node(info.resources, node_affinity, labels)
+        if node is None:
+            return False
+        info.node_id = node.node_id
+        conn = self._node_conns.get(node.node_id)
+        if conn is None:
+            return False
+        # Ask the node daemon to place the actor in a fresh/pooled worker
+        # (reference: GcsActorScheduler leases a worker from the raylet).
+        await conn.notify(
+            "place_actor", actor_id=info.actor_id, spec_blob=info.spec_blob,
+            resources=info.resources,
+        )
+        return True
+
+    async def _actor_ready(self, conn: ServerConnection, actor_id: str, worker_id: str,
+                           host: str, port: int):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return {"ok": False}
+        info.worker_addr = (host, port)
+        info.state = "ALIVE"
+        await self.publish("actor_events", actor_id=actor_id, state="ALIVE",
+                           addr=[host, port])
+        return {"ok": True}
+
+    async def _actor_failed(self, conn: ServerConnection, actor_id: str, reason: str):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return {"ok": False}
+        await self._handle_actor_death(info, reason)
+        return {"ok": True}
+
+    async def _handle_actor_death(self, info: ActorInfo, reason: str):
+        if info.restarts_used < info.max_restarts:
+            info.restarts_used += 1
+            info.state = "RESTARTING"
+            await self.publish("actor_events", actor_id=info.actor_id, state="RESTARTING")
+            if await self._schedule_actor(info):
+                return
+            reason = f"{reason}; restart found no feasible node"
+        info.state = "DEAD"
+        info.death_reason = reason
+        if info.name:
+            self.named_actors.pop((info.namespace, info.name), None)
+        await self.publish("actor_events", actor_id=info.actor_id, state="DEAD",
+                           reason=reason)
+
+    async def _get_actor_info(self, conn: ServerConnection, actor_id: str):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return None
+        return {
+            "state": info.state,
+            "addr": list(info.worker_addr) if info.worker_addr else None,
+            "reason": info.death_reason,
+        }
+
+    async def _get_named_actor(self, conn: ServerConnection, name: str, namespace: str):
+        actor_id = self.named_actors.get((namespace, name))
+        return {"actor_id": actor_id}
+
+    async def _kill_actor(self, conn: ServerConnection, actor_id: str, no_restart: bool):
+        info = self.actors.get(actor_id)
+        if info is None or info.state == "DEAD":
+            return {"ok": True}
+        if no_restart:
+            info.max_restarts = info.restarts_used  # suppress further restarts
+        if info.worker_addr:
+            # Tell the hosting worker to tear the actor down.
+            node = self.nodes.get(info.node_id)
+            nconn = self._node_conns.get(info.node_id) if node else None
+            if nconn is not None:
+                await nconn.notify("kill_actor", actor_id=actor_id)
+        await self._handle_actor_death(info, "killed via kill()")
+        return {"ok": True}
+
+    # ------------------------------------------------------------------ KV
+    # (reference: gcs_kv_manager.cc internal KV — function/code storage, serve
+    # config, usage flags all live here)
+    async def _kv_put(self, conn: ServerConnection, ns: str, key: str, value: bytes,
+                      overwrite: bool = True):
+        table = self.kv.setdefault(ns, {})
+        if not overwrite and key in table:
+            return {"ok": False}
+        table[key] = value
+        return {"ok": True}
+
+    async def _kv_get(self, conn: ServerConnection, ns: str, key: str):
+        return {"value": self.kv.get(ns, {}).get(key)}
+
+    async def _kv_del(self, conn: ServerConnection, ns: str, key: str):
+        return {"ok": self.kv.get(ns, {}).pop(key, None) is not None}
+
+    async def _kv_keys(self, conn: ServerConnection, ns: str, prefix: str = ""):
+        return {"keys": [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]}
+
+    # ------------------------------------------------------------------ resources
+    async def _cluster_resources(self, conn: ServerConnection):
+        out: dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.resources.items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
+
+    async def _available_resources(self, conn: ServerConnection):
+        out: dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.available.items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
+
+
+async def run_head(host: str = "127.0.0.1", port: int = 0) -> HeadServer:
+    head = HeadServer(host, port)
+    await head.start()
+    return head

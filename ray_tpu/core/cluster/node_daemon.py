@@ -1,0 +1,343 @@
+"""Per-node daemon: worker pool + lease-based local scheduler.
+
+Capability parity with the reference's raylet (reference: src/ray/raylet/ —
+NodeManager::HandleRequestWorkerLease node_manager.cc:1781 grants workers to
+task submitters; WorkerPool worker_pool.h:283 forks/pools language workers
+with idle TTL and startup-concurrency caps; LocalLeaseManager queues grants
+against local resource availability; infeasible/overloaded requests spill to
+another node chosen from the cluster view kept fresh by the syncer —
+src/ray/ray_syncer/, here: heartbeats carry the availability view).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ray_tpu.core.cluster.protocol import AsyncRpcClient, RpcServer, ServerConnection
+from ray_tpu.utils.config import get_config
+
+
+@dataclass
+class WorkerProc:
+    worker_id: str  # assigned at registration
+    proc: subprocess.Popen
+    addr: tuple[str, int] | None = None
+    idle_since: float = field(default_factory=time.monotonic)
+    lease_id: str | None = None  # None => idle
+    actor_id: str | None = None  # dedicated to an actor
+    resources: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class _PendingLease:
+    resources: dict[str, float]
+    fut: asyncio.Future
+
+
+class NodeDaemon:
+    def __init__(
+        self,
+        head_host: str,
+        head_port: int,
+        node_id: str,
+        resources: dict[str, float],
+        labels: dict[str, str] | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.node_id = node_id
+        self.head_addr = (head_host, head_port)
+        self.rpc = RpcServer(host, port)
+        self.resources = dict(resources)
+        self.available = dict(resources)
+        self.labels = labels or {}
+        self.workers: dict[str, WorkerProc] = {}  # keyed by worker_id
+        self._unregistered: list[WorkerProc] = []  # forked, not yet registered
+        self._pending: list[_PendingLease] = []
+        self._head: AsyncRpcClient | None = None
+        self._leases: dict[str, WorkerProc] = {}
+        self._actor_workers: dict[str, WorkerProc] = {}
+        self._register_handlers()
+        self._bg: list[asyncio.Task] = []
+
+    def _register_handlers(self):
+        r = self.rpc.register
+        r("register_worker_proc", self._register_worker_proc)
+        r("request_lease", self._request_lease)
+        r("return_lease", self._return_lease)
+        r("node_info", self._node_info)
+        r("ping", self._ping)
+
+    async def _ping(self, conn, **kw):
+        return {"ok": True, "node_id": self.node_id}
+
+    async def start(self) -> tuple[str, int]:
+        addr = await self.rpc.start()
+        self._head = AsyncRpcClient(*self.head_addr)
+        await self._head.connect()
+        self._head.on_notify("place_actor", self._place_actor)
+        self._head.on_notify("kill_actor", self._kill_actor)
+        await self._head.call(
+            "register_node", node_id=self.node_id, host=addr[0], port=addr[1],
+            resources=self.resources, labels=self.labels,
+        )
+        loop = asyncio.get_running_loop()
+        self._bg.append(loop.create_task(self._heartbeat_loop()))
+        self._bg.append(loop.create_task(self._reap_loop()))
+        return addr
+
+    async def stop(self):
+        for t in self._bg:
+            t.cancel()
+        for w in list(self.workers.values()) + self._unregistered:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        await self.rpc.stop()
+
+    # ------------------------------------------------------------------ workers
+    def _fork_worker(self) -> WorkerProc:
+        # reference: WorkerPool::StartWorkerProcess — fork via the language
+        # worker command; here: python -m ray_tpu.core.cluster.worker_main.
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RTPU_HEAD"] = f"{self.head_addr[0]}:{self.head_addr[1]}"
+        env["RTPU_NODE_DAEMON"] = f"{self.rpc.host}:{self.rpc.port}"
+        env["RTPU_NODE_ID"] = self.node_id
+        env["RTPU_PARENT_PID"] = str(os.getpid())
+        log_dir = os.path.join(get_config().temp_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log = open(os.path.join(log_dir, f"worker-{self.node_id[:8]}-{time.time_ns()}.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.cluster.worker_main"],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        log.close()
+        wp = WorkerProc(worker_id="", proc=proc)
+        self._unregistered.append(wp)
+        return wp
+
+    async def _register_worker_proc(self, conn: ServerConnection, worker_id: str,
+                                    host: str, port: int, pid: int):
+        wp = None
+        for cand in self._unregistered:
+            if cand.proc.pid == pid:
+                wp = cand
+                break
+        if wp is None:
+            wp = WorkerProc(worker_id=worker_id, proc=None)  # adopted (tests)
+        else:
+            self._unregistered.remove(wp)
+        wp.worker_id = worker_id
+        wp.addr = (host, port)
+        wp.idle_since = time.monotonic()
+        self.workers[worker_id] = wp
+        conn.meta["worker_id"] = worker_id
+        self._try_grant()
+        return {"ok": True}
+
+    async def _reap_loop(self):
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.worker_idle_ttl_s / 4)
+            now = time.monotonic()
+            for wid, w in list(self.workers.items()):
+                if (
+                    w.lease_id is None and w.actor_id is None
+                    and now - w.idle_since > cfg.worker_idle_ttl_s
+                    and w.proc is not None
+                ):
+                    w.proc.terminate()
+                    del self.workers[wid]
+                if w.proc is not None and w.proc.poll() is not None:
+                    # Worker process died.
+                    self.workers.pop(wid, None)
+                    if w.lease_id or w.actor_id:
+                        self._release_resources(w.resources)
+                    if w.actor_id and self._head:
+                        await self._head.call(
+                            "actor_failed", actor_id=w.actor_id,
+                            reason=f"worker process exited with {w.proc.returncode}",
+                        )
+
+    async def _heartbeat_loop(self):
+        cfg = get_config()
+        while True:
+            try:
+                await self._head.call("heartbeat", node_id=self.node_id,
+                                      available=self.available)
+            except Exception:
+                pass
+            await asyncio.sleep(cfg.health_check_period_s / 2)
+
+    # ------------------------------------------------------------------ leases
+    # reference protocol: HandleRequestWorkerLease → grant | spillback;
+    # ReturnWorkerLease frees the worker back into the pool.
+    def _fits(self, demand: dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) >= v for k, v in demand.items())
+
+    def _feasible(self, demand: dict[str, float]) -> bool:
+        return all(self.resources.get(k, 0.0) >= v for k, v in demand.items())
+
+    def _take_resources(self, demand: dict[str, float]):
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+
+    def _release_resources(self, demand: dict[str, float]):
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    async def _request_lease(self, conn: ServerConnection, resources: dict,
+                             timeout: float | None = None):
+        if not self._feasible(resources):
+            # Spillback: find a feasible node from the head's view
+            # (reference: cluster_lease_manager spills to best remote node).
+            nodes = await self._head.call("list_nodes")
+            for nid, info in nodes.items():
+                if nid == self.node_id or not info["alive"]:
+                    continue
+                if all(info["resources"].get(k, 0.0) >= v for k, v in resources.items()):
+                    return {"spill": info["addr"]}
+            return {"error": f"infeasible resource demand {resources}"}
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append(_PendingLease(dict(resources), fut))
+        self._try_grant()
+        cfg = get_config()
+        try:
+            return await asyncio.wait_for(fut, timeout or cfg.worker_lease_timeout_s)
+        except asyncio.TimeoutError:
+            return {"error": "lease timeout"}
+
+    def _idle_worker(self) -> WorkerProc | None:
+        for w in self.workers.values():
+            if w.lease_id is None and w.actor_id is None and w.addr is not None:
+                return w
+        return None
+
+    def _try_grant(self):
+        cfg = get_config()
+        still: list[_PendingLease] = []
+        for req in self._pending:
+            if req.fut.done():
+                continue
+            if not self._fits(req.resources):
+                still.append(req)
+                continue
+            w = self._idle_worker()
+            if w is None:
+                starting = len(self._unregistered)
+                if starting < cfg.worker_startup_concurrency and (
+                    len(self.workers) + starting < cfg.max_workers_per_node
+                ):
+                    self._fork_worker()
+                still.append(req)
+                continue
+            lease_id = uuid.uuid4().hex
+            w.lease_id = lease_id
+            w.resources = req.resources
+            self._take_resources(req.resources)
+            self._leases[lease_id] = w
+            req.fut.set_result({
+                "lease_id": lease_id, "worker_id": w.worker_id,
+                "addr": list(w.addr),
+            })
+        self._pending = still
+
+    async def _return_lease(self, conn: ServerConnection, lease_id: str):
+        w = self._leases.pop(lease_id, None)
+        if w is not None:
+            self._release_resources(w.resources)
+            w.lease_id = None
+            w.resources = {}
+            w.idle_since = time.monotonic()
+            self._try_grant()
+        return {"ok": True}
+
+    async def _node_info(self, conn: ServerConnection):
+        return {
+            "node_id": self.node_id, "resources": self.resources,
+            "available": self.available, "workers": len(self.workers),
+        }
+
+    # ------------------------------------------------------------------ actors
+    async def _place_actor(self, actor_id: str, spec_blob: bytes, resources: dict):
+        # Dedicated worker per actor (reference: actor creation leases a worker
+        # which then becomes the actor's home for its lifetime).
+        try:
+            if not self._fits(resources):
+                if not self._feasible(resources):
+                    await self._head.call("actor_failed", actor_id=actor_id,
+                                          reason="infeasible on assigned node")
+                    return
+                # wait for resources to free up
+                for _ in range(200):
+                    await asyncio.sleep(0.05)
+                    if self._fits(resources):
+                        break
+                else:
+                    await self._head.call("actor_failed", actor_id=actor_id,
+                                          reason="timed out waiting for resources")
+                    return
+            w = self._idle_worker()
+            if w is None:
+                self._fork_worker()
+                for _ in range(600):
+                    await asyncio.sleep(0.05)
+                    w = self._idle_worker()
+                    if w is not None:
+                        break
+                else:
+                    await self._head.call("actor_failed", actor_id=actor_id,
+                                          reason="worker start timeout")
+                    return
+            w.actor_id = actor_id
+            w.resources = dict(resources)
+            self._take_resources(resources)
+            self._actor_workers[actor_id] = w
+            client = AsyncRpcClient(*w.addr)
+            await client.connect()
+            result = await client.call("init_actor", actor_id=actor_id,
+                                       spec_blob=spec_blob)
+            await client.close()
+            if result.get("ok"):
+                await self._head.call("actor_ready", actor_id=actor_id,
+                                      worker_id=w.worker_id,
+                                      host=w.addr[0], port=w.addr[1])
+            else:
+                self._release_resources(resources)
+                w.actor_id = None
+                await self._head.call("actor_failed", actor_id=actor_id,
+                                      reason=result.get("error", "init failed"))
+        except Exception as e:  # noqa: BLE001
+            try:
+                await self._head.call("actor_failed", actor_id=actor_id,
+                                      reason=f"placement error: {e}")
+            except Exception:
+                pass
+
+    async def _kill_actor(self, actor_id: str):
+        w = self._actor_workers.pop(actor_id, None)
+        if w is None:
+            return
+        self._release_resources(w.resources)
+        if w.proc is not None:
+            w.proc.terminate()
+        self.workers.pop(w.worker_id, None)
+
+
+async def run_node_daemon(head_host, head_port, node_id, resources, labels=None,
+                          host="127.0.0.1", port=0) -> NodeDaemon:
+    daemon = NodeDaemon(head_host, head_port, node_id, resources, labels, host, port)
+    await daemon.start()
+    return daemon

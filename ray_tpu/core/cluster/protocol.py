@@ -1,0 +1,285 @@
+"""RPC substrate: length-prefixed msgpack frames over asyncio TCP.
+
+Fills the role of the reference's gRPC plumbing (reference: src/ray/rpc/ —
+server/client wrappers, client pools with reconnect): a tiny asymmetric RPC
+with request/response correlation, one-way notifications, and long-poll
+support. Every daemon (head, node daemon, worker) runs an ``RpcServer`` with
+named handlers; clients are ``RpcClient``s usable from sync or async code.
+
+Binary payloads (serialized objects) ride as msgpack bin values — no base64,
+no copies beyond the socket buffers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import socket
+import struct
+import threading
+from typing import Any, Awaitable, Callable
+
+import msgpack
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+def _pack(msg: dict) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> dict | None:
+    try:
+        hdr = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    body = await reader.readexactly(n)
+    return msgpack.unpackb(body, raw=False)
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcConnectionLost(RpcError):
+    pass
+
+
+class RpcServer:
+    """Asyncio TCP server dispatching {"m": method, ...} frames to handlers.
+
+    Handlers are ``async def handler(conn, **kwargs) -> Any``; the return
+    value is sent back as the response. Raising sends an error frame.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: dict[str, Callable[..., Awaitable[Any]]] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set["ServerConnection"] = set()
+        self.on_disconnect: Callable[["ServerConnection"], None] | None = None
+
+    def handler(self, name: str):
+        def deco(fn):
+            self._handlers[name] = fn
+            return fn
+
+        return deco
+
+    def register(self, name: str, fn: Callable[..., Awaitable[Any]]):
+        self._handlers[name] = fn
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = ServerConnection(self, reader, writer)
+        self._conns.add(conn)
+        try:
+            await conn.serve()
+        finally:
+            self._conns.discard(conn)
+            if self.on_disconnect:
+                try:
+                    self.on_disconnect(conn)
+                except Exception:
+                    pass
+            writer.close()
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            for conn in list(self._conns):
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
+
+
+class ServerConnection:
+    """One accepted client connection; supports server-push notifications."""
+
+    def __init__(self, server: RpcServer, reader, writer):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.meta: dict[str, Any] = {}  # handler-attached identity (node id, etc.)
+        self._wlock = asyncio.Lock()
+
+    async def serve(self):
+        while True:
+            msg = await _read_frame(self.reader)
+            if msg is None:
+                return
+            asyncio.get_running_loop().create_task(self._dispatch(msg))
+
+    async def _dispatch(self, msg: dict):
+        method, rid = msg.get("m"), msg.get("i")
+        fn = self.server._handlers.get(method)
+        if fn is None:
+            await self._reply(rid, err=f"no such method: {method}")
+            return
+        try:
+            result = await fn(self, **msg.get("a", {}))
+            if rid is not None:
+                await self._reply(rid, ok=result)
+        except Exception as e:  # noqa: BLE001
+            if rid is not None:
+                await self._reply(rid, err=f"{type(e).__name__}: {e}")
+
+    async def _reply(self, rid, ok=None, err=None):
+        frame = {"r": rid, "e": err} if err is not None else {"r": rid, "o": ok}
+        async with self._wlock:
+            try:
+                self.writer.write(_pack(frame))
+                await self.writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def notify(self, method: str, **kwargs):
+        """Server-initiated push (used by pubsub long-poll replacement)."""
+        async with self._wlock:
+            self.writer.write(_pack({"m": method, "a": kwargs}))
+            await self.writer.drain()
+
+
+class AsyncRpcClient:
+    """Async client half: call(method, **kwargs) with correlation ids."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._reader = None
+        self._writer = None
+        self._seq = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._wlock: asyncio.Lock | None = None
+        self._notify_handlers: dict[str, Callable[..., Awaitable[None]]] = {}
+        self._closed = False
+
+    def on_notify(self, method: str, fn: Callable[..., Awaitable[None]]):
+        self._notify_handlers[method] = fn
+
+    async def connect(self):
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        sock = self._writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = asyncio.Lock()
+        asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self):
+        while True:
+            msg = await _read_frame(self._reader)
+            if msg is None:
+                self._fail_all(RpcConnectionLost(f"connection to {self.host}:{self.port} lost"))
+                return
+            if "r" in msg:
+                fut = self._pending.pop(msg["r"], None)
+                if fut is not None and not fut.done():
+                    if msg.get("e") is not None:
+                        fut.set_exception(RpcError(msg["e"]))
+                    else:
+                        fut.set_result(msg.get("o"))
+            elif "m" in msg:
+                fn = self._notify_handlers.get(msg["m"])
+                if fn is not None:
+                    asyncio.get_running_loop().create_task(fn(**msg.get("a", {})))
+
+    def _fail_all(self, exc: Exception):
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    async def call(self, method: str, timeout: float | None = None, **kwargs) -> Any:
+        if self._closed:
+            raise RpcConnectionLost("client closed")
+        rid = next(self._seq)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        async with self._wlock:
+            self._writer.write(_pack({"m": method, "i": rid, "a": kwargs}))
+            await self._writer.drain()
+        return await asyncio.wait_for(fut, timeout)
+
+    async def notify(self, method: str, **kwargs):
+        async with self._wlock:
+            self._writer.write(_pack({"m": method, "a": kwargs}))
+            await self._writer.drain()
+
+    async def close(self):
+        self._closed = True
+        if self._writer:
+            self._writer.close()
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a background thread, shared per process.
+
+    Sync code (the user's driver / worker task code) calls ``run(coro)`` to
+    execute on the loop and block for the result.
+    """
+
+    _singleton: "EventLoopThread | None" = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._main, daemon=True, name="rtpu-io")
+        self._thread.start()
+
+    def _main(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def get(cls) -> "EventLoopThread":
+        with cls._lock:
+            if cls._singleton is None or not cls._singleton._thread.is_alive():
+                cls._singleton = cls()
+            return cls._singleton
+
+    def run(self, coro, timeout: float | None = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+
+class RpcClient:
+    """Sync façade over AsyncRpcClient via the process's io loop thread."""
+
+    def __init__(self, host: str, port: int):
+        self._io = EventLoopThread.get()
+        self._async = AsyncRpcClient(host, port)
+        self._io.run(self._async.connect(), timeout=10)
+
+    @property
+    def aio(self) -> AsyncRpcClient:
+        return self._async
+
+    def call(self, method: str, timeout: float | None = None, **kwargs) -> Any:
+        return self._io.run(self._async.call(method, timeout=timeout, **kwargs), timeout=timeout)
+
+    def notify(self, method: str, **kwargs) -> None:
+        self._io.run(self._async.notify(method, **kwargs))
+
+    def close(self):
+        try:
+            self._io.run(self._async.close())
+        except Exception:
+            pass

@@ -1,0 +1,531 @@
+"""ClusterRuntime: the per-process core-worker library for cluster mode.
+
+Capability parity with the reference's core_worker (reference:
+src/ray/core_worker/core_worker.cc — SubmitTask :1957 lease-based submission
+with worker reuse via NormalTaskSubmitter, Put :971 / Get :1290 owner-based
+object resolution, SubmitActorTask :2372 direct gRPC to the actor's worker):
+every process (driver or pooled worker) instantiates one ClusterRuntime. It
+owns a local object store, serves object fetches to peers, submits tasks via
+node-daemon leases, and talks to the head for actors/KV/named entities.
+
+Object protocol: the submitting worker *owns* task returns. Small results
+ride inline in the task reply and are stored at the owner (reference:
+max_direct_call_object_size); large results stay at the executor, the owner
+records the location, and readers fetch from the holder.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from ray_tpu.core.cluster.protocol import (
+    AsyncRpcClient,
+    EventLoopThread,
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.store import LocalObjectStore, ReferenceCounter
+from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
+from ray_tpu.utils import serialization
+from ray_tpu.utils.config import get_config
+from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, WorkerID
+
+import cloudpickle
+
+
+class _LeasedWorker:
+    def __init__(self, lease_id: str, worker_id: str, addr: tuple[str, int],
+                 client: AsyncRpcClient):
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.addr = addr
+        self.client = client
+        self.inflight = 0
+
+
+class ClusterRuntime:
+    """Runtime interface implementation backed by the cluster."""
+
+    MAX_INFLIGHT_PER_WORKER = 16
+
+    def __init__(self, head_host: str, head_port: int,
+                 node_daemon_addr: tuple[str, int] | None = None,
+                 is_worker: bool = False):
+        self.worker_id = WorkerID.from_random()
+        self.node_id = NodeID.from_random()
+        self.is_worker = is_worker
+        self.store = LocalObjectStore()
+        self.refs = ReferenceCounter(on_release=self.store.delete)
+        self._locations: dict[ObjectID, str] = {}  # owned oid -> holder worker hex
+        self._io = EventLoopThread.get()
+        self.head = RpcClient(head_host, head_port)
+        self._head_host, self._head_port = head_host, head_port
+        self.node_daemon_addr = node_daemon_addr
+        self._daemon = RpcClient(*node_daemon_addr) if node_daemon_addr else None
+        # Leases per scheduling key (reference: normal_task_submitter.h:52).
+        self._leases: dict[tuple, list[_LeasedWorker]] = {}
+        self._lease_lock = threading.Lock()
+        self._peer_clients: dict[tuple[str, int], RpcClient] = {}
+        self._peer_lock = threading.Lock()
+        self._actor_addr_cache: dict[str, tuple[str, int]] = {}
+        self._actor_queues: dict[str, Any] = {}
+        self._actor_queue_lock = threading.Lock()
+        self._actor_states: dict[str, str] = {}
+        self._cancelled: set[ObjectID] = set()
+        self._shutdown = False
+
+        # Serve object fetches (and, for workers, task execution) to peers.
+        self.server = RpcServer("127.0.0.1", 0)
+        self.server.register("get_object", self._handle_get_object)
+        self.server.register("free_object", self._handle_free_object)
+        self.server.register("report_location", self._handle_report_location)
+        self.server.register("ping", self._handle_ping)
+        self.addr = self._io.run(self.server.start())
+        self.head.call("register_worker", worker_id=self.worker_id.hex(),
+                       host=self.addr[0], port=self.addr[1])
+        # Actor state invalidation via pubsub.
+        self.head.aio.on_notify("pub", self._on_pub)
+        self.head.call("subscribe", channel="actor_events")
+
+    # ------------------------------------------------------------------ serving
+    async def _handle_ping(self, conn, **kw):
+        return {"ok": True, "worker_id": self.worker_id.hex()}
+
+    async def _handle_get_object(self, conn, oid: str, timeout: float = 10.0):
+        object_id = ObjectID.from_hex(oid)
+        import asyncio
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.store.contains(object_id):
+                data = await asyncio.get_running_loop().run_in_executor(
+                    None, self.store.get, object_id
+                )
+                return {"data": data}
+            holder = self._locations.get(object_id)
+            if holder is not None:
+                return {"location": holder}
+            await asyncio.sleep(0.01)
+        return {"pending": True}
+
+    async def _handle_free_object(self, conn, oid: str):
+        self.store.delete(ObjectID.from_hex(oid))
+        return {"ok": True}
+
+    async def _handle_report_location(self, conn, oid: str, holder: str):
+        self._locations[ObjectID.from_hex(oid)] = holder
+        return {"ok": True}
+
+    async def _on_pub(self, channel: str, payload: dict):
+        if channel == "actor_events":
+            aid = payload.get("actor_id")
+            state = payload.get("state")
+            self._actor_states[aid] = state
+            if state == "ALIVE" and payload.get("addr"):
+                self._actor_addr_cache[aid] = tuple(payload["addr"])
+            elif state in ("DEAD", "RESTARTING"):
+                self._actor_addr_cache.pop(aid, None)
+
+    # ------------------------------------------------------------------ peers
+    def _peer(self, addr: tuple[str, int]) -> RpcClient:
+        addr = tuple(addr)
+        with self._peer_lock:
+            cli = self._peer_clients.get(addr)
+            if cli is None:
+                cli = RpcClient(*addr)
+                self._peer_clients[addr] = cli
+            return cli
+
+    def _resolve_worker_addr(self, worker_hex: str) -> tuple[str, int] | None:
+        res = self.head.call("resolve_worker", worker_id=worker_hex)
+        return tuple(res["addr"]) if res.get("addr") else None
+
+    # ------------------------------------------------------------------ put/get
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.worker_id)
+        self.store.put(oid, serialization.serialize(value), self.worker_id)
+        self.refs.add_owned(oid, self.worker_id)
+        return ObjectRef(oid, self.worker_id)
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            data = self._fetch(ref, deadline)
+            value = serialization.deserialize(data)
+            if isinstance(value, (TaskError, ActorDiedError, TaskCancelledError)):
+                raise value
+            out.append(value)
+        return out
+
+    def _fetch(self, ref: ObjectRef, deadline: float | None) -> bytes:
+        # 1. local
+        if self.store.contains(ref.id):
+            return self.store.get(ref.id)
+        owner_hex = ref.owner_id.hex() if ref.owner_id else None
+        am_owner = ref.owner_id == self.worker_id
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+            if am_owner:
+                # Block on the store's seal event (inline results land there);
+                # wake periodically to check for a large-result location report.
+                holder = self._locations.get(ref.id)
+                if holder is not None:
+                    data = self._fetch_from_holder(holder, ref)
+                    if data is not None:
+                        return data
+                    time.sleep(0.01)
+                    continue
+                step = 0.1 if remaining is None else min(0.1, remaining)
+                try:
+                    return self.store.get(ref.id, timeout=step)
+                except TimeoutError:
+                    continue
+            # borrower: ask the owner
+            if owner_hex is None:
+                raise ObjectLostError(ref.hex(), "ref has no owner")
+            addr = self._resolve_worker_addr(owner_hex)
+            if addr is None:
+                raise ObjectLostError(ref.hex(), "owner not found (OwnerDied)")
+            try:
+                res = self._peer(addr).call("get_object", oid=ref.hex(),
+                                            timeout=min(remaining or 10.0, 10.0) + 5)
+            except RpcError:
+                raise ObjectLostError(ref.hex(), "owner unreachable")
+            if res.get("data") is not None:
+                self.store.put(ref.id, res["data"], ref.owner_id)
+                return res["data"]
+            if res.get("location"):
+                data = self._fetch_from_holder(res["location"], ref)
+                if data is not None:
+                    return data
+            # pending: loop
+
+    def _fetch_from_holder(self, holder_hex: str, ref: ObjectRef) -> bytes | None:
+        addr = self._resolve_worker_addr(holder_hex)
+        if addr is None:
+            return None
+        try:
+            res = self._peer(addr).call("get_object", oid=ref.hex(), timeout=15)
+        except RpcError:
+            return None
+        if res.get("data") is not None:
+            return res["data"]
+        return None
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready, pending = [], list(refs)
+        while len(ready) < num_returns:
+            still = []
+            for r in pending:
+                if self.store.contains(r.id) or r.id in self._locations:
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    # ------------------------------------------------------------------ tasks
+    def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        return_ids = spec.return_ids()
+        for oid in return_ids:
+            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
+        spec.owner_id = self.worker_id
+        blob = cloudpickle.dumps(spec)
+        t = threading.Thread(
+            target=self._submit_and_collect, args=(spec, blob, return_ids),
+            daemon=True, name=f"submit-{spec.name[:20]}",
+        )
+        t.start()
+        return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+
+    def _submit_and_collect(self, spec: TaskSpec, blob: bytes,
+                            return_ids: list[ObjectID]) -> None:
+        attempts = 0
+        while True:
+            try:
+                worker = self._acquire_lease(spec)
+                try:
+                    reply = self._io.run(
+                        worker.client.call("push_task", spec_blob=blob, timeout=None)
+                    )
+                finally:
+                    self._release_lease(spec, worker)
+                self._handle_task_reply(spec, return_ids, reply)
+                return
+            except (RpcError, OSError) as e:
+                # Worker/daemon failure: retry (system retries, reference
+                # semantics: max_retries counts system failures).
+                attempts += 1
+                if attempts > max(spec.max_retries, 0):
+                    self._store_error_local(
+                        return_ids, TaskError(RuntimeError(f"system failure: {e}"),
+                                              task_desc=spec.name))
+                    return
+                time.sleep(get_config().task_retry_delay_s)
+            except Exception as e:  # noqa: BLE001
+                self._store_error_local(return_ids, TaskError(e, task_desc=spec.name))
+                return
+
+    def _handle_task_reply(self, spec, return_ids, reply: dict):
+        results = reply.get("results", [])
+        for oid, r in zip(return_ids, results):
+            if r.get("data") is not None:
+                self.store.put(oid, r["data"], self.worker_id)
+            elif r.get("location"):
+                self._locations[oid] = r["location"]
+
+    def _store_error_local(self, return_ids, err):
+        blob = serialization.serialize(err)
+        for oid in return_ids:
+            self.store.put(oid, blob, self.worker_id)
+
+    def _acquire_lease(self, spec: TaskSpec) -> _LeasedWorker:
+        key = spec.scheduling_key()
+        with self._lease_lock:
+            pool = self._leases.setdefault(key, [])
+            usable = [w for w in pool if w.inflight < self.MAX_INFLIGHT_PER_WORKER]
+            if usable:
+                w = min(usable, key=lambda w: w.inflight)
+                w.inflight += 1
+                return w
+        # Need a new lease from a node daemon (local first, follow spillback).
+        daemon = self._daemon
+        if daemon is None:
+            raise RuntimeError("no node daemon attached to this process")
+        res = daemon.call("request_lease", resources=spec.resources, timeout=None)
+        hops = 0
+        while res.get("spill") and hops < 4:
+            daemon = self._peer(tuple(res["spill"]))
+            res = daemon.call("request_lease", resources=spec.resources, timeout=None)
+            hops += 1
+        if res.get("error"):
+            raise ValueError(res["error"])
+        client = AsyncRpcClient(*tuple(res["addr"]))
+        self._io.run(client.connect())
+        w = _LeasedWorker(res["lease_id"], res["worker_id"], tuple(res["addr"]), client)
+        w._daemon = daemon  # remember grantor for return
+        w.inflight = 1
+        with self._lease_lock:
+            self._leases.setdefault(key, []).append(w)
+        return w
+
+    def _release_lease(self, spec: TaskSpec, w: _LeasedWorker):
+        with self._lease_lock:
+            w.inflight -= 1
+            if w.inflight <= 0:
+                pool = self._leases.get(spec.scheduling_key(), [])
+                # Keep one cached worker per key for reuse; return extras.
+                if len(pool) > 1 and w in pool:
+                    pool.remove(w)
+                    try:
+                        getattr(w, "_daemon", self._daemon).call(
+                            "return_lease", lease_id=w.lease_id)
+                    except Exception:
+                        pass
+
+    def cancel(self, ref: ObjectRef) -> None:
+        self._cancelled.add(ref.id)
+        self._store_error_local([ref.id], TaskCancelledError())
+
+    # ------------------------------------------------------------------ actors
+    def create_actor(self, spec: ActorCreationSpec) -> None:
+        spec.owner_id = self.worker_id
+        strategy = spec.scheduling_strategy
+        res = self.head.call(
+            "register_actor",
+            actor_id=spec.actor_id.hex(),
+            spec_blob=cloudpickle.dumps(spec),
+            resources=spec.resources,
+            name=spec.name,
+            namespace=spec.namespace,
+            max_restarts=spec.max_restarts,
+            lifetime=spec.lifetime,
+            node_affinity=strategy.node_id_hex if strategy.kind == "NODE_AFFINITY" else None,
+        )
+        if not res.get("ok"):
+            raise ValueError(res.get("error", "actor registration failed"))
+
+    def _actor_addr(self, actor_id: ActorID, timeout: float = 60.0) -> tuple[str, int]:
+        aid = actor_id.hex()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            addr = self._actor_addr_cache.get(aid)
+            if addr:
+                return addr
+            info = self.head.call("get_actor_info", actor_id=aid)
+            if info is None:
+                raise ActorDiedError(aid, "unknown actor")
+            if info["state"] == "ALIVE" and info["addr"]:
+                self._actor_addr_cache[aid] = tuple(info["addr"])
+                return tuple(info["addr"])
+            if info["state"] == "DEAD":
+                raise ActorDiedError(aid, info.get("reason", ""))
+            time.sleep(0.02)
+        raise ActorDiedError(aid, "timed out waiting for actor to start")
+
+    def submit_actor_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        return_ids = spec.return_ids()
+        for oid in return_ids:
+            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
+        spec.owner_id = self.worker_id
+        blob = cloudpickle.dumps(spec)
+        # Ordered per-actor dispatch (reference: sequential_actor_submit_queue
+        # orders calls by sequence number; one FIFO dispatcher per actor here
+        # preserves program order while pipelining over a single connection).
+        with self._actor_queue_lock:
+            q = self._actor_queues.get(spec.actor_id.hex())
+            if q is None:
+                import queue as _q
+
+                q = _q.Queue()
+                self._actor_queues[spec.actor_id.hex()] = q
+                threading.Thread(
+                    target=self._actor_dispatcher, args=(spec.actor_id, q),
+                    daemon=True, name=f"adisp-{spec.actor_id.hex()[:8]}",
+                ).start()
+        q.put((spec, blob, return_ids))
+        return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+
+    def _actor_dispatcher(self, actor_id: ActorID, q) -> None:
+        # Pipelined ordered dispatch: sends ride one connection in FIFO order;
+        # a bounded in-flight window keeps memory in check. Completions are
+        # handled on the io loop; failures fall back to the blocking
+        # retry/restart path.
+        window = threading.Semaphore(128)
+
+        def on_done(spec, blob, return_ids, fut):
+            window.release()
+            try:
+                reply = fut.result()
+                if reply.get("dead"):
+                    raise RpcError(reply.get("reason", "actor dead"))
+                self._handle_task_reply(spec, return_ids, reply)
+            except Exception:  # noqa: BLE001
+                threading.Thread(
+                    target=self._submit_actor_and_collect,
+                    args=(spec, blob, return_ids), daemon=True,
+                ).start()
+
+        while not self._shutdown:
+            item = q.get()
+            if item is None:
+                return
+            spec, blob, return_ids = item
+            try:
+                addr = self._actor_addr(spec.actor_id)
+            except Exception:
+                self._submit_actor_and_collect(spec, blob, return_ids)
+                continue
+            window.acquire()
+            client = self._peer(addr)
+            cfut = self._io.spawn(
+                client.aio.call("push_actor_task", spec_blob=blob, timeout=None)
+            )
+            cfut.add_done_callback(
+                lambda f, s=spec, b=blob, r=return_ids: on_done(s, b, r, f)
+            )
+
+    def _submit_actor_and_collect(self, spec, blob, return_ids):
+        aid = spec.actor_id.hex()
+        attempts = 0
+        try:
+            while True:
+                try:
+                    addr = self._actor_addr(spec.actor_id)
+                    reply = self._peer(addr).call("push_actor_task", spec_blob=blob,
+                                                  timeout=None)
+                    if reply.get("dead"):
+                        raise ActorDiedError(aid, reply.get("reason", ""))
+                    self._handle_task_reply(spec, return_ids, reply)
+                    return
+                except (RpcError, OSError):
+                    # Worker vanished mid-call. If the head says RESTARTING the
+                    # call is retried against the new incarnation (reference:
+                    # actor_task_submitter retries per max_task_retries while
+                    # the GCS FSM restarts the actor).
+                    self._actor_addr_cache.pop(aid, None)
+                    attempts += 1
+                    if attempts > 60:
+                        raise ActorDiedError(aid, "worker connection lost")
+                    deadline = time.monotonic() + 10.0
+                    while time.monotonic() < deadline:
+                        try:
+                            info = self.head.call("get_actor_info", actor_id=aid)
+                        except Exception:
+                            info = None
+                        state = (info or {}).get("state")
+                        if state == "DEAD":
+                            raise ActorDiedError(aid, (info or {}).get("reason",
+                                                 "worker connection lost"))
+                        if state == "ALIVE" and info.get("addr") and \
+                                tuple(info["addr"]) != tuple(addr):
+                            break  # new incarnation up: retry
+                        time.sleep(0.1)
+                    else:
+                        raise ActorDiedError(aid, "worker connection lost")
+        except ActorDiedError as e:
+            self._store_error_local(return_ids, e)
+        except Exception as e:  # noqa: BLE001
+            self._store_error_local(return_ids, TaskError(e, task_desc=spec.name))
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self.head.call("kill_actor", actor_id=actor_id.hex(), no_restart=no_restart)
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> ActorID | None:
+        res = self.head.call("get_named_actor", name=name, namespace=namespace)
+        return ActorID.from_hex(res["actor_id"]) if res.get("actor_id") else None
+
+    def actor_is_alive(self, actor_id: ActorID) -> bool:
+        info = self.head.call("get_actor_info", actor_id=actor_id.hex())
+        return bool(info and info["state"] == "ALIVE")
+
+    # ------------------------------------------------------------------ KV
+    def kv_put(self, key: str, value: bytes, ns: str = "default") -> None:
+        self.head.call("kv_put", ns=ns, key=key, value=value)
+
+    def kv_get(self, key: str, ns: str = "default") -> bytes | None:
+        return self.head.call("kv_get", ns=ns, key=key).get("value")
+
+    def kv_del(self, key: str, ns: str = "default") -> None:
+        self.head.call("kv_del", ns=ns, key=key)
+
+    # ------------------------------------------------------------------ misc
+    def cluster_resources(self) -> dict[str, float]:
+        return self.head.call("cluster_resources")
+
+    def available_resources(self) -> dict[str, float]:
+        return self.head.call("available_resources")
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._io.run(self.server.stop())
+        except Exception:
+            pass
+        for cli in list(self._peer_clients.values()):
+            cli.close()
+        self.head.close()
+        if self._daemon:
+            self._daemon.close()

@@ -1,0 +1,255 @@
+"""Worker process entry point: executes pushed tasks and hosts actors.
+
+Capability parity with the reference's worker side (reference:
+src/ray/core_worker/core_worker.cc HandlePushTask :3335 → TaskReceiver →
+ordered/concurrent execution queues; python worker loop in
+python/ray/_private/worker.py main_loop): the worker registers with its node
+daemon, then serves ``push_task`` (stateless tasks) and
+``init_actor``/``push_actor_task`` (actor hosting) over RPC. Task code runs
+with this process's ClusterRuntime as the global runtime, so nested
+``ray_tpu.get``/``.remote`` calls work from inside tasks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import queue
+import threading
+from typing import Any
+
+import cloudpickle
+
+from ray_tpu.core.cluster.protocol import EventLoopThread
+from ray_tpu.core.cluster.runtime import ClusterRuntime
+from ray_tpu.core.exceptions import ActorDiedError, TaskCancelledError, TaskError
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
+from ray_tpu.utils import serialization
+from ray_tpu.utils.config import get_config
+
+
+class WorkerProcess:
+    def __init__(self):
+        head = os.environ["RTPU_HEAD"].split(":")
+        daemon = os.environ["RTPU_NODE_DAEMON"].split(":")
+        self.runtime = ClusterRuntime(
+            head[0], int(head[1]),
+            node_daemon_addr=(daemon[0], int(daemon[1])),
+            is_worker=True,
+        )
+        # Bind the process-global worker so user code sees the cluster runtime.
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.utils.ids import JobID
+
+        global_worker.runtime = self.runtime
+        global_worker.worker_id = self.runtime.worker_id
+        global_worker.node_id = self.runtime.node_id
+        global_worker.job_id = JobID.from_random()
+        global_worker.mode = "cluster"
+
+        self._io = EventLoopThread.get()
+        srv = self.runtime.server
+        srv.register("push_task", self._push_task)
+        srv.register("init_actor", self._init_actor)
+        srv.register("push_actor_task", self._push_actor_task)
+        srv.register("exit_worker", self._exit_worker)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._task_executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        self._actor_instance: Any = None
+        self._actor_id_hex: str | None = None
+        self._actor_mailbox: "queue.Queue" = queue.Queue()
+        self._actor_loop: asyncio.AbstractEventLoop | None = None
+        self._actor_pool = None
+        self._exit_event = threading.Event()
+
+        self.runtime._daemon.call(
+            "register_worker_proc",
+            worker_id=self.runtime.worker_id.hex(),
+            host=self.runtime.addr[0], port=self.runtime.addr[1],
+            pid=os.getpid(),
+        )
+
+    # ------------------------------------------------------------------ tasks
+    async def _push_task(self, conn, spec_blob: bytes):
+        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        loop = asyncio.get_running_loop()
+        # Serial execution: one normal task at a time per leased worker
+        # (reference semantics — a worker runs one task; pipelined pushes
+        # queue here, matching lease-based resource accounting).
+        return await loop.run_in_executor(self._task_executor, self._execute_task, spec)
+
+    def _execute_task(self, spec: TaskSpec) -> dict:
+        from ray_tpu.core.worker import set_task_context
+
+        return_ids = spec.return_ids()
+        try:
+            fn = serialization.loads_function(spec.fn_blob)
+            args, kwargs = serialization.deserialize(spec.args_blob)
+            args = self._resolve(args)
+            kwargs = self._resolve(kwargs)
+            set_task_context(spec.task_id, spec.actor_id, spec.resources)
+            try:
+                result = fn(*args, **kwargs)
+            finally:
+                set_task_context(None, None, None)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, (TaskError, ActorDiedError, TaskCancelledError)) \
+                else TaskError(e, task_desc=spec.name)
+            blob = serialization.serialize(err)
+            return {"results": [{"data": blob} for _ in return_ids]}
+        return {"results": self._package_results(spec, return_ids, result)}
+
+    def _resolve(self, obj):
+        if isinstance(obj, ObjectRef):
+            return self.runtime.get([obj])[0]
+        if isinstance(obj, tuple):
+            return tuple(self._resolve(o) if isinstance(o, ObjectRef) else o for o in obj)
+        if isinstance(obj, list):
+            return obj
+        if isinstance(obj, dict):
+            return {k: (self._resolve(v) if isinstance(v, ObjectRef) else v)
+                    for k, v in obj.items()}
+        return obj
+
+    def _package_results(self, spec: TaskSpec, return_ids, result) -> list[dict]:
+        cfg = get_config()
+        values = [result] if spec.num_returns == 1 else list(result)
+        if len(values) != spec.num_returns:
+            err = TaskError(
+                ValueError(f"declared num_returns={spec.num_returns}, got {len(values)}"),
+                task_desc=spec.name)
+            blob = serialization.serialize(err)
+            return [{"data": blob} for _ in return_ids]
+        out = []
+        for oid, v in zip(return_ids, values):
+            if isinstance(v, ObjectRef):
+                v = self.runtime.get([v])[0]
+            blob = serialization.serialize(v)
+            if len(blob) <= cfg.inline_object_max_bytes:
+                out.append({"data": blob})
+            else:
+                # Large result: stays here; owner records our location
+                # (reference: results over max_direct_call_object_size go to
+                # plasma at the executor).
+                self.runtime.store.put(oid, blob, spec.owner_id or self.runtime.worker_id)
+                out.append({"location": self.runtime.worker_id.hex()})
+        return out
+
+    # ------------------------------------------------------------------ actors
+    async def _init_actor(self, conn, actor_id: str, spec_blob: bytes):
+        spec: ActorCreationSpec = cloudpickle.loads(spec_blob)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._do_init_actor, actor_id, spec)
+
+    def _do_init_actor(self, actor_id: str, spec: ActorCreationSpec) -> dict:
+        try:
+            cls = serialization.loads_function(spec.cls_blob)
+            args, kwargs = serialization.deserialize(spec.args_blob)
+            self._actor_instance = cls(*self._resolve(args), **self._resolve(kwargs))
+            self._actor_id_hex = actor_id
+            if any(
+                inspect.iscoroutinefunction(getattr(type(self._actor_instance), m, None))
+                for m in dir(type(self._actor_instance)) if not m.startswith("__")
+            ):
+                self._actor_loop = asyncio.new_event_loop()
+                threading.Thread(target=self._actor_loop.run_forever, daemon=True).start()
+            if spec.max_concurrency > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._actor_pool = ThreadPoolExecutor(max_workers=spec.max_concurrency)
+            # Ordered mailbox consumer (reference: ordered actor execution queue).
+            threading.Thread(target=self._actor_consumer, daemon=True).start()
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            return {"ok": False, "error": f"__init__ failed: {e!r}"}
+
+    def _actor_consumer(self):
+        while True:
+            item = self._actor_mailbox.get()
+            if item is None:
+                return
+            spec, reply_fut, loop = item
+            method = getattr(type(self._actor_instance), spec.method_name, None)
+            is_async = inspect.iscoroutinefunction(method)
+            if is_async or self._actor_pool is not None:
+                runner = (
+                    self._actor_pool.submit if self._actor_pool is not None
+                    else lambda f: threading.Thread(target=f, daemon=True).start()
+                )
+                runner(lambda: self._run_actor_method(spec, reply_fut, loop))
+            else:
+                self._run_actor_method(spec, reply_fut, loop)
+
+    def _run_actor_method(self, spec: TaskSpec, reply_fut, loop):
+        from ray_tpu.core.worker import set_task_context
+
+        return_ids = spec.return_ids()
+        try:
+            method = getattr(self._actor_instance, spec.method_name)
+            args, kwargs = serialization.deserialize(spec.args_blob)
+            args, kwargs = self._resolve(args), self._resolve(kwargs)
+            set_task_context(spec.task_id, spec.actor_id, spec.resources)
+            try:
+                if inspect.iscoroutinefunction(method):
+                    fut = asyncio.run_coroutine_threadsafe(
+                        method(*args, **kwargs), self._actor_loop)
+                    result = fut.result()
+                else:
+                    result = method(*args, **kwargs)
+            finally:
+                set_task_context(None, None, None)
+            reply = {"results": self._package_results(spec, return_ids, result)}
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, (TaskError, ActorDiedError, TaskCancelledError)) \
+                else TaskError(e, task_desc=spec.method_name or "")
+            reply = {"results": [{"data": serialization.serialize(err)}
+                                 for _ in return_ids]}
+        loop.call_soon_threadsafe(reply_fut.set_result, reply)
+
+    async def _push_actor_task(self, conn, spec_blob: bytes):
+        if self._actor_instance is None:
+            return {"dead": True, "reason": "no actor hosted in this worker"}
+        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._actor_mailbox.put((spec, fut, loop))
+        return await fut
+
+    async def _exit_worker(self, conn):
+        self._exit_event.set()
+        return {"ok": True}
+
+    def serve_forever(self):
+        self._exit_event.wait()
+
+
+def _parent_watchdog():
+    """Exit if the spawning daemon process dies (orphan prevention —
+    reference: workers die with their raylet via the IPC socket)."""
+    parent = int(os.environ.get("RTPU_PARENT_PID", "0"))
+    if not parent:
+        return
+    import time as _t
+
+    def watch():
+        while True:
+            try:
+                os.kill(parent, 0)
+            except OSError:
+                os._exit(0)
+            _t.sleep(1.0)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
+def main():
+    _parent_watchdog()
+    wp = WorkerProcess()
+    wp.serve_forever()
+
+
+if __name__ == "__main__":
+    main()
