@@ -1,0 +1,28 @@
+"""Collective communication: XLA/ICI backend (default) + host fallback.
+
+Reference capability: python/ray/util/collective (NCCL/gloo backends).
+"""
+
+from ray_tpu.collective.collective import (
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_group,
+    init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.host_backend import HostCollectiveGroup
+from ray_tpu.collective.xla_backend import XlaCollectiveGroup
+
+__all__ = [
+    "init_collective_group", "destroy_collective_group", "get_group",
+    "allreduce", "allgather", "reducescatter", "alltoall", "broadcast",
+    "reduce", "barrier", "send", "recv",
+    "XlaCollectiveGroup", "HostCollectiveGroup",
+]

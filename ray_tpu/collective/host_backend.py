@@ -1,0 +1,165 @@
+"""Host (CPU) collective backend: rendezvous + reduction through a named
+actor — the gloo-equivalent fallback for actors and tests.
+
+Capability parity with the reference's CPU backend (reference:
+python/ray/util/collective/collective_group/torch_gloo_collective_group.py,
+rendezvous shape from nccl_collective_group.py Rendezvous :29 which exchanges
+state through a named Ray actor): each rank calls the op with its local
+array; a per-group coordination actor (async, so ranks interleave) gathers
+world_size contributions, computes the result, and releases all waiters.
+Correctness over speed — the fast path on TPU is the XLA backend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+
+class _GroupCoordinator:
+    """Async actor: one instance per collective group (named actor)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._rounds: dict[str, dict] = {}
+        self._lock = asyncio.Lock()
+
+    def _round(self, key: str) -> dict:
+        r = self._rounds.get(key)
+        if r is None:
+            r = {"parts": {}, "event": asyncio.Event(), "result": None}
+            self._rounds[key] = r
+        return r
+
+    async def contribute(self, key: str, rank: int, data, op: str):
+        async with self._lock:
+            r = self._round(key)
+            r["parts"][rank] = data
+            if len(r["parts"]) == self.world_size:
+                r["result"] = self._combine(r["parts"], op)
+                r["event"].set()
+        await r["event"].wait()
+        result = r["result"]
+        async with self._lock:
+            r["waiters"] = r.get("waiters", 0) + 1
+            if r["waiters"] == self.world_size:
+                self._rounds.pop(key, None)  # round complete: free memory
+        return result if not isinstance(result, dict) else result.get(rank)
+
+    def _combine(self, parts: dict[int, object], op: str):
+        ordered = [np.asarray(parts[r]) for r in sorted(parts)]
+        if op == "sum":
+            return sum(ordered[1:], ordered[0].copy())
+        if op == "max":
+            return np.maximum.reduce(ordered)
+        if op == "min":
+            return np.minimum.reduce(ordered)
+        if op == "gather":
+            return np.concatenate(ordered, axis=0)
+        if op == "alltoall":
+            # rank r receives chunk r of every rank's array, concatenated
+            n = self.world_size
+            out = {}
+            for r in range(n):
+                chunks = [np.array_split(p, n, axis=0)[r] for p in ordered]
+                out[r] = np.concatenate(chunks, axis=0)
+            return out
+        if op == "barrier":
+            return 0
+        if op.startswith("broadcast"):
+            src = int(op.split(":")[1])
+            return np.asarray(parts[src])
+        if op.startswith("reducescatter"):
+            red = sum(ordered[1:], ordered[0].copy())
+            return {r: np.array_split(red, self.world_size, axis=0)[r]
+                    for r in range(self.world_size)}
+        raise ValueError(f"unknown op {op}")
+
+    async def p2p_put(self, key: str, data):
+        async with self._lock:
+            r = self._round(key)
+            r["result"] = data
+            r["event"].set()
+        return True
+
+    async def p2p_take(self, key: str):
+        r = self._round(key)
+        await r["event"].wait()
+        async with self._lock:
+            self._rounds.pop(key, None)
+        return r["result"]
+
+
+class HostCollectiveGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        import ray_tpu
+
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._seq = 0
+        self._p2p_seq: dict[tuple[int, int], int] = {}
+        actor_name = f"_rtpu_collective:{group_name}"
+        try:
+            self._coord = ray_tpu.get_actor(actor_name)
+        except ValueError:
+            Coordinator = ray_tpu.remote(_GroupCoordinator)
+            try:
+                self._coord = Coordinator.options(
+                    name=actor_name, num_cpus=0
+                ).remote(world_size)
+            except ValueError:
+                self._coord = ray_tpu.get_actor(actor_name)  # lost the race
+
+    def _key(self, op: str) -> str:
+        self._seq += 1
+        return f"{op}:{self._seq}"
+
+    def _run(self, op_tag: str, data, op: str):
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._coord.contribute.remote(self._key(op_tag), self.rank, data, op),
+            timeout=120,
+        )
+
+    def allreduce(self, x, op: str = "sum"):
+        return self._run("ar", np.asarray(x), op)
+
+    def allgather(self, x):
+        return self._run("ag", np.asarray(x), "gather")
+
+    def reducescatter(self, x, op: str = "sum"):
+        return self._run("rs", np.asarray(x), f"reducescatter:{op}")
+
+    def alltoall(self, x):
+        return self._run("a2a", np.asarray(x), "alltoall")
+
+    def broadcast(self, x, src_rank: int = 0):
+        return self._run("bc", np.asarray(x), f"broadcast:{src_rank}")
+
+    def reduce(self, x, dst_rank: int = 0, op: str = "sum"):
+        return self._run("rd", np.asarray(x), op)
+
+    def barrier(self):
+        self._run("bar", 0, "barrier")
+
+    def send(self, x, dst_rank: int):
+        import ray_tpu
+
+        pair = (self.rank, dst_rank)
+        self._p2p_seq[pair] = self._p2p_seq.get(pair, 0) + 1
+        key = f"p2p:{pair[0]}->{pair[1]}:{self._p2p_seq[pair]}"
+        ray_tpu.get(self._coord.p2p_put.remote(key, np.asarray(x)), timeout=120)
+
+    def recv(self, shape, dtype, src_rank: int):
+        import ray_tpu
+
+        pair = (src_rank, self.rank)
+        self._p2p_seq[pair] = self._p2p_seq.get(pair, 0) + 1
+        key = f"p2p:{pair[0]}->{pair[1]}:{self._p2p_seq[pair]}"
+        return ray_tpu.get(self._coord.p2p_take.remote(key), timeout=120)
+
+    def destroy(self):
+        pass

@@ -1,0 +1,137 @@
+"""Device-mesh construction: the substrate every collective and sharding
+rides on.
+
+TPU-native replacement for the reference's process-group world (reference:
+python/ray/util/collective — NCCL groups are flat rank lists; torch.distributed
+worlds are 1-D): on TPU the communication domain is a *mesh* over the slice's
+ICI torus, with named axes for each parallelism dimension, and a slower DCN
+dimension between slices (reference multi-slice env plumbing:
+python/ray/util/tpu.py get_tpu_coordinator_env_vars :199). Axis order matters:
+ICI-adjacent axes get the torus bandwidth; the DCN axis must be outermost.
+
+Canonical axis names (used by sharding rules, collectives, and models):
+  dp    — data parallel (gradient allreduce)
+  fsdp  — fully-sharded data parallel (param/optimizer sharding)
+  tp    — tensor parallel (Megatron-style)
+  sp    — sequence/context parallel (ring attention)
+  ep    — expert parallel (MoE all-to-all)
+  pp    — pipeline parallel (stages)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")  # outermost (DCN-most) first
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Named parallelism degrees. Unspecified axes default to 1.
+
+    ``dcn_axes`` marks axes that cross slice boundaries (data/pipeline
+    parallelism between pods); they are laid out outermost so XLA routes
+    their collectives over DCN and everything else over ICI.
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+    dcn_axes: tuple[str, ...] = ()
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in AXIS_ORDER}
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.axis_sizes().values())
+
+    def with_total(self, n_devices: int, grow: str = "dp") -> "MeshSpec":
+        """Scale the ``grow`` axis so the mesh covers ``n_devices``."""
+        fixed = self.num_devices // getattr(self, grow)
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed degree {fixed}"
+            )
+        return MeshSpec(**{**self._asdict(), grow: n_devices // fixed})
+
+    def _asdict(self) -> dict:
+        return {
+            "dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
+            "sp": self.sp, "ep": self.ep, "pp": self.pp,
+            "dcn_axes": self.dcn_axes,
+        }
+
+
+def build_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
+    """Arrange devices into the named mesh.
+
+    Axis order follows AXIS_ORDER so that the innermost (last) axes map to
+    ICI-nearest neighbors — jax device order on TPU enumerates the torus so
+    contiguous device runs share links; tp/sp sit innermost for the
+    bandwidth-hungriest collectives.
+    """
+    devices = devices if devices is not None else jax.devices()
+    sizes = spec.axis_sizes()
+    n = math.prod(sizes.values())
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, only {len(devices)} available")
+    arr = np.array(devices[:n]).reshape(*sizes.values())
+    return Mesh(arr, axis_names=tuple(sizes.keys()))
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(MeshSpec())
+
+
+def mesh_shape_for_slice(accelerator_type: str, num_chips: int) -> dict[str, int]:
+    """Suggest a default (dp × fsdp) split for a slice of the given size.
+
+    Mirrors common practice: fsdp within a host's ICI domain, dp across.
+    """
+    if num_chips <= 4:
+        return {"fsdp": num_chips}
+    return {"dp": num_chips // 4, "fsdp": 4}
+
+
+def hybrid_mesh(spec: MeshSpec, num_slices: int, devices_per_slice: int,
+                devices: list | None = None) -> Mesh:
+    """Multi-slice mesh: DCN axes span slices, ICI axes stay inside a slice.
+
+    With jax.distributed initialized across hosts of several slices, device
+    order groups by slice; reshaping with the DCN axis outermost keeps each
+    slice's devices contiguous on the ICI axes.
+    """
+    devices = devices if devices is not None else jax.devices()
+    sizes = spec.axis_sizes()
+    dcn_degree = math.prod(sizes[a] for a in spec.dcn_axes) if spec.dcn_axes else 1
+    if dcn_degree != num_slices:
+        raise ValueError(
+            f"product of dcn_axes degrees ({dcn_degree}) must equal num_slices "
+            f"({num_slices})"
+        )
+    ici_degree = math.prod(v for a, v in sizes.items() if a not in spec.dcn_axes)
+    if ici_degree != devices_per_slice:
+        raise ValueError(
+            f"ICI axes product ({ici_degree}) must equal devices_per_slice "
+            f"({devices_per_slice})"
+        )
+    # Order: dcn axes first (slice-major), then ici axes.
+    dcn = [a for a in AXIS_ORDER if a in spec.dcn_axes]
+    ici = [a for a in AXIS_ORDER if a not in spec.dcn_axes]
+    arr = np.array(devices[: num_slices * devices_per_slice]).reshape(
+        *[sizes[a] for a in dcn], *[sizes[a] for a in ici]
+    )
+    # Transpose back to canonical AXIS_ORDER.
+    perm = [(dcn + ici).index(a) for a in AXIS_ORDER]
+    arr = arr.transpose(perm)
+    return Mesh(arr.reshape(*[sizes[a] for a in AXIS_ORDER]),
+                axis_names=AXIS_ORDER)

@@ -8,11 +8,18 @@ collective test exercises real multi-device SPMD without TPU hardware.
 
 import os
 
-# Must be set before jax imports anywhere: 8 virtual CPU devices.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force an 8-virtual-device CPU mesh. The environment pre-imports jax with the
+# remote-TPU tunnel platform enabled (slow/flaky to init, single chip), so the
+# env var alone is ignored — jax.config.update must be used before any backend
+# initialization.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,79 @@
+"""Mesh construction and sharding-rule tables."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh, hybrid_mesh
+from ray_tpu.parallel.sharding import ShardingRules, shard_params, tree_shardings
+
+
+def test_mesh_spec_sizes():
+    spec = MeshSpec(dp=2, tp=4)
+    assert spec.num_devices == 8
+    assert spec.axis_sizes()["dp"] == 2
+    assert spec.with_total(16, grow="dp").dp == 4
+
+
+def test_build_mesh(cpu_mesh_devices):
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2), cpu_mesh_devices)
+    assert mesh.shape["dp"] == 2
+    assert mesh.shape["tp"] == 2
+    assert mesh.devices.size == 8
+
+
+def test_mesh_too_big_raises(cpu_mesh_devices):
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec(dp=100), cpu_mesh_devices)
+
+
+def test_hybrid_mesh_dcn_outermost(cpu_mesh_devices):
+    spec = MeshSpec(dp=2, fsdp=4, dcn_axes=("dp",))
+    mesh = hybrid_mesh(spec, num_slices=2, devices_per_slice=4,
+                       devices=cpu_mesh_devices)
+    # each dp row (slice) must hold a contiguous run of devices
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    flat = ids.reshape(2, -1)
+    for s in range(2):
+        assert set(flat[s]) == set(range(s * 4, (s + 1) * 4))
+
+
+def test_sharding_rules_spec():
+    rules = ShardingRules()
+    assert rules.spec("batch", "seq", "act_embed") == P(("dp", "fsdp"), "sp", None)
+    assert rules.spec("embed", "mlp") == P(("fsdp",), "tp")
+    assert rules.spec(None, "heads") == P(None, "tp")
+
+
+def test_sharding_rules_no_duplicate_axis():
+    rules = ShardingRules()
+    # same mesh axis twice in one spec must not repeat
+    s = rules.spec("mlp", "heads")  # both map to tp
+    assert s == P("tp", None)
+
+
+def test_rules_override():
+    rules = ShardingRules().override(embed="tp")
+    assert rules.spec("embed") == P("tp")
+
+
+def test_shard_params_places_on_mesh(cpu_mesh_devices):
+    mesh = build_mesh(MeshSpec(fsdp=2, tp=4), cpu_mesh_devices)
+    params = {
+        "wq": np.ones((16, 32), np.float32),
+        "wo": np.ones((32, 16), np.float32),
+    }
+    logical = {"wq": ("embed", "heads"), "wo": ("heads", "embed")}
+    sharded = shard_params(params, mesh, logical)
+    assert sharded["wq"].sharding.spec == P(("fsdp",), "tp")
+    # value preserved
+    np.testing.assert_allclose(np.asarray(sharded["wq"]), params["wq"])
+
+
+def test_tree_shardings_structure(cpu_mesh_devices):
+    mesh = build_mesh(MeshSpec(dp=8), cpu_mesh_devices)
+    tree = {"a": ("batch", None), "b": {"c": ("embed",)}}
+    sh = tree_shardings(mesh, tree)
+    assert sh["a"].spec == P(("dp", "fsdp"), None)
+    assert sh["b"]["c"].spec == P("fsdp")
