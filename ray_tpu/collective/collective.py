@@ -34,7 +34,16 @@ class GroupManager:
     def _key(group_name: str) -> tuple:
         # Registry is keyed per (group, rank-context): in cluster mode each
         # rank is its own process; in local mode ranks are threads sharing
-        # this module, so the executing task id disambiguates.
+        # this module, so the executing train-session or task id
+        # disambiguates.
+        try:
+            from ray_tpu.train import session as train_session
+
+            ctx = getattr(train_session._local, "ctx", None)
+            if ctx is not None:
+                return (group_name, f"train:{ctx.world_rank}:{ctx.restart_count}")
+        except Exception:
+            pass
         from ray_tpu.core.worker import _task_context
 
         tid = getattr(_task_context, "task_id", None)

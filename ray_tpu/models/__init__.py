@@ -1,0 +1,20 @@
+"""Model zoo: TPU-native JAX models (the reference delegates to torch/vLLM)."""
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+
+MODEL_REGISTRY = {
+    "llama3-8b": LlamaConfig.llama3_8b,
+    "llama3-1b": LlamaConfig.llama3_1b,
+    "llama-tiny": LlamaConfig.tiny,
+}
+
+__all__ = [
+    "LlamaConfig", "forward", "init_params", "loss_fn",
+    "param_logical_axes", "MODEL_REGISTRY",
+]

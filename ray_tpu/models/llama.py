@@ -1,0 +1,215 @@
+"""Llama-3 family: pure-functional JAX transformer with declarative sharding.
+
+The flagship model of the framework (the reference delegates models to
+torch/vLLM; a TPU-native framework owns them — BASELINE config 2: Llama-3-8B
+DDP fine-tune is the north-star workload).
+
+Design points (TPU-first):
+- Params are a flat pytree of arrays; every leaf has a logical-axis tuple in
+  ``param_logical_axes`` consumed by ray_tpu.parallel.sharding rules, so the
+  same model runs pure-DP, FSDP, TP, or any mix by changing the rule table.
+- Layers are stacked on a leading ``layers`` axis and iterated with
+  ``lax.scan`` → one compiled layer body regardless of depth (fast compiles,
+  XLA-friendly).
+- Attention goes through ray_tpu.ops (flash kernel on TPU, blockwise
+  elsewhere, ring attention when the mesh has an ``sp`` axis).
+- bfloat16 activations/params by default, fp32 RMSNorm statistics and logits.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import blockwise_attention, flash_attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.ring_attention import ring_attention_local
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rope_scaling: dict | None = None
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_1b() -> "LlamaConfig":
+        # Llama-3.2-1B geometry
+        return LlamaConfig(hidden_size=2048, intermediate_size=8192,
+                           num_layers=16, num_heads=32, num_kv_heads=8,
+                           head_dim=64, tie_embeddings=True)
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        """Test-size config: compiles in seconds, exercises every code path."""
+        return LlamaConfig(vocab_size=256, hidden_size=64,
+                           intermediate_size=128, num_layers=2, num_heads=4,
+                           num_kv_heads=2, head_dim=16, max_seq_len=256,
+                           dtype="float32")
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def num_params(self) -> int:
+        h, v, i, L = self.hidden_size, self.vocab_size, self.intermediate_size, self.num_layers
+        qkv = h * self.num_heads * self.head_dim + 2 * h * self.num_kv_heads * self.head_dim
+        o = self.num_heads * self.head_dim * h
+        mlp = 3 * h * i
+        embed = v * h * (1 if self.tie_embeddings else 2)
+        return embed + L * (qkv + o + mlp + 2 * h) + h
+
+
+def param_logical_axes(cfg: LlamaConfig) -> dict:
+    """Logical-axis names per param leaf (see parallel/sharding.py rules)."""
+    axes = {
+        "embed_tokens": ("vocab", "embed"),
+        "final_norm": ("embed",),
+        "layers": {
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+            "attn_norm": ("layers", "embed"),
+            "mlp_norm": ("layers", "embed"),
+        },
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Scaled-normal init; layer params stacked on the leading axis."""
+    h, L = cfg.hidden_size, cfg.num_layers
+    qd = cfg.num_heads * cfg.head_dim
+    kvd = cfg.num_kv_heads * cfg.head_dim
+    i = cfg.intermediate_size
+    dt = cfg.jnp_dtype
+    keys = jax.random.split(key, 10)
+
+    def norm_init(k, *shape, scale=None):
+        scale = scale if scale is not None else 1.0 / math.sqrt(shape[-2])
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    params = {
+        "embed_tokens": (jax.random.normal(keys[0], (cfg.vocab_size, h),
+                                           jnp.float32) * 0.02).astype(dt),
+        "final_norm": jnp.ones((h,), dt),
+        "layers": {
+            "wq": norm_init(keys[1], L, h, qd),
+            "wk": norm_init(keys[2], L, h, kvd),
+            "wv": norm_init(keys[3], L, h, kvd),
+            "wo": norm_init(keys[4], L, qd, h, scale=1.0 / math.sqrt(qd * 2 * L)),
+            "w_gate": norm_init(keys[5], L, h, i),
+            "w_up": norm_init(keys[6], L, h, i),
+            "w_down": norm_init(keys[7], L, i, h, scale=1.0 / math.sqrt(i * 2 * L)),
+            "attn_norm": jnp.ones((L, h), dt),
+            "mlp_norm": jnp.ones((L, h), dt),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm_init(keys[8], h, cfg.vocab_size,
+                                      scale=1.0 / math.sqrt(h))
+    return params
+
+
+def _attention(cfg: LlamaConfig, q, k, v, attn_impl: str, sp_axis: str | None):
+    """q: [B, H, S, D], k/v: [B, Hkv, S, D] (already rope'd)."""
+    if sp_axis is not None:
+        # Context parallel: sequence is sharded over sp_axis (we are inside
+        # shard_map); the ring handles cross-shard causality.
+        return ring_attention_local(q, k, v, axis_name=sp_axis, causal=True)
+    if attn_impl == "flash":
+        return flash_attention(q, k, v, True, None, True)
+    return blockwise_attention(q, k, v, causal=True)
+
+
+def _layer(cfg: LlamaConfig, x, layer_params, inv_freq, positions,
+           attn_impl: str, sp_axis: str | None):
+    """One transformer block. x: [B, S, H]."""
+    b, s, h = x.shape
+    lp = layer_params
+    dt = x.dtype
+
+    # -- attention ----------------------------------------------------------
+    xn = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (xn @ lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = (xn @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = (xn @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = q.transpose(0, 2, 1, 3)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    o = _attention(cfg, q, k, v, attn_impl, sp_axis)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.num_heads * cfg.head_dim)
+    x = x + (o @ lp["wo"]).astype(dt)
+
+    # -- mlp (SwiGLU) -------------------------------------------------------
+    xn = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((xn @ lp["w_gate"]).astype(jnp.float32)).astype(dt)
+    up = xn @ lp["w_up"]
+    x = x + ((gate * up) @ lp["w_down"]).astype(dt)
+    return x
+
+
+def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+            positions: jax.Array | None = None, attn_impl: str = "flash",
+            sp_axis: str | None = None, remat: bool = True) -> jax.Array:
+    """tokens [B, S] → logits [B, S, V] (fp32)."""
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s)
+    x = params["embed_tokens"][tokens]
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+
+    layer_fn = partial(_layer, cfg, inv_freq=inv_freq, positions=positions,
+                       attn_impl=attn_impl, sp_axis=sp_axis)
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(x, lp):
+        return layer_fn(x, lp), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x.astype(jnp.float32) @ head.astype(jnp.float32))
+    return logits
+
+
+def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+            targets: jax.Array, mask: jax.Array | None = None,
+            **fwd_kwargs) -> jax.Array:
+    """Mean next-token cross-entropy over unmasked positions."""
+    logits = forward(cfg, params, tokens, **fwd_kwargs)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        mask = jnp.ones_like(targets, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,238 @@
+"""Attention ops: naive reference, blockwise (memory-efficient, autodiff-able),
+and a Pallas TPU flash-attention forward kernel.
+
+This layer is new work relative to the reference framework — Ray delegates
+intra-model compute to torch/vLLM (reference: SURVEY.md §5 "long-context ...
+the reference has none"); a TPU-native framework owns its attention kernels.
+
+Design:
+- ``attention_reference``: O(S²) jnp softmax attention — ground truth in tests.
+- ``blockwise_attention``: lax.scan over KV blocks with online softmax; O(S)
+  activations, differentiable, runs anywhere. This is also the inner step of
+  ring attention (ray_tpu/ops/ring_attention.py).
+- ``flash_attention``: pl.pallas_call kernel (MXU-tiled, VMEM-resident online
+  softmax, causal masking with block skipping); custom_vjp whose backward
+  recomputes through ``blockwise_attention``.
+
+Shapes: q [B, H, Sq, D], k/v [B, Hkv, Skv, D]; GQA when Hkv < H.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
+    """Expand KV heads to match query heads (GQA)."""
+    b, hkv, s, d = k.shape
+    if hkv == num_heads:
+        return k
+    rep = num_heads // hkv
+    return jnp.repeat(k, rep, axis=1)
+
+
+def attention_reference(q, k, v, causal: bool = True, sm_scale: float | None = None,
+                        q_offset: int = 0):
+    """O(S²) reference. q_offset: absolute position of q[0] (for ring/chunked)."""
+    b, h, sq, d = q.shape
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(sq)[:, None] + q_offset
+        kpos = jnp.arange(k.shape[2])[None, :]
+        logits = jnp.where(kpos <= qpos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def blockwise_attention(q, k, v, causal: bool = True,
+                        sm_scale: float | None = None,
+                        kv_block: int = 512, q_offset: int = 0,
+                        kv_offset: int = 0):
+    """Online-softmax attention scanned over KV blocks.
+
+    Activation memory is O(Sq · D) regardless of Skv. Differentiable (autodiff
+    through the scan); combine with jax.checkpoint for long sequences.
+    """
+    b, h, sq, d = q.shape
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    skv = k.shape[2]
+    kv_block = min(kv_block, skv)
+    nblocks = (skv + kv_block - 1) // kv_block
+    pad = nblocks * kv_block - skv
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    kb = k.reshape(b, h, nblocks, kv_block, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblocks, kv_block, d).transpose(2, 0, 1, 3, 4)
+
+    qpos = jnp.arange(sq) + q_offset
+
+    def step(carry, inputs):
+        o, m, l = carry
+        blk_idx, kblk, vblk = inputs
+        kpos = blk_idx * kv_block + jnp.arange(kv_block) + kv_offset
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk).astype(jnp.float32) * scale
+        valid = (kpos[None, :] - kv_offset) < skv  # mask zero-padding
+        if causal:
+            full_mask = (kpos[None, :] <= qpos[:, None]) & valid
+        else:
+            full_mask = valid
+        s = jnp.where(full_mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vblk.dtype), vblk
+        ).astype(jnp.float32)
+        return (o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    idxs = jnp.arange(nblocks)
+    (o, m, l), _ = lax.scan(step, (o0, m0, l0), (idxs, kb, vb))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash-attention forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_seq_len: int,
+                      block_k: int, sm_scale: float, causal: bool,
+                      block_q: int):
+    """Grid: (batch*heads, q_blocks). K/V stream through VMEM in block_k
+    chunks; online softmax state lives in registers/VMEM."""
+    from jax.experimental import pallas as pl  # local: TPU-only dependency
+
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * sm_scale  # [block_q, d]
+
+    nkv = kv_seq_len // block_k
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    d = q_ref.shape[-1]
+    o0 = jnp.zeros((q.shape[0], d), jnp.float32)
+    m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), jnp.float32)
+
+    if causal:
+        # Skip fully-masked KV blocks beyond this Q block's diagonal.
+        upper = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, nkv)
+    else:
+        upper = nkv
+    o, m, l = lax.fori_loop(0, upper, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
+                      block_q: int = 512, block_k: int = 512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    assert sq % block_q == 0 and skv % block_k == 0, (
+        "flash_attention requires seq lengths divisible by block sizes"
+    )
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, skv, d)
+    vf = v.reshape(b * h, skv, d)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, kv_seq_len=skv, block_k=block_k,
+        sm_scale=sm_scale, causal=causal, block_q=block_q,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: float | None = None, use_pallas: bool = True):
+    """Flash attention: Pallas TPU kernel forward, blockwise-recompute backward.
+
+    Falls back to ``blockwise_attention`` off-TPU (or use_pallas=False).
+    """
+    return _flash_fwd(q, k, v, causal, sm_scale, use_pallas)[0]
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, use_pallas):
+    h = q.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    on_tpu = jax.default_backend() == "tpu"
+    if use_pallas and on_tpu:
+        out = _flash_fwd_pallas(_cast(q), _repeat_kv(_cast(k), h),
+                                _repeat_kv(_cast(v), h), causal, scale)
+        out = out.astype(q.dtype)
+    else:
+        out = blockwise_attention(q, k, v, causal=causal, sm_scale=scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, use_pallas, res, g):
+    q, k, v = res
+    # Recompute through the differentiable blockwise path.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                               sm_scale=sm_scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+def _cast(x):
+    return x
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

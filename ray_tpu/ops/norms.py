@@ -1,0 +1,80 @@
+"""RMSNorm: Pallas fused kernel + reference implementation.
+
+The TPU framework owns its normalization kernels (the reference delegates to
+torch). RMSNorm (no mean subtraction) is the transformer default (Llama-family).
+The Pallas kernel fuses the reduction, rsqrt, and scale multiply in VMEM; the
+jnp path is used off-TPU and for autodiff (XLA fuses it into neighbors anyway
+— the kernel exists for the cases XLA's fusion boundary splits, e.g. ahead of
+a sharded matmul).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm_reference(x, weight, eps: float = 1e-6):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    o_ref[...] = (y * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def rms_norm_pallas(x, weight, eps: float = 1e-6, block_rows: int = 256):
+    from jax.experimental import pallas as pl
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = x.size // d
+    x2 = x.reshape(rows, d)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows != 0:
+        return rms_norm_reference(x, weight, eps)
+    out = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+    )(x2, weight)
+    return out.reshape(orig_shape)
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """Dispatch: Pallas on TPU forward, reference elsewhere (and for grad —
+    custom_vjp recomputes via the reference path)."""
+    if jax.default_backend() == "tpu":
+        return _rms_norm_cv(x, weight, eps)
+    return rms_norm_reference(x, weight, eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_norm_cv(x, weight, eps):
+    return rms_norm_pallas(x, weight, eps)
+
+
+def _rms_fwd(x, weight, eps):
+    return rms_norm_pallas(x, weight, eps), (x, weight)
+
+
+def _rms_bwd(eps, res, g):
+    x, weight = res
+    _, vjp = jax.vjp(lambda x_, w_: rms_norm_reference(x_, w_, eps), x, weight)
+    return vjp(g)
+
+
+_rms_norm_cv.defvjp(_rms_fwd, _rms_bwd)

@@ -1,0 +1,27 @@
+"""ray_tpu.train: distributed training orchestration (reference capability:
+ray.train v2 — controller actor + worker group + JAX backend + checkpoints).
+"""
+
+from ray_tpu.train.backend import JaxBackendConfig
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    restore_pytree,
+    save_pytree,
+)
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.controller import Result, TrainController
+from ray_tpu.train.session import get_context, report
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+
+__all__ = [
+    "JaxTrainer", "DataParallelTrainer", "TrainController", "Result",
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "JaxBackendConfig", "get_context", "report",
+    "Checkpoint", "CheckpointManager", "save_pytree", "restore_pytree",
+]

@@ -1,0 +1,48 @@
+"""Train configuration types.
+
+Capability parity with the reference's config surface (reference:
+python/ray/train/v2/api/config.py — ScalingConfig with TPU fields topology/
+accelerator_type/use_tpu :83,196-205; RunConfig/FailureConfig/CheckpointConfig
+shapes from ray.air/ray.train).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    topology: str | None = None          # e.g. "4x4" → one v5p-32 slice
+    accelerator_type: str | None = None  # e.g. "v5p"
+    resources_per_worker: dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> dict[str, float]:
+        res = dict(self.resources_per_worker)
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 4.0  # one host's chips by default
+        if "CPU" not in res and not self.use_tpu:
+            res["CPU"] = 1.0
+        return res
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # -1 = unlimited restarts from latest checkpoint
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)

@@ -1,0 +1,113 @@
+"""Train controller: the off-driver control loop.
+
+Capability parity with the reference's TrainController (reference:
+python/ray/train/v2/_internal/execution/controller/controller.py:105 — async
+control loop `run` :634, one iteration :612: poll worker group → scaling
+decision → failure decision; FailurePolicy restart-from-latest-checkpoint;
+runs as an actor so driver death doesn't kill training).
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ray_tpu.train.backend import JaxBackendConfig, free_port
+from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class Result:
+    metrics: dict[str, Any] = field(default_factory=dict)
+    checkpoint: Any = None
+    error: str | None = None
+    metrics_history: list[dict] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class TrainController:
+    """Runs as an actor (created by the Trainer); drives the worker group."""
+
+    def __init__(self, train_fn: Callable, train_loop_config: dict | None,
+                 scaling_config: ScalingConfig, run_config: RunConfig,
+                 backend_config: JaxBackendConfig | None = None):
+        self.train_fn = train_fn
+        self.train_loop_config = train_loop_config
+        self.scaling = scaling_config
+        self.run_config = run_config
+        self.backend_config = backend_config or JaxBackendConfig()
+        storage = run_config.storage_path or "/tmp/ray_tpu/train"
+        name = run_config.name or f"train-{int(time.time())}"
+        self.ckpt_manager = CheckpointManager(
+            f"{storage}/{name}",
+            num_to_keep=run_config.checkpoint_config.num_to_keep,
+        )
+        self.metrics_history: list[dict] = []
+        self._status = "PENDING"
+
+    def status(self) -> str:
+        return self._status
+
+    def run(self) -> Result:
+        """The control loop (reference: controller.py:634)."""
+        self._status = "RUNNING"
+        max_failures = self.run_config.failure_config.max_failures
+        restart_count = 0
+        while True:
+            group = None
+            try:
+                group = WorkerGroup(
+                    self.scaling, self.run_config.name or "train",
+                    self.ckpt_manager.storage_path,
+                )
+                coordinator = f"127.0.0.1:{free_port()}" \
+                    if self.backend_config.distributed else None
+                latest = self.ckpt_manager.latest()
+                group.setup(coordinator, restart_count,
+                            latest.path if latest else None)
+                self.backend_config.make_backend().on_start(group, coordinator)
+                group.run(self.train_fn, self.train_loop_config)
+                result = self._poll_until_done(group)
+                self._status = "FINISHED" if result.ok else "ERRORED"
+                return result
+            except Exception:  # noqa: BLE001 - worker/actor failures
+                restart_count += 1
+                if max_failures >= 0 and restart_count > max_failures:
+                    self._status = "ERRORED"
+                    return Result(error=traceback.format_exc(),
+                                  checkpoint=self.ckpt_manager.latest(),
+                                  metrics_history=self.metrics_history)
+                # else: loop → new worker group restored from latest checkpoint
+            finally:
+                if group is not None:
+                    group.shutdown()
+
+    def _poll_until_done(self, group: WorkerGroup) -> Result:
+        max_failures = self.run_config.failure_config.max_failures
+        failures_left = float("inf") if max_failures < 0 else max_failures
+        while True:
+            status = group.poll_status(timeout=60)
+            for rep in status.reports:
+                self.metrics_history.append(rep["metrics"])
+                if rep.get("checkpoint") and rep.get("rank", 0) == 0:
+                    self.ckpt_manager.register(rep["checkpoint"], rep["metrics"])
+            if status.errors:
+                err = "\n".join(f"rank {r}: {e}"
+                                for r, e in status.errors.items())
+                if failures_left > 0:
+                    raise RuntimeError(f"worker failure (will restart): {err}")
+                return Result(error=err, checkpoint=self.ckpt_manager.latest(),
+                              metrics_history=self.metrics_history)
+            if status.finished:
+                last = self.metrics_history[-1] if self.metrics_history else {}
+                return Result(metrics=last,
+                              checkpoint=self.ckpt_manager.latest(),
+                              metrics_history=self.metrics_history)
+            time.sleep(0.05)

@@ -1,0 +1,72 @@
+"""Per-worker training session: context + report API.
+
+Capability parity with the reference's session (reference:
+ray.train.get_context / ray.train.report — python/ray/train/v2/_internal/
+execution/context.py shapes; report flows to the controller's checkpoint
+manager, SURVEY.md §3.4 step 4).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = "train"
+    storage_path: str | None = None
+    trial_dir: str | None = None
+    coordinator_addr: str | None = None
+    restart_count: int = 0
+    latest_checkpoint: str | None = None  # dir path, set on restore
+
+    # filled by the worker harness
+    _reports: list[dict] = field(default_factory=list)
+    _report_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_checkpoint(self) -> str | None:
+        return self.latest_checkpoint
+
+
+_local = threading.local()
+
+
+def set_context(ctx: TrainContext | None) -> None:
+    _local.ctx = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError("ray_tpu.train.get_context() called outside a train worker")
+    return ctx
+
+
+def report(metrics: dict[str, Any], checkpoint: str | None = None) -> None:
+    """Report metrics (and optionally a checkpoint directory the worker has
+    already written) to the controller. Non-blocking; the controller collects
+    reports when it polls."""
+    ctx = get_context()
+    with ctx._report_lock:
+        ctx._reports.append({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+def drain_reports(ctx: TrainContext) -> list[dict]:
+    with ctx._report_lock:
+        out, ctx._reports = ctx._reports, []
+    return out

@@ -1,0 +1,53 @@
+"""Trainer entry points.
+
+Capability parity with the reference's trainers (reference:
+python/ray/train/v2/api/data_parallel_trainer.py:67 DataParallelTrainer,
+.fit() :161 spawns the controller actor; v2/jax/jax_trainer.py:20 JaxTrainer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train.backend import JaxBackendConfig
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.controller import Result, TrainController
+
+
+class DataParallelTrainer:
+    """Runs ``train_fn`` on N workers; reports/checkpoints flow back through
+    the controller actor (off-driver, reference semantics)."""
+
+    backend_config_cls = JaxBackendConfig
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: dict | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 backend_config: Any = None):
+        self.train_fn = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend_config = backend_config or self.backend_config_cls()
+
+    def fit(self) -> Result:
+        ray_tpu.api.init()  # no-op if already connected
+        Controller = ray_tpu.remote(TrainController)
+        controller = Controller.options(
+            name=f"_rtpu_train_controller:{id(self)}", num_cpus=0,
+            max_concurrency=2,
+        ).remote(
+            self.train_fn, self.train_loop_config, self.scaling_config,
+            self.run_config, self.backend_config,
+        )
+        return ray_tpu.get(controller.run.remote(), timeout=None)
+
+
+class JaxTrainer(DataParallelTrainer):
+    """JAX/TPU trainer (reference: v2/jax/jax_trainer.py:20). The train_fn is
+    SPMD JAX: every worker (one per TPU host) runs it in lockstep; the
+    backend brings up jax.distributed when configured."""
+
+    backend_config_cls = JaxBackendConfig

@@ -1,0 +1,150 @@
+"""Worker group: one actor per training worker.
+
+Capability parity with the reference's WorkerGroup (reference:
+python/ray/train/v2/_internal/execution/worker_group/worker_group.py:113 —
+actors placed via placement group, train_fn runs on a thread inside each
+actor (thread_runner.py), poll_status :609 aggregates worker states).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train.session import TrainContext, drain_reports, set_context
+
+
+class TrainWorker:
+    """Actor hosting one training worker; the user's train_fn runs on a
+    dedicated thread so poll() stays responsive (max_concurrency=4)."""
+
+    def __init__(self, rank: int, world_size: int, experiment: str,
+                 storage_path: str | None, env: dict[str, str] | None = None):
+        import os
+
+        for k, v in (env or {}).items():
+            os.environ[k] = v
+        self.ctx = TrainContext(
+            world_rank=rank, world_size=world_size, experiment_name=experiment,
+            storage_path=storage_path, local_rank=0,
+        )
+        self._thread: threading.Thread | None = None
+        self._status = "IDLE"  # IDLE | RUNNING | FINISHED | ERRORED
+        self._result: Any = None
+        self._error: str | None = None
+
+    def setup_env(self, coordinator_addr: str | None, restart_count: int,
+                  latest_checkpoint: str | None):
+        self.ctx.coordinator_addr = coordinator_addr
+        self.ctx.restart_count = restart_count
+        self.ctx.latest_checkpoint = latest_checkpoint
+        return True
+
+    def run(self, train_fn: Callable, config: dict | None) -> bool:
+        if self._status == "RUNNING":
+            raise RuntimeError("worker already running")
+        self._status = "RUNNING"
+
+        def main():
+            import inspect
+
+            set_context(self.ctx)
+            try:
+                if len(inspect.signature(train_fn).parameters) >= 1:
+                    self._result = train_fn(config if config is not None else {})
+                else:
+                    self._result = train_fn()
+                self._status = "FINISHED"
+            except BaseException:  # noqa: BLE001
+                self._error = traceback.format_exc()
+                self._status = "ERRORED"
+            finally:
+                set_context(None)
+
+        self._thread = threading.Thread(target=main, daemon=True,
+                                        name=f"train-fn-{self.ctx.world_rank}")
+        self._thread.start()
+        return True
+
+    def poll(self) -> dict:
+        return {
+            "rank": self.ctx.world_rank,
+            "status": self._status,
+            "reports": drain_reports(self.ctx),
+            "error": self._error,
+        }
+
+    def get_result(self):
+        return self._result
+
+    def ping(self) -> str:
+        return "pong"
+
+    def _exec(self, fn, *args, **kwargs):
+        """Run an arbitrary function in this worker (backend setup hooks)."""
+        return fn(*args, **kwargs)
+
+
+@dataclass
+class WorkerStatus:
+    finished: bool = False
+    errors: dict[int, str] = field(default_factory=dict)
+    reports: list[dict] = field(default_factory=list)
+
+
+class WorkerGroup:
+    def __init__(self, scaling, experiment: str, storage_path: str | None,
+                 env: dict[str, str] | None = None):
+        self.scaling = scaling
+        n = scaling.num_workers
+        res = scaling.worker_resources()
+        WorkerActor = ray_tpu.remote(TrainWorker)
+        opts: dict[str, Any] = {"max_concurrency": 4}
+        opts["num_cpus"] = res.get("CPU", 0)
+        opts["num_tpus"] = res.get("TPU", 0)
+        extra = {k: v for k, v in res.items() if k not in ("CPU", "TPU")}
+        if extra:
+            opts["resources"] = extra
+        self.workers = [
+            WorkerActor.options(**opts).remote(
+                rank, n, experiment, storage_path, env)
+            for rank in range(n)
+        ]
+
+    def setup(self, coordinator_addr: str | None, restart_count: int,
+              latest_checkpoint: str | None):
+        ray_tpu.get([
+            w.setup_env.remote(coordinator_addr, restart_count, latest_checkpoint)
+            for w in self.workers
+        ], timeout=120)
+
+    def run(self, train_fn: Callable, config: dict | None):
+        ray_tpu.get([w.run.remote(train_fn, config) for w in self.workers],
+                    timeout=120)
+
+    def poll_status(self, timeout: float = 30.0) -> WorkerStatus:
+        status = WorkerStatus()
+        polls = ray_tpu.get([w.poll.remote() for w in self.workers],
+                            timeout=timeout)
+        states = [p["status"] for p in polls]
+        for p in polls:
+            status.reports.extend(
+                {**r, "rank": p["rank"]} for r in p["reports"])
+            if p["error"]:
+                status.errors[p["rank"]] = p["error"]
+        status.finished = all(s == "FINISHED" for s in states)
+        return status
+
+    def results(self) -> list:
+        return ray_tpu.get([w.get_result.remote() for w in self.workers],
+                           timeout=120)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

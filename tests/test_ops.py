@@ -1,0 +1,170 @@
+"""Attention / norm / rope kernels vs reference implementations.
+
+Pallas kernels run in interpret mode on CPU via pltpu force_tpu_interpret_mode
+where exercised; numerical ground truth is the O(S²) reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import (
+    attention_reference,
+    blockwise_attention,
+    flash_attention,
+)
+from ray_tpu.ops.norms import rms_norm_reference
+from ray_tpu.ops.ring_attention import ring_attention_sharded
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+def _qkv(b=2, h=4, hkv=None, s=128, d=32, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), dtype)
+    k = jax.random.normal(ks[1], (b, hkv or h, s, d), dtype)
+    v = jax.random.normal(ks[2], (b, hkv or h, s, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_blockwise_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = attention_reference(q, k, v, causal=causal)
+    out = blockwise_attention(q, k, v, causal=causal, kv_block=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_blockwise_unaligned_kv_block():
+    q, k, v = _qkv(s=96)
+    ref = attention_reference(q, k, v)
+    out = blockwise_attention(q, k, v, kv_block=40)  # 96 = 2*40 + 16 pad
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_gqa_heads():
+    q, k, v = _qkv(h=8, hkv=2)
+    ref = attention_reference(q, k, v)
+    out = blockwise_attention(q, k, v, kv_block=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_cpu_fallback_and_grad():
+    q, k, v = _qkv(s=64)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, True, None, False).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def loss_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_flash_pallas_interpret_matches_reference():
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v = _qkv(b=1, h=2, s=256, d=64)
+    ref = attention_reference(q, k, v, causal=True)
+    with pltpu.force_tpu_interpret_mode():
+        from ray_tpu.ops.attention import _flash_fwd_pallas
+
+        out = _flash_fwd_pallas(q, k, v, causal=True, sm_scale=1.0 / 8.0,
+                                block_q=128, block_k=128)
+    ref = attention_reference(q, k, v, causal=True, sm_scale=1.0 / 8.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2,
+                               rtol=2e-2)
+
+
+def test_ring_attention_matches_reference(cpu_mesh_devices):
+    mesh = build_mesh(MeshSpec(sp=8), cpu_mesh_devices)
+    q, k, v = _qkv(b=1, h=2, s=256, d=32)
+    ref = attention_reference(q, k, v, causal=True)
+    out = ring_attention_sharded(q, k, v, mesh, axis="sp", causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_ring_attention_noncausal(cpu_mesh_devices):
+    mesh = build_mesh(MeshSpec(sp=4), cpu_mesh_devices[:4])
+    q, k, v = _qkv(b=1, h=2, s=64, d=16)
+    ref = attention_reference(q, k, v, causal=False)
+    out = ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_ring_attention_differentiable(cpu_mesh_devices):
+    mesh = build_mesh(MeshSpec(sp=4), cpu_mesh_devices[:4])
+    q, k, v = _qkv(b=1, h=1, s=64, d=16)
+
+    def ring_loss(q, k, v):
+        return ring_attention_sharded(q, k, v, mesh, axis="sp").sum()
+
+    def ref_loss(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    g = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_rms_norm_reference_properties():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 64)) * 5 + 1
+    w = jnp.ones(64)
+    y = rms_norm_reference(x, w)
+    rms = jnp.sqrt(jnp.mean(y * y, axis=-1))
+    np.testing.assert_allclose(np.asarray(rms), 1.0, atol=1e-3)
+
+
+def test_rms_norm_pallas_interpret():
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ray_tpu.ops.norms import rms_norm_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (256, 128))
+    w = jax.random.normal(jax.random.PRNGKey(2), (128,))
+    with pltpu.force_tpu_interpret_mode():
+        out = rms_norm_pallas(x, w)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(rms_norm_reference(x, w)), atol=1e-5)
+
+
+def test_rope_rotation_preserves_norm():
+    inv = rope_frequencies(64)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 16, 64))
+    out = apply_rope(x, jnp.arange(16), inv)
+    np.testing.assert_allclose(
+        np.asarray(jnp.linalg.norm(out, axis=-1)),
+        np.asarray(jnp.linalg.norm(x, axis=-1)), rtol=1e-5,
+    )
+
+
+def test_rope_relative_property():
+    # <rope(q, m), rope(k, n)> depends only on m - n
+    inv = rope_frequencies(32)
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 1, 32))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1, 32))
+
+    def dot_at(m, n):
+        qm = apply_rope(jnp.broadcast_to(q, (1, 1, 1, 32)), jnp.array([m]), inv)
+        kn = apply_rope(jnp.broadcast_to(k, (1, 1, 1, 32)), jnp.array([n]), inv)
+        return float(jnp.sum(qm * kn))
+
+    assert abs(dot_at(5, 3) - dot_at(12, 10)) < 1e-3
+
+
+def test_rope_llama3_scaling():
+    inv_plain = rope_frequencies(64)
+    inv_scaled = rope_frequencies(64, scaling={
+        "factor": 8.0, "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+        "original_max_position": 8192,
+    })
+    # low-frequency components shrink; highest frequencies unchanged
+    assert np.asarray(inv_scaled)[-1] < np.asarray(inv_plain)[-1]
+    np.testing.assert_allclose(np.asarray(inv_scaled)[0],
+                               np.asarray(inv_plain)[0])
