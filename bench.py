@@ -1,0 +1,121 @@
+"""Benchmark: Llama causal-LM training-step throughput, tokens/sec/chip.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+vs_baseline is FLOP-normalized against the reference north-star (BASELINE.md:
+Llama-3-8B DDP fine-tune at ~3,300 tokens/sec per A100-class chip, i.e.
+6·N·rate ≈ 1.59e14 training FLOP/s/chip): vs_baseline = (6·N·tokens_per_sec)
+/ 1.59e14 — >1.0 means this chip trains more model-FLOPs per second than the
+reference's A100 number.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+A100_8B_TOKENS_PER_SEC = 3300.0
+A100_8B_PARAMS = 8.03e9
+BASELINE_FLOPS = 6.0 * A100_8B_PARAMS * A100_8B_TOKENS_PER_SEC  # 1.59e14
+
+
+def _tpu_reachable(timeout: float = 90.0) -> bool:
+    """Probe the TPU backend in a subprocess — backend init can hang
+    indefinitely if the device tunnel is down, and it must not take the
+    bench process with it."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert any(d.platform == 'tpu' for d in jax.devices())"],
+            timeout=timeout, capture_output=True,
+        )
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def main() -> None:
+    on_tpu = _tpu_reachable()
+    import jax
+
+    if not on_tpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.train.spmd import make_llama_train_step
+
+    if on_tpu:
+        # ~1.1B-param geometry (Llama-3.2-1B-like), bf16, remat.
+        cfg = LlamaConfig(
+            vocab_size=32128, hidden_size=2048, intermediate_size=8192,
+            num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+            max_seq_len=2048, tie_embeddings=True, dtype="bfloat16",
+        )
+        seq = 2048
+        batch_candidates = [8, 4, 2, 1]
+        attn_candidates = ["flash", "blockwise"]
+        steps, warmup = 10, 2
+        metric = "llama_1b_train_tokens_per_sec_per_chip"
+    else:
+        cfg = LlamaConfig.tiny()
+        seq = 128
+        batch_candidates = [4]
+        attn_candidates = ["blockwise"]
+        steps, warmup = 3, 1
+        metric = "llama_tiny_train_tokens_per_sec_cpu_fallback"
+
+    n_params = cfg.num_params()
+    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+
+    last_err = None
+    for attn in attn_candidates:
+        for batch in batch_candidates:
+            try:
+                opt = optax.adamw(3e-4, weight_decay=0.1,
+                                  mu_dtype=jnp.bfloat16)
+                step_fn, init_state, shard = make_llama_train_step(
+                    cfg, mesh, optimizer=opt, attn_impl=attn, remat=True,
+                )
+                state = init_state()
+                rng = np.random.default_rng(0)
+                tokens = shard(rng.integers(0, cfg.vocab_size, (batch, seq),
+                                            dtype=np.int32))
+                targets = shard(np.roll(np.asarray(tokens), -1, axis=1))
+                for _ in range(warmup):
+                    state, m = step_fn(state, tokens, targets)
+                jax.block_until_ready(m["loss"])
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    state, m = step_fn(state, tokens, targets)
+                jax.block_until_ready(m["loss"])
+                dt = (time.perf_counter() - t0) / steps
+                tok_per_sec = batch * seq / dt
+                vs = (6.0 * n_params * tok_per_sec) / BASELINE_FLOPS
+                print(json.dumps({
+                    "metric": metric,
+                    "value": round(tok_per_sec, 1),
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": round(vs, 3),
+                }))
+                return
+            except Exception as e:  # noqa: BLE001 - OOM/compile fallback chain
+                last_err = e
+                continue
+    print(json.dumps({
+        "metric": metric, "value": 0.0, "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+    }))
+    print(f"bench failed: {last_err}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

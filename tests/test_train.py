@@ -200,3 +200,30 @@ def test_jax_train_on_virtual_mesh(rt_start, tmp_path):
     result = trainer.fit()
     assert result.ok, result.error
     assert result.metrics_history[-1]["loss"] < result.metrics_history[0]["loss"]
+
+
+def test_spmd_train_step_factory(cpu_mesh_devices):
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.train.spmd import make_llama_train_step
+
+    cfg = LlamaConfig.tiny()
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2), cpu_mesh_devices)
+    step_fn, init_state, shard = make_llama_train_step(
+        cfg, mesh, optimizer=optax.adamw(1e-2), attn_impl="blockwise",
+        remat=False)
+    state = init_state()
+    rng = np.random.default_rng(0)
+    tokens = shard(rng.integers(0, cfg.vocab_size, (4, 32), dtype=np.int32))
+    targets = shard(np.roll(np.asarray(tokens), -1, axis=1))
+    state, m1 = step_fn(state, tokens, targets)
+    state, m2 = step_fn(state, tokens, targets)
+    assert float(m2["loss"]) < float(m1["loss"])
+    assert int(state.step) == 2
+    # params stayed sharded per rules
+    from jax.sharding import PartitionSpec as P
+    assert state.params["layers"]["wq"].sharding.spec == P(None, ("fsdp",), "tp")
