@@ -128,20 +128,24 @@ class ActorClass:
         opts = self._options
         actor_id = ActorID.of(worker.job_id)
         arg_refs = extract_arg_refs(args, kwargs)
+        from ray_tpu.core.remote_function import resolve_strategy
+
+        resources, strategy = resolve_strategy(
+            _build_resources(opts), opts["scheduling_strategy"])
         spec = ActorCreationSpec(
             actor_id=actor_id,
             job_id=worker.job_id,
             cls_blob=self._cls_blob,
             args_blob=serialization.serialize((args, kwargs)),
             arg_ref_ids=[r.id for r in arg_refs],
-            resources=_build_resources(opts),
+            resources=resources,
             max_restarts=opts["max_restarts"],
             max_task_retries=opts["max_task_retries"],
             max_concurrency=opts["max_concurrency"],
             name=opts["name"],
             namespace=opts["namespace"],
             lifetime=opts["lifetime"],
-            scheduling_strategy=opts["scheduling_strategy"] or SchedulingStrategy(),
+            scheduling_strategy=strategy,
             runtime_env=opts["runtime_env"],
             owner_id=worker.worker_id,
         )

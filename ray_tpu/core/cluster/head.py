@@ -86,7 +86,12 @@ class HeadServer:
         r("subscribe", self._subscribe)
         r("cluster_resources", self._cluster_resources)
         r("available_resources", self._available_resources)
+        r("create_placement_group", self._create_pg)
+        r("remove_placement_group", self._remove_pg)
+        r("placement_group_state", self._pg_state)
         self.rpc.on_disconnect = self._on_disconnect
+        self.pgs: dict[str, dict] = {}
+        self._daemon_clients: dict[str, Any] = {}
 
     async def start(self) -> tuple[str, int]:
         addr = await self.rpc.start()
@@ -139,12 +144,15 @@ class HeadServer:
         await self.publish("node_events", event="added", node_id=node_id)
         return {"ok": True}
 
-    async def _heartbeat(self, conn: ServerConnection, node_id: str, available: dict):
+    async def _heartbeat(self, conn: ServerConnection, node_id: str, available: dict,
+                         resources: dict | None = None):
         info = self.nodes.get(node_id)
         if info is None:
             return {"ok": False, "reregister": True}
         info.last_heartbeat = time.monotonic()
         info.available = available
+        if resources is not None:
+            info.resources = resources  # totals change as PG bundles commit
         return {"ok": True}
 
     async def _drain_node(self, conn: ServerConnection, node_id: str):
@@ -317,6 +325,134 @@ class HeadServer:
                 await nconn.notify("kill_actor", actor_id=actor_id)
         await self._handle_actor_death(info, "killed via kill()")
         return {"ok": True}
+
+    # ------------------------------------------------------------------ placement groups
+    # 2PC coordinator (reference: GcsPlacementGroupScheduler — compute
+    # bundle→node mapping with the bundle policies, prepare all, commit only
+    # after every prepare succeeds; SchedulePendingPlacementGroups retries —
+    # gcs_placement_group_manager.cc:241).
+    async def _daemon_rpc(self, node_id: str):
+        from ray_tpu.core.cluster.protocol import AsyncRpcClient
+
+        cli = self._daemon_clients.get(node_id)
+        if cli is None:
+            info = self.nodes[node_id]
+            cli = AsyncRpcClient(*info.addr)
+            await cli.connect()
+            self._daemon_clients[node_id] = cli
+        return cli
+
+    def _assign_bundles(self, bundles: list[dict], strategy: str) -> list[str] | None:
+        """bundle index → node_id, honoring the strategy; None if infeasible."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        free = {n.node_id: dict(n.available) for n in alive}
+
+        def fits(nid, b):
+            return all(free[nid].get(k, 0.0) >= v for k, v in b.items())
+
+        def take(nid, b):
+            for k, v in b.items():
+                free[nid][k] = free[nid].get(k, 0.0) - v
+
+        assignment: list[str] = []
+        if strategy in ("PACK", "STRICT_PACK"):
+            order = sorted(free, key=lambda nid: -sum(free[nid].values()))
+            for b in bundles:
+                if strategy == "STRICT_PACK" and assignment:
+                    cands = [assignment[0]]
+                else:
+                    # PACK: prefer already-used nodes, then most-free first
+                    cands = list(dict.fromkeys(assignment))
+                    cands += [n for n in order if n not in cands]
+                placed = next((nid for nid in cands if fits(nid, b)), None)
+                if placed is None:
+                    return None
+                take(placed, b)
+                assignment.append(placed)
+            return assignment
+        # SPREAD / STRICT_SPREAD: round-robin over distinct nodes
+        used: list[str] = []
+        for b in bundles:
+            candidates = [nid for nid in free
+                          if fits(nid, b) and (nid not in used or strategy == "SPREAD")]
+            fresh = [nid for nid in candidates if nid not in used]
+            pick = (fresh or candidates or [None])[0]
+            if pick is None:
+                return None
+            take(pick, b)
+            used.append(pick)
+            assignment.append(pick)
+        if strategy == "STRICT_SPREAD" and len(set(assignment)) != len(bundles):
+            return None
+        return assignment
+
+    async def _create_pg(self, conn: ServerConnection, pg_id: str,
+                         bundles: list, strategy: str, name: str | None = None):
+        self.pgs[pg_id] = {"state": "PENDING", "bundles": bundles,
+                           "strategy": strategy, "assignment": None,
+                           "name": name}
+        asyncio.get_running_loop().create_task(self._schedule_pg(pg_id))
+        return {"ok": True}
+
+    async def _schedule_pg(self, pg_id: str, retries: int = 120):
+        pg = self.pgs[pg_id]
+        for _ in range(retries):
+            if pg["state"] == "REMOVED":
+                return
+            assignment = self._assign_bundles(pg["bundles"], pg["strategy"])
+            if assignment is not None:
+                prepared: list[int] = []
+                ok = True
+                for idx, nid in enumerate(assignment):
+                    try:
+                        cli = await self._daemon_rpc(nid)
+                        res = await cli.call("prepare_bundle", pg_id=pg_id,
+                                             bundle_index=idx,
+                                             resources=pg["bundles"][idx])
+                        if not res.get("ok"):
+                            ok = False
+                            break
+                        prepared.append(idx)
+                    except Exception:
+                        ok = False
+                        break
+                if ok:
+                    for idx, nid in enumerate(assignment):
+                        cli = await self._daemon_rpc(nid)
+                        await cli.call("commit_bundle", pg_id=pg_id,
+                                       bundle_index=idx)
+                    pg["assignment"] = assignment
+                    pg["state"] = "CREATED"
+                    await self.publish("pg_events", pg_id=pg_id, state="CREATED")
+                    return
+                # rollback prepared bundles, retry later
+                for idx in prepared:
+                    try:
+                        cli = await self._daemon_rpc(assignment[idx])
+                        await cli.call("return_bundle", pg_id=pg_id,
+                                       bundle_index=idx)
+                    except Exception:
+                        pass
+            await asyncio.sleep(0.5)
+        pg["state"] = "FAILED"
+
+    async def _remove_pg(self, conn: ServerConnection, pg_id: str):
+        pg = self.pgs.get(pg_id)
+        if pg is None:
+            return {"ok": True}
+        if pg.get("assignment"):
+            for idx, nid in enumerate(pg["assignment"]):
+                try:
+                    cli = await self._daemon_rpc(nid)
+                    await cli.call("return_bundle", pg_id=pg_id, bundle_index=idx)
+                except Exception:
+                    pass
+        pg["state"] = "REMOVED"
+        return {"ok": True}
+
+    async def _pg_state(self, conn: ServerConnection, pg_id: str):
+        pg = self.pgs.get(pg_id)
+        return {"state": pg["state"] if pg else "REMOVED"}
 
     # ------------------------------------------------------------------ KV
     # (reference: gcs_kv_manager.cc internal KV — function/code storage, serve

@@ -73,6 +73,9 @@ class NodeDaemon:
         r("return_lease", self._return_lease)
         r("node_info", self._node_info)
         r("ping", self._ping)
+        r("prepare_bundle", self._prepare_bundle)
+        r("commit_bundle", self._commit_bundle)
+        r("return_bundle", self._return_bundle)
 
     async def _ping(self, conn, **kw):
         return {"ok": True, "node_id": self.node_id}
@@ -176,7 +179,8 @@ class NodeDaemon:
         while True:
             try:
                 await self._head.call("heartbeat", node_id=self.node_id,
-                                      available=self.available)
+                                      available=self.available,
+                                      resources=self.resources)
             except Exception:
                 pass
             await asyncio.sleep(cfg.health_check_period_s / 2)
@@ -269,6 +273,62 @@ class NodeDaemon:
             "node_id": self.node_id, "resources": self.resources,
             "available": self.available, "workers": len(self.workers),
         }
+
+    # ------------------------------------------------------------------ placement-group bundles
+    # 2PC participant (reference: NodeManager::HandlePrepareBundleResources
+    # node_manager.cc:1896 / HandleCommitBundleResources :1913; bookkeeping in
+    # NewPlacementGroupResourceManager, ReturnBundle on removal).
+    async def _prepare_bundle(self, conn, pg_id: str, bundle_index: int,
+                              resources: dict):
+        key = (pg_id, bundle_index)
+        if not hasattr(self, "_prepared_bundles"):
+            self._prepared_bundles: dict = {}
+            self._committed_bundles: dict = {}
+        if key in self._prepared_bundles or key in self._committed_bundles:
+            return {"ok": True}  # idempotent retry
+        if not self._fits(resources):
+            return {"ok": False, "reason": "insufficient resources"}
+        self._take_resources(resources)
+        self._prepared_bundles[key] = dict(resources)
+        return {"ok": True}
+
+    async def _commit_bundle(self, conn, pg_id: str, bundle_index: int):
+        key = (pg_id, bundle_index)
+        base = self._prepared_bundles.pop(key, None)
+        if base is None:
+            return {"ok": key in getattr(self, "_committed_bundles", {})}
+        derived = {f"{k}_pg_{pg_id[:16]}_{bundle_index}": v
+                   for k, v in base.items()}
+        for k, v in derived.items():
+            self.resources[k] = v
+            self.available[k] = v
+        self._committed_bundles[key] = (base, derived)
+        # Push the new totals immediately so spillback routing sees the
+        # derived bundle resources without waiting a heartbeat period.
+        try:
+            await self._head.call("heartbeat", node_id=self.node_id,
+                                  available=self.available,
+                                  resources=self.resources)
+        except Exception:
+            pass
+        return {"ok": True}
+
+    async def _return_bundle(self, conn, pg_id: str, bundle_index: int):
+        key = (pg_id, bundle_index)
+        if not hasattr(self, "_prepared_bundles"):
+            return {"ok": True}
+        base = self._prepared_bundles.pop(key, None)
+        if base is not None:  # rollback of a prepared-but-uncommitted bundle
+            self._release_resources(base)
+            return {"ok": True}
+        entry = self._committed_bundles.pop(key, None)
+        if entry is not None:
+            base, derived = entry
+            for k in derived:
+                self.resources.pop(k, None)
+                self.available.pop(k, None)
+            self._release_resources(base)
+        return {"ok": True}
 
     # ------------------------------------------------------------------ actors
     async def _place_actor(self, actor_id: str, spec_blob: bytes, resources: dict):

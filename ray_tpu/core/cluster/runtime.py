@@ -499,6 +499,18 @@ class ClusterRuntime:
         info = self.head.call("get_actor_info", actor_id=actor_id.hex())
         return bool(info and info["state"] == "ALIVE")
 
+    # ------------------------------------------------------------------ placement groups
+    def create_placement_group(self, pg_id, bundles, strategy, name=None,
+                               labels=None) -> None:
+        self.head.call("create_placement_group", pg_id=pg_id.hex(),
+                       bundles=bundles, strategy=strategy, name=name)
+
+    def remove_placement_group(self, pg_id) -> None:
+        self.head.call("remove_placement_group", pg_id=pg_id.hex())
+
+    def placement_group_state(self, pg_id) -> str:
+        return self.head.call("placement_group_state", pg_id=pg_id.hex())["state"]
+
     # ------------------------------------------------------------------ KV
     def kv_put(self, key: str, value: bytes, ns: str = "default") -> None:
         self.head.call("kv_put", ns=ns, key=key, value=value)

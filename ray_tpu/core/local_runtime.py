@@ -77,7 +77,21 @@ class _ResourcePool:
             return dict(self._avail)
 
     def totals(self) -> dict[str, float]:
-        return dict(self._totals)
+        with self._cv:
+            return dict(self._totals)
+
+    def add_resources(self, extra: dict[str, float]) -> None:
+        with self._cv:
+            for k, v in extra.items():
+                self._totals[k] = self._totals.get(k, 0.0) + v
+                self._avail[k] = self._avail.get(k, 0.0) + v
+            self._cv.notify_all()
+
+    def remove_resources(self, extra: dict[str, float]) -> None:
+        with self._cv:
+            for k in extra:
+                self._totals.pop(k, None)
+                self._avail.pop(k, None)
 
 
 @dataclass
@@ -424,6 +438,54 @@ class LocalRuntime:
         with self._lock:
             st = self._actors.get(actor_id)
             return st is not None and not st.dead
+
+    # ------------------------------------------------------------------ placement groups
+    # (single-node semantics: bundles reserve base resources and expose
+    # derived per-bundle resources; strategies are trivially satisfiable on
+    # one node except STRICT_SPREAD)
+    def create_placement_group(self, pg_id, bundles, strategy, name=None,
+                               labels=None) -> None:
+        if strategy == "STRICT_SPREAD" and len(bundles) > 1:
+            self._pg_states = getattr(self, "_pg_states", {})
+            self._pg_states[pg_id] = "FAILED"  # single node: can't spread
+            return
+        total_demand: dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                total_demand[k] = total_demand.get(k, 0.0) + v
+        self._pg_states = getattr(self, "_pg_states", {})
+        self._pg_reserved = getattr(self, "_pg_reserved", {})
+        self._pg_states[pg_id] = "PENDING"
+
+        def reserve():
+            try:
+                ok = self.resources.acquire(total_demand, timeout=60.0)
+            except ValueError:
+                ok = False
+            if not ok:
+                self._pg_states[pg_id] = "FAILED"
+                return
+            derived: dict[str, float] = {}
+            for idx, b in enumerate(bundles):
+                for k, v in b.items():
+                    derived[f"{k}_pg_{pg_id.hex()[:16]}_{idx}"] = v
+            self.resources.add_resources(derived)
+            self._pg_reserved[pg_id] = (total_demand, derived)
+            self._pg_states[pg_id] = "CREATED"
+
+        threading.Thread(target=reserve, daemon=True).start()
+
+    def remove_placement_group(self, pg_id) -> None:
+        reserved = getattr(self, "_pg_reserved", {}).pop(pg_id, None)
+        if reserved is None:
+            return
+        base, derived = reserved
+        self.resources.remove_resources(derived)
+        self.resources.release(base)
+        getattr(self, "_pg_states", {})[pg_id] = "REMOVED"
+
+    def placement_group_state(self, pg_id) -> str:
+        return getattr(self, "_pg_states", {}).get(pg_id, "PENDING")
 
     # ------------------------------------------------------------------ misc
     def cluster_resources(self) -> dict[str, float]:

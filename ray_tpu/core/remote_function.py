@@ -43,6 +43,22 @@ def _build_resources(opts: dict[str, Any]) -> dict[str, float]:
     return res
 
 
+def resolve_strategy(resources: dict[str, float], strategy):
+    """Normalize the user-facing scheduling strategy: placement-group
+    strategies rewrite demands onto the bundle's derived resources."""
+    if strategy is None:
+        return resources, SchedulingStrategy()
+    if isinstance(strategy, SchedulingStrategy):
+        return resources, strategy
+    # PlacementGroupSchedulingStrategy (duck-typed to avoid import cycle)
+    if hasattr(strategy, "to_scheduling_strategy"):
+        from ray_tpu.util.placement_group import rewrite_resources_for_pg
+
+        return (rewrite_resources_for_pg(resources, strategy),
+                strategy.to_scheduling_strategy())
+    raise TypeError(f"unsupported scheduling strategy {strategy!r}")
+
+
 def extract_arg_refs(args: tuple, kwargs: dict) -> list[ObjectRef]:
     refs = [a for a in args if isinstance(a, ObjectRef)]
     refs += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
@@ -78,6 +94,8 @@ class RemoteFunction:
             self._fn_blob = serialization.dumps_function(self._fn)
         opts = self._options
         arg_refs = extract_arg_refs(args, kwargs)
+        resources, strategy = resolve_strategy(
+            _build_resources(opts), opts["scheduling_strategy"])
         spec = TaskSpec(
             task_id=TaskID.of(worker.job_id),
             job_id=worker.job_id,
@@ -86,10 +104,10 @@ class RemoteFunction:
             arg_ref_ids=[r.id for r in arg_refs],
             arg_owner_ids=[r.owner_id for r in arg_refs],
             num_returns=opts["num_returns"],
-            resources=_build_resources(opts),
+            resources=resources,
             max_retries=opts["max_retries"],
             retry_exceptions=bool(opts["retry_exceptions"]),
-            scheduling_strategy=opts["scheduling_strategy"] or SchedulingStrategy(),
+            scheduling_strategy=strategy,
             runtime_env=opts["runtime_env"],
             name=opts["name"] or self._fn.__name__,
             owner_id=worker.worker_id,

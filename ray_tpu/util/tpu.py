@@ -1,0 +1,95 @@
+"""Slice-granular TPU scheduling: reserve whole multi-host slices atomically.
+
+Capability parity with the reference's ray.util.tpu (reference:
+python/ray/util/tpu.py — SlicePlacementGroup :351, slice_placement_group
+:581, multi-slice coordinator env get_tpu_coordinator_env_vars :199,
+get_tpu_nodes_for_slice :239): a slice is the atomic scheduling unit — one
+bundle per TPU host, STRICT_SPREAD so each bundle lands on a distinct host,
+with the slice-head marker resource pinning bundle 0 to the slice's worker 0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ray_tpu.accelerators.tpu import (
+    chips_per_host,
+    num_hosts,
+    slice_head_resource,
+)
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+
+@dataclass
+class SlicePlacementGroup:
+    """Reserves every host of one TPU slice as one placement group."""
+
+    pod_type: str  # e.g. "v5p-64"
+    num_slices: int = 1
+    pg: PlacementGroup | None = field(default=None, repr=False)
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return num_hosts(self.pod_type)
+
+    @property
+    def chips_per_host(self) -> int:
+        return chips_per_host(self.pod_type)
+
+    @property
+    def total_bundles(self) -> int:
+        return self.hosts_per_slice * self.num_slices
+
+    def bundles(self) -> list[dict[str, float]]:
+        out = []
+        for s in range(self.num_slices):
+            for h in range(self.hosts_per_slice):
+                b = {"TPU": float(self.chips_per_host)}
+                if h == 0:
+                    # pin to the slice's worker 0 via the head marker
+                    b[slice_head_resource(self.pod_type)] = 1.0
+                out.append(b)
+        return out
+
+    def reserve(self) -> "SlicePlacementGroup":
+        strategy = "STRICT_SPREAD" if self.total_bundles > 1 else "PACK"
+        self.pg = placement_group(self.bundles(), strategy=strategy)
+        return self
+
+    def ready(self, timeout: float | None = 120.0) -> bool:
+        return self.pg.ready(timeout) if self.pg else False
+
+    def worker_strategy(self, slice_index: int, host_index: int
+                        ) -> PlacementGroupSchedulingStrategy:
+        """Scheduling strategy for the train worker of (slice, host)."""
+        idx = slice_index * self.hosts_per_slice + host_index
+        return PlacementGroupSchedulingStrategy(
+            placement_group=self.pg, placement_group_bundle_index=idx)
+
+    def remove(self) -> None:
+        if self.pg:
+            remove_placement_group(self.pg)
+
+
+def slice_placement_group(pod_type: str, num_slices: int = 1
+                          ) -> SlicePlacementGroup:
+    """Reserve ``num_slices`` whole slices of ``pod_type`` (reference:
+    slice_placement_group util/tpu.py:581)."""
+    return SlicePlacementGroup(pod_type, num_slices).reserve()
+
+
+def get_tpu_coordinator_env_vars(coordinator_addr: str, num_slices: int,
+                                 slice_id: int) -> dict[str, str]:
+    """Multi-slice (DCN) runtime env for each host process (reference:
+    get_tpu_coordinator_env_vars util/tpu.py:199 — the MEGASCALE_* variables
+    are the public libtpu multi-slice interface)."""
+    return {
+        "MEGASCALE_COORDINATOR_ADDRESS": coordinator_addr,
+        "MEGASCALE_NUM_SLICES": str(num_slices),
+        "MEGASCALE_SLICE_ID": str(slice_id),
+    }

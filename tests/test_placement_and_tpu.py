@@ -1,0 +1,185 @@
+"""Placement groups (local + cluster 2PC) and the TPU resource model.
+
+(Reference shapes: python/ray/tests/test_placement_group*.py and
+python/ray/tests/accelerators/test_tpu.py — env/metadata mocked.)
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.accelerators.tpu import (
+    TpuAcceleratorManager,
+    chips_per_host,
+    num_hosts,
+    parse_pod_type,
+    slice_head_resource,
+)
+from ray_tpu.util.placement_group import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util.tpu import SlicePlacementGroup, get_tpu_coordinator_env_vars
+
+
+# ---------------------------------------------------------------- local PGs
+def test_pg_reserve_and_schedule(rt_start):
+    pg = placement_group([{"CPU": 2.0}, {"CPU": 1.0}], strategy="PACK")
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(num_cpus=2)
+    def inside():
+        return "pg"
+
+    strat = PlacementGroupSchedulingStrategy(pg, 0)
+    assert ray_tpu.get(
+        inside.options(scheduling_strategy=strat).remote(), timeout=30) == "pg"
+    remove_placement_group(pg)
+    assert ray_tpu.available_resources().get("CPU") == 8.0
+
+
+def test_pg_reserves_capacity(rt_start):
+    pg = placement_group([{"CPU": 6.0}])
+    assert pg.ready(timeout=10)
+    # only 2 CPUs left outside the group
+    assert ray_tpu.available_resources()["CPU"] == 2.0
+    remove_placement_group(pg)
+
+
+def test_pg_infeasible_fails(rt_start):
+    pg = placement_group([{"CPU": 100.0}])
+    assert not pg.ready(timeout=1.0)
+
+
+def test_pg_strict_spread_impossible_on_one_node(rt_start):
+    pg = placement_group([{"CPU": 1.0}, {"CPU": 1.0}], strategy="STRICT_SPREAD")
+    assert not pg.ready(timeout=1.0)
+
+
+def test_pg_bad_args(rt_start):
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+
+
+# ---------------------------------------------------------------- TPU model
+def test_parse_pod_types():
+    assert parse_pod_type("v5p-64") == ("v5p", 32)   # 64 cores → 32 chips
+    assert parse_pod_type("v5e-16") == ("v5e", 16)
+    assert parse_pod_type("v4-8") == ("v4", 4)
+    with pytest.raises(ValueError):
+        parse_pod_type("gpu-8")
+
+
+def test_hosts_and_chips():
+    assert num_hosts("v5p-64") == 8          # 32 chips / 4 per host
+    assert chips_per_host("v5p-64") == 4
+    assert num_hosts("v5e-16") == 2          # 16 chips / 8 per host
+    assert chips_per_host("v5e-16") == 8
+    assert num_hosts("v4-8") == 1
+
+
+def test_manager_detection_from_env():
+    mgr = TpuAcceleratorManager(env={
+        "TPU_ACCELERATOR_TYPE": "v5p-64",
+        "TPU_WORKER_ID": "0",
+        "TPU_NAME": "slice-a",
+    })
+    assert mgr.get_current_node_accelerator_type() == "v5p"
+    assert mgr.get_current_node_num_accelerators() == 4
+    res = mgr.get_current_node_resources()
+    assert res["TPU"] == 4.0
+    assert res[slice_head_resource("v5p-64")] == 1.0  # worker 0 only
+    labels = mgr.get_current_node_labels()
+    assert labels["rtpu.io/tpu-slice-name"] == "slice-a"
+    assert labels["rtpu.io/tpu-worker-id"] == "0"
+
+
+def test_manager_non_head_worker_has_no_marker():
+    mgr = TpuAcceleratorManager(env={
+        "TPU_ACCELERATOR_TYPE": "v5p-64", "TPU_WORKER_ID": "3",
+    })
+    res = mgr.get_current_node_resources()
+    assert "TPU" in res and len(res) == 1
+
+
+def test_manager_visible_chips_env():
+    mgr = TpuAcceleratorManager(env={"TPU_VISIBLE_CHIPS": "0,1"})
+    assert mgr.get_current_node_num_accelerators() == 2
+    assert mgr.set_visible_accelerator_ids(["2", "3"]) == {
+        "TPU_VISIBLE_CHIPS": "2,3"}
+
+
+def test_manager_metadata_fallback():
+    mgr = TpuAcceleratorManager(
+        env={},
+        metadata_getter={"accelerator-type": "v5e-16",
+                         "agent-worker-number": "0"}.get,
+    )
+    assert mgr.get_current_node_num_accelerators() == 8
+    assert slice_head_resource("v5e-16") in mgr.get_current_node_resources()
+
+
+def test_coordinator_env_vars():
+    env = get_tpu_coordinator_env_vars("10.0.0.1:8080", 4, 2)
+    assert env["MEGASCALE_NUM_SLICES"] == "4"
+    assert env["MEGASCALE_SLICE_ID"] == "2"
+
+
+# ------------------------------------------------------------ cluster 2PC
+@pytest.fixture(scope="module")
+def pg_cluster():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.utils.ids import JobID
+
+    c = Cluster()
+    # a fake v5e-16 slice: 2 hosts × 8 chips, worker 0 carries the marker
+    c.add_node(num_cpus=2, resources={"TPU": 8.0,
+                                      slice_head_resource("v5e-16"): 1.0},
+               labels={"rtpu.io/tpu-worker-id": "0"})
+    c.add_node(num_cpus=2, resources={"TPU": 8.0},
+               labels={"rtpu.io/tpu-worker-id": "1"})
+    rt = c.connect()
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    yield c
+    rt.shutdown()
+    c.shutdown()
+    global_worker.runtime = None
+
+
+def test_slice_placement_group_cluster(pg_cluster):
+    spg = SlicePlacementGroup("v5e-16").reserve()
+    assert spg.hosts_per_slice == 2
+    assert spg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=0, num_tpus=8)
+    def on_host():
+        return "got slice host"
+
+    out = ray_tpu.get([
+        on_host.options(
+            scheduling_strategy=spg.worker_strategy(0, h)).remote()
+        for h in range(2)
+    ], timeout=60)
+    assert out == ["got slice host"] * 2
+    spg.remove()
+
+
+def test_cluster_pg_strict_spread(pg_cluster):
+    pg = placement_group([{"CPU": 1.0}, {"CPU": 1.0}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    remove_placement_group(pg)
+
+
+def test_cluster_pg_infeasible_stays_pending(pg_cluster):
+    pg = placement_group([{"CPU": 50.0}])
+    assert not pg.ready(timeout=1.5)
+    remove_placement_group(pg)
