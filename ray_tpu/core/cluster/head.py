@@ -135,6 +135,7 @@ class HeadServer:
         self, conn: ServerConnection, node_id: str, host: str, port: int,
         resources: dict, labels: dict | None = None,
     ):
+        self._drop_daemon_client(node_id)  # re-registration: stale address
         self.nodes[node_id] = NodeInfo(
             node_id=node_id, addr=(host, port), resources=dict(resources),
             available=dict(resources), labels=labels or {},
@@ -160,6 +161,7 @@ class HeadServer:
         info = self.nodes.get(node_id)
         if info:
             info.alive = False
+            self._drop_daemon_client(node_id)
             await self.publish("node_events", event="removed", node_id=node_id)
         return {"ok": True}
 
@@ -182,6 +184,7 @@ class HeadServer:
             for node in list(self.nodes.values()):
                 if node.alive and now - node.last_heartbeat > threshold:
                     node.alive = False
+                    self._drop_daemon_client(node.node_id)
                     await self.publish("node_events", event="died", node_id=node.node_id)
                     await self._fail_actors_on_node(node.node_id)
 
@@ -334,13 +337,33 @@ class HeadServer:
     async def _daemon_rpc(self, node_id: str):
         from ray_tpu.core.cluster.protocol import AsyncRpcClient
 
-        cli = self._daemon_clients.get(node_id)
-        if cli is None:
-            info = self.nodes[node_id]
-            cli = AsyncRpcClient(*info.addr)
-            await cli.connect()
-            self._daemon_clients[node_id] = cli
+        info = self.nodes[node_id]
+        cached = self._daemon_clients.get(node_id)
+        if cached is not None:
+            addr, cli = cached
+            if addr == info.addr:
+                return cli
+            # node re-registered at a new address: drop the stale client
+            try:
+                await cli.close()
+            except Exception:
+                pass
+            self._daemon_clients.pop(node_id, None)
+        cli = AsyncRpcClient(*info.addr)
+        await cli.connect()
+        self._daemon_clients[node_id] = (info.addr, cli)
         return cli
+
+    def _drop_daemon_client(self, node_id: str) -> None:
+        cached = self._daemon_clients.pop(node_id, None)
+        if cached is not None:
+            _, cli = cached
+            try:
+                close = cli.close()
+                if asyncio.iscoroutine(close):
+                    asyncio.get_running_loop().create_task(close)
+            except Exception:
+                pass
 
     def _assign_bundles(self, bundles: list[dict], strategy: str) -> list[str] | None:
         """bundle index → node_id, honoring the strategy; None if infeasible."""
@@ -416,38 +439,60 @@ class HeadServer:
                     except Exception:
                         ok = False
                         break
+                # A remove() may have arrived while prepares were in flight —
+                # honor it before committing anything.
+                if pg["state"] == "REMOVED":
+                    await self._rollback_bundles(pg_id, assignment, prepared)
+                    return
                 if ok:
-                    for idx, nid in enumerate(assignment):
-                        cli = await self._daemon_rpc(nid)
-                        await cli.call("commit_bundle", pg_id=pg_id,
-                                       bundle_index=idx)
+                    committed: list[int] = []
+                    try:
+                        for idx, nid in enumerate(assignment):
+                            cli = await self._daemon_rpc(nid)
+                            await cli.call("commit_bundle", pg_id=pg_id,
+                                           bundle_index=idx)
+                            committed.append(idx)
+                    except Exception:
+                        # A node died mid-commit: roll back everything (bundle
+                        # return works for both prepared and committed) and
+                        # retry the whole placement from scratch.
+                        await self._rollback_bundles(pg_id, assignment, prepared)
+                        await asyncio.sleep(0.5)
+                        continue
+                    if pg["state"] == "REMOVED":  # removed during commit
+                        await self._rollback_bundles(pg_id, assignment, committed)
+                        return
                     pg["assignment"] = assignment
                     pg["state"] = "CREATED"
                     await self.publish("pg_events", pg_id=pg_id, state="CREATED")
                     return
                 # rollback prepared bundles, retry later
-                for idx in prepared:
-                    try:
-                        cli = await self._daemon_rpc(assignment[idx])
-                        await cli.call("return_bundle", pg_id=pg_id,
-                                       bundle_index=idx)
-                    except Exception:
-                        pass
+                await self._rollback_bundles(pg_id, assignment, prepared)
             await asyncio.sleep(0.5)
-        pg["state"] = "FAILED"
+        if pg["state"] != "REMOVED":
+            pg["state"] = "FAILED"
+
+    async def _rollback_bundles(self, pg_id: str, assignment: list[str],
+                                indices: list[int]) -> None:
+        for idx in indices:
+            try:
+                cli = await self._daemon_rpc(assignment[idx])
+                await cli.call("return_bundle", pg_id=pg_id, bundle_index=idx)
+            except Exception:
+                pass
 
     async def _remove_pg(self, conn: ServerConnection, pg_id: str):
         pg = self.pgs.get(pg_id)
         if pg is None:
             return {"ok": True}
-        if pg.get("assignment"):
-            for idx, nid in enumerate(pg["assignment"]):
-                try:
-                    cli = await self._daemon_rpc(nid)
-                    await cli.call("return_bundle", pg_id=pg_id, bundle_index=idx)
-                except Exception:
-                    pass
+        # Mark REMOVED first: a mid-flight _schedule_pg checks this before and
+        # after its commit phase, so either it rolls its bundles back itself or
+        # we return the already-committed assignment here.
         pg["state"] = "REMOVED"
+        if pg.get("assignment"):
+            await self._rollback_bundles(
+                pg_id, pg["assignment"], list(range(len(pg["assignment"]))))
+            pg["assignment"] = None
         return {"ok": True}
 
     async def _pg_state(self, conn: ServerConnection, pg_id: str):

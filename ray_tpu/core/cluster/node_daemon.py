@@ -63,6 +63,9 @@ class NodeDaemon:
         self._head: AsyncRpcClient | None = None
         self._leases: dict[str, WorkerProc] = {}
         self._actor_workers: dict[str, WorkerProc] = {}
+        # 2PC bundle bookkeeping: (pg_id, bundle_index) -> resources
+        self._prepared_bundles: dict[tuple[str, int], dict] = {}
+        self._committed_bundles: dict[tuple[str, int], tuple[dict, dict]] = {}
         self._register_handlers()
         self._bg: list[asyncio.Task] = []
 
@@ -281,9 +284,6 @@ class NodeDaemon:
     async def _prepare_bundle(self, conn, pg_id: str, bundle_index: int,
                               resources: dict):
         key = (pg_id, bundle_index)
-        if not hasattr(self, "_prepared_bundles"):
-            self._prepared_bundles: dict = {}
-            self._committed_bundles: dict = {}
         if key in self._prepared_bundles or key in self._committed_bundles:
             return {"ok": True}  # idempotent retry
         if not self._fits(resources):
@@ -296,9 +296,12 @@ class NodeDaemon:
         key = (pg_id, bundle_index)
         base = self._prepared_bundles.pop(key, None)
         if base is None:
-            return {"ok": key in getattr(self, "_committed_bundles", {})}
+            return {"ok": key in self._committed_bundles}
         derived = {f"{k}_pg_{pg_id[:16]}_{bundle_index}": v
                    for k, v in base.items()}
+        # Bundle marker resource: pins even zero-resource tasks to the bundle's
+        # node (reference: bundle_group_* 0.001-resource trick).
+        derived[f"bundle_pg_{pg_id[:16]}_{bundle_index}"] = 1000.0
         for k, v in derived.items():
             self.resources[k] = v
             self.available[k] = v
@@ -315,8 +318,6 @@ class NodeDaemon:
 
     async def _return_bundle(self, conn, pg_id: str, bundle_index: int):
         key = (pg_id, bundle_index)
-        if not hasattr(self, "_prepared_bundles"):
-            return {"ok": True}
         base = self._prepared_bundles.pop(key, None)
         if base is not None:  # rollback of a prepared-but-uncommitted bundle
             self._release_resources(base)

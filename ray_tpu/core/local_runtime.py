@@ -119,10 +119,16 @@ class LocalRuntime:
         self.worker_id = WorkerID.from_random()
         self.store = LocalObjectStore()
         self._released: set[ObjectID] = set()
+        # container object -> ObjectIDs nested inside its stored value
+        # (reference semantics: reference_counter.h nested refs keep the inner
+        # object alive until the outer object is GC'd)
+        self._nested: dict[ObjectID, list[ObjectID]] = {}
         self.refs = ReferenceCounter(on_release=self._on_release)
         self.resources = _ResourcePool(totals)
         self._actors: dict[ActorID, _ActorState] = {}
         self._named_actors: dict[tuple[str, str], ActorID] = {}
+        self._pg_states: dict = {}
+        self._pg_reserved: dict = {}
         self._cancelled: set[ObjectID] = set()
         self._lock = threading.RLock()
         self._shutdown = False
@@ -132,12 +138,23 @@ class LocalRuntime:
         # stored forever (fire-and-forget tasks).
         self._released.add(oid)
         self.store.delete(oid)
+        for nid in self._nested.pop(oid, ()):  # release refs the value held
+            self.refs.remove_local_ref(nid)
+
+    def _register_nested(self, oid: ObjectID, value: Any) -> None:
+        """Refs nested in a stored value are held by the container object."""
+        nested = serialization.find_nested_refs(value)
+        if nested:
+            for r in nested:
+                self.refs.add_local_ref(r.id)
+            self._nested[oid] = [r.id for r in nested]
 
     # ------------------------------------------------------------------ put/get
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.worker_id)
         self.store.put(oid, serialization.serialize(value), self.worker_id)
         self.refs.add_owned(oid, self.worker_id)
+        self._register_nested(oid, value)
         return ObjectRef(oid, self.worker_id)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
@@ -279,6 +296,7 @@ class LocalRuntime:
                 v = self.get([v])[0]
             if oid not in self._released:
                 self.store.put(oid, serialization.serialize(v), self.worker_id)
+                self._register_nested(oid, v)
 
     def _store_error(self, return_ids: list[ObjectID], err: BaseException) -> None:
         blob = serialization.serialize(err)
@@ -446,15 +464,12 @@ class LocalRuntime:
     def create_placement_group(self, pg_id, bundles, strategy, name=None,
                                labels=None) -> None:
         if strategy == "STRICT_SPREAD" and len(bundles) > 1:
-            self._pg_states = getattr(self, "_pg_states", {})
             self._pg_states[pg_id] = "FAILED"  # single node: can't spread
             return
         total_demand: dict[str, float] = {}
         for b in bundles:
             for k, v in b.items():
                 total_demand[k] = total_demand.get(k, 0.0) + v
-        self._pg_states = getattr(self, "_pg_states", {})
-        self._pg_reserved = getattr(self, "_pg_reserved", {})
         self._pg_states[pg_id] = "PENDING"
 
         def reserve():
@@ -463,29 +478,39 @@ class LocalRuntime:
             except ValueError:
                 ok = False
             if not ok:
-                self._pg_states[pg_id] = "FAILED"
+                if self._pg_states.get(pg_id) == "PENDING":
+                    self._pg_states[pg_id] = "FAILED"
                 return
-            derived: dict[str, float] = {}
-            for idx, b in enumerate(bundles):
-                for k, v in b.items():
-                    derived[f"{k}_pg_{pg_id.hex()[:16]}_{idx}"] = v
-            self.resources.add_resources(derived)
-            self._pg_reserved[pg_id] = (total_demand, derived)
-            self._pg_states[pg_id] = "CREATED"
+            with self._lock:
+                # remove() may have arrived while we were waiting to reserve
+                if self._pg_states.get(pg_id) != "PENDING":
+                    self.resources.release(total_demand)
+                    return
+                derived: dict[str, float] = {}
+                for idx, b in enumerate(bundles):
+                    for k, v in b.items():
+                        derived[f"{k}_pg_{pg_id.hex()[:16]}_{idx}"] = v
+                    derived[f"bundle_pg_{pg_id.hex()[:16]}_{idx}"] = 1000.0
+                self.resources.add_resources(derived)
+                self._pg_reserved[pg_id] = (total_demand, derived)
+                self._pg_states[pg_id] = "CREATED"
 
         threading.Thread(target=reserve, daemon=True).start()
 
     def remove_placement_group(self, pg_id) -> None:
-        reserved = getattr(self, "_pg_reserved", {}).pop(pg_id, None)
+        with self._lock:
+            # Mark first so a reserve() still blocked in acquire() aborts
+            # instead of resurrecting a removed PG.
+            self._pg_states[pg_id] = "REMOVED"
+            reserved = self._pg_reserved.pop(pg_id, None)
         if reserved is None:
             return
         base, derived = reserved
         self.resources.remove_resources(derived)
         self.resources.release(base)
-        getattr(self, "_pg_states", {})[pg_id] = "REMOVED"
 
     def placement_group_state(self, pg_id) -> str:
-        return getattr(self, "_pg_states", {}).get(pg_id, "PENDING")
+        return self._pg_states.get(pg_id, "PENDING")
 
     # ------------------------------------------------------------------ misc
     def cluster_resources(self) -> dict[str, float]:

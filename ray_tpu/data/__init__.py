@@ -1,0 +1,140 @@
+"""ray_tpu.data: streaming distributed datasets (reference capability:
+python/ray/data — lazy logical plan, streaming block executor, blocks as
+object-store refs, per-train-worker streaming_split)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+# Eagerly finish every heavy IO import while single-threaded: pyarrow and
+# pandas lazily import C-extension submodules at call time (read_table pulls
+# pyarrow.dataset, etc.), and concurrent first-imports of C extensions from
+# parallel task threads segfault CPython's import machinery.
+try:
+    import pandas as _pd  # noqa: F401
+    import pyarrow as _pa  # noqa: F401
+    import pyarrow.csv as _pa_csv  # noqa: F401
+    import pyarrow.dataset as _pa_ds  # noqa: F401
+    import pyarrow.parquet as _pa_pq  # noqa: F401
+except ImportError:  # pragma: no cover - optional IO deps
+    pass
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset
+from ray_tpu.data.executor import ActorPoolStrategy
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.plan import InputData, Read
+from ray_tpu.data.shuffle import (
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)
+from ray_tpu.data.datasource import (
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+)
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return Dataset([Read(RangeDatasource(n), parallelism)])
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    return Dataset([Read(ItemsDatasource(items), parallelism)])
+
+
+def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
+    return Dataset([Read(ds, parallelism)])
+
+
+def read_parquet(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return Dataset([Read(ParquetDatasource(paths, **kwargs), parallelism)])
+
+
+def read_csv(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return Dataset([Read(CSVDatasource(paths, **kwargs), parallelism)])
+
+
+def read_json(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return Dataset([Read(JSONDatasource(paths, **kwargs), parallelism)])
+
+
+def read_numpy(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return Dataset([Read(NumpyDatasource(paths, **kwargs), parallelism)])
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return Dataset([Read(BinaryDatasource(paths), parallelism)])
+
+
+def from_pandas(df) -> Dataset:
+    from ray_tpu.data.block import block_from_pandas
+
+    return from_blocks([block_from_pandas(df)])
+
+
+def from_numpy(arr) -> Dataset:
+    from ray_tpu.data.block import block_from_numpy
+
+    return from_blocks([block_from_numpy(arr)])
+
+
+def from_arrow(table) -> Dataset:
+    from ray_tpu.data.block import block_from_arrow
+
+    return from_blocks([block_from_arrow(table)])
+
+
+def from_blocks(blocks: list[Block]) -> MaterializedDataset:
+    import ray_tpu
+
+    refs_meta = [
+        (ray_tpu.put(b), {"num_rows": BlockAccessor(b).num_rows()})
+        for b in blocks
+    ]
+    return MaterializedDataset(refs_meta)
+
+
+__all__ = [
+    "ActorPoolStrategy",
+    "AggregateFn",
+    "Block",
+    "BlockAccessor",
+    "Count",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "GroupedData",
+    "MaterializedDataset",
+    "Max",
+    "Mean",
+    "Min",
+    "ReadTask",
+    "Std",
+    "Sum",
+    "from_arrow",
+    "from_blocks",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+]

@@ -1,0 +1,33 @@
+"""Execution context/knobs (reference capability:
+python/ray/data/context.py DataContext)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DataContext:
+    # rows per output block a read aims for when parallelism=-1
+    target_min_rows_per_block: int = 1000
+    # default read parallelism when unknown
+    default_parallelism: int = 8
+    # per map-stage cap on concurrently running tasks
+    max_tasks_in_flight_per_stage: int = 8
+    # cap on produced-but-unconsumed blocks per stage (backpressure)
+    max_output_blocks_buffered: int = 16
+    # shuffle fan-out
+    default_shuffle_partitions: int = 8
+    # task resource demand for data tasks (0 CPU => don't starve trainers)
+    task_num_cpus: float = 0.25
+
+    _local = threading.local()
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        ctx = getattr(DataContext._local, "ctx", None)
+        if ctx is None:
+            ctx = DataContext()
+            DataContext._local.ctx = ctx
+        return ctx

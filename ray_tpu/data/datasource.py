@@ -1,0 +1,253 @@
+"""Datasources: each produces a list of ReadTasks (reference capability:
+python/ray/data/datasource/ + read_api.py:934 read_parquet).
+
+A ReadTask is a zero-arg callable returning one Block; the executor runs them
+as remote tasks so reads parallelize and blocks land in the object store.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from ray_tpu.data.block import (
+    Block,
+    block_from_arrow,
+    block_from_numpy,
+    block_from_pandas,
+    block_from_rows,
+)
+
+
+@dataclass
+class ReadTask:
+    fn: Callable[[], Block]
+    # best-effort metadata for planning; -1 means unknown
+    num_rows: int = -1
+    metadata: dict = field(default_factory=dict)
+
+    def __call__(self) -> Block:
+        return self.fn()
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, column: str = "id"):
+        self._n = n
+        self._col = column
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n or 1))
+        chunk = self._n // parallelism
+        rem = self._n % parallelism
+        tasks, start = [], 0
+        for i in range(parallelism):
+            size = chunk + (1 if i < rem else 0)
+            lo, hi = start, start + size
+            start = hi
+            col = self._col
+
+            def fn(lo=lo, hi=hi, col=col) -> Block:
+                return {col: np.arange(lo, hi, dtype=np.int64)}
+
+            tasks.append(ReadTask(fn, num_rows=size))
+        return [t for t in tasks if t.num_rows > 0] or [
+            ReadTask(lambda col=self._col: {col: np.arange(0, dtype=np.int64)},
+                     num_rows=0)
+        ]
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: list):
+        self._items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        n = len(self._items)
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = n // parallelism
+        rem = n % parallelism
+        tasks, start = [], 0
+        for i in range(parallelism):
+            size = chunk + (1 if i < rem else 0)
+            part = self._items[start:start + size]
+            start += size
+            if not part and n > 0:
+                continue
+
+            def fn(part=part) -> Block:
+                rows = [r if isinstance(r, dict) else {"item": r} for r in part]
+                return block_from_rows(rows)
+
+            tasks.append(ReadTask(fn, num_rows=size))
+        return tasks or [ReadTask(lambda: {}, num_rows=0)]
+
+
+def _expand_paths(paths, suffixes: tuple[str, ...]) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for suf in suffixes:
+                out.extend(sorted(_glob.glob(os.path.join(p, f"*{suf}"))))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+class FileDatasource(Datasource):
+    suffixes: tuple[str, ...] = ()
+
+    def __init__(self, paths, **read_kwargs):
+        self._paths = _expand_paths(paths, self.suffixes)
+        self._kwargs = read_kwargs
+
+    def read_file(self, path: str) -> Block:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        tasks = []
+        for path in self._paths:
+            def fn(path=path):
+                return self.read_file(path)
+
+            tasks.append(ReadTask(fn, metadata={"path": path}))
+        return tasks
+
+
+class ParquetDatasource(FileDatasource):
+    suffixes = (".parquet",)
+
+    def read_file(self, path: str) -> Block:
+        pq = _import_pq()
+
+        return block_from_arrow(pq.read_table(path, **self._kwargs))
+
+
+class CSVDatasource(FileDatasource):
+    suffixes = (".csv",)
+
+    def read_file(self, path: str) -> Block:
+        pd = _import_pd()
+
+        return block_from_pandas(pd.read_csv(path, **self._kwargs))
+
+
+class JSONDatasource(FileDatasource):
+    suffixes = (".json", ".jsonl")
+
+    def read_file(self, path: str) -> Block:
+        import json
+
+        rows = []
+        with open(path) as f:
+            text = f.read().strip()
+        if text.startswith("["):
+            rows = json.loads(text)
+        else:
+            for line in text.splitlines():
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        return block_from_rows(rows)
+
+
+class NumpyDatasource(FileDatasource):
+    suffixes = (".npy",)
+
+    def read_file(self, path: str) -> Block:
+        return block_from_numpy(np.load(path, allow_pickle=False))
+
+
+class BinaryDatasource(FileDatasource):
+    """Whole-file bytes, one row per file (images etc.)."""
+
+    suffixes = ()
+
+    def read_file(self, path: str) -> Block:
+        with open(path, "rb") as f:
+            data = f.read()
+        col = np.empty(1, dtype=object)
+        col[0] = data
+        pcol = np.empty(1, dtype=object)
+        pcol[0] = path
+        return {"bytes": col, "path": pcol}
+
+
+# ---------------------------------------------------------------------------
+# write tasks
+
+
+import threading as _threading
+
+# Concurrent *first* imports of pyarrow/pandas C-extension submodules from
+# parallel task threads segfault CPython's import machinery — take one lock
+# around the lazy import, then use the cached module freely from any thread.
+_IMPORT_LOCK = _threading.Lock()
+
+
+def _import_pq():
+    with _IMPORT_LOCK:
+        import pyarrow.parquet as pq
+
+        return pq
+
+
+def _import_pd():
+    with _IMPORT_LOCK:
+        import pandas as pd
+
+        return pd
+
+
+def write_block_parquet(block: Block, path: str, index: int) -> str:
+    pq = _import_pq()
+
+    from ray_tpu.data.block import BlockAccessor
+
+    out = os.path.join(path, f"part-{index:05d}.parquet")
+    pq.write_table(BlockAccessor(block).to_arrow(), out)
+    return out
+
+
+def write_block_csv(block: Block, path: str, index: int) -> str:
+    from ray_tpu.data.block import BlockAccessor
+
+    out = os.path.join(path, f"part-{index:05d}.csv")
+    BlockAccessor(block).to_pandas().to_csv(out, index=False)
+    return out
+
+
+def write_block_json(block: Block, path: str, index: int) -> str:
+    import json
+
+    from ray_tpu.data.block import BlockAccessor
+
+    out = os.path.join(path, f"part-{index:05d}.jsonl")
+    with open(out, "w") as f:
+        for row in BlockAccessor(block).iter_rows():
+            f.write(json.dumps(row, default=_json_default) + "\n")
+    return out
+
+
+def _json_default(v: Any):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    raise TypeError(f"not JSON serializable: {type(v)}")

@@ -1,0 +1,307 @@
+"""Streaming executor (reference capability:
+python/ray/data/_internal/execution/streaming_executor.py:77 — pull-based
+streaming over blocks-as-refs with in-flight budgets and backpressure).
+
+The plan is a linear chain of stages. Each map stage keeps a bounded pool of
+in-flight remote tasks; completed blocks flow downstream without waiting for
+the stage to finish. AllToAll stages are barriers that run their own
+distributed shuffle. The whole loop is a generator: consumers pull
+(block_ref, meta) pairs, which is itself the final backpressure.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.datasource import ReadTask
+from ray_tpu.data.plan import AllToAll, FusedMapStage, InputData, LimitOp, Read
+
+
+def _run_block_fn(block_fn, block: Block):
+    out = block_fn(block)
+    return out, {"num_rows": BlockAccessor(out).num_rows()}
+
+
+def _run_read_task(task: ReadTask):
+    out = task()
+    return out, {"num_rows": BlockAccessor(out).num_rows()}
+
+
+def _slice_block(block: Block, start: int, end: int):
+    out = BlockAccessor(block).slice(start, end)
+    return out, {"num_rows": end - start}
+
+
+class ActorPoolStrategy:
+    """compute= argument for map_batches (reference capability:
+    ray.data.ActorPoolStrategy — actor-pool map operator for stateful or
+    accelerator-bound transforms)."""
+
+    def __init__(self, size: int = 2, *, num_cpus: float = 1.0,
+                 num_tpus: float = 0.0, resources: dict | None = None):
+        self.size = size
+        self.num_cpus = num_cpus
+        self.num_tpus = num_tpus
+        self.resources = resources or {}
+
+
+class _MapWorker:
+    """Actor applying a fused block fn; holds user state (e.g. a compiled
+    model) across blocks."""
+
+    def __init__(self, block_fn):
+        self._fn = block_fn
+
+    def apply(self, block: Block):
+        return _run_block_fn(self._fn, block)
+
+    def ping(self):
+        return True
+
+
+class _StageExec:
+    """Runtime state of one map stage."""
+
+    def __init__(self, stage: FusedMapStage, ctx: DataContext, api):
+        self.stage = stage
+        self.ctx = ctx
+        self.api = api
+        self.input_queue: collections.deque = collections.deque()
+        self.upstream_done = False
+        # meta_ref -> (block_ref, actor_index|None)
+        self.in_flight: dict = {}
+        self.outputs: collections.deque = collections.deque()
+        self._remote_fn = api.remote(num_cpus=ctx.task_num_cpus, num_returns=2)(
+            _run_block_fn
+        )
+        self._pool = None
+        self._pool_load: list[int] = []
+        if isinstance(stage.compute, ActorPoolStrategy):
+            comp = stage.compute
+            actor_cls = api.remote(
+                num_cpus=comp.num_cpus, num_tpus=comp.num_tpus,
+                resources=comp.resources,
+            )(_MapWorker)
+            fn_ref = api.put(stage.block_fn)
+            self._pool = [actor_cls.remote(fn_ref) for _ in range(comp.size)]
+            self._pool_load = [0] * comp.size
+
+    @property
+    def done(self) -> bool:
+        return (self.upstream_done and not self.input_queue
+                and not self.in_flight and not self.outputs)
+
+    def can_launch(self) -> bool:
+        if not self.input_queue:
+            return False
+        if len(self.in_flight) >= self.ctx.max_tasks_in_flight_per_stage:
+            return False
+        if len(self.outputs) >= self.ctx.max_output_blocks_buffered:
+            return False
+        return True
+
+    def launch(self) -> None:
+        while self.can_launch():
+            block_ref, _meta = self.input_queue.popleft()
+            if self._pool is not None:
+                idx = min(range(len(self._pool)), key=lambda i: self._pool_load[i])
+                out_ref, meta_ref = self._pool[idx].apply.options(
+                    num_returns=2
+                ).remote(block_ref)
+                self._pool_load[idx] += 1
+                self.in_flight[meta_ref] = (out_ref, idx)
+            else:
+                out_ref, meta_ref = self._remote_fn.remote(
+                    self.stage.block_fn, block_ref
+                )
+                self.in_flight[meta_ref] = (out_ref, None)
+
+    def collect_ready(self, ready_meta_refs: list) -> None:
+        for meta_ref in ready_meta_refs:
+            if meta_ref not in self.in_flight:
+                continue
+            out_ref, actor_idx = self.in_flight.pop(meta_ref)
+            if actor_idx is not None:
+                self._pool_load[actor_idx] -= 1
+            meta = self.api.get(meta_ref)
+            self.outputs.append((out_ref, meta))
+
+    def shutdown(self) -> None:
+        if self._pool:
+            for a in self._pool:
+                try:
+                    self.api.kill(a)
+                except Exception:
+                    pass
+
+
+def execute_plan(stages: list[Any], api=None) -> Iterator[tuple[Any, dict]]:
+    """Run the lowered stage list; yield (block_ref, meta) of the final stage.
+
+    ``api`` is the ray_tpu module (injectable for tests).
+    """
+    if api is None:
+        import ray_tpu as api  # noqa: PLC0415
+
+    ctx = DataContext.get_current()
+
+    # Source stage → initial (ref, meta) stream.
+    source = stages[0]
+    if isinstance(source, InputData):
+        pending_source: list = []
+        initial = list(source.block_refs)  # already (ref, meta) pairs
+    elif isinstance(source, Read):
+        tasks = source.datasource.get_read_tasks(
+            source.parallelism if source.parallelism > 0
+            else ctx.default_parallelism
+        )
+        read_fn = api.remote(num_cpus=ctx.task_num_cpus, num_returns=2)(
+            _run_read_task
+        )
+        pending_source = []
+        initial = []
+        for t in tasks:
+            out_ref, meta_ref = read_fn.remote(t)
+            pending_source.append((out_ref, meta_ref))
+    else:
+        raise TypeError(f"plan must start with Read/InputData, got {source}")
+
+    rest = stages[1:]
+    yield from _execute_chain(initial, pending_source, rest, ctx, api)
+
+
+def _execute_chain(initial, pending_source, rest, ctx, api):
+    # Split the chain at barriers: run the streaming segment up to the first
+    # AllToAll, materialize, run the barrier fn, continue with the remainder.
+    for i, st in enumerate(rest):
+        if isinstance(st, AllToAll):
+            upstream = list(
+                _stream_segment(initial, pending_source, rest[:i], ctx, api)
+            )
+            shuffled = st.fn(upstream)
+            yield from _execute_chain(shuffled, [], rest[i + 1:], ctx, api)
+            return
+    yield from _stream_segment(initial, pending_source, rest, ctx, api)
+
+
+def _stream_segment(initial, pending_source, stages, ctx, api):
+    """Streaming loop over map/limit stages (no barriers inside)."""
+    limit_remaining: dict[int, int] = {}
+    execs: list[_StageExec | LimitOp] = []
+    for st in stages:
+        if isinstance(st, FusedMapStage):
+            execs.append(_StageExec(st, ctx, api))
+        elif isinstance(st, LimitOp):
+            limit_remaining[id(st)] = st.limit
+            execs.append(st)
+        else:
+            raise TypeError(f"unexpected stage {st}")
+
+    map_execs = [e for e in execs if isinstance(e, _StageExec)]
+    final_out: collections.deque = collections.deque()
+
+    # feed initial materialized refs
+    upstream_out = collections.deque(initial)
+    source_pending = dict(
+        (meta_ref, out_ref) for out_ref, meta_ref in pending_source
+    )
+    source_done = not source_pending
+
+    slice_fn = api.remote(num_cpus=0, num_returns=2)(_slice_block)
+
+    def route(queue_in: collections.deque, start_idx: int) -> None:
+        """Push (ref, meta) pairs through limit stages until the next map
+        stage (or the final output)."""
+        items = list(queue_in)
+        queue_in.clear()
+        for ref, meta in items:
+            idx = start_idx
+            emitted = True
+            cur = (ref, meta)
+            while idx < len(execs):
+                st = execs[idx]
+                if isinstance(st, LimitOp):
+                    rem = limit_remaining[id(st)]
+                    if rem <= 0:
+                        emitted = False
+                        break
+                    nrows = cur[1].get("num_rows", -1)
+                    if nrows < 0:
+                        nrows = api.get(
+                            api.remote(num_cpus=0)(
+                                lambda b: BlockAccessor(b).num_rows()
+                            ).remote(cur[0])
+                        )
+                    if nrows > rem:
+                        sliced_ref, meta_ref = slice_fn.remote(cur[0], 0, rem)
+                        cur = (sliced_ref, api.get(meta_ref))
+                        nrows = rem
+                    limit_remaining[id(st)] -= nrows
+                    idx += 1
+                else:
+                    st.input_queue.append(cur)
+                    emitted = False
+                    break
+            if emitted:
+                final_out.append(cur)
+
+    try:
+        while True:
+            # 1. route source outputs into the chain
+            if upstream_out:
+                route(upstream_out, 0)
+            # 2. move each map stage's outputs downstream
+            for i, st in enumerate(execs):
+                if isinstance(st, _StageExec) and st.outputs:
+                    route(st.outputs, i + 1)
+            # 3. launch work
+            for st in map_execs:
+                st.launch()
+            # 4. drain final outputs to consumer
+            while final_out:
+                yield final_out.popleft()
+            # 5. check termination / limits satisfied
+            all_limits_hit = limit_remaining and all(
+                v <= 0 for v in limit_remaining.values()
+            )
+            upstream_done = source_done
+            for st in execs:
+                if isinstance(st, _StageExec):
+                    st.upstream_done = upstream_done
+                    upstream_done = st.done or (
+                        upstream_done and not st.input_queue and not st.in_flight
+                        and not st.outputs
+                    )
+            if all_limits_hit:
+                break
+            if source_done and all(
+                e.done for e in map_execs
+            ) and not upstream_out and not final_out:
+                break
+            # 6. wait for something to finish
+            wait_refs = list(source_pending.keys())
+            for st in map_execs:
+                wait_refs.extend(st.in_flight.keys())
+            if not wait_refs:
+                continue
+            ready, _ = api.wait(
+                wait_refs, num_returns=1, timeout=0.1, fetch_local=True
+            )
+            for meta_ref in ready:
+                if meta_ref in source_pending:
+                    out_ref = source_pending.pop(meta_ref)
+                    meta = api.get(meta_ref)
+                    upstream_out.append((out_ref, meta))
+                    if not source_pending:
+                        source_done = True
+                else:
+                    for st in map_execs:
+                        st.collect_ready([meta_ref])
+        while final_out:
+            yield final_out.popleft()
+    finally:
+        for st in map_execs:
+            st.shutdown()

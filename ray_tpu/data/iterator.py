@@ -1,0 +1,165 @@
+"""Batch iteration + streaming_split (reference capability:
+python/ray/data/_internal/iterator/stream_split_iterator.py:30 — a shared
+coordinator actor runs the streaming executor once; n consumers pull their
+round-robined shards; ray_tpu.train workers use this for per-host ingest).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Iterator
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
+
+
+def batches_from_refs(
+    refs_iter: Iterator[tuple[Any, dict]],
+    api,
+    *,
+    batch_size: int | None,
+    batch_format: str = "numpy",
+    drop_last: bool = False,
+    shuffle_buffer_size: int | None = None,
+    shuffle_seed: int | None = None,
+) -> Iterator[Any]:
+    """Re-batch a stream of block refs into fixed-size batches."""
+    carry: list[Block] = []
+    carry_rows = 0
+    rng = np.random.default_rng(shuffle_seed)
+
+    def emit(block: Block):
+        if shuffle_buffer_size and BlockAccessor(block).num_rows() > 1:
+            order = rng.permutation(BlockAccessor(block).num_rows())
+            block = BlockAccessor(block).take_rows(order)
+        return BlockAccessor(block).to_batch(batch_format)
+
+    for ref, _meta in refs_iter:
+        block = api.get(ref)
+        n = BlockAccessor(block).num_rows()
+        if n == 0:
+            continue
+        if batch_size is None:
+            yield emit(block)
+            continue
+        carry.append(block)
+        carry_rows += n
+        while carry_rows >= batch_size:
+            merged = concat_blocks(carry)
+            acc = BlockAccessor(merged)
+            yield emit(acc.slice(0, batch_size))
+            rest = acc.slice(batch_size, acc.num_rows())
+            carry = [rest] if BlockAccessor(rest).num_rows() else []
+            carry_rows = BlockAccessor(rest).num_rows() if carry else 0
+    if carry_rows and batch_size is not None and not drop_last:
+        yield emit(concat_blocks(carry))
+
+
+class SplitCoordinator:
+    """Actor: runs the dataset's executor once, round-robins output blocks
+    into n bounded per-split queues. Consumers poll get_next(i)."""
+
+    MAX_QUEUED_PER_SPLIT = 8
+
+    def __init__(self, dataset, n: int, equal: bool):
+        self._n = n
+        self._equal = equal
+        self._queues = [collections.deque() for _ in range(n)]
+        self._lock = threading.Lock()
+        self._done = False
+        self._error: str | None = None
+        self._epoch_datasets = dataset
+        self._thread = threading.Thread(
+            target=self._run, args=(dataset,), daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, dataset) -> None:
+        try:
+            i = 0
+            for ref, meta in dataset.iter_block_refs():
+                # backpressure: wait while the target queue is full
+                while True:
+                    with self._lock:
+                        if len(self._queues[i % self._n]) < self.MAX_QUEUED_PER_SPLIT:
+                            self._queues[i % self._n].append((ref, meta))
+                            break
+                    time.sleep(0.01)
+                i += 1
+        except Exception as e:  # surfaced to all consumers
+            self._error = f"{type(e).__name__}: {e}"
+        finally:
+            self._done = True
+
+    def get_next(self, split: int):
+        """(status, payload): status in {"block", "empty", "done", "error"}."""
+        if self._error:
+            return ("error", self._error)
+        with self._lock:
+            if self._queues[split]:
+                ref, meta = self._queues[split].popleft()
+                return ("block", ref)
+        if self._done:
+            with self._lock:
+                if self._queues[split]:
+                    ref, meta = self._queues[split].popleft()
+                    return ("block", ref)
+            return ("done", None)
+        return ("empty", None)
+
+    def ping(self) -> bool:
+        return True
+
+
+class DataIterator:
+    """Per-consumer handle over a SplitCoordinator split (reference
+    capability: ray.data.DataIterator)."""
+
+    def __init__(self, coordinator, split: int):
+        self._coord = coordinator
+        self._split = split
+
+    def iter_block_refs(self) -> Iterator[tuple[Any, dict]]:
+        import ray_tpu
+
+        while True:
+            status, payload = ray_tpu.get(
+                self._coord.get_next.remote(self._split)
+            )
+            if status == "block":
+                yield payload, {}
+            elif status == "done":
+                return
+            elif status == "error":
+                raise RuntimeError(f"streaming_split producer failed: {payload}")
+            else:
+                time.sleep(0.01)
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        import ray_tpu
+
+        yield from batches_from_refs(
+            self.iter_block_refs(), ray_tpu,
+            batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last,
+        )
+
+    def iter_rows(self) -> Iterator[dict]:
+        import ray_tpu
+
+        for ref, _ in self.iter_block_refs():
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+
+
+def make_streaming_split(dataset, n: int, *, equal: bool = False):
+    import ray_tpu
+
+    coord_cls = ray_tpu.remote(num_cpus=0)(SplitCoordinator)
+    coord = coord_cls.remote(dataset, n, equal)
+    ray_tpu.get(coord.ping.remote())  # ensure started
+    return [DataIterator(coord, i) for i in range(n)]

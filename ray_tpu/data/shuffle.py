@@ -1,0 +1,410 @@
+"""Distributed shuffle ops: sort / random_shuffle / repartition / groupby.
+
+Reference capability: python/ray/data/_internal/execution/operators/
+hash_shuffle.py + sort.py — two-round map/reduce over blocks-as-refs:
+map tasks partition each block (num_returns=P), reduce tasks combine the
+pieces of one partition. All data movement stays in the object store.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
+from ray_tpu.data.context import DataContext
+
+
+def _meta(block: Block) -> dict:
+    return {"num_rows": BlockAccessor(block).num_rows()}
+
+
+# -- map-side partitioners (run as remote tasks, num_returns=P) -------------
+
+
+def _partition_by_boundaries(block: Block, key: str, boundaries: np.ndarray,
+                             descending: bool):
+    col = block.get(key)
+    if col is None or len(col) == 0:
+        return tuple({} for _ in range(len(boundaries) + 1))
+    idx = np.searchsorted(boundaries, col, side="right")
+    acc = BlockAccessor(block)
+    parts = []
+    for p in range(len(boundaries) + 1):
+        parts.append(acc.take_rows(np.nonzero(idx == p)[0]))
+    if descending:
+        parts = parts[::-1]
+    return tuple(parts)
+
+
+def _partition_random(block: Block, num_parts: int, seed: int):
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, num_parts, size=n)
+    return tuple(acc.take_rows(np.nonzero(assign == p)[0])
+                 for p in range(num_parts))
+
+
+def _partition_by_hash(block: Block, key: str, num_parts: int):
+    col = block.get(key)
+    acc = BlockAccessor(block)
+    if col is None or len(col) == 0:
+        return tuple({} for _ in range(num_parts))
+    if col.dtype.kind == "O":
+        hashes = np.fromiter((hash(v) for v in col), dtype=np.int64,
+                             count=len(col))
+    else:
+        # stable integer mix of the raw bytes per value
+        hashes = np.fromiter(
+            (hash(v.tobytes()) for v in col), dtype=np.int64, count=len(col)
+        )
+    assign = hashes % num_parts
+    return tuple(acc.take_rows(np.nonzero(assign == p)[0])
+                 for p in range(num_parts))
+
+
+# -- reduce-side -------------------------------------------------------------
+
+
+def _merge_sorted(key: str, descending: bool, *parts: Block):
+    merged = concat_blocks(list(parts))
+    if not merged:
+        return merged, _meta(merged)
+    order = np.argsort(merged[key], kind="stable")
+    if descending:
+        order = order[::-1]
+    out = BlockAccessor(merged).take_rows(order)
+    return out, _meta(out)
+
+
+def _merge_plain(seed: int, *parts: Block):
+    merged = concat_blocks(list(parts))
+    if merged and seed >= 0:
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(BlockAccessor(merged).num_rows())
+        merged = BlockAccessor(merged).take_rows(order)
+    return merged, _meta(merged)
+
+
+def _merge_aggregate(key: str, aggs: list, *parts: Block):
+    merged = concat_blocks(list(parts))
+    out = aggregate_block(merged, key, aggs)
+    return out, _meta(out)
+
+
+def _sample_boundaries(block: Block, key: str, num_samples: int):
+    col = block.get(key)
+    if col is None or len(col) == 0:
+        return np.array([])
+    idx = np.random.default_rng(len(col)).integers(
+        0, len(col), size=min(num_samples, len(col))
+    )
+    return np.asarray(col[idx])
+
+
+# -- aggregation kernel ------------------------------------------------------
+
+
+class AggregateFn:
+    """(name, init, accumulate(np column)->partial, merge, finalize)."""
+
+    def __init__(self, out_name: str, column: str | None, np_fn: Callable,
+                 finalize: Callable | None = None):
+        self.out_name = out_name
+        self.column = column
+        self.np_fn = np_fn
+        self.finalize = finalize
+
+
+def Count() -> AggregateFn:
+    return AggregateFn("count()", None, lambda c: len(c))
+
+
+def Sum(col: str) -> AggregateFn:
+    return AggregateFn(f"sum({col})", col, np.sum)
+
+
+def Min(col: str) -> AggregateFn:
+    return AggregateFn(f"min({col})", col, np.min)
+
+
+def Max(col: str) -> AggregateFn:
+    return AggregateFn(f"max({col})", col, np.max)
+
+
+def Mean(col: str) -> AggregateFn:
+    return AggregateFn(f"mean({col})", col, np.mean)
+
+
+def Std(col: str) -> AggregateFn:
+    return AggregateFn(f"std({col})", col, lambda c: np.std(c, ddof=1))
+
+
+def aggregate_block(block: Block, key: str | None, aggs: list[AggregateFn]) -> Block:
+    """Group `block` by `key` (None = global) and apply aggs per group."""
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        cols = ([] if key is None else [key]) + [a.out_name for a in aggs]
+        return {c: np.array([]) for c in cols}
+    if key is None:
+        out: Block = {}
+        for a in aggs:
+            col = block[a.column] if a.column else next(iter(block.values()))
+            out[a.out_name] = np.asarray([a.np_fn(col)])
+        return out
+    keys = block[key]
+    if keys.dtype.kind == "O":
+        uniq, inverse = np.unique(np.asarray([str(k) for k in keys]),
+                                  return_inverse=True)
+        uniq_vals = []
+        seen = {}
+        for i, k in enumerate(keys):
+            s = str(k)
+            if s not in seen:
+                seen[s] = k
+        uniq_vals = np.asarray([seen[u] for u in uniq], dtype=object)
+    else:
+        uniq_vals, inverse = np.unique(keys, return_inverse=True)
+    out = {key: uniq_vals}
+    for a in aggs:
+        col = block[a.column] if a.column else keys
+        vals = []
+        for g in range(len(uniq_vals)):
+            vals.append(a.np_fn(col[inverse == g]))
+        out[a.out_name] = np.asarray(vals)
+    return out
+
+
+# -- AllToAll builders (driver-side; each returns fn(list[(ref,meta)])) ------
+
+
+def _two_round(api, refs_meta, partition_fn, partition_args,
+               reduce_fn, reduce_args, num_parts: int):
+    ctx = DataContext.get_current()
+    part_remote = api.remote(num_cpus=ctx.task_num_cpus,
+                             num_returns=num_parts)(partition_fn)
+    red_remote = api.remote(num_cpus=ctx.task_num_cpus,
+                            num_returns=2)(reduce_fn)
+    part_refs = []  # per input block: list of P refs
+    for ref, _m in refs_meta:
+        out = part_remote.remote(ref, *partition_args)
+        if num_parts == 1:
+            out = [out]
+        part_refs.append(out)
+    results = []
+    for p in range(num_parts):
+        pieces = [pr[p] for pr in part_refs]
+        out_ref, meta_ref = red_remote.remote(*reduce_args, *pieces)
+        results.append((out_ref, meta_ref))
+    return [(ref, api.get(meta_ref)) for ref, meta_ref in results]
+
+
+def make_sort_fn(key: str, descending: bool, api):
+    def run(refs_meta):
+        if not refs_meta:
+            return []
+        ctx = DataContext.get_current()
+        num_parts = min(ctx.default_shuffle_partitions, len(refs_meta))
+        sample = api.remote(num_cpus=0)(_sample_boundaries)
+        samples = api.get(
+            [sample.remote(ref, key, 20) for ref, _ in refs_meta]
+        )
+        allv = np.concatenate([s for s in samples if len(s)]) if any(
+            len(s) for s in samples
+        ) else np.array([])
+        if len(allv) == 0:
+            num_parts = 1
+            boundaries = np.array([])
+        else:
+            qs = np.linspace(0, 1, num_parts + 1)[1:-1]
+            boundaries = np.unique(np.quantile(allv, qs))
+            num_parts = len(boundaries) + 1
+        return _two_round(
+            api, refs_meta,
+            _partition_by_boundaries, (key, boundaries, descending),
+            _merge_sorted, (key, descending), num_parts,
+        )
+
+    return run
+
+
+def make_random_shuffle_fn(seed: int | None, api):
+    def run(refs_meta):
+        if not refs_meta:
+            return []
+        ctx = DataContext.get_current()
+        num_parts = min(ctx.default_shuffle_partitions, len(refs_meta))
+        base = seed if seed is not None else 0xC0FFEE
+        out = []
+        part_remote = api.remote(num_cpus=ctx.task_num_cpus,
+                                 num_returns=num_parts)(_partition_random)
+        red_remote = api.remote(num_cpus=ctx.task_num_cpus,
+                                num_returns=2)(_merge_plain)
+        part_refs = []
+        for i, (ref, _m) in enumerate(refs_meta):
+            o = part_remote.remote(ref, num_parts, base + i)
+            part_refs.append([o] if num_parts == 1 else o)
+        for p in range(num_parts):
+            pieces = [pr[p] for pr in part_refs]
+            out_ref, meta_ref = red_remote.remote(base + 7919 * (p + 1), *pieces)
+            out.append((out_ref, api.get(meta_ref)))
+        return out
+
+    return run
+
+
+def make_repartition_fn(num_blocks: int, api):
+    def run(refs_meta):
+        ctx = DataContext.get_current()
+        counts = []
+        for ref, m in refs_meta:
+            n = m.get("num_rows", -1)
+            if n < 0:
+                n = api.get(api.remote(num_cpus=0)(
+                    lambda b: BlockAccessor(b).num_rows()).remote(ref))
+            counts.append(n)
+        total = sum(counts)
+        sizes = [total // num_blocks + (1 if i < total % num_blocks else 0)
+                 for i in range(num_blocks)]
+
+        def slice_task(block, start, end):
+            out = BlockAccessor(block).slice(start, end)
+            return out
+
+        slice_remote = api.remote(num_cpus=0)(slice_task)
+        red_remote = api.remote(num_cpus=ctx.task_num_cpus, num_returns=2)(
+            _merge_plain
+        )
+        # global row cursor → (block index, offset)
+        pieces_per_out: list[list] = [[] for _ in range(num_blocks)]
+        cursor = 0
+        out_idx = 0
+        filled = 0
+        for (ref, _m), n in zip(refs_meta, counts):
+            off = 0
+            while off < n and out_idx < num_blocks:
+                need = sizes[out_idx] - filled
+                take = min(need, n - off)
+                if take > 0:
+                    pieces_per_out[out_idx].append(
+                        slice_remote.remote(ref, off, off + take)
+                    )
+                off += take
+                filled += take
+                if filled == sizes[out_idx]:
+                    out_idx += 1
+                    filled = 0
+            cursor += n
+        out = []
+        for p in range(num_blocks):
+            out_ref, meta_ref = red_remote.remote(-1, *pieces_per_out[p])
+            out.append((out_ref, api.get(meta_ref)))
+        return out
+
+    return run
+
+
+def make_groupby_fn(key: str, aggs: list[AggregateFn], api):
+    def run(refs_meta):
+        if not refs_meta:
+            return []
+        ctx = DataContext.get_current()
+        num_parts = min(ctx.default_shuffle_partitions, len(refs_meta))
+        return _two_round(
+            api, refs_meta,
+            _partition_by_hash, (key, num_parts),
+            _merge_aggregate, (key, aggs), num_parts,
+        )
+
+    return run
+
+
+def make_groupby_shuffle_only_fn(key: str, api):
+    """Hash-partition by key without aggregating (for map_groups): rows of
+    one key land in exactly one output partition."""
+
+    def run(refs_meta):
+        if not refs_meta:
+            return []
+        ctx = DataContext.get_current()
+        num_parts = min(ctx.default_shuffle_partitions, len(refs_meta))
+        return _two_round(
+            api, refs_meta,
+            _partition_by_hash, (key, num_parts),
+            _merge_plain, (-1,), num_parts,
+        )
+
+    return run
+
+
+def make_global_aggregate_fn(aggs: list[AggregateFn], api):
+    """Global (no-key) aggregate via exact sufficient statistics: per-block
+    partials carry (count, sum, sumsq, min, max) per column; one combine task
+    finalizes every agg from those."""
+
+    def run(refs_meta):
+        ctx = DataContext.get_current()
+        columns = sorted({a.column for a in aggs if a.column})
+
+        def partial(block):
+            stats = {"__n": float(BlockAccessor(block).num_rows())}
+            for c in columns:
+                col = block.get(c)
+                if col is None or len(col) == 0:
+                    continue
+                stats[c] = (float(len(col)), float(np.sum(col)),
+                            float(np.sum(np.square(col.astype(np.float64)))),
+                            float(np.min(col)), float(np.max(col)))
+            return stats
+
+        part_remote = api.remote(num_cpus=ctx.task_num_cpus)(partial)
+        partials = [part_remote.remote(ref) for ref, _ in refs_meta]
+
+        def combine(*parts):
+            total_rows = sum(p["__n"] for p in parts)
+            per_col = {}
+            for c in columns:
+                ss = [p[c] for p in parts if c in p]
+                if not ss:
+                    per_col[c] = None
+                    continue
+                n = sum(s[0] for s in ss)
+                sm = sum(s[1] for s in ss)
+                sq = sum(s[2] for s in ss)
+                per_col[c] = (n, sm, sq, min(s[3] for s in ss),
+                              max(s[4] for s in ss))
+            out: Block = {}
+            for a in aggs:
+                if a.column is None:
+                    out[a.out_name] = np.asarray([total_rows])
+                    continue
+                s = per_col.get(a.column)
+                if s is None:
+                    out[a.out_name] = np.asarray([np.nan])
+                    continue
+                n, sm, sq, mn, mx = s
+                if a.out_name.startswith("sum("):
+                    v = sm
+                elif a.out_name.startswith("min("):
+                    v = mn
+                elif a.out_name.startswith("max("):
+                    v = mx
+                elif a.out_name.startswith("mean("):
+                    v = sm / n
+                elif a.out_name.startswith("std("):
+                    v = float(np.sqrt(max(0.0, (sq - sm * sm / n) / (n - 1)))) \
+                        if n > 1 else 0.0
+                else:
+                    v = n
+                out[a.out_name] = np.asarray([v])
+            return out, _meta(out)
+
+        comb_remote = api.remote(num_cpus=ctx.task_num_cpus, num_returns=2)(
+            combine
+        )
+        out_ref, meta_ref = comb_remote.remote(*partials)
+        return [(out_ref, api.get(meta_ref))]
+
+    return run

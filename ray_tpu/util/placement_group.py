@@ -92,6 +92,16 @@ def rewrite_resources_for_pg(resources: dict[str, float],
         if idx >= len(pg.bundles):
             raise PlacementGroupSchedulingError(
                 f"bundle index {idx} out of range ({len(pg.bundles)} bundles)")
-        return {pg.bundle_resource_name(k, idx): v
-                for k, v in resources.items()}
+        bundle = pg.bundles[idx]
+        for k, v in resources.items():
+            if v > bundle.get(k, 0.0):
+                raise PlacementGroupSchedulingError(
+                    f"demand {{{k}: {v}}} exceeds bundle {idx} ({bundle}); "
+                    "the task would never be schedulable")
+        out = {pg.bundle_resource_name(k, idx): v
+               for k, v in resources.items()}
+        # Marker pins even zero-resource tasks to the bundle's node
+        # (reference: bundle_group_* 0.001-resource trick).
+        out[f"bundle_pg_{pg.id.hex()[:16]}_{idx}"] = 0.001
+        return out
     return resources
