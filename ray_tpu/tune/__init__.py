@@ -1,0 +1,38 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Capability parity with the reference's ray.tune (reference:
+python/ray/tune/ — Tuner tuner.py:43, TuneController
+execution/tune_controller.py:67, searchers search/, schedulers schedulers/,
+Trainable trainable/).
+"""
+
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import Trainable, get_checkpoint, report
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, TuneResult
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TuneResult", "Trial",
+    "Trainable", "report", "get_checkpoint",
+    "grid_search", "uniform", "loguniform", "quniform", "randint", "choice",
+    "sample_from", "Searcher", "BasicVariantGenerator",
+    "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+]

@@ -1,0 +1,208 @@
+"""Search spaces and search algorithms.
+
+Capability parity with the reference's search layer (reference:
+python/ray/tune/search/ — sample.py domains, basic_variant.py
+BasicVariantGenerator for grid/random, searcher ABC search/searcher.py).
+Deterministic given a seed; grid axes expand to their cross product, random
+domains are sampled per trial.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from itertools import product
+from typing import Any, Callable
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng: random.Random) -> float:
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng: random.Random) -> float:
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class QUniform(Domain):
+    low: float
+    high: float
+    q: float
+
+    def sample(self, rng: random.Random) -> float:
+        v = rng.uniform(self.low, self.high)
+        return round(v / self.q) * self.q
+
+
+@dataclass
+class RandInt(Domain):
+    low: int
+    high: int  # exclusive, matching the reference
+
+    def sample(self, rng: random.Random) -> int:
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    values: list
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.values)
+
+
+@dataclass
+class SampleFrom(Domain):
+    fn: Callable[[dict], Any]
+
+    def sample(self, rng: random.Random) -> Any:
+        # Config-dependent sampling is resolved by the variant generator,
+        # which passes the partially-resolved spec.
+        raise RuntimeError("SampleFrom must be resolved against a spec")
+
+
+@dataclass
+class GridSearch:
+    values: list
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(values: list) -> Choice:
+    return Choice(list(values))
+
+
+def sample_from(fn: Callable[[dict], Any]) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def grid_search(values: list) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def _walk(space: dict, path: tuple = ()):
+    """Yield (path, leaf) for every leaf of a nested dict search space."""
+    for k, v in space.items():
+        p = path + (k,)
+        if isinstance(v, dict):
+            yield from _walk(v, p)
+        else:
+            yield p, v
+
+
+def _set_path(cfg: dict, path: tuple, value: Any) -> None:
+    d = cfg
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def _deepcopy_plain(space: dict) -> dict:
+    out = {}
+    for k, v in space.items():
+        out[k] = _deepcopy_plain(v) if isinstance(v, dict) else v
+    return out
+
+
+class Searcher:
+    """Search-algorithm ABC (reference: tune/search/searcher.py Searcher).
+
+    ``suggest`` returns a config for a new trial id (or None when exhausted);
+    results flow back via ``on_trial_result``/``on_trial_complete``.
+    """
+
+    def set_search_properties(self, metric: str | None, mode: str | None,
+                              space: dict | None) -> None:
+        self.metric, self.mode, self.space = metric, mode, space
+
+    def suggest(self, trial_id: str) -> dict | None:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product × num_samples random draws (reference:
+    tune/search/basic_variant.py). With no grid axes, emits num_samples
+    sampled configs; with grid axes, each grid variant is repeated
+    num_samples times (random leaves resampled per repeat)."""
+
+    def __init__(self, seed: int | None = None):
+        self._rng = random.Random(seed)
+        self._variants: list[dict] | None = None
+        self._next = 0
+
+    def set_search_properties(self, metric, mode, space) -> None:
+        super().set_search_properties(metric, mode, space)
+
+    def _materialize(self, num_samples: int) -> None:
+        space = self.space or {}
+        grid_axes = [(p, v.values) for p, v in _walk(space)
+                     if isinstance(v, GridSearch)]
+        grids = list(product(*[vals for _, vals in grid_axes])) or [()]
+        self._variants = []
+        for _ in range(num_samples):
+            for combo in grids:
+                cfg = _deepcopy_plain(space)
+                for (p, _), val in zip(grid_axes, combo):
+                    _set_path(cfg, p, val)
+                # Two passes so sample_from can see sampled siblings.
+                deferred = []
+                for p, v in list(_walk(cfg)):
+                    if isinstance(v, Domain):
+                        if isinstance(v, SampleFrom):
+                            deferred.append((p, v))
+                        else:
+                            _set_path(cfg, p, v.sample(self._rng))
+                for p, v in deferred:
+                    _set_path(cfg, p, v.fn(cfg))
+                self._variants.append(cfg)
+
+    def total_variants(self, num_samples: int) -> int:
+        if self._variants is None:
+            self._materialize(num_samples)
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._variants is None or self._next >= len(self._variants):
+            return None
+        cfg = self._variants[self._next]
+        self._next += 1
+        return cfg
