@@ -1,0 +1,34 @@
+"""ray_tpu.serve: online serving over replica actors.
+
+Capability parity with the reference's ray.serve (reference:
+python/ray/serve/ — controller _private/controller.py:121, deployment state
+FSM _private/deployment_state.py:2278, pow-2 router
+_private/request_router/pow_2_router.py:27, replica _private/replica.py:1812,
+long-poll _private/long_poll.py, batching batching.py, HTTP proxy
+_private/proxy.py:1605).
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    http_port,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.http_proxy import Request, Response
+
+__all__ = [
+    "deployment", "Deployment", "Application",
+    "run", "start", "shutdown", "status", "delete",
+    "get_app_handle", "get_deployment_handle", "http_port",
+    "DeploymentHandle", "DeploymentResponse",
+    "AutoscalingConfig", "DeploymentConfig",
+    "batch", "Request", "Response",
+]

@@ -1,0 +1,150 @@
+"""serve.run / serve.start / serve.status / serve.shutdown.
+
+Capability parity with the reference's serve API (reference:
+python/ray/serve/api.py — run :729 deploys an application graph and returns
+the ingress handle; _private/api.py serve_start creates the controller +
+proxies).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import ray_tpu
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import CONTROLLER_NAME, SERVE_NAMESPACE, DeploymentHandle
+from ray_tpu.serve.http_proxy import ProxyActor
+from ray_tpu.utils import serialization
+
+_PROXY_NAME = "SERVE_PROXY"
+
+
+def start(http_options: dict | None = None, detached: bool = True):
+    """Idempotently create the controller (and HTTP proxy if requested)."""
+    ray_tpu.init()
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+    except ValueError:
+        pass
+    Controller = ray_tpu.remote(ServeController)
+    controller = Controller.options(
+        name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE, num_cpus=0,
+        max_concurrency=32, lifetime="detached",
+    ).remote()
+    if http_options is not None:
+        Proxy = ray_tpu.remote(ProxyActor)
+        proxy = Proxy.options(
+            name=_PROXY_NAME, namespace=SERVE_NAMESPACE, num_cpus=0,
+            max_concurrency=32, lifetime="detached",
+        ).remote(http_options.get("host", "127.0.0.1"),
+                 http_options.get("port", 0))
+        ray_tpu.get(proxy.ready.remote())
+    return controller
+
+
+def _controller():
+    return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+
+def run(target: Application, *, name: str = "default",
+        route_prefix: str | None = "/", http: bool = False,
+        http_port: int = 0, _blocking_timeout: float = 60.0) -> DeploymentHandle:
+    """Deploy an application graph; block until healthy; return the ingress
+    deployment's handle."""
+    controller = start(http_options={"port": http_port} if http else None)
+
+    # Flatten the graph: depth-first over bound args, children first.
+    seen: dict[int, str] = {}
+    deployments: list[dict] = []
+
+    def build(app: Application) -> str:
+        if id(app) in seen:
+            return seen[id(app)]
+        dep: Deployment = app.deployment
+        args = tuple(DeploymentHandle(build(a)) if isinstance(a, Application)
+                     else a for a in app.args)
+        kwargs = {k: (DeploymentHandle(build(v)) if isinstance(v, Application)
+                      else v) for k, v in app.kwargs.items()}
+        deployments.append({
+            "name": dep.name,
+            "cls_blob": serialization.serialize(dep.func_or_class),
+            "init_args_blob": serialization.serialize((args, kwargs)),
+            "config": dep.config,
+        })
+        seen[id(app)] = dep.name
+        return dep.name
+
+    ingress = build(target)
+    ray_tpu.get(controller.deploy_application.remote(
+        name, deployments, ingress, route_prefix))
+
+    # Block until every deployment reports HEALTHY (reference: run waits for
+    # the application to be RUNNING).
+    deadline = time.monotonic() + _blocking_timeout
+    while time.monotonic() < deadline:
+        statuses = ray_tpu.get(controller.status.remote())
+        mine = [statuses[d["name"]] for d in deployments
+                if d["name"] in statuses]
+        if mine and all(s.status == "HEALTHY" for s in mine):
+            break
+        time.sleep(0.05)
+    else:
+        bad = {s.name: (s.status, s.message)
+               for s in ray_tpu.get(controller.status.remote()).values()
+               if s.status != "HEALTHY"}
+        raise TimeoutError(f"application {name!r} not healthy: {bad}")
+
+    if http:
+        proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
+        ray_tpu.get(proxy.update_routes.remote(
+            ray_tpu.get(controller.get_routes.remote())))
+
+    return DeploymentHandle(ingress, app_name=name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    routes = ray_tpu.get(_controller().get_routes.remote())
+    for _, dep in routes.items():
+        return DeploymentHandle(dep, app_name=name)
+    raise ValueError(f"no application {name!r}")
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name=app_name)
+
+
+def status() -> dict[str, Any]:
+    return ray_tpu.get(_controller().status.remote())
+
+
+def delete(name: str = "default") -> None:
+    ray_tpu.get(_controller().delete_application.remote(name))
+
+
+def http_port() -> int:
+    proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
+    return ray_tpu.get(proxy.port.remote())
+
+
+def shutdown() -> None:
+    try:
+        controller = _controller()
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=15)
+    except Exception:
+        pass
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
+        proxy.shutdown.remote()
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass

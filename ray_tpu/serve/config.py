@@ -1,0 +1,56 @@
+"""Serve configuration types.
+
+Capability parity with the reference's serve config surface (reference:
+python/ray/serve/config.py — AutoscalingConfig, DeploymentConfig shapes in
+serve/schema.py / _private/config.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    metrics_interval_s: float = 0.2
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    autoscaling_config: AutoscalingConfig | None = None
+    user_config: Any = None
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 5.0
+    max_consecutive_health_failures: int = 3
+    graceful_shutdown_timeout_s: float = 5.0
+    version: str | None = None
+
+    # resources per replica
+    ray_actor_options: dict = field(default_factory=dict)
+
+
+@dataclass
+class ReplicaInfo:
+    """What routers need to know about one live replica (published via
+    long-poll, reference: _private/common.py RunningReplicaInfo)."""
+
+    replica_id: str
+    deployment_name: str
+    actor_name: str
+    max_ongoing_requests: int
+
+
+@dataclass
+class DeploymentStatus:
+    name: str
+    status: str  # UPDATING | HEALTHY | UNHEALTHY
+    replica_states: dict[str, int] = field(default_factory=dict)
+    message: str = ""

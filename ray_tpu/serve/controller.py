@@ -1,0 +1,359 @@
+"""ServeController: the serve control plane.
+
+Capability parity with the reference's controller (reference:
+python/ray/serve/_private/controller.py:121 ServeController — singleton
+actor owning desired state; _private/deployment_state.py:2278
+DeploymentState replica FSM STARTING/RUNNING/STOPPING with rolling updates
+and health checks; autoscaling_state.py metrics-driven replica targets;
+config pushed to routers via the long-poll host).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import threading
+import time
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+import ray_tpu
+from ray_tpu.serve.config import DeploymentConfig, DeploymentStatus, ReplicaInfo
+from ray_tpu.serve.long_poll import LongPollHost
+from ray_tpu.serve.replica import ServeReplica
+
+STARTING, RUNNING, STOPPING = "STARTING", "RUNNING", "STOPPING"
+
+
+@dataclass
+class _Replica:
+    replica_id: str
+    actor_name: str
+    actor: Any
+    version: str
+    state: str = STARTING
+    ready_ref: Any = None
+    health_ref: Any = None
+    health_sent_at: float = 0.0
+    consecutive_failures: int = 0
+    drain_ref: Any = None
+    stop_deadline: float = 0.0
+
+
+@dataclass
+class _DeploymentState:
+    name: str
+    app_name: str
+    cls_blob: bytes
+    init_args_blob: bytes
+    config: DeploymentConfig
+    version: str
+    replicas: list[_Replica] = field(default_factory=list)
+    deleting: bool = False
+    # autoscaling bookkeeping
+    last_metric_pull: float = 0.0
+    total_ongoing: float = 0.0
+    desired_since: tuple[int, float] | None = None  # (desired, since_ts)
+    autoscale_target: int | None = None
+    message: str = ""
+
+
+class ServeController:
+    """Runs as a named detached-style actor; reconciles in a background
+    thread (reference: controller's run_control_loop)."""
+
+    def __init__(self, reconcile_interval_s: float = 0.05):
+        self._interval = reconcile_interval_s
+        self._lock = threading.RLock()
+        self._deployments: dict[str, _DeploymentState] = {}
+        self._apps: dict[str, list[str]] = {}
+        self._routes: dict[str, str] = {}  # route_prefix -> deployment name
+        self._long_poll = LongPollHost()
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(target=self._control_loop, daemon=True)
+        self._thread.start()
+
+    # ---- API (called by serve.api / handles / proxies) ----
+
+    def deploy_application(self, app_name: str, deployments: list[dict],
+                           ingress_name: str | None,
+                           route_prefix: str | None) -> None:
+        with self._lock:
+            old = set(self._apps.get(app_name, []))
+            new_names = []
+            for d in deployments:
+                name = d["name"]
+                new_names.append(name)
+                version = d["config"].version or hashlib.sha1(
+                    d["cls_blob"] + d["init_args_blob"] +
+                    repr(d["config"].user_config).encode() +
+                    repr(d["config"].num_replicas).encode()
+                ).hexdigest()[:12]
+                cur = self._deployments.get(name)
+                if cur is None:
+                    self._deployments[name] = _DeploymentState(
+                        name=name, app_name=app_name, cls_blob=d["cls_blob"],
+                        init_args_blob=d["init_args_blob"], config=d["config"],
+                        version=version)
+                else:
+                    cur.cls_blob = d["cls_blob"]
+                    cur.init_args_blob = d["init_args_blob"]
+                    cur.config = d["config"]
+                    cur.version = version
+                    cur.deleting = False
+            for stale in old - set(new_names):
+                self._deployments[stale].deleting = True
+            self._apps[app_name] = new_names
+            if ingress_name and route_prefix is not None:
+                self._routes[route_prefix] = ingress_name
+                self._long_poll.notify_changed("routes", dict(self._routes))
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            for name in self._apps.pop(app_name, []):
+                if name in self._deployments:
+                    self._deployments[name].deleting = True
+            self._routes = {r: d for r, d in self._routes.items()
+                            if d in {n for ns in self._apps.values() for n in ns}}
+            self._long_poll.notify_changed("routes", dict(self._routes))
+
+    def get_replicas(self, deployment_name: str) -> list[ReplicaInfo]:
+        with self._lock:
+            ds = self._deployments.get(deployment_name)
+            if ds is None:
+                return []
+            return self._running_infos(ds)
+
+    def listen(self, keys_to_versions: dict, timeout: float = 10.0) -> dict:
+        return self._long_poll.listen(keys_to_versions, timeout)
+
+    def get_routes(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
+
+    def status(self) -> dict[str, DeploymentStatus]:
+        with self._lock:
+            out = {}
+            for name, ds in self._deployments.items():
+                counts: dict[str, int] = {}
+                for r in ds.replicas:
+                    counts[r.state] = counts.get(r.state, 0) + 1
+                target = self._target_count(ds)
+                healthy = sum(1 for r in ds.replicas
+                              if r.state == RUNNING and r.version == ds.version)
+                status = ("HEALTHY" if healthy >= target and not ds.deleting
+                          else "UPDATING")
+                out[name] = DeploymentStatus(name=name, status=status,
+                                             replica_states=counts,
+                                             message=ds.message)
+            return out
+
+    def graceful_shutdown(self) -> None:
+        with self._lock:
+            for ds in self._deployments.values():
+                ds.deleting = True
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with self._lock:
+                if all(not ds.replicas for ds in self._deployments.values()):
+                    break
+            time.sleep(0.05)
+        self._shutdown.set()
+
+    # ---- reconcile loop ----
+
+    def _control_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:
+                traceback.print_exc()
+            time.sleep(self._interval)
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, ds in items:
+            with self._lock:
+                before = self._running_infos(ds)
+                self._check_starting(ds)
+                self._check_health(ds)
+                self._autoscale(ds)
+                target = 0 if ds.deleting else self._target_count(ds)
+                self._scale_and_roll(ds, target)
+                self._reap_stopped(ds)
+                after = self._running_infos(ds)
+                if [r.replica_id for r in before] != [r.replica_id for r in after]:
+                    self._long_poll.notify_changed(f"replicas:{name}", after)
+                if ds.deleting and not ds.replicas:
+                    del self._deployments[name]
+
+    def _target_count(self, ds: _DeploymentState) -> int:
+        asc = ds.config.autoscaling_config
+        if asc is None:
+            return ds.config.num_replicas
+        if ds.autoscale_target is None:
+            ds.autoscale_target = asc.min_replicas
+        return ds.autoscale_target
+
+    def _running_infos(self, ds: _DeploymentState) -> list[ReplicaInfo]:
+        return [ReplicaInfo(replica_id=r.replica_id,
+                            deployment_name=ds.name,
+                            actor_name=r.actor_name,
+                            max_ongoing_requests=ds.config.max_ongoing_requests)
+                for r in ds.replicas if r.state == RUNNING]
+
+    # -- replica lifecycle --
+
+    def _start_replica(self, ds: _DeploymentState) -> None:
+        rid = uuid.uuid4().hex[:8]
+        actor_name = f"SERVE_REPLICA::{ds.name}#{rid}"
+        opts = dict(ds.config.ray_actor_options)
+        Remote = ray_tpu.remote(ServeReplica)
+        actor = Remote.options(
+            name=actor_name, namespace="serve",
+            num_cpus=opts.get("num_cpus", 0),
+            num_tpus=opts.get("num_tpus", 0),
+            resources=opts.get("resources"),
+            max_concurrency=ds.config.max_ongoing_requests + 4,
+        ).remote(ds.name, rid, ds.cls_blob, ds.init_args_blob,
+                 ds.config.user_config)
+        rep = _Replica(replica_id=rid, actor_name=actor_name, actor=actor,
+                       version=ds.version)
+        rep.ready_ref = actor.get_metrics.remote()  # readiness probe
+        ds.replicas.append(rep)
+
+    def _check_starting(self, ds: _DeploymentState) -> None:
+        for r in ds.replicas:
+            if r.state != STARTING:
+                continue
+            ready, _ = ray_tpu.wait([r.ready_ref], num_returns=1, timeout=0)
+            if ready:
+                try:
+                    ray_tpu.get(r.ready_ref)
+                    r.state = RUNNING
+                    r.ready_ref = None
+                except Exception as e:
+                    ds.message = f"replica failed to start: {e!r}"
+                    self._stop_replica(ds, r, force=True)
+
+    def _check_health(self, ds: _DeploymentState) -> None:
+        now = time.monotonic()
+        for r in ds.replicas:
+            if r.state != RUNNING:
+                continue
+            if r.health_ref is None:
+                if now - r.health_sent_at >= ds.config.health_check_period_s:
+                    r.health_ref = r.actor.check_health.remote()
+                    r.health_sent_at = now
+                continue
+            ready, _ = ray_tpu.wait([r.health_ref], num_returns=1, timeout=0)
+            if ready:
+                try:
+                    ray_tpu.get(r.health_ref)
+                    r.consecutive_failures = 0
+                except Exception:
+                    r.consecutive_failures += 1
+                r.health_ref = None
+            elif now - r.health_sent_at > ds.config.health_check_timeout_s:
+                r.consecutive_failures += 1
+                r.health_ref = None
+            if r.consecutive_failures >= ds.config.max_consecutive_health_failures:
+                ds.message = f"replica {r.replica_id} failed health checks"
+                self._stop_replica(ds, r, force=True)
+
+    def _autoscale(self, ds: _DeploymentState) -> None:
+        asc = ds.config.autoscaling_config
+        if asc is None or ds.deleting:
+            return
+        now = time.monotonic()
+        if now - ds.last_metric_pull >= asc.metrics_interval_s:
+            ds.last_metric_pull = now
+            refs = [r.actor.get_metrics.remote() for r in ds.replicas
+                    if r.state == RUNNING]
+            total = 0.0
+            try:
+                for m in ray_tpu.get(refs, timeout=2.0):
+                    total += m["ongoing"]
+            except Exception:
+                return
+            ds.total_ongoing = total
+        cur = ds.autoscale_target or asc.min_replicas
+        raw = math.ceil(ds.total_ongoing / max(asc.target_ongoing_requests, 1e-9))
+        desired = max(asc.min_replicas, min(asc.max_replicas, raw))
+        if desired == cur:
+            ds.desired_since = None
+            return
+        if ds.desired_since is None or ds.desired_since[0] != desired:
+            ds.desired_since = (desired, now)
+            return
+        delay = (asc.upscale_delay_s if desired > cur
+                 else asc.downscale_delay_s)
+        if now - ds.desired_since[1] >= delay:
+            ds.autoscale_target = desired
+            ds.desired_since = None
+
+    def _scale_and_roll(self, ds: _DeploymentState, target: int) -> None:
+        live = [r for r in ds.replicas if r.state in (STARTING, RUNNING)]
+        current_version = [r for r in live if r.version == ds.version]
+        old_version = [r for r in live if r.version != ds.version]
+
+        # Scale up with current-version replicas (also drives rolling
+        # updates: new version starts first, old stops as new turn RUNNING).
+        while len(current_version) < target:
+            self._start_replica(ds)
+            current_version.append(ds.replicas[-1])
+
+        running_new = sum(1 for r in current_version if r.state == RUNNING)
+        # Retire old-version replicas as replacements come up.
+        for r in list(old_version):
+            if running_new > 0:
+                self._stop_replica(ds, r)
+                running_new -= 1
+
+        # Scale down extras (prefer STARTING ones).
+        extras = len(current_version) - target
+        if extras > 0:
+            victims = sorted(current_version,
+                             key=lambda r: 0 if r.state == STARTING else 1)
+            for r in victims[:extras]:
+                self._stop_replica(ds, r)
+
+    def _stop_replica(self, ds: _DeploymentState, r: _Replica,
+                      force: bool = False) -> None:
+        if r.state == STOPPING:
+            return
+        was_running = r.state == RUNNING
+        r.state = STOPPING
+        if force or not was_running:
+            try:
+                ray_tpu.kill(r.actor)
+            except Exception:
+                pass
+            r.stop_deadline = 0.0  # reap immediately
+        else:
+            # Drain in-flight requests, then kill once drained/timed out.
+            timeout = ds.config.graceful_shutdown_timeout_s
+            r.drain_ref = r.actor.prepare_for_shutdown.remote(timeout)
+            r.stop_deadline = time.monotonic() + timeout + 1.0
+
+    def _reap_stopped(self, ds: _DeploymentState) -> None:
+        keep = []
+        now = time.monotonic()
+        for r in ds.replicas:
+            if r.state != STOPPING:
+                keep.append(r)
+                continue
+            if r.drain_ref is not None:
+                done, _ = ray_tpu.wait([r.drain_ref], num_returns=1, timeout=0)
+                if not done and now < r.stop_deadline:
+                    keep.append(r)
+                    continue
+                try:
+                    ray_tpu.kill(r.actor)
+                except Exception:
+                    pass
+            # else: already killed; drop the record
+        ds.replicas = keep

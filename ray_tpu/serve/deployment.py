@@ -1,0 +1,93 @@
+"""@serve.deployment and application graphs.
+
+Capability parity with the reference's deployment API (reference:
+python/ray/serve/deployment.py Deployment + api.py @serve.deployment;
+``.bind(...)`` builds an application node whose Application-typed args are
+replaced by DeploymentHandles at deploy time — the model-composition DAG).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any, Callable
+
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+
+class Application:
+    """A bound deployment node (reference: serve/_private/build_app.py)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, func_or_class: Callable, name: str,
+                 config: DeploymentConfig):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, *, name: str | None = None, num_replicas: int | None = None,
+                max_ongoing_requests: int | None = None,
+                autoscaling_config: AutoscalingConfig | dict | None = None,
+                user_config: Any = None, version: str | None = None,
+                health_check_period_s: float | None = None,
+                graceful_shutdown_timeout_s: float | None = None,
+                ray_actor_options: dict | None = None) -> "Deployment":
+        cfg = replace(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if autoscaling_config is not None:
+            if isinstance(autoscaling_config, dict):
+                autoscaling_config = AutoscalingConfig(**autoscaling_config)
+            cfg.autoscaling_config = autoscaling_config
+        if user_config is not None:
+            cfg.user_config = user_config
+        if version is not None:
+            cfg.version = version
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if graceful_shutdown_timeout_s is not None:
+            cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = ray_actor_options
+        return Deployment(self.func_or_class, name or self.name, cfg)
+
+
+def deployment(_func_or_class: Callable | None = None, *,
+               name: str | None = None, num_replicas: int = 1,
+               max_ongoing_requests: int = 16,
+               autoscaling_config: AutoscalingConfig | dict | None = None,
+               user_config: Any = None, version: str | None = None,
+               health_check_period_s: float = 1.0,
+               graceful_shutdown_timeout_s: float = 5.0,
+               ray_actor_options: dict | None = None):
+    """``@serve.deployment`` (reference: serve/api.py deployment decorator)."""
+
+    def deco(func_or_class: Callable) -> Deployment:
+        if isinstance(autoscaling_config, dict):
+            asc = AutoscalingConfig(**autoscaling_config)
+        else:
+            asc = autoscaling_config
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=asc,
+            user_config=user_config,
+            version=version,
+            health_check_period_s=health_check_period_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            ray_actor_options=ray_actor_options or {},
+        )
+        return Deployment(func_or_class,
+                          name or func_or_class.__name__, cfg)
+
+    return deco(_func_or_class) if _func_or_class is not None else deco

@@ -1,0 +1,97 @@
+"""DeploymentHandle: the client-side composition/request API.
+
+Capability parity with the reference's handle (reference:
+python/ray/serve/handle.py — DeploymentHandle.remote() → DeploymentResponse;
+handles are picklable and rebuild their router lazily in the receiving
+process, so deployments compose by passing handles through init args).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import ray_tpu
+from ray_tpu.serve.long_poll import LongPollClient
+from ray_tpu.serve.router import Router
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+
+
+class DeploymentResponse:
+    """Future-like result of a handle call."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: float | None = 60.0) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._lock = threading.Lock()
+        self._router: Router | None = None
+        self._poll: LongPollClient | None = None
+
+    # -- composition --
+
+    def options(self, method_name: str | None = None) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                method_name or self._method_name)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        # handle.method.remote(...) sugar (reference handle API)
+        return DeploymentHandle(self.deployment_name, self.app_name, name)
+
+    # -- data plane --
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = self._ensure_router()
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
+                      else v) for k, v in kwargs.items()}
+        ref = router.assign_request(self._method_name, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def _ensure_router(self) -> Router:
+        with self._lock:
+            if self._router is None:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                               namespace=SERVE_NAMESPACE)
+                key = f"replicas:{self.deployment_name}"
+
+                def listen(kv: dict, timeout: float) -> dict:
+                    return ray_tpu.get(controller.listen.remote(kv, timeout),
+                                       timeout=timeout + 30)
+
+                self._poll = LongPollClient(listen, [key])
+                # Seed synchronously so the first request doesn't race the
+                # poll thread.
+                seed = ray_tpu.get(
+                    controller.get_replicas.remote(self.deployment_name))
+                self._poll._cache.setdefault(key, seed)
+
+                def get_replicas():
+                    return self._poll.get(key) or []
+
+                self._router = Router(self.deployment_name, get_replicas)
+            return self._router
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method_name))
+
+    def __repr__(self) -> str:
+        return f"DeploymentHandle({self.deployment_name!r})"

@@ -1,0 +1,145 @@
+"""HTTP ingress proxy.
+
+Capability parity with the reference's proxy (reference:
+python/ray/serve/_private/proxy.py:1605 ProxyActor — HTTP ingress routed by
+prefix to the application's ingress deployment, request forwarded through a
+handle, response streamed back). Implemented over http.server in the proxy
+actor's thread (stdlib-only; the box has no ASGI server).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+@dataclass
+class Request:
+    """What an ingress deployment's __call__ receives for an HTTP request
+    (reference: starlette Request equivalent, minimal surface)."""
+
+    method: str
+    path: str
+    query_params: dict[str, str] = field(default_factory=dict)
+    headers: dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+    def json(self):
+        return json.loads(self.body) if self.body else None
+
+    @property
+    def text(self) -> str:
+        return self.body.decode()
+
+
+class ProxyActor:
+    """Binds an HTTP server; routes longest-prefix-match to the ingress
+    deployment's handle. Runs as an actor (one per node in the reference;
+    one per cluster here until multi-node proxying lands)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        self._routes: dict[str, str] = {}
+        self._handles: dict[str, DeploymentHandle] = {}
+        self._lock = threading.Lock()
+
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self):
+                parsed = urlparse(self.path)
+                route, dep = proxy._match(parsed.path)
+                if dep is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b"no application at this route")
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                req = Request(
+                    method=self.command,
+                    path=parsed.path[len(route.rstrip("/")):] or "/",
+                    query_params={k: v[0] for k, v in
+                                  parse_qs(parsed.query).items()},
+                    headers={k: v for k, v in self.headers.items()},
+                    body=body,
+                )
+                try:
+                    result = proxy._get_handle(dep).remote(req).result(
+                        timeout=60.0)
+                except Exception as e:  # noqa: BLE001 - surface as 500
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(repr(e).encode())
+                    return
+                status, ctype, payload = _encode(result)
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _match(self, path: str):
+        with self._lock:
+            best = None
+            for route, dep in self._routes.items():
+                r = route.rstrip("/") or "/"
+                if path == r or path.startswith(r.rstrip("/") + "/") or r == "/":
+                    if best is None or len(r) > len(best[0]):
+                        best = (r, dep)
+            return best if best else ("/", None)
+
+    def _get_handle(self, deployment_name: str):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        with self._lock:
+            if deployment_name not in self._handles:
+                self._handles[deployment_name] = DeploymentHandle(deployment_name)
+            return self._handles[deployment_name]
+
+    # -- control plane --
+
+    def update_routes(self, routes: dict[str, str]) -> None:
+        with self._lock:
+            self._routes = dict(routes)
+
+    def port(self) -> int:
+        return self._port
+
+    def ready(self) -> bool:
+        return True
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+def _encode(result) -> tuple[int, str, bytes]:
+    if isinstance(result, Response):
+        return result.status_code, result.content_type, result.body
+    if isinstance(result, bytes):
+        return 200, "application/octet-stream", result
+    if isinstance(result, str):
+        return 200, "text/plain; charset=utf-8", result.encode()
+    return 200, "application/json", json.dumps(result).encode()
+
+
+@dataclass
+class Response:
+    body: bytes
+    status_code: int = 200
+    content_type: str = "application/octet-stream"

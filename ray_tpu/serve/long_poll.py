@@ -1,0 +1,95 @@
+"""Long-poll: controller → router/proxy config push.
+
+Capability parity with the reference's long-poll channel (reference:
+python/ray/serve/_private/long_poll.py — LongPollHost :254 holds versioned
+snapshots per key and parks listeners until a key changes; LongPollClient
+:77 re-issues listens and invokes callbacks on updates).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+
+class LongPollHost:
+    """Embedded in the controller actor. ``notify_changed`` bumps a key's
+    version; ``listen`` blocks until any requested key is newer than the
+    version the caller already has (or timeout → {})."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._snapshots: dict[str, tuple[int, Any]] = {}
+
+    def notify_changed(self, key: str, snapshot: Any) -> None:
+        with self._cv:
+            ver = self._snapshots.get(key, (0, None))[0] + 1
+            self._snapshots[key] = (ver, snapshot)
+            self._cv.notify_all()
+
+    def listen(self, keys_to_versions: dict[str, int],
+               timeout: float = 10.0) -> dict[str, tuple[int, Any]]:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                out = {}
+                for key, have in keys_to_versions.items():
+                    cur = self._snapshots.get(key)
+                    if cur is not None and cur[0] > have:
+                        out[key] = cur
+                if out:
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                self._cv.wait(remaining)
+
+
+class LongPollClient:
+    """Driver/replica-side cache over a controller's long-poll endpoint.
+
+    ``host_listen`` is a callable (keys_to_versions, timeout) → updates —
+    an actor-method bridge so this class stays transport-agnostic.
+    """
+
+    def __init__(self, host_listen: Callable[[dict, float], dict],
+                 keys: list[str],
+                 callback: Callable[[str, Any], None] | None = None,
+                 poll_timeout: float = 5.0):
+        self._listen = host_listen
+        self._versions = {k: 0 for k in keys}
+        self._cache: dict[str, Any] = {}
+        self._callback = callback
+        self._poll_timeout = poll_timeout
+        self._stopped = threading.Event()
+        self._have_first = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                updates = self._listen(dict(self._versions), self._poll_timeout)
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                time.sleep(0.2)
+                continue
+            for key, (ver, snap) in updates.items():
+                self._versions[key] = ver
+                self._cache[key] = snap
+                if self._callback is not None:
+                    self._callback(key, snap)
+            if updates:
+                self._have_first.set()
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._cache.get(key, default)
+
+    def wait_first(self, timeout: float = 10.0) -> bool:
+        return self._have_first.wait(timeout)
+
+    def stop(self) -> None:
+        self._stopped.set()

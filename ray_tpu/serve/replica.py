@@ -1,0 +1,87 @@
+"""Replica actor: hosts one copy of the user's callable.
+
+Capability parity with the reference's replica (reference:
+python/ray/serve/_private/replica.py:1812 Replica — runs the user callable,
+counts ongoing requests for routing/autoscaling, exposes health checks and
+reconfigure; sync methods run on the actor's thread pool).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from ray_tpu.utils import serialization
+
+
+class ServeReplica:
+    """Created by the controller with max_concurrency == max_ongoing_requests
+    so concurrent handle_request calls map to pool threads."""
+
+    def __init__(self, deployment_name: str, replica_id: str,
+                 cls_blob: bytes, init_args_blob: bytes,
+                 user_config: Any = None):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        cls = serialization.deserialize(cls_blob)
+        args, kwargs = serialization.deserialize(init_args_blob)
+        if isinstance(cls, type):
+            self._callable = cls(*args, **kwargs)
+        else:
+            self._callable = cls  # plain function deployment
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._started_at = time.time()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- data plane --
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                target = self._callable
+                if not callable(target):
+                    raise AttributeError(
+                        f"deployment {self.deployment_name} is not callable; "
+                        f"specify a method name")
+            else:
+                target = getattr(self._callable, method_name)
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # -- control plane --
+
+    def get_metrics(self) -> dict:
+        with self._lock:
+            return {"replica_id": self.replica_id, "ongoing": self._ongoing,
+                    "total": self._total}
+
+    def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if callable(user_check):
+            user_check()
+        return True
+
+    def reconfigure(self, user_config: Any) -> None:
+        user_reconf = getattr(self._callable, "reconfigure", None)
+        if callable(user_reconf):
+            user_reconf(user_config)
+
+    def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
+        """Drain: wait for ongoing requests to finish (reference: graceful
+        shutdown loop in replica.py)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    return True
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,81 @@
+"""Router: assigns requests to replicas (power-of-two-choices).
+
+Capability parity with the reference's router (reference:
+python/ray/serve/_private/router.py:510 Router.assign_request :1028 →
+request_router/pow_2_router.py:27 PowerOfTwoChoicesRequestRouter
+.choose_replicas :52 — sample two replicas, pick the one with the smaller
+queue; requests queue router-side when all replicas are saturated).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable
+
+import ray_tpu
+from ray_tpu.serve.config import ReplicaInfo
+
+
+class Router:
+    def __init__(self, deployment_name: str,
+                 get_replicas: Callable[[], list[ReplicaInfo]]):
+        self._deployment = deployment_name
+        self._get_replicas = get_replicas
+        self._inflight: dict[str, int] = {}  # replica_id -> local in-flight
+        self._lock = threading.Lock()
+        self._not_saturated = threading.Condition(self._lock)
+        self._rng = random.Random()
+
+    def assign_request(self, method_name: str, args: tuple, kwargs: dict,
+                       timeout: float = 30.0):
+        """Pick a replica (pow-2 on local in-flight counts), submit, and
+        return the result ObjectRef. Blocks while every replica is at
+        max_ongoing_requests (router-side queuing, reference behavior)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            replicas = self._get_replicas()
+            if replicas:
+                chosen = self._choose(replicas)
+                if chosen is not None:
+                    break
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no available replica for {self._deployment!r} "
+                    f"within {timeout}s")
+            _time.sleep(0.01)
+
+        handle = ray_tpu.get_actor(chosen.actor_name, namespace="serve")
+        with self._lock:
+            self._inflight[chosen.replica_id] = \
+                self._inflight.get(chosen.replica_id, 0) + 1
+        ref = handle.handle_request.remote(method_name, args, kwargs)
+
+        def _done():
+            try:
+                ray_tpu.wait([ref], num_returns=1, timeout=None,
+                             fetch_local=False)
+            finally:
+                with self._lock:
+                    self._inflight[chosen.replica_id] -= 1
+        threading.Thread(target=_done, daemon=True).start()
+        return ref
+
+    def _choose(self, replicas: list[ReplicaInfo]) -> ReplicaInfo | None:
+        with self._lock:
+            candidates = (self._rng.sample(replicas, 2)
+                          if len(replicas) >= 2 else list(replicas))
+            best, best_load = None, None
+            for r in candidates:
+                load = self._inflight.get(r.replica_id, 0)
+                if load >= r.max_ongoing_requests:
+                    continue
+                if best_load is None or load < best_load:
+                    best, best_load = r, load
+            return best
+
+    def metrics(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._inflight)

@@ -1,0 +1,257 @@
+"""Serve layer tests (reference test model: python/ray/serve/tests/ —
+test_deploy, test_autoscaling_policy, test_batching, test_proxy)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def _rt():
+    ray_tpu.init()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_basic_deploy_and_call():
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return f"echo:{x}"
+
+        def shout(self, x):
+            return f"ECHO:{x}"
+
+    handle = serve.run(Echo.bind(), route_prefix=None)
+    assert handle.remote("hi").result() == "echo:hi"
+    assert handle.shout.remote("hi").result() == "ECHO:hi"
+
+
+def test_function_deployment_and_init_args():
+    @serve.deployment
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def __call__(self, x):
+            return self.base + x
+
+    handle = serve.run(Adder.bind(10), route_prefix=None)
+    assert handle.remote(5).result() == 15
+
+
+def test_composition_handle_passing():
+    @serve.deployment
+    class Tokenizer:
+        def __call__(self, text):
+            return text.split()
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, tok):
+            self.tok = tok
+
+        def __call__(self, text):
+            toks = self.tok.remote(text).result()
+            return len(toks)
+
+    handle = serve.run(Pipeline.bind(Tokenizer.bind()), route_prefix=None)
+    assert handle.remote("a b c d").result() == 4
+
+
+def test_multiple_replicas_spread_load():
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __init__(self):
+            import uuid
+            self.id = uuid.uuid4().hex
+
+        def __call__(self):
+            return self.id
+
+    handle = serve.run(WhoAmI.bind(), route_prefix=None)
+    ids = {handle.remote().result() for _ in range(40)}
+    assert len(ids) >= 2  # pow-2 routing reaches multiple replicas
+
+
+def test_status_and_delete():
+    @serve.deployment(num_replicas=2)
+    class D:
+        def __call__(self):
+            return "ok"
+
+    serve.run(D.bind(), route_prefix=None)
+    st = serve.status()
+    assert st["D"].status == "HEALTHY"
+    assert st["D"].replica_states.get("RUNNING") == 2
+    serve.delete()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and serve.status():
+        time.sleep(0.05)
+    assert serve.status() == {}
+
+
+def test_rolling_update_version_change():
+    def make(version_tag):
+        @serve.deployment(name="V", version=version_tag)
+        class V:
+            def __call__(self):
+                return version_tag
+
+        return V
+
+    h = serve.run(make("v1").bind(), route_prefix=None)
+    assert h.remote().result() == "v1"
+    h = serve.run(make("v2").bind(), route_prefix=None)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if h.remote().result() == "v2":
+            break
+        time.sleep(0.05)
+    assert h.remote().result() == "v2"
+
+
+def test_batching():
+    @serve.deployment(max_ongoing_requests=16)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        def __call__(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 2 for x in xs]
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind(), route_prefix=None)
+    results = [None] * 8
+    threads = []
+
+    def call(i):
+        results[i] = handle.remote(i).result()
+
+    for i in range(8):
+        t = threading.Thread(target=call, args=(i,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    assert results == [i * 2 for i in range(8)]
+    sizes = handle.sizes.remote().result()
+    assert max(sizes) > 1  # batching actually coalesced concurrent calls
+
+
+def test_autoscaling_up_and_down():
+    @serve.deployment(
+        max_ongoing_requests=4,
+        autoscaling_config=dict(min_replicas=1, max_replicas=3,
+                                target_ongoing_requests=1.0,
+                                upscale_delay_s=0.2, downscale_delay_s=0.5,
+                                metrics_interval_s=0.1),
+        health_check_period_s=10.0,
+    )
+    class Slow:
+        def __call__(self):
+            time.sleep(0.4)
+            return "done"
+
+    handle = serve.run(Slow.bind(), route_prefix=None)
+    st = serve.status()
+    assert st["Slow"].replica_states.get("RUNNING") == 1
+
+    stop = time.monotonic() + 4.0
+    threads = [threading.Thread(
+        target=lambda: [handle.remote().result() for _ in
+                        iter(lambda: time.monotonic() < stop, False)])
+        for _ in range(6)]
+    for t in threads:
+        t.start()
+    peak = 1
+    while time.monotonic() < stop:
+        st = serve.status()
+        peak = max(peak, st["Slow"].replica_states.get("RUNNING", 0))
+        time.sleep(0.1)
+    for t in threads:
+        t.join()
+    assert peak >= 2  # scaled up under load
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st = serve.status()
+        if st["Slow"].replica_states.get("RUNNING") == 1 and \
+                st["Slow"].status == "HEALTHY":
+            break
+        time.sleep(0.1)
+    assert serve.status()["Slow"].replica_states.get("RUNNING") == 1
+
+
+def test_replica_failure_recovers():
+    @serve.deployment(num_replicas=1, health_check_period_s=0.1,
+                      max_ongoing_requests=4)
+    class Flaky:
+        def __init__(self):
+            self.healthy = True
+
+        def poison(self):
+            self.healthy = False
+
+        def check_health(self):
+            if not self.healthy:
+                raise RuntimeError("poisoned")
+
+        def __call__(self):
+            return "alive"
+
+    handle = serve.run(Flaky.bind(), route_prefix=None)
+    assert handle.remote().result() == "alive"
+    handle.poison.remote().result()
+    # Controller must detect the failing health check and replace the
+    # replica; the new one answers again.
+    deadline = time.monotonic() + 15
+    ok = False
+    while time.monotonic() < deadline:
+        try:
+            if handle.remote().result(timeout=5) == "alive":
+                st = serve.status()
+                if st["Flaky"].status == "HEALTHY":
+                    ok = True
+                    break
+        except Exception:
+            pass
+        time.sleep(0.1)
+    assert ok
+
+
+def test_http_ingress():
+    @serve.deployment
+    class App:
+        def __call__(self, request: serve.Request):
+            if request.method == "POST":
+                data = request.json()
+                return {"sum": data["a"] + data["b"]}
+            return {"path": request.path,
+                    "q": request.query_params.get("q")}
+
+    serve.run(App.bind(), route_prefix="/", http=True)
+    port = serve.http_port()
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/x/y?q=hello", timeout=30) as r:
+        body = json.loads(r.read())
+    assert body == {"path": "/x/y", "q": "hello"}
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", method="POST",
+        data=json.dumps({"a": 2, "b": 3}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.loads(r.read()) == {"sum": 5}
