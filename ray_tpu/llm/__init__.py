@@ -1,0 +1,22 @@
+"""ray_tpu.llm: JAX LLM inference engine + OpenAI-compatible serving.
+
+Capability parity with the reference's ray.llm (reference: python/ray/llm/
+— LLMConfig, LLMServer over vLLM, OpenAI ingress; SURVEY.md §2.3 M5). The
+engine is TPU-native: continuous batching over a static-shape slot KV
+cache, jitted prefill/decode, on-device sampling (engine.py).
+"""
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import GenerationResult, LLMEngine
+from ray_tpu.llm.serving import (
+    LLMServer,
+    build_llm_deployment,
+    build_openai_app,
+)
+from ray_tpu.llm.tokenizer import ByteTokenizer, get_tokenizer
+
+__all__ = [
+    "LLMConfig", "SamplingParams", "LLMEngine", "GenerationResult",
+    "LLMServer", "build_llm_deployment", "build_openai_app",
+    "ByteTokenizer", "get_tokenizer",
+]

@@ -1,0 +1,57 @@
+"""LLM configs.
+
+Capability parity with the reference's LLM config surface (reference:
+python/ray/llm/_internal/serve/core/configs/llm_config.py:141 LLMConfig —
+model id + engine kwargs + placement; engine kwargs tensor_parallel_size
+vllm_models.py:226). TPU-native: the engine is JAX; parallelism is a mesh
+axis, not a worker-process count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.models.llama import LlamaConfig
+
+
+@dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0  # 0 → greedy
+    top_p: float = 1.0
+    top_k: int = 0  # 0 → disabled
+    stop_token_ids: tuple[int, ...] = ()
+    seed: int | None = None
+
+
+@dataclass
+class LLMConfig:
+    model: LlamaConfig | str = "tiny"  # a config or a named geometry
+    tokenizer: str = "byte"            # "byte" or a HF tokenizer path
+    max_num_seqs: int = 8              # continuous-batching slots
+    max_seq_len: int | None = None     # default: model.max_seq_len
+    dtype: str | None = None           # default: model.dtype
+    tensor_parallel_size: int = 1      # tp axis size on the device mesh
+    checkpoint_path: str | None = None # orbax dir; None → seeded random init
+    seed: int = 0
+    prefill_bucket_min: int = 16
+    engine_kwargs: dict[str, Any] = field(default_factory=dict)
+
+    def model_config(self) -> LlamaConfig:
+        if isinstance(self.model, LlamaConfig):
+            cfg = self.model
+        elif self.model == "tiny":
+            from dataclasses import replace
+            # vocab 512 so the byte tokenizer (256 bytes + specials) fits
+            cfg = replace(LlamaConfig.tiny(), vocab_size=512)
+        elif self.model in ("llama3-8b", "llama3_8b"):
+            cfg = LlamaConfig.llama3_8b()
+        elif self.model in ("llama3-1b", "llama3_1b"):
+            cfg = LlamaConfig.llama3_1b()
+        else:
+            raise ValueError(f"unknown model {self.model!r}")
+        if self.dtype is not None and cfg.dtype != self.dtype:
+            from dataclasses import replace
+            cfg = replace(cfg, dtype=self.dtype)
+        return cfg

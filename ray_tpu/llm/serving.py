@@ -1,0 +1,113 @@
+"""LLM serving: OpenAI-compatible app over serve deployments.
+
+Capability parity with the reference's serve-side LLM stack (reference:
+python/ray/llm/_internal/serve/ — LLMServer deployment wrapping the engine,
+OpenAI-compatible ingress core/ingress/; deployment options from LLMConfig
+llm_config.py:141). The engine here is the JAX continuous-batching engine
+(engine.py) instead of a wrapped vLLM.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ray_tpu import serve
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import LLMEngine
+
+
+class LLMServer:
+    """One replica = one engine instance (the engine batches across the
+    replica's concurrent requests)."""
+
+    def __init__(self, llm_config: LLMConfig):
+        self.config = llm_config
+        self.engine = LLMEngine(llm_config)
+        self._model_id = (llm_config.model if isinstance(llm_config.model, str)
+                          else "llama")
+
+    # -- handle API --
+
+    def completions(self, prompt: str, **kw) -> dict:
+        sampling = _sampling_from(kw)
+        res = self.engine.generate(prompt, sampling)
+        return {
+            "id": f"cmpl-{res.request_id}",
+            "object": "text_completion",
+            "model": self._model_id,
+            "choices": [{"index": 0, "text": res.text,
+                         "finish_reason": res.finish_reason}],
+            "usage": {"prompt_tokens": len(res.prompt_ids),
+                      "completion_tokens": len(res.token_ids),
+                      "total_tokens": len(res.prompt_ids) + len(res.token_ids)},
+        }
+
+    def chat(self, messages: list[dict], **kw) -> dict:
+        sampling = _sampling_from(kw)
+        prompt = self.engine.tokenizer.apply_chat_template(messages)
+        res = self.engine.generate(prompt, sampling)
+        return {
+            "id": f"chatcmpl-{res.request_id}",
+            "object": "chat.completion",
+            "model": self._model_id,
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant", "content": res.text},
+                         "finish_reason": res.finish_reason}],
+            "usage": {"prompt_tokens": len(res.prompt_ids),
+                      "completion_tokens": len(res.token_ids),
+                      "total_tokens": len(res.prompt_ids) + len(res.token_ids)},
+        }
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+    def check_health(self) -> None:
+        if not self.engine._thread.is_alive():
+            raise RuntimeError("engine scheduler thread died")
+
+    # -- HTTP ingress (OpenAI surface) --
+
+    def __call__(self, request: "serve.Request") -> Any:
+        path = request.path
+        if path.endswith("/v1/models") or path == "/models":
+            return {"object": "list",
+                    "data": [{"id": self._model_id, "object": "model",
+                              "created": int(time.time()),
+                              "owned_by": "ray_tpu"}]}
+        body = request.json() or {}
+        if path.endswith("/v1/completions") or path == "/completions":
+            return self.completions(body.pop("prompt", ""), **body)
+        if path.endswith("/v1/chat/completions") or path == "/chat/completions":
+            return self.chat(body.pop("messages", []), **body)
+        return {"error": {"message": f"no route {path}", "code": 404}}
+
+
+def _sampling_from(kw: dict) -> SamplingParams:
+    return SamplingParams(
+        max_tokens=int(kw.get("max_tokens", 64)),
+        temperature=float(kw.get("temperature", 0.0)),
+        top_p=float(kw.get("top_p", 1.0)),
+        top_k=int(kw.get("top_k", 0)),
+    )
+
+
+def build_llm_deployment(llm_config: LLMConfig, *,
+                         name: str = "LLMServer",
+                         num_replicas: int = 1,
+                         max_ongoing_requests: int | None = None):
+    """The LLMServer as a serve deployment (reference:
+    build_llm_deployment / LLMServer.as_deployment)."""
+    return serve.deployment(
+        name=name,
+        num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests or llm_config.max_num_seqs,
+        health_check_period_s=2.0,
+    )(LLMServer)
+
+
+def build_openai_app(llm_config: LLMConfig, **deploy_kw) -> "serve.Application":
+    """OpenAI-compatible application: serve.run(build_openai_app(cfg),
+    route_prefix="/", http=True) (reference: serve llm build_openai_app)."""
+    dep = build_llm_deployment(llm_config, **deploy_kw)
+    return dep.bind(llm_config)
