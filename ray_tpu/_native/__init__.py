@@ -1,0 +1,11 @@
+"""Native (C++) components, compiled on demand.
+
+The reference ships its runtime as prebuilt C++ (src/ray/...); this build
+compiles small C++ components with the system toolchain at first use and
+caches the .so beside the sources' hash, so `pip install`-less environments
+work and rebuilds happen exactly when sources change.
+"""
+
+from ray_tpu._native.build import load_library
+
+__all__ = ["load_library"]

@@ -1,0 +1,50 @@
+"""Compile-on-demand for the C++ runtime components."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO_ROOT, "src")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+_LOCK = threading.Lock()
+_CACHE: dict[str, ctypes.CDLL] = {}
+
+
+def _source_hash(sources: list[str]) -> str:
+    h = hashlib.sha1()
+    for src in sources:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def load_library(name: str, sources: list[str],
+                 extra_flags: list[str] | None = None) -> ctypes.CDLL:
+    """Build lib<name>-<srchash>.so from C++ sources (paths relative to
+    src/) if missing, then dlopen it."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        srcs = [os.path.join(_SRC_DIR, s) for s in sources]
+        tag = _source_hash(srcs)
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        so_path = os.path.join(_BUILD_DIR, f"lib{name}-{tag}.so")
+        if not os.path.exists(so_path):
+            tmp = so_path + f".tmp{os.getpid()}"
+            cmd = ["g++", "-O2", "-g", "-fPIC", "-shared", "-std=c++17",
+                   "-Wall", "-o", tmp, *srcs, "-lpthread", "-lrt",
+                   *(extra_flags or [])]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build of {name} failed:\n{proc.stderr}")
+            os.replace(tmp, so_path)  # atomic vs concurrent builders
+        lib = ctypes.CDLL(so_path)
+        _CACHE[name] = lib
+        return lib

@@ -49,6 +49,7 @@ class Cluster:
         rt = ClusterRuntime(
             self.head.rpc.host, self.head.rpc.port,
             node_daemon_addr=(target.rpc.host, target.rpc.port) if target else None,
+            shm_name=target.shm_name if target else None,
         )
         return rt
 

@@ -53,7 +53,8 @@ def connect_cluster(address: str, num_cpus: float | None = None,
         head = start_head()
         daemon = start_node(head.rpc.host, head.rpc.port, totals)
         rt = ClusterRuntime(head.rpc.host, head.rpc.port,
-                            node_daemon_addr=(daemon.rpc.host, daemon.rpc.port))
+                            node_daemon_addr=(daemon.rpc.host, daemon.rpc.port),
+                            shm_name=daemon.shm_name)
         rt._local_cluster = _LocalClusterHandles(head, [daemon])
         _wrap_shutdown(rt)
         return rt
@@ -67,7 +68,16 @@ def connect_cluster(address: str, num_cpus: float | None = None,
         if info["alive"]:
             daemon_addr = tuple(info["addr"])
             break
-    rt = ClusterRuntime(host, int(port), node_daemon_addr=daemon_addr)
+    shm_name = None
+    if daemon_addr is not None:
+        try:
+            dprobe = RpcClient(*daemon_addr)
+            shm_name = dprobe.call("node_info").get("shm_name")
+            dprobe.close()
+        except Exception:
+            shm_name = None
+    rt = ClusterRuntime(host, int(port), node_daemon_addr=daemon_addr,
+                        shm_name=shm_name)
     return rt
 
 

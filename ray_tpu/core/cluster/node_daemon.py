@@ -66,6 +66,21 @@ class NodeDaemon:
         # 2PC bundle bookkeeping: (pg_id, bundle_index) -> resources
         self._prepared_bundles: dict[tuple[str, int], dict] = {}
         self._committed_bundles: dict[tuple[str, int], tuple[dict, dict]] = {}
+        # Node-local shared-memory object arena (reference: the raylet's
+        # in-process plasma store, plasma/store_runner.h:28). Workers attach
+        # by name via RTPU_SHM_NAME.
+        self.shm_name: str | None = None
+        self._shm = None
+        try:
+            from ray_tpu.core.shm_store import SharedMemoryStore
+
+            name = f"rtpu_{self.node_id[:16]}"
+            self._shm = SharedMemoryStore(
+                name, capacity_bytes=get_config().object_store_memory_bytes,
+                create=True)
+            self.shm_name = name
+        except Exception:
+            self._shm = None  # native build unavailable; RPC-only transfers
         self._register_handlers()
         self._bg: list[asyncio.Task] = []
 
@@ -107,6 +122,11 @@ class NodeDaemon:
             except Exception:
                 pass
         await self.rpc.stop()
+        if self._shm is not None:
+            try:
+                self._shm.destroy()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------ workers
     def _fork_worker(self) -> WorkerProc:
@@ -121,6 +141,8 @@ class NodeDaemon:
         env["RTPU_NODE_DAEMON"] = f"{self.rpc.host}:{self.rpc.port}"
         env["RTPU_NODE_ID"] = self.node_id
         env["RTPU_PARENT_PID"] = str(os.getpid())
+        if self.shm_name:
+            env["RTPU_SHM_NAME"] = self.shm_name
         log_dir = os.path.join(get_config().temp_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         log = open(os.path.join(log_dir, f"worker-{self.node_id[:8]}-{time.time_ns()}.log"), "wb")
@@ -275,6 +297,7 @@ class NodeDaemon:
         return {
             "node_id": self.node_id, "resources": self.resources,
             "available": self.available, "workers": len(self.workers),
+            "shm_name": self.shm_name,
         }
 
     # ------------------------------------------------------------------ placement-group bundles

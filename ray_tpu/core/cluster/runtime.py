@@ -16,6 +16,7 @@ records the location, and readers fetch from the holder.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any
@@ -59,14 +60,29 @@ class ClusterRuntime:
 
     MAX_INFLIGHT_PER_WORKER = 16
 
+    # Results below this size travel inline / in the process-local store;
+    # larger blobs go through the node's shared-memory arena when available
+    # (reference: plasma for non-inline objects).
+    SHM_THRESHOLD = 32 * 1024
+
     def __init__(self, head_host: str, head_port: int,
                  node_daemon_addr: tuple[str, int] | None = None,
-                 is_worker: bool = False):
+                 is_worker: bool = False, shm_name: str | None = None):
         self.worker_id = WorkerID.from_random()
         self.node_id = NodeID.from_random()
         self.is_worker = is_worker
         self.store = LocalObjectStore()
-        self.refs = ReferenceCounter(on_release=self.store.delete)
+        self.refs = ReferenceCounter(on_release=self._release_object)
+        # Attach the node's shm arena (created by the node daemon).
+        self.shm = None
+        shm_name = shm_name or os.environ.get("RTPU_SHM_NAME")
+        if shm_name:
+            try:
+                from ray_tpu.core.shm_store import SharedMemoryStore
+
+                self.shm = SharedMemoryStore(shm_name, create=False)
+            except Exception:
+                self.shm = None
         self._locations: dict[ObjectID, str] = {}  # owned oid -> holder worker hex
         self._io = EventLoopThread.get()
         self.head = RpcClient(head_host, head_port)
@@ -108,11 +124,12 @@ class ClusterRuntime:
 
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if self.store.contains(object_id):
+            if self._local_contains(object_id):
                 data = await asyncio.get_running_loop().run_in_executor(
-                    None, self.store.get, object_id
+                    None, self._local_blob, object_id
                 )
-                return {"data": data}
+                if data is not None:
+                    return {"data": data}
             holder = self._locations.get(object_id)
             if holder is not None:
                 return {"location": holder}
@@ -120,7 +137,15 @@ class ClusterRuntime:
         return {"pending": True}
 
     async def _handle_free_object(self, conn, oid: str):
-        self.store.delete(ObjectID.from_hex(oid))
+        # Owner-directed free: drop every local copy, including the node
+        # arena's (the owner has decided the object is dead).
+        object_id = ObjectID.from_hex(oid)
+        self.store.delete(object_id)
+        if self.shm is not None:
+            try:
+                self.shm.delete(object_id.binary())
+            except Exception:
+                pass
         return {"ok": True}
 
     async def _handle_report_location(self, conn, oid: str, holder: str):
@@ -152,9 +177,48 @@ class ClusterRuntime:
         return tuple(res["addr"]) if res.get("addr") else None
 
     # ------------------------------------------------------------------ put/get
+    def _release_object(self, oid: ObjectID, rec=None) -> None:
+        self.store.delete(oid)
+        # The shm arena is shared node-wide: only the object's owner may
+        # delete from it — a borrower releasing its cache must not GC data
+        # other processes still reference (reference: owner-driven GC,
+        # reference_counter.h).
+        owns = rec is not None and rec.owner_id == self.worker_id
+        if owns and self.shm is not None:
+            try:
+                self.shm.delete(oid.binary())
+            except Exception:
+                pass
+
+    def _store_blob(self, oid: ObjectID, blob: bytes, owner) -> None:
+        """Large blobs land in the node shm arena (visible to every local
+        process, zero-copy); small ones in the process-local store."""
+        if self.shm is not None and len(blob) >= self.SHM_THRESHOLD:
+            try:
+                self.shm.put(oid.binary(), blob)
+                return
+            except Exception:
+                pass  # arena full and unspillable: fall back
+        self.store.put(oid, blob, owner)
+
+    def _local_blob(self, oid: ObjectID) -> bytes | None:
+        if self.store.contains(oid):
+            return self.store.get(oid)
+        if self.shm is not None:
+            try:
+                return self.shm.get_bytes(oid.binary())
+            except KeyError:
+                pass
+        return None
+
+    def _local_contains(self, oid: ObjectID) -> bool:
+        if self.store.contains(oid):
+            return True
+        return self.shm is not None and self.shm.contains(oid.binary())
+
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.worker_id)
-        self.store.put(oid, serialization.serialize(value), self.worker_id)
+        self._store_blob(oid, serialization.serialize(value), self.worker_id)
         self.refs.add_owned(oid, self.worker_id)
         return ObjectRef(oid, self.worker_id)
 
@@ -170,9 +234,10 @@ class ClusterRuntime:
         return out
 
     def _fetch(self, ref: ObjectRef, deadline: float | None) -> bytes:
-        # 1. local
-        if self.store.contains(ref.id):
-            return self.store.get(ref.id)
+        # 1. local (process store, then node shm arena)
+        local = self._local_blob(ref.id)
+        if local is not None:
+            return local
         owner_hex = ref.owner_id.hex() if ref.owner_id else None
         am_owner = ref.owner_id == self.worker_id
         while True:
@@ -193,6 +258,13 @@ class ClusterRuntime:
                 try:
                     return self.store.get(ref.id, timeout=step)
                 except TimeoutError:
+                    # A local worker may have deposited the result in the
+                    # node arena rather than our process store.
+                    if self.shm is not None:
+                        try:
+                            return self.shm.get_bytes(ref.id.binary())
+                        except KeyError:
+                            pass
                     continue
             # borrower: ask the owner
             if owner_hex is None:
@@ -232,7 +304,7 @@ class ClusterRuntime:
         while len(ready) < num_returns:
             still = []
             for r in pending:
-                if self.store.contains(r.id) or r.id in self._locations:
+                if self._local_contains(r.id) or r.id in self._locations:
                     ready.append(r)
                 else:
                     still.append(r)

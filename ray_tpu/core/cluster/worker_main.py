@@ -131,10 +131,14 @@ class WorkerProcess:
             if len(blob) <= cfg.inline_object_max_bytes:
                 out.append({"data": blob})
             else:
-                # Large result: stays here; owner records our location
-                # (reference: results over max_direct_call_object_size go to
-                # plasma at the executor).
-                self.runtime.store.put(oid, blob, spec.owner_id or self.runtime.worker_id)
+                # Large result: goes to the node shm arena when available
+                # (same-node readers get it zero-copy without an RPC), else
+                # stays in our process store; either way the owner records
+                # our location for cross-node fetches (reference: results
+                # over max_direct_call_object_size go to plasma at the
+                # executor).
+                self.runtime._store_blob(
+                    oid, blob, spec.owner_id or self.runtime.worker_id)
                 out.append({"location": self.runtime.worker_id.hex()})
         return out
 

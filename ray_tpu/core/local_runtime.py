@@ -133,7 +133,7 @@ class LocalRuntime:
         self._lock = threading.RLock()
         self._shutdown = False
 
-    def _on_release(self, oid: ObjectID) -> None:
+    def _on_release(self, oid: ObjectID, rec=None) -> None:
         # Tombstone so a result landing after all refs died is dropped, not
         # stored forever (fire-and-forget tasks).
         self._released.add(oid)

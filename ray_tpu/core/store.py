@@ -231,4 +231,7 @@ class ReferenceCounter:
         if rec.local_refs == 0 and rec.submitted_task_refs == 0 and not rec.borrowers:
             self._records.pop(object_id, None)
             if self._on_release is not None:
-                self._on_release(object_id)
+                # The released record rides along so the callback can tell
+                # owned objects (delete everywhere, incl. shared arenas)
+                # from borrowed ones (drop the local cache only).
+                self._on_release(object_id, rec)

@@ -1,0 +1,406 @@
+// Shared-memory object store: the node-local arena every worker process on a
+// host attaches to.
+//
+// Capability parity with the reference's plasma store (reference:
+// src/ray/object_manager/plasma/store.h PlasmaStore, dlmalloc.cc shm arena,
+// eviction_policy.cc LRU, fling.cc fd passing). TPU-native simplifications:
+// one POSIX shm segment per node (named, so clients attach by path instead of
+// fd passing); all metadata lives inside the segment (robust process-shared
+// mutex, open-addressed object table, boundary-tag heap) so any process can
+// operate on it; eviction exposes LRU candidates to the caller, which spills
+// to disk before deleting (reference: local_object_manager.h spill flow).
+//
+// C ABI throughout - consumed from Python via ctypes
+// (ray_tpu/core/shm_store.py).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055534852ULL;  // "RTPUSHR"
+constexpr uint32_t kIdSize = 20;
+constexpr uint64_t kAlign = 64;
+constexpr uint64_t kMinSplit = 128;
+
+enum EntryState : uint32_t {
+  kEmpty = 0,
+  kCreated = 1,
+  kSealed = 2,
+  kTombstone = 3,
+};
+
+// Return codes (keep in sync with shm_store.py).
+enum Rc : int {
+  kOk = 0,
+  kErrExists = -1,
+  kErrNotFound = -2,
+  kErrOom = -3,
+  kErrNotSealed = -4,
+  kErrBusy = -5,
+  kErrSys = -6,
+  kErrTooSmall = -7,
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t total_size;
+  uint64_t table_offset;
+  uint64_t num_slots;
+  uint64_t arena_offset;
+  uint64_t arena_size;
+  uint64_t used_bytes;     // payload bytes in live objects
+  uint64_t num_objects;
+  uint64_t lru_clock;
+  pthread_mutex_t mutex;
+};
+
+struct Entry {
+  uint8_t id[kIdSize];
+  uint32_t state;
+  int32_t refcount;
+  uint64_t offset;  // payload offset from segment base
+  uint64_t size;    // payload size
+  uint64_t last_access;
+};
+
+// Boundary-tag heap block. Payload follows the header; prev_size enables
+// backward coalescing.
+struct Block {
+  uint64_t size;       // total block size incl. header
+  uint64_t prev_size;  // size of the block immediately before (0 = first)
+  uint32_t free;
+  uint32_t pad_;
+};
+
+struct Store {
+  uint8_t* base;
+  uint64_t mapped_size;
+  Header* hdr;
+  Entry* table;
+  char name[256];
+};
+
+inline uint64_t AlignUp(uint64_t v, uint64_t a) { return (v + a - 1) / a * a; }
+
+inline uint64_t HashId(const uint8_t* id) {
+  uint64_t h = 1469598103934665603ULL;  // FNV-1a
+  for (uint32_t i = 0; i < kIdSize; ++i) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+class Locker {
+ public:
+  explicit Locker(Store* s) : s_(s) {
+    int rc = pthread_mutex_lock(&s_->hdr->mutex);
+    if (rc == EOWNERDEAD) {
+      // A client died holding the lock; state is still consistent for our
+      // purposes (every mutation below is applied under the lock and is
+      // idempotent at the object level).
+      pthread_mutex_consistent(&s_->hdr->mutex);
+    }
+  }
+  ~Locker() { pthread_mutex_unlock(&s_->hdr->mutex); }
+
+ private:
+  Store* s_;
+};
+
+Block* FirstBlock(Store* s) {
+  return reinterpret_cast<Block*>(s->base + s->hdr->arena_offset);
+}
+
+Block* NextBlock(Store* s, Block* b) {
+  uint8_t* nxt = reinterpret_cast<uint8_t*>(b) + b->size;
+  if (nxt >= s->base + s->hdr->arena_offset + s->hdr->arena_size) return nullptr;
+  return reinterpret_cast<Block*>(nxt);
+}
+
+Block* PrevBlock(Store* s, Block* b) {
+  if (b->prev_size == 0) return nullptr;
+  return reinterpret_cast<Block*>(reinterpret_cast<uint8_t*>(b) - b->prev_size);
+}
+
+Entry* FindEntry(Store* s, const uint8_t* id) {
+  uint64_t mask = s->hdr->num_slots - 1;
+  uint64_t slot = HashId(id) & mask;
+  for (uint64_t probe = 0; probe < s->hdr->num_slots; ++probe) {
+    Entry* e = &s->table[(slot + probe) & mask];
+    if (e->state == kEmpty) return nullptr;
+    if (e->state != kTombstone && memcmp(e->id, id, kIdSize) == 0) return e;
+  }
+  return nullptr;
+}
+
+Entry* AllocEntry(Store* s, const uint8_t* id) {
+  uint64_t mask = s->hdr->num_slots - 1;
+  uint64_t slot = HashId(id) & mask;
+  for (uint64_t probe = 0; probe < s->hdr->num_slots; ++probe) {
+    Entry* e = &s->table[(slot + probe) & mask];
+    if (e->state == kEmpty || e->state == kTombstone) {
+      memcpy(e->id, id, kIdSize);
+      return e;
+    }
+  }
+  return nullptr;  // table full
+}
+
+// First-fit allocate `payload` bytes; returns payload offset or 0 on OOM.
+uint64_t HeapAlloc(Store* s, uint64_t payload) {
+  uint64_t need = AlignUp(payload + sizeof(Block), kAlign);
+  for (Block* b = FirstBlock(s); b != nullptr; b = NextBlock(s, b)) {
+    if (!b->free || b->size < need) continue;
+    uint64_t remainder = b->size - need;
+    if (remainder >= kMinSplit + sizeof(Block)) {
+      b->size = need;
+      Block* split = NextBlock(s, b);
+      split->size = remainder;
+      split->prev_size = need;
+      split->free = 1;
+      Block* after = NextBlock(s, split);
+      if (after != nullptr) after->prev_size = remainder;
+    }
+    b->free = 0;
+    return reinterpret_cast<uint8_t*>(b) + sizeof(Block) - s->base;
+  }
+  return 0;
+}
+
+void HeapFree(Store* s, uint64_t payload_offset) {
+  Block* b = reinterpret_cast<Block*>(s->base + payload_offset - sizeof(Block));
+  b->free = 1;
+  // Coalesce forward.
+  Block* nxt = NextBlock(s, b);
+  if (nxt != nullptr && nxt->free) {
+    b->size += nxt->size;
+    Block* after = NextBlock(s, b);
+    if (after != nullptr) after->prev_size = b->size;
+  }
+  // Coalesce backward.
+  Block* prv = PrevBlock(s, b);
+  if (prv != nullptr && prv->free) {
+    prv->size += b->size;
+    Block* after = NextBlock(s, prv);
+    if (after != nullptr) after->prev_size = prv->size;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (or overwrite) a store segment. Returns handle or null.
+Store* store_create(const char* name, uint64_t capacity, uint64_t num_slots) {
+  if (num_slots == 0) num_slots = 4096;
+  // Round slots to a power of two.
+  uint64_t slots = 1;
+  while (slots < num_slots) slots <<= 1;
+
+  uint64_t table_off = AlignUp(sizeof(Header), kAlign);
+  uint64_t arena_off = AlignUp(table_off + slots * sizeof(Entry), kAlign);
+  uint64_t total = arena_off + AlignUp(capacity, kAlign);
+
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(base);
+  s->mapped_size = total;
+  s->hdr = reinterpret_cast<Header*>(s->base);
+  s->table = reinterpret_cast<Entry*>(s->base + table_off);
+  strncpy(s->name, name, sizeof(s->name) - 1);
+
+  Header* h = s->hdr;
+  memset(h, 0, sizeof(Header));
+  h->total_size = total;
+  h->table_offset = table_off;
+  h->num_slots = slots;
+  h->arena_offset = arena_off;
+  h->arena_size = total - arena_off;
+  memset(s->table, 0, slots * sizeof(Entry));
+
+  Block* first = FirstBlock(s);
+  first->size = h->arena_size;
+  first->prev_size = 0;
+  first->free = 1;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  h->magic = kMagic;  // last: marks the segment initialized
+  return s;
+}
+
+// Attach to an existing segment.
+Store* store_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, static_cast<uint64_t>(st.st_size),
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(base);
+  s->mapped_size = static_cast<uint64_t>(st.st_size);
+  s->hdr = reinterpret_cast<Header*>(s->base);
+  if (s->hdr->magic != kMagic) {
+    munmap(base, s->mapped_size);
+    delete s;
+    return nullptr;
+  }
+  s->table = reinterpret_cast<Entry*>(s->base + s->hdr->table_offset);
+  strncpy(s->name, name, sizeof(s->name) - 1);
+  return s;
+}
+
+void store_close(Store* s) {
+  if (s == nullptr) return;
+  munmap(s->base, s->mapped_size);
+  delete s;
+}
+
+int store_destroy(const char* name) { return shm_unlink(name); }
+
+// Reserve space for an object; payload offset written to *offset_out. The
+// caller memcpys into base+offset and then seals.
+int store_create_object(Store* s, const uint8_t* id, uint64_t size,
+                        uint64_t* offset_out) {
+  Locker l(s);
+  if (FindEntry(s, id) != nullptr) return kErrExists;
+  uint64_t off = HeapAlloc(s, size == 0 ? 1 : size);
+  if (off == 0) return kErrOom;
+  Entry* e = AllocEntry(s, id);
+  if (e == nullptr) {
+    HeapFree(s, off);
+    return kErrOom;
+  }
+  e->state = kCreated;
+  e->refcount = 0;
+  e->offset = off;
+  e->size = size;
+  e->last_access = ++s->hdr->lru_clock;
+  s->hdr->used_bytes += size;
+  s->hdr->num_objects += 1;
+  *offset_out = off;
+  return kOk;
+}
+
+int store_seal(Store* s, const uint8_t* id) {
+  Locker l(s);
+  Entry* e = FindEntry(s, id);
+  if (e == nullptr) return kErrNotFound;
+  if (e->state == kSealed) return kOk;
+  e->state = kSealed;
+  return kOk;
+}
+
+// Pin + locate a sealed object.
+int store_get(Store* s, const uint8_t* id, uint64_t* offset_out,
+              uint64_t* size_out) {
+  Locker l(s);
+  Entry* e = FindEntry(s, id);
+  if (e == nullptr) return kErrNotFound;
+  if (e->state != kSealed) return kErrNotSealed;
+  e->refcount += 1;
+  e->last_access = ++s->hdr->lru_clock;
+  *offset_out = e->offset;
+  *size_out = e->size;
+  return kOk;
+}
+
+int store_release(Store* s, const uint8_t* id) {
+  Locker l(s);
+  Entry* e = FindEntry(s, id);
+  if (e == nullptr) return kErrNotFound;
+  if (e->refcount > 0) e->refcount -= 1;
+  return kOk;
+}
+
+int store_contains(Store* s, const uint8_t* id) {
+  Locker l(s);
+  Entry* e = FindEntry(s, id);
+  return (e != nullptr && e->state == kSealed) ? 1 : 0;
+}
+
+int store_delete(Store* s, const uint8_t* id) {
+  Locker l(s);
+  Entry* e = FindEntry(s, id);
+  if (e == nullptr) return kErrNotFound;
+  if (e->refcount > 0) return kErrBusy;
+  HeapFree(s, e->offset);
+  s->hdr->used_bytes -= e->size;
+  s->hdr->num_objects -= 1;
+  e->state = kTombstone;
+  return kOk;
+}
+
+// LRU spill candidates: sealed, unpinned objects, oldest-access first, until
+// their cumulative payload covers `bytes_needed`. Writes ids consecutively
+// into out_ids (capacity max_out); returns the count.
+int store_evict_candidates(Store* s, uint64_t bytes_needed, uint8_t* out_ids,
+                           int max_out) {
+  Locker l(s);
+  int count = 0;
+  uint64_t gathered = 0;
+  uint64_t last_taken = 0;
+  while (count < max_out && gathered < bytes_needed) {
+    Entry* best = nullptr;
+    for (uint64_t i = 0; i < s->hdr->num_slots; ++i) {
+      Entry* e = &s->table[i];
+      if (e->state != kSealed || e->refcount != 0) continue;
+      if (e->last_access <= last_taken) continue;  // already picked
+      if (best == nullptr || e->last_access < best->last_access) best = e;
+    }
+    if (best == nullptr) break;
+    memcpy(out_ids + count * kIdSize, best->id, kIdSize);
+    last_taken = best->last_access;
+    gathered += best->size;
+    ++count;
+  }
+  return count;
+}
+
+void store_stats(Store* s, uint64_t* capacity, uint64_t* used,
+                 uint64_t* num_objects) {
+  Locker l(s);
+  *capacity = s->hdr->arena_size;
+  *used = s->hdr->used_bytes;
+  *num_objects = s->hdr->num_objects;
+}
+
+uint8_t* store_base(Store* s) { return s->base; }
+uint64_t store_capacity(Store* s) { return s->hdr->arena_size; }
+
+}  // extern "C"

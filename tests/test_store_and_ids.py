@@ -81,7 +81,7 @@ def test_store_spills_over_capacity(tmp_path):
 
 def test_refcount_release_on_zero():
     released = []
-    rc = ReferenceCounter(on_release=released.append)
+    rc = ReferenceCounter(on_release=lambda oid, rec: released.append(oid))
     w = WorkerID.from_random()
     oid = ObjectID.for_put(w)
     rc.add_owned(oid, w)  # ownership registration only — no local ref
@@ -95,7 +95,7 @@ def test_refcount_release_on_zero():
 
 def test_refcount_borrowers_block_release():
     released = []
-    rc = ReferenceCounter(on_release=released.append)
+    rc = ReferenceCounter(on_release=lambda oid, rec: released.append(oid))
     w, b = WorkerID.from_random(), WorkerID.from_random()
     oid = ObjectID.for_put(w)
     rc.add_owned(oid, w)
@@ -109,7 +109,7 @@ def test_refcount_borrowers_block_release():
 
 def test_refcount_pending_task_blocks_release():
     released = []
-    rc = ReferenceCounter(on_release=released.append)
+    rc = ReferenceCounter(on_release=lambda oid, rec: released.append(oid))
     w = WorkerID.from_random()
     oid = ObjectID.for_put(w)
     rc.add_owned(oid, w)
