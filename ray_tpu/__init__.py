@@ -28,6 +28,7 @@ from ray_tpu.core.exceptions import (
     TaskCancelledError,
     TaskError,
 )
+from ray_tpu.core.events import timeline
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.remote_function import remote
 from ray_tpu.core.worker import get_runtime_context
@@ -48,6 +49,7 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "get_runtime_context",
+    "timeline",
     "ObjectRef",
     "RayTpuError",
     "TaskError",

@@ -15,6 +15,7 @@ from typing import Any
 from ray_tpu.core.remote_function import _build_resources, extract_arg_refs
 from ray_tpu.core.task_spec import ActorCreationSpec, SchedulingStrategy, TaskSpec
 from ray_tpu.core.worker import global_worker
+from ray_tpu.util import tracing
 from ray_tpu.utils import serialization
 from ray_tpu.utils.ids import ActorID, TaskID
 
@@ -92,6 +93,7 @@ class ActorHandle:
             seq_no=self._seq_no,
             name=f"{method_name}",
             owner_id=worker.worker_id,
+            trace_ctx=tracing.inject(),
         )
         refs = worker.runtime.submit_actor_task(spec)
         return refs[0] if num_returns == 1 else refs

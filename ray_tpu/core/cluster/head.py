@@ -58,6 +58,11 @@ class HeadServer:
         self.named_actors: dict[tuple[str, str], str] = {}
         self.kv: dict[str, dict[str, bytes]] = {}  # namespace -> key -> value
         self.workers: dict[str, tuple[str, int]] = {}  # worker_id -> rpc addr
+        # Cluster-wide task events flushed from workers (reference:
+        # GcsTaskManager bounded task-event store).
+        from collections import deque
+
+        self.task_events: deque = deque(maxlen=100_000)
         self._subs: dict[str, set[ServerConnection]] = {}  # channel -> conns
         self._node_conns: dict[str, ServerConnection] = {}
         self._register_handlers()
@@ -86,6 +91,8 @@ class HeadServer:
         r("subscribe", self._subscribe)
         r("cluster_resources", self._cluster_resources)
         r("available_resources", self._available_resources)
+        r("state_snapshot", self._state_snapshot)
+        r("report_task_events", self._report_task_events)
         r("create_placement_group", self._create_pg)
         r("remove_placement_group", self._remove_pg)
         r("placement_group_state", self._pg_state)
@@ -518,6 +525,44 @@ class HeadServer:
 
     async def _kv_keys(self, conn: ServerConnection, ns: str, prefix: str = ""):
         return {"keys": [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]}
+
+    # ------------------------------------------------------------------ state API
+    async def _report_task_events(self, conn: ServerConnection, events: list):
+        """Workers flush their task-event batches here (reference:
+        GcsTaskManager as the cluster-wide task-event store)."""
+        self.task_events.extend(events)
+        return {"ok": True}
+
+    async def _state_snapshot(self, conn: ServerConnection):
+        """Whole-cluster view for the state API (reference: the GCS tables
+        behind python/ray/util/state/api.py list_nodes/list_actors/...)."""
+        return {
+            "nodes": {
+                nid: {
+                    "alive": n.alive, "resources": n.resources,
+                    "available": n.available, "labels": n.labels,
+                    "addr": list(n.addr),
+                }
+                for nid, n in self.nodes.items()
+            },
+            "actors": {
+                aid: {
+                    "state": a.state, "name": a.name, "namespace": a.namespace,
+                    "node_id": a.node_id, "resources": a.resources,
+                    "restarts": a.restarts_used, "death_reason": a.death_reason,
+                }
+                for aid, a in self.actors.items()
+            },
+            "placement_groups": {
+                pid: {"state": pg["state"], "strategy": pg["strategy"],
+                      "bundles": pg["bundles"], "name": pg.get("name")}
+                for pid, pg in self.pgs.items()
+            },
+            "workers": {
+                wid: {"addr": list(addr)} for wid, addr in self.workers.items()
+            },
+            "task_events": list(self.task_events),
+        }
 
     # ------------------------------------------------------------------ resources
     async def _cluster_resources(self, conn: ServerConnection):

@@ -318,10 +318,15 @@ class ClusterRuntime:
 
     # ------------------------------------------------------------------ tasks
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        from ray_tpu.core.events import global_event_buffer
+
         return_ids = spec.return_ids()
         for oid in return_ids:
             self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
         spec.owner_id = self.worker_id
+        global_event_buffer().record(
+            spec.task_id.hex(), spec.name, "SUBMITTED",
+            worker_id=self.worker_id.hex(), job_id=spec.job_id.hex())
         blob = cloudpickle.dumps(spec)
         t = threading.Thread(
             target=self._submit_and_collect, args=(spec, blob, return_ids),
@@ -594,6 +599,11 @@ class ClusterRuntime:
         self.head.call("kv_del", ns=ns, key=key)
 
     # ------------------------------------------------------------------ misc
+    def state_snapshot(self) -> dict:
+        snap = self.head.call("state_snapshot")
+        snap["objects"] = self.store.stats()
+        return snap
+
     def cluster_resources(self) -> dict[str, float]:
         return self.head.call("cluster_resources")
 

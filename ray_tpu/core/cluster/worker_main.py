@@ -65,12 +65,36 @@ class WorkerProcess:
         self._actor_pool = None
         self._exit_event = threading.Event()
 
+        self.node_id_hex = os.environ.get("RTPU_NODE_ID", "")
         self.runtime._daemon.call(
             "register_worker_proc",
             worker_id=self.runtime.worker_id.hex(),
             host=self.runtime.addr[0], port=self.runtime.addr[1],
             pid=os.getpid(),
         )
+        # Ship task events to the head on an interval so driver-side
+        # timeline/state-API see cluster-wide execution (reference:
+        # TaskEventBuffer flushes worker events into GcsTaskManager).
+        threading.Thread(target=self._event_flusher, daemon=True,
+                         name="event-flush").start()
+
+    def _event_flusher(self):
+        import dataclasses
+
+        from ray_tpu.core.events import global_event_buffer
+
+        buf = global_event_buffer()
+        while not self._exit_event.is_set():
+            self._exit_event.wait(get_config().task_event_flush_interval_s)
+            batch = buf.drain()
+            if not batch:
+                continue
+            try:
+                self.runtime.head.call(
+                    "report_task_events",
+                    events=[dataclasses.asdict(e) for e in batch])
+            except Exception:
+                pass  # head temporarily unreachable: drop (bounded loss)
 
     # ------------------------------------------------------------------ tasks
     async def _push_task(self, conn, spec_blob: bytes):
@@ -82,6 +106,7 @@ class WorkerProcess:
         return await loop.run_in_executor(self._task_executor, self._execute_task, spec)
 
     def _execute_task(self, spec: TaskSpec) -> dict:
+        from ray_tpu.core.events import task_execution
         from ray_tpu.core.worker import set_task_context
 
         return_ids = spec.return_ids()
@@ -92,7 +117,9 @@ class WorkerProcess:
             kwargs = self._resolve(kwargs)
             set_task_context(spec.task_id, spec.actor_id, spec.resources)
             try:
-                result = fn(*args, **kwargs)
+                with task_execution(spec, self.runtime.worker_id.hex(),
+                                    node_id=self.node_id_hex):
+                    result = fn(*args, **kwargs)
             finally:
                 set_task_context(None, None, None)
         except BaseException as e:  # noqa: BLE001
@@ -188,6 +215,7 @@ class WorkerProcess:
                 self._run_actor_method(spec, reply_fut, loop)
 
     def _run_actor_method(self, spec: TaskSpec, reply_fut, loop):
+        from ray_tpu.core.events import task_execution
         from ray_tpu.core.worker import set_task_context
 
         return_ids = spec.return_ids()
@@ -197,12 +225,14 @@ class WorkerProcess:
             args, kwargs = self._resolve(args), self._resolve(kwargs)
             set_task_context(spec.task_id, spec.actor_id, spec.resources)
             try:
-                if inspect.iscoroutinefunction(method):
-                    fut = asyncio.run_coroutine_threadsafe(
-                        method(*args, **kwargs), self._actor_loop)
-                    result = fut.result()
-                else:
-                    result = method(*args, **kwargs)
+                with task_execution(spec, self.runtime.worker_id.hex(),
+                                    node_id=self.node_id_hex):
+                    if inspect.iscoroutinefunction(method):
+                        fut = asyncio.run_coroutine_threadsafe(
+                            method(*args, **kwargs), self._actor_loop)
+                        result = fut.result()
+                    else:
+                        result = method(*args, **kwargs)
             finally:
                 set_task_context(None, None, None)
             reply = {"results": self._package_results(spec, return_ids, result)}

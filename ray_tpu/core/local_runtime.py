@@ -25,6 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any
 
+from ray_tpu.core.events import global_event_buffer
 from ray_tpu.core.exceptions import (
     ActorDiedError,
     GetTimeoutError,
@@ -210,6 +211,9 @@ class LocalRuntime:
         for oid in return_ids:
             self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
         self.refs.on_task_submitted(spec.arg_ref_ids)
+        global_event_buffer().record(
+            spec.task_id.hex(), spec.name, "SUBMITTED",
+            worker_id=self.worker_id.hex(), job_id=spec.job_id.hex())
         # Thread-per-task: a task blocked on dependencies or on a nested get()
         # never starves other tasks of execution threads (the reference frees
         # the leased worker's CPU while a task blocks in ray.get).
@@ -221,13 +225,17 @@ class LocalRuntime:
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
 
     def _run_normal_task(self, spec: TaskSpec, return_ids: list[ObjectID]) -> None:
+        from ray_tpu.core.events import task_execution
         from ray_tpu.core.worker import set_task_context
 
+        wid = self.worker_id.hex()
         attempts = 0
         try:
             while True:
                 if return_ids[0] in self._cancelled:
                     self._store_error(return_ids, TaskCancelledError(spec.name))
+                    global_event_buffer().record(
+                        spec.task_id.hex(), spec.name, "CANCELLED", worker_id=wid)
                     return
                 try:
                     fn = serialization.loads_function(spec.fn_blob)
@@ -236,7 +244,8 @@ class LocalRuntime:
                         raise RuntimeError("resource acquisition failed")
                     set_task_context(spec.task_id, None, spec.resources)
                     try:
-                        result = fn(*args, **kwargs)
+                        with task_execution(spec, wid):
+                            result = fn(*args, **kwargs)
                     finally:
                         set_task_context(None, None, None)
                         self.resources.release(spec.resources)
@@ -379,17 +388,19 @@ class LocalRuntime:
         return_ids = spec.return_ids()
 
         def run():
+            from ray_tpu.core.events import task_execution
             from ray_tpu.core.worker import set_task_context
 
             try:
                 set_task_context(spec.task_id, state.spec.actor_id, state.spec.resources)
                 method = getattr(state.instance, spec.method_name)
                 args, kwargs = self._resolve_args(spec)
-                if inspect.iscoroutinefunction(method):
-                    fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), state.loop)
-                    result = fut.result()
-                else:
-                    result = method(*args, **kwargs)
+                with task_execution(spec, self.worker_id.hex()):
+                    if inspect.iscoroutinefunction(method):
+                        fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), state.loop)
+                        result = fut.result()
+                    else:
+                        result = method(*args, **kwargs)
                 self._store_results(spec, return_ids, result)
             except (TaskError, ActorDiedError, TaskCancelledError) as e:
                 self._store_error(return_ids, e)
@@ -413,6 +424,11 @@ class LocalRuntime:
         return_ids = spec.return_ids()
         for oid in return_ids:
             self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
+        global_event_buffer().record(
+            spec.task_id.hex(), spec.name, "SUBMITTED",
+            worker_id=self.worker_id.hex(),
+            actor_id=spec.actor_id.hex() if spec.actor_id else "",
+            job_id=spec.job_id.hex())
         with self._lock:
             state = self._actors.get(spec.actor_id)
         if state is None or state.dead:
@@ -513,6 +529,42 @@ class LocalRuntime:
         return self._pg_states.get(pg_id, "PENDING")
 
     # ------------------------------------------------------------------ misc
+    def state_snapshot(self) -> dict:
+        """Cluster-state view for the state API (reference: the GCS-backed
+        sources behind python/ray/util/state/api.py — GcsTaskManager for tasks,
+        actor/node/PG tables for the rest)."""
+        with self._lock:
+            actors = {
+                aid.hex(): {
+                    "state": ("DEAD" if st.dead else "ALIVE"),
+                    "name": st.spec.name,
+                    "namespace": st.spec.namespace,
+                    "node_id": "local",
+                    "resources": st.spec.resources,
+                    "restarts": st.restarts_used,
+                    "death_reason": st.death_reason,
+                }
+                for aid, st in self._actors.items()
+            }
+            pgs = {
+                pg_id.hex(): {"state": state}
+                for pg_id, state in self._pg_states.items()
+            }
+        return {
+            "nodes": {
+                "local": {
+                    "alive": True,
+                    "resources": self.resources.totals(),
+                    "available": self.resources.available(),
+                    "labels": {},
+                }
+            },
+            "actors": actors,
+            "placement_groups": pgs,
+            "workers": {self.worker_id.hex(): {"node_id": "local", "type": "driver"}},
+            "objects": self.store.stats(),
+        }
+
     def cluster_resources(self) -> dict[str, float]:
         return self.resources.totals()
 

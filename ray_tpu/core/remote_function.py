@@ -15,6 +15,7 @@ from typing import Any
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec
 from ray_tpu.core.worker import global_worker
+from ray_tpu.util import tracing
 from ray_tpu.utils import serialization
 from ray_tpu.utils.ids import TaskID
 
@@ -111,6 +112,7 @@ class RemoteFunction:
             runtime_env=opts["runtime_env"],
             name=opts["name"] or self._fn.__name__,
             owner_id=worker.worker_id,
+            trace_ctx=tracing.inject(),
         )
         refs = worker.runtime.submit_task(spec)
         if opts["num_returns"] == 1:

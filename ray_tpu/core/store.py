@@ -103,6 +103,16 @@ class LocalObjectStore:
         with self._lock:
             return self._used
 
+    def stats(self) -> dict:
+        with self._lock:
+            spilled = sum(1 for e in self._objects.values() if e.data is None)
+            return {
+                "num_objects": len(self._objects),
+                "num_spilled": spilled,
+                "used_bytes": self._used,
+                "capacity_bytes": self._capacity,
+            }
+
     def object_ids(self) -> list[ObjectID]:
         with self._lock:
             return list(self._objects.keys())

@@ -43,6 +43,7 @@ class TaskSpec:
     runtime_env: dict[str, Any] | None = None
     name: str = ""
     owner_id: WorkerID | None = None
+    trace_ctx: dict[str, Any] | None = None  # propagated tracing context
 
     # actor-task fields
     actor_id: ActorID | None = None

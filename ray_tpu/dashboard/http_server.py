@@ -1,0 +1,140 @@
+"""Dashboard: HTTP JSON API over cluster state + Prometheus metrics.
+
+Capability parity with the reference's dashboard head (reference:
+python/ray/dashboard/head.py:49 DashboardHead with pluggable modules in
+dashboard/modules/ — state, metrics, job; the reference adds a React client on
+top of the same JSON API): a threaded HTTP server exposing the state API,
+the task timeline, and the metrics registry. Extra modules (e.g. job
+submission) register routes via ``add_route``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+from urllib.parse import parse_qs, urlparse
+
+
+class DashboardServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._routes: dict[tuple[str, str], Callable] = {}
+        self._register_builtin()
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self, method: str):
+                parsed = urlparse(self.path)
+                handler = dashboard._routes.get((method, parsed.path))
+                if handler is None:
+                    self.send_error(404, "no such route")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                    result = handler(params, body)
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(json.dumps({"error": repr(e)}).encode())
+                    return
+                if isinstance(result, (bytes, str)):
+                    payload = result.encode() if isinstance(result, str) else result
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    payload = json.dumps(result).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.addr = self._httpd.server_address
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------ routes
+    def add_route(self, method: str, path: str, handler: Callable) -> None:
+        """handler(params: dict, body: bytes) -> json-able | str | bytes."""
+        self._routes[(method, path)] = handler
+
+    def _register_builtin(self):
+        from ray_tpu import __version__
+        from ray_tpu.core import events
+        from ray_tpu.util import metrics, tracing
+        from ray_tpu.util.state import api as state_api
+
+        def listing(fn):
+            # Query params become equality filters; ?limit=N caps the result
+            # (e.g. /api/tasks?state=FAILED&limit=10).
+            def handler(params, body):
+                params = dict(params)
+                limit = int(params.pop("limit", 10_000))
+                filters = [(k, "=", v) for k, v in params.items()]
+                return fn(filters=filters or None, limit=limit)
+
+            return handler
+
+        self.add_route("GET", "/api/version", lambda p, b: {"version": __version__})
+        self.add_route("GET", "/api/nodes", listing(state_api.list_nodes))
+        self.add_route("GET", "/api/actors", listing(state_api.list_actors))
+        self.add_route("GET", "/api/tasks", listing(state_api.list_tasks))
+        self.add_route("GET", "/api/task_summary", lambda p, b: state_api.summarize_tasks())
+        self.add_route("GET", "/api/placement_groups",
+                       listing(state_api.list_placement_groups))
+        self.add_route("GET", "/api/objects", listing(state_api.list_objects))
+        self.add_route("GET", "/api/timeline", lambda p, b: events.timeline())
+        self.add_route("GET", "/api/traces", lambda p, b: tracing.export())
+        self.add_route("GET", "/metrics",
+                       lambda p, b: metrics.registry().export_prometheus())
+
+        def cluster_status(p, b):
+            from ray_tpu.core.worker import global_worker
+
+            global_worker.check_connected()
+            return {
+                "cluster_resources": global_worker.runtime.cluster_resources(),
+                "available_resources": global_worker.runtime.available_resources(),
+            }
+
+        self.add_route("GET", "/api/cluster_status", cluster_status)
+
+    # ------------------------------------------------------------------ lifecycle
+    def start(self) -> tuple[str, int]:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="dashboard-http")
+        self._thread.start()
+        return self.addr
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+_server: DashboardServer | None = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> DashboardServer:
+    """Start (or return) the process dashboard server."""
+    global _server
+    if _server is None:
+        _server = DashboardServer(host, port)
+        _server.start()
+    return _server

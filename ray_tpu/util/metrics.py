@@ -1,0 +1,185 @@
+"""Application metrics API: Counter / Gauge / Histogram with tags.
+
+Capability parity with the reference's metrics API (reference:
+python/ray/util/metrics.py Counter/Gauge/Histogram over the C++ OpenCensus
+recorder, src/ray/stats/metric.h): processes record metrics locally; the
+dashboard scrapes/aggregates them in Prometheus text exposition format.
+
+TPU-native note: no OpenCensus/OTel dependency — a lock-protected in-process
+registry with Prometheus text export keeps the hot path to a dict update, and
+the export shape identical to what the reference's metrics agent serves.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+)
+
+
+class Metric:
+    """Base: a named measurement with fixed tag keys and per-tagset series."""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] | None = None):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._series: dict[tuple, float] = {}
+        _registry.register(self)
+
+    def set_default_tags(self, tags: dict[str, str]):
+        unknown = set(tags) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(f"tags {unknown} not in declared tag_keys {self.tag_keys}")
+        self._default_tags = dict(tags)
+        return self
+
+    def _series_key(self, tags: dict[str, str] | None) -> tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            unknown = set(tags) - set(self.tag_keys)
+            if unknown:
+                raise ValueError(
+                    f"tags {unknown} not in declared tag_keys {self.tag_keys}")
+            merged.update(tags)
+        return tuple(merged.get(k, "") for k in self.tag_keys)
+
+    def _points(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._series)
+
+
+class Counter(Metric):
+    """Monotonically increasing count."""
+
+    def inc(self, value: float = 1.0, tags: dict[str, str] | None = None):
+        if value < 0:
+            raise ValueError("Counter.inc() value must be >= 0")
+        key = self._series_key(tags)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    prom_type = "counter"
+
+
+class Gauge(Metric):
+    """Last-set value."""
+
+    def set(self, value: float, tags: dict[str, str] | None = None):
+        key = self._series_key(tags)
+        with self._lock:
+            self._series[key] = float(value)
+
+    prom_type = "gauge"
+
+
+class Histogram(Metric):
+    """Bucketed distribution (cumulative buckets, Prometheus-style)."""
+
+    prom_type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] | None = None,
+                 tag_keys: Sequence[str] | None = None):
+        super().__init__(name, description, tag_keys)
+        bounds = tuple(boundaries) if boundaries else _DEFAULT_BUCKETS
+        if list(bounds) != sorted(bounds):
+            raise ValueError("histogram boundaries must be sorted ascending")
+        self.boundaries = bounds
+        self._buckets: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def observe(self, value: float, tags: dict[str, str] | None = None):
+        key = self._series_key(tags)
+        with self._lock:
+            buckets = self._buckets.setdefault(key, [0] * (len(self.boundaries) + 1))
+            idx = len(self.boundaries)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            buckets[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._series[key] = self._series.get(key, 0.0) + 1  # observation count
+
+    def _hist_points(self):
+        with self._lock:
+            return (
+                {k: list(v) for k, v in self._buckets.items()},
+                dict(self._sums),
+                dict(self._series),
+            )
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Metric] = {}
+
+    def register(self, metric: Metric):
+        with self._lock:
+            self._metrics[metric.name] = metric
+
+    def metrics(self) -> list[Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+
+    def export_prometheus(self) -> str:
+        """Prometheus text exposition of every registered metric."""
+        lines: list[str] = []
+        for m in self.metrics():
+            lines.append(f"# HELP {m.name} {m.description}")
+            lines.append(f"# TYPE {m.name} {m.prom_type}")
+            if isinstance(m, Histogram):
+                buckets, sums, counts = m._hist_points()
+                for key, bk in buckets.items():
+                    base = _labels(m.tag_keys, key)
+                    cum = 0
+                    for bound, n in zip(m.boundaries, bk):
+                        cum += n
+                        lines.append(
+                            f'{m.name}_bucket{_labels(m.tag_keys, key, ("le", repr(bound)))} {cum}'
+                        )
+                    cum += bk[-1]
+                    lines.append(
+                        f'{m.name}_bucket{_labels(m.tag_keys, key, ("le", "+Inf"))} {cum}')
+                    lines.append(f"{m.name}_sum{base} {sums.get(key, 0.0)}")
+                    lines.append(f"{m.name}_count{base} {int(counts.get(key, 0))}")
+            else:
+                for key, v in m._points().items():
+                    lines.append(f"{m.name}{_labels(m.tag_keys, key)} {v}")
+        return "\n".join(lines) + "\n"
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(keys: tuple, values: tuple, extra: tuple | None = None) -> str:
+    pairs = [(k, v) for k, v in zip(keys, values) if v != ""]
+    if extra:
+        pairs.append(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry

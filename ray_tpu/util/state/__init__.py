@@ -1,0 +1,19 @@
+from ray_tpu.util.state.api import (
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_tasks,
+)
+
+__all__ = [
+    "list_actors",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_tasks",
+    "list_workers",
+    "summarize_tasks",
+]

@@ -1,0 +1,120 @@
+"""State API: programmatic listing of cluster entities.
+
+Capability parity with the reference's state API (reference:
+python/ray/util/state/api.py — list_tasks/list_actors/list_objects/list_nodes/
+list_workers/list_placement_groups + summarize_*, fed by GCS GcsTaskManager
+and the GCS tables): entity listings with client-side filters. Filters are
+``(key, op, value)`` triples with ops ``=``/``!=``, matching the reference's
+filter surface.
+
+Tasks come from this process's task-event buffer (the owner records every task
+it submitted — in cluster mode that is the driver's view; node-wide events are
+on each worker). Everything else comes from the runtime's state snapshot
+(single source of truth: the head's tables in cluster mode).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.core.worker import global_worker
+
+
+def _snapshot() -> dict:
+    global_worker.check_connected()
+    return global_worker.runtime.state_snapshot()
+
+
+def _apply_filters(rows: list[dict], filters) -> list[dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op == "=":
+                ok = str(have) == str(value)
+            elif op == "!=":
+                ok = str(have) != str(value)
+            else:
+                raise ValueError(f"unsupported filter op {op!r} (use '=' or '!=')")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+    return out
+
+
+def list_nodes(filters=None, limit: int = 10_000) -> list[dict]:
+    snap = _snapshot()
+    rows = [
+        {"node_id": nid, **info} for nid, info in snap.get("nodes", {}).items()
+    ]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_actors(filters=None, limit: int = 10_000) -> list[dict]:
+    snap = _snapshot()
+    rows = [
+        {"actor_id": aid, **info} for aid, info in snap.get("actors", {}).items()
+    ]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_placement_groups(filters=None, limit: int = 10_000) -> list[dict]:
+    snap = _snapshot()
+    rows = [
+        {"placement_group_id": pid, **info}
+        for pid, info in snap.get("placement_groups", {}).items()
+    ]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_workers(filters=None, limit: int = 10_000) -> list[dict]:
+    snap = _snapshot()
+    rows = [
+        {"worker_id": wid, **info} for wid, info in snap.get("workers", {}).items()
+    ]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 10_000) -> list[dict]:
+    """Object-store summary rows (per-store aggregate, not per-object — the
+    reference's per-object listing needs the owner scan; aggregate stats serve
+    the same memory-debugging purpose here)."""
+    snap = _snapshot()
+    stats = snap.get("objects", {})
+    return _apply_filters([{"store": "local", **stats}], filters)[:limit]
+
+
+def list_tasks(filters=None, limit: int = 10_000) -> list[dict]:
+    """Latest state per task, merging this process's events with the
+    cluster-wide events workers flushed to the head (cluster mode)."""
+    from ray_tpu.core.events import all_events
+
+    latest: dict[str, dict] = {}
+    for ev in sorted(all_events(), key=lambda e: e.ts):
+        row = latest.setdefault(ev.task_id, {
+            "task_id": ev.task_id, "name": ev.name, "state": ev.state,
+            "worker_id": ev.worker_id, "actor_id": ev.actor_id,
+            "job_id": ev.job_id, "start_ts": None, "end_ts": None,
+        })
+        row["state"] = ev.state
+        row["name"] = ev.name or row["name"]
+        row["worker_id"] = ev.worker_id or row["worker_id"]
+        if ev.state == "RUNNING":
+            row["start_ts"] = ev.ts
+        elif ev.state in ("FINISHED", "FAILED", "CANCELLED"):
+            row["end_ts"] = ev.ts
+    rows = list(latest.values())
+    return _apply_filters(rows, filters)[:limit]
+
+
+def summarize_tasks() -> dict[str, Any]:
+    """Counts by (name, state) — reference: summarize_tasks."""
+    summary: dict[str, dict[str, int]] = {}
+    for row in list_tasks():
+        by_state = summary.setdefault(row["name"], {})
+        by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+    return summary

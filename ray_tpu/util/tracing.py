@@ -1,0 +1,139 @@
+"""Distributed tracing: spans around every task/actor call, with context
+propagated through task metadata.
+
+Capability parity with the reference's tracing helper (reference:
+python/ray/util/tracing/tracing_helper.py — _tracing_task_invocation wraps
+submission, _inject_tracing_into_class wraps actor methods, _DictPropagator
+:165 carries the context dict inside task metadata, enablement via
+_enable_tracing :98): submission creates a client span whose context rides in
+``TaskSpec.trace_ctx``; the executing worker opens a child span around the user
+function. No OpenTelemetry dependency — spans land in an in-process buffer
+exportable as dicts (same span fields an OTLP exporter would see) and into the
+chrome timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    name: str
+    kind: str  # "client" | "worker" | "internal"
+    start_ts: float
+    end_ts: float = 0.0
+    status: str = "OK"
+    attributes: dict = field(default_factory=dict)
+
+
+_enabled = False
+_ctx = threading.local()  # .trace_id, .span_id
+_spans: deque[Span] = deque(maxlen=100_000)
+_lock = threading.Lock()
+
+
+def enable_tracing() -> None:
+    """Turn span recording on for this process (reference: _enable_tracing)."""
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def _new_id(nbytes: int = 8) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def current_context() -> tuple[str, str] | None:
+    tid = getattr(_ctx, "trace_id", None)
+    sid = getattr(_ctx, "span_id", None)
+    return (tid, sid) if tid else None
+
+
+def inject() -> dict | None:
+    """Context dict to ship inside a TaskSpec (reference: _DictPropagator.inject)."""
+    if not _enabled:
+        return None
+    cur = current_context()
+    if cur is None:
+        # Root: submitting from untraced code still starts a trace.
+        return {"trace_id": _new_id(16), "parent_span_id": None}
+    return {"trace_id": cur[0], "parent_span_id": cur[1]}
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "internal", attributes: dict | None = None,
+         ctx: dict | None = None):
+    """Record a span; nests under the thread's current span unless ``ctx``
+    (a propagated context) is given."""
+    if not _enabled and ctx is None:
+        yield None
+        return
+    if ctx is not None:
+        trace_id = ctx.get("trace_id") or _new_id(16)
+        parent_id = ctx.get("parent_span_id")
+    else:
+        cur = current_context()
+        trace_id = cur[0] if cur else _new_id(16)
+        parent_id = cur[1] if cur else None
+    s = Span(
+        trace_id=trace_id, span_id=_new_id(), parent_id=parent_id, name=name,
+        kind=kind, start_ts=time.time(), attributes=dict(attributes or {}),
+    )
+    prev = current_context()
+    _ctx.trace_id, _ctx.span_id = s.trace_id, s.span_id
+    try:
+        yield s
+    except BaseException as e:
+        s.status = f"ERROR: {type(e).__name__}"
+        raise
+    finally:
+        s.end_ts = time.time()
+        if prev:
+            _ctx.trace_id, _ctx.span_id = prev
+        else:
+            _ctx.trace_id = _ctx.span_id = None
+        with _lock:
+            _spans.append(s)
+
+
+@contextlib.contextmanager
+def task_span(name: str, trace_ctx: dict | None, kind: str = "worker",
+              attributes: dict | None = None):
+    """Worker-side span around task execution; no-op unless the submitter
+    propagated a context or this process has tracing on."""
+    if trace_ctx is None and not _enabled:
+        yield None
+        return
+    with span(name, kind=kind, attributes=attributes, ctx=trace_ctx) as s:
+        yield s
+
+
+def spans() -> list[Span]:
+    with _lock:
+        return list(_spans)
+
+
+def export() -> list[dict]:
+    return [asdict(s) for s in spans()]
+
+
+def clear() -> None:
+    with _lock:
+        _spans.clear()

@@ -47,6 +47,7 @@ class Config:
 
     # --- control plane ---
     health_check_period_s: float = 1.0
+    task_event_flush_interval_s: float = 0.5
     health_check_timeout_s: float = 5.0
     health_check_failure_threshold: int = 5
     gcs_pubsub_poll_timeout_s: float = 30.0

@@ -1,0 +1,250 @@
+"""Metrics / tracing / task events / state API / dashboard.
+
+Mirrors the reference's observability test surface (reference:
+python/ray/tests/test_metrics_agent.py, test_state_api.py, tracing tests):
+everything runs against the in-process runtime.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ray_tpu.core import events
+from ray_tpu.util import metrics, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_buffers():
+    events.global_event_buffer().clear()
+    tracing.clear()
+    tracing.disable_tracing()
+    yield
+    tracing.disable_tracing()
+
+
+class TestMetrics:
+    def test_counter_gauge(self):
+        c = metrics.Counter("test_requests_total", "reqs", tag_keys=("route",))
+        c.inc(tags={"route": "/a"})
+        c.inc(2, tags={"route": "/a"})
+        c.inc(tags={"route": "/b"})
+        g = metrics.Gauge("test_queue_depth", "depth")
+        g.set(7)
+        text = metrics.registry().export_prometheus()
+        assert 'test_requests_total{route="/a"} 3.0' in text
+        assert 'test_requests_total{route="/b"} 1.0' in text
+        assert "test_queue_depth 7.0" in text
+        assert "# TYPE test_requests_total counter" in text
+
+    def test_histogram_buckets(self):
+        h = metrics.Histogram("test_latency_s", "lat", boundaries=[0.1, 1.0])
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        text = metrics.registry().export_prometheus()
+        assert 'test_latency_s_bucket{le="0.1"} 1' in text
+        assert 'test_latency_s_bucket{le="1.0"} 2' in text
+        assert 'test_latency_s_bucket{le="+Inf"} 3' in text
+        assert "test_latency_s_count 3" in text
+
+    def test_counter_rejects_negative_and_unknown_tags(self):
+        c = metrics.Counter("test_neg", "", tag_keys=("a",))
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        with pytest.raises(ValueError):
+            c.inc(tags={"bogus": "x"})
+
+
+class TestTaskEventsAndTimeline:
+    def test_events_recorded(self, rt_start):
+        rt = rt_start
+
+        @rt.remote
+        def f():
+            return 1
+
+        assert rt.get(f.remote()) == 1
+        states = {e.state for e in events.global_event_buffer().events()}
+        assert {"SUBMITTED", "RUNNING", "FINISHED"} <= states
+
+    def test_failed_task_event(self, rt_start):
+        rt = rt_start
+
+        @rt.remote(max_retries=0)
+        def boom():
+            raise ValueError("x")
+
+        with pytest.raises(Exception):
+            rt.get(boom.remote())
+        states = [e.state for e in events.global_event_buffer().events()]
+        assert "FAILED" in states
+
+    def test_timeline_chrome_trace(self, rt_start, tmp_path):
+        rt = rt_start
+
+        @rt.remote
+        def g():
+            return 2
+
+        rt.get([g.remote() for _ in range(3)])
+        trace = rt.timeline()
+        assert len(trace) >= 3
+        assert all(ev["ph"] == "X" and ev["dur"] >= 0 for ev in trace)
+        path = rt.timeline(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            assert json.load(f)
+
+
+class TestTracing:
+    def test_span_propagation_into_task(self, rt_start):
+        rt = rt_start
+        tracing.enable_tracing()
+
+        @rt.remote
+        def traced():
+            return 42
+
+        with tracing.span("driver-op") as root:
+            ref = traced.remote()
+            assert rt.get(ref) == 42
+        spans = tracing.spans()
+        names = [s.name for s in spans]
+        assert "driver-op" in names
+        assert "traced" in names
+        worker_span = next(s for s in spans if s.name == "traced")
+        assert worker_span.trace_id == root.trace_id
+        assert worker_span.parent_id == root.span_id
+
+    def test_disabled_is_noop(self, rt_start):
+        rt = rt_start
+
+        @rt.remote
+        def f():
+            return 1
+
+        rt.get(f.remote())
+        assert tracing.spans() == []
+
+    def test_span_error_status(self):
+        tracing.enable_tracing()
+        with pytest.raises(RuntimeError):
+            with tracing.span("bad"):
+                raise RuntimeError("no")
+        assert tracing.spans()[-1].status.startswith("ERROR")
+
+
+class TestStateApi:
+    def test_list_entities(self, rt_start):
+        rt = rt_start
+        from ray_tpu.util import state
+
+        @rt.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.remote()
+        assert rt.get(a.ping.remote()) == "pong"
+        nodes = state.list_nodes()
+        assert len(nodes) == 1 and nodes[0]["alive"]
+        actors = state.list_actors()
+        assert len(actors) == 1 and actors[0]["state"] == "ALIVE"
+        tasks = state.list_tasks(filters=[("state", "=", "FINISHED")])
+        assert any(t["name"] == "ping" for t in tasks)
+        summary = state.summarize_tasks()
+        assert summary["ping"]["FINISHED"] == 1
+        objs = state.list_objects()
+        assert objs[0]["num_objects"] >= 0
+
+    def test_filters(self, rt_start):
+        rt = rt_start
+
+        @rt.remote
+        def ok():
+            return 1
+
+        rt.get(ok.remote())
+        from ray_tpu.util import state
+
+        assert state.list_tasks(filters=[("state", "=", "NOPE")]) == []
+        with pytest.raises(ValueError):
+            state.list_tasks(filters=[("state", ">", "x")])
+
+
+class TestClusterEvents:
+    def test_worker_events_reach_driver(self):
+        """Worker-side RUNNING/FINISHED events flush to the head and appear in
+        the driver's list_tasks and timeline (reference: TaskEventBuffer →
+        GcsTaskManager → state API)."""
+        import time
+
+        import ray_tpu
+        from ray_tpu.util import state
+
+        ray_tpu.shutdown()
+        ray_tpu.init(address="local-cluster", num_cpus=2)
+        try:
+            @ray_tpu.remote
+            def traced_task():
+                return 7
+
+            assert ray_tpu.get(traced_task.remote()) == 7
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                rows = state.list_tasks(filters=[("name", "=", "traced_task")])
+                if rows and rows[0]["state"] == "FINISHED":
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError(f"worker events never arrived: {rows}")
+            trace = ray_tpu.timeline()
+            assert any(ev["name"] == "traced_task" for ev in trace)
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestDashboard:
+    def test_http_endpoints(self, rt_start):
+        rt = rt_start
+        from ray_tpu.dashboard.http_server import DashboardServer
+
+        @rt.remote
+        def h():
+            return 1
+
+        rt.get(h.remote())
+        srv = DashboardServer()
+        host, port = srv.start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=5) as r:
+                    body = r.read()
+                    return r.headers.get_content_type(), body
+
+            ctype, body = get("/api/version")
+            assert ctype == "application/json"
+            assert json.loads(body)["version"]
+            _, body = get("/api/nodes")
+            assert json.loads(body)[0]["alive"]
+            _, body = get("/api/tasks")
+            assert any(t["name"] == "h" for t in json.loads(body))
+            _, body = get("/api/cluster_status")
+            assert "cluster_resources" in json.loads(body)
+            ctype, body = get("/metrics")
+            assert ctype == "text/plain"
+            _, body = get("/api/timeline")
+            assert isinstance(json.loads(body), list)
+        finally:
+            srv.stop()
+
+    def test_unknown_route_404(self, rt_start):
+        from ray_tpu.dashboard.http_server import DashboardServer
+
+        srv = DashboardServer()
+        host, port = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=5)
+        finally:
+            srv.stop()
