@@ -130,10 +130,14 @@ class ActorClass:
         opts = self._options
         actor_id = ActorID.of(worker.job_id)
         arg_refs = extract_arg_refs(args, kwargs)
-        from ray_tpu.core.remote_function import resolve_strategy
+        from ray_tpu.core.remote_function import (
+            _prepare_runtime_env,
+            resolve_strategy,
+        )
 
         resources, strategy = resolve_strategy(
             _build_resources(opts), opts["scheduling_strategy"])
+        runtime_env = _prepare_runtime_env(worker.runtime, opts["runtime_env"])
         spec = ActorCreationSpec(
             actor_id=actor_id,
             job_id=worker.job_id,
@@ -148,7 +152,7 @@ class ActorClass:
             namespace=opts["namespace"],
             lifetime=opts["lifetime"],
             scheduling_strategy=strategy,
-            runtime_env=opts["runtime_env"],
+            runtime_env=runtime_env,
             owner_id=worker.worker_id,
         )
         worker.runtime.create_actor(spec)

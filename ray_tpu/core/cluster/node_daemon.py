@@ -32,12 +32,18 @@ class WorkerProc:
     lease_id: str | None = None  # None => idle
     actor_id: str | None = None  # dedicated to an actor
     resources: dict[str, float] = field(default_factory=dict)
+    # Runtime-env brand: None = pristine (never ran an env'd task); once a
+    # worker runs a task with a runtime_env it can only be reused for that
+    # env (reference: worker_pool.h PopWorkerRequest runtime-env hash match —
+    # env application is irreversible in-process).
+    env_hash: str | None = None
 
 
 @dataclass
 class _PendingLease:
     resources: dict[str, float]
     fut: asyncio.Future
+    env_hash: str = ""
 
 
 class NodeDaemon:
@@ -228,7 +234,7 @@ class NodeDaemon:
             self.available[k] = self.available.get(k, 0.0) + v
 
     async def _request_lease(self, conn: ServerConnection, resources: dict,
-                             timeout: float | None = None):
+                             timeout: float | None = None, env_hash: str = ""):
         if not self._feasible(resources):
             # Spillback: find a feasible node from the head's view
             # (reference: cluster_lease_manager spills to best remote node).
@@ -240,7 +246,7 @@ class NodeDaemon:
                     return {"spill": info["addr"]}
             return {"error": f"infeasible resource demand {resources}"}
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append(_PendingLease(dict(resources), fut))
+        self._pending.append(_PendingLease(dict(resources), fut, env_hash))
         self._try_grant()
         cfg = get_config()
         try:
@@ -248,11 +254,21 @@ class NodeDaemon:
         except asyncio.TimeoutError:
             return {"error": "lease timeout"}
 
-    def _idle_worker(self) -> WorkerProc | None:
+    def _idle_worker(self, env_hash: str = "",
+                     pristine_only: bool = False) -> WorkerProc | None:
+        """Idle worker whose env brand matches: exact env match first, then a
+        pristine worker (which the grant brands). A worker branded with a
+        different env is never handed out — its os.environ/sys.path/cwd
+        mutations would leak into the task."""
+        pristine = None
         for w in self.workers.values():
-            if w.lease_id is None and w.actor_id is None and w.addr is not None:
+            if w.lease_id is not None or w.actor_id is not None or w.addr is None:
+                continue
+            if not pristine_only and w.env_hash == env_hash:
                 return w
-        return None
+            if w.env_hash is None and pristine is None:
+                pristine = w
+        return pristine
 
     def _try_grant(self):
         cfg = get_config()
@@ -263,7 +279,7 @@ class NodeDaemon:
             if not self._fits(req.resources):
                 still.append(req)
                 continue
-            w = self._idle_worker()
+            w = self._idle_worker(req.env_hash)
             if w is None:
                 starting = len(self._unregistered)
                 if starting < cfg.worker_startup_concurrency and (
@@ -274,6 +290,8 @@ class NodeDaemon:
                 continue
             lease_id = uuid.uuid4().hex
             w.lease_id = lease_id
+            if req.env_hash:
+                w.env_hash = req.env_hash  # branded for this env from now on
             w.resources = req.resources
             self._take_resources(req.resources)
             self._leases[lease_id] = w
@@ -373,12 +391,14 @@ class NodeDaemon:
                     await self._head.call("actor_failed", actor_id=actor_id,
                                           reason="timed out waiting for resources")
                     return
-            w = self._idle_worker()
+            # Actors get a pristine worker: the creation spec's runtime_env is
+            # applied by init_actor, and the worker is dedicated until death.
+            w = self._idle_worker(pristine_only=True)
             if w is None:
                 self._fork_worker()
                 for _ in range(600):
                     await asyncio.sleep(0.05)
-                    w = self._idle_worker()
+                    w = self._idle_worker(pristine_only=True)
                     if w is not None:
                         break
                 else:

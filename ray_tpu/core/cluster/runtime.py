@@ -53,6 +53,7 @@ class _LeasedWorker:
         self.addr = addr
         self.client = client
         self.inflight = 0
+        self.idle_since = 0.0  # monotonic ts when inflight last hit 0
 
 
 class ClusterRuntime:
@@ -110,6 +111,8 @@ class ClusterRuntime:
         self.addr = self._io.run(self.server.start())
         self.head.call("register_worker", worker_id=self.worker_id.hex(),
                        host=self.addr[0], port=self.addr[1])
+        threading.Thread(target=self._lease_reaper, daemon=True,
+                         name="lease-reaper").start()
         # Actor state invalidation via pubsub.
         self.head.aio.on_notify("pub", self._on_pub)
         self.head.call("subscribe", channel="actor_events")
@@ -389,11 +392,14 @@ class ClusterRuntime:
         daemon = self._daemon
         if daemon is None:
             raise RuntimeError("no node daemon attached to this process")
-        res = daemon.call("request_lease", resources=spec.resources, timeout=None)
+        env_hash = key[1]  # canonical runtime_env JSON from the scheduling key
+        res = daemon.call("request_lease", resources=spec.resources,
+                          env_hash=env_hash, timeout=None)
         hops = 0
         while res.get("spill") and hops < 4:
             daemon = self._peer(tuple(res["spill"]))
-            res = daemon.call("request_lease", resources=spec.resources, timeout=None)
+            res = daemon.call("request_lease", resources=spec.resources,
+                              env_hash=env_hash, timeout=None)
             hops += 1
         if res.get("error"):
             raise ValueError(res["error"])
@@ -410,15 +416,36 @@ class ClusterRuntime:
         with self._lease_lock:
             w.inflight -= 1
             if w.inflight <= 0:
-                pool = self._leases.get(spec.scheduling_key(), [])
-                # Keep one cached worker per key for reuse; return extras.
-                if len(pool) > 1 and w in pool:
-                    pool.remove(w)
-                    try:
-                        getattr(w, "_daemon", self._daemon).call(
-                            "return_lease", lease_id=w.lease_id)
-                    except Exception:
-                        pass
+                # Leave the lease cached for back-to-back reuse; the reaper
+                # returns it (freeing the worker's resources node-side) after
+                # the keepalive window (reference: leased workers are returned
+                # when idle so other scheduling keys aren't starved).
+                w.idle_since = time.monotonic()
+
+    def _lease_reaper(self):
+        keepalive = get_config().lease_keepalive_s
+        while not self._shutdown:
+            time.sleep(keepalive / 2)
+            now = time.monotonic()
+            to_return: list[_LeasedWorker] = []
+            with self._lease_lock:
+                for key, pool in list(self._leases.items()):
+                    keep = []
+                    for w in pool:
+                        if w.inflight <= 0 and now - w.idle_since > keepalive:
+                            to_return.append(w)
+                        else:
+                            keep.append(w)
+                    if keep:
+                        self._leases[key] = keep
+                    else:
+                        self._leases.pop(key, None)
+            for w in to_return:
+                try:
+                    getattr(w, "_daemon", self._daemon).call(
+                        "return_lease", lease_id=w.lease_id)
+                except Exception:
+                    pass
 
     def cancel(self, ref: ObjectRef) -> None:
         self._cancelled.add(ref.id)
@@ -597,6 +624,9 @@ class ClusterRuntime:
 
     def kv_del(self, key: str, ns: str = "default") -> None:
         self.head.call("kv_del", ns=ns, key=key)
+
+    def kv_keys(self, prefix: str = "", ns: str = "default") -> list[str]:
+        return self.head.call("kv_keys", ns=ns, prefix=prefix)["keys"]
 
     # ------------------------------------------------------------------ misc
     def state_snapshot(self) -> dict:

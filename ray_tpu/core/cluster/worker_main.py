@@ -111,6 +111,10 @@ class WorkerProcess:
 
         return_ids = spec.return_ids()
         try:
+            if spec.runtime_env:
+                from ray_tpu.runtime_env import get_manager
+
+                get_manager().ensure(spec.runtime_env, self.runtime)
             fn = serialization.loads_function(spec.fn_blob)
             args, kwargs = serialization.deserialize(spec.args_blob)
             args = self._resolve(args)
@@ -177,6 +181,10 @@ class WorkerProcess:
 
     def _do_init_actor(self, actor_id: str, spec: ActorCreationSpec) -> dict:
         try:
+            if spec.runtime_env:
+                from ray_tpu.runtime_env import get_manager
+
+                get_manager().ensure(spec.runtime_env, self.runtime)
             cls = serialization.loads_function(spec.cls_blob)
             args, kwargs = serialization.deserialize(spec.args_blob)
             self._actor_instance = cls(*self._resolve(args), **self._resolve(kwargs))

@@ -131,6 +131,7 @@ class LocalRuntime:
         self._pg_states: dict = {}
         self._pg_reserved: dict = {}
         self._cancelled: set[ObjectID] = set()
+        self._kv: dict[str, dict[str, bytes]] = {}
         self._lock = threading.RLock()
         self._shutdown = False
 
@@ -238,6 +239,10 @@ class LocalRuntime:
                         spec.task_id.hex(), spec.name, "CANCELLED", worker_id=wid)
                     return
                 try:
+                    if spec.runtime_env:
+                        from ray_tpu.runtime_env import get_manager
+
+                        get_manager().ensure(spec.runtime_env, self)
                     fn = serialization.loads_function(spec.fn_blob)
                     args, kwargs = self._resolve_args(spec)
                     if not self.resources.acquire(spec.resources, timeout=None):
@@ -369,6 +374,10 @@ class LocalRuntime:
             self.resources.release(spec.resources)
 
     def _actor_init(self, state: _ActorState) -> None:
+        if state.spec.runtime_env:
+            from ray_tpu.runtime_env import get_manager
+
+            get_manager().ensure(state.spec.runtime_env, self)
         cls = serialization.loads_function(state.spec.cls_blob)
         args, kwargs = serialization.deserialize(state.spec.args_blob)
         args = self._replace_refs(args)
@@ -527,6 +536,25 @@ class LocalRuntime:
 
     def placement_group_state(self, pg_id) -> str:
         return self._pg_states.get(pg_id, "PENDING")
+
+    # ------------------------------------------------------------------ KV
+    # (parity with the cluster runtime's head-backed KV — reference:
+    # gcs_kv_manager.cc internal KV; local mode keeps tables in-process)
+    def kv_put(self, key: str, value: bytes, ns: str = "default") -> None:
+        with self._lock:
+            self._kv.setdefault(ns, {})[key] = value
+
+    def kv_get(self, key: str, ns: str = "default") -> bytes | None:
+        with self._lock:
+            return self._kv.get(ns, {}).get(key)
+
+    def kv_del(self, key: str, ns: str = "default") -> None:
+        with self._lock:
+            self._kv.get(ns, {}).pop(key, None)
+
+    def kv_keys(self, prefix: str = "", ns: str = "default") -> list[str]:
+        with self._lock:
+            return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
 
     # ------------------------------------------------------------------ misc
     def state_snapshot(self) -> dict:

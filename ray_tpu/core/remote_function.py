@@ -44,6 +44,19 @@ def _build_resources(opts: dict[str, Any]) -> dict[str, float]:
     return res
 
 
+def _prepare_runtime_env(runtime, env: dict | None) -> dict | None:
+    """Validate the env and replace local working_dir/py_modules paths with
+    packaged kv:// URIs (reference: runtime envs are packaged at submission,
+    python/ray/_private/runtime_env/packaging.py)."""
+    if not env:
+        return env
+    from ray_tpu.runtime_env.packaging import upload_runtime_env
+    from ray_tpu.runtime_env.runtime_env import RuntimeEnv
+
+    validated = RuntimeEnv.from_dict(env).to_dict()
+    return upload_runtime_env(runtime, validated)
+
+
 def resolve_strategy(resources: dict[str, float], strategy):
     """Normalize the user-facing scheduling strategy: placement-group
     strategies rewrite demands onto the bundle's derived resources."""
@@ -97,6 +110,7 @@ class RemoteFunction:
         arg_refs = extract_arg_refs(args, kwargs)
         resources, strategy = resolve_strategy(
             _build_resources(opts), opts["scheduling_strategy"])
+        runtime_env = _prepare_runtime_env(worker.runtime, opts["runtime_env"])
         spec = TaskSpec(
             task_id=TaskID.of(worker.job_id),
             job_id=worker.job_id,
@@ -109,7 +123,7 @@ class RemoteFunction:
             max_retries=opts["max_retries"],
             retry_exceptions=bool(opts["retry_exceptions"]),
             scheduling_strategy=strategy,
-            runtime_env=opts["runtime_env"],
+            runtime_env=runtime_env,
             name=opts["name"] or self._fn.__name__,
             owner_id=worker.worker_id,
             trace_ctx=tracing.inject(),

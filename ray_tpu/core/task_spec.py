@@ -58,9 +58,19 @@ class TaskSpec:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
 
     def scheduling_key(self) -> tuple:
-        env_key = tuple(sorted((self.runtime_env or {}).items())) if self.runtime_env else ()
-        res_key = tuple(sorted(self.resources.items()))
-        return (res_key, env_key)
+        # Computed once per spec: it's consulted on both lease acquire and
+        # release, and the env canonicalization walks the whole env dict.
+        cached = getattr(self, "_sched_key", None)
+        if cached is None:
+            import json
+
+            # Canonical JSON: runtime_env values are nested dicts/lists,
+            # which are unhashable as raw tuple members.
+            env_key = (json.dumps(self.runtime_env, sort_keys=True, default=str)
+                       if self.runtime_env else "")
+            res_key = tuple(sorted(self.resources.items()))
+            cached = self._sched_key = (res_key, env_key)
+        return cached
 
 
 @dataclass

@@ -1,0 +1,11 @@
+from ray_tpu.runtime_env.manager import RuntimeEnvManager, get_manager
+from ray_tpu.runtime_env.plugin import RuntimeEnvPlugin, register_plugin
+from ray_tpu.runtime_env.runtime_env import RuntimeEnv
+
+__all__ = [
+    "RuntimeEnv",
+    "RuntimeEnvManager",
+    "RuntimeEnvPlugin",
+    "get_manager",
+    "register_plugin",
+]

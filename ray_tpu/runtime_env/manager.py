@@ -1,0 +1,92 @@
+"""Worker-side runtime-env setup.
+
+Capability parity with the reference's runtime-env agent (reference:
+python/ray/_private/runtime_env/agent/ — the per-node agent creates envs on
+worker startup; workers are only reused for tasks with the same env hash, so
+setup happens once per (worker, env)): ``ensure`` applies an env exactly once
+per process — env vars into os.environ, working_dir extracted + chdir'd +
+sys.path'd, py_modules extracted + sys.path'd. Package installers (pip/conda/
+uv) are rejected: the image is immutable, ship code via working_dir/py_modules.
+
+Isolation note: in cluster mode a worker process is branded by its first
+runtime_env and never reused for a different one (node daemon env-hash
+matching), so the process-wide mutations here are single-env by construction.
+The threaded local-mode runtime shares one process: envs apply cumulatively
+there, which matches the reference's local-mode fidelity (debugging aid, not
+an isolation boundary).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+from ray_tpu.runtime_env.packaging import UriCache
+from ray_tpu.runtime_env.plugin import get_plugins
+
+
+class RuntimeEnvManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._applied: set[str] = set()
+        self._cache = UriCache()
+
+    @staticmethod
+    def env_key(env: dict | None) -> str:
+        return json.dumps(env or {}, sort_keys=True, default=str)
+
+    def ensure(self, env: dict | None, runtime) -> None:
+        """Apply ``env`` to this process (idempotent per env)."""
+        if not env:
+            return
+        key = self.env_key(env)
+        with self._lock:
+            if key in self._applied:
+                return
+            self._apply(env, runtime)
+            self._applied.add(key)
+
+    def _apply(self, env: dict, runtime) -> None:
+        for field in ("pip", "conda", "uv"):
+            if env.get(field) is not None:
+                raise RuntimeError(
+                    f"runtime_env[{field!r}] is not supported: the execution "
+                    "image is immutable. Ship code with working_dir/py_modules.")
+        for k, v in (env.get("env_vars") or {}).items():
+            os.environ[k] = v
+        wd = env.get("working_dir")
+        if wd:
+            path = (self._cache.get_or_extract(runtime, wd)
+                    if wd.startswith("kv://") else wd)
+            if path not in sys.path:
+                sys.path.insert(0, path)
+            # Dedicated worker processes run task code relative to the
+            # working dir (reference: workers start in the extracted dir).
+            # In-process (threaded local mode) runtimes must not chdir the
+            # caller's process.
+            if os.environ.get("RTPU_NODE_DAEMON"):
+                os.chdir(path)
+        for m in env.get("py_modules") or ():
+            path = (self._cache.get_or_extract(runtime, m)
+                    if m.startswith("kv://") else m)
+            parent = path if os.path.isdir(path) else os.path.dirname(path)
+            if parent not in sys.path:
+                sys.path.insert(0, parent)
+        for name, plugin in get_plugins().items():
+            if name in env:
+                plugin.validate(env[name])
+                plugin.setup(env[name], runtime)
+
+
+_manager: RuntimeEnvManager | None = None
+_manager_lock = threading.Lock()
+
+
+def get_manager() -> RuntimeEnvManager:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = RuntimeEnvManager()
+        return _manager

@@ -1,0 +1,148 @@
+"""Packaging: zip local dirs, store in the cluster KV, cache per node.
+
+Capability parity with the reference's runtime-env packaging (reference:
+python/ray/_private/runtime_env/packaging.py — zip working_dir/py_modules,
+content-addressed URIs stored in GCS KV, per-node URI cache
+python/ray/_private/runtime_env/uri_cache.py): the driver uploads each
+directory once (content hash dedupes), workers download+extract once per URI
+and reuse the extraction across tasks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import threading
+import zipfile
+
+_KV_NS = "runtime_env_packages"
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+MAX_PACKAGE_BYTES = 512 * 1024 * 1024
+
+
+def _zip_dir(path: str, keep_base_name: bool = False) -> bytes:
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    # py_modules keep their top-level directory name so the extracted tree is
+    # importable as the module; working_dir contents sit at the archive root.
+    prefix = os.path.basename(base) if keep_base_name else ""
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(base):
+            zf.write(base, os.path.basename(base))
+        else:
+            for root, dirs, files in os.walk(base):
+                dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+                for f in files:
+                    full = os.path.join(root, f)
+                    rel = os.path.relpath(full, base)
+                    zf.write(full, os.path.join(prefix, rel) if prefix else rel)
+    data = buf.getvalue()
+    if len(data) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"packaged {path!r} is {len(data)} bytes, over the "
+            f"{MAX_PACKAGE_BYTES} limit")
+    return data
+
+
+# Driver-side memo: (abspath, keep_base, tree signature) -> uri. Repeat
+# submissions with an unchanged tree skip the zip+hash entirely; a stat walk
+# detects changes (reference: packaging caches by content hash per env).
+_upload_memo: dict[tuple, str] = {}
+_memo_lock = threading.Lock()
+
+
+def _tree_signature(path: str) -> tuple:
+    base = os.path.abspath(path)
+    if os.path.isfile(base):
+        st = os.stat(base)
+        return ((os.path.basename(base), st.st_size, st.st_mtime_ns),)
+    sig = []
+    for root, dirs, files in os.walk(base):
+        dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            st = os.stat(full)
+            sig.append((os.path.relpath(full, base), st.st_size, st.st_mtime_ns))
+    return tuple(sig)
+
+
+def upload_package(runtime, path: str, keep_base_name: bool = False) -> str:
+    """Zip ``path`` and store it in the cluster KV; returns a ``kv://`` URI.
+    Content-addressed: identical trees share one package."""
+    memo_key = (os.path.abspath(path), keep_base_name, _tree_signature(path))
+    with _memo_lock:
+        cached = _upload_memo.get(memo_key)
+    if cached is not None:
+        return cached
+    data = _zip_dir(path, keep_base_name=keep_base_name)
+    digest = hashlib.sha256(data).hexdigest()[:32]
+    uri = f"kv://{digest}"
+    # Existence probe via key listing (kv_get would pull the whole blob back).
+    if digest not in runtime.kv_keys(prefix=digest, ns=_KV_NS):
+        runtime.kv_put(digest, data, ns=_KV_NS)
+    with _memo_lock:
+        _upload_memo[memo_key] = uri
+    return uri
+
+
+def upload_runtime_env(runtime, env: dict) -> dict:
+    """Driver-side: replace local paths in the env with packaged URIs
+    (no-op for entries already packaged)."""
+    out = dict(env)
+    wd = out.get("working_dir")
+    if wd and not wd.startswith("kv://"):
+        out["working_dir"] = upload_package(runtime, wd)
+    mods = out.get("py_modules")
+    if mods:
+        out["py_modules"] = [
+            m if m.startswith("kv://")
+            else upload_package(runtime, m, keep_base_name=True)
+            for m in mods
+        ]
+    return out
+
+
+class UriCache:
+    """Per-process extract cache: one extraction per URI (reference:
+    uri_cache.py — per-node cache keyed by URI)."""
+
+    def __init__(self, cache_dir: str | None = None):
+        from ray_tpu.utils.config import get_config
+
+        # Node-shared cache dir: every worker process on the node reuses one
+        # extraction per digest (the digest names the directory, so a
+        # completed extraction is valid for any process).
+        self._dir = cache_dir or os.path.join(
+            get_config().temp_dir, "runtime_env", "pkgs")
+        self._lock = threading.Lock()
+        self._extracted: dict[str, str] = {}
+
+    def get_or_extract(self, runtime, uri: str) -> str:
+        """Returns the extracted directory for a kv:// URI."""
+        with self._lock:
+            cached = self._extracted.get(uri)
+            if cached is not None:
+                return cached
+        digest = uri.removeprefix("kv://")
+        target = os.path.join(self._dir, digest)
+        if not os.path.isdir(target):
+            data = runtime.kv_get(digest, ns=_KV_NS)
+            if data is None:
+                raise FileNotFoundError(f"runtime_env package {uri} not in cluster KV")
+            # Per-process tmp name: concurrent extractors of the same digest
+            # (different workers) must not write into each other's tree.
+            tmp = f"{target}.tmp.{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.rename(tmp, target)
+            except OSError:
+                # Raced with another extractor of the same digest: theirs won.
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        with self._lock:
+            self._extracted[uri] = target
+        return target

@@ -1,0 +1,39 @@
+"""Runtime-env plugin API.
+
+Capability parity with the reference's plugin system (reference:
+python/ray/_private/runtime_env/plugin.py RuntimeEnvPlugin — named plugins
+with validate/create/modify_context hooks, discovered per field name): a
+plugin owns one runtime_env field; ``setup`` runs on the worker before the
+first task of that env executes and returns an undo callable (or None).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class RuntimeEnvPlugin:
+    """Subclass and register to handle a custom runtime_env field."""
+
+    name: str = ""
+    priority: int = 10  # lower runs earlier
+
+    def validate(self, value) -> None:  # raise on bad config
+        pass
+
+    def setup(self, value, runtime) -> Callable[[], None] | None:
+        """Apply the field on this worker; optionally return a teardown."""
+        raise NotImplementedError
+
+
+_plugins: dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin must set a field name")
+    _plugins[plugin.name] = plugin
+
+
+def get_plugins() -> dict[str, RuntimeEnvPlugin]:
+    return dict(_plugins)

@@ -1,0 +1,80 @@
+"""RuntimeEnv: the per-task/per-actor environment description.
+
+Capability parity with the reference's RuntimeEnv (reference:
+python/ray/runtime_env/runtime_env.py RuntimeEnv class; fields handled by
+plugins in python/ray/_private/runtime_env/ — working_dir.py, py_modules.py,
+pip.py/conda.py/uv.py, env-var injection): a validated dict of environment
+requirements carried on every TaskSpec/ActorCreationSpec. Workers are reused
+only for matching envs (the env hash is part of the scheduling key —
+reference: worker_pool.h PopWorkerRequest runtime-env hash matching).
+
+This build supports ``env_vars``, ``working_dir``, ``py_modules``, and
+``config``; package-installer fields (``pip``/``conda``/``uv``) are validated
+but rejected at setup time — the execution image is immutable (no network
+installs), matching how hermetic TPU pods deploy code via packaged URIs
+instead of per-task installs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+_KNOWN_FIELDS = {
+    "env_vars", "working_dir", "py_modules", "pip", "conda", "uv", "config",
+}
+
+
+class RuntimeEnv(dict):
+    """Dict-like, validated runtime environment."""
+
+    def __init__(self, *, env_vars: dict[str, str] | None = None,
+                 working_dir: str | None = None,
+                 py_modules: list[str] | None = None,
+                 pip: Any = None, conda: Any = None, uv: Any = None,
+                 config: dict | None = None, **extra):
+        super().__init__()
+        from ray_tpu.runtime_env.plugin import get_plugins
+
+        plugin_fields = set(get_plugins())
+        unknown = set(extra) - _KNOWN_FIELDS - plugin_fields
+        if unknown:
+            raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
+        for k in set(extra) & plugin_fields:
+            self[k] = extra[k]  # plugin-owned; its validate() runs at setup
+        if env_vars is not None:
+            if not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env_vars.items()):
+                raise TypeError("env_vars must be a dict[str, str]")
+            self["env_vars"] = dict(env_vars)
+        if working_dir is not None:
+            if not isinstance(working_dir, str):
+                raise TypeError("working_dir must be a path or packaged URI string")
+            if not working_dir.startswith("kv://") and not os.path.isdir(working_dir):
+                raise ValueError(f"working_dir {working_dir!r} is not a directory")
+            self["working_dir"] = working_dir
+        if py_modules is not None:
+            if not isinstance(py_modules, (list, tuple)):
+                raise TypeError("py_modules must be a list of paths/URIs")
+            for m in py_modules:
+                if not isinstance(m, str):
+                    raise TypeError("py_modules entries must be strings")
+                if not m.startswith("kv://") and not os.path.exists(m):
+                    raise ValueError(f"py_module {m!r} does not exist")
+            self["py_modules"] = list(py_modules)
+        for name, val in (("pip", pip), ("conda", conda), ("uv", uv)):
+            if val is not None:
+                self[name] = val  # validated here, rejected at setup
+        if config is not None:
+            self["config"] = dict(config)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "RuntimeEnv":
+        return cls(**(d or {}))
+
+    def to_dict(self) -> dict:
+        return dict(self)
+
+    def has_uris(self) -> bool:
+        return bool(self.get("working_dir") or self.get("py_modules"))

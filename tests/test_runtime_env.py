@@ -1,0 +1,193 @@
+"""runtime_env: env_vars, working_dir/py_modules packaging, plugins.
+
+Mirrors the reference's runtime-env test surface (reference:
+python/ray/tests/test_runtime_env*.py — env-var injection, working_dir
+packaging round-trip, plugin hooks), against the in-process and cluster
+runtimes.
+"""
+
+import os
+import sys
+
+import pytest
+
+from ray_tpu.runtime_env import RuntimeEnv
+from ray_tpu.runtime_env.packaging import upload_package, upload_runtime_env
+
+
+class TestRuntimeEnvType:
+    def test_validation(self, tmp_path):
+        env = RuntimeEnv(env_vars={"A": "1"}, working_dir=str(tmp_path))
+        assert env["env_vars"] == {"A": "1"}
+        with pytest.raises(TypeError):
+            RuntimeEnv(env_vars={"A": 1})
+        with pytest.raises(ValueError):
+            RuntimeEnv(working_dir="/nonexistent/dir")
+        with pytest.raises(ValueError):
+            RuntimeEnv(bogus_field=1)
+        with pytest.raises(ValueError):
+            RuntimeEnv(py_modules=["/nonexistent/mod"])
+
+    def test_from_to_dict(self):
+        env = RuntimeEnv.from_dict({"env_vars": {"X": "y"}})
+        assert env.to_dict() == {"env_vars": {"X": "y"}}
+        assert not env.has_uris()
+
+
+class TestPackaging:
+    def test_upload_and_extract_roundtrip(self, rt_start, tmp_path):
+        rt = rt_start
+        pkg = tmp_path / "proj"
+        pkg.mkdir()
+        (pkg / "mymod.py").write_text("MAGIC = 'xyz123'\n")
+        (pkg / "sub").mkdir()
+        (pkg / "sub" / "data.txt").write_text("hello")
+        from ray_tpu.core.worker import global_worker
+
+        uri = upload_package(global_worker.runtime, str(pkg))
+        assert uri.startswith("kv://")
+        # content-addressed: same tree, same URI
+        assert upload_package(global_worker.runtime, str(pkg)) == uri
+
+        from ray_tpu.runtime_env.packaging import UriCache
+
+        cache = UriCache(str(tmp_path / "cache"))
+        path = cache.get_or_extract(global_worker.runtime, uri)
+        assert open(os.path.join(path, "sub", "data.txt")).read() == "hello"
+        # cached: same dir back
+        assert cache.get_or_extract(global_worker.runtime, uri) == path
+
+    def test_upload_runtime_env_rewrites_paths(self, rt_start, tmp_path):
+        from ray_tpu.core.worker import global_worker
+
+        pkg = tmp_path / "wd"
+        pkg.mkdir()
+        (pkg / "f.txt").write_text("x")
+        env = upload_runtime_env(global_worker.runtime,
+                                 {"working_dir": str(pkg), "env_vars": {"A": "1"}})
+        assert env["working_dir"].startswith("kv://")
+        assert env["env_vars"] == {"A": "1"}
+
+
+class TestExecution:
+    def test_env_vars_injected(self, rt_start):
+        rt = rt_start
+
+        @rt.remote(runtime_env={"env_vars": {"RTPU_TEST_VAR": "hello42"}})
+        def read_env():
+            return os.environ.get("RTPU_TEST_VAR")
+
+        assert rt.get(read_env.remote()) == "hello42"
+
+    def test_py_modules_importable(self, rt_start, tmp_path):
+        rt = rt_start
+        mod_dir = tmp_path / "pymods" / "coolmod"
+        mod_dir.mkdir(parents=True)
+        (mod_dir / "__init__.py").write_text("VALUE = 777\n")
+
+        @rt.remote(runtime_env={"py_modules": [str(mod_dir)]})
+        def use_mod():
+            import coolmod
+
+            return coolmod.VALUE
+
+        try:
+            assert rt.get(use_mod.remote()) == 777
+        finally:
+            sys.modules.pop("coolmod", None)
+
+    def test_pip_rejected(self, rt_start):
+        rt = rt_start
+
+        @rt.remote(runtime_env={"pip": ["requests"]}, max_retries=0)
+        def nope():
+            return 1
+
+        with pytest.raises(Exception, match="immutable"):
+            rt.get(nope.remote())
+
+    def test_actor_runtime_env(self, rt_start):
+        rt = rt_start
+
+        @rt.remote(runtime_env={"env_vars": {"RTPU_ACTOR_VAR": "actorval"}})
+        class A:
+            def read(self):
+                return os.environ.get("RTPU_ACTOR_VAR")
+
+        a = A.remote()
+        assert rt.get(a.read.remote()) == "actorval"
+
+
+class TestPlugins:
+    def test_custom_plugin(self, rt_start):
+        rt = rt_start
+        from ray_tpu.runtime_env.plugin import RuntimeEnvPlugin, register_plugin
+
+        seen = {}
+
+        class MyPlugin(RuntimeEnvPlugin):
+            name = "config"
+
+            def setup(self, value, runtime):
+                seen.update(value)
+
+        register_plugin(MyPlugin())
+
+        @rt.remote(runtime_env={"config": {"knob": "v"}})
+        def f():
+            return 1
+
+        assert rt.get(f.remote()) == 1
+        assert seen == {"knob": "v"}
+
+
+class TestClusterRuntimeEnv:
+    def test_working_dir_ships_to_worker(self, tmp_path):
+        """The packaged working_dir must be importable in a separate worker
+        process (real shipping, not same-process sys.path)."""
+        import ray_tpu
+
+        pkg = tmp_path / "shipme"
+        pkg.mkdir()
+        (pkg / "shipped_module.py").write_text("TOKEN = 'shipped-ok'\n")
+        ray_tpu.shutdown()
+        ray_tpu.init(address="local-cluster", num_cpus=2)
+        try:
+            @ray_tpu.remote(runtime_env={"working_dir": str(pkg)})
+            def load():
+                import shipped_module
+
+                return shipped_module.TOKEN
+
+            assert ray_tpu.get(load.remote()) == "shipped-ok"
+
+            # env_vars ride the scheduling key (regression: nested dicts made
+            # the key unhashable, breaking every cluster task with env_vars)
+            @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_CL_VAR": "clv"}})
+            def read_var():
+                return os.environ.get("RTPU_CL_VAR")
+
+            assert ray_tpu.get(read_var.remote()) == "clv"
+
+            # Worker isolation: a no-env task must not see the env'd worker's
+            # variables (the pool brands workers by env hash).
+            @ray_tpu.remote
+            def clean_env():
+                return os.environ.get("RTPU_CL_VAR")
+
+            assert ray_tpu.get(clean_env.remote()) is None
+        finally:
+            ray_tpu.shutdown()
+
+    def test_plugin_field_accepted_in_validation(self):
+        from ray_tpu.runtime_env.plugin import RuntimeEnvPlugin, register_plugin
+
+        class ImgPlugin(RuntimeEnvPlugin):
+            name = "image_uri_test"
+
+            def setup(self, value, runtime):
+                pass
+
+        register_plugin(ImgPlugin())
+        env = RuntimeEnv.from_dict({"image_uri_test": "img://x"})
+        assert env["image_uri_test"] == "img://x"
