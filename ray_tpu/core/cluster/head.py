@@ -18,7 +18,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-from ray_tpu.core.cluster.protocol import RpcServer, ServerConnection
+from ray_tpu.core.cluster.protocol import RpcServer, ServerConnection, spawn_task
 from ray_tpu.utils.config import get_config
 
 
@@ -368,7 +368,7 @@ class HeadServer:
             try:
                 close = cli.close()
                 if asyncio.iscoroutine(close):
-                    asyncio.get_running_loop().create_task(close)
+                    spawn_task(close)
             except Exception:
                 pass
 
@@ -421,7 +421,7 @@ class HeadServer:
         self.pgs[pg_id] = {"state": "PENDING", "bundles": bundles,
                            "strategy": strategy, "assignment": None,
                            "name": name}
-        asyncio.get_running_loop().create_task(self._schedule_pg(pg_id))
+        spawn_task(self._schedule_pg(pg_id))
         return {"ok": True}
 
     async def _schedule_pg(self, pg_id: str, retries: int = 120):

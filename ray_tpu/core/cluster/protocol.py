@@ -24,6 +24,20 @@ import msgpack
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
 
+# The event loop holds only weak references to tasks: a fire-and-forget
+# create_task with no strong reference can be garbage-collected mid-await
+# (observed as GeneratorExit in long-running handlers). Every detached task
+# must be pinned here until done.
+_pinned_tasks: set = set()
+
+
+def spawn_task(coro) -> asyncio.Task:
+    """create_task + strong reference until completion."""
+    task = asyncio.get_running_loop().create_task(coro)
+    _pinned_tasks.add(task)
+    task.add_done_callback(_pinned_tasks.discard)
+    return task
+
 
 def _pack(msg: dict) -> bytes:
     body = msgpack.packb(msg, use_bin_type=True)
@@ -123,7 +137,7 @@ class ServerConnection:
             msg = await _read_frame(self.reader)
             if msg is None:
                 return
-            asyncio.get_running_loop().create_task(self._dispatch(msg))
+            spawn_task(self._dispatch(msg))
 
     async def _dispatch(self, msg: dict):
         method, rid = msg.get("m"), msg.get("i")
@@ -177,7 +191,7 @@ class AsyncRpcClient:
         if sock is not None:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = asyncio.Lock()
-        asyncio.get_running_loop().create_task(self._read_loop())
+        spawn_task(self._read_loop())
 
     async def _read_loop(self):
         while True:
@@ -195,7 +209,7 @@ class AsyncRpcClient:
             elif "m" in msg:
                 fn = self._notify_handlers.get(msg["m"])
                 if fn is not None:
-                    asyncio.get_running_loop().create_task(fn(**msg.get("a", {})))
+                    spawn_task(fn(**msg.get("a", {})))
 
     def _fail_all(self, exc: Exception):
         self._closed = True

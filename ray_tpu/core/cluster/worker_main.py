@@ -288,6 +288,12 @@ def _parent_watchdog():
 
 
 def main():
+    # SIGUSR1 dumps all thread stacks to the worker log — the first tool to
+    # reach for when a worker wedges (reference: ray stack / py-spy dump).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     _parent_watchdog()
     wp = WorkerProcess()
     wp.serve_forever()

@@ -1,0 +1,4 @@
+from ray_tpu.job_submission.job_manager import JobManager, JobStatus
+from ray_tpu.job_submission.sdk import JobSubmissionClient
+
+__all__ = ["JobManager", "JobStatus", "JobSubmissionClient"]

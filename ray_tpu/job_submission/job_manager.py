@@ -1,0 +1,270 @@
+"""Job submission: run driver scripts on the cluster with status/log tracking.
+
+Capability parity with the reference's job layer (reference:
+python/ray/dashboard/modules/job/ — job_manager.py:62 JobManager spawns one
+JobSupervisor actor per job (job_supervisor.py) which execs the entrypoint as
+a subprocess with the job's runtime_env; status transitions
+PENDING→RUNNING→{SUCCEEDED|FAILED|STOPPED} persisted in GCS KV; logs captured
+per job): the supervisor actor here holds the child process, streams its
+output into an in-actor buffer, and mirrors status into the cluster KV so any
+client (HTTP or SDK) can query it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+_KV_NS = "jobs"
+
+
+def _supervisor_class():
+    """Defined lazily so the decorated class binds to the active runtime."""
+    import ray_tpu
+
+    # max_concurrency: run() blocks for the job's lifetime; stop()/logs()
+    # must interleave (reference: the supervisor serves status RPCs while
+    # the entrypoint runs).
+    @ray_tpu.remote(num_cpus=0, max_concurrency=4)
+    class JobSupervisor:
+        """One per job; owns the entrypoint subprocess (reference:
+        job_supervisor.py JobSupervisor actor)."""
+
+        def __init__(self, submission_id: str, entrypoint: str,
+                     env_vars: dict | None):
+            self._id = submission_id
+            self._entrypoint = entrypoint
+            self._env_vars = env_vars or {}
+            self._proc = None
+            self._output: list[bytes] = []
+            self._stopped = False
+
+        def run(self) -> str:
+            import os
+            import subprocess
+            import threading
+
+            from ray_tpu.core.worker import global_worker
+
+            rt = global_worker.runtime
+            if self._stopped:  # stop_job arrived while the run task was queued
+                _set_job_info(rt, self._id, status=JobStatus.STOPPED,
+                              end_time=time.time())
+                return JobStatus.STOPPED
+            _set_job_info(rt, self._id, status=JobStatus.RUNNING,
+                          start_time=time.time())
+            try:
+                env = dict(os.environ)
+                env.update(self._env_vars)
+                self._proc = subprocess.Popen(
+                    self._entrypoint, shell=True, env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                )
+
+                def pump():
+                    for line in self._proc.stdout:
+                        self._output.append(line)
+
+                t = threading.Thread(target=pump, daemon=True)
+                t.start()
+                rc = self._proc.wait()
+                t.join(timeout=5)
+            except BaseException as e:  # noqa: BLE001
+                # run.remote() is fire-and-forget: the error must land in the
+                # job record, not in an unread object ref.
+                _set_job_info(rt, self._id, status=JobStatus.FAILED,
+                              end_time=time.time(), error=repr(e))
+                raise
+            if self._stopped:
+                status = JobStatus.STOPPED
+            elif rc == 0:
+                status = JobStatus.SUCCEEDED
+            else:
+                status = JobStatus.FAILED
+            _set_job_info(rt, self._id, status=status,
+                          end_time=time.time(), returncode=rc)
+            return status
+
+        def stop(self) -> bool:
+            if self._proc is None:
+                # Not started yet: flag it so run() terminates immediately.
+                self._stopped = True
+                return True
+            if self._proc.poll() is None:
+                self._stopped = True
+                self._proc.terminate()
+                return True
+            return False  # already finished; don't rewrite history
+
+        def logs(self) -> str:
+            return b"".join(self._output).decode(errors="replace")
+
+        def ping(self) -> bool:
+            return True
+
+    return JobSupervisor
+
+
+def _set_job_info(runtime, sid: str, **updates):
+    key = sid
+    raw = runtime.kv_get(key, ns=_KV_NS)
+    info = json.loads(raw.decode()) if raw else {}
+    info.update(updates)
+    runtime.kv_put(key, json.dumps(info).encode(), ns=_KV_NS)
+
+
+class JobManager:
+    """Submission-side API; state in the cluster KV + one supervisor actor
+    per job (reference: job_manager.py JobManager)."""
+
+    def __init__(self):
+        import ray_tpu
+
+        ray_tpu.init(ignore_reinit_error=True)
+        self._supervisors: dict[str, object] = {}
+
+    def _runtime(self):
+        from ray_tpu.core.worker import global_worker
+
+        return global_worker.runtime
+
+    # ---------------------------------------------------------------- submit
+    def submit_job(self, *, entrypoint: str, submission_id: str | None = None,
+                   runtime_env: dict | None = None,
+                   metadata: dict | None = None) -> str:
+        import ray_tpu
+
+        submission_id = submission_id or f"job-{uuid.uuid4().hex[:12]}"
+        if self._runtime().kv_get(submission_id, ns=_KV_NS) is not None:
+            raise ValueError(f"job {submission_id!r} already exists")
+        env_vars = dict((runtime_env or {}).get("env_vars") or {})
+        _set_job_info(self._runtime(), submission_id,
+                      submission_id=submission_id, entrypoint=entrypoint,
+                      status=JobStatus.PENDING, metadata=metadata or {},
+                      submit_time=time.time())
+        supervisor_cls = _supervisor_class()
+        options = {"name": f"_job_supervisor_{submission_id}"}
+        if runtime_env:
+            # working_dir/py_modules apply to the supervisor (and thus the
+            # child's cwd); env_vars are passed to the child process directly.
+            renv = {k: v for k, v in runtime_env.items() if k != "env_vars"}
+            if renv:
+                options["runtime_env"] = renv
+        try:
+            sup = supervisor_cls.options(**options).remote(
+                submission_id, entrypoint, env_vars)
+        except BaseException:
+            # Never leave an unsupervised PENDING record behind.
+            self._runtime().kv_del(submission_id, ns=_KV_NS)
+            raise
+        sup.run.remote()  # fire and forget; status lands in KV
+        self._supervisors[submission_id] = sup
+        return submission_id
+
+    # ---------------------------------------------------------------- queries
+    def get_job_info(self, submission_id: str) -> dict:
+        raw = self._runtime().kv_get(submission_id, ns=_KV_NS)
+        if raw is None:
+            raise ValueError(f"no such job {submission_id!r}")
+        return json.loads(raw.decode())
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id)["status"]
+
+    def list_jobs(self) -> list[dict]:
+        rt = self._runtime()
+        out = []
+        for key in rt.kv_keys(ns=_KV_NS):
+            raw = rt.kv_get(key, ns=_KV_NS)
+            if raw:
+                out.append(json.loads(raw.decode()))
+        return sorted(out, key=lambda j: j.get("submit_time", 0.0))
+
+    def get_job_logs(self, submission_id: str) -> str:
+        import ray_tpu
+
+        sup = self._supervisor(submission_id)
+        if sup is None:
+            return ""
+        return ray_tpu.get(sup.logs.remote())
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+
+        self.get_job_info(submission_id)  # raises on unknown id
+        sup = self._supervisor(submission_id)
+        if sup is None:
+            return False
+        return ray_tpu.get(sup.stop.remote())
+
+    def delete_job(self, submission_id: str) -> bool:
+        import ray_tpu
+
+        info = self.get_job_info(submission_id)
+        if info["status"] not in JobStatus.TERMINAL:
+            raise RuntimeError(
+                f"job {submission_id!r} is {info['status']}; stop it first")
+        sup = self._supervisor(submission_id)
+        if sup is not None:
+            # Free the actor (and its log buffer) and release the name so the
+            # submission id can be reused.
+            ray_tpu.kill(sup)
+        self._runtime().kv_del(submission_id, ns=_KV_NS)
+        self._supervisors.pop(submission_id, None)
+        return True
+
+    def _supervisor(self, submission_id: str):
+        import ray_tpu
+
+        sup = self._supervisors.get(submission_id)
+        if sup is not None:
+            return sup
+        try:
+            return ray_tpu.get_actor(f"_job_supervisor_{submission_id}")
+        except ValueError:
+            return None
+
+    # ---------------------------------------------------------------- HTTP
+    def attach_http(self, dashboard) -> None:
+        """Register the job REST surface on a DashboardServer (reference:
+        job REST API in dashboard/modules/job/job_head.py)."""
+
+        def submit(params, body):
+            req = json.loads(body.decode() or "{}")
+            sid = self.submit_job(
+                entrypoint=req["entrypoint"],
+                submission_id=req.get("submission_id"),
+                runtime_env=req.get("runtime_env"),
+                metadata=req.get("metadata"),
+            )
+            return {"submission_id": sid}
+
+        dashboard.add_route("POST", "/api/jobs/submit", submit)
+        dashboard.add_route("GET", "/api/jobs/list",
+                            lambda p, b: self.list_jobs())
+        dashboard.add_route(
+            "GET", "/api/jobs/status",
+            lambda p, b: self.get_job_info(p["submission_id"]))
+        dashboard.add_route(
+            "GET", "/api/jobs/logs",
+            lambda p, b: {"logs": self.get_job_logs(p["submission_id"])})
+        dashboard.add_route(
+            "POST", "/api/jobs/stop",
+            lambda p, b: {"stopped": self.stop_job(
+                json.loads(b.decode())["submission_id"])})
+        dashboard.add_route(
+            "POST", "/api/jobs/delete",
+            lambda p, b: {"deleted": self.delete_job(
+                json.loads(b.decode())["submission_id"])})

@@ -1,0 +1,79 @@
+"""Job submission SDK: HTTP client for the dashboard's job REST surface.
+
+Capability parity with the reference's JobSubmissionClient (reference:
+python/ray/dashboard/modules/job/sdk.py:36 JobSubmissionClient —
+submit_job/get_job_status/get_job_logs/stop_job/delete_job/list_jobs over the
+dashboard REST API).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """``address`` is the dashboard HTTP address, e.g. ``http://host:port``."""
+        self._base = address.rstrip("/")
+        if not self._base.startswith("http"):
+            self._base = f"http://{self._base}"
+
+    def _get(self, path: str) -> dict | list:
+        with urllib.request.urlopen(f"{self._base}{path}", timeout=30) as r:
+            return json.loads(r.read())
+
+    def _post(self, path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self._base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    def submit_job(self, *, entrypoint: str, submission_id: str | None = None,
+                   runtime_env: dict | None = None,
+                   metadata: dict | None = None) -> str:
+        payload = {"entrypoint": entrypoint}
+        if submission_id:
+            payload["submission_id"] = submission_id
+        if runtime_env:
+            payload["runtime_env"] = runtime_env
+        if metadata:
+            payload["metadata"] = metadata
+        return self._post("/api/jobs/submit", payload)["submission_id"]
+
+    def get_job_info(self, submission_id: str) -> dict:
+        sid = urllib.parse.quote(submission_id, safe="")
+        return self._get(f"/api/jobs/status?submission_id={sid}")
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id)["status"]
+
+    def get_job_logs(self, submission_id: str) -> str:
+        sid = urllib.parse.quote(submission_id, safe="")
+        return self._get(f"/api/jobs/logs?submission_id={sid}")["logs"]
+
+    def list_jobs(self) -> list[dict]:
+        return self._get("/api/jobs/list")
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._post("/api/jobs/stop",
+                          {"submission_id": submission_id})["stopped"]
+
+    def delete_job(self, submission_id: str) -> bool:
+        return self._post("/api/jobs/delete",
+                          {"submission_id": submission_id})["deleted"]
+
+    def wait_until_status(self, submission_id: str, statuses,
+                          timeout: float = 60.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in statuses:
+                return status
+            time.sleep(0.25)
+        raise TimeoutError(
+            f"job {submission_id} not in {statuses} within {timeout}s "
+            f"(last: {status})")

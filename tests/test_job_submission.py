@@ -1,0 +1,112 @@
+"""Job submission: manager, supervisor actor, REST + SDK round-trip.
+
+Mirrors the reference's job tests (reference:
+python/ray/dashboard/modules/job/tests/test_job_manager.py — submit/status
+transitions, logs, stop, failed entrypoints).
+"""
+
+import sys
+import time
+
+import pytest
+
+from ray_tpu.job_submission import JobManager, JobStatus, JobSubmissionClient
+
+
+def _wait_status(mgr, sid, statuses, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = mgr.get_job_status(sid)
+        if st in statuses:
+            return st
+        time.sleep(0.1)
+    raise AssertionError(f"job {sid} stuck in {mgr.get_job_status(sid)}")
+
+
+class TestJobManager:
+    def test_successful_job(self, rt_start):
+        mgr = JobManager()
+        sid = mgr.submit_job(
+            entrypoint=f"{sys.executable} -c \"print('job says hi')\"")
+        assert _wait_status(mgr, sid, JobStatus.TERMINAL) == JobStatus.SUCCEEDED
+        assert "job says hi" in mgr.get_job_logs(sid)
+        info = mgr.get_job_info(sid)
+        assert info["returncode"] == 0
+        assert info["entrypoint"].endswith("\"print('job says hi')\"")
+
+    def test_failed_job(self, rt_start):
+        mgr = JobManager()
+        sid = mgr.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+        assert _wait_status(mgr, sid, JobStatus.TERMINAL) == JobStatus.FAILED
+        assert mgr.get_job_info(sid)["returncode"] == 3
+
+    def test_stop_job(self, rt_start):
+        mgr = JobManager()
+        sid = mgr.submit_job(
+            entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+        _wait_status(mgr, sid, (JobStatus.RUNNING,))
+        assert mgr.stop_job(sid)
+        assert _wait_status(mgr, sid, JobStatus.TERMINAL) == JobStatus.STOPPED
+
+    def test_env_vars_and_metadata(self, rt_start):
+        mgr = JobManager()
+        sid = mgr.submit_job(
+            entrypoint=(f"{sys.executable} -c "
+                        "\"import os; print('VAR=' + os.environ['JOBVAR'])\""),
+            runtime_env={"env_vars": {"JOBVAR": "zzz"}},
+            metadata={"owner": "tests"},
+        )
+        assert _wait_status(mgr, sid, JobStatus.TERMINAL) == JobStatus.SUCCEEDED
+        assert "VAR=zzz" in mgr.get_job_logs(sid)
+        assert mgr.get_job_info(sid)["metadata"] == {"owner": "tests"}
+
+    def test_duplicate_id_rejected(self, rt_start):
+        mgr = JobManager()
+        sid = mgr.submit_job(entrypoint="true", submission_id="dup-1")
+        with pytest.raises(ValueError):
+            mgr.submit_job(entrypoint="true", submission_id="dup-1")
+        _wait_status(mgr, sid, JobStatus.TERMINAL)
+
+    def test_delete_requires_terminal(self, rt_start):
+        mgr = JobManager()
+        sid = mgr.submit_job(
+            entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+        _wait_status(mgr, sid, (JobStatus.RUNNING,))
+        with pytest.raises(RuntimeError):
+            mgr.delete_job(sid)
+        mgr.stop_job(sid)
+        _wait_status(mgr, sid, JobStatus.TERMINAL)
+        assert mgr.delete_job(sid)
+        with pytest.raises(ValueError):
+            mgr.get_job_info(sid)
+
+    def test_list_jobs(self, rt_start):
+        mgr = JobManager()
+        a = mgr.submit_job(entrypoint="true")
+        b = mgr.submit_job(entrypoint="true")
+        ids = {j["submission_id"] for j in mgr.list_jobs()}
+        assert {a, b} <= ids
+        for sid in (a, b):
+            _wait_status(mgr, sid, JobStatus.TERMINAL)
+
+
+class TestJobRestAndSdk:
+    def test_sdk_roundtrip(self, rt_start):
+        from ray_tpu.dashboard.http_server import DashboardServer
+
+        srv = DashboardServer()
+        host, port = srv.start()
+        try:
+            mgr = JobManager()
+            mgr.attach_http(srv)
+            client = JobSubmissionClient(f"http://{host}:{port}")
+            sid = client.submit_job(
+                entrypoint=f"{sys.executable} -c \"print('via sdk')\"",
+                metadata={"via": "sdk"})
+            assert client.wait_until_status(
+                sid, JobStatus.TERMINAL, timeout=30) == JobStatus.SUCCEEDED
+            assert "via sdk" in client.get_job_logs(sid)
+            assert any(j["submission_id"] == sid for j in client.list_jobs())
+            assert client.delete_job(sid)
+        finally:
+            srv.stop()
