@@ -49,6 +49,13 @@ class ActorMethod:
             self._method_name, args, kwargs, num_returns=self._num_returns
         )
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node for this method call (reference: python/ray/dag/ —
+        actor.method.bind builds a ClassMethodNode instead of executing)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor method {self._method_name!r} cannot be called directly; use .remote()"
@@ -97,6 +104,12 @@ class ActorHandle:
         )
         refs = worker.runtime.submit_actor_task(spec)
         return refs[0] if num_returns == 1 else refs
+
+    def _call_fn(self, fn, *args, num_returns: int = 1):
+        """Run ``fn(actor_instance, *args)`` inside the actor (internal;
+        reference: __ray_call__). Used to install compiled-graph loops."""
+        return self._submit_method("__rtpu_call_fn__", (fn, *args), {},
+                                   num_returns=num_returns)
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._method_names))

@@ -213,12 +213,23 @@ class WorkerProcess:
             spec, reply_fut, loop = item
             method = getattr(type(self._actor_instance), spec.method_name, None)
             is_async = inspect.iscoroutinefunction(method)
-            if is_async or self._actor_pool is not None:
-                runner = (
-                    self._actor_pool.submit if self._actor_pool is not None
-                    else lambda f: threading.Thread(target=f, daemon=True).start()
-                )
-                runner(lambda: self._run_actor_method(spec, reply_fut, loop))
+            # args= binds eagerly — a lambda would capture the loop variables
+            # by reference and race with the next mailbox item.
+            if spec.method_name == "__rtpu_call_fn__":
+                # Injected functions may be long-running compiled-graph loops;
+                # a dedicated thread keeps both the consumer and the
+                # concurrency pool free.
+                threading.Thread(target=self._run_actor_method,
+                                 args=(spec, reply_fut, loop),
+                                 daemon=True).start()
+            elif is_async or self._actor_pool is not None:
+                if self._actor_pool is not None:
+                    self._actor_pool.submit(
+                        self._run_actor_method, spec, reply_fut, loop)
+                else:
+                    threading.Thread(target=self._run_actor_method,
+                                     args=(spec, reply_fut, loop),
+                                     daemon=True).start()
             else:
                 self._run_actor_method(spec, reply_fut, loop)
 
@@ -228,9 +239,17 @@ class WorkerProcess:
 
         return_ids = spec.return_ids()
         try:
-            method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = serialization.deserialize(spec.args_blob)
             args, kwargs = self._resolve(args), self._resolve(kwargs)
+            if spec.method_name == "__rtpu_call_fn__":
+                # Internal hook: fn(instance, *args) in actor context
+                # (reference: __ray_call__; compiled-graph loop installer).
+                import functools
+
+                method = functools.partial(args[0], self._actor_instance)
+                args = args[1:]
+            else:
+                method = getattr(self._actor_instance, spec.method_name)
             set_task_context(spec.task_id, spec.actor_id, spec.resources)
             try:
                 with task_execution(spec, self.runtime.worker_id.hex(),

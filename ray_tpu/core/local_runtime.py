@@ -402,8 +402,17 @@ class LocalRuntime:
 
             try:
                 set_task_context(spec.task_id, state.spec.actor_id, state.spec.resources)
-                method = getattr(state.instance, spec.method_name)
                 args, kwargs = self._resolve_args(spec)
+                if spec.method_name == "__rtpu_call_fn__":
+                    # Internal hook: run fn(instance, *args) in actor context
+                    # (reference: __ray_call__ — used by compiled graphs to
+                    # install per-actor execution loops).
+                    import functools
+
+                    method = functools.partial(args[0], state.instance)
+                    args = args[1:]
+                else:
+                    method = getattr(state.instance, spec.method_name)
                 with task_execution(spec, self.worker_id.hex()):
                     if inspect.iscoroutinefunction(method):
                         fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), state.loop)
@@ -423,6 +432,10 @@ class LocalRuntime:
         ):
             # Async actor methods interleave on the loop; completion is out of
             # band (reference: async actors via fibers, task_execution/fiber.h).
+            threading.Thread(target=run, daemon=True).start()
+        elif spec.method_name == "__rtpu_call_fn__":
+            # Injected functions may be long-running loops (compiled-graph
+            # schedules); never let them wedge the ordered mailbox.
             threading.Thread(target=run, daemon=True).start()
         elif state.pool is not None:
             state.pool.submit(run)

@@ -1,0 +1,21 @@
+from ray_tpu.dag.communicator import (
+    Communicator,
+    get_accelerator_communicator,
+    register_accelerator_communicator,
+)
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "DAGNode",
+    "InputNode",
+    "ClassMethodNode",
+    "MultiOutputNode",
+    "Communicator",
+    "register_accelerator_communicator",
+    "get_accelerator_communicator",
+]

@@ -1,0 +1,182 @@
+"""Channels: the zero-RPC-scheduling data plane of compiled graphs.
+
+Capability parity with the reference's channel layer (reference:
+python/ray/experimental/channel/ — shared_memory_channel.py mutable-object
+channels backed by C++ experimental_mutable_object_manager.cc,
+intra_process_channel.py for same-process readers): a channel is a named
+single-writer multi-reader slot carrying one value per execution step.
+
+Two transports:
+- ``LocalChannel``: same-process queues (threaded local runtime). Pickling
+  transfers only the name; deserialization re-attaches to the process-global
+  registry, so actor threads and the driver share one instance.
+- ``StoreChannel``: versioned slots in the cluster KV. Works across any two
+  processes on any nodes; data moves without task scheduling but does pay a
+  KV round-trip (a node-local shared-memory fast path needs placement
+  knowledge the compiler doesn't have yet — reference cross-node channels
+  similarly fall back to raylet-pushed mutable objects,
+  node_manager.cc:748 HandlePushMutableObject).
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from typing import Any
+
+from ray_tpu.utils import serialization
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+_CLOSE = b"__rtpu_channel_closed__"
+
+_local_registry: dict[str, "LocalChannel"] = {}
+
+
+def _lookup_local_channel(name: str) -> "LocalChannel":
+    chan = _local_registry.get(name)
+    if chan is None:
+        raise RuntimeError(f"local channel {name!r} not in this process")
+    return chan
+
+
+class LocalChannel:
+    """Same-process channel: one bounded queue per reader."""
+
+    def __init__(self, name: str, num_readers: int = 1, maxsize: int = 16):
+        self.name = name
+        self._queues = [queue.Queue(maxsize=maxsize) for _ in range(num_readers)]
+        self._closed = False
+        _local_registry[name] = self
+
+    def __reduce__(self):
+        # Same-process identity: actors receive the registry instance, not a
+        # copy (a copied queue would never see the driver's writes).
+        return (_lookup_local_channel, (self.name,))
+
+    def write(self, value: Any) -> None:
+        if self._closed:
+            raise ChannelClosed(self.name)
+        for q in self._queues:
+            q.put(value)
+
+    def read(self, reader_index: int = 0, timeout: float | None = None) -> Any:
+        try:
+            value = self._queues[reader_index].get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(f"channel {self.name}") from None
+        if isinstance(value, bytes) and value == _CLOSE:
+            # Propagate to any other blocked reader of the same queue set.
+            self._queues[reader_index].put(_CLOSE)
+            raise ChannelClosed(self.name)
+        return value
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for q in self._queues:
+            q.put(_CLOSE)
+
+    def connect(self, runtime) -> "LocalChannel":
+        return self
+
+
+class StoreChannel:
+    """Cross-process channel over the cluster KV.
+
+    Single writer; each reader holds a private cursor. Slots are keyed
+    ``(name, seq)``; single-reader channels delete a slot on consumption,
+    multi-reader slots are reclaimed at close() (readers poll with backoff —
+    the reference blocks on a mutable-object futex; polling is the portable
+    equivalent).
+    """
+
+    def __init__(self, name: str, num_readers: int = 1):
+        self.name = name
+        self.num_readers = num_readers
+        self._write_seq = 0
+        self._read_seq = 0
+        self._runtime = None
+
+    # Pickled into actors: only the identity travels; cursors and the runtime
+    # binding are per-process.
+    def __getstate__(self):
+        return {"name": self.name, "num_readers": self.num_readers}
+
+    def __setstate__(self, state):
+        self.name = state["name"]
+        self.num_readers = state["num_readers"]
+        self._write_seq = 0
+        self._read_seq = 0
+        self._runtime = None
+
+    def connect(self, runtime) -> "StoreChannel":
+        if self._runtime is None:
+            self._runtime = runtime
+        return self
+
+    def _key(self, seq: int) -> str:
+        return f"chan/{self.name}/{seq}"
+
+    def _write_raw(self, blob: bytes) -> None:
+        self._runtime.kv_put(self._key(self._write_seq), blob, ns="channels")
+        self._write_seq += 1
+
+    _GC_EVERY = 16  # writer reclaims consumed multi-reader slots this often
+
+    def _cursor_key(self, reader_index: int) -> str:
+        return f"chancur/{self.name}/{reader_index}"
+
+    def read(self, reader_index: int = 0, timeout: float | None = None) -> Any:
+        assert self._runtime is not None, "channel not connected"
+        key = self._key(self._read_seq)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        sleep = 0.0005
+        while True:
+            blob = self._runtime.kv_get(key, ns="channels")
+            if blob is not None:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} seq {self._read_seq}")
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.01)
+        self._read_seq += 1
+        if bytes(blob) == _CLOSE:
+            raise ChannelClosed(self.name)
+        value = serialization.deserialize(blob)
+        if self.num_readers == 1:
+            self._runtime.kv_del(key, ns="channels")
+        else:
+            # Publish this reader's cursor so the writer can GC slots every
+            # reader has passed.
+            self._runtime.kv_put(self._cursor_key(reader_index),
+                                 str(self._read_seq).encode(), ns="channels")
+        return value
+
+    def _gc(self) -> None:
+        cursors = []
+        for i in range(self.num_readers):
+            raw = self._runtime.kv_get(self._cursor_key(i), ns="channels")
+            cursors.append(int(raw) if raw else 0)
+        low = min(cursors)
+        for seq in range(getattr(self, "_gc_floor", 0), low):
+            self._runtime.kv_del(self._key(seq), ns="channels")
+        self._gc_floor = low
+
+    def write(self, value: Any) -> None:
+        assert self._runtime is not None, "channel not connected"
+        blob = serialization.serialize(value)
+        self._runtime.kv_put(self._key(self._write_seq), blob, ns="channels")
+        self._write_seq += 1
+        if self.num_readers > 1 and self._write_seq % self._GC_EVERY == 0:
+            self._gc()
+
+    def close(self) -> None:
+        # Only append the close marker: lagging readers must still drain the
+        # slots before their cursor (they GC themselves / via writer GC).
+        assert self._runtime is not None, "channel not connected"
+        self._write_raw(_CLOSE)

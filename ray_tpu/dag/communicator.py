@@ -1,0 +1,71 @@
+"""Communicator registry: pluggable accelerator transports for DAG nodes.
+
+Capability parity with the reference's pluggable channel accelerators
+(reference: python/ray/experimental/channel/communicator.py:18 Communicator
+ABC; accelerator_context.py:19 / register_accelerator_context :222 — the hook
+a device backend uses to provide p2p/collective transport to compiled graphs;
+the reference registers an NCCL communicator for CUDA).
+
+The TPU-native default is the XLA collective backend: compiled-graph
+collective nodes delegate to ``ray_tpu.collective`` groups, whose TPU path
+lowers to jax.lax collectives over ICI inside shard_map
+(ray_tpu/collective/xla_backend.py) and whose CPU test path uses the host
+backend — same insertion point as the reference's NCCL registration.
+"""
+
+from __future__ import annotations
+
+
+class Communicator:
+    """Transport for collective/p2p ops between the actors of a compiled DAG."""
+
+    name = "base"
+
+    def allreduce(self, group_name: str, value, op: str = "sum"):
+        raise NotImplementedError
+
+    def send(self, group_name: str, value, dst_rank: int):
+        raise NotImplementedError
+
+    def recv(self, group_name: str, src_rank: int, **kwargs):
+        raise NotImplementedError
+
+
+class CollectiveCommunicator(Communicator):
+    """Default: delegates to ray_tpu.collective (XLA on TPU, host otherwise)."""
+
+    name = "collective"
+
+    def allreduce(self, group_name: str, value, op: str = "sum"):
+        from ray_tpu.collective import collective
+
+        return collective.allreduce(value, group_name=group_name, op=op)
+
+    def send(self, group_name: str, value, dst_rank: int):
+        from ray_tpu.collective import collective
+
+        return collective.send(value, dst_rank, group_name=group_name)
+
+    def recv(self, group_name: str, src_rank: int, *, tensor_shape=None,
+             dtype=None):
+        from ray_tpu.collective import collective
+
+        return collective.recv(tensor_shape, dtype, src_rank,
+                               group_name=group_name)
+
+
+_communicators: dict[str, Communicator] = {"collective": CollectiveCommunicator()}
+_default = "collective"
+
+
+def register_accelerator_communicator(comm: Communicator,
+                                      make_default: bool = False) -> None:
+    """Register a device transport (reference: register_accelerator_context)."""
+    global _default
+    _communicators[comm.name] = comm
+    if make_default:
+        _default = comm.name
+
+
+def get_accelerator_communicator(name: str | None = None) -> Communicator:
+    return _communicators[name or _default]

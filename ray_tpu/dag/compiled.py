@@ -1,0 +1,257 @@
+"""Compiled DAG execution: static per-actor schedules over channels.
+
+Capability parity with the reference's Compiled Graphs (reference:
+python/ray/dag/compiled_dag_node.py:805 CompiledDAG — _get_or_compile :1550
+allocates channels between actors; _build_execution_schedule :2002 emits a
+static per-actor op list (READ → COMPUTE → WRITE per node,
+dag_node_operation.py:14-24) run in a loop on each actor, replacing per-call
+RPC with channel reads/writes).
+
+Compilation here: walk the graph, allocate one channel per produced value
+(readers = consuming actors and/or the driver), install a loop in every
+participating actor via the ``__rtpu_call_fn__`` hook, and drive executions by
+writing the input channel and reading the terminal channels. Teardown closes
+the input channel; ChannelClosed unwinds every actor loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.dag.channel import ChannelClosed, LocalChannel, StoreChannel
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+_DRIVER = "__driver__"
+
+
+def _actor_loop(instance, ops: list[dict], error_channel):
+    """Installed into each participating actor: runs its static schedule
+    until the upstream channels close (reference: the per-actor loop a
+    compiled DAG executes instead of per-call RPC)."""
+    from ray_tpu.core.worker import global_worker
+
+    rt = global_worker.runtime
+    for op in ops:
+        for kind, chan, _ in op["reads"]:
+            if kind == "chan":
+                chan.connect(rt)
+        if op["write"] is not None:
+            op["write"].connect(rt)
+    error_channel.connect(rt)
+    def cascade_close():
+        # This loop is the writer of its output channels: closing them here
+        # (with this process's write cursor) unwinds downstream loops in turn.
+        for op in ops:
+            if op["write"] is not None:
+                try:
+                    op["write"].close()
+                except BaseException:
+                    pass
+
+    while True:
+        try:
+            for op in ops:
+                args = []
+                for kind, chan_or_val, reader_idx in op["reads"]:
+                    if kind == "chan":
+                        args.append(chan_or_val.read(reader_idx))
+                    else:
+                        args.append(chan_or_val)
+                kwargs = {k: v for k, v in op["const_kwargs"].items()}
+                result = getattr(instance, op["method"])(*args, **kwargs)
+                if op["write"] is not None:
+                    op["write"].write(result)
+        except ChannelClosed:
+            cascade_close()
+            return "closed"
+        except BaseException as e:  # noqa: BLE001
+            # Surface the failure to the driver, then stop this loop — the
+            # schedule is static; a failed step poisons the whole execution.
+            try:
+                error_channel.write(("error", repr(e)))
+            except BaseException:
+                pass
+            cascade_close()
+            return f"error: {e!r}"
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode):
+        import ray_tpu
+        from ray_tpu.core.worker import global_worker
+
+        ray_tpu.init(ignore_reinit_error=True)
+        self._root = root
+        self._rt = global_worker.runtime
+        self._local = global_worker.mode == "local"
+        self._torn_down = False
+        self._compile()
+
+    # ------------------------------------------------------------------ compile
+    def _make_channel(self, name: str, num_readers: int):
+        if self._local:
+            return LocalChannel(name, num_readers)
+        return StoreChannel(name, num_readers)
+
+    def _compile(self):
+        nodes = self._root.walk()
+        self._input_node = next(
+            (n for n in nodes if isinstance(n, InputNode)), None)
+        if self._input_node is None:
+            raise ValueError(
+                "compiled DAGs require an InputNode (teardown propagates by "
+                "closing the input channel)")
+        terminal = self._root
+
+        if isinstance(terminal, InputNode):
+            raise ValueError("DAG must contain at least one actor-method node")
+
+        # Pass A: count read sites per producer. Every consuming arg-use gets
+        # its OWN reader slot — one actor reading a value in two ops is two
+        # readers (each slot queues/deletes independently; sharing a slot
+        # would lose one of the reads).
+        reader_counts: dict[int, int] = {}
+
+        def count_edges(node: DAGNode):
+            if isinstance(node, ClassMethodNode):
+                for arg in node.args:
+                    if isinstance(arg, DAGNode):
+                        reader_counts[arg.node_id] = (
+                            reader_counts.get(arg.node_id, 0) + 1)
+            elif isinstance(node, MultiOutputNode):
+                for up in node.outputs:
+                    reader_counts[up.node_id] = (
+                        reader_counts.get(up.node_id, 0) + 1)
+
+        for node in nodes:
+            count_edges(node)
+        if isinstance(terminal, ClassMethodNode):
+            reader_counts[terminal.node_id] = (
+                reader_counts.get(terminal.node_id, 0) + 1)
+
+        self._channels: dict[int, Any] = {}
+        for node in nodes:
+            n = reader_counts.get(node.node_id, 0)
+            if n:
+                self._channels[node.node_id] = self._make_channel(
+                    f"dag{id(self):x}/n{node.node_id}", n)
+
+        # Pass B: build schedules, assigning reader indices in the SAME node
+        # order as pass A so every read site gets a unique slot.
+        next_reader: dict[int, int] = {}
+
+        def claim(producer_id: int) -> int:
+            idx = next_reader.get(producer_id, 0)
+            next_reader[producer_id] = idx + 1
+            return idx
+
+        schedules: dict[str, list[dict]] = {}
+        self._handles: dict[str, Any] = {}
+        self._output_plan = []
+        self._multi_output = isinstance(terminal, MultiOutputNode)
+        for node in nodes:
+            if isinstance(node, ClassMethodNode):
+                key = node.handle.actor_id.hex()
+                self._handles[key] = node.handle
+                reads = []
+                for arg in node.args:
+                    if isinstance(arg, DAGNode):
+                        reads.append(("chan", self._channels[arg.node_id],
+                                      claim(arg.node_id)))
+                    else:
+                        reads.append(("const", arg, -1))
+                const_kwargs = {}
+                for k, v in node.kwargs.items():
+                    if isinstance(v, DAGNode):
+                        raise ValueError(
+                            "DAG deps must be positional args in compiled graphs")
+                    const_kwargs[k] = v
+                schedules.setdefault(key, []).append({
+                    "node_id": node.node_id,
+                    "method": node.method_name,
+                    "reads": reads,
+                    "const_kwargs": const_kwargs,
+                    "write": self._channels.get(node.node_id),
+                })
+            elif isinstance(node, MultiOutputNode):
+                for up in node.outputs:
+                    self._output_plan.append(
+                        (self._channels[up.node_id], claim(up.node_id)))
+        if isinstance(terminal, ClassMethodNode):
+            self._output_plan.append(
+                (self._channels[terminal.node_id], claim(terminal.node_id)))
+
+        # Error channel: any actor loop reports failures here.
+        self._error_channel = self._make_channel(
+            f"dag{id(self):x}/err", 1).connect(self._rt)
+
+        # Install the loops.
+        self._loop_refs = []
+        for key, ops in schedules.items():
+            handle = self._handles[key]
+            self._loop_refs.append(
+                handle._call_fn(_actor_loop, ops, self._error_channel))
+
+        # Driver connects its ends.
+        self._in_chan = self._channels[self._input_node.node_id].connect(self._rt)
+        for chan, _ in self._output_plan:
+            chan.connect(self._rt)
+
+    # ------------------------------------------------------------------ execute
+    def execute(self, *input_values, timeout: float | None = 60.0):
+        """One synchronous execution through the compiled pipeline."""
+        if self._torn_down:
+            raise RuntimeError("compiled DAG has been torn down")
+        value = input_values[0] if len(input_values) == 1 else input_values
+        self._in_chan.write(value)
+        outs = []
+        for chan, reader_idx in self._output_plan:
+            try:
+                outs.append(chan.read(reader_idx, timeout=timeout))
+            except (TimeoutError, ChannelClosed):
+                # A failed step closes its channels after reporting; surface
+                # the actor's error rather than the secondary symptom.
+                err = self._poll_error(timeout=0.5)
+                if err is not None:
+                    raise RuntimeError(
+                        f"compiled DAG execution failed: {err}") from None
+                raise
+        return outs if self._multi_output else outs[0]
+
+    def _poll_error(self, timeout: float = 0.001):
+        try:
+            kind, msg = self._error_channel.read(0, timeout=timeout)
+            return msg if kind == "error" else None
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------------ teardown
+    def teardown(self):
+        """Close the input channel; each actor loop cascades the close to its
+        own output channels and exits."""
+        if self._torn_down:
+            return
+        self._torn_down = True
+        try:
+            self._in_chan.close()
+        except Exception:
+            pass
+        # The loop results confirm shutdown (and surface loop errors in tests).
+        import ray_tpu
+
+        try:
+            ray_tpu.wait(self._loop_refs, num_returns=len(self._loop_refs),
+                         timeout=10.0)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

@@ -1,0 +1,136 @@
+"""Lazy DAGs of actor-method calls.
+
+Capability parity with the reference's ray.dag (reference: python/ray/dag/
+dag_node.py:32 DAGNode — bind() builds the graph lazily; InputNode marks the
+per-execution input, MultiOutputNode fans multiple leaves out;
+``experimental_compile`` (dag_node.py:279) turns the graph into a CompiledDAG
+with static per-actor schedules instead of per-call RPC).
+
+Uncompiled execution (``dag.execute(x)``) walks the graph submitting ordinary
+actor tasks — same semantics, per-call overhead. Compiling is the fast path.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any
+
+_node_counter = itertools.count()
+
+
+class DAGNode:
+    """Base: a lazily-bound computation with upstream dependencies."""
+
+    def __init__(self):
+        self.node_id = next(_node_counter)
+
+    # -- graph structure ---------------------------------------------------
+    def upstream(self) -> list["DAGNode"]:
+        return []
+
+    def walk(self) -> list["DAGNode"]:
+        """All reachable nodes, deduped, topologically ordered (deps first)."""
+        seen: dict[int, DAGNode] = {}
+        order: list[DAGNode] = []
+
+        def visit(node: DAGNode):
+            if node.node_id in seen:
+                return
+            seen[node.node_id] = node
+            for up in node.upstream():
+                visit(up)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, *input_values):
+        """Eager (uncompiled) execution: submits regular actor tasks."""
+        import ray_tpu
+
+        results: dict[int, Any] = {}
+        for node in self.walk():
+            results[node.node_id] = node._eval(results, input_values)
+        out = results[self.node_id]
+        if isinstance(out, list):
+            return ray_tpu.get(out) if any(
+                hasattr(r, "id") for r in out) else out
+        return ray_tpu.get(out) if hasattr(out, "id") else out
+
+    def _eval(self, results: dict, input_values: tuple):
+        raise NotImplementedError
+
+    def experimental_compile(self, **kwargs) -> "CompiledDAG":
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the per-execution input (reference: InputNode).
+
+    Usable as a context manager for parity with the reference idiom:
+        with InputNode() as inp:
+            dag = actor.fwd.bind(inp)
+    """
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _eval(self, results, input_values):
+        if len(input_values) == 1:
+            return input_values[0]
+        return input_values
+
+
+class ClassMethodNode(DAGNode):
+    """One bound actor-method call (reference: ClassMethodNode)."""
+
+    def __init__(self, handle, method_name: str, args: tuple, kwargs: dict):
+        super().__init__()
+        self.handle = handle
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+
+    def upstream(self) -> list[DAGNode]:
+        ups = [a for a in self.args if isinstance(a, DAGNode)]
+        ups += [v for v in self.kwargs.values() if isinstance(v, DAGNode)]
+        return ups
+
+    def _eval(self, results, input_values):
+        import ray_tpu
+
+        def mat(v):
+            if isinstance(v, DAGNode):
+                r = results[v.node_id]
+                return ray_tpu.get(r) if hasattr(r, "id") else r
+            return v
+
+        args = tuple(mat(a) for a in self.args)
+        kwargs = {k: mat(v) for k, v in self.kwargs.items()}
+        return getattr(self.handle, self.method_name).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Fans out several leaf nodes as the DAG's output list."""
+
+    def __init__(self, outputs: list[DAGNode]):
+        super().__init__()
+        self.outputs = list(outputs)
+
+    def upstream(self) -> list[DAGNode]:
+        return list(self.outputs)
+
+    def _eval(self, results, input_values):
+        import ray_tpu
+
+        out = []
+        for node in self.outputs:
+            r = results[node.node_id]
+            out.append(ray_tpu.get(r) if hasattr(r, "id") else r)
+        return out

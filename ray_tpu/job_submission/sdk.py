@@ -69,6 +69,7 @@ class JobSubmissionClient:
     def wait_until_status(self, submission_id: str, statuses,
                           timeout: float = 60.0) -> str:
         deadline = time.monotonic() + timeout
+        status = None
         while time.monotonic() < deadline:
             status = self.get_job_status(submission_id)
             if status in statuses:
