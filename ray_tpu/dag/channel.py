@@ -81,6 +81,11 @@ class LocalChannel:
         for q in self._queues:
             q.put(_CLOSE)
 
+    def destroy(self) -> None:
+        """Drop the registry entry (teardown) so queues can be collected."""
+        self.close()
+        _local_registry.pop(self.name, None)
+
     def connect(self, runtime) -> "LocalChannel":
         return self
 
@@ -99,7 +104,9 @@ class StoreChannel:
         self.name = name
         self.num_readers = num_readers
         self._write_seq = 0
-        self._read_seq = 0
+        # One cursor per reader index: a single pickled instance can serve
+        # several read sites of one process (distinct reader_index each).
+        self._read_seq: dict[int, int] = {}
         self._runtime = None
 
     # Pickled into actors: only the identity travels; cursors and the runtime
@@ -111,7 +118,7 @@ class StoreChannel:
         self.name = state["name"]
         self.num_readers = state["num_readers"]
         self._write_seq = 0
-        self._read_seq = 0
+        self._read_seq = {}
         self._runtime = None
 
     def connect(self, runtime) -> "StoreChannel":
@@ -133,7 +140,8 @@ class StoreChannel:
 
     def read(self, reader_index: int = 0, timeout: float | None = None) -> Any:
         assert self._runtime is not None, "channel not connected"
-        key = self._key(self._read_seq)
+        seq = self._read_seq.get(reader_index, 0)
+        key = self._key(seq)
         deadline = None if timeout is None else time.monotonic() + timeout
         sleep = 0.0005
         while True:
@@ -141,12 +149,14 @@ class StoreChannel:
             if blob is not None:
                 break
             if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"channel {self.name} seq {self._read_seq}")
+                raise TimeoutError(f"channel {self.name} seq {seq}")
             time.sleep(sleep)
             sleep = min(sleep * 2, 0.01)
-        self._read_seq += 1
         if bytes(blob) == _CLOSE:
+            # Cursor stays on the marker: every subsequent read re-raises
+            # immediately instead of polling a seq that will never arrive.
             raise ChannelClosed(self.name)
+        self._read_seq[reader_index] = seq + 1
         value = serialization.deserialize(blob)
         if self.num_readers == 1:
             self._runtime.kv_del(key, ns="channels")
@@ -154,7 +164,7 @@ class StoreChannel:
             # Publish this reader's cursor so the writer can GC slots every
             # reader has passed.
             self._runtime.kv_put(self._cursor_key(reader_index),
-                                 str(self._read_seq).encode(), ns="channels")
+                                 str(seq + 1).encode(), ns="channels")
         return value
 
     def _gc(self) -> None:
@@ -180,3 +190,10 @@ class StoreChannel:
         # slots before their cursor (they GC themselves / via writer GC).
         assert self._runtime is not None, "channel not connected"
         self._write_raw(_CLOSE)
+
+    def destroy(self) -> None:
+        """Remove every slot and cursor key (teardown, after loops exited)."""
+        assert self._runtime is not None, "channel not connected"
+        for ns_prefix in (f"chan/{self.name}/", f"chancur/{self.name}/"):
+            for key in self._runtime.kv_keys(prefix=ns_prefix, ns="channels"):
+                self._runtime.kv_del(key, ns="channels")

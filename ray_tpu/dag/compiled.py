@@ -85,11 +85,14 @@ class CompiledDAG:
         import ray_tpu
         from ray_tpu.core.worker import global_worker
 
+        import uuid
+
         ray_tpu.init(ignore_reinit_error=True)
         self._root = root
         self._rt = global_worker.runtime
         self._local = global_worker.mode == "local"
         self._torn_down = False
+        self._dag_id = uuid.uuid4().hex[:12]  # globally unique channel prefix
         self._compile()
 
     # ------------------------------------------------------------------ compile
@@ -139,7 +142,7 @@ class CompiledDAG:
             n = reader_counts.get(node.node_id, 0)
             if n:
                 self._channels[node.node_id] = self._make_channel(
-                    f"dag{id(self):x}/n{node.node_id}", n)
+                    f"dag{self._dag_id}/n{node.node_id}", n)
 
         # Pass B: build schedules, assigning reader indices in the SAME node
         # order as pass A so every read site gets a unique slot.
@@ -186,16 +189,21 @@ class CompiledDAG:
             self._output_plan.append(
                 (self._channels[terminal.node_id], claim(terminal.node_id)))
 
-        # Error channel: any actor loop reports failures here.
-        self._error_channel = self._make_channel(
-            f"dag{id(self):x}/err", 1).connect(self._rt)
+        # One error channel per actor: channels are single-writer, and a
+        # shared one would interleave writers' sequence numbers.
+        self._error_channels = {
+            key: self._make_channel(f"dag{self._dag_id}/err/{key}", 1)
+            for key in schedules
+        }
 
         # Install the loops.
         self._loop_refs = []
         for key, ops in schedules.items():
             handle = self._handles[key]
             self._loop_refs.append(
-                handle._call_fn(_actor_loop, ops, self._error_channel))
+                handle._call_fn(_actor_loop, ops, self._error_channels[key]))
+        for chan in self._error_channels.values():
+            chan.connect(self._rt)
 
         # Driver connects its ends.
         self._in_chan = self._channels[self._input_node.node_id].connect(self._rt)
@@ -224,11 +232,14 @@ class CompiledDAG:
         return outs if self._multi_output else outs[0]
 
     def _poll_error(self, timeout: float = 0.001):
-        try:
-            kind, msg = self._error_channel.read(0, timeout=timeout)
-            return msg if kind == "error" else None
-        except Exception:
-            return None
+        for chan in self._error_channels.values():
+            try:
+                kind, msg = chan.read(0, timeout=timeout)
+                if kind == "error":
+                    return msg
+            except Exception:
+                continue
+        return None
 
     # ------------------------------------------------------------------ teardown
     def teardown(self):
@@ -249,6 +260,14 @@ class CompiledDAG:
                          timeout=10.0)
         except Exception:
             pass
+        # Reclaim channel resources (registry entries locally; KV slots and
+        # cursors in cluster mode) now that every loop has exited.
+        for chan in list(self._channels.values()) + list(
+                self._error_channels.values()):
+            try:
+                chan.connect(self._rt).destroy()
+            except Exception:
+                pass
 
     def __del__(self):
         try:

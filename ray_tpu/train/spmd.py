@@ -39,34 +39,34 @@ jax.tree_util.register_dataclass(
 )
 
 
-def make_llama_train_step(
-    cfg: LlamaConfig,
+def make_train_step(
     mesh: Mesh,
+    *,
+    loss: Callable,          # loss(params, tokens, targets) -> scalar
+    init_fn: Callable,       # init_fn(rng_key) -> params pytree
+    logical_axes: Any,       # pytree of logical-axis tuples (see sharding.py)
     rules: ShardingRules | None = None,
     optimizer: optax.GradientTransformation | None = None,
-    attn_impl: str = "flash",
-    remat: bool = True,
     seed: int = 0,
-) -> tuple[Callable, TrainState, Callable]:
-    """Returns (step_fn, initial_state, data_sharder).
+) -> tuple[Callable, Callable, Callable]:
+    """Model-agnostic SPMD step factory: any pure loss + init + axis table
+    becomes one jitted, donated, mesh-sharded train step.
 
-    - step_fn(state, tokens, targets) -> (state, metrics): jitted, with
-      parameter/optimizer shardings from the rule table and batch sharded
-      over (dp, fsdp).
+    Returns (step_fn, init_state, data_sharder):
+    - step_fn(state, tokens, targets) -> (state, metrics), with parameter/
+      optimizer shardings from the rule table and batch over (dp, fsdp).
     - data_sharder(host_array) -> global sharded array.
     """
     rules = rules or ShardingRules()
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1,
                                          mu_dtype=jnp.bfloat16)
 
-    logical = param_logical_axes(cfg)
-    param_sh = tree_shardings(mesh, logical, rules)
+    param_sh = tree_shardings(mesh, logical_axes, rules)
     batch_sh = NamedSharding(mesh, rules.spec("batch", None))
 
     def init_state() -> TrainState:
-        params = jax.jit(
-            partial(init_params, cfg), out_shardings=param_sh
-        )(jax.random.PRNGKey(seed))
+        params = jax.jit(init_fn, out_shardings=param_sh)(
+            jax.random.PRNGKey(seed))
         opt_state = jax.jit(
             optimizer.init,
             out_shardings=_opt_shardings(optimizer, params, param_sh),
@@ -75,11 +75,7 @@ def make_llama_train_step(
                           step=jnp.zeros((), jnp.int32))
 
     def _step(state: TrainState, tokens, targets):
-        def lossf(p):
-            return loss_fn(cfg, p, tokens, targets, attn_impl=attn_impl,
-                           remat=remat)
-
-        loss, grads = jax.value_and_grad(lossf)(state.params)
+        loss_val, grads = jax.value_and_grad(loss)(state.params, tokens, targets)
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
         params = optax.apply_updates(state.params, updates)
@@ -87,7 +83,7 @@ def make_llama_train_step(
         return (
             TrainState(params=params, opt_state=opt_state,
                        step=state.step + 1),
-            {"loss": loss, "grad_norm": gnorm},
+            {"loss": loss_val, "grad_norm": gnorm},
         )
 
     step_fn = jax.jit(
@@ -100,6 +96,49 @@ def make_llama_train_step(
         return jax.device_put(arr, batch_sh)
 
     return step_fn, init_state, data_sharder
+
+
+def make_llama_train_step(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    rules: ShardingRules | None = None,
+    optimizer: optax.GradientTransformation | None = None,
+    attn_impl: str = "flash",
+    remat: bool = True,
+    seed: int = 0,
+) -> tuple[Callable, Callable, Callable]:
+    """Llama-family specialization of :func:`make_train_step`."""
+    return make_train_step(
+        mesh,
+        loss=lambda p, tokens, targets: loss_fn(
+            cfg, p, tokens, targets, attn_impl=attn_impl, remat=remat),
+        init_fn=partial(init_params, cfg),
+        logical_axes=param_logical_axes(cfg),
+        rules=rules, optimizer=optimizer, seed=seed,
+    )
+
+
+def make_mixtral_train_step(
+    cfg,
+    mesh: Mesh,
+    rules: ShardingRules | None = None,
+    optimizer: optax.GradientTransformation | None = None,
+    attn_impl: str = "flash",
+    remat: bool = True,
+    seed: int = 0,
+) -> tuple[Callable, Callable, Callable]:
+    """MoE specialization: expert weights shard over the mesh ``ep`` axis;
+    the dispatch/combine einsums become ep all-to-alls under XLA."""
+    from ray_tpu.models import mixtral
+
+    return make_train_step(
+        mesh,
+        loss=lambda p, tokens, targets: mixtral.loss_fn(
+            cfg, p, tokens, targets, attn_impl=attn_impl, remat=remat),
+        init_fn=partial(mixtral.init_params, cfg),
+        logical_axes=mixtral.param_logical_axes(cfg),
+        rules=rules, optimizer=optimizer, seed=seed,
+    )
 
 
 def _opt_shardings(optimizer, params, param_sh):
