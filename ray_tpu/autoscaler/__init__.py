@@ -1,0 +1,21 @@
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalingConfig, NodeTypeConfig
+from ray_tpu.autoscaler.instance_manager import Instance, InstanceManager, InstanceStatus
+from ray_tpu.autoscaler.node_provider import (
+    FakeMultiNodeProvider,
+    NodeProvider,
+    TpuSliceProvider,
+)
+from ray_tpu.autoscaler.scheduler import bin_pack_demands
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalingConfig",
+    "NodeTypeConfig",
+    "Instance",
+    "InstanceManager",
+    "InstanceStatus",
+    "NodeProvider",
+    "FakeMultiNodeProvider",
+    "TpuSliceProvider",
+    "bin_pack_demands",
+]

@@ -1,0 +1,174 @@
+"""Autoscaler control loop: demand in, launch/terminate decisions out.
+
+Capability parity with the reference's autoscaler v2 (reference:
+python/ray/autoscaler/v2/autoscaler.py:51 Autoscaler + monitor.py — each
+round reads cluster resource state from the GCS
+(GcsAutoscalerStateManager), bin-packs pending demands onto node types,
+launches through the provider, and terminates idle nodes): ``update()`` is
+one reconciliation round; run it from a monitor loop or tests.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ray_tpu.autoscaler.instance_manager import (
+    Instance,
+    InstanceManager,
+    InstanceStatus,
+)
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.scheduler import bin_pack_demands
+
+
+@dataclass
+class NodeTypeConfig:
+    resources: dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class AutoscalingConfig:
+    node_types: dict[str, NodeTypeConfig]
+    idle_timeout_s: float = 60.0
+    max_launches_per_round: int = 8
+
+
+class Autoscaler:
+    def __init__(self, config: AutoscalingConfig, provider: NodeProvider,
+                 head_client):
+        """``head_client`` is an RpcClient to the head (for cluster_load)."""
+        self.config = config
+        self.provider = provider
+        self.head = head_client
+        self.instances = InstanceManager()
+        self._idle_since: dict[str, float] = {}  # node_id -> first idle ts
+
+    # ---------------------------------------------------------------- rounds
+    def update(self) -> dict:
+        """One reconciliation round; returns a summary for observability."""
+        load = self.head.call("cluster_load")
+        self._reconcile_allocated(load)
+        launches = self._scale_up(load)
+        terminated = self._scale_down(load)
+        return {"launched": launches, "terminated": terminated,
+                "pending_demands": len(load.get("pending_demands", []))}
+
+    # ---------------------------------------------------------------- helpers
+    def _counts_by_type(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for inst in self.instances.active():
+            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+        return counts
+
+    def _reconcile_allocated(self, load: dict) -> None:
+        """Move REQUESTED/ALLOCATED instances forward as their nodes join."""
+        alive_nodes = {nid for nid, n in load["nodes"].items() if n["alive"]}
+        for inst in self.instances.instances(
+                (InstanceStatus.REQUESTED, InstanceStatus.ALLOCATED)):
+            status = self.provider.node_status(inst.cloud_id)
+            if status == "failed":
+                self.instances.transition(
+                    inst.instance_id, InstanceStatus.ALLOCATION_FAILED)
+                continue
+            if inst.status == InstanceStatus.REQUESTED and status == "running":
+                self.instances.transition(
+                    inst.instance_id, InstanceStatus.ALLOCATED)
+            node_id = self.provider.runtime_node_id(inst.cloud_id)
+            if (inst.status == InstanceStatus.ALLOCATED
+                    and node_id and node_id in alive_nodes):
+                self.instances.transition(
+                    inst.instance_id, InstanceStatus.RAY_RUNNING,
+                    node_id=node_id)
+
+    def _scale_up(self, load: dict) -> dict[str, int]:
+        demands = list(load.get("pending_demands", []))
+        demands += list(load.get("pending_pg_bundles", []))
+        counts = self._counts_by_type()
+
+        # Min-worker floors count as demands of a full node.
+        for name, cfg in self.config.node_types.items():
+            for _ in range(max(0, cfg.min_workers - counts.get(name, 0))):
+                demands.append(dict(cfg.resources))
+
+        if not demands:
+            return {}
+        free = [dict(n["available"]) for n in load["nodes"].values()
+                if n["alive"]]
+        # Capacity already on the way absorbs demand too.
+        for inst in self.instances.instances(
+                (InstanceStatus.QUEUED, InstanceStatus.REQUESTED,
+                 InstanceStatus.ALLOCATED)):
+            free.append(dict(self.config.node_types[inst.node_type].resources))
+        max_new = {
+            name: min(cfg.max_workers - counts.get(name, 0),
+                      self.config.max_launches_per_round)
+            for name, cfg in self.config.node_types.items()
+        }
+        launches, _infeasible = bin_pack_demands(
+            demands, free,
+            {n: c.resources for n, c in self.config.node_types.items()},
+            max_new_per_type=max_new,
+        )
+        for node_type, count in launches.items():
+            cfg = self.config.node_types[node_type]
+            for _ in range(count):
+                inst = self.instances.create(node_type)
+                self.instances.transition(inst.instance_id,
+                                          InstanceStatus.REQUESTED)
+                try:
+                    cloud_id = self.provider.launch_node(
+                        node_type, dict(cfg.resources), dict(cfg.labels))
+                except Exception:
+                    self.instances.transition(
+                        inst.instance_id, InstanceStatus.ALLOCATION_FAILED)
+                    continue
+                inst.cloud_id = cloud_id
+        return launches
+
+    def _scale_down(self, load: dict) -> list[str]:
+        """Terminate RAY_RUNNING nodes idle past the timeout, above floors."""
+        now = time.monotonic()
+        counts = self._counts_by_type()
+        terminated: list[str] = []
+        for inst in self.instances.instances((InstanceStatus.RAY_RUNNING,)):
+            node = load["nodes"].get(inst.node_id)
+            if node is None or not node["alive"]:
+                self.instances.transition(inst.instance_id,
+                                          InstanceStatus.TERMINATED)
+                continue
+            idle = node["available"] == node["resources"]
+            if not idle:
+                self._idle_since.pop(inst.node_id, None)
+                continue
+            first = self._idle_since.setdefault(inst.node_id, now)
+            floor = self.config.node_types[inst.node_type].min_workers
+            if (now - first >= self.config.idle_timeout_s
+                    and counts.get(inst.node_type, 0) > floor):
+                self.instances.transition(inst.instance_id,
+                                          InstanceStatus.RAY_STOPPING)
+                try:
+                    self.provider.terminate_node(inst.cloud_id)
+                finally:
+                    self.instances.transition(inst.instance_id,
+                                              InstanceStatus.TERMINATED)
+                counts[inst.node_type] -= 1
+                terminated.append(inst.node_id)
+                self._idle_since.pop(inst.node_id, None)
+        return terminated
+
+    # ---------------------------------------------------------------- monitor
+    def run_monitor(self, interval_s: float = 5.0, stop_event=None) -> None:
+        """Blocking reconcile loop (reference: monitor.py)."""
+        import threading
+
+        stop_event = stop_event or threading.Event()
+        while not stop_event.is_set():
+            try:
+                self.update()
+            except Exception:
+                pass
+            stop_event.wait(interval_s)

@@ -1,0 +1,94 @@
+"""Instance lifecycle FSM.
+
+Capability parity with the reference's autoscaler v2 instance manager
+(reference: python/ray/autoscaler/v2/instance_manager/instance_manager.py:29
+InstanceManager — instances move QUEUED → REQUESTED → ALLOCATED →
+RAY_RUNNING → RAY_STOPPING → TERMINATED with status-transition asserts
+:186-202, reconciling cloud state against demand): each instance tracks one
+cloud node from launch request to termination; invalid transitions raise.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+
+
+class InstanceStatus:
+    QUEUED = "QUEUED"
+    REQUESTED = "REQUESTED"
+    ALLOCATED = "ALLOCATED"
+    RAY_RUNNING = "RAY_RUNNING"
+    RAY_STOPPING = "RAY_STOPPING"
+    TERMINATED = "TERMINATED"
+    ALLOCATION_FAILED = "ALLOCATION_FAILED"
+
+
+# Legal transitions (reference: the v2 FSM asserts; same shape minus the
+# install states — node setup here is the provider's launch).
+_TRANSITIONS: dict[str, set[str]] = {
+    InstanceStatus.QUEUED: {InstanceStatus.REQUESTED},
+    InstanceStatus.REQUESTED: {InstanceStatus.ALLOCATED,
+                               InstanceStatus.ALLOCATION_FAILED},
+    InstanceStatus.ALLOCATED: {InstanceStatus.RAY_RUNNING,
+                               InstanceStatus.RAY_STOPPING,
+                               InstanceStatus.TERMINATED},
+    InstanceStatus.RAY_RUNNING: {InstanceStatus.RAY_STOPPING,
+                                 InstanceStatus.TERMINATED},
+    InstanceStatus.RAY_STOPPING: {InstanceStatus.TERMINATED},
+    InstanceStatus.ALLOCATION_FAILED: set(),
+    InstanceStatus.TERMINATED: set(),
+}
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = InstanceStatus.QUEUED
+    cloud_id: str | None = None  # provider-side node id once allocated
+    node_id: str | None = None  # runtime node id once RAY_RUNNING
+    created_at: float = field(default_factory=time.monotonic)
+    status_history: list[tuple[str, float]] = field(default_factory=list)
+
+
+class InstanceManager:
+    def __init__(self):
+        self._instances: dict[str, Instance] = {}
+
+    def create(self, node_type: str) -> Instance:
+        inst = Instance(instance_id=f"inst-{next(_ids)}", node_type=node_type)
+        inst.status_history.append((inst.status, time.monotonic()))
+        self._instances[inst.instance_id] = inst
+        return inst
+
+    def transition(self, instance_id: str, new_status: str, **updates) -> Instance:
+        inst = self._instances[instance_id]
+        allowed = _TRANSITIONS[inst.status]
+        if new_status not in allowed:
+            raise ValueError(
+                f"illegal instance transition {inst.status} -> {new_status} "
+                f"for {instance_id} (allowed: {sorted(allowed)})")
+        inst.status = new_status
+        inst.status_history.append((new_status, time.monotonic()))
+        for k, v in updates.items():
+            setattr(inst, k, v)
+        return inst
+
+    def instances(self, statuses: tuple[str, ...] | None = None) -> list[Instance]:
+        out = list(self._instances.values())
+        if statuses:
+            out = [i for i in out if i.status in statuses]
+        return out
+
+    def get(self, instance_id: str) -> Instance:
+        return self._instances[instance_id]
+
+    def active(self) -> list[Instance]:
+        """Instances that count toward capacity (launched or launching)."""
+        return self.instances((InstanceStatus.QUEUED, InstanceStatus.REQUESTED,
+                               InstanceStatus.ALLOCATED,
+                               InstanceStatus.RAY_RUNNING))

@@ -1,0 +1,71 @@
+"""Demand scheduler: bin-pack pending resource demands onto node types.
+
+Capability parity with the reference's v2 scheduler (reference:
+python/ray/autoscaler/v2/scheduler.py — bin-packs pending resource demands,
+placement-group bundles, and cluster min/max constraints against candidate
+node types to decide launches).
+"""
+
+from __future__ import annotations
+
+
+def _fits(free: dict[str, float], demand: dict[str, float]) -> bool:
+    return all(free.get(k, 0.0) >= v for k, v in demand.items())
+
+
+def _take(free: dict[str, float], demand: dict[str, float]) -> None:
+    for k, v in demand.items():
+        free[k] = free.get(k, 0.0) - v
+
+
+def bin_pack_demands(
+    demands: list[dict[str, float]],
+    existing_free: list[dict[str, float]],
+    node_types: dict[str, dict[str, float]],
+    max_new_per_type: dict[str, int] | None = None,
+) -> tuple[dict[str, int], list[dict[str, float]]]:
+    """First-fit-decreasing pack of ``demands`` into existing free capacity,
+    then into new nodes chosen by best fit.
+
+    Returns (launches: node_type -> count, infeasible demands).
+    """
+    max_new = dict(max_new_per_type or {})
+    free = [dict(f) for f in existing_free]
+    new_nodes: list[tuple[str, dict[str, float]]] = []
+    launches: dict[str, int] = {}
+    infeasible: list[dict[str, float]] = []
+
+    # Big demands first: they constrain placement the most.
+    for demand in sorted(demands, key=lambda d: -sum(d.values())):
+        placed = False
+        for f in free:
+            if _fits(f, demand):
+                _take(f, demand)
+                placed = True
+                break
+        if placed:
+            continue
+        for _, f in new_nodes:
+            if _fits(f, demand):
+                _take(f, demand)
+                placed = True
+                break
+        if placed:
+            continue
+        # Launch the smallest node type that fits the demand.
+        candidates = [
+            (sum(res.values()), name, res)
+            for name, res in node_types.items()
+            if _fits(dict(res), demand)
+            and max_new.get(name, 10 ** 9) > launches.get(name, 0)
+        ]
+        if not candidates:
+            infeasible.append(demand)
+            continue
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        _, name, res = candidates[0]
+        f = dict(res)
+        _take(f, demand)
+        new_nodes.append((name, f))
+        launches[name] = launches.get(name, 0) + 1
+    return launches, infeasible

@@ -31,6 +31,7 @@ class NodeInfo:
     available: dict[str, float] = field(default_factory=dict)
     last_heartbeat: float = field(default_factory=time.monotonic)
     alive: bool = True
+    pending_demands: list = field(default_factory=list)  # autoscaler feed
 
 
 @dataclass
@@ -93,6 +94,7 @@ class HeadServer:
         r("available_resources", self._available_resources)
         r("state_snapshot", self._state_snapshot)
         r("report_task_events", self._report_task_events)
+        r("cluster_load", self._cluster_load)
         r("create_placement_group", self._create_pg)
         r("remove_placement_group", self._remove_pg)
         r("placement_group_state", self._pg_state)
@@ -153,7 +155,8 @@ class HeadServer:
         return {"ok": True}
 
     async def _heartbeat(self, conn: ServerConnection, node_id: str, available: dict,
-                         resources: dict | None = None):
+                         resources: dict | None = None,
+                         pending_demands: list | None = None):
         info = self.nodes.get(node_id)
         if info is None:
             return {"ok": False, "reregister": True}
@@ -161,6 +164,7 @@ class HeadServer:
         info.available = available
         if resources is not None:
             info.resources = resources  # totals change as PG bundles commit
+        info.pending_demands = pending_demands or []
         return {"ok": True}
 
     async def _drain_node(self, conn: ServerConnection, node_id: str):
@@ -562,6 +566,26 @@ class HeadServer:
                 wid: {"addr": list(addr)} for wid, addr in self.workers.items()
             },
             "task_events": list(self.task_events),
+        }
+
+    async def _cluster_load(self, conn: ServerConnection):
+        """Autoscaler demand feed (reference: GcsAutoscalerStateManager's
+        cluster resource state — per-node usage + pending demands + pending
+        placement groups)."""
+        return {
+            "nodes": {
+                nid: {"resources": n.resources, "available": n.available,
+                      "alive": n.alive, "labels": n.labels}
+                for nid, n in self.nodes.items()
+            },
+            "pending_demands": [
+                d for n in self.nodes.values() if n.alive
+                for d in n.pending_demands
+            ],
+            "pending_pg_bundles": [
+                b for pg in self.pgs.values() if pg["state"] == "PENDING"
+                for b in pg["bundles"]
+            ],
         }
 
     # ------------------------------------------------------------------ resources

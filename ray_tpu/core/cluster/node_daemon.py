@@ -209,9 +209,14 @@ class NodeDaemon:
         cfg = get_config()
         while True:
             try:
-                await self._head.call("heartbeat", node_id=self.node_id,
-                                      available=self.available,
-                                      resources=self.resources)
+                await self._head.call(
+                    "heartbeat", node_id=self.node_id,
+                    available=self.available, resources=self.resources,
+                    # Pending lease demands feed the autoscaler (reference:
+                    # raylet reports resource load to GcsResourceManager for
+                    # GcsAutoscalerStateManager).
+                    pending_demands=[r.resources for r in self._pending
+                                     if not r.fut.done()])
             except Exception:
                 pass
             await asyncio.sleep(cfg.health_check_period_s / 2)
@@ -234,7 +239,8 @@ class NodeDaemon:
             self.available[k] = self.available.get(k, 0.0) + v
 
     async def _request_lease(self, conn: ServerConnection, resources: dict,
-                             timeout: float | None = None, env_hash: str = ""):
+                             timeout: float | None = None, env_hash: str = "",
+                             allow_spill: bool = True):
         if not self._feasible(resources):
             # Spillback: find a feasible node from the head's view
             # (reference: cluster_lease_manager spills to best remote node).
@@ -246,13 +252,46 @@ class NodeDaemon:
                     return {"spill": info["addr"]}
             return {"error": f"infeasible resource demand {resources}"}
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append(_PendingLease(dict(resources), fut, env_hash))
+        req = _PendingLease(dict(resources), fut, env_hash)
+        self._pending.append(req)
         self._try_grant()
         cfg = get_config()
-        try:
-            return await asyncio.wait_for(fut, timeout or cfg.worker_lease_timeout_s)
-        except asyncio.TimeoutError:
-            return {"error": "lease timeout"}
+        deadline = time.monotonic() + (timeout or cfg.worker_lease_timeout_s)
+        # Queue locally, but if the wait drags on and another node has free
+        # capacity NOW, spill the request there (reference: hybrid
+        # pack/spread — prefer local until loaded, then least-loaded remote;
+        # this is also what lets freshly-autoscaled nodes absorb a backlog).
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._pending = [p for p in self._pending if p is not req]
+                return {"error": "lease timeout"}
+            try:
+                return await asyncio.wait_for(
+                    asyncio.shield(fut),
+                    min(cfg.lease_spill_check_s, remaining))
+            except asyncio.TimeoutError:
+                pass
+            if fut.done():
+                return fut.result()
+            if not allow_spill:
+                continue
+            try:
+                nodes = await self._head.call("list_nodes")
+            except Exception:
+                continue
+            if fut.done():  # granted while we were asking the head
+                return fut.result()
+            for nid, info in nodes.items():
+                if nid == self.node_id or not info["alive"]:
+                    continue
+                if all(info["available"].get(k, 0.0) >= v
+                       for k, v in resources.items()):
+                    # No await between the done-check and removal: the grant
+                    # path runs on this loop, so this hand-off is atomic.
+                    self._pending = [p for p in self._pending if p is not req]
+                    fut.cancel()
+                    return {"spill": info["addr"]}
 
     def _idle_worker(self, env_hash: str = "",
                      pristine_only: bool = False) -> WorkerProc | None:

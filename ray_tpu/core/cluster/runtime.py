@@ -398,8 +398,11 @@ class ClusterRuntime:
         hops = 0
         while res.get("spill") and hops < 4:
             daemon = self._peer(tuple(res["spill"]))
+            # Final hop commits to its node: prevents spill ping-pong when
+            # every node is briefly busy.
             res = daemon.call("request_lease", resources=spec.resources,
-                              env_hash=env_hash, timeout=None)
+                              env_hash=env_hash, timeout=None,
+                              allow_spill=hops < 3)
             hops += 1
         if res.get("error"):
             raise ValueError(res["error"])

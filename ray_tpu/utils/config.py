@@ -38,6 +38,7 @@ class Config:
     worker_idle_ttl_s: float = 60.0  # idle pooled workers are reaped after this
     worker_startup_concurrency: int = 8
     lease_keepalive_s: float = 2.0  # idle driver-cached leases returned after this
+    lease_spill_check_s: float = 0.3  # queued lease looks for a freer node after this
 
     # --- object store (reference: plasma + spilling thresholds, ray_config_def.h:680-697) ---
     object_store_memory_bytes: int = 2 * 1024**3

@@ -1,0 +1,161 @@
+"""Autoscaler v2: instance FSM, bin-packing, end-to-end elastic capacity.
+
+Mirrors the reference's autoscaler test surface (reference:
+python/ray/autoscaler/v2/tests/ — FSM transition asserts, scheduler
+bin-packing, FakeMultiNodeProvider end-to-end scale up/down).
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    FakeMultiNodeProvider,
+    InstanceManager,
+    InstanceStatus,
+    NodeTypeConfig,
+    TpuSliceProvider,
+    bin_pack_demands,
+)
+
+
+class TestInstanceFsm:
+    def test_happy_path(self):
+        mgr = InstanceManager()
+        inst = mgr.create("cpu4")
+        assert inst.status == InstanceStatus.QUEUED
+        mgr.transition(inst.instance_id, InstanceStatus.REQUESTED)
+        mgr.transition(inst.instance_id, InstanceStatus.ALLOCATED,
+                       cloud_id="c-1")
+        mgr.transition(inst.instance_id, InstanceStatus.RAY_RUNNING,
+                       node_id="n-1")
+        mgr.transition(inst.instance_id, InstanceStatus.RAY_STOPPING)
+        mgr.transition(inst.instance_id, InstanceStatus.TERMINATED)
+        assert [s for s, _ in mgr.get(inst.instance_id).status_history] == [
+            "QUEUED", "REQUESTED", "ALLOCATED", "RAY_RUNNING",
+            "RAY_STOPPING", "TERMINATED"]
+
+    def test_illegal_transition_raises(self):
+        mgr = InstanceManager()
+        inst = mgr.create("cpu4")
+        with pytest.raises(ValueError):
+            mgr.transition(inst.instance_id, InstanceStatus.RAY_RUNNING)
+        mgr.transition(inst.instance_id, InstanceStatus.REQUESTED)
+        with pytest.raises(ValueError):
+            mgr.transition(inst.instance_id, InstanceStatus.QUEUED)
+
+    def test_allocation_failure_is_terminal(self):
+        mgr = InstanceManager()
+        inst = mgr.create("cpu4")
+        mgr.transition(inst.instance_id, InstanceStatus.REQUESTED)
+        mgr.transition(inst.instance_id, InstanceStatus.ALLOCATION_FAILED)
+        with pytest.raises(ValueError):
+            mgr.transition(inst.instance_id, InstanceStatus.ALLOCATED)
+        assert inst not in mgr.active()
+
+
+class TestBinPacking:
+    TYPES = {"cpu4": {"CPU": 4.0}, "cpu16": {"CPU": 16.0},
+             "tpu_host": {"CPU": 8.0, "TPU": 4.0}}
+
+    def test_existing_capacity_absorbs(self):
+        launches, infeasible = bin_pack_demands(
+            [{"CPU": 1.0}] * 3, [{"CPU": 4.0}], self.TYPES)
+        assert launches == {} and infeasible == []
+
+    def test_launches_smallest_fitting_type(self):
+        launches, _ = bin_pack_demands([{"CPU": 1.0}], [], self.TYPES)
+        assert launches == {"cpu4": 1}
+        launches, _ = bin_pack_demands([{"CPU": 10.0}], [], self.TYPES)
+        assert launches == {"cpu16": 1}
+        launches, _ = bin_pack_demands([{"TPU": 4.0}], [], self.TYPES)
+        assert launches == {"tpu_host": 1}
+
+    def test_packs_multiple_demands_per_node(self):
+        launches, _ = bin_pack_demands([{"CPU": 2.0}] * 4, [], self.TYPES)
+        # 8 CPUs of demand: two cpu4 nodes (first-fit into new nodes).
+        assert sum(launches.values()) == 2
+
+    def test_max_per_type_and_infeasible(self):
+        launches, infeasible = bin_pack_demands(
+            [{"CPU": 4.0}] * 3, [], {"cpu4": {"CPU": 4.0}},
+            max_new_per_type={"cpu4": 2})
+        assert launches == {"cpu4": 2}
+        assert len(infeasible) == 1
+        _, infeasible = bin_pack_demands([{"GPU": 1.0}], [], self.TYPES)
+        assert infeasible == [{"GPU": 1.0}]
+
+
+class TestTpuSliceProvider:
+    def test_atomic_slice_lifecycle(self):
+        calls = []
+        provider = TpuSliceProvider(
+            "v5p-16", "2x2x2",
+            create_slice_fn=lambda name, at, topo: calls.append(("create", name, at, topo)),
+            delete_slice_fn=lambda name: calls.append(("delete", name)),
+        )
+        cid = provider.launch_node("tpu_slice", {"TPU": 8.0})
+        assert calls[0][0] == "create" and calls[0][2] == "v5p-16"
+        assert provider.node_status(cid) == "running"
+        provider.terminate_node(cid)
+        assert calls[-1][0] == "delete"
+        assert provider.node_status(cid) == "terminated"
+
+
+class TestEndToEnd:
+    def test_scale_up_then_down(self):
+        """Pending demand launches a real in-process node; idle terminates it."""
+        import ray_tpu
+        from ray_tpu.core.worker import global_worker
+
+        ray_tpu.shutdown()
+        ray_tpu.init(address="local-cluster", num_cpus=1)
+        try:
+            rt = global_worker.runtime
+            config = AutoscalingConfig(
+                node_types={"cpu2": NodeTypeConfig({"CPU": 2.0}, max_workers=2)},
+                idle_timeout_s=1.0,
+            )
+            provider = FakeMultiNodeProvider(
+                (rt._head_host, rt._head_port))
+            scaler = Autoscaler(config, provider, rt.head)
+
+            # Demand beyond the 1-CPU head node: 2 concurrent 1-CPU tasks.
+            @ray_tpu.remote(num_cpus=1)
+            def hold(sec):
+                time.sleep(sec)
+                return 1
+
+            refs = [hold.remote(6) for _ in range(3)]
+            # Wait for the daemons to heartbeat their pending queues.
+            deadline = time.monotonic() + 15
+            launched = {}
+            while time.monotonic() < deadline and not launched:
+                summary = scaler.update()
+                launched = summary["launched"]
+                time.sleep(0.5)
+            assert launched.get("cpu2", 0) >= 1, "no scale-up happened"
+
+            # With the new node, all tasks complete.
+            assert ray_tpu.get(refs, timeout=60) == [1, 1, 1]
+
+            # Node registers as RAY_RUNNING after joining.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                scaler.update()
+                if scaler.instances.instances((InstanceStatus.RAY_RUNNING,)):
+                    break
+                time.sleep(0.5)
+            assert scaler.instances.instances((InstanceStatus.RAY_RUNNING,))
+
+            # Idle: scaled back down past the timeout.
+            deadline = time.monotonic() + 20
+            terminated = []
+            while time.monotonic() < deadline and not terminated:
+                terminated = scaler.update()["terminated"]
+                time.sleep(0.5)
+            assert terminated, "idle node was not terminated"
+        finally:
+            ray_tpu.shutdown()
