@@ -1,0 +1,20 @@
+import time
+import jax, jax.numpy as jnp, numpy as np
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_tpu.train.spmd import make_llama_train_step
+
+cfg = LlamaConfig(vocab_size=32128, hidden_size=2048, intermediate_size=8192,
+    num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+    max_seq_len=2048, tie_embeddings=True, dtype="bfloat16")
+mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+step_fn, init_state, shard = make_llama_train_step(cfg, mesh, attn_impl="flash")
+state = init_state()
+rng = np.random.default_rng(0)
+tokens = shard(rng.integers(0, cfg.vocab_size, (4, 2048), dtype=np.int32))
+targets = shard(rng.integers(0, cfg.vocab_size, (4, 2048), dtype=np.int32))
+for i in range(5):
+    t0=time.perf_counter()
+    state, m = step_fn(state, tokens, targets)
+    loss = float(m["loss"]); gn = float(m["grad_norm"])
+    print(f"step {i}: {time.perf_counter()-t0:.2f}s loss={loss:.4f} gnorm={gn:.3f}", flush=True)

@@ -1,0 +1,39 @@
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+NEG_INF=-1e30
+rng = np.random.default_rng(0)
+B,H,S,D,KB = 2,4,2048,64,512
+q = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+nb = S // KB
+kb = k.reshape(B,H,nb,KB,D).transpose(2,0,1,3,4)
+vb = v.reshape(B,H,nb,KB,D).transpose(2,0,1,3,4)
+scale = 1.0/np.sqrt(D)
+
+# stage 1: s blocks as explicit input
+def from_s(sblocks, vb):
+    def step(carry, inputs):
+        o, m, l = carry
+        s, vblk = inputs
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p.astype(vblk.dtype), vblk).astype(jnp.float32)
+        return (o_new, m_new, l_new), None
+    o0 = jnp.zeros((B,H,S,D), jnp.float32)
+    m0 = jnp.full((B,H,S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B,H,S), jnp.float32)
+    (o, m, l), _ = lax.scan(step, (o0,m0,l0), (sblocks, vb))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l[..., None]).astype(jnp.bfloat16)
+
+sblocks = jnp.stack([ (jnp.einsum("bhqd,bhkd->bhqk", q, kb[j]).astype(jnp.float32) * scale) for j in range(nb)])
+val, gs = jax.jit(jax.value_and_grad(lambda s: from_s(s, vb).astype(jnp.float32).sum()))(sblocks)
+print("ds: nan:", bool(jnp.isnan(gs).any()), "max|ds|:", float(jnp.abs(gs).max()), "min/max s:", float(sblocks.min()), float(sblocks.max()), flush=True)
+# then dq from ds
+ds_bf = gs.astype(jnp.bfloat16)
+print("ds_bf16 nan:", bool(jnp.isnan(ds_bf.astype(jnp.float32)).any()), flush=True)
+dq = sum(jnp.einsum("bhqk,bhkd->bhqd", ds_bf[j], kb[j]) for j in range(nb))
+print("dq nan:", bool(jnp.isnan(dq.astype(jnp.float32)).any()), flush=True)

@@ -1,0 +1,42 @@
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+NEG_INF=-1e30
+rng = np.random.default_rng(0)
+B,H,S,D,KB = 2,4,2048,64,512
+q = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+nb = S // KB
+kb = k.reshape(B,H,nb,KB,D).transpose(2,0,1,3,4)
+vb = v.reshape(B,H,nb,KB,D).transpose(2,0,1,3,4)
+scale = 1.0/np.sqrt(D)
+
+# bf16 s-blocks computed OUTSIDE, softmax-scan INSIDE (same numerics as orig fwd)
+def from_sbf(sbf, vb):
+    def step(carry, inputs):
+        o, m, l = carry
+        sb, vblk = inputs
+        s = sb.astype(jnp.float32) * scale
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p.astype(vblk.dtype), vblk).astype(jnp.float32)
+        return (o_new, m_new, l_new), None
+    o0 = jnp.zeros((B,H,S,D), jnp.float32); m0 = jnp.full((B,H,S), NEG_INF, jnp.float32); l0 = jnp.zeros((B,H,S), jnp.float32)
+    (o, m, l), _ = lax.scan(step, (o0,m0,l0), (sbf, vb))
+    return (o / jnp.maximum(l,1e-30)[..., None]).astype(jnp.bfloat16)
+
+sbf = jnp.stack([jnp.einsum("bhqd,bhkd->bhqk", q, kb[j]) for j in range(nb)])  # bf16
+_, g = jax.jit(jax.value_and_grad(lambda s: from_sbf(s, vb).astype(jnp.float32).sum()))(sbf)
+print("dsbf nan:", bool(jnp.isnan(g.astype(jnp.float32)).any()), flush=True)
+
+# dot INSIDE scan, everything else outside suspicion: loss = sum of per-block s·const
+def dot_in_scan(q):
+    def step(acc, kblk):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk).astype(jnp.float32)
+        return acc + (jnp.tanh(s)).sum(), None
+    acc, _ = lax.scan(step, jnp.zeros((), jnp.float32), kb)
+    return acc
+_, gq = jax.jit(jax.value_and_grad(dot_in_scan))(q)
+print("dot-in-scan dq nan:", bool(jnp.isnan(gq.astype(jnp.float32)).any()), flush=True)

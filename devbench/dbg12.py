@@ -1,0 +1,14 @@
+import jax
+jax.config.update("jax_debug_nans", True)
+import jax.numpy as jnp, numpy as np
+from ray_tpu.ops.attention import blockwise_attention
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.standard_normal((2,4,2048,64)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((2,4,2048,64)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((2,4,2048,64)), jnp.bfloat16)
+f = lambda q,k,v: blockwise_attention(q,k,v,causal=False,kv_block=512).astype(jnp.float32).sum()
+try:
+    _, g = jax.value_and_grad(f, argnums=(0,1,2))(q,k,v)
+    print("no nan raised")
+except FloatingPointError as e:
+    import traceback; traceback.print_exc()

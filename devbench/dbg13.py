@@ -1,0 +1,34 @@
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+NEG_INF=-1e30
+rng = np.random.default_rng(0)
+B,H,S,D,KB = 2,4,2048,64,512
+def blockwise(q, k, v):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]; nb = skv // KB
+    scale = 1.0/np.sqrt(d)
+    kb = k.reshape(b,h,nb,KB,d).transpose(2,0,1,3,4)
+    vb = v.reshape(b,h,nb,KB,d).transpose(2,0,1,3,4)
+    def step(carry, inputs):
+        o, m, l = carry
+        kblk, vblk = inputs
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk,
+                       preferred_element_type=jnp.float32) * scale
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
+        return (o_new, m_new, l_new), None
+    o0 = jnp.zeros((b,h,sq,d), jnp.float32); m0 = jnp.full((b,h,sq), NEG_INF, jnp.float32); l0 = jnp.zeros((b,h,sq), jnp.float32)
+    (o, m, l), _ = lax.scan(step, (o0,m0,l0), (kb, vb))
+    return (o / jnp.maximum(l,1e-30)[..., None]).astype(q.dtype)
+
+q = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+f = lambda q,k,v: blockwise(q,k,v).astype(jnp.float32).sum()
+_, g = jax.jit(jax.value_and_grad(f, argnums=(0,1,2)))(q,k,v)
+print("preferred-f32: nan:", [bool(jnp.isnan(x.astype(jnp.float32)).any()) for x in g], flush=True)

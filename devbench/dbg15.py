@@ -1,0 +1,26 @@
+import jax, jax.numpy as jnp, numpy as np
+import ray_tpu.ops.attention as A
+A.INTERPRET = True
+rng = np.random.default_rng(0)
+def chk(B,H,HK,S,D, causal=True):
+    q = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B,HK,S,D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B,HK,S,D)), jnp.bfloat16)
+    def lf(f):
+        def g(q,k,v):
+            o = f(q,k,v)
+            w = jnp.asarray(np.linspace(0.5, 1.5, o.size).reshape(o.shape), jnp.float32)
+            return (o.astype(jnp.float32)*w).sum()
+        return g
+    f1 = lf(lambda q,k,v: A.flash_attention(q,k,v,causal,None,True))
+    f2 = lf(lambda q,k,v: A.attention_reference(q,k,v,causal=causal))
+    v1, g1 = jax.jit(jax.value_and_grad(f1, argnums=(0,1,2)))(q,k,v)
+    v2, g2 = jax.jit(jax.value_and_grad(f2, argnums=(0,1,2)))(q,k,v)
+    print(f"B{B} H{H}/{HK} S{S} D{D} causal={causal}: val rel {abs(float(v1-v2))/max(abs(float(v2)),1e-9):.2e}", flush=True)
+    for name, a, b in zip("dq dk dv".split(), g1, g2):
+        a = a.astype(jnp.float32); b = b.astype(jnp.float32)
+        rel = float(jnp.abs(a-b).max()) / max(float(jnp.abs(b).max()), 1e-9)
+        print(f"  {name}: rel {rel:.4f} nan={bool(jnp.isnan(a).any())}", flush=True)
+chk(1,2,2,256,64)
+chk(1,4,2,256,64)
+chk(1,2,2,256,64, causal=False)

@@ -1,0 +1,18 @@
+import jax, jax.numpy as jnp, numpy as np
+from ray_tpu.ops.attention import flash_attention, blockwise_attention, attention_reference
+
+rng = np.random.default_rng(0)
+B,H,S,D = 2,4,512,64
+q = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+
+def loss_flash(q,k,v): return flash_attention(q,k,v,True,None,True).astype(jnp.float32).sum()
+def loss_block(q,k,v): return blockwise_attention(q,k,v,causal=True).astype(jnp.float32).sum()
+def loss_ref(q,k,v): return attention_reference(q,k,v,causal=True).astype(jnp.float32).sum()
+
+for name, f in [("flash", loss_flash), ("block", loss_block), ("ref", loss_ref)]:
+    val, grads = jax.value_and_grad(f, argnums=(0,1,2))(q,k,v)
+    gn = [float(jnp.abs(g).max()) for g in grads]
+    has_nan = [bool(jnp.isnan(g.astype(jnp.float32)).any()) for g in grads]
+    print(name, float(val), "max|g|:", gn, "nan:", has_nan, flush=True)

@@ -1,0 +1,20 @@
+import sys, jax, jax.numpy as jnp, numpy as np
+from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn, param_logical_axes
+
+attn = sys.argv[1] if len(sys.argv)>1 else "flash"
+layers = int(sys.argv[2]) if len(sys.argv)>2 else 16
+cfg = LlamaConfig(vocab_size=32128, hidden_size=2048, intermediate_size=8192,
+    num_layers=layers, num_heads=32, num_kv_heads=8, head_dim=64,
+    max_seq_len=2048, tie_embeddings=True, dtype="bfloat16")
+params = jax.jit(lambda k: init_params(cfg, k))(jax.random.PRNGKey(0))
+rng = np.random.default_rng(0)
+tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 2048), dtype=np.int32))
+targets = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 2048), dtype=np.int32))
+val, grads = jax.jit(jax.value_and_grad(
+    lambda p,t,y: loss_fn(cfg,p,t,y,attn_impl=attn,remat=True)))(params, tokens, targets)
+print("loss", float(val), flush=True)
+flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+for path, g in flat:
+    n = bool(jnp.isnan(g.astype(jnp.float32)).any())
+    if n: print("NAN at", jax.tree_util.keystr(path), flush=True)
+print("done", flush=True)

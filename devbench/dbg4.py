@@ -1,0 +1,16 @@
+import sys, jax, jax.numpy as jnp, numpy as np
+from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn
+
+attn = sys.argv[1]; dtype = sys.argv[2]; remat = sys.argv[3] == "remat"; seq = int(sys.argv[4])
+cfg = LlamaConfig(vocab_size=32128, hidden_size=2048, intermediate_size=8192,
+    num_layers=2, num_heads=32, num_kv_heads=8, head_dim=64,
+    max_seq_len=max(seq,2048), tie_embeddings=True, dtype=dtype)
+params = jax.jit(lambda k: init_params(cfg, k))(jax.random.PRNGKey(0))
+rng = np.random.default_rng(0)
+tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, seq), dtype=np.int32))
+targets = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, seq), dtype=np.int32))
+val, grads = jax.jit(jax.value_and_grad(
+    lambda p,t,y: loss_fn(cfg,p,t,y,attn_impl=attn,remat=remat)))(params, tokens, targets)
+nans = [jax.tree_util.keystr(p) for p,g in jax.tree_util.tree_flatten_with_path(grads)[0]
+        if bool(jnp.isnan(g.astype(jnp.float32)).any())]
+print(f"attn={attn} dtype={dtype} remat={remat} seq={seq}: loss={float(val):.4f} nans={nans}", flush=True)

@@ -1,0 +1,29 @@
+import sys, jax, jax.numpy as jnp, numpy as np
+from ray_tpu.models.llama import LlamaConfig, init_params, forward
+from ray_tpu.ops.norms import rms_norm
+cfg = LlamaConfig(vocab_size=32128, hidden_size=2048, intermediate_size=8192,
+    num_layers=2, num_heads=32, num_kv_heads=8, head_dim=64,
+    max_seq_len=2048, tie_embeddings=True, dtype="bfloat16")
+params = jax.jit(lambda k: init_params(cfg, k))(jax.random.PRNGKey(0))
+rng = np.random.default_rng(0)
+tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 2048), dtype=np.int32))
+
+def body_only(p, t):
+    # forward but stop before lm head: reuse forward by taking logits? no - sum of hidden
+    import ray_tpu.models.llama as L
+    from jax import lax
+    from functools import partial
+    b, s = t.shape
+    positions = jnp.arange(s)
+    x = p["embed_tokens"][t]
+    inv_freq = L.rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    layer_fn = partial(L._layer, cfg, inv_freq=inv_freq, positions=positions,
+                       attn_impl="blockwise", sp_axis=None)
+    x, _ = lax.scan(lambda x, lp: (layer_fn(x, lp), None), x, p["layers"])
+    x = rms_norm(x, p["final_norm"], cfg.norm_eps)
+    return x.astype(jnp.float32).sum()
+
+val, grads = jax.jit(jax.value_and_grad(body_only))(params, tokens)
+nans = [jax.tree_util.keystr(p) for p,g in jax.tree_util.tree_flatten_with_path(grads)[0]
+        if bool(jnp.isnan(g.astype(jnp.float32)).any())]
+print("body-only:", float(val), "nans:", nans, flush=True)

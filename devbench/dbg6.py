@@ -1,0 +1,37 @@
+import jax, jax.numpy as jnp, numpy as np
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.ops.attention import blockwise_attention
+
+rng = np.random.default_rng(0)
+def chk(name, f, *args):
+    val, grads = jax.jit(jax.value_and_grad(f, argnums=tuple(range(len(args)))))(*args)
+    nan = [bool(jnp.isnan(g.astype(jnp.float32)).any()) for g in grads]
+    print(name, float(val), "nan:", nan, flush=True)
+
+x = jnp.asarray(rng.standard_normal((2,2048,2048)), jnp.bfloat16)
+w = jnp.ones((2048,), jnp.bfloat16)
+chk("rms_norm", lambda x,w: rms_norm(x,w,1e-5).astype(jnp.float32).sum(), x, w)
+
+B,H,HK,S,D = 2,32,8,2048,64
+q = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B,HK,S,D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B,HK,S,D)), jnp.bfloat16)
+inv_freq = rope_frequencies(D, 500000.0, None)
+pos = jnp.arange(S)
+chk("rope", lambda q: apply_rope(q, pos, inv_freq).astype(jnp.float32).sum(), q)
+chk("blockwise-gqa", lambda q,k,v: blockwise_attention(q,k,v,causal=True).astype(jnp.float32).sum(), q,k,v)
+def rope_attn(q,k,v):
+    qr = apply_rope(q, pos, inv_freq); kr = apply_rope(k, pos, inv_freq)
+    return blockwise_attention(qr,kr,v,causal=True).astype(jnp.float32).sum()
+chk("rope+attn", rope_attn, q,k,v)
+
+h = jnp.asarray(rng.standard_normal((2,2048,2048)), jnp.bfloat16)
+wg = jnp.asarray(rng.standard_normal((2048,8192))/45, jnp.bfloat16)
+wu = jnp.asarray(rng.standard_normal((2048,8192))/45, jnp.bfloat16)
+wd = jnp.asarray(rng.standard_normal((8192,2048))/90, jnp.bfloat16)
+def mlp(h,wg,wu,wd):
+    gate = jax.nn.silu((h @ wg).astype(jnp.float32)).astype(h.dtype)
+    up = h @ wu
+    return ((gate*up) @ wd).astype(jnp.float32).sum()
+chk("mlp", mlp, h,wg,wu,wd)

@@ -1,0 +1,19 @@
+import jax, jax.numpy as jnp, numpy as np
+from ray_tpu.ops.attention import blockwise_attention
+rng = np.random.default_rng(0)
+def chk(name, S, H, HK, kv_block=512, dt=jnp.bfloat16):
+    q = jnp.asarray(rng.standard_normal((2,H,S,64)), dt)
+    k = jnp.asarray(rng.standard_normal((2,HK,S,64)), dt)
+    v = jnp.asarray(rng.standard_normal((2,HK,S,64)), dt)
+    f = lambda q,k,v: blockwise_attention(q,k,v,causal=True,kv_block=kv_block).astype(jnp.float32).sum()
+    _, grads = jax.jit(jax.value_and_grad(f, argnums=(0,1,2)))(q,k,v)
+    nan = [bool(jnp.isnan(g.astype(jnp.float32)).any()) for g in grads]
+    print(f"{name}: S={S} H={H} HK={HK} blk={kv_block} {dt.__name__} nan={nan}", flush=True)
+
+chk("a", 512, 32, 8)
+chk("b", 2048, 4, 4)
+chk("c", 2048, 32, 8)
+chk("d", 2048, 4, 4, kv_block=2048)
+chk("e", 512, 4, 4)
+chk("f", 1024, 4, 4)
+chk("g", 2048, 4, 4, dt=jnp.float32)

@@ -1,0 +1,19 @@
+import jax, jax.numpy as jnp, numpy as np
+import ray_tpu.ops.attention as A
+rng = np.random.default_rng(0)
+def chk(name, causal, neg):
+    old = A.NEG_INF; A.NEG_INF = neg
+    try:
+        q = jnp.asarray(rng.standard_normal((2,4,2048,64)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((2,4,2048,64)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((2,4,2048,64)), jnp.bfloat16)
+        f = lambda q,k,v: A.blockwise_attention(q,k,v,causal=causal,kv_block=512).astype(jnp.float32).sum()
+        _, grads = jax.jit(jax.value_and_grad(f, argnums=(0,1,2)))(q,k,v)
+        nan = [bool(jnp.isnan(g.astype(jnp.float32)).any()) for g in grads]
+        print(f"{name}: causal={causal} neg={neg}: nan={nan}", flush=True)
+    finally:
+        A.NEG_INF = old
+chk("causal -1e30", True, -1e30)
+chk("noncausal -1e30", False, -1e30)
+chk("causal -1e9", True, -1e9)
+chk("causal -3e38", True, -3e38)

@@ -1,0 +1,47 @@
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+NEG_INF = -1e30
+rng = np.random.default_rng(0)
+B,H,S,D,KB = 2,4,2048,64,512
+
+def blockwise(q, k, v, cast_qk_f32=False, cast_p=True, m0=NEG_INF):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    nblocks = skv // KB
+    scale = 1.0 / np.sqrt(d)
+    kb = k.reshape(b, h, nblocks, KB, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblocks, KB, d).transpose(2, 0, 1, 3, 4)
+    def step(carry, inputs):
+        o, m, l = carry
+        kblk, vblk = inputs
+        if cast_qk_f32:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kblk.astype(jnp.float32)) * scale
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk).astype(jnp.float32) * scale
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        pv = p.astype(vblk.dtype) if cast_p else p
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", pv, vblk.astype(pv.dtype)).astype(jnp.float32)
+        return (o_new, m_new, l_new), None
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    mm0 = jnp.full((b, h, sq), m0, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (o, m, l), _ = lax.scan(step, (o0, mm0, l0), (kb, vb))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l[..., None]).astype(q.dtype)
+
+q = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+def chk(name, **kw):
+    f = lambda q,k,v: blockwise(q,k,v,**kw).astype(jnp.float32).sum()
+    _, g = jax.jit(jax.value_and_grad(f, argnums=(0,1,2)))(q,k,v)
+    nan = [bool(jnp.isnan(x.astype(jnp.float32)).any()) for x in g]
+    print(name, kw, "nan:", nan, flush=True)
+chk("base")
+chk("qk_f32", cast_qk_f32=True)
+chk("p_f32", cast_p=False)
+chk("m0_-30", m0=-30.0)
+chk("m0_-3e4", m0=-3e4)

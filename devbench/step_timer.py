@@ -1,0 +1,55 @@
+"""Dev harness: time one train step config on the real TPU chip."""
+import argparse, functools, time, sys
+import jax, jax.numpy as jnp, numpy as np, optax
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_tpu.train.spmd import make_llama_train_step
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--attn", default="flash")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--profile", default="")
+    ap.add_argument("--remat", default="full")
+    args = ap.parse_args()
+
+    cfg = LlamaConfig(
+        vocab_size=32128, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+        max_seq_len=args.seq, tie_embeddings=True, dtype="bfloat16")
+    n_params = cfg.num_params()
+    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    step_fn, init_state, shard = make_llama_train_step(
+        cfg, mesh, attn_impl=args.attn, remat=args.remat != "none")
+    state = init_state()
+    rng = np.random.default_rng(0)
+    tokens = shard(rng.integers(0, cfg.vocab_size, (args.batch, args.seq), dtype=np.int32))
+    targets = shard(rng.integers(0, cfg.vocab_size, (args.batch, args.seq), dtype=np.int32))
+
+    t0=time.time()
+    state, m = step_fn(state, tokens, targets)
+    jax.block_until_ready(m["loss"]); print(f"compile+1st: {time.time()-t0:.1f}s", flush=True)
+    for _ in range(args.warmup):
+        state, m = step_fn(state, tokens, targets)
+    jax.block_until_ready(m["loss"])
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, m = step_fn(state, tokens, targets)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+    if args.profile:
+        jax.profiler.stop_trace()
+    toks = args.batch * args.seq / dt
+    flops = 6 * n_params * toks
+    print(f"batch={args.batch} seq={args.seq} attn={args.attn}: {dt*1e3:.1f} ms/step, "
+          f"{toks:,.0f} tok/s, {flops/1e12:.1f} TFLOP/s (6N), vs_baseline={flops/1.59e14:.3f}, "
+          f"loss={float(m['loss']):.3f}", flush=True)
+
+if __name__ == "__main__":
+    main()

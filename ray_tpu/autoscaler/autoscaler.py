@@ -71,8 +71,15 @@ class Autoscaler:
                 (InstanceStatus.REQUESTED, InstanceStatus.ALLOCATED)):
             status = self.provider.node_status(inst.cloud_id)
             if status == "failed":
+                # REQUESTED never materialized -> ALLOCATION_FAILED; an
+                # ALLOCATED node that failed after create (e.g. TPU slice
+                # preempted) is simply gone -> TERMINATED. The FSM only
+                # permits ALLOCATION_FAILED from REQUESTED.
                 self.instances.transition(
-                    inst.instance_id, InstanceStatus.ALLOCATION_FAILED)
+                    inst.instance_id,
+                    InstanceStatus.ALLOCATION_FAILED
+                    if inst.status == InstanceStatus.REQUESTED
+                    else InstanceStatus.TERMINATED)
                 continue
             if inst.status == InstanceStatus.REQUESTED and status == "running":
                 self.instances.transition(
@@ -140,7 +147,8 @@ class Autoscaler:
                 self.instances.transition(inst.instance_id,
                                           InstanceStatus.TERMINATED)
                 continue
-            idle = node["available"] == node["resources"]
+            idle = (node["available"] == node["resources"]
+                    and not node.get("pending", 0))
             if not idle:
                 self._idle_since.pop(inst.node_id, None)
                 continue

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -64,6 +65,8 @@ class HeadServer:
         from collections import deque
 
         self.task_events: deque = deque(maxlen=100_000)
+        self._task_events_total = 0  # monotone append count (cursor base)
+        self._events_epoch = uuid.uuid4().hex  # head incarnation id
         self._subs: dict[str, set[ServerConnection]] = {}  # channel -> conns
         self._node_conns: dict[str, ServerConnection] = {}
         self._register_handlers()
@@ -94,6 +97,7 @@ class HeadServer:
         r("available_resources", self._available_resources)
         r("state_snapshot", self._state_snapshot)
         r("report_task_events", self._report_task_events)
+        r("get_task_events", self._get_task_events)
         r("cluster_load", self._cluster_load)
         r("create_placement_group", self._create_pg)
         r("remove_placement_group", self._remove_pg)
@@ -535,6 +539,7 @@ class HeadServer:
         """Workers flush their task-event batches here (reference:
         GcsTaskManager as the cluster-wide task-event store)."""
         self.task_events.extend(events)
+        self._task_events_total += len(events)
         return {"ok": True}
 
     async def _state_snapshot(self, conn: ServerConnection):
@@ -565,8 +570,26 @@ class HeadServer:
             "workers": {
                 wid: {"addr": list(addr)} for wid, addr in self.workers.items()
             },
-            "task_events": list(self.task_events),
         }
+
+    async def _get_task_events(self, conn: ServerConnection, since: int = 0,
+                               limit: int = 100_000, epoch: str = ""):
+        """Cursored task-event read: ``since`` is the monotone count of events
+        the caller has already seen, so state-API polls ship only the delta
+        instead of the full 100k-event history on every snapshot (reference:
+        GcsTaskManager serves task events separately from the entity tables).
+        ``epoch`` identifies this head incarnation — a mismatch tells the
+        client its cursor (and cache) belong to a dead head and must reset.
+        Events older than the deque cap are dropped silently."""
+        import itertools
+
+        if epoch and epoch != self._events_epoch:
+            since = 0
+        dropped = self._task_events_total - len(self.task_events)
+        start = max(0, min(since, self._task_events_total) - dropped)
+        events = list(itertools.islice(self.task_events, start, start + limit))
+        return {"events": events, "cursor": dropped + start + len(events),
+                "epoch": self._events_epoch}
 
     async def _cluster_load(self, conn: ServerConnection):
         """Autoscaler demand feed (reference: GcsAutoscalerStateManager's
@@ -575,7 +598,8 @@ class HeadServer:
         return {
             "nodes": {
                 nid: {"resources": n.resources, "available": n.available,
-                      "alive": n.alive, "labels": n.labels}
+                      "alive": n.alive, "labels": n.labels,
+                      "pending": len(n.pending_demands)}
                 for nid, n in self.nodes.items()
             },
             "pending_demands": [

@@ -244,12 +244,11 @@ class NodeDaemon:
         if not self._feasible(resources):
             # Spillback: find a feasible node from the head's view
             # (reference: cluster_lease_manager spills to best remote node).
-            nodes = await self._head.call("list_nodes")
-            for nid, info in nodes.items():
-                if nid == self.node_id or not info["alive"]:
-                    continue
-                if all(info["resources"].get(k, 0.0) >= v for k, v in resources.items()):
-                    return {"spill": info["addr"]}
+            if allow_spill:
+                nodes = await self._head.call("list_nodes")
+                best = self._spill_target(nodes, resources, key="resources")
+                if best is not None:
+                    return {"spill": best}
             return {"error": f"infeasible resource demand {resources}"}
         fut = asyncio.get_running_loop().create_future()
         req = _PendingLease(dict(resources), fut, env_hash)
@@ -274,7 +273,12 @@ class NodeDaemon:
                 pass
             if fut.done():
                 return fut.result()
-            if not allow_spill:
+            # Spill only when this node's resources are genuinely busy. When
+            # the demand fits (we are merely waiting for a forked worker to
+            # register) the grant is imminent — spilling then ping-pongs the
+            # request between nodes that are each mid-fork and none ever
+            # grants (each hop re-queues behind a fresh worker start).
+            if not allow_spill or self._fits(req.resources):
                 continue
             try:
                 nodes = await self._head.call("list_nodes")
@@ -282,16 +286,30 @@ class NodeDaemon:
                 continue
             if fut.done():  # granted while we were asking the head
                 return fut.result()
-            for nid, info in nodes.items():
-                if nid == self.node_id or not info["alive"]:
-                    continue
-                if all(info["available"].get(k, 0.0) >= v
-                       for k, v in resources.items()):
-                    # No await between the done-check and removal: the grant
-                    # path runs on this loop, so this hand-off is atomic.
-                    self._pending = [p for p in self._pending if p is not req]
-                    fut.cancel()
-                    return {"spill": info["addr"]}
+            best = self._spill_target(nodes, resources, key="available")
+            if best is not None:
+                # No await between the done-check and removal: the grant
+                # path runs on this loop, so this hand-off is atomic.
+                self._pending = [p for p in self._pending if p is not req]
+                fut.cancel()
+                return {"spill": best}
+
+    def _spill_target(self, nodes: dict, resources: dict,
+                      key: str) -> list | None:
+        """Pick the remote node with the most headroom that satisfies the
+        demand under ``key`` ('resources' = feasibility, 'available' = can
+        grant now). Most-headroom (vs first-match) spreads spilled backlog
+        instead of dogpiling one node."""
+        best, best_slack = None, -1.0
+        for nid, info in nodes.items():
+            if nid == self.node_id or not info["alive"]:
+                continue
+            if not all(info[key].get(k, 0.0) >= v for k, v in resources.items()):
+                continue
+            slack = sum(info[key].get(k, 0.0) - v for k, v in resources.items())
+            if slack > best_slack:
+                best, best_slack = info["addr"], slack
+        return best
 
     def _idle_worker(self, env_hash: str = "",
                      pristine_only: bool = False) -> WorkerProc | None:

@@ -404,6 +404,13 @@ class ClusterRuntime:
                               env_hash=env_hash, timeout=None,
                               allow_spill=hops < 3)
             hops += 1
+        if res.get("spill"):
+            # Defensive: the final hop runs with allow_spill=False, and the
+            # daemon protocol never returns a spill on that path today. Guard
+            # anyway so a future daemon change surfaces as a scheduling error
+            # here instead of a KeyError on the missing grant below.
+            raise ValueError(
+                f"lease spill chain exhausted for {spec.resources}")
         if res.get("error"):
             raise ValueError(res["error"])
         client = AsyncRpcClient(*tuple(res["addr"]))
@@ -636,6 +643,10 @@ class ClusterRuntime:
         snap = self.head.call("state_snapshot")
         snap["objects"] = self.store.stats()
         return snap
+
+    def task_events(self, since: int = 0, epoch: str = "") -> dict:
+        """Cluster-wide task events newer than the ``since`` cursor."""
+        return self.head.call("get_task_events", since=since, epoch=epoch)
 
     def cluster_resources(self) -> dict[str, float]:
         return self.head.call("cluster_resources")

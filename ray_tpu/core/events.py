@@ -130,19 +130,37 @@ def global_event_buffer() -> TaskEventBuffer:
     return _buffer
 
 
+# Cluster-wide events fetched so far, keyed by head cursor + epoch: repeated
+# polls (state API / dashboard) ship only the delta over RPC, not the full
+# history. Bounded to the head's own retention window; an epoch change (head
+# restart) resets cursor and cache.
+import collections
+
+_cluster_cache: collections.deque = collections.deque(maxlen=100_000)
+_cluster_cursor = 0
+_cluster_epoch = ""
+
+
 def all_events() -> list[TaskEvent]:
     """This process's events plus, in cluster mode, the cluster-wide events
     the head collected from worker flushes."""
+    global _cluster_cursor, _cluster_epoch
     events = _buffer.events()
     from ray_tpu.core.worker import global_worker
 
     rt = global_worker.runtime
     if rt is not None and global_worker.mode == "cluster":
         try:
-            for d in rt.state_snapshot().get("task_events", []):
-                events.append(TaskEvent(**d))
+            res = rt.task_events(since=_cluster_cursor, epoch=_cluster_epoch)
+            epoch = res.get("epoch", "")
+            if epoch != _cluster_epoch:  # new head incarnation
+                _cluster_cache.clear()
+                _cluster_epoch = epoch
+            _cluster_cache.extend(TaskEvent(**d) for d in res.get("events", []))
+            _cluster_cursor = res.get("cursor", _cluster_cursor)
         except Exception:
             pass  # head unreachable: local view still useful
+        events.extend(_cluster_cache)
     return events
 
 

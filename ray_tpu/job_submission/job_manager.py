@@ -72,6 +72,11 @@ def _supervisor_class():
                     self._entrypoint, shell=True, env=env,
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 )
+                if self._stopped:
+                    # stop() ran between the top-of-run check and Popen: it
+                    # saw no child to signal, so terminate the child here or
+                    # it runs to completion under a STOPPED record.
+                    self._terminate_child()
 
                 def pump():
                     for line in self._proc.stdout:
@@ -104,9 +109,29 @@ def _supervisor_class():
                 return True
             if self._proc.poll() is None:
                 self._stopped = True
-                self._proc.terminate()
+                self._terminate_child()
                 return True
             return False  # already finished; don't rewrite history
+
+        def _terminate_child(self, grace_s: float = 3.0) -> None:
+            """SIGTERM, then SIGKILL after a grace period — an entrypoint
+            that ignores SIGTERM must not stay RUNNING forever (reference:
+            job_supervisor.py polls then escalates to SIGKILL)."""
+            import threading
+
+            proc = self._proc
+            proc.terminate()
+
+            def escalate():
+                try:
+                    proc.wait(timeout=grace_s)
+                except Exception:
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+
+            threading.Thread(target=escalate, daemon=True).start()
 
         def logs(self) -> str:
             return b"".join(self._output).decode(errors="replace")

@@ -28,6 +28,10 @@ from jax import lax
 
 NEG_INF = -1e30
 
+# When True, Pallas kernels run in interpreter mode (and the Pallas path is
+# taken off-TPU too) — lets CPU tests exercise the exact kernel code.
+INTERPRET = False
+
 
 def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
     """Expand KV heads to match query heads (GQA)."""
@@ -84,7 +88,12 @@ def blockwise_attention(q, k, v, causal: bool = True,
         o, m, l = carry
         blk_idx, kblk, vblk = inputs
         kpos = blk_idx * kv_block + jnp.arange(kv_block) + kv_offset
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk).astype(jnp.float32) * scale
+        # preferred_element_type (bf16 MXU inputs, f32 accumulate) rather
+        # than a bf16 dot + astype: the cast form miscompiles under XLA
+        # fusion in the scan's backward (NaN dq/dk on CPU and TPU for
+        # multi-block bf16 inputs) and is lower-precision anyway.
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk,
+                       preferred_element_type=jnp.float32) * scale
         valid = (kpos[None, :] - kv_offset) < skv  # mask zero-padding
         if causal:
             full_mask = (kpos[None, :] <= qpos[:, None]) & valid
@@ -96,8 +105,8 @@ def blockwise_attention(q, k, v, causal: bool = True,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
         o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(vblk.dtype), vblk
-        ).astype(jnp.float32)
+            "bhqk,bhkd->bhqd", p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
         return (o_new, m_new, l_new), None
 
     o0 = jnp.zeros((b, h, sq, d), jnp.float32)
@@ -113,11 +122,13 @@ def blockwise_attention(q, k, v, causal: bool = True,
 # Pallas TPU flash-attention forward kernel
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_seq_len: int,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_seq_len: int,
                       block_k: int, sm_scale: float, causal: bool,
                       block_q: int):
     """Grid: (batch*heads, q_blocks). K/V stream through VMEM in block_k
-    chunks; online softmax state lives in registers/VMEM."""
+    chunks; online softmax state lives in registers/VMEM. Also emits the
+    per-row logsumexp so the backward can recompute p = exp(s - lse)
+    without a second online pass (FlashAttention-2 shape)."""
     from jax.experimental import pallas as pl  # local: TPU-only dependency
 
     qi = pl.program_id(1)
@@ -158,6 +169,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_seq_len: int,
     o, m, l = lax.fori_loop(0, upper, body, (o0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = m + jnp.log(l)
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
@@ -180,7 +192,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
         _flash_fwd_kernel, kv_seq_len=skv, block_k=block_k,
         sm_scale=sm_scale, causal=causal, block_q=block_q,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -188,19 +200,179 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
             pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=INTERPRET,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, kv_seq_len: int, block_k: int,
+                         sm_scale: float, causal: bool, block_q: int):
+    """dQ, one q block per grid step: dq = Σ_j (p ∘ (dO·Vᵀ − Δ))·K · scale
+    with p recomputed from the saved logsumexp."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[...]                       # [bq, d] bf16
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...]                   # [bq] f32
+    delta = delta_ref[...]               # [bq] f32
+    nkv = kv_seq_len // block_k
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])    # [bq, bk]
+        dp = jnp.dot(do.astype(v.dtype), v.T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32)
+
+    if causal:
+        upper = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, nkv)
+    else:
+        upper = nkv
+    d = q_ref.shape[-1]
+    dq = lax.fori_loop(0, upper, body, jnp.zeros((q.shape[0], d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, q_seq_len: int, block_q: int,
+                          sm_scale: float, causal: bool, block_k: int):
+    """dK/dV, one kv block per grid step: dv = Σ_i pᵀ·dO,
+    dk = Σ_i (p ∘ (dO·Vᵀ − Δ))ᵀ·Q · scale. Causal skips q blocks above
+    the diagonal (they can't attend to this kv block)."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k = k_ref[...]                       # [bk, d] bf16
+    v = v_ref[...]
+    nq = q_seq_len // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[pl.ds(i * block_q, block_q)]
+        delta = delta_ref[pl.ds(i * block_q, block_q)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])    # [bq, bk]
+        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.dot(do.astype(v.dtype), v.T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jnp.dot(ds.astype(q.dtype).T, q,
+                          preferred_element_type=jnp.float32)
+        return dk, dv
+
+    lower = lax.div(ki * block_k, block_q) if causal else 0
+    d = k_ref.shape[-1]
+    z = jnp.zeros((k.shape[0], d), jnp.float32)
+    dk, dv = lax.fori_loop(lower, nq, body, (z, z))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, sm_scale: float,
+                      block_q: int = 512, block_k: int = 512):
+    """q/k/v here are already GQA-expanded to [B, H, S, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, skv, d)
+    vf = v.reshape(b * h, skv, d)
+    dof = g.reshape(b * h, sq, d).astype(q.dtype)
+    lsef = lse.reshape(b * h, sq)
+    # Δ_i = rowsum(dO ∘ O): the softmax-normalization term of ds.
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    deltaf = delta.reshape(b * h, sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, kv_seq_len=skv,
+                          block_k=block_k, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+        ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
-    )(qf, kf, vf)
-    return out.reshape(b, h, sq, d)
+        interpret=INTERPRET,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, q_seq_len=sq,
+                          block_q=block_q, sm_scale=sm_scale, causal=causal,
+                          block_k=block_k),
+        grid=(b * h, skv // block_k),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, skv, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), q.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=INTERPRET,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, skv, d),
+            dv.reshape(b, h, skv, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: float | None = None, use_pallas: bool = True):
-    """Flash attention: Pallas TPU kernel forward, blockwise-recompute backward.
+    """Flash attention: Pallas TPU kernels for forward AND backward
+    (dq/dk/dv with p recomputed inside the kernel from the saved lse).
 
     Falls back to ``blockwise_attention`` off-TPU (or use_pallas=False).
     """
@@ -211,28 +383,35 @@ def _flash_fwd(q, k, v, causal, sm_scale, use_pallas):
     h = q.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     on_tpu = jax.default_backend() == "tpu"
-    if use_pallas and on_tpu:
-        out = _flash_fwd_pallas(_cast(q), _repeat_kv(_cast(k), h),
-                                _repeat_kv(_cast(v), h), causal, scale)
+    if use_pallas and (on_tpu or INTERPRET):
+        kr, vr = _repeat_kv(k, h), _repeat_kv(v, h)
+        out, lse = _flash_fwd_pallas(q, kr, vr, causal, scale)
         out = out.astype(q.dtype)
-    else:
-        out = blockwise_attention(q, k, v, causal=causal, sm_scale=scale)
-    return out, (q, k, v)
+        return out, (q, k, v, out, lse)
+    out = blockwise_attention(q, k, v, causal=causal, sm_scale=scale)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, sm_scale, use_pallas, res, g):
-    q, k, v = res
-    # Recompute through the differentiable blockwise path.
+    q, k, v, out, lse = res
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if lse is not None:
+        h, hkv = q.shape[1], k.shape[1]
+        kr, vr = _repeat_kv(k, h), _repeat_kv(v, h)
+        dq, dk, dv = _flash_bwd_pallas(q, kr, vr, out, lse, g, causal, scale)
+        if hkv != h:  # GQA: fold the repeated query-head groups back
+            b, _, skv, d = dk.shape
+            rep = h // hkv
+            dk = dk.astype(jnp.float32).reshape(b, hkv, rep, skv, d).sum(2)
+            dv = dv.astype(jnp.float32).reshape(b, hkv, rep, skv, d).sum(2)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    # Off-TPU: recompute through the differentiable blockwise path.
     _, vjp = jax.vjp(
         lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
                                                sm_scale=sm_scale),
         q, k, v,
     )
     return vjp(g)
-
-
-def _cast(x):
-    return x
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

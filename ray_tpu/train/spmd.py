@@ -143,17 +143,31 @@ def make_mixtral_train_step(
 
 def _opt_shardings(optimizer, params, param_sh):
     """Optimizer-state shardings mirror their matching param leaves (ZeRO-
-    style: Adam moments shard exactly like the params they track)."""
-    shape = jax.eval_shape(optimizer.init, params)
+    style: Adam moments shard exactly like the params they track).
 
-    def match(leaf_shape):
-        # Find a param leaf with identical shape → reuse its sharding; scalars
-        # and unmatched leaves replicate.
-        flat_p, _ = jax.tree.flatten(params)
-        flat_s, _ = jax.tree.flatten(param_sh)
-        for p, s in zip(flat_p, flat_s):
-            if p.shape == leaf_shape.shape:
-                return s
-        return None
+    Matching is by key path, not shape: moment pytrees (mu/nu) embed the
+    param tree verbatim, so a state leaf's path ends with its param's path.
+    (Shape matching would silently give two same-shaped params with
+    different logical axes the first param's sharding.) Scalars and
+    unmatched leaves replicate."""
+    shapes = jax.eval_shape(optimizer.init, params)
+    p_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    s_leaves = jax.tree_util.tree_flatten_with_path(param_sh)[0]
+    by_path = {
+        tuple(map(str, path)): (leaf.shape, sh)
+        for (path, leaf), (_, sh) in zip(p_leaves, s_leaves)
+    }
 
-    return jax.tree.map(match, shape)
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
+        spath = tuple(map(str, path))
+        sharding = None
+        for n in range(len(spath), 0, -1):  # longest param-path suffix wins
+            hit = by_path.get(spath[-n:])
+            if hit is not None:
+                pshape, sh = hit
+                if pshape == leaf.shape:
+                    sharding = sh
+                break
+        out.append(sharding)
+    return jax.tree_util.tree_unflatten(jax.tree.structure(shapes), out)

@@ -70,15 +70,48 @@ def test_flash_pallas_interpret_matches_reference():
     from jax.experimental.pallas import tpu as pltpu
 
     q, k, v = _qkv(b=1, h=2, s=256, d=64)
-    ref = attention_reference(q, k, v, causal=True)
     with pltpu.force_tpu_interpret_mode():
         from ray_tpu.ops.attention import _flash_fwd_pallas
 
-        out = _flash_fwd_pallas(q, k, v, causal=True, sm_scale=1.0 / 8.0,
-                                block_q=128, block_k=128)
+        out, lse = _flash_fwd_pallas(q, k, v, causal=True, sm_scale=1.0 / 8.0,
+                                     block_q=128, block_k=128)
     ref = attention_reference(q, k, v, causal=True, sm_scale=1.0 / 8.0)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2,
                                rtol=2e-2)
+    # lse must reproduce softmax normalizers: exp(s - lse) rows sum to 1.
+    s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) / 8.0
+    mask = np.tril(np.ones((256, 256), bool))
+    s = np.where(mask, s, -np.inf)
+    ref_lse = np.log(np.exp(s).sum(-1))
+    np.testing.assert_allclose(np.asarray(lse), ref_lse, atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("h,hkv,causal", [(2, 2, True), (4, 2, True),
+                                          (2, 2, False)])
+def test_flash_pallas_backward_matches_reference(h, hkv, causal):
+    """Gradient equivalence of the Pallas dq/dk/dv kernels (interpret mode)
+    against autodiff through attention_reference — incl. the GQA fold."""
+    import ray_tpu.ops.attention as attn_mod
+
+    q, k, v = _qkv(b=1, h=h, hkv=hkv, s=256, d=64)
+    w = jnp.asarray(
+        np.linspace(0.5, 1.5, q.size).reshape(q.shape), jnp.float32)
+
+    def loss(f):
+        return lambda q, k, v: (f(q, k, v).astype(jnp.float32) * w).sum()
+
+    attn_mod.INTERPRET = True
+    try:
+        g = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal, None, True)), argnums=(0, 1, 2))(q, k, v)
+    finally:
+        attn_mod.INTERPRET = False
+    g_ref = jax.grad(loss(lambda q, k, v: attention_reference(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), g, g_ref):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        denom = max(np.abs(b).max(), 1e-9)
+        assert np.abs(a - b).max() / denom < 2e-2, name
 
 
 def test_ring_attention_matches_reference(cpu_mesh_devices):
