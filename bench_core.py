@@ -1,0 +1,283 @@
+"""Core-runtime microbenchmarks — the runtime-health envelope.
+
+Mirrors the reference's microbenchmark suite shape (reference:
+python/ray/_private/ray_perf.py, published numbers in
+release/perf_metrics/microbenchmark.json — reproduced in BASELINE.md): actor
+call rates, task throughput, object put/get rates and bandwidth, wait fan-in,
+placement-group churn. Results are written to PERF.json with the reference
+baseline beside each row.
+
+Hardware note recorded in the output: the reference numbers come from
+multi-core m5/m6i-class instances; this harness reports `nproc` so ratios can
+be read in context (head + daemons + driver + workers share the same cores).
+
+Run: python bench_core.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import remote
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.worker import global_worker
+from ray_tpu.utils.ids import JobID
+
+# Reference values from BASELINE.md (release/perf_metrics/microbenchmark.json).
+BASELINES = {
+    "1_1_actor_calls_sync": (1645.0, "calls/s"),
+    "1_1_actor_calls_async": (7528.0, "calls/s"),
+    "1_n_actor_calls_async": (6982.0, "calls/s"),
+    "n_n_actor_calls_async": (22975.0, "calls/s"),
+    "single_client_tasks_sync": (751.0, "tasks/s"),
+    "single_client_tasks_async": (5781.0, "tasks/s"),
+    "multi_client_tasks_async": (18575.0, "tasks/s"),
+    "single_client_put_calls": (4552.0, "puts/s"),
+    "single_client_get_calls": (10155.0, "gets/s"),
+    "single_client_put_gigabytes": (10.94, "GB/s"),
+    "single_client_wait_1k_refs": (4.27, "ops/s"),
+    "placement_group_create/removal": (589.0, "PGs/s"),
+}
+
+
+def timeit(name, fn, multiplier=1, min_time=2.0):
+    """Run fn repeatedly for ~min_time, return ops/sec (reference harness
+    shape: ray_perf.py timeit)."""
+    # Two warmup rounds: the first may fork workers (slow), the second runs
+    # against the warmed pool.
+    fn()
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    print(f"  {name}: {rate:,.1f}", file=sys.stderr)
+    return rate
+
+
+@remote
+def noop(*_args):
+    return None
+
+
+@remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def tick(self):
+        self.n += 1
+        return self.n
+
+    def noop(self):
+        return None
+
+
+def bench_actor_calls_sync():
+    a = Counter.remote()
+    ray_tpu.get(a.noop.remote(), timeout=60)  # ensure started
+    def op():
+        ray_tpu.get([a.noop.remote() for _ in range(10)])
+    rate = timeit("1_1_actor_calls_sync", lambda: ray_tpu.get(a.noop.remote()))
+    ray_tpu.kill(a)
+    return rate
+
+
+def bench_actor_calls_async(batch=200):
+    a = Counter.remote()
+    ray_tpu.get(a.noop.remote(), timeout=60)
+    def op():
+        ray_tpu.get([a.noop.remote() for _ in range(batch)])
+    rate = timeit("1_1_actor_calls_async", op, multiplier=batch)
+    ray_tpu.kill(a)
+    return rate
+
+
+def bench_1_n_actor_calls(n=4, batch=100):
+    actors = [Counter.remote() for _ in range(n)]
+    ray_tpu.get([a.noop.remote() for a in actors], timeout=120)
+    def op():
+        refs = []
+        for a in actors:
+            refs.extend(a.noop.remote() for _ in range(batch))
+        ray_tpu.get(refs)
+    rate = timeit("1_n_actor_calls_async", op, multiplier=n * batch)
+    for a in actors:
+        ray_tpu.kill(a)
+    return rate
+
+
+def bench_n_n_actor_calls(n=4, batch=100):
+    actors = [Counter.remote() for _ in range(n)]
+    ray_tpu.get([a.noop.remote() for a in actors], timeout=120)
+    results = [0.0] * n
+
+    def client(i):
+        refs = [actors[i].noop.remote() for _ in range(batch)]
+        ray_tpu.get(refs)
+
+    def op():
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    rate = timeit("n_n_actor_calls_async", op, multiplier=n * batch)
+    for a in actors:
+        ray_tpu.kill(a)
+    return rate
+
+
+def bench_tasks_sync():
+    ray_tpu.get(noop.remote(), timeout=60)
+    return timeit("single_client_tasks_sync", lambda: ray_tpu.get(noop.remote()))
+
+
+def bench_tasks_async(batch=500):
+    ray_tpu.get(noop.remote(), timeout=60)
+    def op():
+        ray_tpu.get([noop.remote() for _ in range(batch)])
+    return timeit("single_client_tasks_async", op, multiplier=batch)
+
+
+def bench_multi_client_tasks(n=4, batch=250):
+    ray_tpu.get(noop.remote(), timeout=60)
+
+    def client():
+        ray_tpu.get([noop.remote() for _ in range(batch)])
+
+    def op():
+        threads = [threading.Thread(target=client) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    return timeit("multi_client_tasks_async", op, multiplier=n * batch)
+
+
+def bench_put_calls():
+    payload = b"x" * 100
+    return timeit("single_client_put_calls", lambda: ray_tpu.put(payload))
+
+
+def bench_get_calls():
+    ref = ray_tpu.put(b"x" * 100)
+    return timeit("single_client_get_calls",
+                  lambda: [ray_tpu.get(ref) for _ in range(100)],
+                  multiplier=100)
+
+
+def bench_put_gigabytes():
+    arr = np.zeros(64 * 1024 * 1024, dtype=np.uint8)  # 64 MB
+    nbytes = arr.nbytes
+
+    def op():
+        ref = ray_tpu.put(arr)
+        del ref
+
+    rate = timeit("single_client_put_gigabytes", op, multiplier=1, min_time=3.0)
+    return rate * nbytes / 1e9
+
+
+def bench_wait_1k_refs():
+    refs = [ray_tpu.put(i) for i in range(1000)]
+
+    def op():
+        ready, _ = ray_tpu.wait(refs, num_returns=1000)
+        assert len(ready) == 1000
+
+    return timeit("single_client_wait_1k_refs", op, min_time=2.0)
+
+
+def bench_pg_churn():
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    def op():
+        pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+        pg.wait(timeout=30)
+        remove_placement_group(pg)
+
+    return timeit("placement_group_create/removal", op, min_time=2.0)
+
+
+def main():
+    quick = "--quick" in sys.argv
+    os.environ.setdefault("RTPU_WORKER_IDLE_TTL_S", "300")
+    from ray_tpu.utils import config as config_mod
+
+    config_mod.set_config(config_mod.Config.load())
+
+    c = Cluster()
+    # 4 CPUs bounds the worker pool: on a small host every extra worker
+    # process costs real latency (all cluster processes share the cores).
+    c.add_node(num_cpus=4)
+    rt = c.connect()
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+
+    suite = [
+        ("single_client_put_calls", bench_put_calls),
+        ("single_client_get_calls", bench_get_calls),
+        ("single_client_put_gigabytes", bench_put_gigabytes),
+        ("single_client_wait_1k_refs", bench_wait_1k_refs),
+        ("single_client_tasks_sync", bench_tasks_sync),
+        ("single_client_tasks_async", bench_tasks_async),
+        ("multi_client_tasks_async", bench_multi_client_tasks),
+        ("1_1_actor_calls_sync", bench_actor_calls_sync),
+        ("1_1_actor_calls_async", bench_actor_calls_async),
+        ("1_n_actor_calls_async", bench_1_n_actor_calls),
+        ("n_n_actor_calls_async", bench_n_n_actor_calls),
+        ("placement_group_create/removal", bench_pg_churn),
+    ]
+    rows = []
+    try:
+        for name, fn in suite:
+            try:
+                value = fn()
+            except Exception as e:  # noqa: BLE001
+                print(f"  {name} FAILED: {e}", file=sys.stderr)
+                value = 0.0
+            base, unit = BASELINES[name]
+            rows.append({
+                "name": name,
+                "value": round(value, 2),
+                "unit": unit,
+                "baseline": base,
+                "ratio": round(value / base, 3) if base else None,
+            })
+    finally:
+        try:
+            rt.shutdown()
+            c.shutdown()
+        except Exception:
+            pass
+
+    out = {
+        "hardware": {"nproc": os.cpu_count(),
+                     "note": "reference numbers are from multi-core m5/m6i "
+                             "instances; this box shares all cluster "
+                             "processes on nproc cores"},
+        "rows": rows,
+    }
+    with open("PERF.json", "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

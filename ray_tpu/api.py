@@ -116,7 +116,7 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     global_worker.check_connected()
-    global_worker.runtime.cancel(ref)
+    global_worker.runtime.cancel(ref, force=force)
 
 
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:

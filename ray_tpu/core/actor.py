@@ -86,12 +86,12 @@ class ActorHandle:
         worker = global_worker
         worker.check_connected()
         self._seq_no += 1
-        arg_refs = extract_arg_refs(args, kwargs)
+        args_blob, arg_refs = serialization.serialize_args((args, kwargs))
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(self._actor_id, self._seq_no, self._handle_nonce),
             job_id=worker.job_id,
             fn_blob=b"",
-            args_blob=serialization.serialize((args, kwargs)),
+            args_blob=args_blob,
             arg_ref_ids=[r.id for r in arg_refs],
             arg_owner_ids=[r.owner_id for r in arg_refs],
             num_returns=num_returns,

@@ -186,6 +186,12 @@ class NodeDaemon:
         while True:
             await asyncio.sleep(cfg.worker_idle_ttl_s / 4)
             now = time.monotonic()
+            # Forked-but-never-registered corpses must not count against the
+            # startup-concurrency budget forever.
+            for wp in list(self._unregistered):
+                if wp.proc is not None and wp.proc.poll() is not None:
+                    self._unregistered.remove(wp)
+                    self._try_grant()
             for wid, w in list(self.workers.items()):
                 if (
                     w.lease_id is None and w.actor_id is None
@@ -199,6 +205,12 @@ class NodeDaemon:
                     self.workers.pop(wid, None)
                     if w.lease_id or w.actor_id:
                         self._release_resources(w.resources)
+                        # Drop the lease record too: a later return_lease for
+                        # it must not release the resources a second time.
+                        if w.lease_id:
+                            self._leases.pop(w.lease_id, None)
+                            w.lease_id = None
+                            w.resources = {}
                     if w.actor_id and self._head:
                         await self._head.call(
                             "actor_failed", actor_id=w.actor_id,
@@ -330,6 +342,7 @@ class NodeDaemon:
     def _try_grant(self):
         cfg = get_config()
         still: list[_PendingLease] = []
+        need_workers = 0
         for req in self._pending:
             if req.fut.done():
                 continue
@@ -338,11 +351,7 @@ class NodeDaemon:
                 continue
             w = self._idle_worker(req.env_hash)
             if w is None:
-                starting = len(self._unregistered)
-                if starting < cfg.worker_startup_concurrency and (
-                    len(self.workers) + starting < cfg.max_workers_per_node
-                ):
-                    self._fork_worker()
+                need_workers += 1
                 still.append(req)
                 continue
             lease_id = uuid.uuid4().hex
@@ -357,6 +366,20 @@ class NodeDaemon:
                 "addr": list(w.addr),
             })
         self._pending = still
+        # Fork only the DEFICIT beyond workers already starting: one fork per
+        # unmatched request per grant pass compounds into a fork storm (each
+        # registration re-runs this pass) — a Python worker boot costs ~1 s
+        # of CPU, which on small hosts starves the very tasks being scheduled
+        # (reference: worker_pool.cc starts processes against
+        # num_initial_python_workers/startup caps, not per-request).
+        starting = len(self._unregistered)
+        to_start = min(
+            need_workers - starting,
+            cfg.worker_startup_concurrency - starting,
+            cfg.max_workers_per_node - len(self.workers) - starting,
+        )
+        for _ in range(max(0, to_start)):
+            self._fork_worker()
 
     async def _return_lease(self, conn: ServerConnection, lease_id: str):
         w = self._leases.pop(lease_id, None)
@@ -365,6 +388,9 @@ class NodeDaemon:
             w.lease_id = None
             w.resources = {}
             w.idle_since = time.monotonic()
+            if w.proc is not None and w.proc.poll() is not None:
+                # Returned because the worker died: don't re-grant a corpse.
+                self.workers.pop(w.worker_id, None)
             self._try_grant()
         return {"ok": True}
 

@@ -95,6 +95,12 @@ class RpcServer:
         return self.host, self.port
 
     async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
         conn = ServerConnection(self, reader, writer)
         self._conns.add(conn)
         try:

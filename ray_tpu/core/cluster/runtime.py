@@ -8,6 +8,15 @@ every process (driver or pooled worker) instantiates one ClusterRuntime. It
 owns a local object store, serves object fetches to peers, submits tasks via
 node-daemon leases, and talks to the head for actors/KV/named entities.
 
+Hot-path design (reference: normal_task_submitter.cc's event-driven submit
+loop — no thread per task): all submission state lives on the process's io
+event loop. ``submit_task`` serializes on the caller thread, then hands the
+task to a per-scheduling-key state machine on the loop which leases workers
+(bounded pending lease requests), pipelines pushes over per-worker
+connections, and resubmits on worker failure. Actor calls ride a per-actor
+state machine with FIFO dispatch on one connection (reference:
+sequential_actor_submit_queue ordering).
+
 Object protocol: the submitting worker *owns* task returns. Small results
 ride inline in the task reply and are stored at the owner (reference:
 max_direct_call_object_size); large results stay at the executor, the owner
@@ -16,9 +25,11 @@ records the location, and readers fetch from the holder.
 
 from __future__ import annotations
 
+import asyncio
 import os
 import threading
 import time
+from collections import deque
 from typing import Any
 
 from ray_tpu.core.cluster.protocol import (
@@ -27,6 +38,7 @@ from ray_tpu.core.cluster.protocol import (
     RpcClient,
     RpcError,
     RpcServer,
+    spawn_task,
 )
 from ray_tpu.core.exceptions import (
     ActorDiedError,
@@ -46,20 +58,79 @@ import cloudpickle
 
 
 class _LeasedWorker:
+    __slots__ = ("lease_id", "worker_id", "addr", "client", "inflight",
+                 "idle_since", "daemon", "dead")
+
     def __init__(self, lease_id: str, worker_id: str, addr: tuple[str, int],
-                 client: AsyncRpcClient):
+                 client: AsyncRpcClient, daemon: AsyncRpcClient):
         self.lease_id = lease_id
         self.worker_id = worker_id
         self.addr = addr
         self.client = client
+        self.daemon = daemon  # grantor, for return_lease
         self.inflight = 0
         self.idle_since = 0.0  # monotonic ts when inflight last hit 0
+        self.dead = False
+
+
+class _TaskItem:
+    __slots__ = ("spec", "blob", "return_ids", "attempts")
+
+    def __init__(self, spec: TaskSpec, blob: bytes, return_ids):
+        self.spec = spec
+        self.blob = blob
+        self.return_ids = return_ids
+        self.attempts = 0
+
+
+class _KeyState:
+    """Per-scheduling-key submitter state (reference: one queue per
+    SchedulingKey in normal_task_submitter.h:52). Loop-thread-only."""
+
+    __slots__ = ("key", "resources", "env_hash", "queue", "workers",
+                 "pending_leases")
+
+    def __init__(self, key, resources, env_hash):
+        self.key = key
+        self.resources = resources
+        self.env_hash = env_hash
+        self.queue: deque[_TaskItem] = deque()
+        self.workers: list[_LeasedWorker] = []
+        self.pending_leases = 0
+
+
+class _ActorState:
+    """Per-actor submitter (reference: actor_task_submitter.cc). FIFO
+    dispatch over one pipelined connection; failed in-flight calls gather in
+    ``retrying`` and are re-queued in seq order after the actor restarts.
+    Loop-thread-only."""
+
+    __slots__ = ("actor_id", "client", "addr", "pending", "inflight",
+                 "resolving", "window", "retrying", "recovering")
+
+    def __init__(self, actor_id: str):
+        self.actor_id = actor_id
+        self.client: AsyncRpcClient | None = None
+        self.addr: tuple[str, int] | None = None
+        self.pending: deque[_TaskItem] = deque()
+        self.inflight = 0
+        self.resolving = False
+        self.window = 256
+        self.retrying: list[_TaskItem] = []
+        self.recovering = False
 
 
 class ClusterRuntime:
     """Runtime interface implementation backed by the cluster."""
 
-    MAX_INFLIGHT_PER_WORKER = 16
+    # Pipelined pushes per leased worker: the worker executes serially, so
+    # depth>1 only hides RPC latency (reference: lease reuse for queued tasks
+    # of the same key).
+    PIPELINE_DEPTH = 16
+    # Outstanding lease requests per key: bounds daemon fork storms while
+    # still scaling out under sustained queue depth (reference:
+    # max_pending_lease_requests_per_scheduling_category).
+    MAX_PENDING_LEASES = 4
 
     # Results below this size travel inline / in the process-local store;
     # larger blobs go through the node's shared-memory arena when available
@@ -90,17 +161,22 @@ class ClusterRuntime:
         self._head_host, self._head_port = head_host, head_port
         self.node_daemon_addr = node_daemon_addr
         self._daemon = RpcClient(*node_daemon_addr) if node_daemon_addr else None
-        # Leases per scheduling key (reference: normal_task_submitter.h:52).
-        self._leases: dict[tuple, list[_LeasedWorker]] = {}
-        self._lease_lock = threading.Lock()
+        # Submission state machines — touched only from the io loop thread.
+        self._key_states: dict[tuple, _KeyState] = {}
+        self._actor_sm: dict[str, _ActorState] = {}
+        # task_id hex -> ("queued", _KeyState) | ("running", _LeasedWorker)
+        self._task_where: dict[str, tuple] = {}
+        self._apeers: dict[tuple[str, int], AsyncRpcClient] = {}
         self._peer_clients: dict[tuple[str, int], RpcClient] = {}
         self._peer_lock = threading.Lock()
         self._actor_addr_cache: dict[str, tuple[str, int]] = {}
-        self._actor_queues: dict[str, Any] = {}
-        self._actor_queue_lock = threading.Lock()
         self._actor_states: dict[str, str] = {}
-        self._cancelled: set[ObjectID] = set()
+        self._cancelled: set[str] = set()  # task_id hex
         self._shutdown = False
+        # Wakes wait()/get() when results land (event-driven wait; the
+        # reference wakes waiters from the in-memory store's seal path).
+        self._wait_cond = threading.Condition()
+        self.store.on_seal = self._notify_waiters
 
         # Serve object fetches (and, for workers, task execution) to peers.
         self.server = RpcServer("127.0.0.1", 0)
@@ -111,8 +187,7 @@ class ClusterRuntime:
         self.addr = self._io.run(self.server.start())
         self.head.call("register_worker", worker_id=self.worker_id.hex(),
                        host=self.addr[0], port=self.addr[1])
-        threading.Thread(target=self._lease_reaper, daemon=True,
-                         name="lease-reaper").start()
+        self._reaper_task = self._io.spawn(self._lease_reaper())
         # Actor state invalidation via pubsub.
         self.head.aio.on_notify("pub", self._on_pub)
         self.head.call("subscribe", channel="actor_events")
@@ -123,7 +198,6 @@ class ClusterRuntime:
 
     async def _handle_get_object(self, conn, oid: str, timeout: float = 10.0):
         object_id = ObjectID.from_hex(oid)
-        import asyncio
 
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -153,6 +227,7 @@ class ClusterRuntime:
 
     async def _handle_report_location(self, conn, oid: str, holder: str):
         self._locations[ObjectID.from_hex(oid)] = holder
+        self._notify_waiters()
         return {"ok": True}
 
     async def _on_pub(self, channel: str, payload: dict):
@@ -165,8 +240,13 @@ class ClusterRuntime:
             elif state in ("DEAD", "RESTARTING"):
                 self._actor_addr_cache.pop(aid, None)
 
+    def _notify_waiters(self) -> None:
+        with self._wait_cond:
+            self._wait_cond.notify_all()
+
     # ------------------------------------------------------------------ peers
     def _peer(self, addr: tuple[str, int]) -> RpcClient:
+        """Sync peer client — caller threads only (never the io loop)."""
         addr = tuple(addr)
         with self._peer_lock:
             cli = self._peer_clients.get(addr)
@@ -174,6 +254,16 @@ class ClusterRuntime:
                 cli = RpcClient(*addr)
                 self._peer_clients[addr] = cli
             return cli
+
+    async def _apeer(self, addr: tuple[str, int]) -> AsyncRpcClient:
+        """Async peer client — io-loop side."""
+        addr = tuple(addr)
+        cli = self._apeers.get(addr)
+        if cli is None or cli._closed:
+            cli = AsyncRpcClient(*addr)
+            await cli.connect()
+            self._apeers[addr] = cli
+        return cli
 
     def _resolve_worker_addr(self, worker_hex: str) -> tuple[str, int] | None:
         res = self.head.call("resolve_worker", worker_id=worker_hex)
@@ -193,16 +283,21 @@ class ClusterRuntime:
             except Exception:
                 pass
 
-    def _store_blob(self, oid: ObjectID, blob: bytes, owner) -> None:
+    def _store_blob(self, oid: ObjectID, blob, owner) -> None:
         """Large blobs land in the node shm arena (visible to every local
-        process, zero-copy); small ones in the process-local store."""
-        if self.shm is not None and len(blob) >= self.SHM_THRESHOLD:
+        process, zero-copy); small ones in the process-local store.
+        ``blob`` may be bytes or a list of buffers (scatter write)."""
+        parts = blob if isinstance(blob, list) else [blob]
+        total = sum(len(p) for p in parts)
+        if self.shm is not None and total >= self.SHM_THRESHOLD:
             try:
-                self.shm.put(oid.binary(), blob)
+                self.shm.put_parts(oid.binary(), parts)
+                self._notify_waiters()
                 return
             except Exception:
                 pass  # arena full and unspillable: fall back
-        self.store.put(oid, blob, owner)
+        self.store.put(oid, b"".join(parts) if len(parts) > 1 else parts[0],
+                       owner)
 
     def _local_blob(self, oid: ObjectID) -> bytes | None:
         if self.store.contains(oid):
@@ -221,7 +316,8 @@ class ClusterRuntime:
 
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.worker_id)
-        self._store_blob(oid, serialization.serialize(value), self.worker_id)
+        self._store_blob(oid, serialization.serialize_parts(value),
+                         self.worker_id)
         self.refs.add_owned(oid, self.worker_id)
         return ObjectRef(oid, self.worker_id)
 
@@ -304,7 +400,7 @@ class ClusterRuntime:
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         deadline = None if timeout is None else time.monotonic() + timeout
         ready, pending = [], list(refs)
-        while len(ready) < num_returns:
+        while True:
             still = []
             for r in pending:
                 if self._local_contains(r.id) or r.id in self._locations:
@@ -316,7 +412,11 @@ class ClusterRuntime:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(0.005)
+            # Event-driven: woken by store seals / location reports. The
+            # short cap covers cross-process shm arena seals, which have no
+            # in-process notification.
+            with self._wait_cond:
+                self._wait_cond.wait(timeout=0.05)
         return ready, pending
 
     # ------------------------------------------------------------------ tasks
@@ -330,41 +430,139 @@ class ClusterRuntime:
         global_event_buffer().record(
             spec.task_id.hex(), spec.name, "SUBMITTED",
             worker_id=self.worker_id.hex(), job_id=spec.job_id.hex())
-        blob = cloudpickle.dumps(spec)
-        t = threading.Thread(
-            target=self._submit_and_collect, args=(spec, blob, return_ids),
-            daemon=True, name=f"submit-{spec.name[:20]}",
-        )
-        t.start()
+        item = _TaskItem(spec, serialization.dumps_spec(spec), return_ids)
+        self._io.loop.call_soon_threadsafe(self._submit_on_loop, item)
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
 
-    def _submit_and_collect(self, spec: TaskSpec, blob: bytes,
-                            return_ids: list[ObjectID]) -> None:
-        attempts = 0
-        while True:
-            try:
-                worker = self._acquire_lease(spec)
-                try:
-                    reply = self._io.run(
-                        worker.client.call("push_task", spec_blob=blob, timeout=None)
-                    )
-                finally:
-                    self._release_lease(spec, worker)
-                self._handle_task_reply(spec, return_ids, reply)
-                return
-            except (RpcError, OSError) as e:
-                # Worker/daemon failure: retry (system retries, reference
-                # semantics: max_retries counts system failures).
-                attempts += 1
-                if attempts > max(spec.max_retries, 0):
+    # -- loop-side submission state machine --------------------------------
+    def _submit_on_loop(self, item: _TaskItem) -> None:
+        tid = item.spec.task_id.hex()
+        if tid in self._cancelled:
+            self._store_error_local(item.return_ids, TaskCancelledError())
+            return
+        key = item.spec.scheduling_key()
+        ks = self._key_states.get(key)
+        if ks is None:
+            ks = _KeyState(key, dict(item.spec.resources), key[1])
+            self._key_states[key] = ks
+        ks.queue.append(item)
+        self._task_where[tid] = ("queued", ks)
+        self._pump(ks)
+
+    def _pump(self, ks: _KeyState) -> None:
+        if self._shutdown:
+            return
+        # Dispatch queued tasks onto workers with pipeline capacity.
+        while ks.queue:
+            live = [w for w in ks.workers
+                    if not w.dead and w.inflight < self.PIPELINE_DEPTH]
+            if not live:
+                break
+            w = min(live, key=lambda w: w.inflight)
+            item = ks.queue.popleft()
+            tid = item.spec.task_id.hex()
+            if tid in self._cancelled:
+                self._task_where.pop(tid, None)
+                self._store_error_local(item.return_ids, TaskCancelledError())
+                continue
+            w.inflight += 1
+            self._task_where[tid] = ("running", w)
+            spawn_task(self._push_and_collect(ks, w, item))
+        # Scale out: request more leases while a backlog remains.
+        if self._daemon is None:
+            if ks.queue and not ks.workers and ks.pending_leases == 0:
+                while ks.queue:
+                    item = ks.queue.popleft()
+                    self._task_where.pop(item.spec.task_id.hex(), None)
                     self._store_error_local(
-                        return_ids, TaskError(RuntimeError(f"system failure: {e}"),
-                                              task_desc=spec.name))
-                    return
-                time.sleep(get_config().task_retry_delay_s)
-            except Exception as e:  # noqa: BLE001
-                self._store_error_local(return_ids, TaskError(e, task_desc=spec.name))
-                return
+                        item.return_ids,
+                        TaskError(RuntimeError("no node daemon attached"),
+                                  task_desc=item.spec.name))
+            return
+        capacity = sum(self.PIPELINE_DEPTH - w.inflight
+                       for w in ks.workers if not w.dead)
+        deficit = len(ks.queue) - capacity
+        want = min(self.MAX_PENDING_LEASES - ks.pending_leases, deficit)
+        for _ in range(max(0, want)):
+            ks.pending_leases += 1
+            spawn_task(self._request_lease(ks))
+
+    async def _push_and_collect(self, ks: _KeyState, w: _LeasedWorker,
+                                item: _TaskItem) -> None:
+        tid = item.spec.task_id.hex()
+        try:
+            reply = await w.client.call("push_task", spec_blob=item.blob,
+                                        timeout=None)
+            self._handle_task_reply(item.spec, item.return_ids, reply)
+        except (RpcError, OSError) as e:
+            # Worker failure: mark the lease dead, return it to the daemon
+            # (a removed-but-unreturned lease permanently leaks the node's
+            # resources), and retry (system retries — reference: max_retries
+            # counts system failures).
+            w.dead = True
+            if w in ks.workers:
+                ks.workers.remove(w)
+                spawn_task(self._return_dead_lease(w))
+            item.attempts += 1
+            if item.attempts > max(item.spec.max_retries, 0):
+                self._store_error_local(
+                    item.return_ids,
+                    TaskError(RuntimeError(f"system failure: {e}"),
+                              task_desc=item.spec.name))
+            else:
+                await asyncio.sleep(get_config().task_retry_delay_s)
+                ks.queue.append(item)
+                self._task_where[tid] = ("queued", ks)
+        except Exception as e:  # noqa: BLE001
+            self._store_error_local(item.return_ids,
+                                    TaskError(e, task_desc=item.spec.name))
+        finally:
+            w.inflight -= 1
+            if w.inflight <= 0:
+                w.idle_since = time.monotonic()
+            where = self._task_where.get(tid)
+            if where is not None and where[0] == "running":
+                self._task_where.pop(tid, None)
+            self._pump(ks)
+
+    async def _request_lease(self, ks: _KeyState) -> None:
+        """Lease a worker from the local daemon, following spillback
+        redirects (reference: cluster_lease_manager spillback)."""
+        try:
+            daemon = self._daemon.aio
+            res = await daemon.call("request_lease", resources=ks.resources,
+                                    env_hash=ks.env_hash, timeout=None)
+            hops = 0
+            while res.get("spill") and hops < 4:
+                daemon = await self._apeer(tuple(res["spill"]))
+                # Final hop commits to its node: prevents spill ping-pong
+                # when every node is briefly busy.
+                res = await daemon.call("request_lease", resources=ks.resources,
+                                        env_hash=ks.env_hash, timeout=None,
+                                        allow_spill=hops < 3)
+                hops += 1
+            if res.get("spill"):
+                raise ValueError(
+                    f"lease spill chain exhausted for {ks.resources}")
+            if res.get("error"):
+                raise ValueError(res["error"])
+            client = AsyncRpcClient(*tuple(res["addr"]))
+            await client.connect()
+            w = _LeasedWorker(res["lease_id"], res["worker_id"],
+                              tuple(res["addr"]), client, daemon)
+            ks.workers.append(w)
+        except Exception as e:  # noqa: BLE001
+            # Lease failed (infeasible/timeout): fail the oldest queued task
+            # of this key — mirrors the old per-task acquire semantics where
+            # one waiting task absorbed one lease failure.
+            if ks.queue and not ks.workers:
+                item = ks.queue.popleft()
+                self._task_where.pop(item.spec.task_id.hex(), None)
+                self._store_error_local(item.return_ids,
+                                        TaskError(e, task_desc=item.spec.name))
+        finally:
+            ks.pending_leases -= 1
+            self._pump(ks)
 
     def _handle_task_reply(self, spec, return_ids, reply: dict):
         results = reply.get("results", [])
@@ -373,93 +571,88 @@ class ClusterRuntime:
                 self.store.put(oid, r["data"], self.worker_id)
             elif r.get("location"):
                 self._locations[oid] = r["location"]
+        self._notify_waiters()
 
     def _store_error_local(self, return_ids, err):
         blob = serialization.serialize(err)
         for oid in return_ids:
             self.store.put(oid, blob, self.worker_id)
+        self._notify_waiters()
 
-    def _acquire_lease(self, spec: TaskSpec) -> _LeasedWorker:
-        key = spec.scheduling_key()
-        with self._lease_lock:
-            pool = self._leases.setdefault(key, [])
-            usable = [w for w in pool if w.inflight < self.MAX_INFLIGHT_PER_WORKER]
-            if usable:
-                w = min(usable, key=lambda w: w.inflight)
-                w.inflight += 1
-                return w
-        # Need a new lease from a node daemon (local first, follow spillback).
-        daemon = self._daemon
-        if daemon is None:
-            raise RuntimeError("no node daemon attached to this process")
-        env_hash = key[1]  # canonical runtime_env JSON from the scheduling key
-        res = daemon.call("request_lease", resources=spec.resources,
-                          env_hash=env_hash, timeout=None)
-        hops = 0
-        while res.get("spill") and hops < 4:
-            daemon = self._peer(tuple(res["spill"]))
-            # Final hop commits to its node: prevents spill ping-pong when
-            # every node is briefly busy.
-            res = daemon.call("request_lease", resources=spec.resources,
-                              env_hash=env_hash, timeout=None,
-                              allow_spill=hops < 3)
-            hops += 1
-        if res.get("spill"):
-            # Defensive: the final hop runs with allow_spill=False, and the
-            # daemon protocol never returns a spill on that path today. Guard
-            # anyway so a future daemon change surfaces as a scheduling error
-            # here instead of a KeyError on the missing grant below.
-            raise ValueError(
-                f"lease spill chain exhausted for {spec.resources}")
-        if res.get("error"):
-            raise ValueError(res["error"])
-        client = AsyncRpcClient(*tuple(res["addr"]))
-        self._io.run(client.connect())
-        w = _LeasedWorker(res["lease_id"], res["worker_id"], tuple(res["addr"]), client)
-        w._daemon = daemon  # remember grantor for return
-        w.inflight = 1
-        with self._lease_lock:
-            self._leases.setdefault(key, []).append(w)
-        return w
+    async def _return_dead_lease(self, w: _LeasedWorker) -> None:
+        try:
+            await w.daemon.call("return_lease", lease_id=w.lease_id)
+        except Exception:
+            pass  # daemon gone too; its own reaper frees the resources
+        try:
+            await w.client.close()
+        except Exception:
+            pass
 
-    def _release_lease(self, spec: TaskSpec, w: _LeasedWorker):
-        with self._lease_lock:
-            w.inflight -= 1
-            if w.inflight <= 0:
-                # Leave the lease cached for back-to-back reuse; the reaper
-                # returns it (freeing the worker's resources node-side) after
-                # the keepalive window (reference: leased workers are returned
-                # when idle so other scheduling keys aren't starved).
-                w.idle_since = time.monotonic()
-
-    def _lease_reaper(self):
-        keepalive = get_config().lease_keepalive_s
+    async def _lease_reaper(self):
+        """Return idle leases after the keepalive window so other scheduling
+        keys / clients aren't starved (reference: ReturnWorkerLease on idle)."""
         while not self._shutdown:
-            time.sleep(keepalive / 2)
+            keepalive = get_config().lease_keepalive_s
+            await asyncio.sleep(keepalive / 2)
             now = time.monotonic()
-            to_return: list[_LeasedWorker] = []
-            with self._lease_lock:
-                for key, pool in list(self._leases.items()):
-                    keep = []
-                    for w in pool:
-                        if w.inflight <= 0 and now - w.idle_since > keepalive:
-                            to_return.append(w)
-                        else:
-                            keep.append(w)
-                    if keep:
-                        self._leases[key] = keep
-                    else:
-                        self._leases.pop(key, None)
-            for w in to_return:
-                try:
-                    getattr(w, "_daemon", self._daemon).call(
-                        "return_lease", lease_id=w.lease_id)
-                except Exception:
-                    pass
+            for ks in list(self._key_states.values()):
+                for w in list(ks.workers):
+                    if w.dead or (w.inflight <= 0
+                                  and now - w.idle_since > keepalive):
+                        ks.workers.remove(w)
+                        try:
+                            await w.daemon.call("return_lease",
+                                                lease_id=w.lease_id)
+                        except Exception:
+                            pass
+                        try:
+                            await w.client.close()
+                        except Exception:
+                            pass
+                if not ks.workers and not ks.queue and not ks.pending_leases:
+                    self._key_states.pop(ks.key, None)
 
-    def cancel(self, ref: ObjectRef) -> None:
-        self._cancelled.add(ref.id)
-        self._store_error_local([ref.id], TaskCancelledError())
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        """Best-effort task cancellation (reference: CoreWorker::CancelTask —
+        queued tasks are dropped; a running task is interrupted in the worker
+        via an async-raised TaskCancelledError)."""
+        tid = self.refs.lineage_task(ref.id)
+        tid_hex = tid.hex() if tid is not None else None
+
+        def on_loop():
+            if tid_hex is None:
+                self._store_error_local([ref.id], TaskCancelledError())
+                return
+            self._cancelled.add(tid_hex)
+            where = self._task_where.pop(tid_hex, None)
+            if where is not None:
+                kind, target = where
+                if kind == "queued":
+                    ks = target
+                    for item in list(ks.queue):
+                        if item.spec.task_id.hex() == tid_hex:
+                            ks.queue.remove(item)
+                            self._store_error_local(item.return_ids,
+                                                    TaskCancelledError())
+                            break
+                else:  # running on a leased worker
+                    w: _LeasedWorker = target
+                    spawn_task(w.client.call("cancel_task", task_id=tid_hex,
+                                             force=force, timeout=5))
+                return
+            # Actor task: drop it from the per-actor queue if not yet sent
+            # (reference: a dispatched actor method isn't interrupted unless
+            # force — the real result lands if cancel loses the race).
+            for st in self._actor_sm.values():
+                for item in list(st.pending):
+                    if item.spec.task_id.hex() == tid_hex:
+                        st.pending.remove(item)
+                        self._store_error_local(item.return_ids,
+                                                TaskCancelledError())
+                        return
+
+        self._io.loop.call_soon_threadsafe(on_loop)
 
     # ------------------------------------------------------------------ actors
     def create_actor(self, spec: ActorCreationSpec) -> None:
@@ -479,128 +672,172 @@ class ClusterRuntime:
         if not res.get("ok"):
             raise ValueError(res.get("error", "actor registration failed"))
 
-    def _actor_addr(self, actor_id: ActorID, timeout: float = 60.0) -> tuple[str, int]:
-        aid = actor_id.hex()
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            addr = self._actor_addr_cache.get(aid)
-            if addr:
-                return addr
-            info = self.head.call("get_actor_info", actor_id=aid)
-            if info is None:
-                raise ActorDiedError(aid, "unknown actor")
-            if info["state"] == "ALIVE" and info["addr"]:
-                self._actor_addr_cache[aid] = tuple(info["addr"])
-                return tuple(info["addr"])
-            if info["state"] == "DEAD":
-                raise ActorDiedError(aid, info.get("reason", ""))
-            time.sleep(0.02)
-        raise ActorDiedError(aid, "timed out waiting for actor to start")
+    async def _actor_info(self, aid: str) -> dict | None:
+        return await self.head.aio.call("get_actor_info", actor_id=aid)
 
     def submit_actor_task(self, spec: TaskSpec) -> list[ObjectRef]:
         return_ids = spec.return_ids()
         for oid in return_ids:
             self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
         spec.owner_id = self.worker_id
-        blob = cloudpickle.dumps(spec)
-        # Ordered per-actor dispatch (reference: sequential_actor_submit_queue
-        # orders calls by sequence number; one FIFO dispatcher per actor here
-        # preserves program order while pipelining over a single connection).
-        with self._actor_queue_lock:
-            q = self._actor_queues.get(spec.actor_id.hex())
-            if q is None:
-                import queue as _q
-
-                q = _q.Queue()
-                self._actor_queues[spec.actor_id.hex()] = q
-                threading.Thread(
-                    target=self._actor_dispatcher, args=(spec.actor_id, q),
-                    daemon=True, name=f"adisp-{spec.actor_id.hex()[:8]}",
-                ).start()
-        q.put((spec, blob, return_ids))
+        item = _TaskItem(spec, serialization.dumps_spec(spec), return_ids)
+        self._io.loop.call_soon_threadsafe(self._actor_submit_on_loop, item)
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
 
-    def _actor_dispatcher(self, actor_id: ActorID, q) -> None:
-        # Pipelined ordered dispatch: sends ride one connection in FIFO order;
-        # a bounded in-flight window keeps memory in check. Completions are
-        # handled on the io loop; failures fall back to the blocking
-        # retry/restart path.
-        window = threading.Semaphore(128)
+    # -- loop-side actor state machine --------------------------------------
+    def _actor_submit_on_loop(self, item: _TaskItem) -> None:
+        aid = item.spec.actor_id.hex()
+        st = self._actor_sm.get(aid)
+        if st is None:
+            st = _ActorState(aid)
+            self._actor_sm[aid] = st
+        st.pending.append(item)
+        self._actor_pump(st)
 
-        def on_done(spec, blob, return_ids, fut):
-            window.release()
-            try:
-                reply = fut.result()
-                if reply.get("dead"):
-                    raise RpcError(reply.get("reason", "actor dead"))
-                self._handle_task_reply(spec, return_ids, reply)
-            except Exception:  # noqa: BLE001
-                threading.Thread(
-                    target=self._submit_actor_and_collect,
-                    args=(spec, blob, return_ids), daemon=True,
-                ).start()
+    def _actor_pump(self, st: _ActorState) -> None:
+        if self._shutdown:
+            return
+        if st.client is None:
+            if not st.resolving:
+                st.resolving = True
+                spawn_task(self._actor_resolve(st))
+            return
+        # FIFO dispatch: tasks spawned here start in creation order and the
+        # connection's write lock is FIFO, so frames hit the wire in program
+        # order (reference: sequence-numbered sends).
+        while st.pending and st.inflight < st.window:
+            item = st.pending.popleft()
+            st.inflight += 1
+            spawn_task(self._actor_push(st, item))
 
-        while not self._shutdown:
-            item = q.get()
-            if item is None:
-                return
-            spec, blob, return_ids = item
-            try:
-                addr = self._actor_addr(spec.actor_id)
-            except Exception:
-                self._submit_actor_and_collect(spec, blob, return_ids)
-                continue
-            window.acquire()
-            client = self._peer(addr)
-            cfut = self._io.spawn(
-                client.aio.call("push_actor_task", spec_blob=blob, timeout=None)
-            )
-            cfut.add_done_callback(
-                lambda f, s=spec, b=blob, r=return_ids: on_done(s, b, r, f)
-            )
-
-    def _submit_actor_and_collect(self, spec, blob, return_ids):
-        aid = spec.actor_id.hex()
-        attempts = 0
+    async def _actor_resolve(self, st: _ActorState) -> None:
+        """Wait for the actor to be ALIVE and open its connection. Transient
+        head errors retry within the loop — only a DEAD verdict or the
+        deadline fails the pending queue."""
         try:
-            while True:
-                try:
-                    addr = self._actor_addr(spec.actor_id)
-                    reply = self._peer(addr).call("push_actor_task", spec_blob=blob,
-                                                  timeout=None)
-                    if reply.get("dead"):
-                        raise ActorDiedError(aid, reply.get("reason", ""))
-                    self._handle_task_reply(spec, return_ids, reply)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                addr = self._actor_addr_cache.get(st.actor_id)
+                if addr is None:
+                    try:
+                        info = await self._actor_info(st.actor_id)
+                    except Exception:  # head briefly unreachable: retry
+                        await asyncio.sleep(0.1)
+                        continue
+                    if info is None:
+                        raise ActorDiedError(st.actor_id, "unknown actor")
+                    if info["state"] == "DEAD":
+                        raise ActorDiedError(st.actor_id, info.get("reason", ""))
+                    if info["state"] == "ALIVE" and info.get("addr"):
+                        addr = tuple(info["addr"])
+                        self._actor_addr_cache[st.actor_id] = addr
+                if addr is not None:
+                    client = AsyncRpcClient(*addr)
+                    try:
+                        await client.connect()
+                    except OSError:
+                        # Stale address (old incarnation): drop and re-ask.
+                        self._actor_addr_cache.pop(st.actor_id, None)
+                        await asyncio.sleep(0.05)
+                        continue
+                    st.addr = addr
+                    st.client = client
                     return
-                except (RpcError, OSError):
-                    # Worker vanished mid-call. If the head says RESTARTING the
-                    # call is retried against the new incarnation (reference:
-                    # actor_task_submitter retries per max_task_retries while
-                    # the GCS FSM restarts the actor).
-                    self._actor_addr_cache.pop(aid, None)
-                    attempts += 1
-                    if attempts > 60:
-                        raise ActorDiedError(aid, "worker connection lost")
-                    deadline = time.monotonic() + 10.0
-                    while time.monotonic() < deadline:
-                        try:
-                            info = self.head.call("get_actor_info", actor_id=aid)
-                        except Exception:
-                            info = None
-                        state = (info or {}).get("state")
-                        if state == "DEAD":
-                            raise ActorDiedError(aid, (info or {}).get("reason",
-                                                 "worker connection lost"))
-                        if state == "ALIVE" and info.get("addr") and \
-                                tuple(info["addr"]) != tuple(addr):
-                            break  # new incarnation up: retry
-                        time.sleep(0.1)
-                    else:
-                        raise ActorDiedError(aid, "worker connection lost")
+                await asyncio.sleep(0.02)
+            raise ActorDiedError(st.actor_id,
+                                 "timed out waiting for actor to start")
         except ActorDiedError as e:
-            self._store_error_local(return_ids, e)
+            self._fail_actor_queue(st, e)
+        finally:
+            st.resolving = False
+            if st.client is not None:
+                self._actor_pump(st)
+
+    def _fail_actor_queue(self, st: _ActorState, err: ActorDiedError) -> None:
+        for item in st.retrying:
+            self._store_error_local(item.return_ids, err)
+        st.retrying = []
+        while st.pending:
+            item = st.pending.popleft()
+            self._store_error_local(item.return_ids, err)
+
+    async def _actor_push(self, st: _ActorState, item: _TaskItem) -> None:
+        client = st.client  # the connection THIS call rides
+        try:
+            reply = await client.call("push_actor_task",
+                                      spec_blob=item.blob, timeout=None)
+            if reply.get("dead"):
+                raise RpcError(reply.get("reason", "actor dead"))
+            self._handle_task_reply(item.spec, item.return_ids, reply)
+        except (RpcError, OSError):
+            # Connection lost / incarnation died. Only tear down st.client if
+            # it is still the connection we used — a sibling failure may have
+            # already installed a fresh one that must survive.
+            if st.client is client:
+                try:
+                    await client.close()
+                except Exception:
+                    pass
+                st.client = None
+                self._actor_addr_cache.pop(st.actor_id, None)
+            item.attempts += 1
+            if item.attempts > 60:
+                self._store_error_local(
+                    item.return_ids,
+                    ActorDiedError(st.actor_id, "worker connection lost"))
+            else:
+                st.retrying.append(item)
+                if st.client is not None:
+                    # A sibling already recovered the connection: merge this
+                    # straggler straight back in order.
+                    self._merge_retrying(st)
+                elif not st.recovering:
+                    st.recovering = True
+                    spawn_task(self._actor_recover(st, st.addr))
         except Exception as e:  # noqa: BLE001
-            self._store_error_local(return_ids, TaskError(e, task_desc=spec.name))
+            self._store_error_local(item.return_ids,
+                                    TaskError(e, task_desc=item.spec.name))
+        finally:
+            st.inflight -= 1
+            self._actor_pump(st)
+
+    async def _actor_recover(self, st: _ActorState, old_addr) -> None:
+        """Wait for a new incarnation, then merge failed calls back into the
+        queue in sequence order (reference: actor_task_submitter resends the
+        out-of-order set ordered by sequence number after restart)."""
+        aid = st.actor_id
+        try:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                try:
+                    info = await self._actor_info(aid)
+                except Exception:
+                    info = None
+                state = (info or {}).get("state")
+                if state == "DEAD":
+                    raise ActorDiedError(aid, (info or {}).get(
+                        "reason", "worker connection lost"))
+                if state == "ALIVE" and info.get("addr") and \
+                        tuple(info["addr"]) != (old_addr or ()):
+                    self._actor_addr_cache[aid] = tuple(info["addr"])
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise ActorDiedError(aid, "worker connection lost")
+            self._merge_retrying(st)
+            st.recovering = False
+            self._actor_pump(st)
+        except ActorDiedError as e:
+            st.recovering = False
+            self._fail_actor_queue(st, e)
+
+    def _merge_retrying(self, st: _ActorState) -> None:
+        """Re-queue failed calls sorted by sequence number ahead of (and
+        merged with) anything already pending — program order survives any
+        interleaving of failure notifications."""
+        st.pending = deque(sorted(
+            st.retrying + list(st.pending), key=lambda it: it.spec.seq_no))
+        st.retrying = []
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self.head.call("kill_actor", actor_id=actor_id.hex(), no_restart=no_restart)
@@ -658,6 +895,10 @@ class ClusterRuntime:
         if self._shutdown:
             return
         self._shutdown = True
+        try:
+            self._reaper_task.cancel()
+        except Exception:
+            pass
         try:
             self._io.run(self.server.stop())
         except Exception:

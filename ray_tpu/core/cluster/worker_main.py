@@ -30,6 +30,50 @@ from ray_tpu.utils import serialization
 from ray_tpu.utils.config import get_config
 
 
+class _SerialExecutor:
+    """One-task-at-a-time executor whose worker thread survives async-raised
+    interrupts. cancel_task delivers TaskCancelledError via
+    PyThreadState_SetAsyncExc; if the target task finishes before delivery,
+    the exception lands between tasks — a ThreadPoolExecutor thread would die
+    (and max_workers=1 never replaces it, wedging the worker), this loop
+    swallows it and keeps serving. Interface subset of concurrent.futures
+    used by loop.run_in_executor: submit() -> Future."""
+
+    def __init__(self):
+        import concurrent.futures
+        import queue as _q
+
+        self._futures = concurrent.futures
+        self._q: "_q.Queue" = _q.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="task-exec")
+        self._thread.start()
+
+    def submit(self, fn, *args):
+        fut = self._futures.Future()
+        self._q.put((fut, fn, args))
+        return fut
+
+    def shutdown(self, wait=True):  # noqa: ARG002 - interface compat
+        self._q.put(None)
+
+    def _run(self):
+        while True:
+            try:
+                item = self._q.get()
+                if item is None:
+                    return
+                fut, fn, args = item
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(fn(*args))
+                except BaseException as e:  # noqa: BLE001
+                    fut.set_exception(e)
+            except TaskCancelledError:
+                continue  # late async interrupt landed between tasks
+
+
 class WorkerProcess:
     def __init__(self):
         head = os.environ["RTPU_HEAD"].split(":")
@@ -54,10 +98,17 @@ class WorkerProcess:
         srv.register("push_task", self._push_task)
         srv.register("init_actor", self._init_actor)
         srv.register("push_actor_task", self._push_actor_task)
+        srv.register("cancel_task", self._cancel_task)
         srv.register("exit_worker", self._exit_worker)
-        from concurrent.futures import ThreadPoolExecutor
-
-        self._task_executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        # Cancellation state: ids cancelled before start, and the thread
+        # currently executing each task (for async interrupt).
+        self._cancelled_tasks: set[str] = set()
+        self._running_tasks: dict[str, int] = {}  # task_id hex -> thread ident
+        # Deserialized-function cache keyed by the exact code blob — repeat
+        # submissions of the same @remote function skip the unpickle
+        # (reference: function_manager.py caches imported remote functions).
+        self._fn_cache: dict[bytes, Any] = {}
+        self._task_executor = _SerialExecutor()
         self._actor_instance: Any = None
         self._actor_id_hex: str | None = None
         self._actor_mailbox: "queue.Queue" = queue.Queue()
@@ -79,43 +130,64 @@ class WorkerProcess:
                          name="event-flush").start()
 
     def _event_flusher(self):
-        import dataclasses
-
         from ray_tpu.core.events import global_event_buffer
 
         buf = global_event_buffer()
         while not self._exit_event.is_set():
             self._exit_event.wait(get_config().task_event_flush_interval_s)
-            batch = buf.drain()
+            batch = buf.drain_dicts()
             if not batch:
                 continue
             try:
-                self.runtime.head.call(
-                    "report_task_events",
-                    events=[dataclasses.asdict(e) for e in batch])
+                self.runtime.head.call("report_task_events", events=batch)
             except Exception:
                 pass  # head temporarily unreachable: drop (bounded loss)
 
     # ------------------------------------------------------------------ tasks
     async def _push_task(self, conn, spec_blob: bytes):
-        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        spec: TaskSpec = serialization.loads_spec(spec_blob)
         loop = asyncio.get_running_loop()
         # Serial execution: one normal task at a time per leased worker
         # (reference semantics — a worker runs one task; pipelined pushes
         # queue here, matching lease-based resource accounting).
         return await loop.run_in_executor(self._task_executor, self._execute_task, spec)
 
+    async def _cancel_task(self, conn, task_id: str, force: bool = False):
+        """Best-effort cancel (reference: CoreWorker::HandleCancelTask —
+        interrupt the running task or drop it from the queue). A running
+        task is interrupted by raising TaskCancelledError asynchronously in
+        its executing thread."""
+        self._cancelled_tasks.add(task_id)
+        tident = self._running_tasks.get(task_id)
+        if tident is not None:
+            import ctypes
+
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tident), ctypes.py_object(TaskCancelledError))
+        return {"ok": True, "was_running": tident is not None}
+
     def _execute_task(self, spec: TaskSpec) -> dict:
         from ray_tpu.core.events import task_execution
         from ray_tpu.core.worker import set_task_context
 
         return_ids = spec.return_ids()
+        tid_hex = spec.task_id.hex()
+        if tid_hex in self._cancelled_tasks:
+            self._cancelled_tasks.discard(tid_hex)
+            blob = serialization.serialize(TaskCancelledError())
+            return {"results": [{"data": blob} for _ in return_ids]}
+        self._running_tasks[tid_hex] = threading.get_ident()
         try:
             if spec.runtime_env:
                 from ray_tpu.runtime_env import get_manager
 
                 get_manager().ensure(spec.runtime_env, self.runtime)
-            fn = serialization.loads_function(spec.fn_blob)
+            fn = self._fn_cache.get(spec.fn_blob)
+            if fn is None:
+                fn = serialization.loads_function(spec.fn_blob)
+                if len(self._fn_cache) > 256:
+                    self._fn_cache.clear()
+                self._fn_cache[spec.fn_blob] = fn
             args, kwargs = serialization.deserialize(spec.args_blob)
             args = self._resolve(args)
             kwargs = self._resolve(kwargs)
@@ -131,6 +203,9 @@ class WorkerProcess:
                 else TaskError(e, task_desc=spec.name)
             blob = serialization.serialize(err)
             return {"results": [{"data": blob} for _ in return_ids]}
+        finally:
+            self._running_tasks.pop(tid_hex, None)
+            self._cancelled_tasks.discard(tid_hex)
         return {"results": self._package_results(spec, return_ids, result)}
 
     def _resolve(self, obj):
@@ -273,7 +348,7 @@ class WorkerProcess:
     async def _push_actor_task(self, conn, spec_blob: bytes):
         if self._actor_instance is None:
             return {"dead": True, "reason": "no actor hosted in this worker"}
-        spec: TaskSpec = cloudpickle.loads(spec_blob)
+        spec: TaskSpec = serialization.loads_spec(spec_blob)
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._actor_mailbox.put((spec, fut, loop))

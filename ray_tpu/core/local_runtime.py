@@ -318,7 +318,7 @@ class LocalRuntime:
             if oid not in self._released:
                 self.store.put(oid, blob, self.worker_id)
 
-    def cancel(self, ref: ObjectRef) -> None:
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
         self._cancelled.add(ref.id)
 
     # ------------------------------------------------------------------ actors

@@ -107,7 +107,7 @@ class RemoteFunction:
         if self._fn_blob is None:
             self._fn_blob = serialization.dumps_function(self._fn)
         opts = self._options
-        arg_refs = extract_arg_refs(args, kwargs)
+        args_blob, arg_refs = serialization.serialize_args((args, kwargs))
         resources, strategy = resolve_strategy(
             _build_resources(opts), opts["scheduling_strategy"])
         runtime_env = _prepare_runtime_env(worker.runtime, opts["runtime_env"])
@@ -115,7 +115,7 @@ class RemoteFunction:
             task_id=TaskID.of(worker.job_id),
             job_id=worker.job_id,
             fn_blob=self._fn_blob,
-            args_blob=serialization.serialize((args, kwargs)),
+            args_blob=args_blob,
             arg_ref_ids=[r.id for r in arg_refs],
             arg_owner_ids=[r.owner_id for r in arg_refs],
             num_returns=opts["num_returns"],

@@ -107,8 +107,14 @@ class SharedMemoryStore:
 
     def put(self, object_id: bytes, data) -> None:
         """Create+write+seal. Spills LRU objects on OOM."""
-        data = memoryview(data).cast("B")
-        size = len(data)
+        self.put_parts(object_id, [data])
+
+    def put_parts(self, object_id: bytes, parts) -> None:
+        """Scatter-write: allocate once, memcpy each buffer directly into the
+        arena (skips the concatenation copy a single-``bytes`` put needs —
+        reference: plasma CreateAndSeal with out-of-band pickle5 buffers)."""
+        parts = [memoryview(p).cast("B") for p in parts]
+        size = sum(len(p) for p in parts)
         idb = _id_buf(bytes(object_id))
         off = ctypes.c_uint64()
         for _ in range(3):
@@ -127,7 +133,10 @@ class SharedMemoryStore:
             raise ShmStoreError(f"create failed rc={rc}")
         else:
             raise ShmStoreError(f"object of {size} bytes does not fit")
-        self._mm[off.value:off.value + size] = data
+        pos = off.value
+        for p in parts:
+            self._mm[pos:pos + len(p)] = p
+            pos += len(p)
         self._libh.store_seal(self._h, idb)
 
     def get(self, object_id: bytes) -> memoryview:

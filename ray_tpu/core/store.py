@@ -48,6 +48,9 @@ class LocalObjectStore:
         self._used = 0
         self._lock = threading.RLock()
         self._seal_events: dict[ObjectID, threading.Event] = {}
+        # Optional runtime hook fired after every seal — wakes event-driven
+        # wait()/get() paths without polling.
+        self.on_seal = None
 
     # -- create/seal -------------------------------------------------------
     def put(self, object_id: ObjectID, data: bytes, owner_id: WorkerID) -> None:
@@ -61,6 +64,8 @@ class LocalObjectStore:
             ev = self._seal_events.pop(object_id, None)
         if ev is not None:
             ev.set()
+        if self.on_seal is not None:
+            self.on_seal()
 
     # -- read --------------------------------------------------------------
     def get(self, object_id: ObjectID, timeout: float | None = None) -> bytes:

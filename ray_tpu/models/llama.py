@@ -177,35 +177,68 @@ def _layer(cfg: LlamaConfig, x, layer_params, inv_freq, positions,
     return x
 
 
-def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array,
-            positions: jax.Array | None = None, attn_impl: str = "flash",
-            sp_axis: str | None = None, remat: bool = True) -> jax.Array:
-    """tokens [B, S] → logits [B, S, V] (fp32)."""
+def _remat_wrap(layer_fn, remat):
+    """remat policy: True/'full' = recompute everything (min memory),
+    'dots' = save matmul outputs (jax.checkpoint_policies.checkpoint_dots —
+    ~no recompute FLOPs at moderate memory), False/'none' = save all."""
+    if remat in (False, "none"):
+        return layer_fn
+    if remat == "dots":
+        return jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.checkpoint_dots)
+    return jax.checkpoint(layer_fn)
+
+
+def forward_hidden(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+                   positions: jax.Array | None = None,
+                   attn_impl: str = "flash", sp_axis: str | None = None,
+                   remat: bool | str = True) -> jax.Array:
+    """tokens [B, S] → final-norm hidden states [B, S, H]."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.arange(s)
     x = params["embed_tokens"][tokens]
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
-    layer_fn = partial(_layer, cfg, inv_freq=inv_freq, positions=positions,
-                       attn_impl=attn_impl, sp_axis=sp_axis)
-    if remat:
-        layer_fn = jax.checkpoint(layer_fn)
+    layer_fn = _remat_wrap(
+        partial(_layer, cfg, inv_freq=inv_freq, positions=positions,
+                attn_impl=attn_impl, sp_axis=sp_axis), remat)
 
     def scan_body(x, lp):
         return layer_fn(x, lp), None
 
     x, _ = lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x.astype(jnp.float32) @ head.astype(jnp.float32))
-    return logits
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def unembed_weights(cfg: LlamaConfig, params: dict) -> jax.Array:
+    """[H, V] head matrix (transpose of tied embeddings stays a lazy dot
+    permutation under XLA — never materialized)."""
+    return params["embed_tokens"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+            positions: jax.Array | None = None, attn_impl: str = "flash",
+            sp_axis: str | None = None, remat: bool | str = True) -> jax.Array:
+    """tokens [B, S] → logits [B, S, V] (fp32). bf16 MXU matmul with fp32
+    accumulation — a fp32×fp32 dot would run off the MXU fast path."""
+    x = forward_hidden(cfg, params, tokens, positions, attn_impl, sp_axis,
+                       remat)
+    head = unembed_weights(cfg, params)
+    return jnp.einsum("bsh,hv->bsv", x, head,
+                      preferred_element_type=jnp.float32)
 
 
 def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array,
             targets: jax.Array, mask: jax.Array | None = None,
-            **fwd_kwargs) -> jax.Array:
+            fused_ce: bool = True, **fwd_kwargs) -> jax.Array:
     """Mean next-token cross-entropy over unmasked positions."""
+    if fused_ce:
+        from ray_tpu.ops.loss import fused_cross_entropy
+
+        x = forward_hidden(cfg, params, tokens, **fwd_kwargs)
+        head = unembed_weights(cfg, params)
+        return fused_cross_entropy(x, head, targets, mask)
     logits = forward(cfg, params, tokens, **fwd_kwargs)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

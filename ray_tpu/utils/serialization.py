@@ -47,18 +47,70 @@ def find_nested_refs(obj: Any) -> list:
         return []
 
 
-def serialize(obj: Any) -> bytes:
-    """Serialize ``obj`` to a self-describing byte string."""
+class _ArgPickler(cloudpickle.CloudPickler):
+    """CloudPickler that records ObjectRefs as they stream past."""
+
+    _ref_cls = None  # resolved lazily (import cycle)
+
+    def __init__(self, file, refs: list):
+        super().__init__(file, protocol=pickle.HIGHEST_PROTOCOL)
+        if _ArgPickler._ref_cls is None:
+            from ray_tpu.core.object_ref import ObjectRef
+
+            _ArgPickler._ref_cls = ObjectRef
+        self._refs = refs
+
+    def persistent_id(self, o):  # noqa: N802 - pickle API name
+        if isinstance(o, _ArgPickler._ref_cls):
+            self._refs.append(o)
+        return None  # keep normal pickling; we only observe
+
+
+def serialize_args(args_kwargs: tuple) -> tuple[bytes, list]:
+    """Serialize ``(args, kwargs)`` and collect nested ObjectRefs in ONE
+    pickle pass (the hot submit path previously paid a discovery dump plus a
+    serialization dump — reference: the raylet codepath also discovers refs
+    during argument serialization, serialization.py SerializedObject)."""
+    found: list = []
+    buf = io.BytesIO()
+    _ArgPickler(buf, found).dump(args_kwargs)
+    return _TAG_PICKLE + buf.getvalue(), found
+
+
+def dumps_spec(spec) -> bytes:
+    """Wire format for Task/ActorCreation specs: plain pickle (protocol 5).
+    Specs are plain dataclasses of importable classes — cloudpickle's
+    reducer_override machinery is ~3x slower and only needed for code
+    objects, which ride pre-serialized in fn_blob/cls_blob."""
+    return pickle.dumps(spec, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads_spec(data: bytes):
+    return pickle.loads(data)
+
+
+def serialize_parts(obj: Any) -> list:
+    """Serialize ``obj`` to a list of buffers whose concatenation is the wire
+    format. Large array payloads stay as zero-copy memoryviews so the store
+    layer can scatter-write them (one memcpy into the shm arena instead of a
+    serialize-copy followed by a store-copy — reference: plasma writes the
+    pickle5 out-of-band buffers straight into the object's plasma slab)."""
     if isinstance(obj, np.ndarray) and obj.dtype != object:
         header = cloudpickle.dumps((obj.dtype.str, obj.shape))
         buf = np.ascontiguousarray(obj)
-        return (
-            _TAG_NDARRAY
-            + len(header).to_bytes(4, "little")
-            + header
-            + memoryview(buf).cast("B").tobytes()
-        )
-    return _TAG_PICKLE + cloudpickle.dumps(obj)
+        return [
+            _TAG_NDARRAY + len(header).to_bytes(4, "little") + header,
+            memoryview(buf).cast("B"),
+        ]
+    return [_TAG_PICKLE + cloudpickle.dumps(obj)]
+
+
+def serialize(obj: Any) -> bytes:
+    """Serialize ``obj`` to a self-describing byte string."""
+    parts = serialize_parts(obj)
+    if len(parts) == 1:
+        return bytes(parts[0]) if isinstance(parts[0], memoryview) else parts[0]
+    return b"".join(parts)
 
 
 def deserialize(data: bytes | memoryview) -> Any:

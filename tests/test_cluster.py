@@ -231,3 +231,38 @@ def test_node_death_detection(cluster):
             break
         time.sleep(0.2)
     assert not nodes[nid]["alive"]
+
+
+def test_cancel_running_task(cluster):
+    """A long-running task is interrupted in its worker (reference:
+    CoreWorker::CancelTask raises in the executing thread)."""
+
+    @remote
+    def spin():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30:
+            time.sleep(0.01)
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # let it start executing
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+
+
+def test_cancel_queued_task(cluster):
+    """A task cancelled while queued behind a busy resource never runs."""
+
+    @remote(resources={"TPU": 4.0})
+    def hold(sec):
+        time.sleep(sec)
+        return "held"
+
+    holder = hold.remote(3.0)
+    time.sleep(0.5)  # holder now occupies all 4 TPU
+    victim = hold.remote(0.0)  # queued: no TPU available
+    ray_tpu.cancel(victim)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(victim, timeout=20)
+    assert ray_tpu.get(holder, timeout=20) == "held"

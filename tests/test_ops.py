@@ -201,3 +201,65 @@ def test_rope_llama3_scaling():
     assert np.asarray(inv_scaled)[-1] < np.asarray(inv_plain)[-1]
     np.testing.assert_allclose(np.asarray(inv_scaled)[0],
                                np.asarray(inv_plain)[0])
+
+
+# ---------------------------------------------------------------------------
+# fused cross-entropy (ops/loss.py)
+# ---------------------------------------------------------------------------
+
+def _ce_reference(x, head, targets, mask):
+    logits = (x.astype(jnp.float32) @ head.astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    m = (jnp.ones_like(nll) if mask is None else mask).astype(jnp.float32)
+    return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+@pytest.mark.parametrize("chunk", [4, 8, 32])
+def test_fused_cross_entropy_matches_reference(chunk):
+    from ray_tpu.ops.loss import fused_cross_entropy
+
+    key = jax.random.PRNGKey(0)
+    b, s, h, v = 2, 16, 8, 32
+    x = jax.random.normal(key, (b, s, h), jnp.float32)
+    head = jax.random.normal(jax.random.PRNGKey(1), (h, v), jnp.float32) * 0.2
+    targets = jax.random.randint(jax.random.PRNGKey(2), (b, s), 0, v)
+
+    got = fused_cross_entropy(x, head, targets, None, chunk)
+    want = _ce_reference(x, head, targets, None)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_cross_entropy_grads_match():
+    from ray_tpu.ops.loss import fused_cross_entropy
+
+    key = jax.random.PRNGKey(3)
+    b, s, h, v = 2, 8, 8, 24
+    x = jax.random.normal(key, (b, s, h), jnp.float32)
+    head = jax.random.normal(jax.random.PRNGKey(4), (h, v), jnp.float32) * 0.2
+    targets = jax.random.randint(jax.random.PRNGKey(5), (b, s), 0, v)
+    mask = (jax.random.uniform(jax.random.PRNGKey(6), (b, s)) > 0.3)
+
+    gx, gh = jax.grad(
+        lambda x_, h_: fused_cross_entropy(x_, h_, targets, mask, 4),
+        argnums=(0, 1))(x, head)
+    rx, rh = jax.grad(
+        lambda x_, h_: _ce_reference(x_, h_, targets, mask),
+        argnums=(0, 1))(x, head)
+    np.testing.assert_allclose(gx, rx, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gh, rh, rtol=1e-4, atol=1e-5)
+
+
+def test_llama_loss_fused_matches_unfused():
+    from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    fused = loss_fn(cfg, params, tokens, targets, attn_impl="blockwise",
+                    remat=False, fused_ce=True)
+    plain = loss_fn(cfg, params, tokens, targets, attn_impl="blockwise",
+                    remat=False, fused_ce=False)
+    np.testing.assert_allclose(fused, plain, rtol=1e-5, atol=1e-5)
