@@ -86,8 +86,6 @@ class Counter:
 def bench_actor_calls_sync():
     a = Counter.remote()
     ray_tpu.get(a.noop.remote(), timeout=60)  # ensure started
-    def op():
-        ray_tpu.get([a.noop.remote() for _ in range(10)])
     rate = timeit("1_1_actor_calls_sync", lambda: ray_tpu.get(a.noop.remote()))
     ray_tpu.kill(a)
     return rate
@@ -120,7 +118,6 @@ def bench_1_n_actor_calls(n=4, batch=100):
 def bench_n_n_actor_calls(n=4, batch=100):
     actors = [Counter.remote() for _ in range(n)]
     ray_tpu.get([a.noop.remote() for a in actors], timeout=120)
-    results = [0.0] * n
 
     def client(i):
         refs = [actors[i].noop.remote() for _ in range(batch)]

@@ -1,0 +1,143 @@
+"""Serve-LLM latency benchmark: time-to-first-token through the full stack.
+
+Measures TTFT (request start → first SSE token frame) and per-token latency
+through proxy → router → replica → engine with streaming enabled, under
+concurrent load — the serving health metric BASELINE.md targets ("Serve LLM
+inference p50 TTFT", reference: release serve_tests latency suites).
+
+On TPU the flagship 1B model serves real tokens; off-TPU the tiny config
+exercises the identical code path. Writes PERF_SERVE.json.
+
+Run: python bench_serve.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+
+def _tpu_reachable(timeout: float = 60.0) -> bool:
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert any(d.platform == 'tpu' for d in jax.devices())"],
+            timeout=timeout, capture_output=True)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def main() -> None:
+    on_tpu = _tpu_reachable()
+    if not on_tpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.serving import build_openai_app
+
+    if on_tpu:
+        cfg = LLMConfig(model="llama3_1b", max_num_seqs=8, max_seq_len=1024,
+                        dtype="bfloat16")
+        n_requests, concurrency, max_tokens = 24, 6, 32
+        label = "llama_1b"
+    else:
+        cfg = LLMConfig(model="tiny", max_num_seqs=4, max_seq_len=256)
+        n_requests, concurrency, max_tokens = 12, 3, 16
+        label = "tiny_cpu"
+
+    ray_tpu.init()
+    serve.run(build_openai_app(cfg), route_prefix="/", http=True)
+    port = serve.http_port()
+    url = f"http://127.0.0.1:{port}/v1/chat/completions"
+
+    # Warm the engine (first compile).
+    _one_request(url, max_tokens=4)
+
+    ttfts, totals, tokens_out = [], [], []
+    lock = threading.Lock()
+    sem = threading.Semaphore(concurrency)
+
+    def worker(i):
+        with sem:
+            try:
+                ttft, total, ntok = _one_request(url, max_tokens=max_tokens,
+                                                 seed=i)
+            except Exception as e:  # noqa: BLE001
+                print(f"request {i} failed: {e}", file=sys.stderr)
+                return
+            with lock:
+                ttfts.append(ttft)
+                totals.append(total)
+                tokens_out.append(ntok)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_requests)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    if not ttfts:
+        print(json.dumps({"error": "no successful requests"}))
+        sys.exit(1)
+    ttfts_ms = np.array(ttfts) * 1e3
+    out = {
+        "model": label,
+        "requests": len(ttfts),
+        "concurrency": concurrency,
+        "ttft_ms": {"p50": round(float(np.percentile(ttfts_ms, 50)), 1),
+                    "p90": round(float(np.percentile(ttfts_ms, 90)), 1),
+                    "p99": round(float(np.percentile(ttfts_ms, 99)), 1)},
+        "tokens_per_sec_total": round(sum(tokens_out) / wall, 1),
+        "mean_request_s": round(float(np.mean(totals)), 3),
+    }
+    with open("PERF_SERVE.json", "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+def _one_request(url: str, max_tokens: int, seed: int = 0):
+    body = json.dumps({
+        "messages": [{"role": "user",
+                      "content": f"benchmark prompt {seed} " * 4}],
+        "max_tokens": max_tokens,
+        "temperature": 0.0,
+        "stream": True,
+    }).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    ttft = None
+    ntok = 0
+    with urllib.request.urlopen(req, timeout=300) as r:
+        while True:
+            chunk = r.read1(8192)
+            if not chunk:
+                break
+            if b"data:" in chunk:
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                ntok += chunk.count(b"data:")
+    return ttft if ttft is not None else time.perf_counter() - t0, \
+        time.perf_counter() - t0, ntok
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,24 @@ class Cluster:
         if daemon in self.nodes:
             self.nodes.remove(daemon)
 
+    def kill_workers(self, node: NodeDaemon | None = None) -> int:
+        """Chaos: SIGKILL every worker process on a node (reference:
+        WorkerKillerActor, test_utils.py:1279). Returns the kill count —
+        objects held only by those workers become reconstruction fodder."""
+        import signal
+
+        targets = [node] if node else list(self.nodes)
+        n = 0
+        for d in targets:
+            for w in list(d.workers.values()) + list(d._unregistered):
+                if w.proc is not None and w.proc.poll() is None:
+                    try:
+                        w.proc.send_signal(signal.SIGKILL)
+                        n += 1
+                    except OSError:
+                        pass
+        return n
+
     def connect(self, node: NodeDaemon | None = None) -> ClusterRuntime:
         target = node or (self.nodes[0] if self.nodes else None)
         rt = ClusterRuntime(

@@ -42,6 +42,7 @@ class XlaCollectiveGroup:
         self.mesh = mesh
         self.axis = axis
         self.group_name = group_name
+        self._p2p: dict[int, list] = {}  # src_rank -> buffered sends
 
     @property
     def world_size(self) -> int:
@@ -121,6 +122,23 @@ class XlaCollectiveGroup:
                 )(x)
             return fn
 
+        if op.startswith("reduce_"):
+            reducer = _REDUCERS[op.split("_")[1]]
+            dst = int(extra)
+
+            @jax.jit
+            def fn(x):
+                def inner(s):
+                    r = reducer(s, axis)
+                    keep = lax.axis_index(axis) == dst
+                    return jnp.where(keep, r, s)[None]
+                # Members differ post-reduce (dst holds the reduction, the
+                # rest keep their input), so the global result is the
+                # per-member stack [world, ...].
+                return shard_map(inner, mesh=mesh, in_specs=repl,
+                                 out_specs=P(axis), check_vma=False)(x)
+            return fn
+
         if op == "broadcast":
             src = int(extra)
 
@@ -171,8 +189,13 @@ class XlaCollectiveGroup:
         return self._compiled("broadcast", src_rank)(x)
 
     def reduce(self, x, dst_rank: int = 0, op: str = "sum"):
-        # XLA collectives are symmetric; reduce == allreduce (dst sees it).
-        return self.allreduce(x, op=op)
+        """Reduce replicated copies to ``dst_rank``. Members diverge after a
+        reduce (only dst holds the reduction; the rest keep their input —
+        reference: collective.py:356 reduce semantics), so the result is the
+        per-member stack ``[world, *x.shape]``: ``out[dst_rank]`` is the
+        reduction, ``out[r]`` is member r's original value."""
+        x = self._device_put_sharded(jnp.asarray(x), P())
+        return self._compiled(f"reduce_{op}", int(dst_rank))(x)
 
     def ppermute(self, x, perm: list[tuple[int, int]]):
         x = self._device_put_sharded(x, P(self.axis))
@@ -183,17 +206,35 @@ class XlaCollectiveGroup:
         x = jnp.zeros((self.world_size,), jnp.float32)
         self.allreduce(x).block_until_ready()
 
-    def send(self, x, dst_rank: int):
-        raise NotImplementedError(
-            "point-to-point send/recv lowers to ppermute on TPU; use "
-            "ppermute(x, [(src, dst)])"
-        )
+    def send(self, x, dst_rank: int, src_rank: int = 0):
+        """Point-to-point shard move src→dst, lowered to a one-pair
+        ``lax.ppermute`` over ICI (reference: send/recv
+        collective.py:576/:639 — NCCL p2p). The group is single-controller
+        SPMD, so one call expresses both sides; the moved array is also
+        buffered for a matching ``recv``."""
+        out = self.ppermute(x, [(int(src_rank), int(dst_rank))])
+        buf = self._p2p.setdefault(int(src_rank), [])
+        buf.append(out)
+        if len(buf) > 64:  # send-only usage must not pin arrays forever
+            buf.pop(0)
+        return out
 
     def recv(self, shape, dtype, src_rank: int):
-        raise NotImplementedError(
-            "point-to-point send/recv lowers to ppermute on TPU; use "
-            "ppermute(x, [(src, dst)])"
-        )
+        """Take the oldest buffered ``send`` from ``src_rank`` (matched-pair
+        protocol of the two-sided API, collapsed into one process)."""
+        buf = self._p2p.get(int(src_rank))
+        if not buf:
+            raise RuntimeError(
+                f"recv: no buffered send from rank {src_rank}; in the "
+                "single-controller XLA group send() and recv() form a "
+                "matched pair in the same process")
+        out = buf.pop(0)
+        if tuple(shape) != tuple(out.shape) or jnp.dtype(dtype) != out.dtype:
+            raise ValueError(
+                f"recv: shape/dtype mismatch: sent {out.shape}/{out.dtype}, "
+                f"expected {tuple(shape)}/{jnp.dtype(dtype)}")
+        return out
 
     def destroy(self):
         self._compiled.cache_clear()
+        self._p2p.clear()

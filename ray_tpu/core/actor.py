@@ -103,6 +103,11 @@ class ActorHandle:
             trace_ctx=tracing.inject(),
         )
         refs = worker.runtime.submit_actor_task(spec)
+        if num_returns == "streaming":
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, worker.worker_id,
+                                      end_ref=refs[0])
         return refs[0] if num_returns == 1 else refs
 
     def _call_fn(self, fn, *args, num_returns: int = 1):

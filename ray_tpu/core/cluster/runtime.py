@@ -172,6 +172,13 @@ class ClusterRuntime:
         self._actor_addr_cache: dict[str, tuple[str, int]] = {}
         self._actor_states: dict[str, str] = {}
         self._cancelled: set[str] = set()  # task_id hex
+        # Lineage retention for reconstruction (reference:
+        # task_manager.h:184 lineage kept while returns are referenced;
+        # object_recovery_manager.h:41 resubmits the creating task when a
+        # stored copy is lost). task_id hex -> (spec, blob, live return count).
+        self._lineage: dict[str, list] = {}
+        self._recovering: set[ObjectID] = set()
+        self._recovery_attempts: dict[ObjectID, int] = {}
         self._shutdown = False
         # Wakes wait()/get() when results land (event-driven wait; the
         # reference wakes waiters from the in-memory store's seal path).
@@ -183,6 +190,7 @@ class ClusterRuntime:
         self.server.register("get_object", self._handle_get_object)
         self.server.register("free_object", self._handle_free_object)
         self.server.register("report_location", self._handle_report_location)
+        self.server.register("report_lost", self._handle_report_lost)
         self.server.register("ping", self._handle_ping)
         self.addr = self._io.run(self.server.start())
         self.head.call("register_worker", worker_id=self.worker_id.hex(),
@@ -230,6 +238,16 @@ class ClusterRuntime:
         self._notify_waiters()
         return {"ok": True}
 
+    async def _handle_report_lost(self, conn, oid: str):
+        """A borrower found our recorded holder unreachable: run owner-side
+        lineage recovery (reference: owner-driven recovery on lost copies)."""
+        object_id = ObjectID.from_hex(oid)
+        if self._local_contains(object_id):
+            return {"ok": True, "state": "present"}
+        self._locations.pop(object_id, None)
+        ok = self._recover_object(object_id)
+        return {"ok": ok, "state": "recovering" if ok else "lost"}
+
     async def _on_pub(self, channel: str, payload: dict):
         if channel == "actor_events":
             aid = payload.get("actor_id")
@@ -272,6 +290,15 @@ class ClusterRuntime:
     # ------------------------------------------------------------------ put/get
     def _release_object(self, oid: ObjectID, rec=None) -> None:
         self.store.delete(oid)
+        self._recovery_attempts.pop(oid, None)
+        # Lineage GC: drop the retained spec once its last return is
+        # released (reference: lineage released with the object refs).
+        if rec is not None and rec.lineage_task is not None:
+            entry = self._lineage.get(rec.lineage_task.hex())
+            if entry is not None:
+                entry[2] -= 1
+                if entry[2] <= 0:
+                    self._lineage.pop(rec.lineage_task.hex(), None)
         # The shm arena is shared node-wide: only the object's owner may
         # delete from it — a borrower releasing its cache must not GC data
         # other processes still reference (reference: owner-driven GC,
@@ -339,6 +366,7 @@ class ClusterRuntime:
             return local
         owner_hex = ref.owner_id.hex() if ref.owner_id else None
         am_owner = ref.owner_id == self.worker_id
+        holder_failures = 0
         while True:
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
@@ -351,6 +379,18 @@ class ClusterRuntime:
                     data = self._fetch_from_holder(holder, ref)
                     if data is not None:
                         return data
+                    holder_failures += 1
+                    if holder_failures >= 2:
+                        # Holder is gone: reconstruct from lineage by
+                        # resubmitting the creating task (reference:
+                        # object_recovery_manager.h:41), or fail for
+                        # unrecoverable objects (puts, exhausted retries).
+                        holder_failures = 0
+                        self._locations.pop(ref.id, None)
+                        if not self._recover_object(ref.id):
+                            raise ObjectLostError(
+                                ref.hex(),
+                                "holder died and object has no lineage")
                     time.sleep(0.01)
                     continue
                 step = 0.1 if remaining is None else min(0.1, remaining)
@@ -374,7 +414,7 @@ class ClusterRuntime:
             try:
                 res = self._peer(addr).call("get_object", oid=ref.hex(),
                                             timeout=min(remaining or 10.0, 10.0) + 5)
-            except RpcError:
+            except (RpcError, OSError):
                 raise ObjectLostError(ref.hex(), "owner unreachable")
             if res.get("data") is not None:
                 self.store.put(ref.id, res["data"], ref.owner_id)
@@ -383,6 +423,16 @@ class ClusterRuntime:
                 data = self._fetch_from_holder(res["location"], ref)
                 if data is not None:
                     return data
+                holder_failures += 1
+                if holder_failures >= 2:
+                    # Tell the owner its recorded holder is unreachable so
+                    # IT can run recovery (only the owner has the lineage).
+                    holder_failures = 0
+                    try:
+                        self._peer(addr).call("report_lost", oid=ref.hex(),
+                                              timeout=10)
+                    except (RpcError, OSError):
+                        pass
             # pending: loop
 
     def _fetch_from_holder(self, holder_hex: str, ref: ObjectRef) -> bytes | None:
@@ -391,7 +441,7 @@ class ClusterRuntime:
             return None
         try:
             res = self._peer(addr).call("get_object", oid=ref.hex(), timeout=15)
-        except RpcError:
+        except (RpcError, OSError):  # dead holder: connect refused or reset
             return None
         if res.get("data") is not None:
             return res["data"]
@@ -431,8 +481,45 @@ class ClusterRuntime:
             spec.task_id.hex(), spec.name, "SUBMITTED",
             worker_id=self.worker_id.hex(), job_id=spec.job_id.hex())
         item = _TaskItem(spec, serialization.dumps_spec(spec), return_ids)
+        if spec.num_returns != "streaming":
+            # Retain lineage while any return is referenced so a lost copy
+            # can be recomputed by resubmission.
+            self._lineage[spec.task_id.hex()] = [spec, item.blob,
+                                                 len(return_ids)]
         self._io.loop.call_soon_threadsafe(self._submit_on_loop, item)
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+
+    def _recover_object(self, object_id: ObjectID) -> bool:
+        """Lineage reconstruction: resubmit the task that created the object
+        (reference: ObjectRecoveryManager::RecoverObject). Returns False when
+        the object has no recomputable lineage (puts, exhausted retries)."""
+        tid = self.refs.lineage_task(object_id)
+        if tid is None:
+            return False
+        entry = self._lineage.get(tid.hex())
+        if entry is None:
+            return False
+        attempts = self._recovery_attempts.get(object_id, 0)
+        if attempts >= 3:
+            return False
+        self._recovery_attempts[object_id] = attempts + 1
+        spec, blob, _ = entry
+
+        def on_loop():
+            # _recovering stays set until the resubmitted task's results
+            # land (_handle_task_reply / _store_error_local clear it) —
+            # dedups concurrent getters racing to recover the same object.
+            if object_id in self._recovering:
+                return
+            self._recovering.add(object_id)
+            # Forget the stale location; the fresh execution reports anew.
+            for oid in spec.return_ids():
+                self._locations.pop(oid, None)
+            item = _TaskItem(spec, blob, spec.return_ids())
+            self._submit_on_loop(item)
+
+        self._io.loop.call_soon_threadsafe(on_loop)
+        return True
 
     # -- loop-side submission state machine --------------------------------
     def _submit_on_loop(self, item: _TaskItem) -> None:
@@ -527,30 +614,48 @@ class ClusterRuntime:
 
     async def _request_lease(self, ks: _KeyState) -> None:
         """Lease a worker from the local daemon, following spillback
-        redirects (reference: cluster_lease_manager spillback)."""
+        redirects (reference: cluster_lease_manager spillback). A granted
+        worker that refuses connections (killed between grant and connect)
+        is returned and the lease re-requested."""
         try:
-            daemon = self._daemon.aio
-            res = await daemon.call("request_lease", resources=ks.resources,
-                                    env_hash=ks.env_hash, timeout=None)
-            hops = 0
-            while res.get("spill") and hops < 4:
-                daemon = await self._apeer(tuple(res["spill"]))
-                # Final hop commits to its node: prevents spill ping-pong
-                # when every node is briefly busy.
+            for _ in range(4):
+                daemon = self._daemon.aio
                 res = await daemon.call("request_lease", resources=ks.resources,
-                                        env_hash=ks.env_hash, timeout=None,
-                                        allow_spill=hops < 3)
-                hops += 1
-            if res.get("spill"):
-                raise ValueError(
-                    f"lease spill chain exhausted for {ks.resources}")
-            if res.get("error"):
-                raise ValueError(res["error"])
-            client = AsyncRpcClient(*tuple(res["addr"]))
-            await client.connect()
-            w = _LeasedWorker(res["lease_id"], res["worker_id"],
-                              tuple(res["addr"]), client, daemon)
-            ks.workers.append(w)
+                                        env_hash=ks.env_hash, timeout=None)
+                hops = 0
+                while res.get("spill") and hops < 4:
+                    daemon = await self._apeer(tuple(res["spill"]))
+                    # Final hop commits to its node: prevents spill
+                    # ping-pong when every node is briefly busy.
+                    res = await daemon.call("request_lease",
+                                            resources=ks.resources,
+                                            env_hash=ks.env_hash, timeout=None,
+                                            allow_spill=hops < 3)
+                    hops += 1
+                if res.get("spill"):
+                    raise ValueError(
+                        f"lease spill chain exhausted for {ks.resources}")
+                if res.get("error"):
+                    raise ValueError(res["error"])
+                client = AsyncRpcClient(*tuple(res["addr"]))
+                client.on_notify("stream_item", self._on_stream_item)
+                try:
+                    await client.connect()
+                except OSError:
+                    # Dead-on-arrival worker (chaos kill mid-grant): hand
+                    # the lease back so the daemon reaps it, then retry.
+                    try:
+                        await daemon.call("return_lease",
+                                          lease_id=res["lease_id"])
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.1)
+                    continue
+                w = _LeasedWorker(res["lease_id"], res["worker_id"],
+                                  tuple(res["addr"]), client, daemon)
+                ks.workers.append(w)
+                return
+            raise ValueError("granted workers repeatedly unreachable")
         except Exception as e:  # noqa: BLE001
             # Lease failed (infeasible/timeout): fail the oldest queued task
             # of this key — mirrors the old per-task acquire semantics where
@@ -565,17 +670,42 @@ class ClusterRuntime:
             self._pump(ks)
 
     def _handle_task_reply(self, spec, return_ids, reply: dict):
+        if "stream_count" in reply:
+            # End of a streaming task: the item count seals the stream
+            # (return_ids == [end marker oid] for streaming specs).
+            self.store.put(return_ids[0],
+                           serialization.serialize(int(reply["stream_count"])),
+                           self.worker_id)
+            self._notify_waiters()
+            return
         results = reply.get("results", [])
         for oid, r in zip(return_ids, results):
+            self._recovering.discard(oid)
             if r.get("data") is not None:
                 self.store.put(oid, r["data"], self.worker_id)
             elif r.get("location"):
                 self._locations[oid] = r["location"]
         self._notify_waiters()
 
+    async def _on_stream_item(self, task_id: str, index: int,
+                              data: bytes | None = None,
+                              location: str | None = None):
+        """A streaming task yielded item ``index`` (notify frame from the
+        executing worker — arrives before the final reply by TCP ordering)."""
+        from ray_tpu.utils.ids import TaskID
+
+        oid = ObjectID.for_task_return(TaskID.from_hex(task_id), index)
+        self.refs.add_owned(oid, self.worker_id)
+        if data is not None:
+            self.store.put(oid, data, self.worker_id)
+        elif location:
+            self._locations[oid] = location
+        self._notify_waiters()
+
     def _store_error_local(self, return_ids, err):
         blob = serialization.serialize(err)
         for oid in return_ids:
+            self._recovering.discard(oid)
             self.store.put(oid, blob, self.worker_id)
         self._notify_waiters()
 
@@ -733,6 +863,7 @@ class ClusterRuntime:
                         self._actor_addr_cache[st.actor_id] = addr
                 if addr is not None:
                     client = AsyncRpcClient(*addr)
+                    client.on_notify("stream_item", self._on_stream_item)
                     try:
                         await client.connect()
                     except OSError:

@@ -147,10 +147,64 @@ class WorkerProcess:
     async def _push_task(self, conn, spec_blob: bytes):
         spec: TaskSpec = serialization.loads_spec(spec_blob)
         loop = asyncio.get_running_loop()
+        emit = self._stream_emitter(conn, loop, spec) \
+            if spec.num_returns == "streaming" else None
         # Serial execution: one normal task at a time per leased worker
         # (reference semantics — a worker runs one task; pipelined pushes
         # queue here, matching lease-based resource accounting).
-        return await loop.run_in_executor(self._task_executor, self._execute_task, spec)
+        return await loop.run_in_executor(self._task_executor,
+                                          self._execute_task, spec, emit)
+
+    def _stream_emitter(self, conn, loop, spec):
+        """Item pump for streaming tasks: each yield goes back to the owner
+        as a notify frame on the submitting connection (TCP ordering puts
+        every item before the final reply — reference: streamed generator
+        returns report each dynamic return to the owner as produced)."""
+        cfg = get_config()
+
+        def emit(index: int, value) -> None:
+            from ray_tpu.utils.ids import ObjectID
+
+            blob = serialization.serialize(value)
+            tid = spec.task_id.hex()
+            if len(blob) <= cfg.inline_object_max_bytes:
+                coro = conn.notify("stream_item", task_id=tid, index=index,
+                                   data=blob)
+            else:
+                oid = ObjectID.for_task_return(spec.task_id, index)
+                self.runtime._store_blob(
+                    oid, blob, spec.owner_id or self.runtime.worker_id)
+                coro = conn.notify("stream_item", task_id=tid, index=index,
+                                   location=self.runtime.worker_id.hex())
+            asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=60)
+
+        return emit
+
+    def _run_stream(self, spec, result, emit) -> dict:
+        """Drive a streaming task's generator; returns the end-of-stream
+        reply ({"stream_count": N} or the error for the end marker).
+        Registered in _running_tasks for the whole drive so cancel_task can
+        interrupt mid-stream (the generator body runs HERE, not in the
+        user-function call that produced the generator object)."""
+        tid_hex = spec.task_id.hex()
+        self._running_tasks[tid_hex] = threading.get_ident()
+        i = 0
+        try:
+            for v in result:
+                if tid_hex in self._cancelled_tasks:
+                    raise TaskCancelledError()
+                emit(i, v)
+                i += 1
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, (TaskError, ActorDiedError,
+                                      TaskCancelledError)) \
+                else TaskError(e, task_desc=spec.name)
+            return {"results": [{"data": serialization.serialize(err)}],
+                    "stream_error": True}
+        finally:
+            self._running_tasks.pop(tid_hex, None)
+            self._cancelled_tasks.discard(tid_hex)
+        return {"stream_count": i}
 
     async def _cancel_task(self, conn, task_id: str, force: bool = False):
         """Best-effort cancel (reference: CoreWorker::HandleCancelTask —
@@ -166,7 +220,7 @@ class WorkerProcess:
                 ctypes.c_ulong(tident), ctypes.py_object(TaskCancelledError))
         return {"ok": True, "was_running": tident is not None}
 
-    def _execute_task(self, spec: TaskSpec) -> dict:
+    def _execute_task(self, spec: TaskSpec, stream_emit=None) -> dict:
         from ray_tpu.core.events import task_execution
         from ray_tpu.core.worker import set_task_context
 
@@ -206,6 +260,8 @@ class WorkerProcess:
         finally:
             self._running_tasks.pop(tid_hex, None)
             self._cancelled_tasks.discard(tid_hex)
+        if stream_emit is not None:
+            return self._run_stream(spec, result, stream_emit)
         return {"results": self._package_results(spec, return_ids, result)}
 
     def _resolve(self, obj):
@@ -285,7 +341,7 @@ class WorkerProcess:
             item = self._actor_mailbox.get()
             if item is None:
                 return
-            spec, reply_fut, loop = item
+            spec, reply_fut, loop, conn = item
             method = getattr(type(self._actor_instance), spec.method_name, None)
             is_async = inspect.iscoroutinefunction(method)
             # args= binds eagerly — a lambda would capture the loop variables
@@ -295,20 +351,20 @@ class WorkerProcess:
                 # a dedicated thread keeps both the consumer and the
                 # concurrency pool free.
                 threading.Thread(target=self._run_actor_method,
-                                 args=(spec, reply_fut, loop),
+                                 args=(spec, reply_fut, loop, conn),
                                  daemon=True).start()
             elif is_async or self._actor_pool is not None:
                 if self._actor_pool is not None:
                     self._actor_pool.submit(
-                        self._run_actor_method, spec, reply_fut, loop)
+                        self._run_actor_method, spec, reply_fut, loop, conn)
                 else:
                     threading.Thread(target=self._run_actor_method,
-                                     args=(spec, reply_fut, loop),
+                                     args=(spec, reply_fut, loop, conn),
                                      daemon=True).start()
             else:
-                self._run_actor_method(spec, reply_fut, loop)
+                self._run_actor_method(spec, reply_fut, loop, conn)
 
-    def _run_actor_method(self, spec: TaskSpec, reply_fut, loop):
+    def _run_actor_method(self, spec: TaskSpec, reply_fut, loop, conn=None):
         from ray_tpu.core.events import task_execution
         from ray_tpu.core.worker import set_task_context
 
@@ -337,7 +393,12 @@ class WorkerProcess:
                         result = method(*args, **kwargs)
             finally:
                 set_task_context(None, None, None)
-            reply = {"results": self._package_results(spec, return_ids, result)}
+            if spec.num_returns == "streaming" and conn is not None:
+                emit = self._stream_emitter(conn, loop, spec)
+                reply = self._run_stream(spec, result, emit)
+            else:
+                reply = {"results": self._package_results(spec, return_ids,
+                                                          result)}
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, (TaskError, ActorDiedError, TaskCancelledError)) \
                 else TaskError(e, task_desc=spec.method_name or "")
@@ -351,7 +412,7 @@ class WorkerProcess:
         spec: TaskSpec = serialization.loads_spec(spec_blob)
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._actor_mailbox.put((spec, fut, loop))
+        self._actor_mailbox.put((spec, fut, loop, conn))
         return await fut
 
     async def _exit_worker(self, conn):

@@ -288,6 +288,29 @@ class LocalRuntime:
         return obj
 
     def _store_results(self, spec: TaskSpec, return_ids: list[ObjectID], result: Any) -> None:
+        if spec.num_returns == "streaming":
+            # Drive the generator here (executor side); each yield becomes an
+            # object the consumer's ObjectRefGenerator picks up, the item
+            # count lands under STREAM_END_INDEX (reference: streaming
+            # generator returns, _raylet.pyx ObjectRefGenerator).
+            from ray_tpu.core.object_ref import STREAM_END_INDEX
+
+            i = 0
+            try:
+                for v in result:
+                    oid = ObjectID.for_task_return(spec.task_id, i)
+                    self.store.put(oid, serialization.serialize(v),
+                                   self.worker_id)
+                    self.refs.add_owned(oid, self.worker_id)
+                    i += 1
+            except BaseException as e:  # noqa: BLE001 - stream error → end marker
+                end = ObjectID.for_task_return(spec.task_id, STREAM_END_INDEX)
+                self.store.put(end, serialization.serialize(
+                    TaskError(e, task_desc=spec.name)), self.worker_id)
+                return
+            end = ObjectID.for_task_return(spec.task_id, STREAM_END_INDEX)
+            self.store.put(end, serialization.serialize(i), self.worker_id)
+            return
         if spec.num_returns == 1:
             values = [result]
         else:

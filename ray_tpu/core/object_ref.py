@@ -102,3 +102,102 @@ class ObjectRef:
         # Crossing a process boundary: the deserializing side becomes a
         # borrower (registered on arrival by the worker's deserializer).
         return (ObjectRef, (self.id, self.owner_id))
+
+
+# Stream-end sentinel index: the item count of a finished streaming task is
+# stored under this return index (far above any real item index).
+STREAM_END_INDEX = 0xFFFFFFFE
+
+
+class ObjectRefGenerator:
+    """Iterator over the yields of a streaming task
+    (``num_returns="streaming"``).
+
+    Capability parity with the reference's streaming generators (reference:
+    python/ray/_raylet.pyx ObjectRefGenerator; used by serve response
+    streaming and ray.data blocks): each ``__next__`` blocks until the next
+    yielded item is available at the owner and returns its ObjectRef. The
+    stream ends when the executor stores the item count under
+    STREAM_END_INDEX.
+    """
+
+    def __init__(self, task_id, owner_id: WorkerID, end_ref=None):
+        from ray_tpu.utils.ids import TaskID  # noqa: F401 - typing only
+
+        self._task_id = task_id
+        self._owner_id = owner_id
+        self._index = 0
+        self._total: int | None = None
+        # Pin the stream-end marker for the generator's lifetime — it's the
+        # task's only pre-declared return, and dropping its last ObjectRef
+        # would GC the sealed marker out from under the iteration.
+        self._end_ref = end_ref
+
+    def _runtime(self):
+        from ray_tpu.core.worker import global_worker
+
+        return global_worker.runtime
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        return self._next(timeout=300.0)
+
+    def _next(self, timeout: float) -> "ObjectRef":
+        import time as _time
+
+        rt = self._runtime()
+        local = getattr(rt, "_local_contains", None) or rt.store.contains
+        locations = getattr(rt, "_locations", {})  # remote holders count too
+        contains = lambda oid: local(oid) or oid in locations  # noqa: E731
+        oid = ObjectID.for_task_return(self._task_id, self._index)
+        end_oid = ObjectID.for_task_return(self._task_id, STREAM_END_INDEX)
+        deadline = _time.monotonic() + timeout
+        while True:
+            if self._total is not None and self._index >= self._total:
+                raise StopIteration
+            if contains(oid):
+                self._index += 1
+                return ObjectRef(oid, self._owner_id)
+            if self._total is None and contains(end_oid):
+                end = rt.get([ObjectRef(end_oid, self._owner_id)])[0]
+                if isinstance(end, BaseException):
+                    raise end
+                self._total = int(end)
+                continue
+            if _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"streaming task {self._task_id.hex()[:12]} produced no "
+                    f"item {self._index} in time")
+            # Plain polling — constructing ObjectRefs here to use wait()
+            # would add/drop local refs on ids the producer hasn't sealed
+            # yet, releasing (and deleting) items as they land.
+            cond = getattr(rt, "_wait_cond", None)
+            if cond is not None:
+                with cond:
+                    cond.wait(timeout=0.02)
+            else:
+                _time.sleep(0.01)
+
+    def completed(self) -> bool:
+        return self._total is not None and self._index >= self._total
+
+    def __del__(self):
+        # Best-effort: release items the consumer never took (constructing
+        # then dropping a ref runs the normal release path). Items produced
+        # after this GC are cleaned when the owner runtime shuts down.
+        try:
+            rt = self._runtime()
+            if rt is None:
+                return
+            contains = getattr(rt, "_local_contains", None) or rt.store.contains
+            i = self._index
+            while (self._total is None or i < self._total) and i < 1 << 20:
+                oid = ObjectID.for_task_return(self._task_id, i)
+                if not contains(oid):
+                    break
+                ObjectRef(oid, self._owner_id)  # ctor+drop => release
+                i += 1
+        except Exception:
+            pass

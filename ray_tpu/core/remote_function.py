@@ -129,6 +129,11 @@ class RemoteFunction:
             trace_ctx=tracing.inject(),
         )
         refs = worker.runtime.submit_task(spec)
+        if opts["num_returns"] == "streaming":
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, worker.worker_id,
+                                      end_ref=refs[0])
         if opts["num_returns"] == 1:
             return refs[0]
         return refs

@@ -35,7 +35,7 @@ class TaskSpec:
     args_blob: bytes  # serialized (args, kwargs) with refs replaced by markers
     arg_ref_ids: list[ObjectID] = field(default_factory=list)
     arg_owner_ids: list[WorkerID | None] = field(default_factory=list)
-    num_returns: int = 1
+    num_returns: int | str = 1  # int, or "streaming" (generator task)
     resources: dict[str, float] = field(default_factory=dict)
     max_retries: int = 3
     retry_exceptions: bool = False
@@ -55,6 +55,12 @@ class TaskSpec:
         return self.actor_id is not None and self.method_name is not None
 
     def return_ids(self) -> list[ObjectID]:
+        if self.num_returns == "streaming":
+            # The stream-end marker is the task's one pre-declared return:
+            # errors land there and the consumer's generator raises them.
+            from ray_tpu.core.object_ref import STREAM_END_INDEX
+
+            return [ObjectID.for_task_return(self.task_id, STREAM_END_INDEX)]
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
 
     def scheduling_key(self) -> tuple:

@@ -36,6 +36,10 @@ class LLMConfig:
     checkpoint_path: str | None = None # orbax dir; None → seeded random init
     seed: int = 0
     prefill_bucket_min: int = 16
+    # Chunked prefill: long prompts prefill in chunks of this many tokens so
+    # active decodes run between chunks (bounds time-per-output-token under
+    # prefill load; reference shape: vLLM enable_chunked_prefill).
+    prefill_chunk: int = 512
     engine_kwargs: dict[str, Any] = field(default_factory=dict)
 
     def model_config(self) -> LlamaConfig:

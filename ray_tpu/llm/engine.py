@@ -127,11 +127,67 @@ def prefill(cfg: LlamaConfig, params, cache, tokens, length, slot):
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def decode_step(cfg: LlamaConfig, params, cache, tokens, positions):
+def prefill_chunk(cfg: LlamaConfig, params, cache, tokens, kv_len, length,
+                  slot):
+    """Prefill ONE chunk of one sequence (chunked prefill — long prompts are
+    split so decode steps interleave between chunks instead of stalling
+    behind a whole-prompt prefill; reference shape: vLLM chunked prefill /
+    enable_chunked_prefill).
+
+    tokens: [C] chunk (padded), kv_len: tokens already cached for this slot,
+    length: true total prompt length. Queries attend to cache[0..kv_len) +
+    the chunk's own causal prefix. Returns (cache, last-token logits [V]).
+    """
+    c = tokens.shape[0]
+    max_seq = cache["k"].shape[3]
+    x = params["embed_tokens"][tokens][None]  # [1, C, H]
+    positions = kv_len + jnp.arange(c)
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    n_rep = cfg.num_heads // cfg.num_kv_heads
+    kpos = jnp.arange(max_seq)
+    # [C, max_seq]: causal vs absolute kv position, limited to real tokens.
+    mask = (kpos[None, :] <= positions[:, None]) & (kpos[None, :] < length)
+    mask = mask[None, None]
+
+    def body(x, scanned):
+        lp, k_l, v_l = scanned  # k_l/v_l: [slots, Hkv, max_seq, D]
+        b, c_, _ = x.shape
+        xn = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(cfg, lp, xn, b, c_)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        k_l = lax.dynamic_update_slice(k_l, k[0].astype(k_l.dtype)[None],
+                                       (slot, 0, kv_len, 0))
+        v_l = lax.dynamic_update_slice(v_l, v[0].astype(v_l.dtype)[None],
+                                       (slot, 0, kv_len, 0))
+        ks = lax.dynamic_slice_in_dim(k_l, slot, 1, 0).astype(x.dtype)
+        vs = lax.dynamic_slice_in_dim(v_l, slot, 1, 0).astype(x.dtype)
+        kr, vr = _repeat_kv(ks, n_rep), _repeat_kv(vs, n_rep)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kr).astype(jnp.float32)
+        scores = scores / np.sqrt(cfg.head_dim) + jnp.where(mask, 0.0, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vr)
+        o = o.transpose(0, 2, 1, 3).reshape(b, c_, -1)
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        x = _mlp(cfg, lp, x)
+        return x, (k_l, v_l)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    logits = _lm_head(cfg, params, x[0])  # [C, V]
+    last = logits[jnp.clip(length - 1 - kv_len, 0, c - 1)]
+    return {"k": new_k, "v": new_v}, last
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def decode_step(cfg: LlamaConfig, params, cache, tokens, positions,
+                write_mask=None):
     """One decode step for EVERY slot.
 
     tokens: [B] (last sampled token per slot), positions: [B] (where each
-    token is written/attends from). Returns (cache, logits [B, V]).
+    token is written/attends from). write_mask: [B] bool — slots mid-prefill
+    or empty must not have garbage K/V written into their cache (False =
+    keep the existing cache line). Returns (cache, logits [B, V]).
     """
     b = tokens.shape[0]
     max_seq = cache["k"].shape[3]
@@ -139,12 +195,16 @@ def decode_step(cfg: LlamaConfig, params, cache, tokens, positions):
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     n_rep = cfg.num_heads // cfg.num_kv_heads
     kv_mask = (jnp.arange(max_seq)[None] <= positions[:, None])[:, None, None]
+    if write_mask is None:
+        write_mask = jnp.ones((b,), bool)
 
     def write(cache_l, new, pos):
         # cache_l: [B, Hkv, S, D] (this layer), new: [B, Hkv, 1, D]
-        def upd(c, n, p):
-            return lax.dynamic_update_slice(c, n.astype(c.dtype), (0, p, 0))
-        return jax.vmap(upd)(cache_l, new, pos)
+        def upd(c, n, p, en):
+            cur = lax.dynamic_slice(c, (0, p, 0), (c.shape[0], 1, c.shape[2]))
+            n = jnp.where(en, n.astype(c.dtype), cur)
+            return lax.dynamic_update_slice(c, n, (0, p, 0))
+        return jax.vmap(upd)(cache_l, new, pos, write_mask)
 
     def body(x, scanned):
         lp, k_l, v_l = scanned
@@ -205,7 +265,8 @@ class GenerationRequest:
     done: threading.Event = field(default_factory=threading.Event)
     error: str | None = None
     finish_reason: str | None = None
-    next_pos: int = 0  # position the next token will occupy
+    next_pos: int = 0  # position the next token will occupy; <0 = prefilling
+    prefilled_len: int = 0  # prompt tokens already in the KV cache
 
 
 @dataclass
@@ -313,14 +374,22 @@ class LLMEngine:
                 self._work.clear()
 
     def _tick(self) -> bool:
-        admitted = self._admit()
-        active = {s: r for s, r in self._slots.items() if r is not None}
-        if not active:
-            return admitted
-        self._decode(active)
-        return True
+        """One scheduler step: at most ONE prefill chunk, then one decode
+        batch over the decoding slots. Chunking + the one-per-tick cap stop
+        a long prompt from head-of-line-blocking every active decode
+        (reference shape: vLLM chunked prefill scheduling)."""
+        worked = self._admit()
+        worked = self._prefill_step() or worked
+        decoding = {s: r for s, r in self._slots.items()
+                    if r is not None and r.next_pos >= 0}
+        if decoding:
+            self._decode(decoding)
+            worked = True
+        return worked
 
     def _admit(self) -> bool:
+        """Move waiting requests into free slots (prefill starts on
+        subsequent ticks)."""
         admitted = False
         for slot, occupant in self._slots.items():
             if occupant is not None:
@@ -329,37 +398,55 @@ class LLMEngine:
                 req = self._waiting.get_nowait()
             except queue.Empty:
                 break
-            # Occupy the slot BEFORE prefill: _emit may finish the request
-            # immediately (max_tokens=1), and _finish frees by identity.
+            # next_pos < 0 marks "still prefilling" (prefilled_len tracks
+            # progress); _finish frees by identity.
+            req.next_pos = -1
+            req.prefilled_len = 0
             self._slots[slot] = req
-            self._prefill(req, slot)
             admitted = True
         return admitted
 
-    def _prefill(self, req: GenerationRequest, slot: int) -> None:
-        p = len(req.prompt_ids)
-        bucket = self.config.prefill_bucket_min
-        while bucket < p:
-            bucket *= 2
-        bucket = min(bucket, self.max_seq)
-        toks = np.zeros((bucket,), np.int32)
-        toks[:p] = req.prompt_ids
-        self.cache, logits = prefill(
-            self.model_cfg, self.params, self.cache, jnp.asarray(toks),
-            jnp.int32(p), jnp.int32(slot))
-        tok = self._sample_one(logits[None], [req])[0]
-        req.next_pos = p
-        self._emit(req, int(tok))
+    def _prefill_step(self) -> bool:
+        """Run ONE chunk of ONE prefilling request (round-robin by slot)."""
+        for slot, req in self._slots.items():
+            if req is None or req.next_pos >= 0:
+                continue
+            p = len(req.prompt_ids)
+            chunk = self.config.prefill_chunk
+            bucket = self.config.prefill_bucket_min
+            remaining = p - req.prefilled_len
+            while bucket < min(remaining, chunk):
+                bucket *= 2
+            # Clamp to the cache tail: a window crossing max_seq would make
+            # dynamic_update_slice clamp its start index and silently
+            # overwrite earlier positions.
+            bucket = min(bucket, self.max_seq - req.prefilled_len)
+            toks = np.zeros((bucket,), np.int32)
+            take = min(remaining, bucket)
+            toks[:take] = req.prompt_ids[req.prefilled_len:
+                                         req.prefilled_len + take]
+            self.cache, logits = prefill_chunk(
+                self.model_cfg, self.params, self.cache, jnp.asarray(toks),
+                jnp.int32(req.prefilled_len), jnp.int32(p), jnp.int32(slot))
+            req.prefilled_len += take
+            if req.prefilled_len >= p:  # final chunk: sample first token
+                tok = self._sample_one(logits[None], [req])[0]
+                req.next_pos = p
+                self._emit(req, int(tok))
+            return True
+        return False
 
     def _decode(self, active: dict[int, GenerationRequest]) -> None:
         tokens = np.zeros((self.max_slots,), np.int32)
         positions = np.zeros((self.max_slots,), np.int32)
+        write = np.zeros((self.max_slots,), bool)
         for slot, req in active.items():
             tokens[slot] = req.out_tokens[-1]
             positions[slot] = req.next_pos
+            write[slot] = True
         self.cache, logits = decode_step(
             self.model_cfg, self.params, self.cache,
-            jnp.asarray(tokens), jnp.asarray(positions))
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(write))
         reqs = [active.get(s) for s in range(self.max_slots)]
         sampled = self._sample_one(logits, reqs)
         for slot, req in active.items():

@@ -9,6 +9,7 @@ llm_config.py:141). The engine here is the JAX continuous-batching engine
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Any
 
@@ -59,6 +60,47 @@ class LLMServer:
                       "total_tokens": len(res.prompt_ids) + len(res.token_ids)},
         }
 
+    def chat_stream(self, messages: list[dict], **kw):
+        """SSE token stream (reference: OpenAI chat.completion.chunk frames
+        through the streaming ingress, serve llm openai compat)."""
+        sampling = _sampling_from(kw)
+        prompt = self.engine.tokenizer.apply_chat_template(messages)
+        req = self.engine.submit(prompt, sampling, stream=True)
+        rid = f"chatcmpl-{req.request_id}"
+        while True:
+            item = req.stream_queue.get()
+            if item is None:
+                break
+            delta = self.engine.tokenizer.decode([item])
+            frame = {"id": rid, "object": "chat.completion.chunk",
+                     "model": self._model_id,
+                     "choices": [{"index": 0,
+                                  "delta": {"content": delta},
+                                  "finish_reason": None}]}
+            yield f"data: {json.dumps(frame)}\n\n"
+        done = {"id": rid, "object": "chat.completion.chunk",
+                "model": self._model_id,
+                "choices": [{"index": 0, "delta": {},
+                             "finish_reason": req.finish_reason or "stop"}]}
+        yield f"data: {json.dumps(done)}\n\n"
+        yield "data: [DONE]\n\n"
+
+    def completions_stream(self, prompt: str, **kw):
+        sampling = _sampling_from(kw)
+        req = self.engine.submit(prompt, sampling, stream=True)
+        rid = f"cmpl-{req.request_id}"
+        while True:
+            item = req.stream_queue.get()
+            if item is None:
+                break
+            frame = {"id": rid, "object": "text_completion",
+                     "model": self._model_id,
+                     "choices": [{"index": 0,
+                                  "text": self.engine.tokenizer.decode([item]),
+                                  "finish_reason": None}]}
+            yield f"data: {json.dumps(frame)}\n\n"
+        yield "data: [DONE]\n\n"
+
     def stats(self) -> dict:
         return self.engine.stats()
 
@@ -76,10 +118,17 @@ class LLMServer:
                               "created": int(time.time()),
                               "owned_by": "ray_tpu"}]}
         body = request.json() or {}
+        stream = bool(body.pop("stream", False))
         if path.endswith("/v1/completions") or path == "/completions":
-            return self.completions(body.pop("prompt", ""), **body)
+            prompt = body.pop("prompt", "")
+            if stream:
+                return self.completions_stream(prompt, **body)
+            return self.completions(prompt, **body)
         if path.endswith("/v1/chat/completions") or path == "/chat/completions":
-            return self.chat(body.pop("messages", []), **body)
+            messages = body.pop("messages", [])
+            if stream:
+                return self.chat_stream(messages, **body)
+            return self.chat(messages, **body)
         return {"error": {"message": f"no route {path}", "code": 404}}
 
 

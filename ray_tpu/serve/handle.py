@@ -32,21 +32,71 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming handle call's chunks (reference:
+    DeploymentResponseGenerator, handle.options(stream=True)). The first
+    item from the replica is a meta dict ({"streaming": bool}); it is
+    consumed here and exposed as ``.streaming``. ``timeout`` bounds the wait
+    for each chunk."""
+
+    def __init__(self, ref_gen, on_done=None, timeout: float = 60.0):
+        self._gen = ref_gen
+        self._meta = None
+        self._on_done = on_done
+        self.timeout = timeout
+
+    @property
+    def meta(self) -> dict:
+        if self._meta is None:
+            self._meta = ray_tpu.get(self._gen._next(self.timeout))
+        return self._meta
+
+    @property
+    def streaming(self) -> bool:
+        return bool(self.meta.get("streaming"))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        self.meta  # ensure consumed
+        try:
+            return ray_tpu.get(self._gen._next(self.timeout))
+        except BaseException:
+            self._done()
+            raise
+
+    def _done(self):
+        if self._on_done is not None:
+            cb, self._on_done = self._on_done, None
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def __del__(self):
+        self._done()
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__"):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
+        self._stream = False
         self._lock = threading.Lock()
         self._router: Router | None = None
         self._poll: LongPollClient | None = None
 
     # -- composition --
 
-    def options(self, method_name: str | None = None) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, self.app_name,
-                                method_name or self._method_name)
+    def options(self, method_name: str | None = None,
+                stream: bool | None = None) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self.app_name,
+                             method_name or self._method_name)
+        h._stream = self._stream if stream is None else stream
+        return h
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -56,12 +106,16 @@ class DeploymentHandle:
 
     # -- data plane --
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         router = self._ensure_router()
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
                      else a for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
                       else v) for k, v in kwargs.items()}
+        if self._stream:
+            gen, on_done = router.assign_request(self._method_name, args,
+                                                 kwargs, stream=True)
+            return DeploymentResponseGenerator(gen, on_done=on_done)
         ref = router.assign_request(self._method_name, args, kwargs)
         return DeploymentResponse(ref)
 

@@ -72,8 +72,29 @@ class ProxyActor:
                     body=body,
                 )
                 try:
-                    result = proxy._get_handle(dep).remote(req).result(
-                        timeout=60.0)
+                    gen = proxy._get_handle(dep).options(
+                        stream=True).remote(req)
+                    gen.timeout = 60.0  # bound a wedged replica per chunk
+                    if gen.streaming:
+                        # SSE/chunk streaming: write each produced chunk as
+                        # it arrives; length-delimited by connection close
+                        # (reference: proxy_request streaming path,
+                        # proxy.py:481).
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/event-stream; charset=utf-8")
+                        self.send_header("Cache-Control", "no-cache")
+                        self.send_header("Connection", "close")
+                        self.end_headers()
+                        for chunk in gen:
+                            if isinstance(chunk, str):
+                                chunk = chunk.encode()
+                            elif not isinstance(chunk, (bytes, bytearray)):
+                                chunk = json.dumps(chunk).encode()
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                        return
+                    result = next(gen)
                 except Exception as e:  # noqa: BLE001 - surface as 500
                     self.send_response(500)
                     self.end_headers()

@@ -58,6 +58,8 @@ class LongPollClient:
                  keys: list[str],
                  callback: Callable[[str, Any], None] | None = None,
                  poll_timeout: float = 5.0):
+        from ray_tpu.core.worker import global_worker
+
         self._listen = host_listen
         self._versions = {k: 0 for k in keys}
         self._cache: dict[str, Any] = {}
@@ -65,11 +67,19 @@ class LongPollClient:
         self._poll_timeout = poll_timeout
         self._stopped = threading.Event()
         self._have_first = threading.Event()
+        # Die with the runtime that spawned us: a poller surviving a
+        # shutdown/init cycle would keep issuing listen calls into the NEW
+        # runtime forever (each one allocating task returns in its store).
+        self._born_runtime = global_worker.runtime
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def _loop(self) -> None:
+        from ray_tpu.core.worker import global_worker
+
         while not self._stopped.is_set():
+            if global_worker.runtime is not self._born_runtime:
+                return  # our runtime is gone; stop polling
             try:
                 updates = self._listen(dict(self._versions), self._poll_timeout)
             except Exception:

@@ -57,6 +57,40 @@ class ServeReplica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method_name: str, args: tuple,
+                                 kwargs: dict):
+        """Streaming data plane: a generator actor method (called with
+        num_returns="streaming"). First yield is a meta dict
+        {"streaming": bool}; then either the single complete result or the
+        user generator's chunks as they are produced (reference:
+        replica.py streaming call path + proxy_request streaming)."""
+        import inspect
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            if inspect.isgeneratorfunction(target) or \
+                    inspect.isgeneratorfunction(
+                        getattr(target, "__call__", None)):
+                yield {"streaming": True}
+                yield from target(*args, **kwargs)
+                return
+            result = target(*args, **kwargs)
+            if inspect.isgenerator(result):
+                yield {"streaming": True}
+                yield from result
+                return
+            yield {"streaming": False}
+            yield result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     # -- control plane --
 
     def get_metrics(self) -> dict:

@@ -28,7 +28,7 @@ class Router:
         self._rng = random.Random()
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       timeout: float = 30.0):
+                       timeout: float = 30.0, stream: bool = False):
         """Pick a replica (pow-2 on local in-flight counts), submit, and
         return the result ObjectRef. Blocks while every replica is at
         max_ongoing_requests (router-side queuing, reference behavior)."""
@@ -51,6 +51,21 @@ class Router:
         with self._lock:
             self._inflight[chosen.replica_id] = \
                 self._inflight.get(chosen.replica_id, 0) + 1
+        if stream:
+            gen = handle.handle_request_streaming.options(
+                num_returns="streaming").remote(method_name, args, kwargs)
+
+            done = threading.Event()
+
+            def on_stream_done():
+                # In-flight until the consumer exhausts/abandons the stream
+                # (keeps max_ongoing_requests honest for long-lived SSE).
+                if not done.is_set():
+                    done.set()
+                    with self._lock:
+                        self._inflight[chosen.replica_id] -= 1
+
+            return gen, on_stream_done
         ref = handle.handle_request.remote(method_name, args, kwargs)
 
         def _done():

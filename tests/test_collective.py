@@ -124,3 +124,24 @@ def test_host_sendrecv(rt_start):
 
     out = ray_tpu.get([worker.remote(r) for r in range(2)], timeout=60)
     assert out[1] == [42.0]
+
+
+def test_xla_reduce_to_dst(xla_group):
+    """reduce: dst member holds the reduction, others keep their input
+    (per-member stack result — see XlaCollectiveGroup.reduce)."""
+    x = np.full((4,), 2.0, np.float32)
+    out = np.asarray(xla_group.reduce(x, dst_rank=3))
+    assert out.shape == (8, 4)
+    np.testing.assert_allclose(out[3], x * 8)
+    for r in (0, 1, 2, 4, 5, 6, 7):
+        np.testing.assert_allclose(out[r], x)
+
+
+def test_xla_send_recv_pair(xla_group):
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)  # shard r = row r
+    sent = xla_group.send(x, dst_rank=5, src_rank=2)
+    got = np.asarray(xla_group.recv((8, 2), np.float32, src_rank=2))
+    np.testing.assert_allclose(got, np.asarray(sent))
+    np.testing.assert_allclose(got[5], x[2])  # dst now holds src's shard
+    with pytest.raises(RuntimeError):
+        xla_group.recv((8, 2), np.float32, src_rank=2)  # buffer drained

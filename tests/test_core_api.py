@@ -333,3 +333,40 @@ def test_object_gc_releases_store_memory(rt_start):
     del refs
     gc.collect()
     assert len(rt.store.object_ids()) <= before + 1
+
+
+def test_streaming_generator_task(rt_start):
+    """num_returns="streaming" yields ObjectRefs as items are produced
+    (reference: streaming generators, _raylet.pyx ObjectRefGenerator)."""
+
+    @remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    vals = [ray_tpu.get(ref) for ref in gen.remote(5)]
+    assert vals == [0, 1, 4, 9, 16]
+
+
+def test_streaming_generator_actor(rt_start):
+    @remote
+    class A:
+        def stream(self, n):
+            for i in range(n):
+                yield chr(65 + i)
+
+    a = A.remote()
+    gen = a.stream.options(num_returns="streaming").remote(4)
+    assert "".join(ray_tpu.get(r) for r in gen) == "ABCD"
+
+
+def test_streaming_generator_error(rt_start):
+    @remote(num_returns="streaming")
+    def bad():
+        yield 1
+        raise ValueError("mid-stream")
+
+    g = bad.remote()
+    assert ray_tpu.get(next(g)) == 1
+    with pytest.raises(ray_tpu.TaskError, match="mid-stream"):
+        next(g)

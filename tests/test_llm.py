@@ -153,3 +153,105 @@ def test_llm_server_openai_surface():
         serve.shutdown()
     finally:
         ray_tpu.shutdown()
+
+
+def test_chunked_prefill_matches_full(tiny):
+    """prefill_chunk over N chunks must equal one whole-prompt prefill
+    (same cache contents, same last-token logits)."""
+    from ray_tpu.llm.engine import prefill_chunk
+
+    cfg, params = tiny
+    prompt = np.arange(1, 13, dtype=np.int32)  # 12 tokens
+    p = len(prompt)
+
+    cache_full = init_kv_cache(cfg, max_slots=2, max_seq=32)
+    toks = np.zeros((16,), np.int32)
+    toks[:p] = prompt
+    cache_full, last_full = prefill(cfg, params, cache_full,
+                                    jnp.asarray(toks), jnp.int32(p),
+                                    jnp.int32(1))
+
+    cache_c = init_kv_cache(cfg, max_slots=2, max_seq=32)
+    last_c = None
+    for start in range(0, p, 4):  # 3 chunks of 4
+        chunk = np.zeros((4,), np.int32)
+        chunk[:] = prompt[start:start + 4]
+        cache_c, last_c = prefill_chunk(cfg, params, cache_c,
+                                        jnp.asarray(chunk),
+                                        jnp.int32(start), jnp.int32(p),
+                                        jnp.int32(1))
+    np.testing.assert_allclose(np.asarray(last_c), np.asarray(last_full),
+                               rtol=2e-4, atol=2e-4)
+    # cache contents match where real tokens live
+    np.testing.assert_allclose(
+        np.asarray(cache_c["k"][:, 1, :, :p]).astype(np.float32),
+        np.asarray(cache_full["k"][:, 1, :, :p]).astype(np.float32),
+        rtol=2e-3, atol=2e-3)
+
+
+def test_decode_write_mask_protects_prefilling_slot(tiny):
+    """A slot mid-prefill must not be corrupted by the batched decode's
+    writes (write_mask=False keeps the cache line)."""
+    cfg, params = tiny
+    cache = init_kv_cache(cfg, max_slots=2, max_seq=32)
+    before = np.asarray(cache["k"][:, 0]).copy()
+    tokens = np.array([99, 3], np.int32)
+    positions = np.array([0, 0], np.int32)
+    write = np.array([False, True])
+    cache, _ = decode_step(cfg, params, cache, jnp.asarray(tokens),
+                           jnp.asarray(positions), jnp.asarray(write))
+    after = np.asarray(cache["k"][:, 0])
+    np.testing.assert_array_equal(before, after)  # slot 0 untouched
+    assert np.abs(np.asarray(cache["k"][:, 1, :, 0])).sum() > 0  # slot 1 written
+
+
+def test_engine_long_prompt_chunked():
+    """A prompt longer than prefill_chunk completes across chunks."""
+    cfg = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=96)
+    cfg.prefill_chunk = 16
+    eng = LLMEngine(cfg)
+    try:
+        prompt = list(np.random.default_rng(0).integers(1, 200, 40))
+        out = eng.generate(prompt, SamplingParams(max_tokens=4,
+                                                  temperature=0.0),
+                           timeout=120)
+        assert len(out.token_ids) >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_openai_sse_streaming():
+    """stream: true returns chat.completion.chunk SSE frames ending with
+    [DONE] (reference: OpenAI-compatible streaming ingress)."""
+    import json as _json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.serving import build_openai_app
+
+    ray_tpu.init()
+    try:
+        cfg = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=128)
+        serve.run(build_openai_app(cfg), route_prefix="/", http=True)
+        port = serve.http_port()
+        body = _json.dumps({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 5, "temperature": 0.0, "stream": True,
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            text = r.read().decode()
+        frames = [ln[6:] for ln in text.splitlines()
+                  if ln.startswith("data: ") and ln != "data: [DONE]"]
+        assert text.rstrip().endswith("data: [DONE]")
+        parsed = [_json.loads(f) for f in frames]
+        assert all(p["object"] == "chat.completion.chunk" for p in parsed)
+        assert parsed[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
