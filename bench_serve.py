@@ -126,15 +126,22 @@ def _one_request(url: str, max_tokens: int, seed: int = 0):
     t0 = time.perf_counter()
     ttft = None
     ntok = 0
+    buf = b""
     with urllib.request.urlopen(req, timeout=300) as r:
         while True:
             chunk = r.read1(8192)
             if not chunk:
                 break
-            if b"data:" in chunk:
-                if ttft is None:
-                    ttft = time.perf_counter() - t0
-                ntok += chunk.count(b"data:")
+            buf += chunk
+            frames = buf.split(b"\n\n")
+            buf = frames.pop()  # partial frame stays buffered
+            for f in frames:
+                # A token frame carries delta content; skip [DONE] and the
+                # finish-reason-only frame.
+                if f.startswith(b"data:") and b'"content"' in f:
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+                    ntok += 1
     return ttft if ttft is not None else time.perf_counter() - t0, \
         time.perf_counter() - t0, ntok
 

@@ -215,8 +215,15 @@ class XlaCollectiveGroup:
         out = self.ppermute(x, [(int(src_rank), int(dst_rank))])
         buf = self._p2p.setdefault(int(src_rank), [])
         buf.append(out)
-        if len(buf) > 64:  # send-only usage must not pin arrays forever
-            buf.pop(0)
+        if len(buf) > 64:
+            # Dropping entries would silently pair a later recv with the
+            # wrong send; fail loudly instead (send-only callers should use
+            # ppermute directly).
+            buf.clear()
+            raise RuntimeError(
+                "send(): >64 unmatched sends buffered for rank "
+                f"{src_rank}; pair each send with a recv, or use "
+                "ppermute() for one-sided transfers")
         return out
 
     def recv(self, shape, dtype, src_rank: int):

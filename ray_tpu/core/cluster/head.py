@@ -249,7 +249,7 @@ class HeadServer:
                    labels: dict | None = None) -> NodeInfo | None:
         # Least-loaded feasible node (reference default is hybrid pack/spread;
         # actors spread by load — gcs_actor_scheduler picks via cluster view).
-        candidates = []
+        ready, feasible = [], []
         for n in self.nodes.values():
             if not n.alive:
                 continue
@@ -257,13 +257,23 @@ class HeadServer:
                 continue
             if labels and any(n.labels.get(k) != v for k, v in labels.items()):
                 continue
-            if all(n.resources.get(k, 0.0) >= v for k, v in resources.items()):
-                free = sum(n.available.get(k, 0.0) for k in ("CPU",))
-                candidates.append((-free, n.node_id, n))
-        if not candidates:
+            if not all(n.resources.get(k, 0.0) >= v
+                       for k, v in resources.items()):
+                continue
+            free = sum(n.available.get(k, 0.0) for k in ("CPU",))
+            feasible.append((-free, n.node_id, n))
+            # Prefer nodes that can host the actor NOW — picking by totals
+            # alone stacks same-resource actors onto one node while its
+            # twin sits idle (the daemon would park the extra actor in its
+            # wait-for-resources loop).
+            if all(n.available.get(k, 0.0) >= v
+                   for k, v in resources.items()):
+                ready.append((-free, n.node_id, n))
+        pool = ready or feasible
+        if not pool:
             return None
-        candidates.sort()
-        return candidates[0][2]
+        pool.sort()
+        return pool[0][2]
 
     async def _schedule_actor(self, info: ActorInfo, node_affinity: str | None = None,
                               labels: dict | None = None) -> bool:
@@ -274,6 +284,12 @@ class HeadServer:
         conn = self._node_conns.get(node.node_id)
         if conn is None:
             return False
+        # Optimistic availability decrement: the daemon's own accounting
+        # arrives with the next heartbeat, but back-to-back placements must
+        # not all see the same node as free (placement would stack
+        # same-resource actors on one node).
+        for k, v in info.resources.items():
+            node.available[k] = node.available.get(k, 0.0) - v
         # Ask the node daemon to place the actor in a fresh/pooled worker
         # (reference: GcsActorScheduler leases a worker from the raylet).
         await conn.notify(

@@ -136,6 +136,8 @@ class ClusterRuntime:
     # larger blobs go through the node's shared-memory arena when available
     # (reference: plasma for non-inline objects).
     SHM_THRESHOLD = 32 * 1024
+    # Lineage retention budget (reference: RAY_max_lineage_bytes).
+    MAX_LINEAGE_BYTES = 64 * 1024 * 1024
 
     def __init__(self, head_host: str, head_port: int,
                  node_daemon_addr: tuple[str, int] | None = None,
@@ -177,6 +179,7 @@ class ClusterRuntime:
         # object_recovery_manager.h:41 resubmits the creating task when a
         # stored copy is lost). task_id hex -> (spec, blob, live return count).
         self._lineage: dict[str, list] = {}
+        self._lineage_bytes = 0
         self._recovering: set[ObjectID] = set()
         self._recovery_attempts: dict[ObjectID, int] = {}
         self._shutdown = False
@@ -299,6 +302,7 @@ class ClusterRuntime:
                 entry[2] -= 1
                 if entry[2] <= 0:
                     self._lineage.pop(rec.lineage_task.hex(), None)
+                    self._lineage_bytes -= len(entry[1])
         # The shm arena is shared node-wide: only the object's owner may
         # delete from it — a borrower releasing its cache must not GC data
         # other processes still reference (reference: owner-driven GC,
@@ -390,7 +394,9 @@ class ClusterRuntime:
                         if not self._recover_object(ref.id):
                             raise ObjectLostError(
                                 ref.hex(),
-                                "holder died and object has no lineage")
+                                "holder died and the object is not "
+                                "reconstructable (no retained lineage, or "
+                                "recovery retries exhausted)")
                     time.sleep(0.01)
                     continue
                 step = 0.1 if remaining is None else min(0.1, remaining)
@@ -429,10 +435,13 @@ class ClusterRuntime:
                     # IT can run recovery (only the owner has the lineage).
                     holder_failures = 0
                     try:
-                        self._peer(addr).call("report_lost", oid=ref.hex(),
-                                              timeout=10)
+                        verdict = self._peer(addr).call(
+                            "report_lost", oid=ref.hex(), timeout=10)
                     except (RpcError, OSError):
-                        pass
+                        verdict = None
+                    if verdict is not None and verdict.get("state") == "lost":
+                        raise ObjectLostError(
+                            ref.hex(), "owner cannot reconstruct the object")
             # pending: loop
 
     def _fetch_from_holder(self, holder_hex: str, ref: ObjectRef) -> bytes | None:
@@ -483,9 +492,19 @@ class ClusterRuntime:
         item = _TaskItem(spec, serialization.dumps_spec(spec), return_ids)
         if spec.num_returns != "streaming":
             # Retain lineage while any return is referenced so a lost copy
-            # can be recomputed by resubmission.
+            # can be recomputed by resubmission — bounded by a byte budget
+            # (reference: task_manager.h:184 max_lineage_bytes); evicted
+            # entries just lose reconstructability, not correctness.
             self._lineage[spec.task_id.hex()] = [spec, item.blob,
                                                  len(return_ids)]
+            self._lineage_bytes += len(item.blob)
+            while self._lineage_bytes > self.MAX_LINEAGE_BYTES and \
+                    len(self._lineage) > 1:
+                old_tid, entry = next(iter(self._lineage.items()))
+                if old_tid == spec.task_id.hex():
+                    break
+                self._lineage.pop(old_tid)
+                self._lineage_bytes -= len(entry[1])
         self._io.loop.call_soon_threadsafe(self._submit_on_loop, item)
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
 
@@ -681,6 +700,9 @@ class ClusterRuntime:
         results = reply.get("results", [])
         for oid, r in zip(return_ids, results):
             self._recovering.discard(oid)
+            # Fresh loss bursts get a fresh retry budget once a recovery
+            # (or first execution) lands.
+            self._recovery_attempts.pop(oid, None)
             if r.get("data") is not None:
                 self.store.put(oid, r["data"], self.worker_id)
             elif r.get("location"):

@@ -306,6 +306,7 @@ class LLMEngine:
 
         self._slots: dict[int, GenerationRequest | None] = {
             i: None for i in range(self.max_slots)}
+        self._prefill_rr = -1  # last slot that ran a prefill chunk
         self._waiting: queue.Queue[GenerationRequest] = queue.Queue()
         self._requests: dict[str, GenerationRequest] = {}
         self._rng_key = jax.random.PRNGKey(config.seed + 1)
@@ -407,10 +408,17 @@ class LLMEngine:
         return admitted
 
     def _prefill_step(self) -> bool:
-        """Run ONE chunk of ONE prefilling request (round-robin by slot)."""
-        for slot, req in self._slots.items():
+        """Run ONE chunk of ONE prefilling request, rotating across slots so
+        concurrent long prompts interleave chunks (true round-robin — a
+        lowest-slot rescan would monopolize prefill for one prompt)."""
+        slots = list(self._slots.keys())
+        n = len(slots)
+        for i in range(n):
+            slot = slots[(self._prefill_rr + 1 + i) % n]
+            req = self._slots.get(slot)
             if req is None or req.next_pos >= 0:
                 continue
+            self._prefill_rr = slot
             p = len(req.prompt_ids)
             chunk = self.config.prefill_chunk
             bucket = self.config.prefill_bucket_min

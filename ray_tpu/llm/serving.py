@@ -99,6 +99,11 @@ class LLMServer:
                                   "text": self.engine.tokenizer.decode([item]),
                                   "finish_reason": None}]}
             yield f"data: {json.dumps(frame)}\n\n"
+        done = {"id": rid, "object": "text_completion",
+                "model": self._model_id,
+                "choices": [{"index": 0, "text": "",
+                             "finish_reason": req.finish_reason or "stop"}]}
+        yield f"data: {json.dumps(done)}\n\n"
         yield "data: [DONE]\n\n"
 
     def stats(self) -> dict:

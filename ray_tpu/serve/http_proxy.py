@@ -86,13 +86,20 @@ class ProxyActor:
                         self.send_header("Cache-Control", "no-cache")
                         self.send_header("Connection", "close")
                         self.end_headers()
-                        for chunk in gen:
-                            if isinstance(chunk, str):
-                                chunk = chunk.encode()
-                            elif not isinstance(chunk, (bytes, bytearray)):
-                                chunk = json.dumps(chunk).encode()
-                            self.wfile.write(chunk)
-                            self.wfile.flush()
+                        try:
+                            for chunk in gen:
+                                if isinstance(chunk, str):
+                                    chunk = chunk.encode()
+                                elif not isinstance(chunk,
+                                                    (bytes, bytearray)):
+                                    chunk = json.dumps(chunk).encode()
+                                self.wfile.write(chunk)
+                                self.wfile.flush()
+                        except Exception:  # noqa: BLE001
+                            # 200 + body already on the wire: terminate the
+                            # stream (connection close) — a second status
+                            # line would corrupt the client's event stream.
+                            pass
                         return
                     result = next(gen)
                 except Exception as e:  # noqa: BLE001 - surface as 500

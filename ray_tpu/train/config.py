@@ -19,6 +19,11 @@ class ScalingConfig:
     accelerator_type: str | None = None  # e.g. "v5p"
     resources_per_worker: dict[str, float] = field(default_factory=dict)
     placement_strategy: str = "PACK"
+    # Elastic range (reference: elastic.py:29 ElasticScalingPolicy). Setting
+    # either makes scaling elastic: every (re)start picks the largest
+    # feasible world size in [min_workers, max_workers].
+    min_workers: int | None = None
+    max_workers: int | None = None
 
     def worker_resources(self) -> dict[str, float]:
         res = dict(self.resources_per_worker)

@@ -56,16 +56,23 @@ class TrainController:
         return self._status
 
     def run(self) -> Result:
-        """The control loop (reference: controller.py:634)."""
+        """The control loop (reference: controller.py:634). Each (re)start
+        consults the scaling policy — elastic configs resume at a smaller
+        world size after capacity loss (reference: elastic.py:29)."""
+        from ray_tpu.train.scaling_policy import make_scaling_policy
+
         self._status = "RUNNING"
         max_failures = self.run_config.failure_config.max_failures
+        policy = make_scaling_policy(self.scaling,
+                                     getattr(self, "_resources_fn", None))
         restart_count = 0
         while True:
             group = None
             try:
+                world = policy.decide_world_size(restart_count)
                 group = WorkerGroup(
                     self.scaling, self.run_config.name or "train",
-                    self.ckpt_manager.storage_path,
+                    self.ckpt_manager.storage_path, num_workers=world,
                 )
                 coordinator = f"127.0.0.1:{free_port()}" \
                     if self.backend_config.distributed else None

@@ -97,9 +97,11 @@ class WorkerStatus:
 
 class WorkerGroup:
     def __init__(self, scaling, experiment: str, storage_path: str | None,
-                 env: dict[str, str] | None = None):
+                 env: dict[str, str] | None = None,
+                 num_workers: int | None = None):
         self.scaling = scaling
-        n = scaling.num_workers
+        n = num_workers if num_workers is not None else scaling.num_workers
+        self.num_workers = n
         res = scaling.worker_resources()
         WorkerActor = ray_tpu.remote(TrainWorker)
         opts: dict[str, Any] = {"max_concurrency": 4}

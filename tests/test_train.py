@@ -227,3 +227,132 @@ def test_spmd_train_step_factory(cpu_mesh_devices):
     # params stayed sharded per rules
     from jax.sharding import PartitionSpec as P
     assert state.params["layers"]["wq"].sharding.spec == P(None, ("fsdp",), "tp")
+
+
+def test_elastic_restart_at_smaller_world_size(tmp_path):
+    """Chaos: kill a node mid-run; the elastic policy resumes training at a
+    smaller world size from the latest checkpoint (reference:
+    scaling_policy/elastic.py:29 + failure_handling restart)."""
+    import threading
+    import time
+
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train.backend import JaxBackendConfig
+    from ray_tpu.train.controller import TrainController
+    from ray_tpu.utils import config as config_mod
+    from ray_tpu.utils.ids import JobID
+
+    os.environ["RTPU_HEALTH_CHECK_PERIOD_S"] = "0.2"
+    config_mod.set_config(config_mod.Config.load())
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=8, resources={"trainslot": 1.0})
+    doomed = c.add_node(num_cpus=2, resources={"trainslot": 1.0})
+    rt = c.connect()
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    try:
+        progress = str(tmp_path / "progress")
+        os.makedirs(progress, exist_ok=True)
+
+        def train_fn(config):
+            import os
+            import time
+
+            import numpy as np
+
+            from ray_tpu.train import get_context, report
+
+            ctx = get_context()
+            start = 0
+            if ctx.get_checkpoint():
+                start = int(np.load(os.path.join(ctx.get_checkpoint(),
+                                                 "step.npy"))) + 1
+            for step in range(start, 6):
+                time.sleep(0.4)
+                ck = None
+                if ctx.get_world_rank() == 0:
+                    d = os.path.join(ctx.storage_path,
+                                     f"ck_{step}_{ctx.restart_count}")
+                    os.makedirs(d, exist_ok=True)
+                    np.save(os.path.join(d, "step.npy"), np.array(step))
+                    ck = d
+                    open(os.path.join(config["progress"],
+                                      f"step_{step}"), "w").close()
+                report({"step": step, "world": ctx.get_world_size(),
+                        "restart": ctx.restart_count}, checkpoint=ck)
+
+        controller = TrainController(
+            train_fn, {"progress": progress},
+            ScalingConfig(num_workers=2, min_workers=1, max_workers=2,
+                          resources_per_worker={"trainslot": 1.0,
+                                                "CPU": 1.0}),
+            RunConfig(name="elastic", storage_path=str(tmp_path),
+                      failure_config=FailureConfig(max_failures=3)),
+            JaxBackendConfig(distributed=False),
+        )
+
+        def chaos():
+            # wait for training to reach step 2, then kill the second node
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if os.path.exists(os.path.join(progress, "step_2")):
+                    break
+                time.sleep(0.1)
+            c.remove_node(doomed)
+
+        killer = threading.Thread(target=chaos)
+        killer.start()
+        result = controller.run()
+        killer.join()
+
+        assert result.ok, result.error
+        worlds = [(m["restart"], m["world"], m["step"])
+                  for m in result.metrics_history]
+        # started at world 2 ...
+        assert any(w == 2 for _, w, _ in worlds)
+        # ... and a later restart ran at world 1 (elastic downsize)
+        downsized = [(r, w, s) for r, w, s in worlds if w == 1]
+        assert downsized, f"never downsized: {worlds}"
+        # resumed from checkpoint, not from scratch
+        assert min(s for _, _, s in downsized) >= 2
+        # and training finished
+        assert max(s for _, _, s in worlds) == 5
+    finally:
+        rt.shutdown()
+        c.shutdown()
+        global_worker.runtime = None
+        config_mod.set_config(config_mod.Config.load())
+
+
+def test_checkpoint_restore_at_different_world_size(cpu_mesh_devices, tmp_path):
+    """A checkpoint sharded over 8 devices restores onto a 4-device mesh
+    (the elastic-downsize reload path — reference: restore-from-checkpoint
+    at new world size, orbax resharded load)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh8 = build_mesh(MeshSpec(dp=8), cpu_mesh_devices[:8])
+    x = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                       NamedSharding(mesh8, P("dp")))
+    tree = {"w": x, "step": jnp.int32(5)}
+    d = save_pytree(tree, str(tmp_path / "ck8"), step=5)
+
+    mesh4 = build_mesh(MeshSpec(dp=4), cpu_mesh_devices[:4])
+    template = {
+        "w": jax.ShapeDtypeStruct((8, 8), jnp.float32,
+                                  sharding=NamedSharding(mesh4, P("dp"))),
+        "step": jax.ShapeDtypeStruct((), jnp.int32),
+    }
+    restored = restore_pytree(d, template)
+    assert restored["w"].sharding.mesh.devices.size == 4
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.arange(64.0).reshape(8, 8))
+    assert int(restored["step"]) == 5
