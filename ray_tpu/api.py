@@ -46,6 +46,15 @@ def init(
         global_worker.worker_id = global_worker.runtime.worker_id
         global_worker.node_id = _node_id or NodeID.from_random()
         global_worker.mode = "local"
+    elif address.startswith("client://"):
+        # Remote-driver mode (reference: ray.init("ray://...") through the
+        # Ray Client proxy, python/ray/util/client/client_builder.py).
+        from ray_tpu.util.client import connect as client_connect
+
+        global_worker.runtime = client_connect(address[len("client://"):])
+        global_worker.worker_id = global_worker.runtime.worker_id
+        global_worker.node_id = global_worker.runtime.node_id
+        global_worker.mode = "client"
     else:
         try:
             from ray_tpu.core.cluster.client import connect_cluster

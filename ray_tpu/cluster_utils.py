@@ -19,10 +19,21 @@ from ray_tpu.core.cluster.runtime import ClusterRuntime
 
 
 class Cluster:
-    def __init__(self):
+    def __init__(self, persist_path: str | None = None):
         self._io = EventLoopThread.get()
-        self.head = start_head()
+        self._persist_path = persist_path
+        self.head = start_head(persist_path=persist_path)
         self.nodes: list[NodeDaemon] = []
+
+    def restart_head(self) -> None:
+        """Chaos: kill the control plane and bring it back on the SAME
+        address — daemons/drivers reconnect, state reloads from the
+        persistence snapshot (reference: GCS restart backed by Redis,
+        redis_store_client.cc + HandleNotifyGCSRestart)."""
+        host, port = self.head.rpc.host, self.head.rpc.port
+        self._io.run(self.head.stop())
+        self.head = start_head(host=host, port=port,
+                               persist_path=self._persist_path)
 
     @property
     def address(self) -> str:

@@ -28,9 +28,10 @@ class _LocalClusterHandles:
         self.daemons = daemons
 
 
-def start_head(host: str = "127.0.0.1", port: int = 0) -> HeadServer:
+def start_head(host: str = "127.0.0.1", port: int = 0,
+               persist_path: str | None = None) -> HeadServer:
     io = EventLoopThread.get()
-    head = HeadServer(host, port)
+    head = HeadServer(host, port, persist_path=persist_path)
     io.run(head.start())
     return head
 

@@ -53,13 +53,23 @@ class ActorInfo:
 
 
 class HeadServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: str | None = None):
         self.rpc = RpcServer(host, port)
         self.nodes: dict[str, NodeInfo] = {}
         self.actors: dict[str, ActorInfo] = {}
         self.named_actors: dict[tuple[str, str], str] = {}
         self.kv: dict[str, dict[str, bytes]] = {}  # namespace -> key -> value
         self.workers: dict[str, tuple[str, int]] = {}  # worker_id -> rpc addr
+        # Control-plane fault tolerance: durable tables reload on restart
+        # (reference: GCS backed by redis_store_client.cc; raylets
+        # reconnect via HandleNotifyGCSRestart, node_manager.cc:1050).
+        self._persist_path = persist_path
+        self._dirty = False
+        self._persist_task: asyncio.Task | None = None
+        self._write_fut = None  # in-flight executor write, if any
+        if persist_path:
+            self._load_snapshot()
         # Cluster-wide task events flushed from workers (reference:
         # GcsTaskManager bounded task-event store).
         from collections import deque
@@ -108,13 +118,94 @@ class HeadServer:
 
     async def start(self) -> tuple[str, int]:
         addr = await self.rpc.start()
-        self._health_task = asyncio.get_running_loop().create_task(self._health_loop())
+        loop = asyncio.get_running_loop()
+        self._health_task = loop.create_task(self._health_loop())
+        if self._persist_path:
+            self._persist_task = loop.create_task(self._persist_loop())
         return addr
 
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        if self._persist_task:
+            self._persist_task.cancel()
+            if self._write_fut is not None:
+                # Never two writers on the same .tmp path: wait out the
+                # in-flight executor write before the final flush.
+                try:
+                    await self._write_fut
+                except Exception:
+                    pass
+            if self._dirty:
+                self._dirty = False
+                self._write_snapshot(self._snapshot_state())
         await self.rpc.stop()
+
+    # ---------------------------------------------------------- persistence
+    def mark_dirty(self) -> None:
+        self._dirty = True
+
+    def _snapshot_state(self) -> dict:
+        """Copy on the loop thread — the executor pickles the copy while the
+        loop keeps mutating the live tables."""
+        import copy
+
+        return {
+            "actors": dict(self.actors),
+            "named_actors": dict(self.named_actors),
+            "kv": copy.deepcopy(self.kv),
+            "workers": dict(self.workers),
+        }
+
+    def _write_snapshot(self, state: dict) -> None:
+        import os
+        import pickle
+
+        tmp = self._persist_path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(self._persist_path)),
+                    exist_ok=True)
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, self._persist_path)  # atomic swap
+
+    def _load_snapshot(self) -> None:
+        import os
+        import pickle
+
+        if not os.path.exists(self._persist_path):
+            return
+        try:
+            with open(self._persist_path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception:
+            # A corrupt snapshot must not crash-loop the control plane:
+            # start empty (nodes/workers re-register) and overwrite it.
+            self._dirty = True
+            return
+        self.actors = snap.get("actors", {})
+        self.named_actors = snap.get("named_actors", {})
+        self.kv = snap.get("kv", {})
+        self.workers = snap.get("workers", {})
+        # Restored actors keep their last known addresses; nodes re-register
+        # and the health loop culls anything whose node never returns.
+
+    async def _persist_loop(self):
+        while True:
+            await asyncio.sleep(0.2)
+            if self._dirty:
+                # Clear BEFORE snapshotting: a mutation landing during the
+                # write re-marks dirty and gets the next tick (clearing
+                # after would erase that mark and lose the mutation).
+                self._dirty = False
+                state = self._snapshot_state()
+                try:
+                    self._write_fut = asyncio.get_running_loop().\
+                        run_in_executor(None, self._write_snapshot, state)
+                    await self._write_fut
+                except Exception:
+                    self._dirty = True  # next tick retries
+                finally:
+                    self._write_fut = None
 
     # ------------------------------------------------------------------ pubsub
     # (reference: src/ray/pubsub long-poll channels; here: server-push over the
@@ -211,6 +302,7 @@ class HeadServer:
     # ------------------------------------------------------------------ workers
     async def _register_worker(self, conn: ServerConnection, worker_id: str, host: str, port: int):
         self.workers[worker_id] = (host, port)
+        self.mark_dirty()
         return {"ok": True}
 
     async def _resolve_worker(self, conn: ServerConnection, worker_id: str):
@@ -238,6 +330,7 @@ class HeadServer:
         self.actors[actor_id] = info
         if name:
             self.named_actors[(namespace, name)] = actor_id
+        self.mark_dirty()
         ok = await self._schedule_actor(info, node_affinity=node_affinity, labels=labels)
         if not ok:
             info.state = "DEAD"
@@ -305,6 +398,7 @@ class HeadServer:
             return {"ok": False}
         info.worker_addr = (host, port)
         info.state = "ALIVE"
+        self.mark_dirty()
         await self.publish("actor_events", actor_id=actor_id, state="ALIVE",
                            addr=[host, port])
         return {"ok": True}
@@ -328,6 +422,7 @@ class HeadServer:
         info.death_reason = reason
         if info.name:
             self.named_actors.pop((info.namespace, info.name), None)
+        self.mark_dirty()
         await self.publish("actor_events", actor_id=info.actor_id, state="DEAD",
                            reason=reason)
 
@@ -539,13 +634,17 @@ class HeadServer:
         if not overwrite and key in table:
             return {"ok": False}
         table[key] = value
+        self.mark_dirty()
         return {"ok": True}
 
     async def _kv_get(self, conn: ServerConnection, ns: str, key: str):
         return {"value": self.kv.get(ns, {}).get(key)}
 
     async def _kv_del(self, conn: ServerConnection, ns: str, key: str):
-        return {"ok": self.kv.get(ns, {}).pop(key, None) is not None}
+        existed = self.kv.get(ns, {}).pop(key, None) is not None
+        if existed:
+            self.mark_dirty()
+        return {"ok": existed}
 
     async def _kv_keys(self, conn: ServerConnection, ns: str, prefix: str = ""):
         return {"keys": [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]}

@@ -230,8 +230,29 @@ class NodeDaemon:
                     pending_demands=[r.resources for r in self._pending
                                      if not r.fut.done()])
             except Exception:
-                pass
+                # Head down/restarted: reconnect and re-register so a
+                # restarted control plane rebuilds its node view (reference:
+                # raylet HandleNotifyGCSRestart, node_manager.cc:1050).
+                await self._reconnect_head()
             await asyncio.sleep(cfg.health_check_period_s / 2)
+
+    async def _reconnect_head(self) -> None:
+        try:
+            client = AsyncRpcClient(*self.head_addr)
+            await client.connect()
+            client.on_notify("place_actor", self._place_actor)
+            client.on_notify("kill_actor", self._kill_actor)
+            await client.call(
+                "register_node", node_id=self.node_id, host=self.rpc.host,
+                port=self.rpc.port, resources=self.resources,
+                labels=self.labels)
+            old, self._head = self._head, client
+            try:
+                await old.close()
+            except Exception:
+                pass
+        except Exception:
+            pass  # still down; next heartbeat retries
 
     # ------------------------------------------------------------------ leases
     # reference protocol: HandleRequestWorkerLease → grant | spillback;

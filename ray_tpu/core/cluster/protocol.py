@@ -61,7 +61,12 @@ class RpcError(Exception):
 
 
 class RpcConnectionLost(RpcError):
-    pass
+    """Connection died. ``sent`` is False when the request never hit the
+    wire (callers may retry side-effect-free without consuming budgets)."""
+
+    def __init__(self, *args, sent: bool = True):
+        super().__init__(*args)
+        self.sent = sent
 
 
 class RpcServer:
@@ -226,13 +231,17 @@ class AsyncRpcClient:
 
     async def call(self, method: str, timeout: float | None = None, **kwargs) -> Any:
         if self._closed:
-            raise RpcConnectionLost("client closed")
+            raise RpcConnectionLost("client closed", sent=False)
         rid = next(self._seq)
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        async with self._wlock:
-            self._writer.write(_pack({"m": method, "i": rid, "a": kwargs}))
-            await self._writer.drain()
+        try:
+            async with self._wlock:
+                self._writer.write(_pack({"m": method, "i": rid, "a": kwargs}))
+                await self._writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            self._pending.pop(rid, None)
+            raise RpcConnectionLost(f"send failed: {e}", sent=False)
         return await asyncio.wait_for(fut, timeout)
 
     async def notify(self, method: str, **kwargs):
@@ -281,19 +290,45 @@ class EventLoopThread:
 
 
 class RpcClient:
-    """Sync façade over AsyncRpcClient via the process's io loop thread."""
+    """Sync façade over AsyncRpcClient via the process's io loop thread.
+    Reconnects once per call after a lost connection (a restarted server at
+    the same address resumes service transparently — reference: gcs clients
+    retry through GCS restarts)."""
 
     def __init__(self, host: str, port: int):
         self._io = EventLoopThread.get()
         self._async = AsyncRpcClient(host, port)
         self._io.run(self._async.connect(), timeout=10)
+        self.on_reconnect = None  # hook: re-subscribe server-push channels
 
     @property
     def aio(self) -> AsyncRpcClient:
         return self._async
 
+    def _reconnect(self) -> None:
+        old = self._async
+        fresh = AsyncRpcClient(old.host, old.port)
+        fresh._notify_handlers = dict(old._notify_handlers)
+        self._io.run(fresh.connect(), timeout=10)
+        self._async = fresh
+        if self.on_reconnect is not None:
+            self.on_reconnect()
+
     def call(self, method: str, timeout: float | None = None, **kwargs) -> Any:
-        return self._io.run(self._async.call(method, timeout=timeout, **kwargs), timeout=timeout)
+        try:
+            return self._io.run(
+                self._async.call(method, timeout=timeout, **kwargs),
+                timeout=timeout)
+        except RpcConnectionLost as e:
+            if e.sent:
+                # The request may have executed (only the reply was lost):
+                # retrying would double-run non-idempotent RPCs. Surface the
+                # failure; the NEXT call reconnects via the sent=False path.
+                raise
+            self._reconnect()
+            return self._io.run(
+                self._async.call(method, timeout=timeout, **kwargs),
+                timeout=timeout)
 
     def notify(self, method: str, **kwargs) -> None:
         self._io.run(self._async.notify(method, **kwargs))

@@ -191,6 +191,7 @@ class ClusterRuntime:
         # Serve object fetches (and, for workers, task execution) to peers.
         self.server = RpcServer("127.0.0.1", 0)
         self.server.register("get_object", self._handle_get_object)
+        self.server.register("get_object_chunk", self._handle_get_object_chunk)
         self.server.register("free_object", self._handle_free_object)
         self.server.register("report_location", self._handle_report_location)
         self.server.register("report_lost", self._handle_report_lost)
@@ -202,6 +203,19 @@ class ClusterRuntime:
         # Actor state invalidation via pubsub.
         self.head.aio.on_notify("pub", self._on_pub)
         self.head.call("subscribe", channel="actor_events")
+
+        def _on_head_reconnect():
+            # A restarted head rebuilt its tables from its snapshot; refresh
+            # anything connection-scoped (worker directory row, pubsub subs).
+            try:
+                self.head.call("register_worker",
+                               worker_id=self.worker_id.hex(),
+                               host=self.addr[0], port=self.addr[1])
+                self.head.call("subscribe", channel="actor_events")
+            except Exception:
+                pass
+
+        self.head.on_reconnect = _on_head_reconnect
 
     # ------------------------------------------------------------------ serving
     async def _handle_ping(self, conn, **kw):
@@ -223,6 +237,36 @@ class ClusterRuntime:
                 return {"location": holder}
             await asyncio.sleep(0.01)
         return {"pending": True}
+
+    async def _handle_get_object_chunk(self, conn, oid: str, offset: int,
+                                       length: int):
+        """One chunk of a large object (reference: object transfer rides
+        gRPC chunks, object_manager.proto + ObjectBufferPool). offset=0
+        additionally reports the total size so the puller can preallocate."""
+        object_id = ObjectID.from_hex(oid)
+
+        def read():
+            if self.shm is not None:
+                try:
+                    view = self.shm.get(object_id.binary())
+                    try:
+                        total = len(view)
+                        return bytes(view[offset:offset + length]), total
+                    finally:
+                        view.release()
+                        self.shm.release(object_id.binary())
+                except KeyError:
+                    pass
+            if self.store.contains(object_id):
+                blob = self.store.get(object_id)
+                return blob[offset:offset + length], len(blob)
+            return None, 0
+
+        data, total = await asyncio.get_running_loop().run_in_executor(
+            None, read)
+        if data is None:
+            return {"missing": True}
+        return {"data": data, "total": total}
 
     async def _handle_free_object(self, conn, oid: str):
         # Owner-directed free: drop every local copy, including the node
@@ -444,17 +488,90 @@ class ClusterRuntime:
                             ref.hex(), "owner cannot reconstruct the object")
             # pending: loop
 
+    # Node-to-node transfer chunking (reference: object_manager.proto moves
+    # objects in chunks through ObjectBufferPool; PullManager bounds the
+    # bytes in flight, pull_manager.h:50).
+    PULL_CHUNK = 4 * 1024 * 1024
+    PULL_WINDOW = 4  # concurrent chunk requests (bounded in-flight bytes)
+
     def _fetch_from_holder(self, holder_hex: str, ref: ObjectRef) -> bytes | None:
         addr = self._resolve_worker_addr(holder_hex)
         if addr is None:
             return None
-        try:
-            res = self._peer(addr).call("get_object", oid=ref.hex(), timeout=15)
-        except (RpcError, OSError):  # dead holder: connect refused or reset
+        try:  # dead holder: connect refused (ctor) or reset (call)
+            peer = self._peer(addr)
+            first = peer.call("get_object_chunk", oid=ref.hex(), offset=0,
+                              length=self.PULL_CHUNK, timeout=30)
+        except (RpcError, OSError):
             return None
-        if res.get("data") is not None:
-            return res["data"]
-        return None
+        if first.get("missing"):
+            return None
+        total = first["total"]
+        if total <= self.PULL_CHUNK:
+            return first["data"]
+        return self._pull_chunked(peer, ref, first["data"], total)
+
+    def _pull_chunked(self, peer: RpcClient, ref: ObjectRef,
+                      first: bytes, total: int) -> bytes | None:
+        """Assemble a large object from pipelined chunk pulls, writing each
+        chunk straight into its destination (the node shm arena when it
+        fits) — extra memory in flight is bounded by WINDOW × CHUNK."""
+        dest = None
+        shm_backed = False
+        if self.shm is not None:
+            try:
+                dest = self.shm.create(ref.id.binary(), total)
+                shm_backed = True
+            except Exception:
+                dest = None
+        if dest is None:
+            dest = memoryview(bytearray(total))
+        dest[:len(first)] = first
+        oid_hex = ref.hex()
+        chunk, window = self.PULL_CHUNK, self.PULL_WINDOW
+
+        async def pull():
+            aio = peer.aio
+            sem = asyncio.Semaphore(window)
+
+            async def one(off):
+                async with sem:
+                    r = await aio.call("get_object_chunk", oid=oid_hex,
+                                       offset=off, length=chunk, timeout=60)
+                if r.get("missing"):
+                    raise KeyError(oid_hex)
+                data = r["data"]
+                dest[off:off + len(data)] = data
+
+            tasks = [asyncio.ensure_future(one(off))
+                     for off in range(chunk, total, chunk)]
+            try:
+                await asyncio.gather(*tasks)
+            except BaseException:
+                # Cancel and AWAIT the siblings: an orphaned chunk coroutine
+                # finishing later would write into arena memory the failure
+                # path is about to free (use-after-free corruption).
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
+
+        try:
+            self._io.run(pull())
+        except Exception:
+            if shm_backed:
+                try:
+                    self.shm.delete(ref.id.binary())
+                except Exception:
+                    pass
+            return None
+        if shm_backed:
+            self.shm.seal(ref.id.binary())
+            self._notify_waiters()
+            return self.shm.get_bytes(ref.id.binary())
+        blob = bytes(dest)
+        self.store.put(ref.id, blob, ref.owner_id)
+        return blob
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -604,12 +721,16 @@ class ClusterRuntime:
             # Worker failure: mark the lease dead, return it to the daemon
             # (a removed-but-unreturned lease permanently leaks the node's
             # resources), and retry (system retries — reference: max_retries
-            # counts system failures).
+            # counts system failures). A request that never hit the wire
+            # (cached lease whose worker was already gone) consumes no retry
+            # budget — several stale leases must not exhaust a task's
+            # retries before it ever runs.
             w.dead = True
             if w in ks.workers:
                 ks.workers.remove(w)
                 spawn_task(self._return_dead_lease(w))
-            item.attempts += 1
+            if getattr(e, "sent", True):
+                item.attempts += 1
             if item.attempts > max(item.spec.max_retries, 0):
                 self._store_error_local(
                     item.return_ids,

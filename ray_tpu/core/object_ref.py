@@ -17,13 +17,35 @@ if TYPE_CHECKING:
     pass
 
 
+import contextlib
+import threading
+
+_refcount_off = threading.local()
+
+
+@contextlib.contextmanager
+def refcount_disabled():
+    """Suppress ObjectRef local-ref accounting on this thread. Used by proxy
+    layers (client server) whose transient refs are pure transport — their
+    pinning is explicit, and ctor/dtor accounting against the process-global
+    runtime would release objects out from under the real owner."""
+    _refcount_off.on = True
+    try:
+        yield
+    finally:
+        _refcount_off.on = False
+
+
 class ObjectRef:
-    __slots__ = ("id", "owner_id", "_worker")
+    __slots__ = ("id", "owner_id", "_worker", "_counted")
 
     def __init__(self, object_id: ObjectID, owner_id: WorkerID | None = None):
         self.id = object_id
         self.owner_id = owner_id
         self._worker = None  # bound lazily to the current worker
+        self._counted = False
+        if getattr(_refcount_off, "on", False):
+            return
         # Distributed GC: every live ObjectRef instance holds one local ref;
         # release in __del__ (reference: _raylet ObjectRef dealloc decrements
         # the local count in the reference counter).
@@ -33,10 +55,13 @@ class ObjectRef:
             rt = global_worker.runtime
             if rt is not None:
                 rt.refs.add_local_ref(object_id)
+                self._counted = True
         except Exception:
             pass
 
     def __del__(self):
+        if not self._counted:
+            return
         try:
             from ray_tpu.core.worker import global_worker
 

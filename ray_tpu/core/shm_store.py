@@ -105,6 +105,27 @@ class SharedMemoryStore:
 
     # -- object API --
 
+    def _alloc(self, object_id: bytes, size: int) -> int | None:
+        """Allocate an unsealed entry, spilling LRU objects on OOM. Returns
+        the arena offset, or None when the object already exists."""
+        idb = _id_buf(bytes(object_id))
+        off = ctypes.c_uint64()
+        for _ in range(3):
+            rc = self._libh.store_create_object(self._h, idb, size,
+                                                ctypes.byref(off))
+            if rc == OK:
+                return off.value
+            if rc == ERR_EXISTS:
+                return None
+            if rc == ERR_OOM:
+                if not self._spill(size):
+                    raise ShmStoreError(
+                        f"object of {size} bytes does not fit "
+                        f"(capacity {self.stats()['capacity']})")
+                continue
+            raise ShmStoreError(f"create failed rc={rc}")
+        raise ShmStoreError(f"object of {size} bytes does not fit")
+
     def put(self, object_id: bytes, data) -> None:
         """Create+write+seal. Spills LRU objects on OOM."""
         self.put_parts(object_id, [data])
@@ -115,29 +136,26 @@ class SharedMemoryStore:
         reference: plasma CreateAndSeal with out-of-band pickle5 buffers)."""
         parts = [memoryview(p).cast("B") for p in parts]
         size = sum(len(p) for p in parts)
-        idb = _id_buf(bytes(object_id))
-        off = ctypes.c_uint64()
-        for _ in range(3):
-            rc = self._libh.store_create_object(self._h, idb, size,
-                                                ctypes.byref(off))
-            if rc == OK:
-                break
-            if rc == ERR_EXISTS:
-                return  # idempotent
-            if rc == ERR_OOM:
-                if not self._spill(size):
-                    raise ShmStoreError(
-                        f"object of {size} bytes does not fit "
-                        f"(capacity {self.stats()['capacity']})")
-                continue
-            raise ShmStoreError(f"create failed rc={rc}")
-        else:
-            raise ShmStoreError(f"object of {size} bytes does not fit")
-        pos = off.value
+        pos = self._alloc(object_id, size)
+        if pos is None:
+            return  # idempotent
         for p in parts:
             self._mm[pos:pos + len(p)] = p
             pos += len(p)
-        self._libh.store_seal(self._h, idb)
+        self._libh.store_seal(self._h, _id_buf(bytes(object_id)))
+
+    def create(self, object_id: bytes, size: int) -> memoryview:
+        """Allocate an unsealed entry and return a writable view into the
+        arena — chunked transfers write received pieces straight into place
+        (one memcpy total; reference: plasma Create→write→Seal protocol).
+        Call seal() when every byte is written."""
+        off = self._alloc(object_id, size)
+        if off is None:
+            raise ShmStoreError("object already exists")
+        return memoryview(self._mm)[off:off + size]
+
+    def seal(self, object_id: bytes) -> None:
+        self._libh.store_seal(self._h, _id_buf(bytes(object_id)))
 
     def get(self, object_id: bytes) -> memoryview:
         """Zero-copy view; call release(object_id) when done."""

@@ -1,0 +1,20 @@
+"""RL stack: environment runners + JAX learners under the Tune surface.
+
+Capability parity with the reference's RLlib core shape (reference: rllib/ —
+Algorithm as a Tune Trainable algorithms/algorithm.py:212, rollout collection
+via an EnvRunnerGroup of EnvRunner actors env/env_runner.py:36 +
+single_agent_env_runner.py:67, SGD via a LearnerGroup
+core/learner/learner_group.py:100): the TPU-native rebuild keeps those
+process shapes but the policy/value networks, GAE, and the PPO update are
+pure JAX (jit-compiled, mesh-shardable) instead of torch.
+"""
+
+from ray_tpu.rl.env import CartPoleEnv, VectorEnv, make_env
+from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.ppo import PPO, PPOConfig
+
+__all__ = [
+    "CartPoleEnv", "VectorEnv", "make_env",
+    "EnvRunner", "EnvRunnerGroup",
+    "PPO", "PPOConfig",
+]

@@ -1,0 +1,126 @@
+"""EnvRunner: rollout collection, locally or as a fleet of actors.
+
+Capability parity with the reference's runner group (reference:
+rllib/env/env_runner.py:36 EnvRunner ABC, single_agent_env_runner.py:67
+sample(); env_runner_group.py fans out sampling and syncs weights; the
+fault-aware group tolerates dead runners via utils/actor_manager.py
+FaultAwareApply): runners hold vectorized envs + the current policy params
+and return fixed-length trajectory batches; the group broadcasts weights,
+samples in parallel, and replaces dead runners.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+
+class EnvRunner:
+    """One runner = N vectorized envs + a policy-apply function."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 policy_factory: Callable, seed: int = 0):
+        from ray_tpu.rl.env import VectorEnv
+
+        self.vec = VectorEnv(env_name, num_envs, seed=seed)
+        self.rollout_len = rollout_len
+        # policy_factory() -> (act_fn, initial_params); act_fn(params, obs,
+        # rng_seed) -> (actions, logp, value) as numpy.
+        self.act_fn, self.params = policy_factory()
+        self.obs = self.vec.reset()
+        self._seed = seed
+        self._step = 0
+
+    def set_weights(self, params: Any) -> None:
+        self.params = params
+
+    def sample(self) -> dict:
+        """Collect rollout_len steps per env: a [T, N, ...] batch plus the
+        bootstrap values the learner's GAE needs."""
+        T, N = self.rollout_len, self.vec.num_envs
+        obs_b = np.zeros((T, N, self.obs.shape[-1]), np.float32)
+        act_b = np.zeros((T, N), np.int32)
+        logp_b = np.zeros((T, N), np.float32)
+        val_b = np.zeros((T, N), np.float32)
+        rew_b = np.zeros((T, N), np.float32)
+        done_b = np.zeros((T, N), np.bool_)
+        for t in range(T):
+            self._step += 1
+            actions, logp, value = self.act_fn(self.params, self.obs,
+                                               self._seed * 100_003 + self._step)
+            obs_b[t] = self.obs
+            act_b[t], logp_b[t], val_b[t] = actions, logp, value
+            self.obs, rew_b[t], done_b[t] = self.vec.step(actions)
+        _, _, last_value = self.act_fn(self.params, self.obs,
+                                       self._seed * 100_003 + self._step + 1)
+        return {
+            "obs": obs_b, "actions": act_b, "logp": logp_b, "values": val_b,
+            "rewards": rew_b, "dones": done_b, "last_values": last_value,
+            "episode_returns": self.vec.drain_episode_returns(),
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+class EnvRunnerGroup:
+    """Fan-out sampling over runner actors; num_runners=0 runs inline
+    (reference: num_env_runners=0 -> local EnvRunner)."""
+
+    def __init__(self, env_name: str, *, num_runners: int = 0,
+                 num_envs_per_runner: int = 8, rollout_len: int = 64,
+                 policy_factory: Callable, seed: int = 0):
+        self._args = (env_name, num_envs_per_runner, rollout_len,
+                      policy_factory)
+        self._seed = seed
+        self.num_runners = num_runners
+        if num_runners == 0:
+            self._local = EnvRunner(env_name, num_envs_per_runner,
+                                    rollout_len, policy_factory, seed=seed)
+            self.actors = []
+        else:
+            self._local = None
+            self.actors = [self._spawn(i) for i in range(num_runners)]
+
+    def _spawn(self, idx: int):
+        import ray_tpu
+
+        RunnerActor = ray_tpu.remote(EnvRunner)
+        return RunnerActor.options(num_cpus=0).remote(
+            *self._args, seed=self._seed + idx * 1000)
+
+    def sample(self, params) -> list[dict]:
+        import ray_tpu
+
+        if self._local is not None:
+            self._local.set_weights(params)
+            return [self._local.sample()]
+        ref = ray_tpu.put(params)  # one broadcast object, not N copies
+        out, dead = [], []
+        live = []
+        for i, a in enumerate(self.actors):
+            try:  # a dead runner must not sink the whole step
+                ray_tpu.get(a.set_weights.remote(ref), timeout=120)
+                live.append((i, a))
+            except ray_tpu.ActorDiedError:
+                dead.append(i)
+        for i, a in live:
+            try:
+                out.append(ray_tpu.get(a.sample.remote(), timeout=120))
+            except ray_tpu.ActorDiedError:
+                dead.append(i)
+        # Fault tolerance: replace dead runners; the surviving sample set
+        # still trains this iteration (reference: FaultAwareApply).
+        for i in dead:
+            self.actors[i] = self._spawn(i + self._seed + 17)
+        return out
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -1,0 +1,16 @@
+"""Ray-Client-equivalent: drive a remote cluster from a thin local process.
+
+Capability parity with the reference's Ray Client (reference:
+python/ray/util/client/ — server/server.py:962 proxies the driver API over
+gRPC so a laptop process can submit work to a remote cluster;
+client_builder.py `ray.init("ray://...")`): here the proxy speaks the
+framework's native RPC protocol, the server side hosts a full driver-grade
+ClusterRuntime, and `ray_tpu.init(address="client://host:port")` swaps the
+process's runtime for the thin forwarding one — @remote / actors / get /
+put / wait work unchanged.
+"""
+
+from ray_tpu.util.client.client import ClientRuntime, connect
+from ray_tpu.util.client.server import ClientServer, start_client_server
+
+__all__ = ["ClientRuntime", "ClientServer", "connect", "start_client_server"]

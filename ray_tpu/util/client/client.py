@@ -1,0 +1,154 @@
+"""Thin client runtime: the full driver API forwarded over one connection
+(reference: python/ray/util/client/ worker.py — every api call becomes a
+gRPC request against the proxy; refs are ids scoped to the server)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.core.cluster.protocol import RpcClient
+from ray_tpu.core.exceptions import ActorDiedError, TaskCancelledError, TaskError
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.store import ReferenceCounter
+from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
+from ray_tpu.utils import serialization
+from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, WorkerID
+
+
+class ClientRuntime:
+    """Implements the runtime interface by proxying to a ClientServer."""
+
+    def __init__(self, host: str, port: int):
+        self._rpc = RpcClient(host, port)
+        self.worker_id = WorkerID.from_random()  # local identity (client-side)
+        self.node_id = NodeID.from_random()
+        self._server_worker: WorkerID | None = None
+        # Local refcounting: when the last local ref to a proxied object
+        # drops, tell the server to unpin it (reference: client refs release
+        # server-side state on del).
+        self.refs = ReferenceCounter(on_release=self._release_remote)
+
+    def _owner(self, owner_hex: str) -> WorkerID:
+        w = WorkerID.from_hex(owner_hex)
+        self._server_worker = w
+        return w
+
+    def _release_remote(self, oid: ObjectID, rec=None) -> None:
+        # Fire-and-forget from __del__ context: a blocking RPC here can run
+        # on the io-loop thread during GC (deadlock) and holds the
+        # refcounter lock for the duration. Schedule the release onto the
+        # loop instead.
+        from ray_tpu.core.cluster.protocol import EventLoopThread, spawn_task
+
+        aio = self._rpc.aio
+        oid_hex = oid.hex()
+
+        def on_loop():
+            async def send():
+                try:
+                    await aio.call("c_release", oids=[oid_hex], timeout=10)
+                except Exception:
+                    pass  # server disconnect cleans residual pins
+
+            spawn_task(send())
+
+        try:
+            EventLoopThread.get().loop.call_soon_threadsafe(on_loop)
+        except Exception:
+            pass
+
+    # ---- objects ----
+    def put(self, value: Any) -> ObjectRef:
+        res = self._rpc.call("c_put",
+                             blob=serialization.serialize(value))
+        return ObjectRef(ObjectID.from_hex(res["oid"]),
+                         self._owner(res["owner"]))
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None):
+        wire = None if timeout is None else timeout + 15
+        res = self._rpc.call("c_get", oids=[r.hex() for r in refs],
+                             api_timeout=timeout, timeout=wire)
+        if isinstance(res, dict) and res.get("error") is not None:
+            raise serialization.deserialize(res["error"])
+        out = []
+        for item in res:
+            value = serialization.deserialize(item["blob"])
+            if isinstance(value, (TaskError, ActorDiedError,
+                                  TaskCancelledError)):
+                raise value
+            out.append(value)
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        wire = None if timeout is None else timeout + 15
+        res = self._rpc.call("c_wait", oids=[r.hex() for r in refs],
+                             num_returns=num_returns, api_timeout=timeout,
+                             timeout=wire)
+        by_hex = {r.hex(): r for r in refs}
+        return ([by_hex[h] for h in res["ready"]],
+                [by_hex[h] for h in res["pending"]])
+
+    # ---- tasks ----
+    def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        res = self._rpc.call("c_submit_task",
+                             spec_blob=serialization.dumps_spec(spec))
+        owner = self._owner(res["owner"])
+        return [ObjectRef(ObjectID.from_hex(h), owner) for h in res["oids"]]
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        self._rpc.call("c_cancel", oid=ref.hex(), force=force)
+
+    # ---- actors ----
+    def create_actor(self, spec: ActorCreationSpec) -> None:
+        res = self._rpc.call("c_create_actor",
+                             spec_blob=serialization.dumps_spec(spec))
+        if not res.get("ok"):
+            raise ValueError(res.get("error", "actor registration failed"))
+
+    def submit_actor_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        res = self._rpc.call("c_submit_actor_task",
+                             spec_blob=serialization.dumps_spec(spec))
+        owner = self._owner(res["owner"])
+        return [ObjectRef(ObjectID.from_hex(h), owner) for h in res["oids"]]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._rpc.call("c_kill_actor", actor_id=actor_id.hex(),
+                       no_restart=no_restart)
+
+    def get_named_actor(self, name: str, namespace: str = "default"):
+        res = self._rpc.call("c_get_named_actor", name=name,
+                             namespace=namespace)
+        return ActorID.from_hex(res["actor_id"]) if res.get("actor_id") \
+            else None
+
+    def actor_is_alive(self, actor_id: ActorID) -> bool:
+        return bool(self._rpc.call("c_actor_is_alive",
+                                   actor_id=actor_id.hex())["alive"])
+
+    # ---- cluster / kv ----
+    def cluster_resources(self) -> dict[str, float]:
+        return self._rpc.call("c_cluster_resources")
+
+    def available_resources(self) -> dict[str, float]:
+        return self._rpc.call("c_available_resources")
+
+    def kv_put(self, key: str, value: bytes, ns: str = "default") -> None:
+        self._rpc.call("c_kv", op="put", ns=ns, key=key, value=value)
+
+    def kv_get(self, key: str, ns: str = "default"):
+        return self._rpc.call("c_kv", op="get", ns=ns, key=key).get("value")
+
+    def kv_del(self, key: str, ns: str = "default") -> None:
+        self._rpc.call("c_kv", op="del", ns=ns, key=key)
+
+    def kv_keys(self, prefix: str = "", ns: str = "default"):
+        return self._rpc.call("c_kv", op="keys", ns=ns, prefix=prefix)["keys"]
+
+    def shutdown(self) -> None:
+        self._rpc.close()
+
+
+def connect(address: str) -> ClientRuntime:
+    """address: "host:port" of a ClientServer."""
+    host, port = address.rsplit(":", 1)
+    return ClientRuntime(host, int(port))

@@ -1,0 +1,187 @@
+"""Client proxy server: hosts a driver-grade runtime on behalf of remote
+thin clients (reference: python/ray/util/client/server/server.py — the
+RayletServicer executes API calls against the real core worker and tracks
+per-client object ownership, releasing it on disconnect)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from ray_tpu.core.cluster.protocol import RpcServer, ServerConnection
+from ray_tpu.core.object_ref import ObjectRef, refcount_disabled
+from ray_tpu.utils import serialization
+from ray_tpu.utils.ids import ActorID, ObjectID
+
+
+class ClientServer:
+    """One RpcServer fronting one ClusterRuntime. Each client connection
+    gets a pin-set of ObjectRefs the server holds alive on its behalf."""
+
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+        self.runtime = runtime
+        self.rpc = RpcServer(host, port)
+        r = self.rpc.register
+        r("c_put", self._put)
+        r("c_get", self._get)
+        r("c_wait", self._wait)
+        r("c_submit_task", self._submit_task)
+        r("c_create_actor", self._create_actor)
+        r("c_submit_actor_task", self._submit_actor_task)
+        r("c_kill_actor", self._kill_actor)
+        r("c_cancel", self._cancel)
+        r("c_get_named_actor", self._get_named_actor)
+        r("c_actor_is_alive", self._actor_is_alive)
+        r("c_release", self._release)
+        r("c_cluster_resources", self._cluster_resources)
+        r("c_available_resources", self._available_resources)
+        r("c_kv", self._kv)
+        self.rpc.on_disconnect = self._client_gone
+        # conn -> pinned ObjectIDs: one explicit local ref held in the
+        # backend runtime's counter per client-visible object, released on
+        # c_release or client disconnect (explicit — NOT via ObjectRef GC,
+        # which binds to the process-global runtime).
+        self._pins: dict[ServerConnection, dict[str, ObjectID]] = {}
+
+    async def start(self):
+        return await self.rpc.start()
+
+    async def stop(self):
+        await self.rpc.stop()
+
+    def _client_gone(self, conn: ServerConnection) -> None:
+        for oid in (self._pins.pop(conn, None) or {}).values():
+            self.runtime.refs.remove_local_ref(oid)
+
+    def _pin(self, conn, refs) -> None:
+        pins = self._pins.setdefault(conn, {})
+        for ref in refs:
+            if ref.hex() not in pins:
+                pins[ref.hex()] = ref.id
+                self.runtime.refs.add_local_ref(ref.id)
+
+    def _run(self, fn, *args):
+        """Runtime calls block (store waits, RPCs); keep the loop free. Ref
+        accounting is suppressed: refs materialized inside handlers are
+        transport-only (pinning is explicit via the backend's counter)."""
+        from ray_tpu.core.object_ref import refcount_disabled
+
+        def wrapped():
+            with refcount_disabled():
+                return fn(*args)
+
+        return asyncio.get_running_loop().run_in_executor(None, wrapped)
+
+    # ---- handlers ----
+    async def _put(self, conn, blob: bytes):
+        value = serialization.deserialize(blob)
+        ref = await self._run(self.runtime.put, value)
+        self._pin(conn, [ref])
+        return {"oid": ref.hex(), "owner": self.runtime.worker_id.hex()}
+
+    async def _get(self, conn, oids: list[str], api_timeout: float | None):
+        with refcount_disabled():
+            refs = [ObjectRef(ObjectID.from_hex(h), self.runtime.worker_id)
+                    for h in oids]
+
+        def fetch():
+            try:
+                values = self.runtime.get(refs, timeout=api_timeout)
+                return [{"blob": serialization.serialize(v)} for v in values]
+            except BaseException as e:  # noqa: BLE001 - errors cross the wire
+                return {"error": serialization.serialize(e)}
+
+        return await self._run(fetch)
+
+    async def _wait(self, conn, oids: list[str], num_returns: int,
+                    api_timeout: float | None):
+        with refcount_disabled():
+            refs = [ObjectRef(ObjectID.from_hex(h), self.runtime.worker_id)
+                    for h in oids]
+        ready, pending = await self._run(
+            lambda: self.runtime.wait(refs, num_returns=num_returns,
+                                      timeout=api_timeout))
+        return {"ready": [r.hex() for r in ready],
+                "pending": [r.hex() for r in pending]}
+
+    async def _submit_task(self, conn, spec_blob: bytes):
+        spec = serialization.loads_spec(spec_blob)
+        spec.owner_id = self.runtime.worker_id
+        refs = await self._run(self.runtime.submit_task, spec)
+        self._pin(conn, refs)
+        return {"oids": [r.hex() for r in refs],
+                "owner": self.runtime.worker_id.hex()}
+
+    async def _create_actor(self, conn, spec_blob: bytes):
+        spec = serialization.loads_spec(spec_blob)
+        spec.owner_id = self.runtime.worker_id
+        await self._run(self.runtime.create_actor, spec)
+        return {"ok": True}
+
+    async def _submit_actor_task(self, conn, spec_blob: bytes):
+        spec = serialization.loads_spec(spec_blob)
+        spec.owner_id = self.runtime.worker_id
+        refs = await self._run(self.runtime.submit_actor_task, spec)
+        self._pin(conn, refs)
+        return {"oids": [r.hex() for r in refs],
+                "owner": self.runtime.worker_id.hex()}
+
+    async def _kill_actor(self, conn, actor_id: str, no_restart: bool):
+        await self._run(lambda: self.runtime.kill_actor(
+            ActorID.from_hex(actor_id), no_restart=no_restart))
+        return {"ok": True}
+
+    async def _cancel(self, conn, oid: str, force: bool):
+        with refcount_disabled():
+            ref = ObjectRef(ObjectID.from_hex(oid), self.runtime.worker_id)
+        self.runtime.cancel(ref, force=force)
+        return {"ok": True}
+
+    async def _get_named_actor(self, conn, name: str, namespace: str):
+        aid = await self._run(
+            lambda: self.runtime.get_named_actor(name, namespace))
+        return {"actor_id": aid.hex() if aid else None}
+
+    async def _actor_is_alive(self, conn, actor_id: str):
+        alive = await self._run(
+            lambda: self.runtime.actor_is_alive(ActorID.from_hex(actor_id)))
+        return {"alive": bool(alive)}
+
+    async def _release(self, conn, oids: list[str]):
+        pins = self._pins.get(conn, {})
+        for h in oids:
+            oid = pins.pop(h, None)
+            if oid is not None:
+                self.runtime.refs.remove_local_ref(oid)
+        return {"ok": True}
+
+    async def _cluster_resources(self, conn):
+        return await self._run(self.runtime.cluster_resources)
+
+    async def _available_resources(self, conn):
+        return await self._run(self.runtime.available_resources)
+
+    async def _kv(self, conn, op: str, ns: str, key: str = "",
+                  value: bytes | None = None, prefix: str = ""):
+        if op == "put":
+            await self._run(lambda: self.runtime.kv_put(key, value, ns=ns))
+            return {"ok": True}
+        if op == "get":
+            return {"value": await self._run(
+                lambda: self.runtime.kv_get(key, ns=ns))}
+        if op == "del":
+            await self._run(lambda: self.runtime.kv_del(key, ns=ns))
+            return {"ok": True}
+        return {"keys": await self._run(
+            lambda: self.runtime.kv_keys(prefix, ns=ns))}
+
+
+def start_client_server(runtime, host: str = "127.0.0.1",
+                        port: int = 0) -> ClientServer:
+    """Attach a client proxy to an existing driver runtime (typically run on
+    the head node — reference: ray start --ray-client-server-port)."""
+    from ray_tpu.core.cluster.protocol import EventLoopThread
+
+    srv = ClientServer(runtime, host, port)
+    EventLoopThread.get().run(srv.start())
+    return srv

@@ -347,3 +347,94 @@ def test_put_objects_are_not_reconstructable(cluster):
     rt._locations[ref.id] = "00" * 16  # bogus dead holder
     with pytest.raises((ray_tpu.ObjectLostError, ray_tpu.GetTimeoutError)):
         ray_tpu.get(ref, timeout=10)
+
+
+def test_head_restart_with_persistence(tmp_path):
+    """Control-plane fault tolerance: restart the head; daemons and drivers
+    reconnect, named actors stay resolvable, KV survives (reference: GCS
+    restart from Redis; raylet HandleNotifyGCSRestart)."""
+    os.environ["RTPU_HEALTH_CHECK_PERIOD_S"] = "0.2"
+    from ray_tpu.utils import config as config_mod
+
+    config_mod.set_config(config_mod.Config.load())
+    c = Cluster(persist_path=str(tmp_path / "head_snapshot.pkl"))
+    c.add_node(num_cpus=4)
+    rt = c.connect()
+    old_runtime = global_worker.runtime
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    try:
+        @remote
+        class KV:
+            def __init__(self):
+                self.d = {}
+
+            def put(self, k, v):
+                self.d[k] = v
+                return "ok"
+
+            def get(self, k):
+                return self.d.get(k)
+
+        h = KV.options(name="survivor").remote()
+        assert ray_tpu.get(h.put.remote("a", 1), timeout=60) == "ok"
+        rt.kv_put("durable", b"value")
+        time.sleep(0.6)  # let the persist loop flush
+
+        c.restart_head()
+        time.sleep(0.5)  # daemons reconnect on their heartbeat
+
+        # Driver RPC reconnects transparently; durable state is back.
+        assert rt.kv_get("durable") == b"value"
+        h2 = ray_tpu.get_actor("survivor")
+        # The actor process never died — calls flow to the same worker and
+        # its in-memory state is intact.
+        assert ray_tpu.get(h2.get.remote("a"), timeout=60) == 1
+        # New work schedules normally on the reconnected node.
+        @remote
+        def ping():
+            return "alive"
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == "alive"
+    finally:
+        rt.shutdown()
+        c.shutdown()
+        global_worker.runtime = old_runtime
+        config_mod.set_config(config_mod.Config.load())
+
+
+def test_chunked_pull_large_object(cluster, monkeypatch):
+    """Large results move node-to-node in bounded pipelined chunks
+    (reference: pull_manager.h:50 bounded pulls + ObjectBufferPool chunks).
+    The producer runs on a SECOND node so its result lives in a different
+    shm arena and must cross the wire."""
+    import numpy as np
+
+    from ray_tpu.core.cluster.runtime import ClusterRuntime
+
+    monkeypatch.setattr(ClusterRuntime, "PULL_CHUNK", 256 * 1024)
+    pulls = []
+    orig = ClusterRuntime._pull_chunked
+
+    def counting_pull(self, peer, ref, first, total):
+        pulls.append(total)
+        return orig(self, peer, ref, first, total)
+
+    monkeypatch.setattr(ClusterRuntime, "_pull_chunked", counting_pull)
+    cluster.add_node(num_cpus=2, resources={"far": 1.0})
+    time.sleep(0.3)
+
+    @remote(resources={"far": 1.0})
+    def big():
+        import numpy as np
+        return np.arange(1_500_000, dtype=np.float32)  # ~6MB -> ~24 chunks
+
+    ref = big.remote()
+    arr = ray_tpu.get(ref, timeout=120)
+    assert arr.shape == (1_500_000,)
+    np.testing.assert_allclose(arr[:5], [0, 1, 2, 3, 4])
+    assert float(arr[-1]) == 1_499_999.0
+    assert pulls and pulls[0] > 1_000_000  # the chunked path actually ran

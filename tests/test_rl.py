@@ -1,0 +1,119 @@
+"""RL stack: env physics, GAE, PPO learning, runner actors, Tuner
+integration. (Reference test model: rllib/algorithms/ppo/tests/test_ppo.py
+learning smoke + env runner tests.)"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.rl import PPO, PPOConfig
+from ray_tpu.rl.env import CartPoleEnv, VectorEnv
+from ray_tpu.rl.ppo import compute_gae
+
+
+def test_cartpole_physics():
+    env = CartPoleEnv(seed=0)
+    obs = env.reset()
+    assert obs.shape == (4,) and np.all(np.abs(obs) <= 0.05)
+    total = 0
+    for _ in range(500):
+        obs, r, term, trunc = env.step(1)  # constant push tips the pole
+        total += r
+        if term or trunc:
+            break
+    assert term  # constant action must fail well before truncation
+    assert 5 < total < 100
+
+
+def test_vector_env_autoreset():
+    vec = VectorEnv("CartPole-v1", 4, seed=0)
+    vec.reset()
+    for _ in range(200):
+        _, _, dones = vec.step(np.ones(4, np.int32))
+    rets = vec.drain_episode_returns()
+    assert len(rets) >= 4  # several episodes ended and auto-reset
+    assert all(r > 0 for r in rets)
+
+
+def test_gae_matches_manual():
+    import jax.numpy as jnp
+
+    rewards = jnp.asarray([[1.0], [1.0], [1.0]])
+    values = jnp.asarray([[0.5], [0.5], [0.5]])
+    dones = jnp.zeros((3, 1), bool)
+    last = jnp.asarray([0.5])
+    gamma, lam = 0.9, 0.8
+    adv, ret = compute_gae(rewards, values, dones, last, gamma, lam)
+    # manual reverse recursion
+    deltas = [1.0 + gamma * 0.5 - 0.5] * 3
+    a2 = deltas[2]
+    a1 = deltas[1] + gamma * lam * a2
+    a0 = deltas[0] + gamma * lam * a1
+    np.testing.assert_allclose(np.asarray(adv)[:, 0], [a0, a1, a2], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ret), np.asarray(adv) + 0.5,
+                               rtol=1e-6)
+
+
+def test_gae_resets_at_done():
+    import jax.numpy as jnp
+
+    rewards = jnp.ones((2, 1))
+    values = jnp.zeros((2, 1))
+    dones = jnp.asarray([[True], [False]])
+    last = jnp.asarray([10.0])
+    adv, _ = compute_gae(rewards, values, dones, last, 0.9, 1.0)
+    # t=0 episode ended: no bootstrap through the boundary
+    np.testing.assert_allclose(float(adv[0, 0]), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(adv[1, 0]), 1.0 + 0.9 * 10.0, rtol=1e-6)
+
+
+def test_ppo_solves_cartpole():
+    """The headline learning test (reference: rllib PPO CartPole tune runs
+    to a reward threshold)."""
+    algo = PPOConfig(num_envs_per_runner=8, rollout_len=128, lr=3e-4,
+                     seed=0).build()
+    best = 0.0
+    for _ in range(50):
+        r = algo.train_step()
+        best = max(best, r["episode_return_mean"])
+        if best >= 150.0:
+            break
+    algo.cleanup()
+    assert best >= 150.0, f"PPO failed to learn CartPole: best {best}"
+
+
+def test_ppo_runner_actors(rt_start):
+    """Distributed rollout path: env-runner ACTORS sample in parallel and
+    receive weight broadcasts (reference: EnvRunnerGroup over actors)."""
+    algo = PPO({"ppo_config": PPOConfig(
+        num_env_runners=2, num_envs_per_runner=4, rollout_len=32,
+        seed=1)})
+    r1 = algo.train_step()
+    r2 = algo.train_step()
+    assert r1["num_env_steps_sampled"] == 2 * 4 * 32
+    assert "policy_loss" in r2
+    algo.cleanup()
+
+
+def test_ppo_under_tuner(rt_start):
+    """PPO as a Tune trainable: a small sweep returns the better lr
+    (reference: Algorithm is a Tune Trainable)."""
+    tuner = tune.Tuner(
+        PPO,
+        param_space={
+            "env": "CartPole-v1",
+            "rollout_len": 64,
+            "num_envs_per_runner": 4,
+            "lr": tune.grid_search([3e-4, 0.0]),  # lr=0 can't learn
+            "seed": 0,
+        },
+        tune_config=tune.TuneConfig(metric="episode_return_mean",
+                                    mode="max"),
+        stop={"training_iteration": 12},
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    best = grid.get_best_result()
+    assert best.config["lr"] == 3e-4
+    assert best.metrics["episode_return_mean"] > 25.0
