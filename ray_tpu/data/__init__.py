@@ -138,3 +138,11 @@ __all__ = [
     "read_numpy",
     "read_parquet",
 ]
+
+# usage telemetry (local-only, opt-out — reference: usage_lib auto-records
+# library imports)
+try:
+    from ray_tpu.usage import record_library_usage as _rec
+    _rec("data")
+except Exception:
+    pass

@@ -17,6 +17,9 @@ class DataContext:
     max_tasks_in_flight_per_stage: int = 8
     # cap on produced-but-unconsumed blocks per stage (backpressure)
     max_output_blocks_buffered: int = 16
+    # cap on produced-but-unconsumed BYTES per stage (backpressure budget —
+    # reference: ResourceManager object-store memory budgets)
+    max_output_bytes_buffered: int = 256 * 1024 * 1024
     # shuffle fan-out
     default_shuffle_partitions: int = 8
     # task resource demand for data tasks (0 CPU => don't starve trainers)

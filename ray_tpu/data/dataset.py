@@ -63,17 +63,21 @@ class Dataset:
         compute: ActorPoolStrategy | None = None,
         fn_args: tuple = (),
         fn_kwargs: dict | None = None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: dict | None = None,
     ) -> "Dataset":
         if isinstance(fn, type):
             # Class-based UDF → stateful actor-pool map: each pool actor
             # instantiates the class once and reuses it across blocks.
             compute = compute or ActorPoolStrategy()
             cls = fn
+            ctor_kwargs = fn_constructor_kwargs or {}
             inst_holder: dict = {}
 
             def call(batch, *a, **kw):
                 if "inst" not in inst_holder:
-                    inst_holder["inst"] = cls()
+                    inst_holder["inst"] = cls(*fn_constructor_args,
+                                              **ctor_kwargs)
                 return inst_holder["inst"](batch, *a, **kw)
 
             fn = call
@@ -140,6 +144,18 @@ class Dataset:
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
 
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             ) -> "Dataset":
+        """Hash join on column ``on`` (reference: Dataset.join, join.py —
+        both sides hash-partition on the key, partitions join pairwise).
+        ``how``: "inner" or "left". Right-side column collisions get an
+        ``_r`` suffix."""
+        if how not in ("inner", "left"):
+            raise ValueError(f"how must be 'inner' or 'left', got {how!r}")
+        return self._with(
+            AllToAll(_shuffle.make_join_fn(other, on, how, _api()),
+                     label=f"Join({how})"))
+
     def union(self, *others: "Dataset") -> "Dataset":
         mats = [self.materialize()] + [o.materialize() for o in others]
         refs = list(itertools.chain.from_iterable(m._refs_meta for m in mats))
@@ -195,6 +211,33 @@ class Dataset:
             shuffle_buffer_size=local_shuffle_buffer_size,
             shuffle_seed=local_shuffle_seed,
         )
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: int | None = 256,
+        sharding=None,
+        prefetch: int = 2,
+        drop_last: bool = False,
+        local_shuffle_buffer_size: int | None = None,
+        local_shuffle_seed: int | None = None,
+    ) -> Iterator[Any]:
+        """numpy batches moved onto device ahead of consumption (TPU-native
+        analogue of the reference's iter_torch_batches: host→device transfer
+        overlaps the consumer's compute via a ``prefetch``-deep pipeline).
+
+        ``sharding``: a jax.sharding.Sharding (e.g. NamedSharding over the
+        dp axis); None puts batches on the default device.
+        """
+        from ray_tpu.data.iterator import device_prefetch
+
+        batches = self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
+        yield from device_prefetch(batches, sharding=sharding,
+                                   depth=prefetch)
 
     def take(self, n: int = 20) -> list[dict]:
         out = []

@@ -22,12 +22,14 @@ from ray_tpu.data.plan import AllToAll, FusedMapStage, InputData, LimitOp, Read
 
 def _run_block_fn(block_fn, block: Block):
     out = block_fn(block)
-    return out, {"num_rows": BlockAccessor(out).num_rows()}
+    acc = BlockAccessor(out)
+    return out, {"num_rows": acc.num_rows(), "size_bytes": acc.size_bytes()}
 
 
 def _run_read_task(task: ReadTask):
     out = task()
-    return out, {"num_rows": BlockAccessor(out).num_rows()}
+    acc = BlockAccessor(out)
+    return out, {"num_rows": acc.num_rows(), "size_bytes": acc.size_bytes()}
 
 
 def _slice_block(block: Block, start: int, end: int):
@@ -101,6 +103,9 @@ class _StageExec:
             return False
         if len(self.outputs) >= self.ctx.max_output_blocks_buffered:
             return False
+        buffered = sum(m.get("size_bytes", 0) for _, m in self.outputs)
+        if buffered >= self.ctx.max_output_bytes_buffered:
+            return False  # byte budget (reference: ResourceManager)
         return True
 
     def launch(self) -> None:

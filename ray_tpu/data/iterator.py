@@ -163,3 +163,66 @@ def make_streaming_split(dataset, n: int, *, equal: bool = False):
     coord = coord_cls.remote(dataset, n, equal)
     ray_tpu.get(coord.ping.remote())  # ensure started
     return [DataIterator(coord, i) for i in range(n)]
+
+
+def device_prefetch(batches, *, sharding=None, depth: int = 2):
+    """Pipeline host→device transfer: a background thread device_puts up to
+    ``depth`` batches ahead while the consumer computes on the current one
+    (the standard TPU input-pipeline overlap the reference leaves to
+    frameworks)."""
+    import queue as _q
+    import threading
+
+    import jax
+
+    q: "_q.Queue" = _q.Queue(maxsize=max(1, depth))
+    _END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # Bounded put that notices consumer abandonment — a plain q.put on
+        # a full queue would block this thread forever and pin `depth`
+        # device-resident batches (plus the upstream pipeline).
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except _q.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for batch in batches:
+                if stop.is_set():
+                    return
+                if sharding is not None:
+                    dev = {k: jax.device_put(v, sharding)
+                           for k, v in batch.items()}
+                else:
+                    dev = {k: jax.device_put(v) for k, v in batch.items()}
+                if not _put(dev):
+                    return
+        except BaseException as e:  # noqa: BLE001 - surface in consumer
+            _put(e)
+            return
+        _put(_END)
+
+    t = threading.Thread(target=produce, daemon=True,
+                         name="data-device-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()  # early break / error: release the producer + buffers
+        while not q.empty():
+            try:
+                q.get_nowait()
+            except _q.Empty:
+                break

@@ -47,20 +47,40 @@ def _partition_random(block: Block, num_parts: int, seed: int):
                  for p in range(num_parts))
 
 
+def _stable_hash(col: np.ndarray) -> np.ndarray:
+    """Process-independent per-value hashes. Python's hash() is SipHash
+    salted per interpreter — partition tasks running in different worker
+    processes would route the same key to different partitions, silently
+    dropping join matches / splitting groups."""
+    import zlib
+
+    if col.dtype.kind in "iu":
+        v = col.astype(np.uint64, copy=False)
+    elif col.dtype.kind == "f":
+        v = col.astype(np.float64, copy=False).view(np.uint64)
+    elif col.dtype.kind == "b":
+        v = col.astype(np.uint64)
+    else:  # strings/objects: stable byte-level CRC per value
+        return np.fromiter(
+            (zlib.crc32(str(x).encode()) for x in col),
+            dtype=np.uint64, count=len(col))
+    # splitmix64 finalizer — deterministic, well-mixed, fully vectorized.
+    v = (v + np.uint64(0x9E3779B97F4A7C15))
+    v ^= v >> np.uint64(30)
+    v *= np.uint64(0xBF58476D1CE4E5B9)
+    v ^= v >> np.uint64(27)
+    v *= np.uint64(0x94D049BB133111EB)
+    v ^= v >> np.uint64(31)
+    return v
+
+
 def _partition_by_hash(block: Block, key: str, num_parts: int):
     col = block.get(key)
     acc = BlockAccessor(block)
     if col is None or len(col) == 0:
         return tuple({} for _ in range(num_parts))
-    if col.dtype.kind == "O":
-        hashes = np.fromiter((hash(v) for v in col), dtype=np.int64,
-                             count=len(col))
-    else:
-        # stable integer mix of the raw bytes per value
-        hashes = np.fromiter(
-            (hash(v.tobytes()) for v in col), dtype=np.int64, count=len(col)
-        )
-    assign = hashes % num_parts
+    with np.errstate(over="ignore"):
+        assign = _stable_hash(col) % np.uint64(num_parts)
     return tuple(acc.take_rows(np.nonzero(assign == p)[0])
                  for p in range(num_parts))
 
@@ -406,5 +426,126 @@ def make_global_aggregate_fn(aggs: list[AggregateFn], api):
         )
         out_ref, meta_ref = comb_remote.remote(*partials)
         return [(out_ref, api.get(meta_ref))]
+
+    return run
+
+
+# -- joins (reference capability: Dataset.join/join.py — hash-partition both
+#    sides on the key, then per-partition hash joins) ------------------------
+
+
+def _merge_join(key: str, how: str, num_left: int, *parts: Block):
+    """Join the concatenation of the first num_left parts (left side)
+    against the rest (right side) on ``key``. Vectorized via sort +
+    searchsorted; right-side column collisions get an ``_r`` suffix."""
+    # num_parts == 1 ships the partition fn's whole 1-tuple in one ref.
+    parts = tuple(p[0] if isinstance(p, tuple) else p for p in parts)
+    left = concat_blocks([p for p in parts[:num_left] if len(p)])
+    right = concat_blocks([p for p in parts[num_left:] if len(p)])
+    empty = {}, {"num_rows": 0}
+    la, ra = BlockAccessor(left), BlockAccessor(right)
+    if la.num_rows() == 0:
+        return empty
+    if ra.num_rows() == 0 and how == "inner":
+        return empty
+
+    lk = left[key]
+    rk = right[key] if ra.num_rows() > 0 else np.array([], dtype=lk.dtype)
+    order = np.argsort(rk, kind="stable")
+    rk_sorted = rk[order]
+    lo = np.searchsorted(rk_sorted, lk, side="left")
+    hi = np.searchsorted(rk_sorted, lk, side="right")
+    counts = hi - lo
+
+    # Vectorized match-index construction: matched left rows repeat by
+    # match count; their right indices are contiguous runs of `order`
+    # starting at lo[i] (run-local offsets via a cumsum-reset trick).
+    m_li = np.repeat(np.arange(len(lk)), counts)
+    if len(m_li):
+        starts = np.repeat(lo, counts)
+        run_first = np.repeat(np.cumsum(counts) - counts, counts)
+        offsets = np.arange(len(m_li)) - run_first
+        m_ri = order[starts + offsets]
+    else:
+        m_ri = np.array([], dtype=np.int64)
+    if how == "left":
+        miss = np.nonzero(counts == 0)[0]
+        li = np.concatenate([m_li, miss])
+        ri = np.concatenate([m_ri, np.full(len(miss), -1)])
+    else:
+        li, ri = m_li, m_ri
+    if len(li) == 0:
+        return empty
+    li = li.astype(np.int64)
+    ri = ri.astype(np.int64)
+
+    out: Block = {}
+    for col in la.columns():
+        out[col] = left[col][li]
+    matched = ri >= 0
+    # Schema comes from the raw parts: concat drops 0-row blocks, and a
+    # match-less partition must still emit the right-side columns (as
+    # misses) or the joined dataset's schema varies per block.
+    right_cols = next((list(p.keys()) for p in parts[num_left:] if len(p)),
+                      list(ra.columns()))
+    for col in right_cols:
+        if col == key:
+            continue
+        name = col if col not in out else f"{col}_r"
+        rcol = right.get(col)
+        if rcol is None:
+            # concat dropped the 0-row blocks; a raw part still carries the
+            # column's DTYPE, which decides NaN (numeric) vs None (object)
+            # fill — a float default would put NaN into string columns.
+            rcol = next((p[col] for p in parts[num_left:] if col in p),
+                        np.array([]))
+        if len(rcol) == 0 or not matched.any():
+            # every output row is a left-join miss for this column
+            out[name] = np.full(len(li), np.nan) if rcol.dtype.kind in "fiu" \
+                else np.full(len(li), None, dtype=object)
+            continue
+        vals = rcol[np.where(matched, ri, 0)]
+        if not matched.all():  # left-join misses -> NaN/None fill
+            if vals.dtype.kind in "fiu":
+                vals = vals.astype(np.float64)
+                vals[~matched] = np.nan
+            else:
+                vals = vals.astype(object)
+                vals[~matched] = None
+        out[name] = vals
+    return out, {"num_rows": len(li)}
+
+
+def make_join_fn(right_dataset, key: str, how: str, api):
+    """AllToAll builder: hash-partition both sides, join per partition."""
+
+    def run(left_refs_meta):
+        right_refs_meta = list(right_dataset._execute())
+        ctx = DataContext.get_current()
+        num_parts = max(1, min(ctx.default_shuffle_partitions,
+                               max(len(left_refs_meta),
+                                   len(right_refs_meta), 1)))
+        part_remote = api.remote(num_cpus=ctx.task_num_cpus,
+                                 num_returns=num_parts)(_partition_by_hash)
+        join_remote = api.remote(num_cpus=ctx.task_num_cpus,
+                                 num_returns=2)(_merge_join)
+
+        def partition(refs_meta):
+            out = []
+            for ref, _m in refs_meta:
+                parts = part_remote.remote(ref, key, num_parts)
+                out.append([parts] if num_parts == 1 else parts)
+            return out
+
+        left_parts = partition(left_refs_meta)
+        right_parts = partition(right_refs_meta)
+        results = []
+        for p in range(num_parts):
+            lps = [pr[p] for pr in left_parts]
+            rps = [pr[p] for pr in right_parts]
+            out_ref, meta_ref = join_remote.remote(key, how, len(lps),
+                                                   *lps, *rps)
+            results.append((out_ref, meta_ref))
+        return [(ref, api.get(meta_ref)) for ref, meta_ref in results]
 
     return run

@@ -20,3 +20,11 @@ __all__ = [
     "LLMServer", "build_llm_deployment", "build_openai_app",
     "ByteTokenizer", "get_tokenizer",
 ]
+
+# usage telemetry (local-only, opt-out — reference: usage_lib auto-records
+# library imports)
+try:
+    from ray_tpu.usage import record_library_usage as _rec
+    _rec("llm")
+except Exception:
+    pass

@@ -18,3 +18,11 @@ __all__ = [
     "EnvRunner", "EnvRunnerGroup",
     "PPO", "PPOConfig",
 ]
+
+# usage telemetry (local-only, opt-out — reference: usage_lib auto-records
+# library imports)
+try:
+    from ray_tpu.usage import record_library_usage as _rec
+    _rec("rl")
+except Exception:
+    pass

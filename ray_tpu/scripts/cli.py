@@ -1,0 +1,168 @@
+"""Command-line interface: cluster status, state listings, timeline, logs.
+
+Capability parity with the reference's CLI surface (reference:
+python/ray/scripts/scripts.py `ray status`; util/state/state_cli.py
+`ray list tasks|actors|...`, `ray summary tasks`, `ray timeline`,
+`ray logs`): `python -m ray_tpu <command> [--address host:port]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _connect(address: str | None):
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+def _fmt_table(rows: list[dict], columns: list[str]) -> str:
+    if not rows:
+        return "(empty)"
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns}
+    line = "  ".join(c.ljust(widths[c]) for c in columns)
+    out = [line, "-" * len(line)]
+    for r in rows:
+        out.append("  ".join(str(r.get(c, "")).ljust(widths[c])
+                             for c in columns))
+    return "\n".join(out)
+
+
+def cmd_status(args) -> int:
+    api = _connect(args.address)
+    total = api.cluster_resources()
+    avail = api.available_resources()
+    print("Cluster resources:")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+    from ray_tpu.util.state import list_nodes
+
+    nodes = list_nodes()
+    print(f"\nNodes ({len(nodes)}):")
+    print(_fmt_table(nodes, ["node_id", "alive", "resources"]))
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state
+
+    _connect(args.address)
+    fns = {
+        "tasks": state.list_tasks, "actors": state.list_actors,
+        "nodes": state.list_nodes, "workers": state.list_workers,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }
+    rows = fns[args.resource]()
+    if args.json:
+        print(json.dumps(rows, default=str))
+    else:
+        cols = list(rows[0].keys()) if rows else []
+        print(_fmt_table(rows, cols[:6]))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from ray_tpu.util.state import summarize_tasks
+
+    _connect(args.address)
+    print(json.dumps(summarize_tasks(), indent=2, default=str))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    """Chrome-trace JSON of task execution (reference: ray timeline)."""
+    api = _connect(args.address)
+    from ray_tpu.core.events import TaskEvent, chrome_trace
+
+    events = api.timeline() if hasattr(api, "timeline") else None
+    if events is None:
+        from ray_tpu.core.worker import global_worker
+
+        raw = global_worker.runtime.task_events()["events"]
+        events = chrome_trace([TaskEvent(**e) for e in raw])
+    with open(args.out, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} trace events to {args.out}")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """Tail worker logs (reference: ray logs)."""
+    from ray_tpu.utils.config import get_config
+
+    log_dir = os.path.join(get_config().temp_dir, "logs")
+    if not os.path.isdir(log_dir):
+        print(f"no logs at {log_dir}")
+        return 1
+    names = sorted(os.listdir(log_dir))
+    if args.glob:
+        import fnmatch
+
+        names = [n for n in names if fnmatch.fnmatch(n, args.glob)]
+    if args.list:
+        for n in names:
+            print(n)
+        return 0
+    for n in names:
+        path = os.path.join(log_dir, n)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - args.tail), os.SEEK_SET)
+                data = f.read().decode(errors="replace")
+        except OSError:
+            continue
+        if data.strip():
+            print(f"==== {n} ====")
+            print(data)
+    return 0
+
+
+def cmd_memory(args) -> int:
+    """Object store usage (reference: ray memory)."""
+    api = _connect(args.address)
+    from ray_tpu.core.worker import global_worker
+
+    snap = global_worker.runtime.state_snapshot()
+    print(json.dumps(snap.get("objects", {}), indent=2))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    p.add_argument("--address", default=None,
+                   help="head address (host:port), client://host:port, or "
+                        "omit for an in-process runtime")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("status")
+    lp = sub.add_parser("list")
+    lp.add_argument("resource", choices=["tasks", "actors", "nodes",
+                                         "workers", "objects",
+                                         "placement-groups"])
+    lp.add_argument("--json", action="store_true")
+    sp = sub.add_parser("summary")
+    sp.add_argument("resource", choices=["tasks"])
+    tp = sub.add_parser("timeline")
+    tp.add_argument("--out", default="timeline.json")
+    gp = sub.add_parser("logs")
+    gp.add_argument("glob", nargs="?", default=None)
+    gp.add_argument("--list", action="store_true")
+    gp.add_argument("--tail", type=int, default=20_000)
+    sub.add_parser("memory")
+
+    args = p.parse_args(argv)
+    cmds = {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
+            "timeline": cmd_timeline, "logs": cmd_logs, "memory": cmd_memory}
+    return cmds[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,3 +32,11 @@ __all__ = [
     "AutoscalingConfig", "DeploymentConfig",
     "batch", "Request", "Response",
 ]
+
+# usage telemetry (local-only, opt-out — reference: usage_lib auto-records
+# library imports)
+try:
+    from ray_tpu.usage import record_library_usage as _rec
+    _rec("serve")
+except Exception:
+    pass

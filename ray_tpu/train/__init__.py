@@ -25,3 +25,11 @@ __all__ = [
     "JaxBackendConfig", "get_context", "report",
     "Checkpoint", "CheckpointManager", "save_pytree", "restore_pytree",
 ]
+
+# usage telemetry (local-only, opt-out — reference: usage_lib auto-records
+# library imports)
+try:
+    from ray_tpu.usage import record_library_usage as _rec
+    _rec("train")
+except Exception:
+    pass

@@ -36,3 +36,11 @@ __all__ = [
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
 ]
+
+# usage telemetry (local-only, opt-out — reference: usage_lib auto-records
+# library imports)
+try:
+    from ray_tpu.usage import record_library_usage as _rec
+    _rec("tune")
+except Exception:
+    pass

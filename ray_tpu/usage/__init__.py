@@ -1,0 +1,56 @@
+"""Usage/telemetry recording (opt-out, local-only).
+
+Capability parity with the reference's usage-stats shape (reference:
+python/ray/_private/usage/usage_lib.py — feature-flag usage recorded and
+(opt-out via RAY_USAGE_STATS_ENABLED=0) periodically reported): here usage
+records append to a local JSON file only — nothing leaves the machine.
+Disable with RTPU_USAGE_STATS_ENABLED=0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_features: set[str] = set()
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RTPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def record_library_usage(name: str) -> None:
+    """Mark a library (train/serve/data/...) as used this session."""
+    _record("library", name)
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    _record("tag", f"{key}={value}")
+
+
+def _record(kind: str, name: str) -> None:
+    if not usage_stats_enabled():
+        return
+    tag = f"{kind}:{name}"
+    with _lock:
+        if tag in _features:
+            return
+        _features.add(tag)
+    try:
+        from ray_tpu.utils.config import get_config
+
+        path = os.path.join(get_config().temp_dir, "usage_stats.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps({"ts": time.time(), "kind": kind,
+                                "name": name}) + "\n")
+    except Exception:
+        pass
+
+
+def recorded_features() -> set[str]:
+    with _lock:
+        return set(_features)

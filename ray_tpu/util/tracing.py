@@ -137,3 +137,68 @@ def export() -> list[dict]:
 def clear() -> None:
     with _lock:
         _spans.clear()
+
+
+# -- exporters --------------------------------------------------------------
+
+
+def export_otlp() -> dict:
+    """Spans in OTLP/JSON shape (resourceSpans → scopeSpans → spans) — the
+    wire format OTel collectors ingest (reference: tracing_helper.py exports
+    through opentelemetry SDK; here the structure is emitted directly so no
+    SDK dependency is needed)."""
+    def ns(ts: float) -> str:
+        return str(int(ts * 1e9))
+
+    otel_spans = []
+    for s in spans():
+        otel_spans.append({
+            "traceId": s.trace_id,
+            "spanId": s.span_id,
+            "parentSpanId": s.parent_id or "",
+            "name": s.name,
+            "kind": {"client": 3, "worker": 2,
+                     "internal": 1}.get(s.kind, 1),
+            "startTimeUnixNano": ns(s.start_ts),
+            "endTimeUnixNano": ns(s.end_ts),
+            "status": {"code": 1 if s.status == "OK" else 2,
+                       "message": s.status},
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in s.attributes.items()
+            ],
+        })
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": "ray_tpu"}}]},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.tracing"},
+                "spans": otel_spans,
+            }],
+        }]
+    }
+
+
+def save_otlp(path: str) -> str:
+    import json
+
+    with open(path, "w") as f:
+        json.dump(export_otlp(), f)
+    return path
+
+
+@contextlib.contextmanager
+def profile(logdir: str):
+    """XLA profiler capture around a block: writes an xplane trace viewable
+    in TensorBoard/XProf alongside a framework span (reference: SURVEY §5 —
+    hooks to dump jax.profiler traces into the same timeline channel)."""
+    import jax
+
+    with span("jax.profile", attributes={"logdir": logdir}):
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()

@@ -229,3 +229,124 @@ def test_map_groups(rt):
         by_k.setdefault(r["k"], []).append(r["v"])
     for vs in by_k.values():
         assert np.mean(vs) == pytest.approx(0.0, abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# joins / prefetch / batch llm (reference: join.py, iter_torch_batches,
+# ray.data.llm batch inference)
+# ---------------------------------------------------------------------------
+
+def test_inner_join(rt_start):
+    import ray_tpu.data as rd
+
+    left = rd.from_items([{"id": i, "a": i * 10} for i in range(8)])
+    right = rd.from_items([{"id": i, "b": i * 100} for i in range(4, 12)])
+    out = sorted(left.join(right, on="id").take_all(),
+                 key=lambda r: r["id"])
+    assert [r["id"] for r in out] == [4, 5, 6, 7]
+    assert all(r["b"] == r["id"] * 100 and r["a"] == r["id"] * 10
+               for r in out)
+
+
+def test_left_join_fills_misses(rt_start):
+    import numpy as np
+
+    import ray_tpu.data as rd
+
+    left = rd.from_items([{"id": i, "a": i} for i in range(4)])
+    right = rd.from_items([{"id": 1, "b": 11.0}, {"id": 3, "b": 33.0}])
+    out = sorted(left.join(right, on="id", how="left").take_all(),
+                 key=lambda r: r["id"])
+    assert [r["id"] for r in out] == [0, 1, 2, 3]
+    assert out[1]["b"] == 11.0 and out[3]["b"] == 33.0
+    assert np.isnan(out[0]["b"]) and np.isnan(out[2]["b"])
+
+
+def test_join_column_collision_suffix(rt_start):
+    import ray_tpu.data as rd
+
+    left = rd.from_items([{"id": 1, "v": "L"}])
+    right = rd.from_items([{"id": 1, "v": "R"}])
+    row = left.join(right, on="id").take_all()[0]
+    assert row["v"] == "L" and row["v_r"] == "R"
+
+
+def test_iter_jax_batches_prefetch(rt_start):
+    import jax
+
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"x": float(i)} for i in range(64)])
+    seen = 0
+    for batch in ds.iter_jax_batches(batch_size=16, prefetch=2):
+        assert isinstance(batch["x"], jax.Array)
+        seen += batch["x"].shape[0]
+    assert seen == 64
+
+
+def test_batch_llm_inference(rt_start):
+    import ray_tpu.data as rd
+    from ray_tpu.data.llm import ProcessorConfig, build_llm_processor
+    from ray_tpu.llm import LLMConfig
+
+    processor = build_llm_processor(
+        LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64),
+        config=ProcessorConfig(batch_size=4, concurrency=1,
+                               sampling={"max_tokens": 3,
+                                         "temperature": 0.0}))
+    ds = rd.from_items([{"prompt": f"say {i}"} for i in range(6)])
+    rows = processor(ds).take_all()
+    assert len(rows) == 6
+    assert all(isinstance(r["generated_text"], str) for r in rows)
+    assert all(r["num_generated_tokens"] >= 1 for r in rows)
+
+
+def test_stable_hash_is_process_independent(rt_start):
+    """Partition hashing must agree across worker processes (Python hash()
+    is SipHash-salted per interpreter)."""
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from ray_tpu.data.shuffle import _stable_hash
+
+    here = _stable_hash(np.arange(16)).tolist()
+    code = (
+        "import numpy as np, json, sys\n"
+        "sys.path.insert(0, '/root/repo')\n"
+        "from ray_tpu.data.shuffle import _stable_hash\n"
+        "print(json.dumps(_stable_hash(np.arange(16)).tolist()))\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env={"PYTHONHASHSEED": "random",
+                                         "PATH": "/usr/bin:/bin",
+                                         "JAX_PLATFORMS": "cpu"})
+    import json as _json
+
+    assert _json.loads(out.stdout) == here
+    # strings too
+    s_here = _stable_hash(np.asarray(["a", "bb", "ccc"], object)).tolist()
+    assert s_here == _stable_hash(np.asarray(["a", "bb", "ccc"],
+                                             object)).tolist()
+
+
+def test_device_prefetch_early_break_releases_producer(rt_start):
+    import threading
+    import time
+
+    import ray_tpu.data as rd
+
+    before = {t.name for t in threading.enumerate()}
+    ds = rd.from_items([{"x": float(i)} for i in range(512)])
+    for batch in ds.iter_jax_batches(batch_size=8, prefetch=2):
+        break  # abandon early
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "data-device-prefetch" and t.is_alive()]
+        if not alive:
+            break
+        time.sleep(0.1)
+    assert not [t for t in threading.enumerate()
+                if t.name == "data-device-prefetch" and t.is_alive()]

@@ -248,3 +248,91 @@ class TestDashboard:
                 urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=5)
         finally:
             srv.stop()
+
+
+def test_otlp_export_shape(rt_start):
+    from ray_tpu.util import tracing
+
+    tracing.clear()
+    tracing.enable_tracing()
+    try:
+        with tracing.span("outer", kind="client"):
+            with tracing.span("inner"):
+                pass
+        otlp = tracing.export_otlp()
+        spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        names = {s["name"] for s in spans}
+        assert {"outer", "inner"} <= names
+        inner = next(s for s in spans if s["name"] == "inner")
+        outer = next(s for s in spans if s["name"] == "outer")
+        assert inner["parentSpanId"] == outer["spanId"]
+        assert inner["traceId"] == outer["traceId"]
+        assert int(inner["endTimeUnixNano"]) >= int(inner["startTimeUnixNano"])
+    finally:
+        tracing.disable_tracing()
+
+
+def test_cross_process_trace_propagation(rt_start):
+    """A traced submission's context rides the TaskSpec into the executor
+    (reference: _DictPropagator through task metadata)."""
+    import ray_tpu
+    from ray_tpu import remote
+    from ray_tpu.util import tracing
+
+    tracing.clear()
+    tracing.enable_tracing()
+    try:
+        @remote
+        def traced():
+            return 1
+
+        with tracing.span("driver", kind="client"):
+            ref = traced.remote()
+        assert ray_tpu.get(ref, timeout=30) == 1
+        by_name = {s.name: s for s in tracing.spans()}
+        assert "driver" in by_name and "traced" in by_name
+        assert by_name["traced"].trace_id == by_name["driver"].trace_id
+    finally:
+        tracing.disable_tracing()
+
+
+def test_cli_status_and_list(rt_start, capsys):
+    from ray_tpu.scripts.cli import main
+
+    assert main(["status"]) == 0
+    out = capsys.readouterr().out
+    assert "Cluster resources" in out and "CPU" in out
+    assert main(["list", "nodes", "--json"]) == 0
+    import json as _json
+
+    rows = _json.loads(capsys.readouterr().out)
+    assert isinstance(rows, list)
+
+
+def test_cli_timeline(rt_start, tmp_path, capsys):
+    import ray_tpu
+    from ray_tpu import remote
+    from ray_tpu.scripts.cli import main
+
+    @remote
+    def work():
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    out = str(tmp_path / "tl.json")
+    assert main(["timeline", "--out", out]) == 0
+    import json as _json
+
+    events = _json.load(open(out))
+    assert isinstance(events, list)
+
+
+def test_usage_recording(rt_start, tmp_path, monkeypatch):
+    from ray_tpu import usage
+
+    usage.record_library_usage("train")
+    usage.record_library_usage("train")  # dedup
+    assert "library:train" in usage.recorded_features()
+    monkeypatch.setenv("RTPU_USAGE_STATS_ENABLED", "0")
+    usage.record_library_usage("secret")
+    assert "library:secret" not in usage.recorded_features()
