@@ -675,6 +675,14 @@ class ClusterRuntime:
     def _pump(self, ks: _KeyState) -> None:
         if self._shutdown:
             return
+        # A lease whose connection is already known-dead must not receive
+        # dispatches: the push would fail AFTER hitting the socket buffer
+        # (sent=True) and burn the task's retry budget for nothing.
+        for w in list(ks.workers):
+            if not w.dead and w.client._closed:
+                w.dead = True
+                ks.workers.remove(w)
+                spawn_task(self._return_dead_lease(w))
         # Dispatch queued tasks onto workers with pipeline capacity.
         while ks.queue:
             live = [w for w in ks.workers

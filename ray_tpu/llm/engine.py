@@ -25,7 +25,7 @@ import queue
 import threading
 import time
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Any, Callable
 
@@ -267,6 +267,9 @@ class GenerationRequest:
     finish_reason: str | None = None
     next_pos: int = 0  # position the next token will occupy; <0 = prefilling
     prefilled_len: int = 0  # prompt tokens already in the KV cache
+    preloaded: tuple | None = None  # (kv_k, kv_v, first_token) P/D import
+    last_slot: int = -1  # slot the request last occupied (KV export)
+    hold_slot: bool = False  # keep the slot (and its KV) after finishing
 
 
 @dataclass
@@ -343,6 +346,69 @@ class LLMEngine:
             raise RuntimeError(req.error)
         return self._result(req)
 
+    # -- prefill/decode disaggregation (reference:
+    #    serving_patterns/prefill_decode/pd_server.py + kv_transfer/ — a
+    #    prefill engine computes the prompt's KV once, ships it, and a
+    #    decode engine continues token generation from it) --
+
+    def prefill_only(self, prompt: str | list[int],
+                     sampling: SamplingParams | None = None) -> dict:
+        """Run ONLY the prompt prefill; return the KV slice + first sampled
+        token for hand-off to a decode engine."""
+        sampling = sampling or SamplingParams()
+        ids = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
+               else list(prompt))
+        ids = ids[: self.max_seq - 1]
+        req = GenerationRequest(
+            request_id=uuid.uuid4().hex[:12], prompt_ids=ids,
+            sampling=replace(sampling, max_tokens=1), hold_slot=True)
+        self._requests[req.request_id] = req
+        self._waiting.put(req)
+        self._work.set()
+        try:
+            if not req.done.wait(120):
+                raise TimeoutError("prefill timed out")
+            if req.error:
+                raise RuntimeError(req.error)
+            p = len(ids)
+            # hold_slot kept the slot reserved so no other admit overwrote
+            # the KV lines between finish and this export.
+            slot = req.last_slot
+            kv_k = np.asarray(self.cache["k"][:, slot, :, :p, :])
+            kv_v = np.asarray(self.cache["v"][:, slot, :, :p, :])
+        finally:
+            # On timeout the request may still be running: dropping
+            # hold_slot lets its eventual _finish free the slot — orphaned
+            # holds would leak slots until the engine deadlocks.
+            req.hold_slot = False
+            self.release_slot(req)
+        return {"prompt_ids": ids, "kv_k": kv_k, "kv_v": kv_v,
+                "first_token": req.out_tokens[0],
+                "finish_reason": req.finish_reason}
+
+    def release_slot(self, req: GenerationRequest) -> None:
+        for slot, r in self._slots.items():
+            if r is req:
+                self._slots[slot] = None
+        self._work.set()
+
+    def submit_prefilled(self, payload: dict,
+                         sampling: SamplingParams | None = None,
+                         stream: bool = False) -> GenerationRequest:
+        """Continue decoding from a shipped prefill (KV import)."""
+        sampling = sampling or SamplingParams()
+        req = GenerationRequest(
+            request_id=uuid.uuid4().hex[:12],
+            prompt_ids=list(payload["prompt_ids"]), sampling=sampling,
+            stream_queue=queue.Queue() if stream else None)
+        req.preloaded = (np.asarray(payload["kv_k"]),
+                         np.asarray(payload["kv_v"]),
+                         int(payload["first_token"]))
+        self._requests[req.request_id] = req
+        self._waiting.put(req)
+        self._work.set()
+        return req
+
     def generate_stream(self, prompt: str | list[int],
                         sampling: SamplingParams | None = None):
         """Yields decoded text fragments as tokens arrive."""
@@ -369,7 +435,14 @@ class LLMEngine:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            worked = self._tick()
+            try:
+                worked = self._tick()
+            except Exception:  # noqa: BLE001 - one bad request must not
+                # kill the scheduler thread (every queued request would
+                # hang to its timeout); the offending request was failed
+                # by the raising site where attributable.
+                worked = True
+                continue
             if not worked:
                 self._work.wait(timeout=0.02)
                 self._work.clear()
@@ -382,7 +455,8 @@ class LLMEngine:
         worked = self._admit()
         worked = self._prefill_step() or worked
         decoding = {s: r for s, r in self._slots.items()
-                    if r is not None and r.next_pos >= 0}
+                    if r is not None and r.next_pos >= 0
+                    and not r.done.is_set()}
         if decoding:
             self._decode(decoding)
             worked = True
@@ -399,13 +473,57 @@ class LLMEngine:
                 req = self._waiting.get_nowait()
             except queue.Empty:
                 break
+            if req.preloaded is not None:
+                try:
+                    self._admit_prefilled(req, slot)
+                except Exception as e:  # noqa: BLE001 - bad KV payload
+                    self._slots[slot] = None
+                    req.error = f"KV import failed: {e!r}"
+                    req.finish_reason = "error"
+                    if req.stream_queue is not None:
+                        req.stream_queue.put(None)
+                    req.done.set()
+                admitted = True
+                continue
             # next_pos < 0 marks "still prefilling" (prefilled_len tracks
             # progress); _finish frees by identity.
             req.next_pos = -1
             req.prefilled_len = 0
+            req.last_slot = slot
             self._slots[slot] = req
             admitted = True
         return admitted
+
+    def _admit_prefilled(self, req: GenerationRequest, slot: int) -> None:
+        """KV import: write the shipped prefill into this slot and enter
+        decode directly (reference: kv_transfer connectors on the decode
+        engine side)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        kv_k, kv_v, first_token = req.preloaded
+        want = (self.model_cfg.num_layers, self.model_cfg.num_kv_heads,
+                self.model_cfg.head_dim)
+        got = (kv_k.shape[0], kv_k.shape[1], kv_k.shape[3])
+        p = kv_k.shape[2]
+        if got != want or p > self.max_seq or kv_v.shape != kv_k.shape:
+            raise ValueError(
+                f"payload KV shape {kv_k.shape} incompatible with this "
+                f"engine (layers/kv_heads/head_dim {want}, max_seq "
+                f"{self.max_seq})")
+        self.cache["k"] = lax.dynamic_update_slice(
+            self.cache["k"],
+            jnp.asarray(kv_k, self.cache["k"].dtype)[:, None],
+            (0, slot, 0, 0, 0))
+        self.cache["v"] = lax.dynamic_update_slice(
+            self.cache["v"],
+            jnp.asarray(kv_v, self.cache["v"].dtype)[:, None],
+            (0, slot, 0, 0, 0))
+        req.preloaded = None
+        req.next_pos = p
+        req.last_slot = slot
+        self._slots[slot] = req
+        self._emit(req, first_token)
 
     def _prefill_step(self) -> bool:
         """Run ONE chunk of ONE prefilling request, rotating across slots so
@@ -497,7 +615,9 @@ class LLMEngine:
         req.finish_reason = reason
         for slot, r in self._slots.items():
             if r is req:
-                self._slots[slot] = None
+                req.last_slot = slot
+                if not req.hold_slot:
+                    self._slots[slot] = None
         if req.stream_queue is not None:
             req.stream_queue.put(None)
         self._requests.pop(req.request_id, None)

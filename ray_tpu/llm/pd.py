@@ -1,0 +1,168 @@
+"""Prefill/decode disaggregation serving pattern.
+
+Capability parity with the reference's P/D pattern (reference:
+python/ray/llm/_internal/serve/serving_patterns/prefill_decode/pd_server.py
+— a prefill deployment computes the prompt KV, a KV connector ships it, and
+a decode deployment continues generation): here the KV slice travels as a
+plain object through the handle call (the object store moves it; intra-node
+it rides the shm arena), and the decode engine imports it into a slot.
+
+Prefill replicas never decode (their slots turn over at prompt rate) and
+decode replicas never prefill (steady small-batch decode steps) — the
+latency isolation that motivates the pattern.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+from ray_tpu import serve
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import LLMEngine
+from ray_tpu.llm.serving import _sampling_from
+
+
+class PrefillServer:
+    """Computes prompt KV + the first token; no decode loop runs here."""
+
+    def __init__(self, llm_config: LLMConfig):
+        self.engine = LLMEngine(llm_config)
+
+    def prefill(self, prompt_ids: list[int], sampling_kw: dict) -> dict:
+        return self.engine.prefill_only(prompt_ids,
+                                        _sampling_from(sampling_kw))
+
+    def check_health(self) -> None:
+        if not self.engine._thread.is_alive():
+            raise RuntimeError("prefill engine died")
+
+
+class DecodeServer:
+    """Continues generation from shipped KV; never prefills."""
+
+    def __init__(self, llm_config: LLMConfig):
+        self.engine = LLMEngine(llm_config)
+
+    def decode(self, payload: dict, sampling_kw: dict) -> dict:
+        req = self.engine.submit_prefilled(
+            payload, _sampling_from(sampling_kw))
+        if not req.done.wait(300):
+            raise TimeoutError("decode timed out")
+        if req.error:
+            raise RuntimeError(req.error)
+        res = self.engine._result(req)
+        return {"token_ids": res.token_ids, "text": res.text,
+                "finish_reason": res.finish_reason}
+
+    def decode_stream(self, payload: dict, sampling_kw: dict):
+        req = self.engine.submit_prefilled(
+            payload, _sampling_from(sampling_kw), stream=True)
+        while True:
+            item = req.stream_queue.get()
+            if item is None:
+                break
+            yield self.engine.tokenizer.decode([item])
+        yield ("__finish__", req.finish_reason or "stop")
+
+    def check_health(self) -> None:
+        if not self.engine._thread.is_alive():
+            raise RuntimeError("decode engine died")
+
+
+class PDServer:
+    """OpenAI-style ingress orchestrating prefill → KV hand-off → decode."""
+
+    def __init__(self, prefill_handle, decode_handle, llm_config: LLMConfig):
+        # Bind method handles ONCE: options() creates a fresh handle whose
+        # first call builds a router + long-poll client — per-request
+        # options() would leak a polling thread per chat call.
+        self.prefill = prefill_handle.options(method_name="prefill")
+        self.decode = decode_handle.options(method_name="decode")
+        self.decode_stream_h = decode_handle.options(
+            method_name="decode_stream", stream=True)
+        from ray_tpu.llm.tokenizer import get_tokenizer
+
+        self.tokenizer = get_tokenizer(llm_config.tokenizer)
+        self._model_id = (llm_config.model
+                         if isinstance(llm_config.model, str) else "llama")
+
+    def chat(self, messages: list[dict], **kw) -> dict:
+        prompt = self.tokenizer.encode(
+            self.tokenizer.apply_chat_template(messages))
+        payload = self.prefill.remote(prompt, kw).result(timeout=300)
+        out = self.decode.remote(payload, kw).result(timeout=300)
+        # token_ids already starts with first_token (the decode engine
+        # emits the imported token as its first output).
+        toks = list(out["token_ids"])
+        text = self.tokenizer.decode(
+            [t for t in toks if t != self.tokenizer.eos_id])
+        return {
+            "id": "chatcmpl-pd",
+            "object": "chat.completion",
+            "model": self._model_id,
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant", "content": text},
+                         "finish_reason": out["finish_reason"]}],
+            "usage": {"prompt_tokens": len(prompt),
+                      "completion_tokens": len(toks)},
+        }
+
+    def chat_stream(self, messages: list[dict], **kw):
+        prompt = self.tokenizer.encode(
+            self.tokenizer.apply_chat_template(messages))
+        payload = self.prefill.remote(prompt, kw).result(timeout=300)
+        first = self.tokenizer.decode([payload["first_token"]])
+        yield ("data: " + json.dumps({
+            "object": "chat.completion.chunk",
+            "choices": [{"index": 0, "delta": {"content": first},
+                         "finish_reason": None}]}) + "\n\n")
+        gen = self.decode_stream_h.remote(payload, kw)
+        skipped_first = False
+        finish = "stop"
+        for delta in gen:
+            if isinstance(delta, (tuple, list)) and delta \
+                    and delta[0] == "__finish__":
+                finish = delta[1] or "stop"
+                continue
+            if not skipped_first:
+                skipped_first = True  # already streamed as the TTFT chunk
+                continue
+            yield ("data: " + json.dumps({
+                "object": "chat.completion.chunk",
+                "choices": [{"index": 0, "delta": {"content": delta},
+                             "finish_reason": None}]}) + "\n\n")
+        # Terminal frame carrying finish_reason — the same contract as the
+        # single-server OpenAI streaming path.
+        yield ("data: " + json.dumps({
+            "object": "chat.completion.chunk",
+            "choices": [{"index": 0, "delta": {},
+                         "finish_reason": finish}]}) + "\n\n")
+        yield "data: [DONE]\n\n"
+
+    def __call__(self, request: "serve.Request") -> Any:
+        body = request.json() or {}
+        stream = bool(body.pop("stream", False))
+        messages = body.pop("messages", [])
+        if stream:
+            return self.chat_stream(messages, **body)
+        return self.chat(messages, **body)
+
+
+def build_pd_openai_app(llm_config: LLMConfig, *,
+                        num_prefill_replicas: int = 1,
+                        num_decode_replicas: int = 1):
+    """serve.run(build_pd_openai_app(cfg), route_prefix="/", http=True)."""
+    prefill_dep = serve.deployment(
+        name="PrefillServer", num_replicas=num_prefill_replicas,
+        max_ongoing_requests=llm_config.max_num_seqs,
+        health_check_period_s=2.0)(PrefillServer)
+    decode_dep = serve.deployment(
+        name="DecodeServer", num_replicas=num_decode_replicas,
+        max_ongoing_requests=llm_config.max_num_seqs,
+        health_check_period_s=2.0)(DecodeServer)
+    pd_dep = serve.deployment(name="PDServer", num_replicas=1,
+                              max_ongoing_requests=64)(PDServer)
+    return pd_dep.bind(prefill_dep.bind(llm_config),
+                       decode_dep.bind(llm_config), llm_config)

@@ -23,6 +23,7 @@ from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.http_proxy import Request, Response
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment", "Deployment", "Application",
@@ -31,6 +32,7 @@ __all__ = [
     "DeploymentHandle", "DeploymentResponse",
     "AutoscalingConfig", "DeploymentConfig",
     "batch", "Request", "Response",
+    "multiplexed", "get_multiplexed_model_id",
 ]
 
 # usage telemetry (local-only, opt-out — reference: usage_lib auto-records

@@ -85,6 +85,8 @@ class DeploymentHandle:
         self.app_name = app_name
         self._method_name = method_name
         self._stream = False
+        self._mux_id: str | None = None
+        self._route_hint: str | None = None
         self._lock = threading.Lock()
         self._router: Router | None = None
         self._poll: LongPollClient | None = None
@@ -92,10 +94,20 @@ class DeploymentHandle:
     # -- composition --
 
     def options(self, method_name: str | None = None,
-                stream: bool | None = None) -> "DeploymentHandle":
+                stream: bool | None = None,
+                multiplexed_model_id: str | None = None,
+                route_hint: str | None = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self.app_name,
                              method_name or self._method_name)
         h._stream = self._stream if stream is None else stream
+        # multiplexed_model_id routes to the replica holding the model AND
+        # is readable replica-side via serve.get_multiplexed_model_id()
+        # (reference: handle.options(multiplexed_model_id=...)). route_hint
+        # is the bare affinity key (reference: prefix-aware routing).
+        h._mux_id = multiplexed_model_id \
+            if multiplexed_model_id is not None else self._mux_id
+        h._route_hint = route_hint if route_hint is not None \
+            else self._route_hint
         return h
 
     def __getattr__(self, name: str):
@@ -112,11 +124,16 @@ class DeploymentHandle:
                      else a for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
                       else v) for k, v in kwargs.items()}
+        hint = self._route_hint or self._mux_id
+        if self._mux_id:
+            kwargs["__rtpu_mux_id"] = self._mux_id  # replica context
         if self._stream:
             gen, on_done = router.assign_request(self._method_name, args,
-                                                 kwargs, stream=True)
+                                                 kwargs, stream=True,
+                                                 route_hint=hint)
             return DeploymentResponseGenerator(gen, on_done=on_done)
-        ref = router.assign_request(self._method_name, args, kwargs)
+        ref = router.assign_request(self._method_name, args, kwargs,
+                                    route_hint=hint)
         return DeploymentResponse(ref)
 
     def _ensure_router(self) -> Router:

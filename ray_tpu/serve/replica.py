@@ -40,6 +40,10 @@ class ServeReplica:
     # -- data plane --
 
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
+        mux_id = kwargs.pop("__rtpu_mux_id", "")
+        _set_multiplexed_model_id(mux_id)
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -66,6 +70,9 @@ class ServeReplica:
         replica.py streaming call path + proxy_request streaming)."""
         import inspect
 
+        from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
+        _set_multiplexed_model_id(kwargs.pop("__rtpu_mux_id", ""))
         with self._lock:
             self._ongoing += 1
             self._total += 1

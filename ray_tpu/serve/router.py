@@ -28,17 +28,24 @@ class Router:
         self._rng = random.Random()
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       timeout: float = 30.0, stream: bool = False):
+                       timeout: float = 30.0, stream: bool = False,
+                       route_hint: str | None = None):
         """Pick a replica (pow-2 on local in-flight counts), submit, and
         return the result ObjectRef. Blocks while every replica is at
-        max_ongoing_requests (router-side queuing, reference behavior)."""
+        max_ongoing_requests (router-side queuing, reference behavior).
+
+        ``route_hint`` biases placement for cache locality: the same hint
+        routes to the same replica while it has capacity (reference:
+        multiplexed-model routing, request_router/multiplex + the
+        prefix-aware policy in llm routing_policies/prefix_aware — both are
+        affinity-by-key over the replica set)."""
         import time as _time
 
         deadline = _time.monotonic() + timeout
         while True:
             replicas = self._get_replicas()
             if replicas:
-                chosen = self._choose(replicas)
+                chosen = self._choose(replicas, route_hint=route_hint)
                 if chosen is not None:
                     break
             if _time.monotonic() > deadline:
@@ -78,8 +85,25 @@ class Router:
         threading.Thread(target=_done, daemon=True).start()
         return ref
 
-    def _choose(self, replicas: list[ReplicaInfo]) -> ReplicaInfo | None:
+    def _choose(self, replicas: list[ReplicaInfo],
+                route_hint: str | None = None) -> ReplicaInfo | None:
         with self._lock:
+            if route_hint is not None:
+                # Rendezvous hashing: every router maps the same hint to the
+                # same replica without coordination; saturation falls back
+                # to load-based choice (losing only cache locality).
+                import zlib
+
+                ranked = sorted(
+                    replicas,
+                    key=lambda r: zlib.crc32(
+                        f"{route_hint}:{r.replica_id}".encode()),
+                )
+                for r in ranked:
+                    if self._inflight.get(r.replica_id, 0) < \
+                            r.max_ongoing_requests:
+                        return r
+                return None
             candidates = (self._rng.sample(replicas, 2)
                           if len(replicas) >= 2 else list(replicas))
             best, best_load = None, None

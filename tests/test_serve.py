@@ -310,3 +310,76 @@ def test_http_sse_streaming():
     # Incremental delivery: the first chunk must arrive well before the
     # ~0.2s it takes to produce all four.
     assert first_at is not None and first_at < 0.15
+
+
+def test_model_multiplexing():
+    """Many models share a replica pool: per-replica LRU + model-affinity
+    routing (reference: serve.multiplexed / multiplexed_model_id)."""
+    @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+    class MuxServer:
+        def __init__(self):
+            self.load_counts = {}
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.load_counts[model_id] = \
+                self.load_counts.get(model_id, 0) + 1
+            return {"id": model_id, "weights": model_id.upper()}
+
+        def predict(self, x):
+            model = self.get_model()
+            return f"{model['weights']}:{x}"
+
+        def loads(self):
+            return dict(self.load_counts)
+
+    h = serve.run(MuxServer.bind())
+    h1 = h.options(method_name="predict", multiplexed_model_id="m1")
+    h2 = h.options(method_name="predict", multiplexed_model_id="m2")
+    assert h1.remote("a").result() == "M1:a"
+    assert h2.remote("b").result() == "M2:b"
+    # repeat calls reuse the cached model (affinity => same replica)
+    for _ in range(4):
+        assert h1.remote("c").result() == "M1:c"
+    counts = h.options(method_name="loads",
+                       multiplexed_model_id="m1").remote().result()
+    assert counts.get("m1") == 1  # loaded exactly once on its home replica
+
+
+def test_multiplex_lru_eviction():
+    @serve.deployment(num_replicas=1)
+    class Evicting:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return model_id
+
+        def which(self):
+            from ray_tpu.serve.multiplex import get_multiplexed_model_id
+
+            self.get_model()
+            return get_multiplexed_model_id()
+
+    h = serve.run(Evicting.bind())
+    for mid in ("a", "b", "c", "a"):  # c evicts a; reloading a evicts b
+        got = h.options(method_name="which",
+                        multiplexed_model_id=mid).remote().result()
+        assert got == mid
+
+
+def test_route_hint_affinity():
+    """The same route hint lands on the same replica while it has capacity
+    (reference: prefix-aware routing policy shape)."""
+    @serve.deployment(num_replicas=3, max_ongoing_requests=8)
+    class Who:
+        def __init__(self):
+            import os
+
+            self.pid_tag = f"{os.getpid()}-{id(self)}"
+
+        def __call__(self, _req=None):
+            return self.pid_tag
+
+    h = serve.run(Who.bind())
+    tags = {h.options(route_hint="prefix-xyz").remote().result()
+            for _ in range(6)}
+    assert len(tags) == 1  # all six routed to one replica
