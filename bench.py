@@ -82,6 +82,7 @@ def main() -> None:
     mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
 
     last_err = None
+    state = step_fn = None
     for batch, remat, attn in candidates:
             try:
                 opt = optax.adamw(3e-4, weight_decay=0.1,
@@ -113,6 +114,15 @@ def main() -> None:
                 return
             except Exception as e:  # noqa: BLE001 - OOM/compile fallback chain
                 last_err = e
+                print(f"candidate {(batch, remat, attn)} failed: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+                # Drop every live buffer from the failed candidate before the
+                # next one allocates — otherwise a single OOM leaks ~9 GB of
+                # params/optimizer state and cascades down the whole chain.
+                state = step_fn = None
+                for buf in jax.live_arrays():
+                    buf.delete()
+                jax.clear_caches()
                 continue
     print(json.dumps({
         "metric": metric, "value": 0.0, "unit": "tokens/sec/chip",

@@ -179,13 +179,18 @@ def _layer(cfg: LlamaConfig, x, layer_params, inv_freq, positions,
 
 def _remat_wrap(layer_fn, remat):
     """remat policy: True/'full' = recompute everything (min memory),
-    'dots' = save matmul outputs (jax.checkpoint_policies.checkpoint_dots —
-    ~no recompute FLOPs at moderate memory), False/'none' = save all."""
+    'dots' = save matmul outputs (jax.checkpoint_policies.checkpoint_dots)
+    plus the flash-attention residuals (out, lse) — so the backward pass
+    neither recomputes the matmuls nor re-runs the attention kernel,
+    False/'none' = save all."""
     if remat in (False, "none"):
         return layer_fn
     if remat == "dots":
-        return jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.checkpoint_dots)
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.checkpoint_dots,
+            jax.checkpoint_policies.save_only_these_names("flash_resid"),
+        )
+        return jax.checkpoint(layer_fn, policy=policy)
     return jax.checkpoint(layer_fn)
 
 

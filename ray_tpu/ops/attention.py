@@ -25,6 +25,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 NEG_INF = -1e30
 
@@ -132,7 +133,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_seq_len: int,
     from jax.experimental import pallas as pl  # local: TPU-only dependency
 
     qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * sm_scale  # [block_q, d]
+    q = q_ref[...]  # [block_q, d] — keep bf16: the MXU runs bf16×bf16 with
+    # f32 accumulation at full rate; casting inputs to f32 would fall off
+    # the fast path (~6x slower). Scale is applied to the f32 logits.
 
     nkv = kv_seq_len // block_k
 
@@ -140,8 +143,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_seq_len: int,
         o, m, l = carry
         k = k_ref[pl.ds(j * block_k, block_k), :]
         v = v_ref[pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k.T.astype(jnp.float32),
-                    preferred_element_type=jnp.float32)  # [bq, bk]
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
         if causal:
             qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -169,24 +172,27 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_seq_len: int,
     o, m, l = lax.fori_loop(0, upper, body, (o0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = m + jnp.log(l)
+    lse_ref[0, :] = m + jnp.log(l)
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
                       block_q: int = 512, block_k: int = 512):
+    """GQA-native: k/v stay [B, Hkv, S, D]; the BlockSpec index maps send
+    query head i to kv head i // (H/Hkv) — no materialized repeat."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
-    skv = k.shape[2]
+    hkv, skv = k.shape[1], k.shape[2]
+    rep = h // hkv
     block_q = min(block_q, sq)
     block_k = min(block_k, skv)
     assert sq % block_q == 0 and skv % block_k == 0, (
         "flash_attention requires seq lengths divisible by block sizes"
     )
     qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, skv, d)
-    vf = v.reshape(b * h, skv, d)
+    kf = k.reshape(b * hkv, skv, d)
+    vf = v.reshape(b * hkv, skv, d)
 
     kernel = functools.partial(
         _flash_fwd_kernel, kv_seq_len=skv, block_k=block_k,
@@ -197,16 +203,20 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
         grid=(b * h, sq // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // rep, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // rep, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            # lse rows live as [bh, 1, sq]: a (1, block_q) block keeps the
+            # sublane dim equal to the array dim (TPU tiling requires the
+            # last two block dims be (8k, 128k) or match the array), without
+            # the official kernel's 128-lane broadcast copy of every row.
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
@@ -214,6 +224,11 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
         interpret=INTERPRET,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _rows_3d(x, bh, s):
+    """[B, H, S] row-statistics → the [B*H, 1, S] kernel layout."""
+    return x.reshape(bh, 1, s)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -226,8 +241,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     q = q_ref[...]                       # [bq, d] bf16
     do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...]                   # [bq] f32
-    delta = delta_ref[...]               # [bq] f32
+    lse = lse_ref[0, :]                  # [bq] f32
+    delta = delta_ref[0, :]              # [bq] f32
     nkv = kv_seq_len // block_k
 
     def body(j, dq):
@@ -272,8 +287,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[pl.ds(i * block_q, block_q), :]
         do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[pl.ds(i * block_q, block_q)]
-        delta = delta_ref[pl.ds(i * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             qpos = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -299,22 +314,24 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, sm_scale: float,
                       block_q: int = 512, block_k: int = 512):
-    """q/k/v here are already GQA-expanded to [B, H, S, D]."""
+    """GQA-native like the forward: k/v stay [B, Hkv, S, D]; dk/dv come back
+    per *query* head [B, H, S, D] (caller folds the group dimension)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
-    skv = k.shape[2]
+    hkv, skv = k.shape[1], k.shape[2]
+    rep = h // hkv
     block_q = min(block_q, sq)
     block_k = min(block_k, skv)
     qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, skv, d)
-    vf = v.reshape(b * h, skv, d)
+    kf = k.reshape(b * hkv, skv, d)
+    vf = v.reshape(b * hkv, skv, d)
     dof = g.reshape(b * h, sq, d).astype(q.dtype)
-    lsef = lse.reshape(b * h, sq)
+    lsef = _rows_3d(lse, b * h, sq)
     # Δ_i = rowsum(dO ∘ O): the softmax-normalization term of ds.
     delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
-    deltaf = delta.reshape(b * h, sq)
+    deltaf = _rows_3d(delta, b * h, sq)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, kv_seq_len=skv,
@@ -323,11 +340,11 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, sm_scale: float,
         grid=(b * h, sq // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // rep, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // rep, 0, 0)),
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -344,11 +361,11 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, sm_scale: float,
         grid=(b * h, skv // block_k),
         in_specs=[
             pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i // rep, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i // rep, j, 0)),
             pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
-            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((None, 1, sq), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, sq), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
@@ -380,13 +397,16 @@ def flash_attention(q, k, v, causal: bool = True,
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, use_pallas):
-    h = q.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     on_tpu = jax.default_backend() == "tpu"
     if use_pallas and (on_tpu or INTERPRET):
-        kr, vr = _repeat_kv(k, h), _repeat_kv(v, h)
-        out, lse = _flash_fwd_pallas(q, kr, vr, causal, scale)
+        out, lse = _flash_fwd_pallas(q, k, v, causal, scale)
         out = out.astype(q.dtype)
+        # Under jax.checkpoint, a policy that saves 'flash_resid' keeps these
+        # residuals across the remat boundary so the backward pass does NOT
+        # re-run the forward kernel (see models/llama.py _remat_wrap 'dots').
+        out = checkpoint_name(out, "flash_resid")
+        lse = checkpoint_name(lse, "flash_resid")
         return out, (q, k, v, out, lse)
     out = blockwise_attention(q, k, v, causal=causal, sm_scale=scale)
     return out, (q, k, v, None, None)
@@ -397,8 +417,7 @@ def _flash_bwd(causal, sm_scale, use_pallas, res, g):
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if lse is not None:
         h, hkv = q.shape[1], k.shape[1]
-        kr, vr = _repeat_kv(k, h), _repeat_kv(v, h)
-        dq, dk, dv = _flash_bwd_pallas(q, kr, vr, out, lse, g, causal, scale)
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale)
         if hkv != h:  # GQA: fold the repeated query-head groups back
             b, _, skv, d = dk.shape
             rep = h // hkv
