@@ -21,6 +21,7 @@ wrapper:
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -39,6 +40,8 @@ from ray_tpu.llm.tokenizer import get_tokenizer
 from ray_tpu.models.llama import LlamaConfig, init_params
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+logger = logging.getLogger(__name__)
 
 NEG_INF = -1e30
 
@@ -439,10 +442,11 @@ class LLMEngine:
                 worked = self._tick()
             except Exception:  # noqa: BLE001 - one bad request must not
                 # kill the scheduler thread (every queued request would
-                # hang to its timeout); the offending request was failed
-                # by the raising site where attributable.
-                worked = True
-                continue
+                # hang to its timeout). _prefill_step/_decode fail the
+                # offending requests where attributable; anything that
+                # still escapes is logged and backed off, never hot-spun.
+                logger.exception("LLMEngine scheduler tick failed")
+                worked = False
             if not worked:
                 self._work.wait(timeout=0.02)
                 self._work.clear()
@@ -478,11 +482,7 @@ class LLMEngine:
                     self._admit_prefilled(req, slot)
                 except Exception as e:  # noqa: BLE001 - bad KV payload
                     self._slots[slot] = None
-                    req.error = f"KV import failed: {e!r}"
-                    req.finish_reason = "error"
-                    if req.stream_queue is not None:
-                        req.stream_queue.put(None)
-                    req.done.set()
+                    self._fail(req, f"KV import failed: {e!r}")
                 admitted = True
                 continue
             # next_pos < 0 marks "still prefilling" (prefilled_len tracks
@@ -551,16 +551,34 @@ class LLMEngine:
             take = min(remaining, bucket)
             toks[:take] = req.prompt_ids[req.prefilled_len:
                                          req.prefilled_len + take]
-            self.cache, logits = prefill_chunk(
-                self.model_cfg, self.params, self.cache, jnp.asarray(toks),
-                jnp.int32(req.prefilled_len), jnp.int32(p), jnp.int32(slot))
-            req.prefilled_len += take
-            if req.prefilled_len >= p:  # final chunk: sample first token
-                tok = self._sample_one(logits[None], [req])[0]
-                req.next_pos = p
-                self._emit(req, int(tok))
+            try:
+                self.cache, logits = prefill_chunk(
+                    self.model_cfg, self.params, self.cache,
+                    jnp.asarray(toks), jnp.int32(req.prefilled_len),
+                    jnp.int32(p), jnp.int32(slot))
+                req.prefilled_len += take
+                if req.prefilled_len >= p:  # final chunk: sample 1st token
+                    tok = self._sample_one(logits[None], [req])[0]
+                    req.next_pos = p
+                    self._emit(req, int(tok))
+            except Exception as e:  # noqa: BLE001 - e.g. OOM on long prompt
+                logger.exception("prefill failed for %s", req.request_id)
+                self._recover_device_failure(f"prefill failed: {e!r}")
             return True
         return False
+
+    def _recover_device_failure(self, err: str) -> None:
+        """After a failed prefill/decode dispatch the KV cache is gone —
+        prefill_chunk/decode_step donate it (donate_argnums=(2,)), so its
+        buffers were consumed by the very call that raised. Every slotted
+        request's context lived there: fail them all, then rebuild a fresh
+        cache so the engine keeps serving NEW traffic."""
+        for req in list(self._slots.values()):
+            if req is not None:
+                self._fail(req, err)
+        self._slots = {i: None for i in range(self.max_slots)}
+        self.cache = init_kv_cache(self.model_cfg, self.max_slots,
+                                   self.max_seq)
 
     def _decode(self, active: dict[int, GenerationRequest]) -> None:
         tokens = np.zeros((self.max_slots,), np.int32)
@@ -570,11 +588,17 @@ class LLMEngine:
             tokens[slot] = req.out_tokens[-1]
             positions[slot] = req.next_pos
             write[slot] = True
-        self.cache, logits = decode_step(
-            self.model_cfg, self.params, self.cache,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(write))
-        reqs = [active.get(s) for s in range(self.max_slots)]
-        sampled = self._sample_one(logits, reqs)
+        try:
+            self.cache, logits = decode_step(
+                self.model_cfg, self.params, self.cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(write))
+            reqs = [active.get(s) for s in range(self.max_slots)]
+            sampled = self._sample_one(logits, reqs)
+        except Exception as e:  # noqa: BLE001 - cache donated & lost
+            logger.exception("decode step failed (%d active)", len(active))
+            self._recover_device_failure(f"decode failed: {e!r}")
+            return
         for slot, req in active.items():
             req.next_pos += 1
             self._emit(req, int(sampled[slot]))
@@ -610,6 +634,14 @@ class LLMEngine:
             finish = "length"
         if finish:
             self._finish(req, finish)
+
+    def _fail(self, req: GenerationRequest, err: str) -> None:
+        """Fail one request: record the error, free its slot and any staged
+        KV payload, and wake its waiter — the engine keeps serving others."""
+        req.error = err
+        req.preloaded = None
+        req.hold_slot = False  # never pin a slot for a failed request
+        self._finish(req, "error")
 
     def _finish(self, req: GenerationRequest, reason: str) -> None:
         req.finish_reason = reason

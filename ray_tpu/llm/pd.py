@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import time
+import uuid
 from typing import Any
 
 from ray_tpu import serve
@@ -94,19 +95,20 @@ class PDServer:
         payload = self.prefill.remote(prompt, kw).result(timeout=300)
         out = self.decode.remote(payload, kw).result(timeout=300)
         # token_ids already starts with first_token (the decode engine
-        # emits the imported token as its first output).
+        # emits the imported token as its first output) and the engine
+        # already stripped/decoded eos — out["text"] is authoritative.
         toks = list(out["token_ids"])
-        text = self.tokenizer.decode(
-            [t for t in toks if t != self.tokenizer.eos_id])
         return {
-            "id": "chatcmpl-pd",
+            "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
             "model": self._model_id,
             "choices": [{"index": 0,
-                         "message": {"role": "assistant", "content": text},
+                         "message": {"role": "assistant",
+                                     "content": out["text"]},
                          "finish_reason": out["finish_reason"]}],
             "usage": {"prompt_tokens": len(prompt),
-                      "completion_tokens": len(toks)},
+                      "completion_tokens": len(toks),
+                      "total_tokens": len(prompt) + len(toks)},
         }
 
     def chat_stream(self, messages: list[dict], **kw):
@@ -114,8 +116,12 @@ class PDServer:
             self.tokenizer.apply_chat_template(messages))
         payload = self.prefill.remote(prompt, kw).result(timeout=300)
         first = self.tokenizer.decode([payload["first_token"]])
+        rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+        # Frames carry per-request id/model like the single-server OpenAI
+        # path (serving.py chat_stream) so strict SDK clients parse both.
         yield ("data: " + json.dumps({
-            "object": "chat.completion.chunk",
+            "id": rid, "object": "chat.completion.chunk",
+            "model": self._model_id,
             "choices": [{"index": 0, "delta": {"content": first},
                          "finish_reason": None}]}) + "\n\n")
         gen = self.decode_stream_h.remote(payload, kw)
@@ -130,13 +136,15 @@ class PDServer:
                 skipped_first = True  # already streamed as the TTFT chunk
                 continue
             yield ("data: " + json.dumps({
-                "object": "chat.completion.chunk",
+                "id": rid, "object": "chat.completion.chunk",
+                "model": self._model_id,
                 "choices": [{"index": 0, "delta": {"content": delta},
                              "finish_reason": None}]}) + "\n\n")
         # Terminal frame carrying finish_reason — the same contract as the
         # single-server OpenAI streaming path.
         yield ("data: " + json.dumps({
-            "object": "chat.completion.chunk",
+            "id": rid, "object": "chat.completion.chunk",
+            "model": self._model_id,
             "choices": [{"index": 0, "delta": {},
                          "finish_reason": finish}]}) + "\n\n")
         yield "data: [DONE]\n\n"

@@ -291,6 +291,62 @@ def test_prefill_decode_kv_handoff(tiny):
         dec.shutdown()
 
 
+def test_engine_bad_kv_payload_fails_cleanly():
+    """A decode engine receiving an incompatible KV payload must fail that
+    request (error surfaced, waiter woken) without leaking it in _requests
+    or wedging the scheduler (engine.py _admit / _fail)."""
+    eng = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64))
+    try:
+        bad = {
+            "prompt_ids": [1, 2, 3],
+            "first_token": 5,
+            # wrong layer count -> shape validation failure on import
+            "kv_k": np.zeros((99, 1, 3, 4), np.float32),
+            "kv_v": np.zeros((99, 1, 3, 4), np.float32),
+        }
+        req = eng.submit_prefilled(bad, SamplingParams(max_tokens=4))
+        assert req.done.wait(60)
+        assert req.error and "KV import failed" in req.error
+        assert req.finish_reason == "error"
+        assert req.request_id not in eng._requests
+        assert req.preloaded is None  # staged payload released
+        # engine still serves normal traffic afterwards
+        res = eng.generate([1, 2, 3], SamplingParams(max_tokens=3,
+                                                     temperature=0.0))
+        assert len(res.token_ids) > 0
+    finally:
+        eng.shutdown()
+
+
+def test_engine_recovers_from_device_failure(monkeypatch):
+    """decode_step donates the KV cache, so a device-side failure kills the
+    cache with it. The engine must fail in-flight requests AND rebuild the
+    cache so new traffic still works (engine.py _recover_device_failure)."""
+    import ray_tpu.llm.engine as eng_mod
+
+    eng = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64))
+    real_decode = eng_mod.decode_step
+    boom = {"n": 0}
+
+    def flaky_decode(*a, **kw):
+        if boom["n"] == 0:
+            boom["n"] += 1
+            raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
+        return real_decode(*a, **kw)
+
+    try:
+        monkeypatch.setattr(eng_mod, "decode_step", flaky_decode)
+        req = eng.submit([1, 2, 3], SamplingParams(max_tokens=4))
+        assert req.done.wait(60)
+        assert req.error and "decode failed" in req.error
+        # fresh cache, fresh request: engine serves normally again
+        res = eng.generate([1, 2, 3], SamplingParams(max_tokens=3,
+                                                     temperature=0.0))
+        assert len(res.token_ids) > 0 and boom["n"] == 1
+    finally:
+        eng.shutdown()
+
+
 def test_pd_serving_app():
     """Full P/D app through serve: prefill replica -> KV object -> decode
     replica -> ingress answer matches the single-server app (greedy)."""
@@ -327,6 +383,9 @@ def test_pd_serving_app():
         serve.run(build_pd_openai_app(cfg), route_prefix="/", http=True)
         pd_answer = ask(serve.http_port())
         assert pd_answer["choices"][0]["message"]["content"] == baseline
+        # usage parity with the single-server OpenAI path
+        u = pd_answer["usage"]
+        assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
         # streaming through the P/D path too
         sreq = urllib.request.Request(
             f"http://127.0.0.1:{serve.http_port()}/v1/chat/completions",
@@ -337,6 +396,12 @@ def test_pd_serving_app():
         with urllib.request.urlopen(sreq, timeout=120) as r:
             text = r.read().decode()
         assert text.rstrip().endswith("data: [DONE]")
+        # every chunk frame must carry id/model (strict SDK clients require
+        # the same frame shape as the single-server path)
+        for line in text.splitlines():
+            if line.startswith("data: {"):
+                frame = _json.loads(line[len("data: "):])
+                assert frame["id"] and frame["model"]
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
