@@ -1,0 +1,27 @@
+import time, jax, jax.numpy as jnp, numpy as np
+from ray_tpu.ops import attention as A
+rng = np.random.default_rng(0)
+b,h,hkv,s,d = 4,32,8,2048,64
+q = jnp.asarray(rng.standard_normal((b,h,s,d)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((b,hkv,s,d)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((b,hkv,s,d)), jnp.bfloat16)
+g = jnp.asarray(rng.standard_normal((b,h,s,d)), jnp.bfloat16)
+
+def timeit(f, iters=30):
+    o = jax.tree.leaves(f())
+    for x in o: x.block_until_ready()
+    float(o[0].astype(jnp.float32).sum())
+    t0=time.perf_counter()
+    for _ in range(iters): o = jax.tree.leaves(f())
+    float(o[0].astype(jnp.float32).sum())
+    return (time.perf_counter()-t0)/iters
+
+for bq, bk in [(256,256),(512,512),(1024,1024),(2048,2048),(1024,512),(512,1024),(2048,1024),(1024,2048),(256,512),(512,256)]:
+    try:
+        fwd = jax.jit(lambda bq=bq, bk=bk: A._flash_fwd_pallas(q,k,v,True,0.125,block_q=bq,block_k=bk))
+        out, lse = fwd()
+        bwd = jax.jit(lambda bq=bq, bk=bk: A._flash_bwd_pallas(q,k,v,out,lse,g,True,0.125,block_q=bq,block_k=bk))
+        tf, tb = timeit(fwd), timeit(bwd)
+        print(f"bq={bq:5d} bk={bk:5d} fwd {tf*1e3:6.2f} ms  bwd {tb*1e3:6.2f} ms", flush=True)
+    except Exception as e:
+        print(f"bq={bq:5d} bk={bk:5d} FAIL {str(e)[:80]}", flush=True)

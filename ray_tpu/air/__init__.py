@@ -1,0 +1,30 @@
+"""ray_tpu.air — shared result/checkpoint/config types + integrations.
+
+Capability parity with the reference's ray.air (reference:
+python/ray/air/config.py RunConfig/ScalingConfig/FailureConfig/
+CheckpointConfig, air Result/Checkpoint shared by train+tune, and
+air/integrations/ experiment-tracker callbacks). Here the canonical
+definitions live in ray_tpu.train; air re-exports them as the shared
+surface and hosts the integrations layer.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.controller import Result
+
+from ray_tpu.air.integrations.base import Callback
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "Result",
+    "Callback",
+]

@@ -1,0 +1,15 @@
+"""Experiment-tracker integrations (reference: python/ray/air/integrations/)."""
+
+from ray_tpu.air.integrations.base import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TBXLoggerCallback,
+)
+
+__all__ = [
+    "Callback",
+    "CSVLoggerCallback",
+    "JsonLoggerCallback",
+    "TBXLoggerCallback",
+]

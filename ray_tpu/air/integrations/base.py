@@ -1,0 +1,119 @@
+"""Run callbacks: the hook surface train/tune invoke per reported result.
+
+Capability parity with the reference's logger/tracker callbacks (reference:
+python/ray/tune/logger/ JsonLoggerCallback/CSVLoggerCallback/TBXLoggerCallback
+and python/ray/air/integrations/wandb.py / mlflow.py setup helpers). The
+callbacks ship to the controller actor, so they must be picklable; file
+handles are opened lazily on first use.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import time
+from typing import Any
+
+
+class Callback:
+    """Base run callback (reference: ray.tune Callback shape, reduced to the
+    run-scoped hooks this controller emits)."""
+
+    def on_run_start(self, run_name: str, config: dict | None) -> None:
+        pass
+
+    def on_result(self, metrics: dict, iteration: int) -> None:
+        pass
+
+    def on_checkpoint(self, checkpoint_path: str, metrics: dict) -> None:
+        pass
+
+    def on_run_end(self, result: Any) -> None:
+        pass
+
+
+class _FileCallback(Callback):
+    def __init__(self, log_dir: str | None = None):
+        self.log_dir = log_dir
+        self._run_name = "run"
+
+    def on_run_start(self, run_name: str, config: dict | None) -> None:
+        self._run_name = run_name
+        if self.log_dir is None:
+            self.log_dir = f"/tmp/ray_tpu/results/{run_name}"
+        os.makedirs(self.log_dir, exist_ok=True)
+        if config:
+            with open(os.path.join(self.log_dir, "params.json"), "w") as f:
+                json.dump(config, f, default=str)
+
+
+class JsonLoggerCallback(_FileCallback):
+    """result.json: one JSON line per reported result (reference:
+    tune/logger/json.py)."""
+
+    def on_result(self, metrics: dict, iteration: int) -> None:
+        if self.log_dir is None:
+            self.on_run_start(self._run_name, None)
+        row = {"training_iteration": iteration, "timestamp": time.time(),
+               **metrics}
+        with open(os.path.join(self.log_dir, "result.json"), "a") as f:
+            f.write(json.dumps(row, default=str) + "\n")
+
+
+class CSVLoggerCallback(_FileCallback):
+    """progress.csv with a header from the first result (reference:
+    tune/logger/csv.py). Later keys not in the first row are dropped, as in
+    the reference."""
+
+    def __init__(self, log_dir: str | None = None):
+        super().__init__(log_dir)
+        self._fields: list[str] | None = None
+
+    def on_result(self, metrics: dict, iteration: int) -> None:
+        if self.log_dir is None:
+            self.on_run_start(self._run_name, None)
+        row = {"training_iteration": iteration, **metrics}
+        path = os.path.join(self.log_dir, "progress.csv")
+        new = self._fields is None
+        if new:
+            self._fields = list(row.keys())
+        with open(path, "a", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=self._fields, extrasaction="ignore")
+            if new:
+                w.writeheader()
+            w.writerow(row)
+
+
+class TBXLoggerCallback(_FileCallback):
+    """TensorBoard scalars via tensorboardX (reference: tune/logger/
+    tensorboardx.py TBXLoggerCallback)."""
+
+    def __init__(self, log_dir: str | None = None):
+        super().__init__(log_dir)
+        self._writer = None
+
+    def _w(self):
+        if self._writer is None:
+            from tensorboardX import SummaryWriter
+
+            if self.log_dir is None:
+                self.on_run_start(self._run_name, None)
+            self._writer = SummaryWriter(self.log_dir)
+        return self._writer
+
+    def on_result(self, metrics: dict, iteration: int) -> None:
+        w = self._w()
+        for key, val in metrics.items():
+            if isinstance(val, (int, float)):
+                w.add_scalar(key, val, iteration)
+        w.flush()
+
+    def on_run_end(self, result: Any) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_writer"] = None  # writers don't pickle; reopen lazily
+        return state

@@ -1,0 +1,52 @@
+"""MLflow integration (reference: python/ray/air/integrations/mlflow.py
+MLflowLoggerCallback/setup_mlflow). mlflow is not part of this image; the
+callback degrades to an informative error at construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.air.integrations.base import Callback
+
+
+def _import_mlflow():
+    try:
+        import mlflow  # noqa: F401
+        return mlflow
+    except ImportError as e:
+        raise ImportError(
+            "mlflow is not installed in this environment; use "
+            "JsonLoggerCallback/CSVLoggerCallback/TBXLoggerCallback, or "
+            "install mlflow where permitted.") from e
+
+
+class MLflowLoggerCallback(Callback):
+    def __init__(self, experiment_name: str | None = None,
+                 tracking_uri: str | None = None, **kw):
+        self._mlflow = _import_mlflow()
+        self.experiment_name, self.tracking_uri, self.kw = (
+            experiment_name, tracking_uri, kw)
+
+    def on_run_start(self, run_name: str, config: dict | None) -> None:
+        if self.tracking_uri:
+            self._mlflow.set_tracking_uri(self.tracking_uri)
+        if self.experiment_name:
+            self._mlflow.set_experiment(self.experiment_name)
+        self._mlflow.start_run(run_name=run_name)
+        if config:
+            self._mlflow.log_params(
+                {k: str(v)[:250] for k, v in config.items()})
+
+    def on_result(self, metrics: dict, iteration: int) -> None:
+        self._mlflow.log_metrics(
+            {k: v for k, v in metrics.items() if isinstance(v, (int, float))},
+            step=iteration)
+
+    def on_run_end(self, result: Any) -> None:
+        self._mlflow.end_run()
+
+
+def setup_mlflow(config: dict | None = None, **kw):
+    """Per-worker setup inside a train loop (reference: setup_mlflow)."""
+    return _import_mlflow()

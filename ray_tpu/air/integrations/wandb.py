@@ -1,0 +1,47 @@
+"""Weights & Biases integration (reference: python/ray/air/integrations/
+wandb.py WandbLoggerCallback/setup_wandb). wandb is not part of this image;
+the callback degrades to an informative error at construction so a run
+config referencing it fails fast rather than mid-run.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.air.integrations.base import Callback
+
+
+def _import_wandb():
+    try:
+        import wandb  # noqa: F401
+        return wandb
+    except ImportError as e:
+        raise ImportError(
+            "wandb is not installed in this environment; use "
+            "JsonLoggerCallback/CSVLoggerCallback/TBXLoggerCallback, or "
+            "install wandb where permitted.") from e
+
+
+class WandbLoggerCallback(Callback):
+    def __init__(self, project: str, name: str | None = None, **init_kw):
+        self._wandb = _import_wandb()
+        self.project, self.name, self.init_kw = project, name, init_kw
+        self._run = None
+
+    def on_run_start(self, run_name: str, config: dict | None) -> None:
+        self._run = self._wandb.init(
+            project=self.project, name=self.name or run_name,
+            config=config, **self.init_kw)
+
+    def on_result(self, metrics: dict, iteration: int) -> None:
+        if self._run is not None:
+            self._run.log(metrics, step=iteration)
+
+    def on_run_end(self, result: Any) -> None:
+        if self._run is not None:
+            self._run.finish()
+
+
+def setup_wandb(config: dict | None = None, **kw):
+    """Per-worker setup inside a train loop (reference: setup_wandb)."""
+    return _import_wandb().init(config=config, **kw)

@@ -44,7 +44,10 @@ class DashboardServer:
                     self.end_headers()
                     self.wfile.write(json.dumps({"error": repr(e)}).encode())
                     return
-                if isinstance(result, (bytes, str)):
+                if isinstance(result, tuple) and len(result) == 2:
+                    payload, ctype = result  # (bytes|str, content-type)
+                    payload = payload.encode() if isinstance(payload, str) else payload
+                elif isinstance(result, (bytes, str)):
                     payload = result.encode() if isinstance(result, str) else result
                     ctype = "text/plain; version=0.0.4"
                 else:
@@ -92,6 +95,10 @@ class DashboardServer:
 
             return handler
 
+        from ray_tpu.dashboard.ui import INDEX_HTML
+
+        self.add_route("GET", "/",
+                       lambda p, b: (INDEX_HTML, "text/html; charset=utf-8"))
         self.add_route("GET", "/api/version", lambda p, b: {"version": __version__})
         self.add_route("GET", "/api/nodes", listing(state_api.list_nodes))
         self.add_route("GET", "/api/actors", listing(state_api.list_actors))

@@ -312,6 +312,7 @@ class LLMEngine:
 
         self._slots: dict[int, GenerationRequest | None] = {
             i: None for i in range(self.max_slots)}
+        self._cache_gen = 0  # bumped when a device failure rebuilds the cache
         self._prefill_rr = -1  # last slot that ran a prefill chunk
         self._waiting: queue.Queue[GenerationRequest] = queue.Queue()
         self._requests: dict[str, GenerationRequest] = {}
@@ -371,14 +372,22 @@ class LLMEngine:
         try:
             if not req.done.wait(120):
                 raise TimeoutError("prefill timed out")
+            # Capture the cache reference + generation BEFORE the error
+            # check: if a device failure rebuilds the cache mid-export, the
+            # gen re-check below turns a silent all-zero export into an
+            # error (reading the old donated cache raises on its own).
+            cache, gen = self.cache, self._cache_gen
             if req.error:
                 raise RuntimeError(req.error)
             p = len(ids)
             # hold_slot kept the slot reserved so no other admit overwrote
             # the KV lines between finish and this export.
             slot = req.last_slot
-            kv_k = np.asarray(self.cache["k"][:, slot, :, :p, :])
-            kv_v = np.asarray(self.cache["v"][:, slot, :, :p, :])
+            kv_k = np.asarray(cache["k"][:, slot, :, :p, :])
+            kv_v = np.asarray(cache["v"][:, slot, :, :p, :])
+            if self._cache_gen != gen or req.error:
+                raise RuntimeError(
+                    req.error or "KV cache lost during prefill export")
         finally:
             # On timeout the request may still be running: dropping
             # hold_slot lets its eventual _finish free the slot — orphaned
@@ -573,8 +582,16 @@ class LLMEngine:
         buffers were consumed by the very call that raised. Every slotted
         request's context lived there: fail them all, then rebuild a fresh
         cache so the engine keeps serving NEW traffic."""
+        self._cache_gen += 1  # invalidates in-flight prefill_only exports
         for req in list(self._slots.values()):
-            if req is not None:
+            if req is None:
+                continue
+            if req.done.is_set():
+                # Already finished (hold_slot prefill awaiting export): its
+                # waiter has the result — don't rewrite finish_reason, just
+                # mark the held KV unusable so the export raises.
+                req.error = err
+            else:
                 self._fail(req, err)
         self._slots = {i: None for i in range(self.max_slots)}
         self.cache = init_kv_cache(self.model_cfg, self.max_slots,
@@ -593,11 +610,18 @@ class LLMEngine:
                 self.model_cfg, self.params, self.cache,
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(write))
-            reqs = [active.get(s) for s in range(self.max_slots)]
-            sampled = self._sample_one(logits, reqs)
         except Exception as e:  # noqa: BLE001 - cache donated & lost
             logger.exception("decode step failed (%d active)", len(active))
             self._recover_device_failure(f"decode failed: {e!r}")
+            return
+        try:
+            reqs = [active.get(s) for s in range(self.max_slots)]
+            sampled = self._sample_one(logits, reqs)
+        except Exception as e:  # noqa: BLE001 - cache survived; only this
+            # batch's requests lack tokens — fail them, keep other contexts.
+            logger.exception("sampling failed (%d active)", len(active))
+            for req in active.values():
+                self._fail(req, f"sampling failed: {e!r}")
             return
         for slot, req in active.items():
             req.next_pos += 1

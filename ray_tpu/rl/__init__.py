@@ -11,12 +11,16 @@ pure JAX (jit-compiled, mesh-shardable) instead of torch.
 
 from ray_tpu.rl.env import CartPoleEnv, VectorEnv, make_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
 
 __all__ = [
     "CartPoleEnv", "VectorEnv", "make_env",
     "EnvRunner", "EnvRunnerGroup",
     "PPO", "PPOConfig",
+    "DQN", "DQNConfig",
+    "ReplayBuffer", "PrioritizedReplayBuffer",
 ]
 
 # usage telemetry (local-only, opt-out — reference: usage_lib auto-records

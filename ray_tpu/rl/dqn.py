@@ -1,0 +1,202 @@
+"""DQN: off-policy Q-learning with replay and a target network, in pure JAX.
+
+Capability parity with the reference's DQN family (reference:
+rllib/algorithms/dqn/dqn.py + torch learner — replay buffer (optionally
+prioritized), epsilon-greedy exploration schedule, target network sync,
+double-DQN targets; Algorithm is a Tune Trainable): rollouts come from the
+same EnvRunnerGroup as PPO, the update is a jitted JAX step, and the
+Algorithm plugs into ray_tpu.tune unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env_runner import EnvRunnerGroup
+from ray_tpu.rl.ppo import init_mlp, mlp_apply
+from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.tune.trainable import Trainable
+
+
+@jax.jit
+def _greedy_q(params, obs):
+    return mlp_apply(params, obs)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def dqn_update(optimizer, double_dqn, params, target_params, opt_state,
+               batches, gamma):
+    """K SGD steps on Huber TD error in ONE dispatch (lax.scan over stacked
+    [K, B, ...] minibatches); returns per-sample |TD| for PER."""
+
+    def one(carry, batch):
+        p, os_ = carry
+
+        def loss_fn(p):
+            q = mlp_apply(p, batch["obs"])
+            q_sa = jnp.take_along_axis(q, batch["actions"][:, None], 1)[:, 0]
+            q_next_t = mlp_apply(target_params, batch["next_obs"])
+            if double_dqn:
+                # Online net picks the argmax, target net evaluates it.
+                a_star = mlp_apply(p, batch["next_obs"]).argmax(-1)
+                q_next = jnp.take_along_axis(q_next_t, a_star[:, None],
+                                             1)[:, 0]
+            else:
+                q_next = q_next_t.max(-1)
+            target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+                jax.lax.stop_gradient(q_next)
+            td = q_sa - target
+            w = batch.get("weights", jnp.ones_like(td))
+            return (w * optax.huber_loss(q_sa, target)).mean(), td
+
+        (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        updates, os_ = optimizer.update(grads, os_, p)
+        return (optax.apply_updates(p, updates), os_), (loss, jnp.abs(td))
+
+    (params, opt_state), (losses, tds) = jax.lax.scan(
+        one, (params, opt_state), batches)
+    return params, opt_state, losses[-1], tds
+
+
+@dataclass
+class DQNConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 0
+    num_envs_per_runner: int = 8
+    rollout_len: int = 16
+    lr: float = 2.5e-3
+    gamma: float = 0.99
+    buffer_size: int = 50_000
+    batch_size: int = 128
+    learning_starts: int = 500        # env steps before SGD begins
+    train_batches_per_step: int = 32  # SGD minibatches per step()
+    target_update_freq: int = 2       # in step() iterations
+    double_dqn: bool = True
+    prioritized_replay: bool = False
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 2_000  # env steps to anneal over
+    hidden: int = 64
+    seed: int = 0
+    extra: dict = field(default_factory=dict)
+
+    def build(self) -> "DQN":
+        return DQN({"dqn_config": self})
+
+
+class DQN(Trainable):
+    """EnvRunnerGroup sampling with epsilon-greedy exploration + replay +
+    jitted TD updates (reference: dqn.py training_step shape)."""
+
+    def setup(self, config: dict) -> None:
+        cfg = config.get("dqn_config") or DQNConfig(
+            **{k: v for k, v in config.items()
+               if k in DQNConfig.__dataclass_fields__})
+        self.cfg = cfg
+        probe = make_env(cfg.env, seed=cfg.seed)
+        obs_size, num_actions = probe.observation_size, probe.num_actions
+        self.num_actions = num_actions
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = init_mlp(key, [obs_size, cfg.hidden, cfg.hidden,
+                                     num_actions], scale_last=1.0)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        buf_cls = (PrioritizedReplayBuffer if cfg.prioritized_replay
+                   else ReplayBuffer)
+        self.buffer = buf_cls(cfg.buffer_size, obs_size, seed=cfg.seed)
+        self.env_steps = 0
+
+        num_actions_ = num_actions
+
+        def policy_factory(params=None):
+            # act params are (q_params, epsilon): runner actors receive the
+            # annealed epsilon with each weight sync.
+            def act(p, obs, seed):
+                q_params, eps = p
+                q = np.asarray(_greedy_q(q_params, jnp.asarray(obs)))
+                greedy = q.argmax(-1)
+                rng = np.random.default_rng(seed)
+                explore = rng.random(len(greedy)) < eps
+                rand = rng.integers(0, num_actions_, len(greedy))
+                a = np.where(explore, rand, greedy)
+                zeros = np.zeros(len(greedy), np.float32)
+                return a.astype(np.int32), zeros, zeros
+            return act, None
+
+        self.runners = EnvRunnerGroup(
+            cfg.env, num_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_runner,
+            rollout_len=cfg.rollout_len, policy_factory=policy_factory,
+            seed=cfg.seed)
+        self._return_window: list[float] = []
+
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self.env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def step(self) -> dict:
+        cfg = self.cfg
+        samples = self.runners.sample((self.params, self._epsilon()))
+        for s in samples:
+            T, N = s["rewards"].shape
+            next_obs = np.concatenate(
+                [s["obs"][1:], s["last_obs"][None]], axis=0)
+            self.buffer.add_batch(
+                s["obs"].reshape(T * N, -1), s["actions"].reshape(-1),
+                s["rewards"].reshape(-1), next_obs.reshape(T * N, -1),
+                s["dones"].reshape(-1).astype(np.float32))
+            self.env_steps += T * N
+            self._return_window.extend(s["episode_returns"])
+
+        loss = 0.0
+        if self.env_steps >= cfg.learning_starts:
+            raw = [self.buffer.sample(cfg.batch_size)
+                   for _ in range(cfg.train_batches_per_step)]
+            idxs = [b.pop("idx", None) for b in raw]
+            batches = {k: jnp.asarray(np.stack([b[k] for b in raw]))
+                       for k in raw[0]}
+            self.params, self.opt_state, loss_j, tds = dqn_update(
+                self.optimizer, cfg.double_dqn, self.params,
+                self.target_params, self.opt_state, batches, cfg.gamma)
+            loss = float(loss_j)
+            if idxs[0] is not None:
+                tds_np = np.asarray(tds)
+                for idx, td in zip(idxs, tds_np):
+                    self.buffer.update_priorities(idx, td)
+            if self.iteration % cfg.target_update_freq == 0:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+
+        self._return_window = self._return_window[-100:]
+        mean_ret = (float(np.mean(self._return_window))
+                    if self._return_window else 0.0)
+        return {
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled": self.env_steps,
+            "epsilon": self._epsilon(),
+            "td_loss": loss,
+            "buffer_size": len(self.buffer),
+        }
+
+    def save_checkpoint(self) -> Any:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "target": jax.tree.map(np.asarray, self.target_params),
+                "env_steps": self.env_steps, "iteration": self.iteration}
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, checkpoint["params"])
+        self.target_params = jax.tree.map(jnp.asarray, checkpoint["target"])
+        self.env_steps = checkpoint["env_steps"]
+        self.iteration = checkpoint["iteration"]
+
+    def cleanup(self) -> None:
+        self.runners.shutdown()

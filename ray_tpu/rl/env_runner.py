@@ -57,6 +57,7 @@ class EnvRunner:
         return {
             "obs": obs_b, "actions": act_b, "logp": logp_b, "values": val_b,
             "rewards": rew_b, "dones": done_b, "last_values": last_value,
+            "last_obs": np.asarray(self.obs, np.float32),  # for 1-step targets
             "episode_returns": self.vec.drain_episode_returns(),
         }
 

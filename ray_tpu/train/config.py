@@ -51,3 +51,6 @@ class RunConfig:
     storage_path: str | None = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    # air integration callbacks (ray_tpu.air.integrations), invoked by the
+    # controller on run start / each reported result / checkpoint / run end.
+    callbacks: list = field(default_factory=list)

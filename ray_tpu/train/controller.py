@@ -51,6 +51,16 @@ class TrainController:
         )
         self.metrics_history: list[dict] = []
         self._status = "PENDING"
+        self._callbacks = list(run_config.callbacks)
+        self._run_name = name
+        self._rank0_reports = 0  # callback iteration counter (rank-0 only)
+
+    def _cb(self, hook: str, *args) -> None:
+        for cb in self._callbacks:
+            try:
+                getattr(cb, hook)(*args)
+            except Exception:  # noqa: BLE001 - a tracker must not kill a run
+                traceback.print_exc()
 
     def status(self) -> str:
         return self._status
@@ -62,6 +72,7 @@ class TrainController:
         from ray_tpu.train.scaling_policy import make_scaling_policy
 
         self._status = "RUNNING"
+        self._cb("on_run_start", self._run_name, self.train_loop_config)
         max_failures = self.run_config.failure_config.max_failures
         policy = make_scaling_policy(self.scaling,
                                      getattr(self, "_resources_fn", None))
@@ -83,14 +94,17 @@ class TrainController:
                 group.run(self.train_fn, self.train_loop_config)
                 result = self._poll_until_done(group)
                 self._status = "FINISHED" if result.ok else "ERRORED"
+                self._cb("on_run_end", result)
                 return result
             except Exception:  # noqa: BLE001 - worker/actor failures
                 restart_count += 1
                 if max_failures >= 0 and restart_count > max_failures:
                     self._status = "ERRORED"
-                    return Result(error=traceback.format_exc(),
-                                  checkpoint=self.ckpt_manager.latest(),
-                                  metrics_history=self.metrics_history)
+                    result = Result(error=traceback.format_exc(),
+                                    checkpoint=self.ckpt_manager.latest(),
+                                    metrics_history=self.metrics_history)
+                    self._cb("on_run_end", result)
+                    return result
                 # else: loop → new worker group restored from latest checkpoint
             finally:
                 if group is not None:
@@ -103,8 +117,12 @@ class TrainController:
             status = group.poll_status(timeout=60)
             for rep in status.reports:
                 self.metrics_history.append(rep["metrics"])
+                if rep.get("rank", 0) == 0:
+                    self._rank0_reports += 1
+                    self._cb("on_result", rep["metrics"], self._rank0_reports)
                 if rep.get("checkpoint") and rep.get("rank", 0) == 0:
                     self.ckpt_manager.register(rep["checkpoint"], rep["metrics"])
+                    self._cb("on_checkpoint", rep["checkpoint"], rep["metrics"])
             if status.errors:
                 err = "\n".join(f"rank {r}: {e}"
                                 for r, e in status.errors.items())

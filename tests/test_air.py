@@ -1,0 +1,90 @@
+"""air shared types + integration callbacks (reference test model:
+python/ray/air/tests/test_integration_*, tune logger tests)."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_air_reexports_shared_types():
+    import ray_tpu.air as air
+
+    assert air.RunConfig is not None
+    assert air.ScalingConfig(num_workers=2).num_workers == 2
+    r = air.Result(metrics={"a": 1})
+    assert r.ok and r.metrics["a"] == 1
+
+
+def test_logger_callbacks_write_files(tmp_path):
+    from ray_tpu.air.integrations import CSVLoggerCallback, JsonLoggerCallback
+
+    jl = JsonLoggerCallback(str(tmp_path / "j"))
+    cl = CSVLoggerCallback(str(tmp_path / "c"))
+    for cb in (jl, cl):
+        cb.on_run_start("run1", {"lr": 0.1})
+        cb.on_result({"loss": 1.5, "acc": 0.2}, 1)
+        cb.on_result({"loss": 1.0, "acc": 0.4}, 2)
+        cb.on_run_end(None)
+
+    lines = (tmp_path / "j" / "result.json").read_text().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[1])["loss"] == 1.0
+    assert json.loads((tmp_path / "j" / "params.json").read_text()) == {"lr": 0.1}
+
+    csv_lines = (tmp_path / "c" / "progress.csv").read_text().splitlines()
+    assert csv_lines[0].startswith("training_iteration,loss")
+    assert len(csv_lines) == 3
+
+
+def test_tbx_callback_writes_events(tmp_path):
+    from ray_tpu.air.integrations import TBXLoggerCallback
+
+    cb = TBXLoggerCallback(str(tmp_path))
+    cb.on_run_start("run1", None)
+    cb.on_result({"loss": 0.5, "skip_me": "str"}, 1)
+    cb.on_run_end(None)
+    assert any(f.startswith("events.out") for f in os.listdir(tmp_path))
+
+
+def test_gated_trackers_fail_fast():
+    from ray_tpu.air.integrations.mlflow import MLflowLoggerCallback
+    from ray_tpu.air.integrations.wandb import WandbLoggerCallback
+
+    with pytest.raises(ImportError, match="not installed"):
+        WandbLoggerCallback("proj")
+    with pytest.raises(ImportError, match="not installed"):
+        MLflowLoggerCallback("exp")
+
+
+def test_trainer_invokes_callbacks(tmp_path):
+    """Callbacks ride RunConfig into the controller actor and fire on each
+    reported result (train/controller.py _cb)."""
+    from ray_tpu.air.integrations import JsonLoggerCallback
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.train.config import RunConfig, ScalingConfig
+
+    def loop(config):
+        from ray_tpu.train import session
+
+        for i in range(3):
+            session.report({"step": i, "loss": 1.0 / (i + 1)})
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="cbrun", storage_path=str(tmp_path),
+                callbacks=[JsonLoggerCallback(str(tmp_path / "logs"))]),
+        )
+        result = trainer.fit()
+        assert result.ok
+        lines = (tmp_path / "logs" / "result.json").read_text().splitlines()
+        assert len(lines) == 3
+        assert json.loads(lines[-1])["step"] == 2
+    finally:
+        ray_tpu.shutdown()

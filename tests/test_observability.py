@@ -235,6 +235,11 @@ class TestDashboard:
             assert ctype == "text/plain"
             _, body = get("/api/timeline")
             assert isinstance(json.loads(body), list)
+            # web UI at the root: html that targets the JSON API routes
+            ctype, body = get("/")
+            assert ctype == "text/html"
+            page = body.decode()
+            assert "/api/cluster_status" in page and "</html>" in page
         finally:
             srv.stop()
 

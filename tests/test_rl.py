@@ -117,3 +117,62 @@ def test_ppo_under_tuner(rt_start):
     best = grid.get_best_result()
     assert best.config["lr"] == 3e-4
     assert best.metrics["episode_return_mean"] > 25.0
+
+
+def test_replay_buffers():
+    from ray_tpu.rl import PrioritizedReplayBuffer, ReplayBuffer
+
+    buf = ReplayBuffer(8, 3, seed=0)
+    obs = np.arange(30, dtype=np.float32).reshape(10, 3)
+    buf.add_batch(obs, np.arange(10), np.ones(10), obs + 1, np.zeros(10))
+    assert len(buf) == 8  # ring wrapped: capacity bound holds
+    b = buf.sample(4)
+    assert b["obs"].shape == (4, 3) and (b["next_obs"] == b["obs"] + 1).all()
+
+    pbuf = PrioritizedReplayBuffer(16, 3, seed=0)
+    pbuf.add_batch(obs, np.arange(10), np.ones(10), obs + 1, np.zeros(10))
+    b = pbuf.sample(6)
+    assert "weights" in b and b["weights"].max() <= 1.0 + 1e-6
+    # boost one transition's priority: it must dominate sampling (uniform
+    # would draw it ~10% of the time; prioritized ~99%)
+    pbuf.update_priorities(np.array([3]), np.array([100.0]))
+    draws = np.concatenate([pbuf.sample(8)["idx"] for _ in range(25)])
+    assert (draws == 3).mean() > 0.5, (draws == 3).mean()
+    # its importance weight is the (relatively) smallest
+    b = pbuf.sample(32)
+    w3 = b["weights"][b["idx"] == 3]
+    assert len(w3) and np.allclose(w3, b["weights"].min())
+
+
+def test_dqn_learns_cartpole():
+    """DQN with replay + target net reaches a learning threshold on CartPole
+    (reference: rllib DQN CartPole runs; threshold kept modest for CI)."""
+    from ray_tpu.rl import DQNConfig
+
+    algo = DQNConfig(num_envs_per_runner=8, rollout_len=16,
+                     learning_starts=256, seed=0).build()
+    best = 0.0
+    for _ in range(120):
+        r = algo.train_step()
+        best = max(best, r["episode_return_mean"])
+        if best >= 100.0:
+            break
+    algo.cleanup()
+    assert best >= 100.0, f"DQN failed to learn CartPole: best {best}"
+    assert r["epsilon"] < 1.0 and r["buffer_size"] > 0
+
+
+def test_dqn_prioritized_and_checkpoint():
+    from ray_tpu.rl import DQN, DQNConfig
+
+    algo = DQNConfig(prioritized_replay=True, learning_starts=64,
+                     rollout_len=8, num_envs_per_runner=4, seed=2).build()
+    for _ in range(3):
+        r = algo.train_step()
+    ckpt = algo.save_checkpoint()
+    algo.cleanup()
+
+    algo2 = DQN({"dqn_config": DQNConfig(seed=3)})
+    algo2.load_checkpoint(ckpt)
+    assert algo2.env_steps == ckpt["env_steps"]
+    algo2.cleanup()
