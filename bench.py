@@ -65,7 +65,8 @@ def main() -> None:
         # recompute tax when activations fit; 'dots' saves matmul outputs
         # only; full remat is the memory floor.
         candidates = [
-            (4, "none", "flash"), (4, "dots", "flash"), (4, "full", "flash"),
+            (4, "dots+", "flash"), (8, "dots+", "flash"),
+            (4, "dots", "flash"), (4, "full", "flash"),
             (8, "full", "flash"), (2, "full", "flash"),
             (4, "full", "blockwise"),
         ]

@@ -50,6 +50,11 @@ class ActorInfo:
     death_reason: str = ""
     owner_node: str | None = None
     lifetime: str = "non_detached"
+    # Scheduling constraints, kept for restarts (reference: the GCS actor
+    # scheduler re-applies the creation spec's strategy on reconstruction).
+    node_affinity: str | None = None
+    affinity_soft: bool = False
+    labels: dict | None = None
 
 
 class HeadServer:
@@ -317,6 +322,7 @@ class HeadServer:
         resources: dict, name: str | None, namespace: str, max_restarts: int,
         lifetime: str = "non_detached",
         node_affinity: str | None = None, labels: dict | None = None,
+        affinity_soft: bool = False,
     ):
         if name:
             key = (namespace, name)
@@ -325,13 +331,14 @@ class HeadServer:
         info = ActorInfo(
             actor_id=actor_id, spec_blob=spec_blob, resources=dict(resources),
             name=name, namespace=namespace, max_restarts=max_restarts,
-            lifetime=lifetime,
+            lifetime=lifetime, node_affinity=node_affinity,
+            affinity_soft=affinity_soft, labels=labels,
         )
         self.actors[actor_id] = info
         if name:
             self.named_actors[(namespace, name)] = actor_id
         self.mark_dirty()
-        ok = await self._schedule_actor(info, node_affinity=node_affinity, labels=labels)
+        ok = await self._schedule_actor(info)
         if not ok:
             info.state = "DEAD"
             info.death_reason = "no feasible node"
@@ -368,9 +375,12 @@ class HeadServer:
         pool.sort()
         return pool[0][2]
 
-    async def _schedule_actor(self, info: ActorInfo, node_affinity: str | None = None,
-                              labels: dict | None = None) -> bool:
-        node = self._pick_node(info.resources, node_affinity, labels)
+    async def _schedule_actor(self, info: ActorInfo) -> bool:
+        node = self._pick_node(info.resources, info.node_affinity,
+                               info.labels)
+        if node is None and info.node_affinity and info.affinity_soft:
+            # Soft affinity: target gone/infeasible → default placement.
+            node = self._pick_node(info.resources, None, info.labels)
         if node is None:
             return False
         info.node_id = node.node_id

@@ -59,7 +59,7 @@ import cloudpickle
 
 class _LeasedWorker:
     __slots__ = ("lease_id", "worker_id", "addr", "client", "inflight",
-                 "idle_since", "daemon", "dead")
+                 "idle_since", "daemon", "dead", "served")
 
     def __init__(self, lease_id: str, worker_id: str, addr: tuple[str, int],
                  client: AsyncRpcClient, daemon: AsyncRpcClient):
@@ -71,6 +71,7 @@ class _LeasedWorker:
         self.inflight = 0
         self.idle_since = 0.0  # monotonic ts when inflight last hit 0
         self.dead = False
+        self.served = 0  # tasks dispatched over this lease's lifetime
 
 
 class _TaskItem:
@@ -88,15 +89,17 @@ class _KeyState:
     SchedulingKey in normal_task_submitter.h:52). Loop-thread-only."""
 
     __slots__ = ("key", "resources", "env_hash", "queue", "workers",
-                 "pending_leases")
+                 "pending_leases", "strategy", "spread_idx")
 
-    def __init__(self, key, resources, env_hash):
+    def __init__(self, key, resources, env_hash, strategy=None):
         self.key = key
         self.resources = resources
         self.env_hash = env_hash
         self.queue: deque[_TaskItem] = deque()
         self.workers: list[_LeasedWorker] = []
         self.pending_leases = 0
+        self.strategy = strategy   # SchedulingStrategy (None = DEFAULT)
+        self.spread_idx = 0        # SPREAD round-robin cursor
 
 
 class _ActorState:
@@ -666,7 +669,8 @@ class ClusterRuntime:
         key = item.spec.scheduling_key()
         ks = self._key_states.get(key)
         if ks is None:
-            ks = _KeyState(key, dict(item.spec.resources), key[1])
+            ks = _KeyState(key, dict(item.spec.resources), key[1],
+                           strategy=item.spec.scheduling_strategy)
             self._key_states[key] = ks
         ks.queue.append(item)
         self._task_where[tid] = ("queued", ks)
@@ -683,13 +687,24 @@ class ClusterRuntime:
                 w.dead = True
                 ks.workers.remove(w)
                 spawn_task(self._return_dead_lease(w))
-        # Dispatch queued tasks onto workers with pipeline capacity.
+        # Dispatch queued tasks onto workers with pipeline capacity. SPREAD
+        # keys cap each worker at one in-flight task so the backlog forces
+        # leases on other nodes (the round-robin entry point in
+        # _lease_entry_daemon does the actual spreading).
+        spread = ks.strategy is not None and ks.strategy.kind == "SPREAD"
+        depth = 1 if spread else self.PIPELINE_DEPTH
         while ks.queue:
             live = [w for w in ks.workers
-                    if not w.dead and w.inflight < self.PIPELINE_DEPTH]
+                    if not w.dead and w.inflight < depth]
+            if spread and ks.pending_leases > 0:
+                # Don't funnel the backlog through an already-used worker
+                # while fresh leases (round-robined over other nodes) are
+                # still in flight — that would defeat the spread.
+                live = [w for w in live if w.served == 0]
             if not live:
                 break
             w = min(live, key=lambda w: w.inflight)
+            w.served += 1
             item = ks.queue.popleft()
             tid = item.spec.task_id.hex()
             if tid in self._cancelled:
@@ -710,7 +725,7 @@ class ClusterRuntime:
                         TaskError(RuntimeError("no node daemon attached"),
                                   task_desc=item.spec.name))
             return
-        capacity = sum(self.PIPELINE_DEPTH - w.inflight
+        capacity = sum(depth - w.inflight
                        for w in ks.workers if not w.dead)
         deficit = len(ks.queue) - capacity
         want = min(self.MAX_PENDING_LEASES - ks.pending_leases, deficit)
@@ -760,16 +775,56 @@ class ClusterRuntime:
                 self._task_where.pop(tid, None)
             self._pump(ks)
 
+    async def _lease_entry_daemon(self, ks: _KeyState):
+        """(daemon, pinned) the lease request starts at, per scheduling
+        strategy (reference: scheduling policies in raylet/scheduling/policy/
+        — hybrid pack/spread is the daemon's native spillback behavior):
+        - DEFAULT: local daemon (hybrid: local until busy, then spill).
+        - SPREAD: round-robin over feasible alive nodes (spread_scheduling
+          _policy.h), unpinned so a busy pick still spills.
+        - NODE_AFFINITY: the target node's daemon, pinned unless soft; a
+          dead/unknown hard target fails the lease loudly.
+        """
+        strat = ks.strategy
+        kind = getattr(strat, "kind", "DEFAULT")
+        if kind == "SPREAD":
+            try:
+                nodes = await self.head.aio.call("list_nodes")
+            except Exception:
+                return self._daemon.aio, False
+            feasible = sorted(
+                (nid, tuple(info["addr"])) for nid, info in nodes.items()
+                if info["alive"] and all(
+                    info["resources"].get(k, 0.0) >= v
+                    for k, v in ks.resources.items()))
+            if feasible:
+                nid, addr = feasible[ks.spread_idx % len(feasible)]
+                ks.spread_idx += 1
+                return (await self._apeer(addr)), False
+            return self._daemon.aio, False
+        if kind == "NODE_AFFINITY":
+            nodes = await self.head.aio.call("list_nodes")
+            info = nodes.get(strat.node_id_hex)
+            if info is None or not info["alive"]:
+                if strat.soft:
+                    return self._daemon.aio, False
+                raise ValueError(
+                    f"node affinity target {strat.node_id_hex} is not alive")
+            return (await self._apeer(tuple(info["addr"]))), not strat.soft
+        return self._daemon.aio, False
+
     async def _request_lease(self, ks: _KeyState) -> None:
-        """Lease a worker from the local daemon, following spillback
-        redirects (reference: cluster_lease_manager spillback). A granted
-        worker that refuses connections (killed between grant and connect)
-        is returned and the lease re-requested."""
+        """Lease a worker from the local daemon (or the strategy's entry
+        node), following spillback redirects (reference:
+        cluster_lease_manager spillback). A granted worker that refuses
+        connections (killed between grant and connect) is returned and the
+        lease re-requested."""
         try:
             for _ in range(4):
-                daemon = self._daemon.aio
+                daemon, pinned = await self._lease_entry_daemon(ks)
                 res = await daemon.call("request_lease", resources=ks.resources,
-                                        env_hash=ks.env_hash, timeout=None)
+                                        env_hash=ks.env_hash, timeout=None,
+                                        allow_spill=not pinned)
                 hops = 0
                 while res.get("spill") and hops < 4:
                     daemon = await self._apeer(tuple(res["spill"]))
@@ -949,6 +1004,7 @@ class ClusterRuntime:
             max_restarts=spec.max_restarts,
             lifetime=spec.lifetime,
             node_affinity=strategy.node_id_hex if strategy.kind == "NODE_AFFINITY" else None,
+            affinity_soft=strategy.soft,
         )
         if not res.get("ok"):
             raise ValueError(res.get("error", "actor registration failed"))

@@ -62,14 +62,22 @@ def resolve_strategy(resources: dict[str, float], strategy):
     strategies rewrite demands onto the bundle's derived resources."""
     if strategy is None:
         return resources, SchedulingStrategy()
+    if isinstance(strategy, str):
+        if strategy not in ("DEFAULT", "SPREAD"):
+            raise ValueError(
+                f"unknown scheduling strategy {strategy!r} "
+                "(expected 'DEFAULT', 'SPREAD', or a strategy object)")
+        return resources, SchedulingStrategy(kind=strategy)
     if isinstance(strategy, SchedulingStrategy):
         return resources, strategy
     # PlacementGroupSchedulingStrategy (duck-typed to avoid import cycle)
-    if hasattr(strategy, "to_scheduling_strategy"):
+    if hasattr(strategy, "placement_group"):
         from ray_tpu.util.placement_group import rewrite_resources_for_pg
 
         return (rewrite_resources_for_pg(resources, strategy),
                 strategy.to_scheduling_strategy())
+    if hasattr(strategy, "to_scheduling_strategy"):
+        return resources, strategy.to_scheduling_strategy()
     raise TypeError(f"unsupported scheduling strategy {strategy!r}")
 
 

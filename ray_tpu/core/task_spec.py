@@ -75,7 +75,9 @@ class TaskSpec:
             env_key = (json.dumps(self.runtime_env, sort_keys=True, default=str)
                        if self.runtime_env else "")
             res_key = tuple(sorted(self.resources.items()))
-            cached = self._sched_key = (res_key, env_key)
+            s = self.scheduling_strategy
+            strat_key = (s.kind, s.node_id_hex, s.soft)
+            cached = self._sched_key = (res_key, env_key, strat_key)
         return cached
 
 

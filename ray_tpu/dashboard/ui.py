@@ -76,10 +76,14 @@ INDEX_HTML = """<!doctype html>
 <script>
 const fmt = (x) => typeof x === "number" && !Number.isInteger(x)
     ? x.toFixed(2) : String(x);
+// Cluster-supplied strings (actor names, job entrypoints, labels) are
+// untrusted: escape before any innerHTML insertion (stored-XSS guard).
+const esc = (s) => String(s).replace(/[&<>"']/g, (c) => ({
+  "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
 function table(el, rows, cols) {
   const div = document.getElementById(el);
   if (!rows || !rows.length) { div.innerHTML = '<div class="empty">none</div>'; return; }
-  let h = "<table><tr>" + cols.map(c => `<th>${c[0]}</th>`).join("") + "</tr>";
+  let h = "<table><tr>" + cols.map(c => `<th>${esc(c[0])}</th>`).join("") + "</tr>";
   for (const r of rows.slice(0, 50)) {
     h += "<tr>" + cols.map(c => {
       let v = typeof c[1] === "function" ? c[1](r) : r[c[1]];
@@ -87,7 +91,7 @@ function table(el, rows, cols) {
       if (typeof v === "object") v = JSON.stringify(v);
       const cls = /ALIVE|RUNNING|SUCCEEDED|FINISHED|true/.test(String(v)) ? "s-ok"
                 : /DEAD|FAILED|ERROR/.test(String(v)) ? "s-bad" : "";
-      return `<td class="${cls}">${fmt(v)}</td>`;
+      return `<td class="${cls}">${esc(fmt(v))}</td>`;
     }).join("") + "</tr>";
   }
   div.innerHTML = h + "</table>";
@@ -114,7 +118,7 @@ async function refresh() {
   if (nodes) tiles.push(["nodes", nodes.length]);
   if (actors) tiles.push(["actors", actors.length]);
   document.getElementById("tiles").innerHTML = tiles.map(
-    ([k, v]) => `<div class="tile"><div class="k">${k}</div><div class="v">${v}</div></div>`
+    ([k, v]) => `<div class="tile"><div class="k">${esc(k)}</div><div class="v">${esc(v)}</div></div>`
   ).join("");
   table("nodes", nodes, [["id", "node_id"], ["state", r => r.alive ? "ALIVE" : "DEAD"],
     ["address", r => (r.addr || []).join ? r.addr.join(":") : r.addr],

@@ -25,6 +25,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import blockwise_attention, flash_attention
 from ray_tpu.ops.norms import rms_norm
@@ -156,21 +157,23 @@ def _layer(cfg: LlamaConfig, x, layer_params, inv_freq, positions,
     dt = x.dtype
 
     # -- attention ----------------------------------------------------------
-    xn = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    xn = checkpoint_name(rms_norm(x, lp["attn_norm"], cfg.norm_eps),
+                         "norm_out")
     q = (xn @ lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
     k = (xn @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     v = (xn @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     q = q.transpose(0, 2, 1, 3)
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
-    q = apply_rope(q, positions, inv_freq)
-    k = apply_rope(k, positions, inv_freq)
+    q = checkpoint_name(apply_rope(q, positions, inv_freq), "rope_out")
+    k = checkpoint_name(apply_rope(k, positions, inv_freq), "rope_out")
     o = _attention(cfg, q, k, v, attn_impl, sp_axis)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.num_heads * cfg.head_dim)
     x = x + (o @ lp["wo"]).astype(dt)
 
     # -- mlp (SwiGLU) -------------------------------------------------------
-    xn = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    xn = checkpoint_name(rms_norm(x, lp["mlp_norm"], cfg.norm_eps),
+                         "norm_out")
     gate = jax.nn.silu((xn @ lp["w_gate"]).astype(jnp.float32)).astype(dt)
     up = xn @ lp["w_up"]
     x = x + ((gate * up) @ lp["w_down"]).astype(dt)
@@ -182,13 +185,17 @@ def _remat_wrap(layer_fn, remat):
     'dots' = save matmul outputs (jax.checkpoint_policies.checkpoint_dots)
     plus the flash-attention residuals (out, lse) — so the backward pass
     neither recomputes the matmuls nor re-runs the attention kernel,
+    'dots+' = 'dots' plus the rms_norm/rope outputs (no elementwise
+    recompute at all — highest memory short of 'none'),
     False/'none' = save all."""
     if remat in (False, "none"):
         return layer_fn
-    if remat == "dots":
+    if remat in ("dots", "dots+"):
+        names = ("flash_resid",) if remat == "dots" else (
+            "flash_resid", "norm_out", "rope_out")
         policy = jax.checkpoint_policies.save_from_both_policies(
             jax.checkpoint_policies.checkpoint_dots,
-            jax.checkpoint_policies.save_only_these_names("flash_resid"),
+            jax.checkpoint_policies.save_only_these_names(*names),
         )
         return jax.checkpoint(layer_fn, policy=policy)
     return jax.checkpoint(layer_fn)

@@ -12,6 +12,7 @@ from ray_tpu.serve.api import (
     delete,
     get_app_handle,
     get_deployment_handle,
+    grpc_port,
     http_port,
     run,
     shutdown,
@@ -22,13 +23,15 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.grpc_proxy import GrpcRequest
 from ray_tpu.serve.http_proxy import Request, Response
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment", "Deployment", "Application",
     "run", "start", "shutdown", "status", "delete",
-    "get_app_handle", "get_deployment_handle", "http_port",
+    "get_app_handle", "get_deployment_handle", "http_port", "grpc_port",
+    "GrpcRequest",
     "DeploymentHandle", "DeploymentResponse",
     "AutoscalingConfig", "DeploymentConfig",
     "batch", "Request", "Response",

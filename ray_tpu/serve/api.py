@@ -19,28 +19,47 @@ from ray_tpu.serve.http_proxy import ProxyActor
 from ray_tpu.utils import serialization
 
 _PROXY_NAME = "SERVE_PROXY"
+_GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 
 
-def start(http_options: dict | None = None, detached: bool = True):
-    """Idempotently create the controller (and HTTP proxy if requested)."""
+def start(http_options: dict | None = None, detached: bool = True,
+          grpc_options: dict | None = None):
+    """Idempotently create the controller (and HTTP/gRPC proxies if
+    requested)."""
     ray_tpu.init()
     try:
-        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                       namespace=SERVE_NAMESPACE)
     except ValueError:
-        pass
-    Controller = ray_tpu.remote(ServeController)
-    controller = Controller.options(
-        name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE, num_cpus=0,
-        max_concurrency=32, lifetime="detached",
-    ).remote()
-    if http_options is not None:
-        Proxy = ray_tpu.remote(ProxyActor)
-        proxy = Proxy.options(
-            name=_PROXY_NAME, namespace=SERVE_NAMESPACE, num_cpus=0,
+        Controller = ray_tpu.remote(ServeController)
+        controller = Controller.options(
+            name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE, num_cpus=0,
             max_concurrency=32, lifetime="detached",
-        ).remote(http_options.get("host", "127.0.0.1"),
-                 http_options.get("port", 0))
-        ray_tpu.get(proxy.ready.remote())
+        ).remote()
+    if http_options is not None:
+        try:
+            ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
+        except ValueError:
+            Proxy = ray_tpu.remote(ProxyActor)
+            proxy = Proxy.options(
+                name=_PROXY_NAME, namespace=SERVE_NAMESPACE, num_cpus=0,
+                max_concurrency=32, lifetime="detached",
+            ).remote(http_options.get("host", "127.0.0.1"),
+                     http_options.get("port", 0))
+            ray_tpu.get(proxy.ready.remote())
+    if grpc_options is not None:
+        try:
+            ray_tpu.get_actor(_GRPC_PROXY_NAME, namespace=SERVE_NAMESPACE)
+        except ValueError:
+            from ray_tpu.serve.grpc_proxy import GrpcProxyActor
+
+            GProxy = ray_tpu.remote(GrpcProxyActor)
+            gproxy = GProxy.options(
+                name=_GRPC_PROXY_NAME, namespace=SERVE_NAMESPACE, num_cpus=0,
+                max_concurrency=32, lifetime="detached",
+            ).remote(grpc_options.get("host", "127.0.0.1"),
+                     grpc_options.get("port", 0))
+            ray_tpu.get(gproxy.ready.remote())
     return controller
 
 
@@ -50,10 +69,12 @@ def _controller():
 
 def run(target: Application, *, name: str = "default",
         route_prefix: str | None = "/", http: bool = False,
-        http_port: int = 0, _blocking_timeout: float = 60.0) -> DeploymentHandle:
+        http_port: int = 0, grpc: bool = False, grpc_port: int = 0,
+        _blocking_timeout: float = 60.0) -> DeploymentHandle:
     """Deploy an application graph; block until healthy; return the ingress
     deployment's handle."""
-    controller = start(http_options={"port": http_port} if http else None)
+    controller = start(http_options={"port": http_port} if http else None,
+                       grpc_options={"port": grpc_port} if grpc else None)
 
     # Flatten the graph: depth-first over bound args, children first.
     seen: dict[int, str] = {}
@@ -100,6 +121,11 @@ def run(target: Application, *, name: str = "default",
         proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
         ray_tpu.get(proxy.update_routes.remote(
             ray_tpu.get(controller.get_routes.remote())))
+    if grpc:
+        gproxy = ray_tpu.get_actor(_GRPC_PROXY_NAME,
+                                   namespace=SERVE_NAMESPACE)
+        routes = ray_tpu.get(controller.get_routes.remote())
+        ray_tpu.get(gproxy.update_routes.remote(routes, {name: ingress}))
 
     return DeploymentHandle(ingress, app_name=name)
 
@@ -129,6 +155,11 @@ def http_port() -> int:
     return ray_tpu.get(proxy.port.remote())
 
 
+def grpc_port() -> int:
+    proxy = ray_tpu.get_actor(_GRPC_PROXY_NAME, namespace=SERVE_NAMESPACE)
+    return ray_tpu.get(proxy.port.remote())
+
+
 def shutdown() -> None:
     try:
         controller = _controller()
@@ -138,12 +169,13 @@ def shutdown() -> None:
         ray_tpu.get(controller.graceful_shutdown.remote(), timeout=15)
     except Exception:
         pass
-    try:
-        proxy = ray_tpu.get_actor(_PROXY_NAME, namespace=SERVE_NAMESPACE)
-        proxy.shutdown.remote()
-        ray_tpu.kill(proxy)
-    except Exception:
-        pass
+    for pname in (_PROXY_NAME, _GRPC_PROXY_NAME):
+        try:
+            proxy = ray_tpu.get_actor(pname, namespace=SERVE_NAMESPACE)
+            proxy.shutdown.remote()
+            ray_tpu.kill(proxy)
+        except Exception:
+            pass
     try:
         ray_tpu.kill(controller)
     except Exception:

@@ -1,0 +1,146 @@
+"""gRPC ingress proxy.
+
+Capability parity with the reference's gRPC proxy (reference:
+python/ray/serve/_private/proxy.py gRPCProxy — a grpc.server whose service
+methods route to the application's ingress deployment; the app is selected
+with the `application` request-metadata key; streaming methods yield).
+
+Proto-agnostic design: a GenericRpcHandler accepts ANY fully-qualified
+method (`/pkg.Service/Method`) with identity (de)serializers, so user
+deployments work with raw request bytes (decode with their own protobuf or
+codec) and return bytes/str/JSON-able values. A client that sets the
+`streaming` metadata key gets a server-streaming call whose responses are
+the chunks the deployment generator yields. This keeps the reference's
+"bring your own servicer" capability without a protoc build step.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class GrpcRequest:
+    """What an ingress deployment's __call__ receives for a gRPC request."""
+
+    method: str                                  # "/pkg.Service/Method"
+    data: bytes = b""
+    metadata: dict[str, str] = field(default_factory=dict)
+
+    def json(self):
+        return json.loads(self.data) if self.data else None
+
+
+def _encode(chunk) -> bytes:
+    if isinstance(chunk, (bytes, bytearray)):
+        return bytes(chunk)
+    if isinstance(chunk, str):
+        return chunk.encode()
+    return json.dumps(chunk).encode()
+
+
+class GrpcProxyActor:
+    """Binds a grpc.server; routes every method to the application ingress
+    selected by the `application` metadata key (or the only route)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import grpc
+        from concurrent import futures
+
+        self._routes: dict[str, str] = {}   # route_prefix -> deployment
+        self._apps: dict[str, str] = {}     # app name -> deployment
+        self._handles: dict[str, object] = {}
+        self._lock = threading.Lock()
+        proxy = self
+
+        class AnyService(grpc.GenericRpcHandler):
+            def service(self, details):
+                streaming = any(k == "streaming" and str(v).lower() in
+                                ("1", "true")
+                                for k, v in (details.invocation_metadata or []))
+                method = details.method
+
+                def unary(request, context):
+                    return proxy._call(method, request, context,
+                                       stream=False)
+
+                def stream(request, context):
+                    yield from proxy._call(method, request, context,
+                                           stream=True)
+
+                if streaming:
+                    return grpc.unary_stream_rpc_method_handler(
+                        stream, request_deserializer=None,
+                        response_serializer=None)
+                return grpc.unary_unary_rpc_method_handler(
+                    unary, request_deserializer=None,
+                    response_serializer=None)
+
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((AnyService(),))
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+
+    def _pick(self, metadata: dict[str, str]) -> str | None:
+        with self._lock:
+            app = metadata.get("application")
+            if app and app in self._apps:
+                return self._apps[app]
+            if self._routes:
+                # deterministic default: shortest route prefix (the "/" app)
+                route = sorted(self._routes)[0]
+                return self._routes[route]
+        return None
+
+    def _call(self, method: str, request: bytes, context, stream: bool):
+        md = {k: str(v) for k, v in (context.invocation_metadata() or [])}
+        dep = self._pick(md)
+        if dep is None:
+            import grpc
+
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          "no serve application for this call")
+        req = GrpcRequest(method=method, data=bytes(request or b""),
+                          metadata=md)
+        gen = self._get_handle(dep).options(stream=True).remote(req)
+        gen.timeout = 60.0
+        if stream:
+            return (_encode(c) for c in gen)
+        return _encode(next(gen))
+
+    def _get_handle(self, deployment_name: str):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        with self._lock:
+            if deployment_name not in self._handles:
+                self._handles[deployment_name] = DeploymentHandle(
+                    deployment_name)
+            return self._handles[deployment_name]
+
+    # -- control plane --
+
+    def update_routes(self, routes: dict[str, str],
+                      apps: dict[str, str] | None = None) -> None:
+        with self._lock:
+            self._routes = dict(routes)
+            if apps is not None:
+                # Merge: each serve.run() pushes only ITS app's ingress;
+                # replacing wholesale would break `application` metadata
+                # routing for previously deployed apps.
+                self._apps.update(apps)
+                # Drop apps whose ingress no longer appears in any route
+                # (deleted applications).
+                live = set(routes.values())
+                self._apps = {a: d for a, d in self._apps.items()
+                              if d in live}
+
+    def port(self) -> int:
+        return self._port
+
+    def ready(self) -> bool:
+        return True
+
+    def shutdown(self) -> None:
+        self._server.stop(grace=None)

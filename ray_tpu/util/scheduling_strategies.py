@@ -1,0 +1,31 @@
+"""Public scheduling-strategy types (reference:
+python/ray/util/scheduling_strategies.py — NodeAffinitySchedulingStrategy,
+PlacementGroupSchedulingStrategy, and the "DEFAULT"/"SPREAD" strings
+accepted by @remote(scheduling_strategy=...)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.util.placement_group import PlacementGroupSchedulingStrategy
+
+__all__ = [
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin to a node by id; soft=True falls back to the default policy when
+    the node is dead or lacks capacity (reference:
+    scheduling_strategies.py NodeAffinitySchedulingStrategy)."""
+
+    node_id: str
+    soft: bool = False
+
+    def to_scheduling_strategy(self) -> SchedulingStrategy:
+        return SchedulingStrategy(kind="NODE_AFFINITY",
+                                  node_id_hex=self.node_id, soft=self.soft)

@@ -438,3 +438,55 @@ def test_chunked_pull_large_object(cluster, monkeypatch):
     np.testing.assert_allclose(arr[:5], [0, 1, 2, 3, 4])
     assert float(arr[-1]) == 1_499_999.0
     assert pulls and pulls[0] > 1_000_000  # the chunked path actually ran
+
+
+def test_task_scheduling_strategies(tmp_path):
+    """SPREAD round-robins tasks across feasible nodes; NODE_AFFINITY pins
+    (hard) or falls back (soft) — reference: raylet scheduling policies +
+    util/scheduling_strategies.py."""
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    c = Cluster()
+    n1 = c.add_node(num_cpus=2, node_id="node-aaa")
+    n2 = c.add_node(num_cpus=2, node_id="node-bbb")
+    rt = c.connect()
+    old = (global_worker.runtime, global_worker.worker_id,
+           global_worker.node_id, global_worker.mode)
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    try:
+        @remote
+        def where():
+            return os.environ["RTPU_NODE_ID"]
+
+        # SPREAD: consecutive tasks land on BOTH nodes
+        spread = where.options(scheduling_strategy="SPREAD", num_cpus=1)
+        nodes = set(ray_tpu.get([spread.remote() for _ in range(4)],
+                                timeout=120))
+        assert nodes == {"node-aaa", "node-bbb"}, nodes
+
+        # NODE_AFFINITY hard: every task lands on the pinned node
+        pin = where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id="node-bbb"), num_cpus=1)
+        assert set(ray_tpu.get([pin.remote() for _ in range(3)],
+                               timeout=120)) == {"node-bbb"}
+
+        # NODE_AFFINITY soft to a dead node: falls back to a live one
+        soft = where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id="node-dead", soft=True), num_cpus=1)
+        assert ray_tpu.get(soft.remote(), timeout=120) in ("node-aaa",
+                                                           "node-bbb")
+
+        # hard affinity to a dead node fails loudly
+        hard = where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id="node-dead"), num_cpus=1)
+        with pytest.raises(Exception):
+            ray_tpu.get(hard.remote(), timeout=60)
+    finally:
+        rt.shutdown()
+        c.shutdown()
+        (global_worker.runtime, global_worker.worker_id,
+         global_worker.node_id, global_worker.mode) = old
