@@ -41,6 +41,10 @@ class LLMConfig:
     # prefill load; reference shape: vLLM enable_chunked_prefill).
     prefill_chunk: int = 512
     engine_kwargs: dict[str, Any] = field(default_factory=dict)
+    # Per-replica gang placement (reference: llm_config.py:181
+    # placement_group_config): {"bundles": [{...}, ...], "strategy": "PACK"}.
+    # Bundle 0 hosts the replica actor; the rest reserve TP/PP worker hosts.
+    placement_group_config: dict | None = None
 
     def model_config(self) -> LlamaConfig:
         if isinstance(self.model, LlamaConfig):

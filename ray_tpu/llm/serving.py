@@ -151,12 +151,19 @@ def build_llm_deployment(llm_config: LLMConfig, *,
                          num_replicas: int = 1,
                          max_ongoing_requests: int | None = None):
     """The LLMServer as a serve deployment (reference:
-    build_llm_deployment / LLMServer.as_deployment)."""
+    build_llm_deployment / LLMServer.as_deployment). A
+    placement_group_config on the LLMConfig gives each replica its own
+    gang PG — the multi-host shape where bundle 0 hosts the replica actor
+    and the rest reserve the TP/PP worker hosts (reference:
+    llm_config.py:181 placement_group_config)."""
+    pgc = llm_config.placement_group_config or {}
     return serve.deployment(
         name=name,
         num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests or llm_config.max_num_seqs,
         health_check_period_s=2.0,
+        placement_group_bundles=pgc.get("bundles"),
+        placement_group_strategy=pgc.get("strategy", "PACK"),
     )(LLMServer)
 
 

@@ -125,7 +125,8 @@ def run(target: Application, *, name: str = "default",
         gproxy = ray_tpu.get_actor(_GRPC_PROXY_NAME,
                                    namespace=SERVE_NAMESPACE)
         routes = ray_tpu.get(controller.get_routes.remote())
-        ray_tpu.get(gproxy.update_routes.remote(routes, {name: ingress}))
+        apps = ray_tpu.get(controller.get_app_ingresses.remote())
+        ray_tpu.get(gproxy.update_routes.remote(routes, apps))
 
     return DeploymentHandle(ingress, app_name=name)
 

@@ -35,6 +35,12 @@ class DeploymentConfig:
 
     # resources per replica
     ray_actor_options: dict = field(default_factory=dict)
+    # Gang resources per replica (reference: serve deployment
+    # placement_group_bundles/strategy — each replica gets its own PG and
+    # its actor runs in bundle 0; multi-host LLM replicas reserve one
+    # bundle per TP/PP worker host via LLMConfig.placement_group_config).
+    placement_group_bundles: list | None = None
+    placement_group_strategy: str = "PACK"
 
 
 @dataclass

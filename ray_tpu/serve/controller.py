@@ -40,6 +40,7 @@ class _Replica:
     consecutive_failures: int = 0
     drain_ref: Any = None
     stop_deadline: float = 0.0
+    pg: Any = None  # per-replica gang placement group, if configured
 
 
 @dataclass
@@ -70,6 +71,7 @@ class ServeController:
         self._deployments: dict[str, _DeploymentState] = {}
         self._apps: dict[str, list[str]] = {}
         self._routes: dict[str, str] = {}  # route_prefix -> deployment name
+        self._app_ingress: dict[str, str] = {}  # app name -> ingress dep
         self._long_poll = LongPollHost()
         self._shutdown = threading.Event()
         self._thread = threading.Thread(target=self._control_loop, daemon=True)
@@ -106,12 +108,17 @@ class ServeController:
             for stale in old - set(new_names):
                 self._deployments[stale].deleting = True
             self._apps[app_name] = new_names
+            if ingress_name:
+                # gRPC routes by app name even when there is no HTTP route
+                # prefix (route_prefix=None).
+                self._app_ingress[app_name] = ingress_name
             if ingress_name and route_prefix is not None:
                 self._routes[route_prefix] = ingress_name
                 self._long_poll.notify_changed("routes", dict(self._routes))
 
     def delete_application(self, app_name: str) -> None:
         with self._lock:
+            self._app_ingress.pop(app_name, None)
             for name in self._apps.pop(app_name, []):
                 if name in self._deployments:
                     self._deployments[name].deleting = True
@@ -132,6 +139,12 @@ class ServeController:
     def get_routes(self) -> dict[str, str]:
         with self._lock:
             return dict(self._routes)
+
+    def get_app_ingresses(self) -> dict[str, str]:
+        """app name -> ingress deployment, including HTTP-less (gRPC-only,
+        route_prefix=None) applications."""
+        with self._lock:
+            return dict(self._app_ingress)
 
     def status(self) -> dict[str, DeploymentStatus]:
         with self._lock:
@@ -210,24 +223,95 @@ class ServeController:
     def _start_replica(self, ds: _DeploymentState) -> None:
         rid = uuid.uuid4().hex[:8]
         actor_name = f"SERVE_REPLICA::{ds.name}#{rid}"
-        opts = dict(ds.config.ray_actor_options)
-        Remote = ray_tpu.remote(ServeReplica)
-        actor = Remote.options(
-            name=actor_name, namespace="serve",
-            num_cpus=opts.get("num_cpus", 0),
-            num_tpus=opts.get("num_tpus", 0),
-            resources=opts.get("resources"),
-            max_concurrency=ds.config.max_ongoing_requests + 4,
-        ).remote(ds.name, rid, ds.cls_blob, ds.init_args_blob,
-                 ds.config.user_config)
-        rep = _Replica(replica_id=rid, actor_name=actor_name, actor=actor,
+        if ds.config.placement_group_bundles:
+            # Gang reservation per replica (reference: serve deployment
+            # placement_group_bundles; ray.llm replica PGs hold the TP/PP
+            # worker hosts). The PG 2PC commits asynchronously, so the
+            # replica record starts actor-less and _check_starting launches
+            # the actor once the PG reports CREATED — never blocking the
+            # control loop on reservation.
+            from ray_tpu.util.placement_group import placement_group
+
+            try:
+                pg = placement_group(
+                    [dict(b) for b in ds.config.placement_group_bundles],
+                    strategy=ds.config.placement_group_strategy)
+            except Exception as e:  # noqa: BLE001 - bad bundle config
+                ds.message = f"placement group creation failed: {e!r}"
+                return
+            rep = _Replica(replica_id=rid, actor_name=actor_name, actor=None,
+                           version=ds.version, pg=pg)
+            rep.stop_deadline = time.monotonic() + 60.0  # PG-wait deadline
+            ds.replicas.append(rep)
+            return
+        rep = _Replica(replica_id=rid, actor_name=actor_name, actor=None,
                        version=ds.version)
-        rep.ready_ref = actor.get_metrics.remote()  # readiness probe
         ds.replicas.append(rep)
+        self._launch_replica_actor(ds, rep)
+
+    def _launch_replica_actor(self, ds: _DeploymentState,
+                              rep: _Replica) -> None:
+        opts = dict(ds.config.ray_actor_options)
+        sched_kw = {}
+        if rep.pg is not None:
+            from ray_tpu.util.placement_group import (
+                PlacementGroupSchedulingStrategy)
+
+            sched_kw["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                placement_group=rep.pg, placement_group_bundle_index=0)
+        Remote = ray_tpu.remote(ServeReplica)
+        try:
+            rep.actor = Remote.options(
+                name=rep.actor_name, namespace="serve",
+                num_cpus=opts.get("num_cpus", 0),
+                num_tpus=opts.get("num_tpus", 0),
+                resources=opts.get("resources"),
+                max_concurrency=ds.config.max_ongoing_requests + 4,
+                **sched_kw,
+            ).remote(ds.name, rep.replica_id, ds.cls_blob, ds.init_args_blob,
+                     ds.config.user_config)
+        except Exception as e:  # noqa: BLE001 - infeasible/registration fail
+            ds.message = f"replica actor creation failed: {e!r}"
+            self._release_pg(rep)
+            ds.replicas.remove(rep)
+            return
+        rep.ready_ref = rep.actor.get_metrics.remote()  # readiness probe
+
+    def _release_pg(self, rep: _Replica) -> None:
+        if rep.pg is None:
+            return
+        try:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            remove_placement_group(rep.pg)
+        except Exception:  # noqa: BLE001
+            pass
+        rep.pg = None
 
     def _check_starting(self, ds: _DeploymentState) -> None:
-        for r in ds.replicas:
+        from ray_tpu.core.worker import global_worker
+
+        now = time.monotonic()
+        for r in list(ds.replicas):
             if r.state != STARTING:
+                continue
+            if r.actor is None:
+                # Waiting on the gang PG's async 2PC (non-blocking poll).
+                try:
+                    state = global_worker.runtime.placement_group_state(
+                        r.pg.id)
+                except Exception:  # noqa: BLE001
+                    state = "PENDING"
+                if state == "CREATED":
+                    r.stop_deadline = 0.0
+                    self._launch_replica_actor(ds, r)
+                elif state in ("REMOVED", "FAILED") or now > r.stop_deadline:
+                    ds.message = (f"replica {r.replica_id} placement group "
+                                  f"not satisfiable (state {state})")
+                    self._release_pg(r)
+                    ds.replicas.remove(r)
+                continue
+            if r.ready_ref is None:
                 continue
             ready, _ = ray_tpu.wait([r.ready_ref], num_returns=1, timeout=0)
             if ready:
@@ -327,6 +411,10 @@ class ServeController:
             return
         was_running = r.state == RUNNING
         r.state = STOPPING
+        if r.actor is None:  # PG-pending replica: nothing to kill/drain
+            self._release_pg(r)
+            r.stop_deadline = 0.0
+            return
         if force or not was_running:
             try:
                 ray_tpu.kill(r.actor)
@@ -356,4 +444,5 @@ class ServeController:
                 except Exception:
                     pass
             # else: already killed; drop the record
+            self._release_pg(r)
         ds.replicas = keep

@@ -14,6 +14,28 @@ from typing import Any, Callable
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 
 
+def _validate_pg_options(bundles: list | None, strategy: str) -> None:
+    """Fail fast at declaration time (reference: serve validates deployment
+    options client-side) — a bad gang config otherwise only surfaces as an
+    opaque serve.run timeout from the controller's reconcile loop."""
+    from ray_tpu.util.placement_group import VALID_STRATEGIES
+
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"placement_group_strategy must be one of {VALID_STRATEGIES}, "
+            f"got {strategy!r}")
+    if bundles is None:
+        return
+    if not bundles or not all(
+            isinstance(b, dict) and b
+            and all(isinstance(v, (int, float)) and v > 0
+                    for v in b.values())
+            for b in bundles):
+        raise ValueError(
+            "placement_group_bundles must be a non-empty list of non-empty "
+            f"{{resource: positive amount}} dicts, got {bundles!r}")
+
+
 class Application:
     """A bound deployment node (reference: serve/_private/build_app.py)."""
 
@@ -39,7 +61,9 @@ class Deployment:
                 user_config: Any = None, version: str | None = None,
                 health_check_period_s: float | None = None,
                 graceful_shutdown_timeout_s: float | None = None,
-                ray_actor_options: dict | None = None) -> "Deployment":
+                ray_actor_options: dict | None = None,
+                placement_group_bundles: list | None = None,
+                placement_group_strategy: str | None = None) -> "Deployment":
         cfg = replace(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
@@ -59,6 +83,14 @@ class Deployment:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         if ray_actor_options is not None:
             cfg.ray_actor_options = ray_actor_options
+        if placement_group_bundles is not None:
+            cfg.placement_group_bundles = placement_group_bundles
+        if placement_group_strategy is not None:
+            cfg.placement_group_strategy = placement_group_strategy
+        if (placement_group_bundles is not None
+                or placement_group_strategy is not None):
+            _validate_pg_options(cfg.placement_group_bundles,
+                                 cfg.placement_group_strategy)
         return Deployment(self.func_or_class, name or self.name, cfg)
 
 
@@ -69,10 +101,16 @@ def deployment(_func_or_class: Callable | None = None, *,
                user_config: Any = None, version: str | None = None,
                health_check_period_s: float = 1.0,
                graceful_shutdown_timeout_s: float = 5.0,
-               ray_actor_options: dict | None = None):
+               ray_actor_options: dict | None = None,
+               placement_group_bundles: list | None = None,
+               placement_group_strategy: str = "PACK"):
     """``@serve.deployment`` (reference: serve/api.py deployment decorator)."""
 
     def deco(func_or_class: Callable) -> Deployment:
+        if placement_group_bundles is not None or \
+                placement_group_strategy != "PACK":
+            _validate_pg_options(placement_group_bundles,
+                                 placement_group_strategy)
         if isinstance(autoscaling_config, dict):
             asc = AutoscalingConfig(**autoscaling_config)
         else:
@@ -86,6 +124,8 @@ def deployment(_func_or_class: Callable | None = None, *,
             health_check_period_s=health_check_period_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=ray_actor_options or {},
+            placement_group_bundles=placement_group_bundles,
+            placement_group_strategy=placement_group_strategy,
         )
         return Deployment(func_or_class,
                           name or func_or_class.__name__, cfg)

@@ -92,6 +92,8 @@ class GrpcProxyActor:
                 # deterministic default: shortest route prefix (the "/" app)
                 route = sorted(self._routes)[0]
                 return self._routes[route]
+            if len(self._apps) == 1:  # single gRPC-only app: route to it
+                return next(iter(self._apps.values()))
         return None
 
     def _call(self, method: str, request: bytes, context, stream: bool):
@@ -123,18 +125,13 @@ class GrpcProxyActor:
 
     def update_routes(self, routes: dict[str, str],
                       apps: dict[str, str] | None = None) -> None:
+        """``apps`` is the controller's authoritative app→ingress map
+        (get_app_ingresses), which includes gRPC-only route_prefix=None
+        applications the HTTP route table can't represent."""
         with self._lock:
             self._routes = dict(routes)
             if apps is not None:
-                # Merge: each serve.run() pushes only ITS app's ingress;
-                # replacing wholesale would break `application` metadata
-                # routing for previously deployed apps.
-                self._apps.update(apps)
-                # Drop apps whose ingress no longer appears in any route
-                # (deleted applications).
-                live = set(routes.values())
-                self._apps = {a: d for a, d in self._apps.items()
-                              if d in live}
+                self._apps = dict(apps)
 
     def port(self) -> int:
         return self._port

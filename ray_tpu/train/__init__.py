@@ -16,13 +16,13 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.controller import Result, TrainController
-from ray_tpu.train.session import get_context, report
+from ray_tpu.train.session import get_context, get_dataset_shard, report
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 
 __all__ = [
     "JaxTrainer", "DataParallelTrainer", "TrainController", "Result",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
-    "JaxBackendConfig", "get_context", "report",
+    "JaxBackendConfig", "get_context", "get_dataset_shard", "report",
     "Checkpoint", "CheckpointManager", "save_pytree", "restore_pytree",
 ]
 

@@ -37,9 +37,11 @@ class TrainController:
 
     def __init__(self, train_fn: Callable, train_loop_config: dict | None,
                  scaling_config: ScalingConfig, run_config: RunConfig,
-                 backend_config: JaxBackendConfig | None = None):
+                 backend_config: JaxBackendConfig | None = None,
+                 datasets: dict | None = None):
         self.train_fn = train_fn
         self.train_loop_config = train_loop_config
+        self.datasets = datasets or {}
         self.scaling = scaling_config
         self.run_config = run_config
         self.backend_config = backend_config or JaxBackendConfig()
@@ -91,6 +93,15 @@ class TrainController:
                 group.setup(coordinator, restart_count,
                             latest.path if latest else None)
                 self.backend_config.make_backend().on_start(group, coordinator)
+                if self.datasets:
+                    # Split per (re)start so elastic world-size changes get
+                    # fresh equal splits (reference: datasets= are
+                    # streaming_split across the current worker group).
+                    splits = {name: ds.streaming_split(world, equal=True)
+                              for name, ds in self.datasets.items()}
+                    group.assign_dataset_shards([
+                        {name: its[rank] for name, its in splits.items()}
+                        for rank in range(world)])
                 group.run(self.train_fn, self.train_loop_config)
                 result = self._poll_until_done(group)
                 self._status = "FINISHED" if result.ok else "ERRORED"

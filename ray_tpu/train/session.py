@@ -27,6 +27,7 @@ class TrainContext:
     latest_checkpoint: str | None = None  # dir path, set on restore
 
     # filled by the worker harness
+    dataset_shards: dict = field(default_factory=dict)  # name -> DataIterator
     _reports: list[dict] = field(default_factory=list)
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
 
@@ -41,6 +42,16 @@ class TrainContext:
 
     def get_checkpoint(self) -> str | None:
         return self.latest_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        """This worker's streaming split of a Trainer dataset (reference:
+        ray.train.get_dataset_shard — v2 DataParallelTrainer datasets= are
+        streaming_split across the worker group)."""
+        if name not in self.dataset_shards:
+            raise KeyError(
+                f"no dataset {name!r}; Trainer(datasets={{...}}) keys: "
+                f"{sorted(self.dataset_shards)}")
+        return self.dataset_shards[name]
 
 
 _local = threading.local()
@@ -70,3 +81,8 @@ def drain_reports(ctx: TrainContext) -> list[dict]:
     with ctx._report_lock:
         out, ctx._reports = ctx._reports, []
     return out
+
+
+def get_dataset_shard(name: str = "train"):
+    """Module-level alias (reference: ray.train.get_dataset_shard)."""
+    return get_context().get_dataset_shard(name)

@@ -25,12 +25,14 @@ class DataParallelTrainer:
                  *, train_loop_config: dict | None = None,
                  scaling_config: ScalingConfig | None = None,
                  run_config: RunConfig | None = None,
-                 backend_config: Any = None):
+                 backend_config: Any = None,
+                 datasets: dict | None = None):
         self.train_fn = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.backend_config = backend_config or self.backend_config_cls()
+        self.datasets = datasets
 
     def fit(self) -> Result:
         ray_tpu.api.init()  # no-op if already connected
@@ -40,7 +42,7 @@ class DataParallelTrainer:
             max_concurrency=2,
         ).remote(
             self.train_fn, self.train_loop_config, self.scaling_config,
-            self.run_config, self.backend_config,
+            self.run_config, self.backend_config, self.datasets,
         )
         return ray_tpu.get(controller.run.remote(), timeout=None)
 

@@ -43,6 +43,10 @@ class TrainWorker:
         self.ctx.latest_checkpoint = latest_checkpoint
         return True
 
+    def set_dataset_shards(self, shards: dict) -> bool:
+        self.ctx.dataset_shards = dict(shards)
+        return True
+
     def run(self, train_fn: Callable, config: dict | None) -> bool:
         if self._status == "RUNNING":
             raise RuntimeError("worker already running")
@@ -122,6 +126,11 @@ class WorkerGroup:
             w.setup_env.remote(coordinator_addr, restart_count, latest_checkpoint)
             for w in self.workers
         ], timeout=120)
+
+    def assign_dataset_shards(self, per_rank: list[dict]) -> None:
+        """per_rank[i] = {name: DataIterator} for worker rank i."""
+        ray_tpu.get([w.set_dataset_shards.remote(per_rank[i])
+                     for i, w in enumerate(self.workers)], timeout=120)
 
     def run(self, train_fn: Callable, config: dict | None):
         ray_tpu.get([w.run.remote(train_fn, config) for w in self.workers],

@@ -426,3 +426,97 @@ def test_grpc_ingress(rt_start):
         chan.close()
     finally:
         serve.shutdown()
+
+
+def test_replica_placement_group(rt_start):
+    """placement_group_bundles gives each replica a gang PG; the replica
+    actor runs in bundle 0 and the PG is removed when the replica stops
+    (reference: serve placement_group_bundles / ray.llm replica PGs)."""
+    from ray_tpu import serve
+
+    @serve.deployment(placement_group_bundles=[{"CPU": 1.0}, {"CPU": 1.0}],
+                      placement_group_strategy="PACK")
+    class Gang:
+        def __call__(self, req):
+            return "ok"
+
+    serve.run(Gang.bind(), route_prefix="/")
+    try:
+        h = serve.get_app_handle()
+        assert h.remote(None).result(timeout=30) == "ok"
+        # a PG exists for the replica
+        from ray_tpu.util.state.api import list_placement_groups
+        pgs = list_placement_groups()
+        assert any(p["state"] == "CREATED" for p in pgs), pgs
+    finally:
+        serve.shutdown()
+    # after shutdown the replica PG is released
+    from ray_tpu.util.state.api import list_placement_groups
+    pgs = [p for p in list_placement_groups() if p["state"] == "CREATED"]
+    assert not pgs, pgs
+
+
+def test_grpc_only_app_no_http_route(rt_start):
+    """A gRPC-only application (route_prefix=None) stays routable via the
+    controller's app-ingress map (grpc_proxy.py update_routes)."""
+    import grpc
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class G:
+        def __call__(self, req):
+            return b"grpc-only"
+
+    serve.run(G.bind(), name="gonly", route_prefix=None, grpc=True)
+    try:
+        chan = grpc.insecure_channel(f"127.0.0.1:{serve.grpc_port()}")
+        unary = chan.unary_unary("/x.Y/Z", request_serializer=None,
+                                 response_deserializer=None)
+        assert unary(b"", metadata=(("application", "gonly"),),
+                     timeout=30) == b"grpc-only"
+        # single-app default routing works without metadata too
+        assert unary(b"", timeout=30) == b"grpc-only"
+        chan.close()
+    finally:
+        serve.shutdown()
+
+
+def test_pg_options_validated_at_declaration():
+    from ray_tpu import serve
+
+    with pytest.raises(ValueError, match="strategy"):
+        serve.deployment(placement_group_bundles=[{"CPU": 1}],
+                         placement_group_strategy="pack")(object)
+    with pytest.raises(ValueError, match="bundles"):
+        serve.deployment(placement_group_bundles=[{}])(object)
+
+
+def test_infeasible_pg_does_not_wedge_controller(rt_start):
+    """An unsatisfiable gang PG must not block reconciliation: a healthy
+    app deployed afterwards still comes up while the infeasible one stays
+    pending (controller.py non-blocking PG startup)."""
+    from ray_tpu import serve
+
+    @serve.deployment(placement_group_bundles=[{"CPU": 512.0}])
+    class Huge:
+        def __call__(self, req):
+            return "huge"
+
+    @serve.deployment
+    class Small:
+        def __call__(self, req):
+            return "small"
+
+    import pytest as _pytest
+
+    with _pytest.raises(TimeoutError):
+        serve.run(Huge.bind(), name="huge", route_prefix="/huge",
+                  _blocking_timeout=3.0)
+    # the controller is still responsive: a normal app deploys fine
+    serve.run(Small.bind(), name="small", route_prefix="/small")
+    try:
+        h = serve.get_deployment_handle("Small", app_name="small")
+        assert h.remote(None).result(timeout=30) == "small"
+    finally:
+        serve.shutdown()

@@ -356,3 +356,39 @@ def test_checkpoint_restore_at_different_world_size(cpu_mesh_devices, tmp_path):
     np.testing.assert_allclose(np.asarray(restored["w"]),
                                np.arange(64.0).reshape(8, 8))
     assert int(restored["step"]) == 5
+
+
+def test_trainer_dataset_ingest(tmp_path):
+    """datasets= are streaming_split across the worker group and consumed
+    via get_dataset_shard (reference: DataParallelTrainer datasets= +
+    ray.train.get_dataset_shard; VERDICT M1 ingest wiring)."""
+    import ray_tpu.data as rdata
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.train.config import RunConfig, ScalingConfig
+
+    def loop(config):
+        from ray_tpu.train import get_dataset_shard, session
+
+        it = get_dataset_shard("train")
+        seen = []
+        for batch in it.iter_batches(batch_size=8):
+            seen.extend(int(v) for v in batch["id"])
+        session.report({"n": len(seen), "sum": sum(seen)})
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        ds = rdata.range(64, parallelism=8)
+        trainer = JaxTrainer(
+            loop, datasets={"train": ds},
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="ingest", storage_path=str(tmp_path)))
+        result = trainer.fit()
+        assert result.ok, result.error
+        # both ranks together see every row exactly once
+        reports = result.metrics_history
+        assert sum(r["n"] for r in reports) == 64
+        assert sum(r["sum"] for r in reports) == sum(range(64))
+        # equal split: each worker got half
+        assert {r["n"] for r in reports} == {32}
+    finally:
+        ray_tpu.shutdown()
