@@ -560,21 +560,39 @@ class HeadServer:
                 return
             assignment = self._assign_bundles(pg["bundles"], pg["strategy"])
             if assignment is not None:
-                prepared: list[int] = []
-                ok = True
+                # Per-node concurrent prepares (reference 2PC semantics;
+                # sequential per-bundle RPCs made PG churn latency scale
+                # with bundle count).
+                by_node: dict[str, list[int]] = {}
                 for idx, nid in enumerate(assignment):
+                    by_node.setdefault(nid, []).append(idx)
+
+                async def _prepare_node(nid: str, idxs: list[int]):
+                    # Never raises: a partial failure still reports the
+                    # bundles that DID prepare so rollback can return them.
+                    got: list[int] = []
                     try:
                         cli = await self._daemon_rpc(nid)
-                        res = await cli.call("prepare_bundle", pg_id=pg_id,
-                                             bundle_index=idx,
-                                             resources=pg["bundles"][idx])
-                        if not res.get("ok"):
-                            ok = False
-                            break
-                        prepared.append(idx)
-                    except Exception:
-                        ok = False
-                        break
+                        for idx in idxs:
+                            res = await cli.call(
+                                "prepare_bundle", pg_id=pg_id,
+                                bundle_index=idx,
+                                resources=pg["bundles"][idx])
+                            if not res.get("ok"):
+                                return got, False
+                            got.append(idx)
+                    except Exception:  # noqa: BLE001 - node/RPC failure
+                        return got, False
+                    return got, True
+
+                prepared: list[int] = []
+                ok = True
+                results = await asyncio.gather(
+                    *(_prepare_node(nid, idxs)
+                      for nid, idxs in by_node.items()))
+                for got, node_ok in results:
+                    prepared.extend(got)
+                    ok = ok and node_ok
                 # A remove() may have arrived while prepares were in flight —
                 # honor it before committing anything.
                 if pg["state"] == "REMOVED":
@@ -583,11 +601,24 @@ class HeadServer:
                 if ok:
                     committed: list[int] = []
                     try:
-                        for idx, nid in enumerate(assignment):
+                        async def _commit_node(nid: str, idxs: list[int]):
                             cli = await self._daemon_rpc(nid)
-                            await cli.call("commit_bundle", pg_id=pg_id,
-                                           bundle_index=idx)
-                            committed.append(idx)
+                            for idx in idxs:
+                                await cli.call("commit_bundle", pg_id=pg_id,
+                                               bundle_index=idx)
+                                committed.append(idx)
+
+                        # return_exceptions: every node's coroutine runs to
+                        # completion BEFORE any rollback decision — a plain
+                        # gather would roll back while a surviving node is
+                        # still committing, leaking its bundle afterwards.
+                        cres = await asyncio.gather(
+                            *(_commit_node(nid, idxs)
+                              for nid, idxs in by_node.items()),
+                            return_exceptions=True)
+                        for c in cres:
+                            if isinstance(c, BaseException):
+                                raise c
                     except Exception:
                         # A node died mid-commit: roll back everything (bundle
                         # return works for both prepared and committed) and

@@ -119,6 +119,22 @@ class LocalRuntime:
         totals.update(resources or {})
         self.worker_id = WorkerID.from_random()
         self.store = LocalObjectStore()
+        # Event-driven wait(): seals notify the condition so wait() wakes
+        # immediately instead of polling (same pattern as the cluster
+        # runtime's _wait_cond — reference: wait_manager.cc callbacks).
+        self._wait_cond = threading.Condition()
+
+        def _notify():
+            with self._wait_cond:
+                self._wait_cond.notify_all()
+
+        self.store.on_seal = _notify
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._task_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="task")
+        self._tasks_inflight = 0  # includes tasks blocked in nested get()
+        self._inflight_lock = threading.Lock()
         self._released: set[ObjectID] = set()
         # container object -> ObjectIDs nested inside its stored value
         # (reference semantics: reference_counter.h nested refs keep the inner
@@ -159,21 +175,49 @@ class LocalRuntime:
         self._register_nested(oid, value)
         return ObjectRef(oid, self.worker_id)
 
+    def _yield_task_resources(self):
+        """Release the calling task's acquired resources for the duration of
+        a blocking get()/wait() and re-acquire afterwards (reference: a
+        worker blocked in ray.get returns its CPU to the raylet so the
+        tasks it waits on can run — otherwise parents waiting on children
+        deadlock the resource ledger)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            from ray_tpu.core.worker import _task_context
+
+            res = getattr(_task_context, "resources", None)
+            # Actors hold their resources for their lifetime (reference:
+            # actor resources are not returned while blocked) — only plain
+            # tasks yield.
+            if not res or getattr(_task_context, "actor_id", None) is not None:
+                yield
+                return
+            self.resources.release(res)
+            try:
+                yield
+            finally:
+                self.resources.acquire(res, timeout=None)
+
+        return cm()
+
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
         import time as _time
 
         deadline = None if timeout is None else _time.monotonic() + timeout
         out = []
-        for ref in refs:
-            remaining = None if deadline is None else max(0.0, deadline - _time.monotonic())
-            try:
-                data = self.store.get(ref.id, timeout=remaining)
-            except TimeoutError:
-                raise GetTimeoutError(f"get() timed out waiting for {ref}") from None
-            value = serialization.deserialize(data)
-            if isinstance(value, (TaskError, ActorDiedError, TaskCancelledError)):
-                raise value
-            out.append(value)
+        with self._yield_task_resources():
+            for ref in refs:
+                remaining = None if deadline is None else max(0.0, deadline - _time.monotonic())
+                try:
+                    data = self.store.get(ref.id, timeout=remaining)
+                except TimeoutError:
+                    raise GetTimeoutError(f"get() timed out waiting for {ref}") from None
+                value = serialization.deserialize(data)
+                if isinstance(value, (TaskError, ActorDiedError, TaskCancelledError)):
+                    raise value
+                out.append(value)
         return out
 
     def wait(
@@ -188,6 +232,12 @@ class LocalRuntime:
         deadline = None if timeout is None else _time.monotonic() + timeout
         ready: list[ObjectRef] = []
         pending = list(refs)
+        with self._yield_task_resources():
+            return self._wait_loop(ready, pending, num_returns, deadline)
+
+    def _wait_loop(self, ready, pending, num_returns, deadline):
+        import time as _time
+
         while len(ready) < num_returns:
             progressed = False
             still = []
@@ -203,7 +253,16 @@ class LocalRuntime:
             if deadline is not None and _time.monotonic() >= deadline:
                 break
             if not progressed:
-                _time.sleep(0.001)
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - _time.monotonic()))
+                with self._wait_cond:
+                    # Recheck under the lock: a seal between the scan above
+                    # and this acquire would otherwise be a lost wakeup
+                    # (notify_all fires outside the store lock, so this
+                    # nesting cannot deadlock).
+                    if not any(self.store.contains(r.id) for r in pending):
+                        self._wait_cond.wait(
+                            0.05 if remaining is None else min(remaining, 0.05))
         return ready, pending
 
     # ------------------------------------------------------------------ tasks
@@ -215,15 +274,30 @@ class LocalRuntime:
         global_event_buffer().record(
             spec.task_id.hex(), spec.name, "SUBMITTED",
             worker_id=self.worker_id.hex(), job_id=spec.job_id.hex())
-        # Thread-per-task: a task blocked on dependencies or on a nested get()
-        # never starves other tasks of execution threads (the reference frees
-        # the leased worker's CPU while a task blocks in ray.get).
-        t = threading.Thread(
-            target=self._run_normal_task, args=(spec, return_ids), daemon=True,
-            name=f"task-{spec.name[:24]}",
-        )
-        t.start()
+        # Pooled execution threads: ThreadPoolExecutor reuses idle threads
+        # (thread-per-task spent ~0.2 ms/task on thread start alone). The
+        # thread-per-task property that mattered — a task blocked on a
+        # nested get() never starves the tasks it waits on — is preserved
+        # by overflow: when every pool thread is occupied (possibly all
+        # blocked in nested gets), new submissions get dedicated threads
+        # instead of queueing behind the blocked ones.
+        with self._inflight_lock:
+            self._tasks_inflight += 1
+            overflow = self._tasks_inflight > 64
+        if overflow:
+            threading.Thread(
+                target=self._run_pooled, args=(spec, return_ids),
+                daemon=True, name=f"task-ovf-{spec.name[:20]}").start()
+        else:
+            self._task_pool.submit(self._run_pooled, spec, return_ids)
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+
+    def _run_pooled(self, spec: TaskSpec, return_ids: list[ObjectID]) -> None:
+        try:
+            self._run_normal_task(spec, return_ids)
+        finally:
+            with self._inflight_lock:
+                self._tasks_inflight -= 1
 
     def _run_normal_task(self, spec: TaskSpec, return_ids: list[ObjectID]) -> None:
         from ray_tpu.core.events import task_execution
@@ -643,3 +717,4 @@ class LocalRuntime:
             actors = list(self._actors.values())
         for st in actors:
             st.mailbox.put(None)
+        self._task_pool.shutdown(wait=False, cancel_futures=True)

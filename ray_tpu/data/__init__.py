@@ -4,6 +4,8 @@ object-store refs, per-train-worker streaming_split)."""
 
 from __future__ import annotations
 
+import builtins
+
 from typing import Any
 
 # Eagerly finish every heavy IO import while single-threaded: pyarrow and
@@ -97,6 +99,22 @@ def from_arrow(table) -> Dataset:
     return from_blocks([block_from_arrow(table)])
 
 
+def from_huggingface(hf_dataset, *, rows_per_block: int = 4096) -> Dataset:
+    """A Dataset over a HuggingFace ``datasets.Dataset`` (reference:
+    ray.data.from_huggingface). Rows are chunked into column-dict blocks."""
+    import numpy as np
+
+    blocks = []
+    n = len(hf_dataset)
+    cols = hf_dataset.column_names
+    for start in builtins.range(0, n, rows_per_block):
+        sl = hf_dataset[start:start + rows_per_block]
+        blocks.append({c: np.asarray(sl[c]) for c in cols})
+    if not blocks:
+        blocks = [{c: np.asarray([]) for c in cols}]
+    return from_blocks(blocks)
+
+
 def from_blocks(blocks: list[Block]) -> MaterializedDataset:
     import ray_tpu
 
@@ -127,6 +145,7 @@ __all__ = [
     "Sum",
     "from_arrow",
     "from_blocks",
+    "from_huggingface",
     "from_items",
     "from_numpy",
     "from_pandas",

@@ -212,6 +212,35 @@ class Dataset:
             shuffle_seed=local_shuffle_seed,
         )
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: int | None = 256,
+        dtypes=None,
+        device: str = "cpu",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: int | None = None,
+        local_shuffle_seed: int | None = None,
+    ) -> Iterator[Any]:
+        """Batches as dicts of torch tensors (reference:
+        Dataset.iter_torch_batches — dataset.py:5650 family). ``dtypes``
+        maps column name → torch dtype (or one dtype for all columns)."""
+        import torch
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last,
+                local_shuffle_buffer_size=local_shuffle_buffer_size,
+                local_shuffle_seed=local_shuffle_seed):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(v)
+                dt = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+                if dt is not None:
+                    t = t.to(dt)
+                out[k] = t.to(device) if device != "cpu" else t
+            yield out
+
     def iter_jax_batches(
         self,
         *,

@@ -73,9 +73,15 @@ class _StageExec:
         self.api = api
         self.input_queue: collections.deque = collections.deque()
         self.upstream_done = False
-        # meta_ref -> (block_ref, actor_index|None)
+        # meta_ref -> (block_ref, actor_index|None, seq)
         self.in_flight: dict = {}
         self.outputs: collections.deque = collections.deque()
+        # Deterministic block order (reference: ray.data preserves block
+        # order end-to-end): tasks complete in any order, but outputs are
+        # released strictly in input order.
+        self._seq_in = 0
+        self._seq_out = 0
+        self._pending_out: dict[int, tuple] = {}
         self._remote_fn = api.remote(num_cpus=ctx.task_num_cpus, num_returns=2)(
             _run_block_fn
         )
@@ -111,28 +117,33 @@ class _StageExec:
     def launch(self) -> None:
         while self.can_launch():
             block_ref, _meta = self.input_queue.popleft()
+            seq = self._seq_in
+            self._seq_in += 1
             if self._pool is not None:
                 idx = min(range(len(self._pool)), key=lambda i: self._pool_load[i])
                 out_ref, meta_ref = self._pool[idx].apply.options(
                     num_returns=2
                 ).remote(block_ref)
                 self._pool_load[idx] += 1
-                self.in_flight[meta_ref] = (out_ref, idx)
+                self.in_flight[meta_ref] = (out_ref, idx, seq)
             else:
                 out_ref, meta_ref = self._remote_fn.remote(
                     self.stage.block_fn, block_ref
                 )
-                self.in_flight[meta_ref] = (out_ref, None)
+                self.in_flight[meta_ref] = (out_ref, None, seq)
 
     def collect_ready(self, ready_meta_refs: list) -> None:
         for meta_ref in ready_meta_refs:
             if meta_ref not in self.in_flight:
                 continue
-            out_ref, actor_idx = self.in_flight.pop(meta_ref)
+            out_ref, actor_idx, seq = self.in_flight.pop(meta_ref)
             if actor_idx is not None:
                 self._pool_load[actor_idx] -= 1
             meta = self.api.get(meta_ref)
-            self.outputs.append((out_ref, meta))
+            self._pending_out[seq] = (out_ref, meta)
+        while self._seq_out in self._pending_out:
+            self.outputs.append(self._pending_out.pop(self._seq_out))
+            self._seq_out += 1
 
     def shutdown(self) -> None:
         if self._pool:
@@ -210,9 +221,14 @@ def _stream_segment(initial, pending_source, stages, ctx, api):
 
     # feed initial materialized refs
     upstream_out = collections.deque(initial)
-    source_pending = dict(
-        (meta_ref, out_ref) for out_ref, meta_ref in pending_source
-    )
+    # Source blocks release in submission order even though read tasks
+    # complete in any order (deterministic block order, as above).
+    source_pending = {
+        meta_ref: (out_ref, i)
+        for i, (out_ref, meta_ref) in enumerate(pending_source)
+    }
+    src_buffer: dict[int, tuple] = {}
+    src_next = 0
     source_done = not source_pending
 
     slice_fn = api.remote(num_cpus=0, num_returns=2)(_slice_block)
@@ -297,9 +313,11 @@ def _stream_segment(initial, pending_source, stages, ctx, api):
             )
             for meta_ref in ready:
                 if meta_ref in source_pending:
-                    out_ref = source_pending.pop(meta_ref)
-                    meta = api.get(meta_ref)
-                    upstream_out.append((out_ref, meta))
+                    out_ref, idx = source_pending.pop(meta_ref)
+                    src_buffer[idx] = (out_ref, api.get(meta_ref))
+                    while src_next in src_buffer:
+                        upstream_out.append(src_buffer.pop(src_next))
+                        src_next += 1
                     if not source_pending:
                         source_done = True
                 else:

@@ -220,7 +220,7 @@ class ServeController:
 
     # -- replica lifecycle --
 
-    def _start_replica(self, ds: _DeploymentState) -> None:
+    def _start_replica(self, ds: _DeploymentState) -> "_Replica | None":
         rid = uuid.uuid4().hex[:8]
         actor_name = f"SERVE_REPLICA::{ds.name}#{rid}"
         if ds.config.placement_group_bundles:
@@ -238,16 +238,17 @@ class ServeController:
                     strategy=ds.config.placement_group_strategy)
             except Exception as e:  # noqa: BLE001 - bad bundle config
                 ds.message = f"placement group creation failed: {e!r}"
-                return
+                return None
             rep = _Replica(replica_id=rid, actor_name=actor_name, actor=None,
                            version=ds.version, pg=pg)
             rep.stop_deadline = time.monotonic() + 60.0  # PG-wait deadline
             ds.replicas.append(rep)
-            return
+            return rep
         rep = _Replica(replica_id=rid, actor_name=actor_name, actor=None,
                        version=ds.version)
         ds.replicas.append(rep)
         self._launch_replica_actor(ds, rep)
+        return rep if rep in ds.replicas else None
 
     def _launch_replica_actor(self, ds: _DeploymentState,
                               rep: _Replica) -> None:
@@ -387,8 +388,10 @@ class ServeController:
         # Scale up with current-version replicas (also drives rolling
         # updates: new version starts first, old stops as new turn RUNNING).
         while len(current_version) < target:
-            self._start_replica(ds)
-            current_version.append(ds.replicas[-1])
+            rep = self._start_replica(ds)
+            if rep is None:  # PG creation / actor registration failed
+                break        # ds.message set; next reconcile pass retries
+            current_version.append(rep)
 
         running_new = sum(1 for r in current_version if r.state == RUNNING)
         # Retire old-version replicas as replacements come up.

@@ -35,6 +35,7 @@ class PlacementGroup:
 
     def ready(self, timeout: float | None = 60.0) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout
+        sleep = 0.001  # adaptive: sub-ms-fresh PGs resolve on early polls
         while True:
             state = global_worker.runtime.placement_group_state(self.id)
             if state == "CREATED":
@@ -43,7 +44,8 @@ class PlacementGroup:
                 return False
             if deadline is not None and time.monotonic() >= deadline:
                 return False
-            time.sleep(0.02)
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.02)
 
     def wait(self, timeout: float | None = 60.0) -> bool:
         return self.ready(timeout)

@@ -370,3 +370,21 @@ def test_streaming_generator_error(rt_start):
     assert ray_tpu.get(next(g)) == 1
     with pytest.raises(ray_tpu.TaskError, match="mid-stream"):
         next(g)
+
+
+def test_deep_nested_task_fanout_no_starvation(rt_start):
+    """More blocked parents than the execution pool has threads: children
+    must still run (local_runtime overflow threads preserve the
+    thread-per-task no-starvation property)."""
+    rt = rt_start
+
+    @rt.remote
+    def child(i):
+        return i
+
+    @rt.remote
+    def parent(i):
+        return rt.get(child.remote(i)) + 100
+
+    out = rt.get([parent.remote(i) for i in range(80)], timeout=120)
+    assert out == [i + 100 for i in range(80)]

@@ -350,3 +350,30 @@ def test_device_prefetch_early_break_releases_producer(rt_start):
         time.sleep(0.1)
     assert not [t for t in threading.enumerate()
                 if t.name == "data-device-prefetch" and t.is_alive()]
+
+
+def test_iter_torch_batches(rt_start):
+    import torch
+
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(10)
+    batches = list(ds.iter_torch_batches(batch_size=4,
+                                         dtypes=torch.float32))
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    assert batches[0]["id"].dtype == torch.float32
+    got = torch.cat([b["id"] for b in batches]).tolist()
+    assert sorted(got) == [float(i) for i in range(10)]
+
+
+def test_from_huggingface(rt_start):
+    import datasets as hf
+
+    import ray_tpu.data as rdata
+
+    hfds = hf.Dataset.from_dict({"x": list(range(12)),
+                                 "y": [i * 2 for i in range(12)]})
+    ds = rdata.from_huggingface(hfds, rows_per_block=5)
+    rows = sorted((int(r["x"]), int(r["y"])) for r in ds.iter_rows())
+    assert rows == [(i, 2 * i) for i in range(12)]
+    assert ds.count() == 12
