@@ -100,6 +100,47 @@ class NodeDaemon:
         r("prepare_bundle", self._prepare_bundle)
         r("commit_bundle", self._commit_bundle)
         r("return_bundle", self._return_bundle)
+        r("list_logs", self._list_logs)
+        r("tail_log", self._tail_log)
+
+    async def _list_logs(self, conn, **kw):
+        """Worker log files on this node (reference: `ray logs` — the
+        dashboard agent's per-node log index)."""
+        log_dir = os.path.join(get_config().temp_dir, "logs")
+        # The logs dir is shared by every daemon on this host (and across
+        # runs): claim only THIS node's files, named worker-{node_id[:8]}-*.
+        mine = f"worker-{self.node_id[:8]}-"
+        out = []
+        try:
+            for name in sorted(os.listdir(log_dir)):
+                if not name.startswith(mine):
+                    continue
+                path = os.path.join(log_dir, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                out.append({"filename": name, "size": st.st_size,
+                            "mtime": st.st_mtime, "node_id": self.node_id})
+        except FileNotFoundError:
+            pass
+        return {"logs": out}
+
+    async def _tail_log(self, conn, filename: str = "",
+                        tail_bytes: int = 65536, **kw):
+        """Last N bytes of one log file (path-traversal safe)."""
+        log_dir = os.path.join(get_config().temp_dir, "logs")
+        name = os.path.basename(filename)
+        path = os.path.join(log_dir, name)
+        if not os.path.isfile(path):
+            return {"error": f"no such log {name!r} on {self.node_id}"}
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > tail_bytes:
+                f.seek(size - tail_bytes)
+            data = f.read(tail_bytes)
+        return {"filename": name, "node_id": self.node_id,
+                "data": data.decode("utf-8", "replace")}
 
     async def _ping(self, conn, **kw):
         return {"ok": True, "node_id": self.node_id}
@@ -143,6 +184,7 @@ class NodeDaemon:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["PYTHONUNBUFFERED"] = "1"  # worker prints land in logs promptly
         env["RTPU_HEAD"] = f"{self.head_addr[0]}:{self.head_addr[1]}"
         env["RTPU_NODE_DAEMON"] = f"{self.rpc.host}:{self.rpc.port}"
         env["RTPU_NODE_ID"] = self.node_id

@@ -18,6 +18,7 @@ interface; tests of API semantics run against this one.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import inspect
 import queue
 import threading
@@ -37,6 +38,11 @@ from ray_tpu.core.store import LocalObjectStore, ReferenceCounter
 from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
 from ray_tpu.utils import serialization
 from ray_tpu.utils.ids import ActorID, ObjectID, WorkerID
+
+# Execution-thread pool cap AND the overflow threshold in submit_task: past
+# this many in-flight tasks, new submissions get dedicated threads so pool
+# threads blocked in nested get() can never starve the tasks they wait on.
+_TASK_POOL_SIZE = 64
 
 
 class _ResourcePool:
@@ -129,10 +135,8 @@ class LocalRuntime:
                 self._wait_cond.notify_all()
 
         self.store.on_seal = _notify
-        from concurrent.futures import ThreadPoolExecutor
-
         self._task_pool = ThreadPoolExecutor(
-            max_workers=64, thread_name_prefix="task")
+            max_workers=_TASK_POOL_SIZE, thread_name_prefix="task")
         self._tasks_inflight = 0  # includes tasks blocked in nested get()
         self._inflight_lock = threading.Lock()
         self._released: set[ObjectID] = set()
@@ -175,32 +179,26 @@ class LocalRuntime:
         self._register_nested(oid, value)
         return ObjectRef(oid, self.worker_id)
 
+    @contextlib.contextmanager
     def _yield_task_resources(self):
         """Release the calling task's acquired resources for the duration of
         a blocking get()/wait() and re-acquire afterwards (reference: a
         worker blocked in ray.get returns its CPU to the raylet so the
         tasks it waits on can run — otherwise parents waiting on children
-        deadlock the resource ledger)."""
-        import contextlib
+        deadlock the resource ledger). Actors hold their resources for
+        their lifetime (the reference doesn't return them while blocked) —
+        only plain tasks yield."""
+        from ray_tpu.core.worker import _task_context
 
-        @contextlib.contextmanager
-        def cm():
-            from ray_tpu.core.worker import _task_context
-
-            res = getattr(_task_context, "resources", None)
-            # Actors hold their resources for their lifetime (reference:
-            # actor resources are not returned while blocked) — only plain
-            # tasks yield.
-            if not res or getattr(_task_context, "actor_id", None) is not None:
-                yield
-                return
-            self.resources.release(res)
-            try:
-                yield
-            finally:
-                self.resources.acquire(res, timeout=None)
-
-        return cm()
+        res = getattr(_task_context, "resources", None)
+        if not res or getattr(_task_context, "actor_id", None) is not None:
+            yield
+            return
+        self.resources.release(res)
+        try:
+            yield
+        finally:
+            self.resources.acquire(res, timeout=None)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
         import time as _time
@@ -283,7 +281,7 @@ class LocalRuntime:
         # instead of queueing behind the blocked ones.
         with self._inflight_lock:
             self._tasks_inflight += 1
-            overflow = self._tasks_inflight > 64
+            overflow = self._tasks_inflight > _TASK_POOL_SIZE
         if overflow:
             threading.Thread(
                 target=self._run_pooled, args=(spec, return_ids),

@@ -107,9 +107,15 @@ class _StageExec:
             return False
         if len(self.in_flight) >= self.ctx.max_tasks_in_flight_per_stage:
             return False
-        if len(self.outputs) >= self.ctx.max_output_blocks_buffered:
+        # _pending_out holds completed blocks awaiting earlier sequence
+        # numbers — they're buffered memory too, or the ordering buffer
+        # would bypass the budgets entirely.
+        n_buffered = len(self.outputs) + len(self._pending_out)
+        if n_buffered >= self.ctx.max_output_blocks_buffered:
             return False
         buffered = sum(m.get("size_bytes", 0) for _, m in self.outputs)
+        buffered += sum(m.get("size_bytes", 0)
+                        for _, m in self._pending_out.values())
         if buffered >= self.ctx.max_output_bytes_buffered:
             return False  # byte budget (reference: ResourceManager)
         return True

@@ -220,10 +220,12 @@ def forward(cfg: MixtralConfig, params: dict, tokens: jax.Array,
     x = params["embed_tokens"][tokens]
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, None)
 
-    layer_fn = partial(_layer, cfg, inv_freq=inv_freq, positions=positions,
-                       attn_impl=attn_impl)
-    if remat:
-        layer_fn = jax.checkpoint(layer_fn)
+    from ray_tpu.models.llama import _remat_wrap
+
+    layer_fn = _remat_wrap(
+        partial(_layer, cfg, inv_freq=inv_freq, positions=positions,
+                attn_impl=attn_impl),
+        remat)
 
     def scan_body(x, lp):
         x, aux = layer_fn(x, lp)
@@ -231,7 +233,10 @@ def forward(cfg: MixtralConfig, params: dict, tokens: jax.Array,
 
     x, aux = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    # bf16 MXU matmul with f32 accumulation — casting both operands to f32
+    # would fall off the MXU fast path (see llama.forward).
+    logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
     return logits, aux.mean()
 
 

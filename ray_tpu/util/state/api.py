@@ -118,3 +118,46 @@ def summarize_tasks() -> dict[str, Any]:
         by_state = summary.setdefault(row["name"], {})
         by_state[row["state"]] = by_state.get(row["state"], 0) + 1
     return summary
+
+
+def list_logs(node_id: str | None = None) -> list[dict]:
+    """Per-node worker log files (reference: `ray logs` listing via the
+    dashboard agent). Cluster mode only; in-process runtimes have no
+    worker processes and return []."""
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    peer = getattr(rt, "_peer", None)
+    if peer is None:
+        return []
+    out: list[dict] = []
+    for node in list_nodes():
+        if node_id and node["node_id"] != node_id:
+            continue
+        if not node.get("alive"):
+            continue
+        try:
+            res = peer(tuple(node["addr"])).call("list_logs")
+            out.extend(res.get("logs", []))
+        except Exception:  # noqa: BLE001 - dead daemon: skip its logs
+            continue
+    return out
+
+
+def get_log(filename: str, node_id: str, tail_bytes: int = 65536) -> str:
+    """Tail of one worker log file on one node (reference: `ray logs
+    <file> --node-id ...`)."""
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    peer = getattr(rt, "_peer", None)
+    if peer is None:
+        raise ValueError("log access requires cluster mode")
+    for node in list_nodes():
+        if node["node_id"] == node_id:
+            if not node.get("alive"):
+                raise ValueError(f"node {node_id!r} is not alive")
+            res = peer(tuple(node["addr"])).call(
+                "tail_log", filename=filename, tail_bytes=tail_bytes)
+            if res.get("error"):
+                raise FileNotFoundError(res["error"])
+            return res["data"]
+    raise ValueError(f"unknown node {node_id!r}")

@@ -341,3 +341,53 @@ def test_usage_recording(rt_start, tmp_path, monkeypatch):
     monkeypatch.setenv("RTPU_USAGE_STATS_ENABLED", "0")
     usage.record_library_usage("secret")
     assert "library:secret" not in usage.recorded_features()
+
+
+class TestLogs:
+    def test_list_and_tail_worker_logs(self):
+        """Per-node worker log listing + tail through the daemons
+        (reference: `ray logs` via the dashboard agent)."""
+        import time
+
+        from ray_tpu.cluster_utils import Cluster
+        from ray_tpu.core.remote_function import remote
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.util.state.api import get_log, list_logs
+        from ray_tpu.utils.ids import JobID
+
+        import ray_tpu
+
+        c = Cluster()
+        c.add_node(num_cpus=2)
+        rt = c.connect()
+        old = (global_worker.runtime, global_worker.worker_id,
+               global_worker.node_id, global_worker.mode,
+               global_worker.job_id)
+        global_worker.runtime = rt
+        global_worker.worker_id = rt.worker_id
+        global_worker.node_id = rt.node_id
+        global_worker.job_id = JobID.from_random()
+        global_worker.mode = "cluster"
+        try:
+            @remote
+            def noisy():
+                print("log-marker-xyzzy")
+                return 1
+
+            assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+            time.sleep(0.3)  # let the worker's write hit the file
+            logs = list_logs()
+            assert logs and all("filename" in l and "node_id" in l
+                                for l in logs)
+            found = any(
+                "log-marker-xyzzy" in get_log(l["filename"], l["node_id"])
+                for l in logs)
+            assert found, "worker print not found in any log file"
+            with pytest.raises(FileNotFoundError):
+                get_log("../etc/passwd", logs[0]["node_id"])
+        finally:
+            rt.shutdown()
+            c.shutdown()
+            (global_worker.runtime, global_worker.worker_id,
+             global_worker.node_id, global_worker.mode,
+             global_worker.job_id) = old
