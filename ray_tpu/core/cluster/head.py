@@ -33,6 +33,7 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.monotonic)
     alive: bool = True
     pending_demands: list = field(default_factory=list)  # autoscaler feed
+    transfer_addr: tuple | None = None  # native object-transfer server
 
 
 @dataclass
@@ -65,7 +66,9 @@ class HeadServer:
         self.actors: dict[str, ActorInfo] = {}
         self.named_actors: dict[tuple[str, str], str] = {}
         self.kv: dict[str, dict[str, bytes]] = {}  # namespace -> key -> value
-        self.workers: dict[str, tuple[str, int]] = {}  # worker_id -> rpc addr
+        # worker_id -> (host, port, node_id) — node_id routes large-object
+        # pulls to the holder node's native transfer server.
+        self.workers: dict[str, tuple] = {}
         # Control-plane fault tolerance: durable tables reload on restart
         # (reference: GCS backed by redis_store_client.cc; raylets
         # reconnect via HandleNotifyGCSRestart, node_manager.cc:1050).
@@ -243,11 +246,13 @@ class HeadServer:
     async def _register_node(
         self, conn: ServerConnection, node_id: str, host: str, port: int,
         resources: dict, labels: dict | None = None,
+        transfer_addr: list | None = None,
     ):
         self._drop_daemon_client(node_id)  # re-registration: stale address
         self.nodes[node_id] = NodeInfo(
             node_id=node_id, addr=(host, port), resources=dict(resources),
             available=dict(resources), labels=labels or {},
+            transfer_addr=tuple(transfer_addr) if transfer_addr else None,
         )
         conn.meta["node_id"] = node_id
         self._node_conns[node_id] = conn
@@ -281,6 +286,8 @@ class HeadServer:
             nid: {
                 "addr": list(n.addr), "resources": n.resources,
                 "available": n.available, "alive": n.alive, "labels": n.labels,
+                "transfer_addr": (list(n.transfer_addr)
+                                  if n.transfer_addr else None),
             }
             for nid, n in self.nodes.items()
         }
@@ -305,14 +312,19 @@ class HeadServer:
                 await self._handle_actor_death(actor, f"node {node_id[:8]} died")
 
     # ------------------------------------------------------------------ workers
-    async def _register_worker(self, conn: ServerConnection, worker_id: str, host: str, port: int):
-        self.workers[worker_id] = (host, port)
+    async def _register_worker(self, conn: ServerConnection, worker_id: str,
+                               host: str, port: int, node_id: str = ""):
+        self.workers[worker_id] = (host, port, node_id)
         self.mark_dirty()
         return {"ok": True}
 
     async def _resolve_worker(self, conn: ServerConnection, worker_id: str):
-        addr = self.workers.get(worker_id)
-        return {"addr": list(addr) if addr else None}
+        row = self.workers.get(worker_id)
+        if row is None:
+            return {"addr": None}
+        host, port = row[0], row[1]
+        node_id = row[2] if len(row) > 2 else ""
+        return {"addr": [host, port], "node_id": node_id}
 
     # ------------------------------------------------------------------ actors
     # FSM parity: reference gcs_actor_manager.cc — REGISTER → schedule (lease
@@ -707,6 +719,8 @@ class HeadServer:
                     "alive": n.alive, "resources": n.resources,
                     "available": n.available, "labels": n.labels,
                     "addr": list(n.addr),
+                    "transfer_addr": (list(n.transfer_addr)
+                                      if n.transfer_addr else None),
                 }
                 for nid, n in self.nodes.items()
             },
@@ -724,7 +738,8 @@ class HeadServer:
                 for pid, pg in self.pgs.items()
             },
             "workers": {
-                wid: {"addr": list(addr)} for wid, addr in self.workers.items()
+                wid: {"addr": [row[0], row[1]]}
+                for wid, row in self.workers.items()
             },
         }
 

@@ -87,6 +87,22 @@ class NodeDaemon:
             self.shm_name = name
         except Exception:
             self._shm = None  # native build unavailable; RPC-only transfers
+        # Native transfer data plane over the arena (src/transfer/
+        # transfer.cc): serves object bytes to pulling nodes with zero
+        # Python in the byte path (reference: object_manager data plane).
+        self.transfer_addr: tuple[str, int] | None = None
+        self._transfer_handle = None
+        if self._shm is not None:
+            try:
+                from ray_tpu.core import transfer
+
+                # Bind/advertise the daemon's RPC host (NOT loopback) so
+                # pullers on other machines reach this node's data plane.
+                self._transfer_handle, port = transfer.start_server(
+                    self._shm.name, host=self.rpc.host)
+                self.transfer_addr = (self.rpc.host, port)
+            except Exception:
+                self.transfer_addr = None  # RPC chunk fallback only
         self._register_handlers()
         self._bg: list[asyncio.Task] = []
 
@@ -154,6 +170,8 @@ class NodeDaemon:
         await self._head.call(
             "register_node", node_id=self.node_id, host=addr[0], port=addr[1],
             resources=self.resources, labels=self.labels,
+            transfer_addr=(list(self.transfer_addr)
+                           if self.transfer_addr else None),
         )
         loop = asyncio.get_running_loop()
         self._bg.append(loop.create_task(self._heartbeat_loop()))
@@ -169,6 +187,16 @@ class NodeDaemon:
             except Exception:
                 pass
         await self.rpc.stop()
+        if self._transfer_handle is not None:
+            try:
+                from ray_tpu.core import transfer
+
+                # Stop BEFORE destroying the arena: the server drains its
+                # connections and unmaps its own arena view.
+                transfer.stop_server(self._transfer_handle)
+            except Exception:
+                pass
+            self._transfer_handle = None
         if self._shm is not None:
             try:
                 self._shm.destroy()
@@ -287,7 +315,9 @@ class NodeDaemon:
             await client.call(
                 "register_node", node_id=self.node_id, host=self.rpc.host,
                 port=self.rpc.port, resources=self.resources,
-                labels=self.labels)
+                labels=self.labels,
+                transfer_addr=(list(self.transfer_addr)
+                               if self.transfer_addr else None))
             old, self._head = self._head, client
             try:
                 await old.close()

@@ -175,6 +175,7 @@ class ClusterRuntime:
         self._peer_clients: dict[tuple[str, int], RpcClient] = {}
         self._peer_lock = threading.Lock()
         self._actor_addr_cache: dict[str, tuple[str, int]] = {}
+        self._xfer_cache = None  # (ts, {node_id: transfer_addr})
         self._actor_states: dict[str, str] = {}
         self._cancelled: set[str] = set()  # task_id hex
         # Lineage retention for reconstruction (reference:
@@ -201,7 +202,8 @@ class ClusterRuntime:
         self.server.register("ping", self._handle_ping)
         self.addr = self._io.run(self.server.start())
         self.head.call("register_worker", worker_id=self.worker_id.hex(),
-                       host=self.addr[0], port=self.addr[1])
+                       host=self.addr[0], port=self.addr[1],
+                       node_id=os.environ.get("RTPU_NODE_ID", ""))
         self._reaper_task = self._io.spawn(self._lease_reaper())
         # Actor state invalidation via pubsub.
         self.head.aio.on_notify("pub", self._on_pub)
@@ -213,7 +215,8 @@ class ClusterRuntime:
             try:
                 self.head.call("register_worker",
                                worker_id=self.worker_id.hex(),
-                               host=self.addr[0], port=self.addr[1])
+                               host=self.addr[0], port=self.addr[1],
+                               node_id=os.environ.get("RTPU_NODE_ID", ""))
                 self.head.call("subscribe", channel="actor_events")
             except Exception:
                 pass
@@ -336,6 +339,26 @@ class ClusterRuntime:
     def _resolve_worker_addr(self, worker_hex: str) -> tuple[str, int] | None:
         res = self.head.call("resolve_worker", worker_id=worker_hex)
         return tuple(res["addr"]) if res.get("addr") else None
+
+    def _resolve_worker(self, worker_hex: str) -> tuple[tuple | None, str]:
+        res = self.head.call("resolve_worker", worker_id=worker_hex)
+        addr = tuple(res["addr"]) if res.get("addr") else None
+        return addr, res.get("node_id") or ""
+
+    def _node_transfer_addr(self, node_id: str) -> tuple | None:
+        """Cached node_id -> native transfer-server address (5s TTL)."""
+        now = time.monotonic()
+        cached = self._xfer_cache
+        if cached is None or now - cached[0] > 5.0:
+            try:
+                nodes = self.head.call("list_nodes")
+            except Exception:
+                return None
+            cached = self._xfer_cache = (now, {
+                nid: tuple(info["transfer_addr"])
+                for nid, info in nodes.items()
+                if info.get("alive") and info.get("transfer_addr")})
+        return cached[1].get(node_id)
 
     # ------------------------------------------------------------------ put/get
     def _release_object(self, oid: ObjectID, rec=None) -> None:
@@ -497,10 +520,40 @@ class ClusterRuntime:
     PULL_CHUNK = 4 * 1024 * 1024
     PULL_WINDOW = 4  # concurrent chunk requests (bounded in-flight bytes)
 
+    def _native_pull(self, holder_node: str, ref: ObjectRef) -> bytes | None:
+        """Arena-to-arena pull over the native data plane (src/transfer/
+        transfer.cc): zero Python in the byte path. Returns the bytes, or
+        None to fall back to the RPC chunk path (object not in the holder's
+        arena, no transfer server, or any transport failure)."""
+        if not holder_node:
+            return None
+        xfer = self._node_transfer_addr(holder_node)
+        if xfer is None:
+            return None
+        try:
+            from ray_tpu.core import transfer
+
+            oid = ref.id.binary()
+            if self.shm is not None:
+                if self.shm.contains(oid):
+                    return self.shm.get_bytes(oid)
+                total = transfer.pull_to_store(self.shm.name, oid,
+                                               xfer[0], xfer[1])
+                if total is None:
+                    return None
+                return self.shm.get_bytes(oid)
+            return transfer.fetch_to_buffer(ref.id.binary(), xfer[0],
+                                            xfer[1])
+        except Exception:  # noqa: BLE001 - any native failure -> RPC path
+            return None
+
     def _fetch_from_holder(self, holder_hex: str, ref: ObjectRef) -> bytes | None:
-        addr = self._resolve_worker_addr(holder_hex)
+        addr, holder_node = self._resolve_worker(holder_hex)
         if addr is None:
             return None
+        data = self._native_pull(holder_node, ref)
+        if data is not None:
+            return data
         try:  # dead holder: connect refused (ctor) or reset (call)
             peer = self._peer(addr)
             first = peer.call("get_object_chunk", oid=ref.hex(), offset=0,

@@ -1,0 +1,95 @@
+"""ctypes bindings for the native transfer data plane (src/transfer/
+transfer.cc): a per-node TCP server that streams object bytes directly out
+of the shm arena, and a parallel-range puller that lands them directly in
+the puller's arena.
+
+Capability parity with the reference's object-manager data path (reference:
+src/ray/object_manager/object_manager.h + pull_manager.h:50 — chunked,
+bounded-parallel node-to-node transfer); here the entire byte path is
+native, with Python only exchanging (host, port) endpoints.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from ray_tpu._native import load_library
+
+_lib = None
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        l = load_library("transfer",
+                         ["transfer/transfer.cc", "objstore/objstore.cc"])
+        l.transfer_server_start2.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        l.transfer_server_start2.restype = ctypes.c_void_p
+        l.transfer_server_stop.argtypes = [ctypes.c_void_p]
+        l.transfer_server_stop.restype = None
+        l.transfer_size.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_char_p]
+        l.transfer_size.restype = ctypes.c_int64
+        l.transfer_pull.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_uint64, ctypes.c_int]
+        l.transfer_pull.restype = ctypes.c_int64
+        l.transfer_fetch_buf.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                         ctypes.c_char_p, ctypes.c_char_p,
+                                         ctypes.c_uint64, ctypes.c_uint64,
+                                         ctypes.c_int]
+        l.transfer_fetch_buf.restype = ctypes.c_int
+        _lib = l
+    return _lib
+
+
+def start_server(shm_name: str, host: str = "127.0.0.1",
+                 port: int = 0) -> tuple[int, int]:
+    """Serve shm_name's objects; returns (handle, bound_port). Pass the
+    handle to stop_server when the daemon shuts down (the server drains
+    in-flight connections, then unmaps its arena view)."""
+    bound = ctypes.c_int(0)
+    handle = lib().transfer_server_start2(shm_name.encode(), host.encode(),
+                                          port, ctypes.byref(bound))
+    if not handle:
+        raise OSError(f"transfer server failed to start for {shm_name}")
+    return handle, bound.value
+
+
+def stop_server(handle: int) -> None:
+    lib().transfer_server_stop(handle)
+
+
+def pull_to_store(local_shm: str, object_id: bytes, host: str,
+                  port: int, *, chunk: int = 8 * 1024 * 1024,
+                  conns: int = 4) -> int | None:
+    """Pull object_id from (host, port) straight into the local arena.
+    Returns total bytes, or None if the holder doesn't have it (caller
+    falls back to the RPC chunk path)."""
+    rc = lib().transfer_pull(local_shm.encode(), object_id, host.encode(),
+                             port, chunk, conns)
+    if rc == -2:
+        return None  # not in the holder's arena
+    if rc < 0:
+        raise OSError(f"native pull failed (rc {rc})")
+    return int(rc)
+
+
+def fetch_to_buffer(object_id: bytes, host: str, port: int,
+                    *, chunk: int = 8 * 1024 * 1024,
+                    conns: int = 4) -> bytes | None:
+    """Pull into process memory (puller without an arena). None if the
+    holder doesn't have the object in its arena."""
+    l = lib()
+    total = l.transfer_size(host.encode(), port, object_id)
+    if total == -2:
+        return None
+    if total < 0:
+        raise OSError("transfer_size failed")
+    buf = ctypes.create_string_buffer(int(total))
+    if l.transfer_fetch_buf(host.encode(), port, object_id, buf,
+                            total, chunk, conns) != 0:
+        raise OSError("native fetch failed")
+    return buf.raw

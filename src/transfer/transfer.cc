@@ -1,0 +1,314 @@
+// Native node-to-node object transfer data plane.
+//
+// Capability parity with the reference's object manager data path
+// (reference: src/ray/object_manager/object_manager.h ObjectManager moving
+// objects in chunks via ObjectBufferPool, pull_manager.h bounded parallel
+// pulls, push_manager.h) — but as a dedicated TCP byte plane that reads
+// straight out of the node's shared-memory arena (src/objstore/objstore.cc)
+// and writes straight into the puller's arena: no Python, no serialization,
+// no per-chunk RPC framing in the hot path.
+//
+// Server: one accept thread per node daemon; a connection carries repeated
+//   requests  [id:20][offset:u64][length:u64]   (length==0 → size probe)
+//   responses [status:u32][total:u64][n:u64][payload n bytes]
+//   status: 0 ok, 1 missing (not sealed in this node's arena).
+// Client: transfer_size() probes; transfer_pull() creates the object in the
+// local arena and fills it with `conns` parallel range connections (disjoint
+// ranges → lock-free writes); transfer_fetch_buf() fills a caller buffer for
+// pullers with no arena.
+//
+// C ABI throughout — consumed from Python via ctypes
+// (ray_tpu/core/transfer.py). Compiled together with objstore.cc; each
+// process maps the shm segment by NAME, so no handles cross libraries.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kIdSize = 20;
+constexpr uint64_t kMaxChunk = 64ULL * 1024 * 1024;
+
+// objstore.cc C API (linked into the same shared object).
+extern "C" {
+struct Store;
+Store* store_open(const char* name);
+void store_close(Store* s);
+int store_get(Store* s, const uint8_t* id, uint64_t* offset_out,
+              uint64_t* size_out);
+int store_release(Store* s, const uint8_t* id);
+int store_create_object(Store* s, const uint8_t* id, uint64_t size,
+                        uint64_t* offset_out);
+int store_seal(Store* s, const uint8_t* id);
+int store_delete(Store* s, const uint8_t* id);
+uint8_t* store_base(Store* s);
+}
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+struct Req {
+  uint8_t id[kIdSize];
+  uint64_t offset;
+  uint64_t length;
+} __attribute__((packed));
+
+struct RespHdr {
+  uint32_t status;
+  uint64_t total;
+  uint64_t n;
+} __attribute__((packed));
+
+struct ServerState {
+  Store* store;
+  int lfd;
+  std::atomic<bool> stopping{false};
+  std::atomic<int> active{0};
+};
+
+void ServeConn(ServerState* st, int fd) {
+  st->active.fetch_add(1);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  Req req;
+  while (!st->stopping.load() && ReadFull(fd, &req, sizeof(req))) {
+    uint64_t off = 0, total = 0;
+    RespHdr h{1, 0, 0};
+    if (store_get(st->store, req.id, &off, &total) == 0) {
+      uint64_t start = req.offset > total ? total : req.offset;
+      uint64_t want = req.length > kMaxChunk ? kMaxChunk : req.length;
+      uint64_t n = (start + want > total) ? total - start : want;
+      h = RespHdr{0, total, n};
+      bool ok = WriteFull(fd, &h, sizeof(h)) &&
+                (n == 0 ||
+                 WriteFull(fd, store_base(st->store) + off + start, n));
+      store_release(st->store, req.id);
+      if (!ok) break;
+      continue;
+    }
+    if (!WriteFull(fd, &h, sizeof(h))) break;
+  }
+  close(fd);
+  st->active.fetch_sub(1);
+}
+
+int Connect(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// One request/response on an open connection; payload lands at dest (may be
+// null when probing). Returns -1 on error, else sets *total and *got.
+int RoundTrip(int fd, const uint8_t* id, uint64_t offset, uint64_t length,
+              uint8_t* dest, uint64_t* total, uint64_t* got) {
+  Req req;
+  memcpy(req.id, id, kIdSize);
+  req.offset = offset;
+  req.length = length;
+  if (!WriteFull(fd, &req, sizeof(req))) return -1;
+  RespHdr h;
+  if (!ReadFull(fd, &h, sizeof(h))) return -1;
+  if (h.status != 0) return -2;  // missing
+  if (h.n > 0) {
+    if (dest == nullptr) return -1;
+    if (!ReadFull(fd, dest, h.n)) return -1;
+  }
+  *total = h.total;
+  *got = h.n;
+  return 0;
+}
+
+// Parallel range pull into dest[0..total).
+int PullRanges(const char* host, int port, const uint8_t* id, uint8_t* dest,
+               uint64_t total, uint64_t chunk, int conns) {
+  if (chunk == 0 || chunk > kMaxChunk) chunk = 8ULL * 1024 * 1024;
+  if (conns < 1) conns = 1;
+  if (conns > 16) conns = 16;
+  std::atomic<uint64_t> next{0};
+  std::atomic<int> failed{0};
+  auto worker = [&]() {
+    int fd = Connect(host, port);
+    if (fd < 0) {
+      failed.store(1);
+      return;
+    }
+    while (failed.load() == 0) {
+      uint64_t off = next.fetch_add(chunk);
+      if (off >= total) break;
+      uint64_t want = off + chunk > total ? total - off : chunk;
+      uint64_t t = 0, got = 0;
+      if (RoundTrip(fd, id, off, want, dest + off, &t, &got) != 0 ||
+          got != want) {
+        failed.store(1);
+        break;
+      }
+    }
+    close(fd);
+  };
+  std::thread threads[16];
+  int n = conns;
+  for (int i = 0; i < n; i++) threads[i] = std::thread(worker);
+  for (int i = 0; i < n; i++) threads[i].join();
+  return failed.load() == 0 ? 0 : -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----------------------------------------------------------------
+
+// Start the transfer server for shm segment `shm_name` on host:port
+// (port 0 → ephemeral). Writes the bound port to *port_out and returns an
+// opaque handle for transfer_server_stop, or null on failure.
+void* transfer_server_start2(const char* shm_name, const char* host,
+                             int port, int* port_out) {
+  Store* store = store_open(shm_name);
+  if (store == nullptr) return nullptr;
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) {
+    store_close(store);
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(lfd, 64) != 0) {
+    close(lfd);
+    store_close(store);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  *port_out = ntohs(addr.sin_port);
+  ServerState* st = new ServerState();
+  st->store = store;
+  st->lfd = lfd;
+  std::thread([st]() {
+    while (true) {
+      int cfd = accept(st->lfd, nullptr, nullptr);
+      if (cfd < 0) {
+        if (errno == EINTR && !st->stopping.load()) continue;
+        break;  // stop() closed the listener (or fatal error)
+      }
+      std::thread(ServeConn, st, cfd).detach();
+    }
+    // Drain in-flight connections before unmapping the arena (a serving
+    // thread reading a freed mapping would be use-after-free).
+    while (st->active.load() != 0) usleep(1000);
+    store_close(st->store);
+    delete st;
+  }).detach();
+  return st;
+}
+
+// Stop a server started with transfer_server_start2: wakes the accept loop
+// (which drains connections, unmaps the arena, and frees the state).
+void transfer_server_stop(void* handle) {
+  if (handle == nullptr) return;
+  ServerState* st = static_cast<ServerState*>(handle);
+  st->stopping.store(true);
+  shutdown(st->lfd, SHUT_RDWR);
+  close(st->lfd);
+  // st is freed by the accept thread after the drain — do not touch it.
+}
+
+// ---- client ----------------------------------------------------------------
+
+// Size probe: total object bytes, -2 if the holder doesn't have it sealed
+// in its arena, -1 on connection error.
+int64_t transfer_size(const char* host, int port, const uint8_t* id) {
+  int fd = Connect(host, port);
+  if (fd < 0) return -1;
+  uint64_t total = 0, got = 0;
+  int rc = RoundTrip(fd, id, 0, 0, nullptr, &total, &got);
+  close(fd);
+  if (rc == -2) return -2;
+  if (rc != 0) return -1;
+  return static_cast<int64_t>(total);
+}
+
+// Pull an object into the LOCAL arena `local_shm`: create, parallel range
+// fill, seal. Returns total bytes, -2 if missing at the holder, -3 if the
+// local arena can't hold it, -1 on transfer error.
+int64_t transfer_pull(const char* local_shm, const uint8_t* id,
+                      const char* host, int port, uint64_t chunk,
+                      int conns) {
+  int64_t total = transfer_size(host, port, id);
+  if (total < 0) return total;
+  Store* local = store_open(local_shm);
+  if (local == nullptr) return -3;
+  uint64_t off = 0;
+  int rc = store_create_object(local, id, static_cast<uint64_t>(total), &off);
+  int64_t result;
+  if (rc != 0) {
+    result = -3;
+  } else if (PullRanges(host, port, id, store_base(local) + off,
+                        static_cast<uint64_t>(total), chunk, conns) != 0) {
+    store_delete(local, id);
+    result = -1;
+  } else {
+    store_seal(local, id);
+    result = total;
+  }
+  store_close(local);
+  return result;
+}
+
+// Pull into a caller-provided buffer (puller without an arena). dest must
+// hold `total` bytes as returned by transfer_size. Returns 0 or -1.
+int transfer_fetch_buf(const char* host, int port, const uint8_t* id,
+                       uint8_t* dest, uint64_t total, uint64_t chunk,
+                       int conns) {
+  return PullRanges(host, port, id, dest, total, chunk, conns);
+}
+
+}  // extern "C"

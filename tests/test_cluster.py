@@ -424,6 +424,10 @@ def test_chunked_pull_large_object(cluster, monkeypatch):
         return orig(self, peer, ref, first, total)
 
     monkeypatch.setattr(ClusterRuntime, "_pull_chunked", counting_pull)
+    # Pin the RPC fallback: the native data plane would otherwise serve
+    # this pull before the chunked path (covered by its own test below).
+    monkeypatch.setattr(ClusterRuntime, "_native_pull",
+                        lambda self, node, ref: None)
     cluster.add_node(num_cpus=2, resources={"far": 1.0})
     time.sleep(0.3)
 
@@ -438,6 +442,43 @@ def test_chunked_pull_large_object(cluster, monkeypatch):
     np.testing.assert_allclose(arr[:5], [0, 1, 2, 3, 4])
     assert float(arr[-1]) == 1_499_999.0
     assert pulls and pulls[0] > 1_000_000  # the chunked path actually ran
+
+
+def test_native_transfer_data_plane(cluster, monkeypatch):
+    """Large cross-node results ride the C++ arena-to-arena transfer plane
+    (src/transfer/transfer.cc): the holder node's transfer server streams
+    bytes out of its shm arena into the puller's (reference: the object
+    manager's native data path, object_manager.h + pull_manager.h)."""
+    import numpy as np
+
+    from ray_tpu.core.cluster.runtime import ClusterRuntime
+
+    native = []
+    orig = ClusterRuntime._native_pull
+
+    def counting_native(self, node, ref):
+        out = orig(self, node, ref)
+        native.append((node, out is not None))
+        return out
+
+    if global_worker.runtime.shm is None:
+        pytest.skip("native toolchain unavailable")
+    monkeypatch.setattr(ClusterRuntime, "_native_pull", counting_native)
+    cluster.add_node(num_cpus=2, resources={"xfer": 1.0})
+    time.sleep(0.3)
+
+    # every alive node advertises its transfer server
+    from ray_tpu.util.state.api import list_nodes
+    assert all(n.get("transfer_addr") for n in list_nodes() if n["alive"])
+
+    @remote(resources={"xfer": 1.0})
+    def big():
+        import numpy as np
+        return np.arange(2_000_000, dtype=np.float32)  # ~8MB
+
+    arr = ray_tpu.get(big.remote(), timeout=120)
+    assert arr.shape == (2_000_000,) and float(arr[-1]) == 1_999_999.0
+    assert any(ok for _node, ok in native), native  # native path served it
 
 
 def test_task_scheduling_strategies(tmp_path):
