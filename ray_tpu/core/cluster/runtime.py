@@ -337,8 +337,7 @@ class ClusterRuntime:
         return cli
 
     def _resolve_worker_addr(self, worker_hex: str) -> tuple[str, int] | None:
-        res = self.head.call("resolve_worker", worker_id=worker_hex)
-        return tuple(res["addr"]) if res.get("addr") else None
+        return self._resolve_worker(worker_hex)[0]
 
     def _resolve_worker(self, worker_hex: str) -> tuple[tuple | None, str]:
         res = self.head.call("resolve_worker", worker_id=worker_hex)
@@ -542,8 +541,13 @@ class ClusterRuntime:
                 if total is None:
                     return None
                 return self.shm.get_bytes(oid)
-            return transfer.fetch_to_buffer(ref.id.binary(), xfer[0],
+            data = transfer.fetch_to_buffer(ref.id.binary(), xfer[0],
                                             xfer[1])
+            if data is not None:
+                # Cache like the RPC chunk path does, or every re-get of
+                # this ref re-transfers the whole object.
+                self.store.put(ref.id, data, ref.owner_id)
+            return data
         except Exception:  # noqa: BLE001 - any native failure -> RPC path
             return None
 

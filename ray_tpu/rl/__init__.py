@@ -11,6 +11,7 @@ pure JAX (jit-compiled, mesh-shardable) instead of torch.
 
 from ray_tpu.rl.env import CartPoleEnv, VectorEnv, make_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.bc import BC, BCConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
@@ -20,6 +21,7 @@ __all__ = [
     "EnvRunner", "EnvRunnerGroup",
     "PPO", "PPOConfig",
     "DQN", "DQNConfig",
+    "BC", "BCConfig",
     "ReplayBuffer", "PrioritizedReplayBuffer",
 ]
 

@@ -1,0 +1,129 @@
+"""Behavior cloning: offline RL from a dataset of (obs, action) pairs.
+
+Capability parity with the reference's offline-RL entry point (reference:
+rllib/algorithms/bc/bc.py — BC trains the policy head by supervised
+action log-likelihood over an offline dataset read through ray.data;
+offline/offline_data.py streams the dataset into learner batches). Here the
+dataset is a ray_tpu.data Dataset with "obs" and "actions" columns, batches
+stream through iter_batches, and the update is a jitted cross-entropy step
+on the same MLP policy PPO uses — so a BC-pretrained policy drops straight
+into PPO fine-tuning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.ppo import init_mlp, mlp_apply
+from ray_tpu.tune.trainable import Trainable
+
+
+@partial(jax.jit, static_argnums=(0,))
+def bc_update(optimizer, params, opt_state, obs, actions):
+    def loss_fn(p):
+        logits = mlp_apply(p, obs)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, actions[:, None], 1)[:, 0]
+        acc = (logits.argmax(-1) == actions).mean()
+        return nll.mean(), acc
+
+    (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss, acc
+
+
+@dataclass
+class BCConfig:
+    env: str = "CartPole-v1"           # for obs/action spaces + evaluation
+    dataset: Any = None                # ray_tpu.data Dataset ("obs","actions")
+    lr: float = 1e-3
+    batch_size: int = 256
+    epochs_per_step: int = 1
+    hidden: int = 64
+    evaluation_episodes: int = 0       # >0: greedy rollouts each step()
+    seed: int = 0
+    extra: dict = field(default_factory=dict)
+
+    def build(self) -> "BC":
+        return BC({"bc_config": self})
+
+
+class BC(Trainable):
+    """Supervised policy training over an offline dataset (reference:
+    bc.py training_step: offline batch → log-likelihood update)."""
+
+    def setup(self, config: dict) -> None:
+        cfg = config.get("bc_config") or BCConfig(
+            **{k: v for k, v in config.items()
+               if k in BCConfig.__dataclass_fields__})
+        if cfg.dataset is None:
+            raise ValueError("BCConfig.dataset is required (offline data)")
+        self.cfg = cfg
+        probe = make_env(cfg.env, seed=cfg.seed)
+        self._probe_env = probe
+        self.params = init_mlp(
+            jax.random.PRNGKey(cfg.seed),
+            [probe.observation_size, cfg.hidden, cfg.hidden,
+             probe.num_actions])
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+    def step(self) -> dict:
+        cfg = self.cfg
+        loss_sum = acc_sum = 0.0
+        seen = 0
+        for _ in range(cfg.epochs_per_step):
+            for batch in cfg.dataset.iter_batches(
+                    batch_size=cfg.batch_size,
+                    local_shuffle_buffer_size=4 * cfg.batch_size,
+                    local_shuffle_seed=cfg.seed + self.iteration):
+                obs = jnp.asarray(np.asarray(batch["obs"], np.float32))
+                act = jnp.asarray(np.asarray(batch["actions"], np.int32))
+                self.params, self.opt_state, loss_j, acc_j = bc_update(
+                    self.optimizer, self.params, self.opt_state, obs, act)
+                n = len(act)
+                loss_sum += float(loss_j) * n
+                acc_sum += float(acc_j) * n
+                seen += n
+        denom = max(seen, 1)
+        out = {"bc_loss": loss_sum / denom,
+               "action_accuracy": acc_sum / denom,
+               "num_samples_trained": seen}
+        if cfg.evaluation_episodes > 0:
+            out["episode_return_mean"] = self._evaluate(
+                cfg.evaluation_episodes)
+        return out
+
+    def _evaluate(self, episodes: int) -> float:
+        """Greedy policy rollouts (reference: evaluation_config rollouts)."""
+        returns = []
+        env = make_env(self.cfg.env, seed=self.cfg.seed + 10_000)
+        for _ in range(episodes):
+            obs = env.reset()
+            total, done, steps = 0.0, False, 0
+            while not done and steps < 1000:
+                a = int(np.asarray(
+                    mlp_apply(self.params, jnp.asarray(obs[None]))
+                ).argmax(-1)[0])
+                obs, r, term, trunc = env.step(a)
+                done = term or trunc
+                total += r
+                steps += 1
+            returns.append(total)
+        return float(np.mean(returns))
+
+    def save_checkpoint(self) -> Any:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "iteration": self.iteration}
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, checkpoint["params"])
+        self.iteration = checkpoint["iteration"]

@@ -100,7 +100,9 @@ struct ServerState {
 };
 
 void ServeConn(ServerState* st, int fd) {
-  st->active.fetch_add(1);
+  // st->active was incremented by the ACCEPT loop before this thread was
+  // spawned — incrementing here would race the shutdown drain (stop() could
+  // see active==0, free st, and unmap the arena before this thread ran).
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   Req req;
@@ -236,9 +238,17 @@ void* transfer_server_start2(const char* shm_name, const char* host,
     while (true) {
       int cfd = accept(st->lfd, nullptr, nullptr);
       if (cfd < 0) {
-        if (errno == EINTR && !st->stopping.load()) continue;
-        break;  // stop() closed the listener (or fatal error)
+        if (st->stopping.load()) break;  // stop() closed the listener
+        if (errno == EINTR || errno == ECONNABORTED || errno == EMFILE ||
+            errno == ENFILE || errno == EAGAIN) {
+          // Transient: a dead server while the node still advertises its
+          // transfer_addr would silently degrade every pull to RPC.
+          if (errno == EMFILE || errno == ENFILE) usleep(10000);
+          continue;
+        }
+        break;  // listener genuinely broken
       }
+      st->active.fetch_add(1);  // before detach: pairs with the drain below
       std::thread(ServeConn, st, cfd).detach();
     }
     // Drain in-flight connections before unmapping the arena (a serving

@@ -176,3 +176,40 @@ def test_dqn_prioritized_and_checkpoint():
     algo2.load_checkpoint(ckpt)
     assert algo2.env_steps == ckpt["env_steps"]
     algo2.cleanup()
+
+
+def test_bc_offline_training(rt_start):
+    """Behavior cloning from an offline ray_tpu.data dataset recovers an
+    expert policy (reference: rllib BC over ray.data offline data)."""
+    import ray_tpu.data as rdata
+    from ray_tpu.rl import BCConfig
+    from ray_tpu.rl.env import CartPoleEnv
+
+    # Expert: a simple angle+velocity controller that balances CartPole.
+    env = CartPoleEnv(seed=0)
+    obs_rows, act_rows = [], []
+    for ep in range(30):
+        obs = env.reset()
+        done, steps = False, 0
+        while not done and steps < 200:
+            a = 1 if (obs[2] + 0.5 * obs[3]) > 0 else 0
+            obs_rows.append(np.asarray(obs, np.float32))
+            act_rows.append(a)
+            obs, _, term, trunc = env.step(a)
+            done = term or trunc
+            steps += 1
+    ds = rdata.from_blocks([{"obs": np.stack(obs_rows),
+                             "actions": np.asarray(act_rows, np.int32)}])
+
+    algo = BCConfig(dataset=ds, epochs_per_step=3,
+                    evaluation_episodes=3, seed=0).build()
+    last = None
+    for _ in range(5):
+        last = algo.train_step()
+    # Return is the success criterion (perfect balancing = 500); accuracy
+    # plateaus near the expert's sharp decision boundary.
+    assert last["action_accuracy"] > 0.8, last
+    assert last["episode_return_mean"] > 100.0, last
+    # checkpoint round-trips
+    ckpt = algo.save_checkpoint()
+    algo.load_checkpoint(ckpt)
