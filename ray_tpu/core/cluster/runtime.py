@@ -186,6 +186,7 @@ class ClusterRuntime:
         self._lineage_bytes = 0
         self._recovering: set[ObjectID] = set()
         self._recovery_attempts: dict[ObjectID, int] = {}
+        self._recovery_lock = threading.Lock()
         self._shutdown = False
         # Wakes wait()/get() when results land (event-driven wait; the
         # reference wakes waiters from the in-memory store's seal path).
@@ -689,25 +690,32 @@ class ClusterRuntime:
         """Lineage reconstruction: resubmit the task that created the object
         (reference: ObjectRecoveryManager::RecoverObject). Returns False when
         the object has no recomputable lineage (puts, exhausted retries)."""
-        tid = self.refs.lineage_task(object_id)
-        if tid is None:
-            return False
-        entry = self._lineage.get(tid.hex())
-        if entry is None:
-            return False
-        attempts = self._recovery_attempts.get(object_id, 0)
-        if attempts >= 3:
-            return False
-        self._recovery_attempts[object_id] = attempts + 1
+        # In-flight dedup FIRST (before the lineage lookup: a concurrent
+        # lineage eviction mid-recovery must not turn a poll into a
+        # spurious "cannot reconstruct"), and under a lock (a getter
+        # thread and the IO loop's report_lost handler can race the
+        # check-then-add — both resubmitting would run the task twice and
+        # burn two attempts on one loss). Getters polling while the
+        # resubmitted task runs report success without burning attempts.
+        with self._recovery_lock:
+            if object_id in self._recovering:
+                return True
+            tid = self.refs.lineage_task(object_id)
+            if tid is None:
+                return False
+            entry = self._lineage.get(tid.hex())
+            if entry is None:
+                return False
+            attempts = self._recovery_attempts.get(object_id, 0)
+            if attempts >= 3:
+                return False
+            self._recovery_attempts[object_id] = attempts + 1
+            self._recovering.add(object_id)
         spec, blob, _ = entry
 
         def on_loop():
             # _recovering stays set until the resubmitted task's results
-            # land (_handle_task_reply / _store_error_local clear it) —
-            # dedups concurrent getters racing to recover the same object.
-            if object_id in self._recovering:
-                return
-            self._recovering.add(object_id)
+            # land (_handle_task_reply / _store_error_local clear it).
             # Forget the stale location; the fresh execution reports anew.
             for oid in spec.return_ids():
                 self._locations.pop(oid, None)

@@ -68,7 +68,6 @@ class BC(Trainable):
             raise ValueError("BCConfig.dataset is required (offline data)")
         self.cfg = cfg
         probe = make_env(cfg.env, seed=cfg.seed)
-        self._probe_env = probe
         self.params = init_mlp(
             jax.random.PRNGKey(cfg.seed),
             [probe.observation_size, cfg.hidden, cfg.hidden,

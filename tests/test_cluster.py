@@ -332,6 +332,36 @@ def test_lineage_reconstruction_on_worker_death(cluster):
     assert float(again[0]) == 7.0 and again.shape == (300_000,)
 
 
+def test_recovery_attempts_not_burned_by_polling(cluster):
+    """Getters polling while a recovery is in flight must not consume the
+    bounded recovery budget (runtime.py _recover_object dedup-before-count;
+    this raced as a spurious ObjectLostError under load)."""
+    import numpy as np
+
+    @remote
+    def build():
+        import numpy as np
+        return np.full(300_000, 3.0, np.float32)
+
+    ref = build.remote()
+    assert float(ray_tpu.get(ref, timeout=60)[0]) == 3.0
+    rt = global_worker.runtime
+    rt.store.delete(ref.id)
+    if rt.shm is not None:
+        try:
+            rt.shm.delete(ref.id.binary())
+        except Exception:
+            pass
+    cluster.kill_workers()
+    time.sleep(0.3)
+    # Hammer the recovery entry point like racing getters would.
+    for _ in range(6):
+        assert rt._recover_object(ref.id)
+    assert rt._recovery_attempts.get(ref.id, 0) <= 1
+    again = ray_tpu.get(ref, timeout=120)
+    assert float(again[0]) == 3.0
+
+
 def test_put_objects_are_not_reconstructable(cluster):
     """Lost put() objects raise ObjectLostError (no lineage — reference
     semantics: only task returns reconstruct)."""
