@@ -1,0 +1,58 @@
+"""Measure achievable bf16 matmul FLOP/s on this chip (MFU ceiling probe).
+
+Chains iterations through a data dependency AND fetches a scalar to host
+each timing — on the axon tunnel, block_until_ready alone does not appear
+to wait for execution.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def bench(n, iters=20):
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16) * 0.01
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16) * 0.01
+
+    @jax.jit
+    def chain(a, b):
+        def body(x, _):
+            return lax.dot(x, b, preferred_element_type=jnp.bfloat16) * 0.01, None
+        out, _ = lax.scan(body, a, None, length=iters)
+        return out.astype(jnp.float32).sum()
+
+    float(chain(a, b))  # warmup + compile
+    t0 = time.perf_counter()
+    s = float(chain(a, b))
+    dt = (time.perf_counter() - t0) / iters
+    flops = 2 * n * n * n
+    print(f"{n}^3 chained+fetch: {dt*1e3:.3f} ms/matmul  "
+          f"{flops/dt/1e12:.1f} TFLOP/s (sum={s:.3g})", flush=True)
+
+
+for n in [2048, 4096, 8192]:
+    bench(n)
+
+# asymptote probes: vary iters to separate fixed fetch latency, bigger n
+def bench2(n, iters):
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16) * 0.01
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16) * 0.01
+
+    @jax.jit
+    def chain(a, b):
+        def body(x, _):
+            return lax.dot(x, b, preferred_element_type=jnp.bfloat16) * 0.01, None
+        out, _ = lax.scan(body, a, None, length=iters)
+        return out.astype(jnp.float32).sum()
+
+    float(chain(a, b))
+    t0 = time.perf_counter()
+    float(chain(a, b))
+    tot = time.perf_counter() - t0
+    flops = 2 * n * n * n * iters
+    print(f"n={n} iters={iters}: total {tot*1e3:.1f} ms  {flops/tot/1e12:.1f} TFLOP/s",
+          flush=True)
+
+for it in [5, 20, 80]:
+    bench2(8192, it)

@@ -1,0 +1,53 @@
+"""Profile the async-task submission path (single_client_tasks_async)."""
+import cProfile
+import os
+import pstats
+import sys
+import time
+
+import ray_tpu
+from ray_tpu import remote
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.worker import global_worker
+from ray_tpu.utils.ids import JobID
+
+os.environ.setdefault("RTPU_WORKER_IDLE_TTL_S", "300")
+from ray_tpu.utils import config as config_mod
+
+config_mod.set_config(config_mod.Config.load())
+
+
+@remote
+def noop(*_args):
+    return None
+
+
+c = Cluster()
+c.add_node(num_cpus=4)
+rt = c.connect()
+global_worker.runtime = rt
+global_worker.worker_id = rt.worker_id
+global_worker.node_id = rt.node_id
+global_worker.job_id = JobID.from_random()
+global_worker.mode = "cluster"
+
+batch = 500
+ray_tpu.get(noop.remote(), timeout=60)
+# warm until steady state (worker pool fully forked)
+for _ in range(5):
+    ray_tpu.get([noop.remote() for _ in range(batch)])
+
+t0 = time.perf_counter()
+ray_tpu.get([noop.remote() for _ in range(batch)])
+print(f"warm batch: {batch/(time.perf_counter()-t0):.0f} tasks/s",
+      file=sys.stderr)
+
+pr = cProfile.Profile()
+pr.enable()
+for _ in range(3):
+    ray_tpu.get([noop.remote() for _ in range(batch)])
+pr.disable()
+st = pstats.Stats(pr)
+st.sort_stats("cumulative").print_stats(35)
+rt.shutdown()
+c.shutdown()

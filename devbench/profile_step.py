@@ -1,0 +1,137 @@
+"""Decompose the Llama-1B train-step time on the real TPU.
+
+Timing protocol (axon tunnel): block_until_ready does not block, so every
+measurement chains steps through donated state and ends with a scalar host
+fetch; per-step time is the slope between two iteration counts (cancels the
+fixed ~70ms dispatch+fetch latency).
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models.llama import LlamaConfig, forward_hidden, init_params, loss_fn, param_logical_axes, unembed_weights
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_tpu.train.spmd import make_llama_train_step
+
+cfg = LlamaConfig(
+    vocab_size=32128, hidden_size=2048, intermediate_size=8192,
+    num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+    max_seq_len=2048, tie_embeddings=True, dtype="bfloat16",
+)
+BATCH, SEQ = 4, 2048
+N_PARAMS = cfg.num_params()
+mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+
+
+def timed_slope(run_n, n1=3, n2=9):
+    """run_n(n) must execute n chained device steps then fetch a scalar."""
+    run_n(1)  # warmup/compile
+    t0 = time.perf_counter(); run_n(n1); ta = time.perf_counter() - t0
+    t0 = time.perf_counter(); run_n(n2); tb = time.perf_counter() - t0
+    return (tb - ta) / (n2 - n1)
+
+
+def report(name, per_step, tokens=BATCH * SEQ):
+    tps = tokens / per_step
+    mfu = 6.0 * N_PARAMS * tps / 1.97e14
+    print(f"{name:34s} {per_step*1e3:8.1f} ms  {tps:9.0f} tok/s  "
+          f"model-MFU(v5e)={mfu:.3f}", flush=True)
+
+
+rng = np.random.default_rng(0)
+tokens_h = rng.integers(0, cfg.vocab_size, (BATCH, SEQ), dtype=np.int32)
+targets_h = np.roll(tokens_h, -1, axis=1)
+
+# ---- full train step (dots, flash) -----------------------------------------
+opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
+step_fn, init_state, shard = make_llama_train_step(
+    cfg, mesh, optimizer=opt, attn_impl="flash", remat="dots")
+state = init_state()
+tokens = shard(tokens_h)
+targets = shard(targets_h)
+
+
+def run_full(n):
+    global state
+    for _ in range(n):
+        state, m = step_fn(state, tokens, targets)
+    float(m["loss"])
+
+
+report("full step (dots, flash)", timed_slope(run_full))
+
+# ---- fwd+bwd only (no optimizer) -------------------------------------------
+params = state.params
+
+
+def make_gradloop(attn_impl, remat, fused_ce=True):
+    def gloss(p, t, tg):
+        return loss_fn(cfg, p, t, tg, fused_ce=fused_ce, attn_impl=attn_impl,
+                       remat=remat)
+
+    @jax.jit
+    def gstep(p, t, tg, acc):
+        l, g = jax.value_and_grad(gloss)(p, t, tg)
+        # chain dependency: fold grads into a scalar accumulator
+        return acc + l + 0.0 * jax.tree_util.tree_reduce(
+            lambda a, b: a + b.astype(jnp.float32).sum() * 0.0, g, 0.0)
+
+    def run(n):
+        acc = jnp.zeros((), jnp.float32)
+        for _ in range(n):
+            acc = gstep(params, tokens, targets, acc)
+        float(acc)
+    return run
+
+
+def safe(name, thunk, tokens=BATCH * SEQ):
+    try:
+        report(name, timed_slope(thunk), tokens)
+    except Exception as e:
+        print(f"{name:34s} FAILED: {str(e)[:120]}", flush=True)
+
+
+safe("fwd+bwd (dots, flash)", make_gradloop("flash", "dots"))
+safe("fwd+bwd (full remat, flash)", make_gradloop("flash", "full"))
+
+# ---- fwd only ---------------------------------------------------------------
+@jax.jit
+def fwd_only(p, t, tg, acc):
+    return acc + loss_fn(cfg, p, t, tg, fused_ce=True, attn_impl="flash",
+                         remat="none")
+
+
+def run_fwd(n):
+    acc = jnp.zeros((), jnp.float32)
+    for _ in range(n):
+        acc = fwd_only(params, tokens, targets, acc)
+    float(acc)
+
+
+safe("fwd only (flash, no remat)", run_fwd)
+
+# ---- fwd+bwd of hidden trunk only (no CE head) ------------------------------
+def make_trunk(attn_impl):
+    def tl(p, t):
+        x = forward_hidden(cfg, p, t, attn_impl=attn_impl, remat="dots")
+        return x.astype(jnp.float32).mean()
+
+    @jax.jit
+    def tstep(p, t, acc):
+        l, g = jax.value_and_grad(tl)(p, t)
+        return acc + l + 0.0 * jax.tree_util.tree_reduce(
+            lambda a, b: a + b.astype(jnp.float32).sum() * 0.0, g, 0.0)
+
+    def run(n):
+        acc = jnp.zeros((), jnp.float32)
+        for _ in range(n):
+            acc = tstep(params, tokens, acc)
+        float(acc)
+    return run
+
+
+safe("fwd+bwd trunk only (no CE)", make_trunk("flash"))

@@ -1,0 +1,44 @@
+"""Plain timing of async-task batches (no profiler)."""
+import os
+import sys
+import time
+
+import ray_tpu
+from ray_tpu import remote
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.worker import global_worker
+from ray_tpu.utils.ids import JobID
+
+os.environ.setdefault("RTPU_WORKER_IDLE_TTL_S", "300")
+from ray_tpu.utils import config as config_mod
+
+config_mod.set_config(config_mod.Config.load())
+
+
+@remote
+def noop(*_args):
+    return None
+
+
+c = Cluster()
+c.add_node(num_cpus=4)
+rt = c.connect()
+global_worker.runtime = rt
+global_worker.worker_id = rt.worker_id
+global_worker.node_id = rt.node_id
+global_worker.job_id = JobID.from_random()
+global_worker.mode = "cluster"
+
+batch = 500
+ray_tpu.get(noop.remote(), timeout=60)
+ray_tpu.get([noop.remote() for _ in range(batch)])
+for i in range(6):
+    t0 = time.perf_counter()
+    ray_tpu.get([noop.remote() for _ in range(batch)])
+    ks = list(rt._key_states.values())
+    nworkers = sum(len(k.workers) for k in ks)
+    print(f"round {i}: {batch/(time.perf_counter()-t0):.0f} tasks/s "
+          f"workers={nworkers} pending={sum(k.pending_leases for k in ks)}",
+          file=sys.stderr, flush=True)
+rt.shutdown()
+c.shutdown()

@@ -21,7 +21,12 @@ from ray_tpu.utils.ids import ActorID, TaskID
 
 
 _DEFAULT_ACTOR_OPTIONS = dict(
-    num_cpus=1,
+    # Actors default to ZERO lifetime CPUs (reference: actors without an
+    # explicit num_cpus use 1 CPU for placement but 0 while running, so any
+    # number of actors can share a node). A default of 1 starves task
+    # submission: a handful of long-lived actors would hold every CPU lease
+    # on the node and later tasks would wait on leases forever.
+    num_cpus=0,
     num_tpus=0,
     resources=None,
     max_restarts=0,

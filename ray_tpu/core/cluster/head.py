@@ -388,11 +388,19 @@ class HeadServer:
         return pool[0][2]
 
     async def _schedule_actor(self, info: ActorInfo) -> bool:
-        node = self._pick_node(info.resources, info.node_affinity,
+        # Placement demand: an actor with no lifetime resources still
+        # weighs one CPU for the placement DECISION (reference: default
+        # actors cost 1 CPU to place, 0 while running) — otherwise every
+        # zero-resource actor looks free everywhere, the optimistic
+        # decrement below is a no-op, and default actors all stack on the
+        # single most-free node.
+        placement = dict(info.resources) if any(info.resources.values()) \
+            else {"CPU": 1.0}
+        node = self._pick_node(placement, info.node_affinity,
                                info.labels)
         if node is None and info.node_affinity and info.affinity_soft:
             # Soft affinity: target gone/infeasible → default placement.
-            node = self._pick_node(info.resources, None, info.labels)
+            node = self._pick_node(placement, None, info.labels)
         if node is None:
             return False
         info.node_id = node.node_id
@@ -403,7 +411,7 @@ class HeadServer:
         # arrives with the next heartbeat, but back-to-back placements must
         # not all see the same node as free (placement would stack
         # same-resource actors on one node).
-        for k, v in info.resources.items():
+        for k, v in placement.items():
             node.available[k] = node.available.get(k, 0.0) - v
         # Ask the node daemon to place the actor in a fresh/pooled worker
         # (reference: GcsActorScheduler leases a worker from the raylet).

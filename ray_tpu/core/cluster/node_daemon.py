@@ -118,6 +118,21 @@ class NodeDaemon:
         r("return_bundle", self._return_bundle)
         r("list_logs", self._list_logs)
         r("tail_log", self._tail_log)
+        r("prestart_workers", self._prestart_workers)
+
+    async def _prestart_workers(self, conn, n: int = 0):
+        """Warm the worker pool ahead of demand (reference:
+        NodeManager::HandlePrestartWorkers node_manager.cc:1864). Forks up
+        to ``n`` workers beyond those alive or already starting, bounded by
+        the node's CPU count and the per-node worker cap."""
+        cfg = get_config()
+        have = len(self.workers) + len(self._unregistered)
+        want = min(int(n) if n else int(self.resources.get("CPU", 1)),
+                   int(self.resources.get("CPU", 1)),
+                   cfg.max_workers_per_node) - have
+        for _ in range(max(0, want)):
+            self._fork_worker()
+        return {"started": max(0, want), "have": have}
 
     async def _list_logs(self, conn, **kw):
         """Worker log files on this node (reference: `ray logs` — the

@@ -89,7 +89,7 @@ class _KeyState:
     SchedulingKey in normal_task_submitter.h:52). Loop-thread-only."""
 
     __slots__ = ("key", "resources", "env_hash", "queue", "workers",
-                 "pending_leases", "strategy", "spread_idx")
+                 "pending_leases", "strategy", "spread_idx", "pump_scheduled")
 
     def __init__(self, key, resources, env_hash, strategy=None):
         self.key = key
@@ -100,6 +100,7 @@ class _KeyState:
         self.pending_leases = 0
         self.strategy = strategy   # SchedulingStrategy (None = DEFAULT)
         self.spread_idx = 0        # SPREAD round-robin cursor
+        self.pump_scheduled = False  # a deferred _pump is queued on the loop
 
 
 class _ActorState:
@@ -109,7 +110,8 @@ class _ActorState:
     Loop-thread-only."""
 
     __slots__ = ("actor_id", "client", "addr", "pending", "inflight",
-                 "resolving", "window", "retrying", "recovering")
+                 "resolving", "window", "retrying", "recovering",
+                 "pump_scheduled")
 
     def __init__(self, actor_id: str):
         self.actor_id = actor_id
@@ -121,6 +123,7 @@ class _ActorState:
         self.window = 256
         self.retrying: list[_TaskItem] = []
         self.recovering = False
+        self.pump_scheduled = False
 
 
 class ClusterRuntime:
@@ -168,6 +171,10 @@ class ClusterRuntime:
         self._daemon = RpcClient(*node_daemon_addr) if node_daemon_addr else None
         # Submission state machines — touched only from the io loop thread.
         self._key_states: dict[tuple, _KeyState] = {}
+        # Cross-thread submission buffer (drained on the loop in one wakeup).
+        self._submit_buf: deque[_TaskItem] = deque()
+        self._submit_wake = False
+        self._submit_lock = threading.Lock()
         self._actor_sm: dict[str, _ActorState] = {}
         # task_id hex -> ("queued", _KeyState) | ("running", _LeasedWorker)
         self._task_where: dict[str, tuple] = {}
@@ -541,6 +548,9 @@ class ClusterRuntime:
                                                xfer[0], xfer[1])
                 if total is None:
                     return None
+                # Sealing into the arena bypasses store.on_seal — wake
+                # concurrent wait()ers on this ref like the RPC path does.
+                self._notify_waiters()
                 return self.shm.get_bytes(oid)
             data = transfer.fetch_to_buffer(ref.id.binary(), xfer[0],
                                             xfer[1])
@@ -548,6 +558,7 @@ class ClusterRuntime:
                 # Cache like the RPC chunk path does, or every re-get of
                 # this ref re-transfers the whole object.
                 self.store.put(ref.id, data, ref.owner_id)
+                self._notify_waiters()
             return data
         except Exception:  # noqa: BLE001 - any native failure -> RPC path
             return None
@@ -683,8 +694,27 @@ class ClusterRuntime:
                     break
                 self._lineage.pop(old_tid)
                 self._lineage_bytes -= len(entry[1])
-        self._io.loop.call_soon_threadsafe(self._submit_on_loop, item)
+        # Coalesce cross-thread wakeups: call_soon_threadsafe writes the
+        # loop's self-pipe per call (a syscall per task under fan-out
+        # submission). One wakeup drains everything submitted since.
+        with self._submit_lock:
+            self._submit_buf.append(("task", item))
+            wake = not self._submit_wake
+            self._submit_wake = True
+        if wake:
+            self._io.loop.call_soon_threadsafe(self._drain_submits)
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+
+    def _drain_submits(self) -> None:
+        with self._submit_lock:
+            items = list(self._submit_buf)
+            self._submit_buf.clear()
+            self._submit_wake = False
+        for kind, item in items:
+            if kind == "task":
+                self._submit_on_loop(item)
+            else:
+                self._actor_submit_on_loop(item)
 
     def _recover_object(self, object_id: ObjectID) -> bool:
         """Lineage reconstruction: resubmit the task that created the object
@@ -739,6 +769,15 @@ class ClusterRuntime:
             self._key_states[key] = ks
         ks.queue.append(item)
         self._task_where[tid] = ("queued", ks)
+        # Defer the pump one loop tick so a burst of submissions lands in
+        # the queue before dispatch — that is what lets _pump push a BATCH
+        # per worker instead of one task per frame.
+        if not ks.pump_scheduled:
+            ks.pump_scheduled = True
+            self._io.loop.call_soon(self._deferred_pump, ks)
+
+    def _deferred_pump(self, ks: _KeyState) -> None:
+        ks.pump_scheduled = False
         self._pump(ks)
 
     def _pump(self, ks: _KeyState) -> None:
@@ -761,24 +800,53 @@ class ClusterRuntime:
         while ks.queue:
             live = [w for w in ks.workers
                     if not w.dead and w.inflight < depth]
-            if spread and ks.pending_leases > 0:
+            if spread and ks.pending_leases >= len(ks.queue):
                 # Don't funnel the backlog through an already-used worker
-                # while fresh leases (round-robined over other nodes) are
-                # still in flight — that would defeat the spread.
+                # while fresh leases (round-robined over other nodes) can
+                # still absorb it — that would defeat the spread. But when
+                # the backlog outruns the in-flight leases, reuse idle
+                # leased workers instead of starving them behind lease
+                # churn (which caps throughput below leased capacity).
                 live = [w for w in live if w.served == 0]
             if not live:
                 break
             w = min(live, key=lambda w: w.inflight)
             w.served += 1
-            item = ks.queue.popleft()
-            tid = item.spec.task_id.hex()
-            if tid in self._cancelled:
-                self._task_where.pop(tid, None)
-                self._store_error_local(item.return_ids, TaskCancelledError())
+            # Fill the worker's remaining pipeline capacity in ONE batched
+            # push frame: per-task RPCs cost a frame + dispatch + executor
+            # hop each, which dominates small-task throughput (reference
+            # batches the lease-reuse path in normal_task_submitter.cc).
+            batch: list[_TaskItem] = []
+            room = 1 if spread else depth - w.inflight
+            while ks.queue and len(batch) < room:
+                item = ks.queue.popleft()
+                tid = item.spec.task_id.hex()
+                if tid in self._cancelled:
+                    self._task_where.pop(tid, None)
+                    self._store_error_local(item.return_ids,
+                                            TaskCancelledError())
+                    continue
+                if item.spec.num_returns == "streaming" and batch:
+                    # Streaming tasks ride the single-push path (their
+                    # items flow back on the pushing connection).
+                    ks.queue.appendleft(item)
+                    break
+                batch.append(item)
+                if item.spec.num_returns == "streaming":
+                    break
+            if not batch:
                 continue
-            w.inflight += 1
-            self._task_where[tid] = ("running", w)
-            spawn_task(self._push_and_collect(ks, w, item))
+            w.inflight += len(batch)
+            for item in batch:
+                self._task_where[item.spec.task_id.hex()] = ("running", w)
+            # Streaming is the ONLY single-push user (its items flow back on
+            # the pushing connection); everything else takes the batch path
+            # even for one task, so there is a single failure-handling state
+            # machine for normal tasks.
+            if batch[0].spec.num_returns == "streaming":
+                spawn_task(self._push_and_collect(ks, w, batch[0]))
+            else:
+                spawn_task(self._push_batch_and_collect(ks, w, batch))
         # Scale out: request more leases while a backlog remains.
         if self._daemon is None:
             if ks.queue and not ks.workers and ks.pending_leases == 0:
@@ -838,6 +906,57 @@ class ClusterRuntime:
             where = self._task_where.get(tid)
             if where is not None and where[0] == "running":
                 self._task_where.pop(tid, None)
+            self._pump(ks)
+
+    async def _push_batch_and_collect(self, ks: _KeyState, w: _LeasedWorker,
+                                      items: list[_TaskItem]) -> None:
+        """Batched variant of _push_and_collect: one RPC carries N task
+        specs, one reply carries N results (executed in order on the
+        worker). Failure handling mirrors the single path, applied to every
+        item of the batch."""
+        try:
+            reply = await w.client.call(
+                "push_task_batch", blobs=[i.blob for i in items],
+                timeout=None)
+            for item, r in zip(items, reply["replies"]):
+                self._handle_task_reply(item.spec, item.return_ids, r,
+                                        notify=False)
+            self._notify_waiters()
+        except (RpcError, OSError) as e:
+            w.dead = True
+            if w in ks.workers:
+                ks.workers.remove(w)
+                spawn_task(self._return_dead_lease(w))
+            sent = getattr(e, "sent", True)
+            retry = []
+            for item in items:
+                if sent:
+                    item.attempts += 1
+                if item.attempts > max(item.spec.max_retries, 0):
+                    self._store_error_local(
+                        item.return_ids,
+                        TaskError(RuntimeError(f"system failure: {e}"),
+                                  task_desc=item.spec.name))
+                else:
+                    retry.append(item)
+            if retry:
+                await asyncio.sleep(get_config().task_retry_delay_s)
+                for item in retry:
+                    ks.queue.append(item)
+                    self._task_where[item.spec.task_id.hex()] = ("queued", ks)
+        except Exception as e:  # noqa: BLE001
+            for item in items:
+                self._store_error_local(item.return_ids,
+                                        TaskError(e, task_desc=item.spec.name))
+        finally:
+            w.inflight -= len(items)
+            if w.inflight <= 0:
+                w.idle_since = time.monotonic()
+            for item in items:
+                tid = item.spec.task_id.hex()
+                where = self._task_where.get(tid)
+                if where is not None and where[0] == "running":
+                    self._task_where.pop(tid, None)
             self._pump(ks)
 
     async def _lease_entry_daemon(self, ks: _KeyState):
@@ -925,10 +1044,16 @@ class ClusterRuntime:
                 return
             raise ValueError("granted workers repeatedly unreachable")
         except Exception as e:  # noqa: BLE001
-            # Lease failed (infeasible/timeout): fail the oldest queued task
-            # of this key — mirrors the old per-task acquire semantics where
-            # one waiting task absorbed one lease failure.
-            if ks.queue and not ks.workers:
+            # A lease TIMEOUT is a stale-demand signal, not a task failure:
+            # the request was sized for an earlier queue depth (e.g. a burst
+            # that finished on fewer workers than requested). Failing a
+            # queued task for it poisons whatever happens to be queued when
+            # the 30 s timer fires. Just fall through to the finally-pump,
+            # which re-requests leases sized to the CURRENT deficit.
+            # Genuinely un-servable demands (infeasible resources, dead
+            # affinity targets, unreachable workers) still fail a waiting
+            # task, mirroring the per-task acquire semantics.
+            if "lease timeout" not in str(e) and ks.queue and not ks.workers:
                 item = ks.queue.popleft()
                 self._task_where.pop(item.spec.task_id.hex(), None)
                 self._store_error_local(item.return_ids,
@@ -937,7 +1062,8 @@ class ClusterRuntime:
             ks.pending_leases -= 1
             self._pump(ks)
 
-    def _handle_task_reply(self, spec, return_ids, reply: dict):
+    def _handle_task_reply(self, spec, return_ids, reply: dict,
+                           notify: bool = True):
         if "stream_count" in reply:
             # End of a streaming task: the item count seals the stream
             # (return_ids == [end marker oid] for streaming specs).
@@ -956,7 +1082,8 @@ class ClusterRuntime:
                 self.store.put(oid, r["data"], self.worker_id)
             elif r.get("location"):
                 self._locations[oid] = r["location"]
-        self._notify_waiters()
+        if notify:
+            self._notify_waiters()
 
     async def _on_stream_item(self, task_id: str, index: int,
                               data: bytes | None = None,
@@ -1083,7 +1210,12 @@ class ClusterRuntime:
             self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
         spec.owner_id = self.worker_id
         item = _TaskItem(spec, serialization.dumps_spec(spec), return_ids)
-        self._io.loop.call_soon_threadsafe(self._actor_submit_on_loop, item)
+        with self._submit_lock:
+            self._submit_buf.append(("actor", item))
+            wake = not self._submit_wake
+            self._submit_wake = True
+        if wake:
+            self._io.loop.call_soon_threadsafe(self._drain_submits)
         return [ObjectRef(oid, self.worker_id) for oid in return_ids]
 
     # -- loop-side actor state machine --------------------------------------
@@ -1094,6 +1226,13 @@ class ClusterRuntime:
             st = _ActorState(aid)
             self._actor_sm[aid] = st
         st.pending.append(item)
+        # Defer one tick so same-burst calls dispatch as a batch frame.
+        if not st.pump_scheduled:
+            st.pump_scheduled = True
+            self._io.loop.call_soon(self._actor_deferred_pump, st)
+
+    def _actor_deferred_pump(self, st: _ActorState) -> None:
+        st.pump_scheduled = False
         self._actor_pump(st)
 
     def _actor_pump(self, st: _ActorState) -> None:
@@ -1104,13 +1243,26 @@ class ClusterRuntime:
                 st.resolving = True
                 spawn_task(self._actor_resolve(st))
             return
-        # FIFO dispatch: tasks spawned here start in creation order and the
-        # connection's write lock is FIFO, so frames hit the wire in program
-        # order (reference: sequence-numbered sends).
+        # FIFO dispatch: tasks spawned here start in creation order and
+        # frames hit the wire in program order (reference: sequence-numbered
+        # sends). Bursst of calls ride one batched frame each (the worker
+        # executes them in order and replies once).
         while st.pending and st.inflight < st.window:
-            item = st.pending.popleft()
-            st.inflight += 1
-            spawn_task(self._actor_push(st, item))
+            batch: list[_TaskItem] = []
+            room = min(st.window - st.inflight, 64)
+            while st.pending and len(batch) < room:
+                if st.pending[0].spec.num_returns == "streaming" and batch:
+                    break  # streaming rides the single-push path
+                batch.append(st.pending.popleft())
+                if batch[-1].spec.num_returns == "streaming":
+                    break
+            st.inflight += len(batch)
+            # Streaming only on the single path; batch otherwise (one
+            # failure-handling state machine for normal calls).
+            if batch[0].spec.num_returns == "streaming":
+                spawn_task(self._actor_push(st, batch[0]))
+            else:
+                spawn_task(self._actor_push_batch(st, batch))
 
     async def _actor_resolve(self, st: _ActorState) -> None:
         """Wait for the actor to be ALIVE and open its connection. Transient
@@ -1202,6 +1354,53 @@ class ClusterRuntime:
                                     TaskError(e, task_desc=item.spec.name))
         finally:
             st.inflight -= 1
+            self._actor_pump(st)
+
+    async def _actor_push_batch(self, st: _ActorState,
+                                items: list[_TaskItem]) -> None:
+        """Batched variant of _actor_push: one frame carries N method calls,
+        executed in order by the actor, one reply with N results. Failure
+        handling mirrors the single path applied per item (all land in
+        ``retrying`` in order, so the post-restart merge preserves FIFO)."""
+        client = st.client
+        try:
+            reply = await client.call("push_actor_task_batch",
+                                      blobs=[i.blob for i in items],
+                                      timeout=None)
+            if reply.get("dead"):
+                raise RpcError(reply.get("reason", "actor dead"))
+            for item, r in zip(items, reply["replies"]):
+                self._handle_task_reply(item.spec, item.return_ids, r,
+                                        notify=False)
+            self._notify_waiters()
+        except (RpcError, OSError):
+            if st.client is client:
+                try:
+                    await client.close()
+                except Exception:
+                    pass
+                st.client = None
+                self._actor_addr_cache.pop(st.actor_id, None)
+            for item in items:
+                item.attempts += 1
+                if item.attempts > 60:
+                    self._store_error_local(
+                        item.return_ids,
+                        ActorDiedError(st.actor_id, "worker connection lost"))
+                else:
+                    st.retrying.append(item)
+            if st.retrying:
+                if st.client is not None:
+                    self._merge_retrying(st)
+                elif not st.recovering:
+                    st.recovering = True
+                    spawn_task(self._actor_recover(st, st.addr))
+        except Exception as e:  # noqa: BLE001
+            for item in items:
+                self._store_error_local(item.return_ids,
+                                        TaskError(e, task_desc=item.spec.name))
+        finally:
+            st.inflight -= len(items)
             self._actor_pump(st)
 
     async def _actor_recover(self, st: _ActorState, old_addr) -> None:

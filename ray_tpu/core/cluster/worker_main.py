@@ -96,6 +96,8 @@ class WorkerProcess:
         self._io = EventLoopThread.get()
         srv = self.runtime.server
         srv.register("push_task", self._push_task)
+        srv.register("push_task_batch", self._push_task_batch)
+        srv.register("push_actor_task_batch", self._push_actor_task_batch)
         srv.register("init_actor", self._init_actor)
         srv.register("push_actor_task", self._push_actor_task)
         srv.register("cancel_task", self._cancel_task)
@@ -154,6 +156,32 @@ class WorkerProcess:
         # queue here, matching lease-based resource accounting).
         return await loop.run_in_executor(self._task_executor,
                                           self._execute_task, spec, emit)
+
+    async def _push_task_batch(self, conn, blobs: list):
+        """Batched push: N specs in one frame, executed in order, N results
+        in one reply — one executor hop for the whole batch instead of a
+        queue+future+thread-wake round trip per task (the per-task hop
+        dominates small-task throughput on few-core hosts)."""
+        specs = [serialization.loads_spec(b) for b in blobs]
+        loop = asyncio.get_running_loop()
+        replies = await loop.run_in_executor(self._task_executor,
+                                             self._execute_batch, specs)
+        return {"replies": replies}
+
+    def _execute_batch(self, specs) -> list:
+        # A stale cancel_task async-interrupt can land BETWEEN tasks (see
+        # _SerialExecutor._run, which swallows exactly this). Contain it
+        # here too: an escape would fail the whole batch and get a healthy
+        # worker marked dead by the submitter.
+        replies: list = []
+        while len(replies) < len(specs):
+            try:
+                while len(replies) < len(specs):
+                    replies.append(self._execute_task(specs[len(replies)],
+                                                      None))
+            except TaskCancelledError:
+                continue  # late interrupt for an already-finished task
+        return replies
 
     def _stream_emitter(self, conn, loop, spec):
         """Item pump for streaming tasks: each yield goes back to the owner
@@ -341,6 +369,24 @@ class WorkerProcess:
             item = self._actor_mailbox.get()
             if item is None:
                 return
+            if item[0] == "__batch__":
+                # Sync-actor batch: run all calls in order on this thread,
+                # one reply wakeup for the whole batch (per-call
+                # call_soon_threadsafe is a self-pipe syscall each). Contain
+                # stray async cancel-interrupts landing between calls, like
+                # _execute_batch does.
+                _, specs, reply_fut, loop, conn = item
+                replies = []
+                while len(replies) < len(specs):
+                    try:
+                        while len(replies) < len(specs):
+                            replies.append(self._exec_actor_reply(
+                                specs[len(replies)], loop, conn))
+                    except TaskCancelledError:
+                        continue
+                loop.call_soon_threadsafe(reply_fut.set_result,
+                                          {"replies": replies})
+                continue
             spec, reply_fut, loop, conn = item
             method = getattr(type(self._actor_instance), spec.method_name, None)
             is_async = inspect.iscoroutinefunction(method)
@@ -365,6 +411,10 @@ class WorkerProcess:
                 self._run_actor_method(spec, reply_fut, loop, conn)
 
     def _run_actor_method(self, spec: TaskSpec, reply_fut, loop, conn=None):
+        reply = self._exec_actor_reply(spec, loop, conn)
+        loop.call_soon_threadsafe(reply_fut.set_result, reply)
+
+    def _exec_actor_reply(self, spec: TaskSpec, loop, conn=None) -> dict:
         from ray_tpu.core.events import task_execution
         from ray_tpu.core.worker import set_task_context
 
@@ -404,7 +454,7 @@ class WorkerProcess:
                 else TaskError(e, task_desc=spec.method_name or "")
             reply = {"results": [{"data": serialization.serialize(err)}
                                  for _ in return_ids]}
-        loop.call_soon_threadsafe(reply_fut.set_result, reply)
+        return reply
 
     async def _push_actor_task(self, conn, spec_blob: bytes):
         if self._actor_instance is None:
@@ -414,6 +464,30 @@ class WorkerProcess:
         fut = loop.create_future()
         self._actor_mailbox.put((spec, fut, loop, conn))
         return await fut
+
+    async def _push_actor_task_batch(self, conn, blobs: list):
+        """Batched actor calls: one frame in, one reply out (order
+        preserved). Sync actors run the whole batch on the mailbox consumer
+        thread; async/pooled actors keep their concurrent execution paths,
+        with the replies gathered before answering."""
+        if self._actor_instance is None:
+            return {"dead": True, "reason": "no actor hosted in this worker"}
+        specs = [serialization.loads_spec(b) for b in blobs]
+        loop = asyncio.get_running_loop()
+        simple = (self._actor_pool is None and self._actor_loop is None
+                  and all(s.num_returns != "streaming" and
+                          s.method_name != "__rtpu_call_fn__"
+                          for s in specs))
+        if simple:
+            fut = loop.create_future()
+            self._actor_mailbox.put(("__batch__", specs, fut, loop, conn))
+            return await fut
+        futs = []
+        for s in specs:
+            f = loop.create_future()
+            self._actor_mailbox.put((s, f, loop, conn))
+            futs.append(f)
+        return {"replies": await asyncio.gather(*futs)}
 
     async def _exit_worker(self, conn):
         self._exit_event.set()

@@ -110,7 +110,24 @@ class GrpcProxyActor:
         gen.timeout = 60.0
         if stream:
             return (_encode(c) for c in gen)
-        return _encode(next(gen))
+        # Unary: take exactly the first chunk. A bare next() would leak
+        # StopIteration through the grpc handler as an opaque UNKNOWN error,
+        # and silently drop any extra chunks the deployment yields.
+        try:
+            first = next(gen)
+        except StopIteration:
+            import grpc
+
+            context.abort(grpc.StatusCode.OUT_OF_RANGE,
+                          "deployment yielded no response for unary call")
+        finally:
+            close = getattr(gen, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+        return _encode(first)
 
     def _get_handle(self, deployment_name: str):
         from ray_tpu.serve.handle import DeploymentHandle

@@ -251,6 +251,10 @@ void* transfer_server_start2(const char* shm_name, const char* host,
       st->active.fetch_add(1);  // before detach: pairs with the drain below
       std::thread(ServeConn, st, cfd).detach();
     }
+    // If the listener broke on its own (not via stop()), park until the
+    // owner calls transfer_server_stop: freeing st here would leave the
+    // owner's handle dangling and its stop() call a use-after-free.
+    while (!st->stopping.load()) usleep(10000);
     // Drain in-flight connections before unmapping the arena (a serving
     // thread reading a freed mapping would be use-after-free).
     while (st->active.load() != 0) usleep(1000);
