@@ -35,6 +35,27 @@ class Cluster:
         self.head = start_head(host=host, port=port,
                                persist_path=self._persist_path)
 
+    def crash_head(self) -> None:
+        """Chaos: hard-kill the control plane — NO final snapshot flush
+        (kill -9 semantics) — and bring it back on the same address. State
+        must come back from the per-mutation WAL (reference: GCS persists
+        each mutation to Redis, so a crash between snapshots loses
+        nothing)."""
+        host, port = self.head.rpc.host, self.head.rpc.port
+        head = self.head
+
+        async def hard_stop():
+            if head._health_task:
+                head._health_task.cancel()
+            if head._persist_task:
+                head._persist_task.cancel()
+            head._wal_f = None  # records already flushed per mutation
+            await head.rpc.stop()
+
+        self._io.run(hard_stop())
+        self.head = start_head(host=host, port=port,
+                               persist_path=self._persist_path)
+
     @property
     def address(self) -> str:
         return f"{self.head.rpc.host}:{self.head.rpc.port}"

@@ -76,8 +76,11 @@ class HeadServer:
         self._dirty = False
         self._persist_task: asyncio.Task | None = None
         self._write_fut = None  # in-flight executor write, if any
+        self._wal_f = None  # append handle for the mutation log
+        self.pgs: dict[str, dict] = {}
         if persist_path:
             self._load_snapshot()
+            self._open_wal()
         # Cluster-wide task events flushed from workers (reference:
         # GcsTaskManager bounded task-event store).
         from collections import deque
@@ -121,7 +124,6 @@ class HeadServer:
         r("remove_placement_group", self._remove_pg)
         r("placement_group_state", self._pg_state)
         self.rpc.on_disconnect = self._on_disconnect
-        self.pgs: dict[str, dict] = {}
         self._daemon_clients: dict[str, Any] = {}
 
     async def start(self) -> tuple[str, int]:
@@ -150,19 +152,128 @@ class HeadServer:
         await self.rpc.stop()
 
     # ---------------------------------------------------------- persistence
+    # Durability model (reference: the GCS persists PER MUTATION through
+    # redis_store_client.cc; a crash between writes loses nothing): every
+    # mutation appends a record to a write-ahead log, and the periodic
+    # snapshot compacts it. Records are flushed to the OS per mutation (a
+    # head-process crash loses nothing; only a whole-machine power loss can
+    # drop the un-fsynced tail — redis appendfsync-everysec makes the same
+    # trade). Restart = load snapshot, then replay <path>.wal.old + .wal.
     def mark_dirty(self) -> None:
         self._dirty = True
 
+    def _log_mutation(self, kind: str, *args) -> None:
+        """Append one durable mutation record and mark the snapshot stale."""
+        self._dirty = True
+        if self._wal_f is None:
+            return
+        import pickle
+        import struct
+
+        try:
+            rec = pickle.dumps((kind, args))
+            self._wal_f.write(struct.pack("<I", len(rec)) + rec)
+            self._wal_f.flush()
+        except Exception:
+            pass  # durability is best-effort; the snapshot still lands
+
+    def _open_wal(self) -> None:
+        import os
+
+        os.makedirs(os.path.dirname(os.path.abspath(self._persist_path)),
+                    exist_ok=True)
+        self._wal_f = open(self._persist_path + ".wal", "ab")
+
+    def _rotate_wal(self) -> None:
+        """Called at snapshot-copy time ON THE LOOP THREAD: the snapshot
+        absorbs all state up to this instant, so records before it move to
+        .wal.old (deleted once the snapshot write succeeds; still replayed
+        after a crash mid-write)."""
+        import os
+
+        if self._wal_f is None:
+            return
+        try:
+            self._wal_f.close()
+            old = self._persist_path + ".wal.old"
+            cur = self._persist_path + ".wal"
+            if os.path.exists(old):
+                # A previous snapshot write FAILED: .wal.old still holds
+                # mutations covered by no snapshot. Append, never clobber —
+                # os.replace here would silently drop them.
+                with open(old, "ab") as dst, open(cur, "rb") as src:
+                    dst.write(src.read())
+                os.remove(cur)
+            else:
+                os.replace(cur, old)
+        except Exception:
+            pass
+        self._open_wal()
+
+    def _replay_wal(self) -> None:
+        import os
+        import pickle
+        import struct
+
+        for suffix in (".wal.old", ".wal"):
+            path = self._persist_path + suffix
+            if not os.path.exists(path):
+                continue
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except Exception:
+                continue
+            off = 0
+            while off + 4 <= len(data):
+                (n,) = struct.unpack_from("<I", data, off)
+                if off + 4 + n > len(data):
+                    break  # truncated tail record (crash mid-append)
+                try:
+                    kind, args = pickle.loads(data[off + 4:off + 4 + n])
+                    self._apply_mutation(kind, args)
+                except Exception:
+                    break  # corrupt tail: stop replay, keep what we have
+                off += 4 + n
+
+    def _apply_mutation(self, kind: str, args: tuple) -> None:
+        if kind == "actor":
+            aid, info = args
+            self.actors[aid] = info
+            if info.name:
+                key = (info.namespace, info.name)
+                if info.state == "DEAD":
+                    self.named_actors.pop(key, None)
+                else:
+                    self.named_actors[key] = aid
+        elif kind == "worker":
+            wid, row = args
+            self.workers[wid] = tuple(row)
+        elif kind == "kv_put":
+            ns, key, value = args
+            self.kv.setdefault(ns, {})[key] = value
+        elif kind == "kv_del":
+            ns, key = args
+            self.kv.get(ns, {}).pop(key, None)
+        elif kind == "pg":
+            pg_id, pg = args
+            self.pgs[pg_id] = pg
+        elif kind == "pg_del":
+            self.pgs.pop(args[0], None)
+
     def _snapshot_state(self) -> dict:
         """Copy on the loop thread — the executor pickles the copy while the
-        loop keeps mutating the live tables."""
+        loop keeps mutating the live tables. Rotating the WAL here (same
+        instant, same thread) keeps log and snapshot exactly aligned."""
         import copy
 
+        self._rotate_wal()
         return {
             "actors": dict(self.actors),
             "named_actors": dict(self.named_actors),
             "kv": copy.deepcopy(self.kv),
             "workers": dict(self.workers),
+            "pgs": copy.deepcopy(self.pgs),
         }
 
     def _write_snapshot(self, state: dict) -> None:
@@ -175,12 +286,20 @@ class HeadServer:
         with open(tmp, "wb") as f:
             pickle.dump(state, f)
         os.replace(tmp, self._persist_path)  # atomic swap
+        # The snapshot now covers every record rotated into .wal.old.
+        try:
+            os.remove(self._persist_path + ".wal.old")
+        except OSError:
+            pass
 
     def _load_snapshot(self) -> None:
         import os
         import pickle
 
         if not os.path.exists(self._persist_path):
+            # No snapshot yet — but a WAL may exist (crash before the first
+            # compaction); replay it into the empty tables.
+            self._replay_wal()
             return
         try:
             with open(self._persist_path, "rb") as f:
@@ -189,13 +308,17 @@ class HeadServer:
             # A corrupt snapshot must not crash-loop the control plane:
             # start empty (nodes/workers re-register) and overwrite it.
             self._dirty = True
+            self._replay_wal()
             return
         self.actors = snap.get("actors", {})
         self.named_actors = snap.get("named_actors", {})
         self.kv = snap.get("kv", {})
         self.workers = snap.get("workers", {})
+        self.pgs = snap.get("pgs", {})
         # Restored actors keep their last known addresses; nodes re-register
         # and the health loop culls anything whose node never returns.
+        # Then roll forward mutations logged after the snapshot was cut.
+        self._replay_wal()
 
     async def _persist_loop(self):
         while True:
@@ -315,7 +438,7 @@ class HeadServer:
     async def _register_worker(self, conn: ServerConnection, worker_id: str,
                                host: str, port: int, node_id: str = ""):
         self.workers[worker_id] = (host, port, node_id)
-        self.mark_dirty()
+        self._log_mutation("worker", worker_id, (host, port, node_id))
         return {"ok": True}
 
     async def _resolve_worker(self, conn: ServerConnection, worker_id: str):
@@ -349,7 +472,7 @@ class HeadServer:
         self.actors[actor_id] = info
         if name:
             self.named_actors[(namespace, name)] = actor_id
-        self.mark_dirty()
+        self._log_mutation("actor", actor_id, info)
         ok = await self._schedule_actor(info)
         if not ok:
             info.state = "DEAD"
@@ -428,7 +551,7 @@ class HeadServer:
             return {"ok": False}
         info.worker_addr = (host, port)
         info.state = "ALIVE"
-        self.mark_dirty()
+        self._log_mutation("actor", actor_id, info)
         await self.publish("actor_events", actor_id=actor_id, state="ALIVE",
                            addr=[host, port])
         return {"ok": True}
@@ -452,7 +575,7 @@ class HeadServer:
         info.death_reason = reason
         if info.name:
             self.named_actors.pop((info.namespace, info.name), None)
-        self.mark_dirty()
+        self._log_mutation("actor", info.actor_id, info)
         await self.publish("actor_events", actor_id=info.actor_id, state="DEAD",
                            reason=reason)
 
@@ -570,6 +693,7 @@ class HeadServer:
         self.pgs[pg_id] = {"state": "PENDING", "bundles": bundles,
                            "strategy": strategy, "assignment": None,
                            "name": name}
+        self._log_mutation("pg", pg_id, dict(self.pgs[pg_id]))
         spawn_task(self._schedule_pg(pg_id))
         return {"ok": True}
 
@@ -651,6 +775,7 @@ class HeadServer:
                         return
                     pg["assignment"] = assignment
                     pg["state"] = "CREATED"
+                    self._log_mutation("pg", pg_id, dict(pg))
                     await self.publish("pg_events", pg_id=pg_id, state="CREATED")
                     return
                 # rollback prepared bundles, retry later
@@ -676,6 +801,7 @@ class HeadServer:
         # after its commit phase, so either it rolls its bundles back itself or
         # we return the already-committed assignment here.
         pg["state"] = "REMOVED"
+        self._log_mutation("pg_del", pg_id)
         if pg.get("assignment"):
             await self._rollback_bundles(
                 pg_id, pg["assignment"], list(range(len(pg["assignment"]))))
@@ -695,7 +821,7 @@ class HeadServer:
         if not overwrite and key in table:
             return {"ok": False}
         table[key] = value
-        self.mark_dirty()
+        self._log_mutation("kv_put", ns, key, value)
         return {"ok": True}
 
     async def _kv_get(self, conn: ServerConnection, ns: str, key: str):
@@ -704,7 +830,7 @@ class HeadServer:
     async def _kv_del(self, conn: ServerConnection, ns: str, key: str):
         existed = self.kv.get(ns, {}).pop(key, None) is not None
         if existed:
-            self.mark_dirty()
+            self._log_mutation("kv_del", ns, key)
         return {"ok": existed}
 
     async def _kv_keys(self, conn: ServerConnection, ns: str, prefix: str = ""):

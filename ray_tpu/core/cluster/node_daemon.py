@@ -384,7 +384,7 @@ class NodeDaemon:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 self._pending = [p for p in self._pending if p is not req]
-                return {"error": "lease timeout"}
+                return {"error": "lease timeout", "timeout": True}
             try:
                 return await asyncio.wait_for(
                     asyncio.shield(fut),

@@ -43,6 +43,7 @@ from ray_tpu.core.cluster.protocol import (
 from ray_tpu.core.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    LeaseTimeoutError,
     ObjectLostError,
     TaskCancelledError,
     TaskError,
@@ -1023,6 +1024,8 @@ class ClusterRuntime:
                     raise ValueError(
                         f"lease spill chain exhausted for {ks.resources}")
                 if res.get("error"):
+                    if res.get("timeout"):
+                        raise LeaseTimeoutError(res["error"])
                     raise ValueError(res["error"])
                 client = AsyncRpcClient(*tuple(res["addr"]))
                 client.on_notify("stream_item", self._on_stream_item)
@@ -1053,7 +1056,8 @@ class ClusterRuntime:
             # Genuinely un-servable demands (infeasible resources, dead
             # affinity targets, unreachable workers) still fail a waiting
             # task, mirroring the per-task acquire semantics.
-            if "lease timeout" not in str(e) and ks.queue and not ks.workers:
+            if not isinstance(e, LeaseTimeoutError) and ks.queue \
+                    and not ks.workers:
                 item = ks.queue.popleft()
                 self._task_where.pop(item.spec.task_id.hex(), None)
                 self._store_error_local(item.return_ids,

@@ -30,6 +30,22 @@ from ray_tpu.utils import serialization
 from ray_tpu.utils.config import get_config
 
 
+def _run_batch_contained(specs, run_one) -> list:
+    """Run ``run_one(spec)`` for each spec in order, containing stale
+    cancel_task async-interrupts that land BETWEEN tasks (see
+    _SerialExecutor._run, which swallows exactly this case). An escape
+    would fail the whole batch and get a healthy worker marked dead by
+    the submitter."""
+    replies: list = []
+    while len(replies) < len(specs):
+        try:
+            while len(replies) < len(specs):
+                replies.append(run_one(specs[len(replies)]))
+        except TaskCancelledError:
+            continue  # late interrupt for an already-finished task
+    return replies
+
+
 class _SerialExecutor:
     """One-task-at-a-time executor whose worker thread survives async-raised
     interrupts. cancel_task delivers TaskCancelledError via
@@ -169,19 +185,8 @@ class WorkerProcess:
         return {"replies": replies}
 
     def _execute_batch(self, specs) -> list:
-        # A stale cancel_task async-interrupt can land BETWEEN tasks (see
-        # _SerialExecutor._run, which swallows exactly this). Contain it
-        # here too: an escape would fail the whole batch and get a healthy
-        # worker marked dead by the submitter.
-        replies: list = []
-        while len(replies) < len(specs):
-            try:
-                while len(replies) < len(specs):
-                    replies.append(self._execute_task(specs[len(replies)],
-                                                      None))
-            except TaskCancelledError:
-                continue  # late interrupt for an already-finished task
-        return replies
+        return _run_batch_contained(
+            specs, lambda spec: self._execute_task(spec, None))
 
     def _stream_emitter(self, conn, loop, spec):
         """Item pump for streaming tasks: each yield goes back to the owner
@@ -372,18 +377,10 @@ class WorkerProcess:
             if item[0] == "__batch__":
                 # Sync-actor batch: run all calls in order on this thread,
                 # one reply wakeup for the whole batch (per-call
-                # call_soon_threadsafe is a self-pipe syscall each). Contain
-                # stray async cancel-interrupts landing between calls, like
-                # _execute_batch does.
+                # call_soon_threadsafe is a self-pipe syscall each).
                 _, specs, reply_fut, loop, conn = item
-                replies = []
-                while len(replies) < len(specs):
-                    try:
-                        while len(replies) < len(specs):
-                            replies.append(self._exec_actor_reply(
-                                specs[len(replies)], loop, conn))
-                    except TaskCancelledError:
-                        continue
+                replies = _run_batch_contained(
+                    specs, lambda s: self._exec_actor_reply(s, loop, conn))
                 loop.call_soon_threadsafe(reply_fut.set_result,
                                           {"replies": replies})
                 continue

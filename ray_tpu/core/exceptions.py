@@ -91,5 +91,11 @@ class NodeDiedError(RayTpuError):
     pass
 
 
+class LeaseTimeoutError(RayTpuError):
+    """A worker-lease request waited out the daemon's grant window. A
+    stale-demand signal (the queue that motivated the request drained), not
+    a task failure — submitters re-request sized to the current backlog."""
+
+
 class PlacementGroupSchedulingError(RayTpuError):
     pass

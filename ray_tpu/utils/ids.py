@@ -27,6 +27,7 @@ _UNIQUE_LEN = 16  # bytes of entropy for standalone ids
 # random prefix keeps cross-process collision odds at 2^-64 per pair;
 # itertools.count is atomic under the GIL.
 _RAND_BASE = os.urandom(16)
+_RAND64 = int.from_bytes(_RAND_BASE[8:], "little")
 _COUNTER = itertools.count(int.from_bytes(os.urandom(6), "little"))
 _MASK64 = (1 << 64) - 1
 
@@ -34,7 +35,9 @@ _MASK64 = (1 << 64) - 1
 def _unique_bytes(n: int) -> bytes:
     c = next(_COUNTER) & _MASK64
     if n <= 8:
-        return c.to_bytes(8, "little")[:n]
+        # Small ids (JobID): fold per-process entropy into the counter —
+        # bare counter bits would collide across processes at ~2^-(8n/2).
+        return ((c ^ _RAND64) & _MASK64).to_bytes(8, "little")[:n]
     return _RAND_BASE[: n - 8] + c.to_bytes(8, "little")
 
 
