@@ -44,7 +44,15 @@ BASELINES = {
     "single_client_put_gigabytes": (10.94, "GB/s"),
     "single_client_wait_1k_refs": (4.27, "ops/s"),
     "placement_group_create/removal": (589.0, "PGs/s"),
+    # Reference: 1 GiB broadcast to 50 nodes in 16.72 s (BASELINE.md,
+    # scalability/object_store.json) = 2.99 GB/s aggregate delivery on a
+    # 50-node AWS cluster. Here: 128 MB to 4 fake nodes on one box —
+    # aggregate delivered GB/s, relay-distributed with bounded source
+    # egress (runtime._pick_copy).
+    "object_store_broadcast": (2.99, "GB/s aggregate"),
 }
+
+CLUSTER = None  # set by main(); bench_broadcast adds nodes to it
 
 
 def timeit(name, fn, multiplier=1, min_time=2.0):
@@ -198,6 +206,41 @@ def bench_wait_1k_refs():
     return timeit("single_client_wait_1k_refs", op, min_time=2.0)
 
 
+def bench_broadcast():
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    rt = global_worker.runtime
+    size = 128 * 1024 * 1024
+    n_nodes = 4
+    added = [CLUSTER.add_node(num_cpus=1, node_id=f"bcast-{i}")
+             for i in range(n_nodes)]
+    try:
+        @remote
+        def consume(blob):
+            return len(blob)
+
+        def fan_out():
+            big = ray_tpu.put(b"b" * size)
+            refs = [consume.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=f"bcast-{i}"), num_cpus=1).remote(big)
+                for i in range(n_nodes)]
+            assert ray_tpu.get(refs, timeout=300) == [size] * n_nodes
+
+        fan_out()  # warm worker forks
+        t0 = time.perf_counter()
+        fan_out()
+        dt = time.perf_counter() - t0
+        return n_nodes * size / dt / 1e9
+    finally:
+        for d in added:
+            try:
+                CLUSTER.remove_node(d)
+            except Exception:
+                pass
+
+
 def bench_pg_churn():
     from ray_tpu.util.placement_group import placement_group, remove_placement_group
 
@@ -216,7 +259,9 @@ def main():
 
     config_mod.set_config(config_mod.Config.load())
 
+    global CLUSTER
     c = Cluster()
+    CLUSTER = c
     # 4 CPUs bounds the worker pool: on a small host every extra worker
     # process costs real latency (all cluster processes share the cores).
     c.add_node(num_cpus=4)
@@ -255,6 +300,7 @@ def main():
         ("1_n_actor_calls_async", bench_1_n_actor_calls),
         ("n_n_actor_calls_async", bench_n_n_actor_calls),
         ("placement_group_create/removal", bench_pg_churn),
+        ("object_store_broadcast", bench_broadcast),
     ]
     rows = []
     try:

@@ -477,6 +477,12 @@ class HeadServer:
         if not ok:
             info.state = "DEAD"
             info.death_reason = "no feasible node"
+            if name:
+                self.named_actors.pop((namespace, name), None)
+            # Log the death too — replaying only the PENDING registration
+            # after a crash would resurrect an actor that can never run
+            # (and leave its name squatting in named_actors).
+            self._log_mutation("actor", actor_id, info)
             return {"ok": False, "error": "no feasible node for actor resources"}
         return {"ok": True}
 

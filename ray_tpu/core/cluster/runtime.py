@@ -165,6 +165,15 @@ class ClusterRuntime:
             except Exception:
                 self.shm = None
         self._locations: dict[ObjectID, str] = {}  # owned oid -> holder worker hex
+        # One-to-many distribution (reference: push_manager.h relay trees;
+        # here pull-based): owner tracks every worker that CACHED a copy of
+        # a large owned object and refers new pullers round-robin across
+        # all copies, with a bounded number of outstanding referrals so the
+        # source's egress stays bounded under a simultaneous fan-out.
+        self._replicas: dict[ObjectID, set[str]] = {}
+        self._referrals: dict[ObjectID, list[float]] = {}  # issue stamps
+        self._refer_rr: dict[ObjectID, int] = {}
+        self.refer_counts: dict[ObjectID, dict[str, int]] = {}  # observability
         self._io = EventLoopThread.get()
         self.head = RpcClient(head_host, head_port)
         self._head_host, self._head_port = head_host, head_port
@@ -183,6 +192,8 @@ class ClusterRuntime:
         self._peer_clients: dict[tuple[str, int], RpcClient] = {}
         self._peer_lock = threading.Lock()
         self._actor_addr_cache: dict[str, tuple[str, int]] = {}
+        self._holder_nodes: dict[str, str] = {}  # worker hex -> node hex
+        self._nodes_cache: tuple[float, dict] | None = None  # (ts, nodes)
         self._xfer_cache = None  # (ts, {node_id: transfer_addr})
         self._actor_states: dict[str, str] = {}
         self._cancelled: set[str] = set()  # task_id hex
@@ -208,11 +219,23 @@ class ClusterRuntime:
         self.server.register("free_object", self._handle_free_object)
         self.server.register("report_location", self._handle_report_location)
         self.server.register("report_lost", self._handle_report_lost)
+        self.server.register("report_holder", self._handle_report_holder)
         self.server.register("ping", self._handle_ping)
         self.addr = self._io.run(self.server.start())
+        # Workers learn their node from the forking daemon's env; a DRIVER
+        # asks its attached daemon — without this, objects the driver holds
+        # can't be served over the node's native transfer plane (pullers
+        # couldn't map our worker id to a node).
+        my_node = os.environ.get("RTPU_NODE_ID", "")
+        if not my_node and self._daemon is not None:
+            try:
+                my_node = self._daemon.call("node_info",
+                                            timeout=10).get("node_id", "")
+            except Exception:
+                my_node = ""
         self.head.call("register_worker", worker_id=self.worker_id.hex(),
                        host=self.addr[0], port=self.addr[1],
-                       node_id=os.environ.get("RTPU_NODE_ID", ""))
+                       node_id=my_node)
         self._reaper_task = self._io.spawn(self._lease_reaper())
         # Actor state invalidation via pubsub.
         self.head.aio.on_notify("pub", self._on_pub)
@@ -236,12 +259,61 @@ class ClusterRuntime:
     async def _handle_ping(self, conn, **kw):
         return {"ok": True, "worker_id": self.worker_id.hex()}
 
-    async def _handle_get_object(self, conn, oid: str, timeout: float = 10.0):
+    # Relay-distribution knobs (reference: push_manager bounds concurrent
+    # chunk sends; here the owner bounds outstanding referrals per copy).
+    RELAY_MIN_BYTES = 1 << 20
+    RELAY_REFERRALS_PER_COPY = 2
+    REFERRAL_TTL_S = 15.0
+
+    def _pick_copy(self, object_id: ObjectID, primary: str) -> str | None:
+        """Choose which copy a puller should fetch from. Returns None when
+        the referral budget (bounded source egress) is exhausted — the
+        puller backs off and retries, by which time finished pulls have
+        become new copies and the budget has grown."""
+        copies = [primary] + [h for h in sorted(self._replicas.get(object_id, ()))
+                              if h != primary]
+        now = time.monotonic()
+        stamps = [t for t in self._referrals.get(object_id, ())
+                  if now - t < self.REFERRAL_TTL_S]
+        if len(stamps) >= self.RELAY_REFERRALS_PER_COPY * len(copies):
+            self._referrals[object_id] = stamps
+            return None
+        stamps.append(now)
+        self._referrals[object_id] = stamps
+        i = self._refer_rr.get(object_id, 0)
+        self._refer_rr[object_id] = i + 1
+        pick = copies[i % len(copies)]
+        counts = self.refer_counts.setdefault(object_id, {})
+        counts[pick] = counts.get(pick, 0) + 1
+        return pick
+
+    def _local_size(self, object_id: ObjectID) -> int | None:
+        n = self.store.size(object_id)
+        if n is None and self.shm is not None:
+            n = self.shm.size(object_id.binary())
+        return n
+
+    async def _handle_get_object(self, conn, oid: str, timeout: float = 10.0,
+                                 poll_s: float | None = None):
+        """Long-poll object resolution. ``poll_s`` is the CALLER's budget —
+        always shorter than its RPC timeout, so under load we answer
+        'pending' (caller re-polls) instead of letting the RPC time out
+        (which the borrower must treat as owner death)."""
         object_id = ObjectID.from_hex(oid)
 
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + (poll_s if poll_s else timeout)
         while time.monotonic() < deadline:
-            if self._local_contains(object_id):
+            size = self._local_size(object_id)
+            if size is not None:
+                if size >= self.RELAY_MIN_BYTES:
+                    # Never inline large objects: refer the puller to a
+                    # copy (possibly us) so it uses the bounded chunk /
+                    # native-transfer path and joins the relay set.
+                    loc = self._pick_copy(object_id, self.worker_id.hex())
+                    if loc is None:
+                        await asyncio.sleep(0.05)
+                        continue  # referral budget exhausted: brief backoff
+                    return {"location": loc}
                 data = await asyncio.get_running_loop().run_in_executor(
                     None, self._local_blob, object_id
                 )
@@ -249,9 +321,23 @@ class ClusterRuntime:
                     return {"data": data}
             holder = self._locations.get(object_id)
             if holder is not None:
-                return {"location": holder}
+                loc = self._pick_copy(object_id, holder)
+                if loc is None:
+                    await asyncio.sleep(0.05)
+                    continue
+                return {"location": loc}
             await asyncio.sleep(0.01)
         return {"pending": True}
+
+    async def _handle_report_holder(self, conn, oid: str, worker_id: str):
+        """A puller cached a servable copy: add it to the relay set and
+        free one referral slot (its pull completed)."""
+        object_id = ObjectID.from_hex(oid)
+        self._replicas.setdefault(object_id, set()).add(worker_id)
+        stamps = self._referrals.get(object_id)
+        if stamps:
+            stamps.pop(0)
+        return {"ok": True}
 
     async def _handle_get_object_chunk(self, conn, oid: str, offset: int,
                                        length: int):
@@ -300,13 +386,31 @@ class ClusterRuntime:
         self._notify_waiters()
         return {"ok": True}
 
-    async def _handle_report_lost(self, conn, oid: str):
+    async def _handle_report_lost(self, conn, oid: str,
+                                  holder: str | None = None):
         """A borrower found our recorded holder unreachable: run owner-side
-        lineage recovery (reference: owner-driven recovery on lost copies)."""
+        lineage recovery (reference: owner-driven recovery on lost copies).
+        When the failed holder was merely a relay replica, just drop it
+        from the relay set — the primary is intact."""
         object_id = ObjectID.from_hex(oid)
+        if holder:
+            reps = self._replicas.get(object_id)
+            if reps is not None:
+                reps.discard(holder)
         if self._local_contains(object_id):
             return {"ok": True, "state": "present"}
+        if holder and holder != self._locations.get(object_id) \
+                and self._locations.get(object_id) is not None:
+            return {"ok": True, "state": "present"}  # a replica died, not us
+        # Primary gone — promote a surviving relay replica before resorting
+        # to recompute: a live copy beats lineage reconstruction (and is
+        # the only option for put() objects, which have no lineage).
+        reps = self._replicas.get(object_id)
+        if reps:
+            self._locations[object_id] = next(iter(reps))
+            return {"ok": True, "state": "present"}
         self._locations.pop(object_id, None)
+        self._replicas.pop(object_id, None)
         ok = self._recover_object(object_id)
         return {"ok": ok, "state": "recovering" if ok else "lost"}
 
@@ -372,6 +476,10 @@ class ClusterRuntime:
     def _release_object(self, oid: ObjectID, rec=None) -> None:
         self.store.delete(oid)
         self._recovery_attempts.pop(oid, None)
+        self._replicas.pop(oid, None)
+        self._referrals.pop(oid, None)
+        self._refer_rr.pop(oid, None)
+        self.refer_counts.pop(oid, None)
         # Lineage GC: drop the retained spec once its last return is
         # released (reference: lineage released with the object refs).
         if rec is not None and rec.lineage_task is not None:
@@ -495,9 +603,15 @@ class ClusterRuntime:
             addr = self._resolve_worker_addr(owner_hex)
             if addr is None:
                 raise ObjectLostError(ref.hex(), "owner not found (OwnerDied)")
+            poll = min(remaining or 10.0, 10.0)
             try:
                 res = self._peer(addr).call("get_object", oid=ref.hex(),
-                                            timeout=min(remaining or 10.0, 10.0) + 5)
+                                            poll_s=poll, timeout=poll + 5)
+            except TimeoutError:
+                # Long-poll overran under load (TimeoutError is an OSError
+                # subclass — it must NOT read as owner death); re-ask until
+                # our own deadline expires.
+                continue
             except (RpcError, OSError):
                 raise ObjectLostError(ref.hex(), "owner unreachable")
             if res.get("data") is not None:
@@ -506,6 +620,18 @@ class ClusterRuntime:
             if res.get("location"):
                 data = self._fetch_from_holder(res["location"], ref)
                 if data is not None:
+                    # Relay distribution: if we cached a servable copy,
+                    # tell the owner so later pullers can fetch from US
+                    # instead of the source (reference: push_manager relay
+                    # trees; bounded source egress).
+                    if len(data) >= self.RELAY_MIN_BYTES and \
+                            self._local_contains(ref.id):
+                        try:
+                            self._peer(addr).call(
+                                "report_holder", oid=ref.hex(),
+                                worker_id=self.worker_id.hex(), timeout=5)
+                        except (RpcError, OSError):
+                            pass
                     return data
                 holder_failures += 1
                 if holder_failures >= 2:
@@ -514,7 +640,8 @@ class ClusterRuntime:
                     holder_failures = 0
                     try:
                         verdict = self._peer(addr).call(
-                            "report_lost", oid=ref.hex(), timeout=10)
+                            "report_lost", oid=ref.hex(),
+                            holder=res["location"], timeout=10)
                     except (RpcError, OSError):
                         verdict = None
                     if verdict is not None and verdict.get("state") == "lost":
@@ -996,7 +1123,56 @@ class ClusterRuntime:
                 raise ValueError(
                     f"node affinity target {strat.node_id_hex} is not alive")
             return (await self._apeer(tuple(info["addr"]))), not strat.soft
+        # Data locality (reference: lease_policy.cc LocalityAwareLeasePolicy,
+        # SURVEY §3.2 step 2): when the task at the front of the queue
+        # consumes large objects held on a remote node, lease from that
+        # node's daemon so the bytes don't cross the wire. Only non-inline
+        # objects appear in _locations, so small args never redirect.
+        if ks.queue:
+            nid = await self._locality_node(ks.queue[0].spec)
+            if nid is not None:
+                try:
+                    info = (await self._nodes_cached()).get(nid)
+                    if info is not None and info["alive"] and all(
+                            info["resources"].get(k, 0.0) >= v
+                            for k, v in ks.resources.items()):
+                        return (await self._apeer(tuple(info["addr"]))), False
+                except Exception:
+                    pass  # head hiccup: fall through to the local daemon
         return self._daemon.aio, False
+
+    async def _nodes_cached(self) -> dict:
+        """TTL-cached head node view — the locality branch runs per lease
+        request; an uncached list_nodes there would serialize lease
+        throughput on head round-trips (same pattern as _xfer_cache)."""
+        now = time.monotonic()
+        if self._nodes_cache is not None and now - self._nodes_cache[0] < 1.0:
+            return self._nodes_cache[1]
+        nodes = await self.head.aio.call("list_nodes")
+        self._nodes_cache = (now, nodes)
+        return nodes
+
+    async def _locality_node(self, spec) -> str | None:
+        """Node holding the plurality of the task's located (large) args."""
+        counts: dict[str, int] = {}
+        for oid in spec.arg_ref_ids:
+            holder = self._locations.get(oid)
+            if holder is None:
+                continue
+            node = self._holder_nodes.get(holder)
+            if node is None:
+                try:
+                    res = await self.head.aio.call("resolve_worker",
+                                                   worker_id=holder)
+                except Exception:
+                    continue
+                node = res.get("node_id") or ""
+                self._holder_nodes[holder] = node
+            if node:
+                counts[node] = counts.get(node, 0) + 1
+        if not counts:
+            return None
+        return max(counts.items(), key=lambda kv: kv[1])[0]
 
     async def _request_lease(self, ks: _KeyState) -> None:
         """Lease a worker from the local daemon (or the strategy's entry

@@ -185,6 +185,18 @@ class SharedMemoryStore:
     def release(self, object_id: bytes) -> None:
         self._libh.store_release(self._h, _id_buf(bytes(object_id)))
 
+    def size(self, object_id: bytes) -> int | None:
+        """Size probe without copying the payload out of the arena."""
+        try:
+            view = self.get(object_id)
+        except Exception:
+            return None
+        try:
+            return len(view)
+        finally:
+            view.release()
+            self.release(object_id)
+
     def contains(self, object_id: bytes) -> bool:
         if self._libh.store_contains(self._h, _id_buf(bytes(object_id))):
             return True

@@ -91,6 +91,12 @@ class LocalObjectStore:
         with self._lock:
             return object_id in self._objects
 
+    def size(self, object_id: ObjectID) -> int | None:
+        """O(1) size probe without materializing (or restoring) the data."""
+        with self._lock:
+            entry = self._objects.get(object_id)
+            return entry.size if entry is not None else None
+
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
             entry = self._objects.pop(object_id, None)

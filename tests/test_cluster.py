@@ -654,3 +654,90 @@ def test_head_crash_after_mutation_cluster(tmp_path):
         c.shutdown()
         (global_worker.runtime, global_worker.worker_id,
          global_worker.node_id, global_worker.mode) = old
+
+
+def test_data_locality_lease_placement(tmp_path):
+    """A task consuming a large remote object leases from the node HOLDING
+    it, without a transfer (reference: lease_policy.cc locality-aware lease
+    policy; SURVEY §3.2 step 2 — the chosen raylet is data-locality aware)."""
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    c = Cluster()
+    c.add_node(num_cpus=2, node_id="node-src")
+    c.add_node(num_cpus=2, node_id="node-holder")
+    rt = c.connect()
+    old = (global_worker.runtime, global_worker.worker_id,
+           global_worker.node_id, global_worker.mode)
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    try:
+        @remote
+        def produce():
+            return b"z" * (10 * 1024 * 1024)  # non-inline: stays at executor
+
+        @remote
+        def consume(blob):
+            return (os.environ["RTPU_NODE_ID"], len(blob))
+
+        big = produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id="node-holder"), num_cpus=1).remote()
+        ray_tpu.wait([big], timeout=120)
+        node, size = ray_tpu.get(consume.remote(big), timeout=120)
+        assert size == 10 * 1024 * 1024
+        assert node == "node-holder", f"consumer ran on {node}, not holder"
+    finally:
+        rt.shutdown()
+        c.shutdown()
+        (global_worker.runtime, global_worker.worker_id,
+         global_worker.node_id, global_worker.mode) = old
+
+
+def test_broadcast_relay_distribution(tmp_path):
+    """One-to-many distribution: N nodes pulling the same large object are
+    spread across copies as they appear instead of all hammering the owner
+    (reference: push_manager.h relay/broadcast; BASELINE 1GiB->50 nodes).
+    The owner bounds outstanding referrals per copy, so a simultaneous
+    fan-out cannot exceed 2x concurrent transfers from the source."""
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    c = Cluster()
+    nodes = [c.add_node(num_cpus=2, node_id=f"bnode-{i}") for i in range(4)]
+    rt = c.connect()
+    old = (global_worker.runtime, global_worker.worker_id,
+           global_worker.node_id, global_worker.mode)
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    try:
+        payload = b"b" * (4 * 1024 * 1024)  # >= RELAY_MIN_BYTES
+        big = ray_tpu.put(payload)
+
+        @remote
+        def consume(blob):
+            return len(blob)
+
+        refs = []
+        for i in range(8):
+            node = f"bnode-{i % 4}"
+            refs.append(consume.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node), num_cpus=1).remote(big))
+        out = ray_tpu.get(refs, timeout=180)
+        assert out == [len(payload)] * 8
+        counts = rt.refer_counts.get(big.id, {})
+        assert counts, "owner never issued relay referrals"
+        # Pullers that cached a copy joined the relay set, and referrals
+        # were spread beyond the single source copy.
+        assert len(rt._replicas.get(big.id, ())) >= 1, rt._replicas
+        assert len(counts) >= 2, f"all pulls referred to one copy: {counts}"
+    finally:
+        rt.shutdown()
+        c.shutdown()
+        (global_worker.runtime, global_worker.worker_id,
+         global_worker.node_id, global_worker.mode) = old
