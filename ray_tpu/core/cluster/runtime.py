@@ -165,12 +165,14 @@ class ClusterRuntime:
             except Exception:
                 self.shm = None
         self._locations: dict[ObjectID, str] = {}  # owned oid -> holder worker hex
+        self._location_sizes: dict[ObjectID, int] = {}  # oid -> bytes (if known)
         # One-to-many distribution (reference: push_manager.h relay trees;
         # here pull-based): owner tracks every worker that CACHED a copy of
         # a large owned object and refers new pullers round-robin across
         # all copies, with a bounded number of outstanding referrals so the
         # source's egress stays bounded under a simultaneous fan-out.
         self._replicas: dict[ObjectID, set[str]] = {}
+        self._reported_holder: dict[ObjectID, str] = {}  # oid -> owner hex
         self._referrals: dict[ObjectID, list[float]] = {}  # issue stamps
         self._refer_rr: dict[ObjectID, int] = {}
         self.refer_counts: dict[ObjectID, dict[str, int]] = {}  # observability
@@ -321,6 +323,13 @@ class ClusterRuntime:
                     return {"data": data}
             holder = self._locations.get(object_id)
             if holder is not None:
+                known = self._location_sizes.get(object_id)
+                if known is None or known < self.RELAY_MIN_BYTES:
+                    # Small or unknown-size remote object: plain referral.
+                    # Relay budgeting would stall here — its referral slots
+                    # are only freed by report_holder, which pullers send
+                    # for large cached copies alone.
+                    return {"location": holder}
                 loc = self._pick_copy(object_id, holder)
                 if loc is None:
                     await asyncio.sleep(0.05)
@@ -329,10 +338,17 @@ class ClusterRuntime:
             await asyncio.sleep(0.01)
         return {"pending": True}
 
-    async def _handle_report_holder(self, conn, oid: str, worker_id: str):
-        """A puller cached a servable copy: add it to the relay set and
-        free one referral slot (its pull completed)."""
+    async def _handle_report_holder(self, conn, oid: str, worker_id: str,
+                                    remove: bool = False):
+        """A puller cached a servable copy (add it to the relay set and
+        free one referral slot), or released its copy (``remove`` — stale
+        entries would send later pullers on failed-fetch detours)."""
         object_id = ObjectID.from_hex(oid)
+        if remove:
+            reps = self._replicas.get(object_id)
+            if reps is not None:
+                reps.discard(worker_id)
+            return {"ok": True}
         self._replicas.setdefault(object_id, set()).add(worker_id)
         stamps = self._referrals.get(object_id)
         if stamps:
@@ -381,8 +397,12 @@ class ClusterRuntime:
                 pass
         return {"ok": True}
 
-    async def _handle_report_location(self, conn, oid: str, holder: str):
-        self._locations[ObjectID.from_hex(oid)] = holder
+    async def _handle_report_location(self, conn, oid: str, holder: str,
+                                      size: int | None = None):
+        object_id = ObjectID.from_hex(oid)
+        self._locations[object_id] = holder
+        if size:
+            self._location_sizes[object_id] = int(size)
         self._notify_waiters()
         return {"ok": True}
 
@@ -452,6 +472,10 @@ class ClusterRuntime:
     def _resolve_worker_addr(self, worker_hex: str) -> tuple[str, int] | None:
         return self._resolve_worker(worker_hex)[0]
 
+    async def _aresolve_worker_addr(self, worker_hex: str):
+        res = await self.head.aio.call("resolve_worker", worker_id=worker_hex)
+        return tuple(res["addr"]) if res.get("addr") else None
+
     def _resolve_worker(self, worker_hex: str) -> tuple[tuple | None, str]:
         res = self.head.call("resolve_worker", worker_id=worker_hex)
         addr = tuple(res["addr"]) if res.get("addr") else None
@@ -477,9 +501,30 @@ class ClusterRuntime:
         self.store.delete(oid)
         self._recovery_attempts.pop(oid, None)
         self._replicas.pop(oid, None)
+        self._location_sizes.pop(oid, None)
         self._referrals.pop(oid, None)
         self._refer_rr.pop(oid, None)
         self.refer_counts.pop(oid, None)
+        # If we advertised ourselves as a relay holder for this object,
+        # retract it — the owner would keep referring pullers to a copy we
+        # just dropped. Best-effort, off-thread (GC paths call this).
+        owner_hex = self._reported_holder.pop(oid, None)
+        if owner_hex is not None and not self._shutdown:
+            async def _retract():
+                try:
+                    addr = await self._aresolve_worker_addr(owner_hex)
+                    if addr is not None:
+                        peer = await self._apeer(addr)
+                        await peer.call("report_holder", oid=oid.hex(),
+                                        worker_id=self.worker_id.hex(),
+                                        remove=True, timeout=5)
+                except Exception:
+                    pass
+            try:
+                self._io.loop.call_soon_threadsafe(
+                    lambda: spawn_task(_retract()))
+            except RuntimeError:
+                pass  # loop shut down
         # Lineage GC: drop the retained spec once its last return is
         # released (reference: lineage released with the object refs).
         if rec is not None and rec.lineage_task is not None:
@@ -630,6 +675,7 @@ class ClusterRuntime:
                             self._peer(addr).call(
                                 "report_holder", oid=ref.hex(),
                                 worker_id=self.worker_id.hex(), timeout=5)
+                            self._reported_holder[ref.id] = owner_hex
                         except (RpcError, OSError):
                             pass
                     return data
@@ -1262,12 +1308,15 @@ class ClusterRuntime:
                 self.store.put(oid, r["data"], self.worker_id)
             elif r.get("location"):
                 self._locations[oid] = r["location"]
+                if r.get("size"):
+                    self._location_sizes[oid] = int(r["size"])
         if notify:
             self._notify_waiters()
 
     async def _on_stream_item(self, task_id: str, index: int,
                               data: bytes | None = None,
-                              location: str | None = None):
+                              location: str | None = None,
+                              size: int | None = None):
         """A streaming task yielded item ``index`` (notify frame from the
         executing worker — arrives before the final reply by TCP ordering)."""
         from ray_tpu.utils.ids import TaskID
@@ -1278,6 +1327,8 @@ class ClusterRuntime:
             self.store.put(oid, data, self.worker_id)
         elif location:
             self._locations[oid] = location
+            if size:
+                self._location_sizes[oid] = int(size)
         self._notify_waiters()
 
     def _store_error_local(self, return_ids, err):

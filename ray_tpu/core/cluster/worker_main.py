@@ -208,7 +208,8 @@ class WorkerProcess:
                 self.runtime._store_blob(
                     oid, blob, spec.owner_id or self.runtime.worker_id)
                 coro = conn.notify("stream_item", task_id=tid, index=index,
-                                   location=self.runtime.worker_id.hex())
+                                   location=self.runtime.worker_id.hex(),
+                                   size=len(blob))
             asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=60)
 
         return emit
@@ -334,7 +335,8 @@ class WorkerProcess:
                 # executor).
                 self.runtime._store_blob(
                     oid, blob, spec.owner_id or self.runtime.worker_id)
-                out.append({"location": self.runtime.worker_id.hex()})
+                out.append({"location": self.runtime.worker_id.hex(),
+                            "size": len(blob)})
         return out
 
     # ------------------------------------------------------------------ actors

@@ -46,6 +46,14 @@ logger = logging.getLogger(__name__)
 NEG_INF = -1e30
 
 
+def _lcp(a, b, cap: int) -> int:
+    n = min(len(a), len(b), cap)
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
 def init_kv_cache(cfg: LlamaConfig, max_slots: int, max_seq: int):
     shape = (cfg.num_layers, max_slots, cfg.num_kv_heads, max_seq,
              cfg.head_dim)
@@ -237,6 +245,24 @@ def decode_step(cfg: LlamaConfig, params, cache, tokens, positions,
     return {"k": new_k, "v": new_v}, logits
 
 
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def copy_prefix_kv(cfg: LlamaConfig, cache, src_slot, dst_slot):
+    """Copy one slot's whole KV line to another slot, all layers at once
+    (prefix-cache adoption from a LIVE donor). Copying the full max_seq
+    line is safe: positions beyond the adopted prefix are masked by
+    ``length``/``positions`` in prefill_chunk/decode_step, and the copy is
+    pure HBM bandwidth — orders of magnitude cheaper than recomputing the
+    prefix (vLLM APC makes the same recompute-vs-reuse trade)."""
+    k_line = lax.dynamic_slice_in_dim(cache["k"], src_slot, 1, 1)
+    v_line = lax.dynamic_slice_in_dim(cache["v"], src_slot, 1, 1)
+    return {
+        "k": lax.dynamic_update_slice(cache["k"], k_line,
+                                      (0, dst_slot, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], v_line,
+                                      (0, dst_slot, 0, 0, 0)),
+    }
+
+
 @partial(jax.jit, static_argnums=(3,))
 def sample_tokens(logits, temps, top_ps, top_k: int, key):
     """logits [B, V] fp32; temps/top_ps [B]. Greedy where temp == 0."""
@@ -312,6 +338,19 @@ class LLMEngine:
 
         self._slots: dict[int, GenerationRequest | None] = {
             i: None for i in range(self.max_slots)}
+        # Prefix KV reuse (reference: vLLM automatic prefix caching +
+        # routing_policies/prefix_aware/ — the serve router already sends
+        # shared-prefix requests to the same replica; here the engine makes
+        # the shared prefill actually free). Donor registry:
+        # - _prefix_live: slot -> prompt tokens, prefill COMPLETE, request
+        #   still running (adoption copies the line to the new slot).
+        # - _prefix_cached: retired slot -> (tokens, last_use); the slot is
+        #   unoccupied but its KV is intact — an exact/prefix re-hit admits
+        #   straight into it with zero copy; unrelated admits evict LRU.
+        self._prefix_live: dict[int, tuple[int, ...]] = {}
+        self._prefix_cached: dict[int, tuple[tuple[int, ...], float]] = {}
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
         self._cache_gen = 0  # bumped when a device failure rebuilds the cache
         self._prefill_rr = -1  # last slot that ran a prefill chunk
         self._waiting: queue.Queue[GenerationRequest] = queue.Queue()
@@ -402,6 +441,7 @@ class LLMEngine:
         for slot, r in self._slots.items():
             if r is req:
                 self._slots[slot] = None
+                self._prefix_live.pop(slot, None)
         self._work.set()
 
     def submit_prefilled(self, payload: dict,
@@ -441,7 +481,10 @@ class LLMEngine:
     def stats(self) -> dict:
         active = sum(1 for r in self._slots.values() if r is not None)
         return {"active": active, "waiting": self._waiting.qsize(),
-                "slots": self.max_slots}
+                "slots": self.max_slots,
+                "prefix_hits": self.prefix_hits,
+                "prefix_tokens_saved": self.prefix_tokens_saved,
+                "prefix_cached_slots": len(self._prefix_cached)}
 
     # ---- scheduler ----
 
@@ -475,18 +518,23 @@ class LLMEngine:
             worked = True
         return worked
 
+    # Minimum adopted-prefix length that justifies a cross-slot KV copy
+    # (the copy moves whole cache lines; tiny prefixes aren't worth it).
+    PREFIX_COPY_MIN = 16
+
     def _admit(self) -> bool:
-        """Move waiting requests into free slots (prefill starts on
-        subsequent ticks)."""
+        """Move waiting requests into unoccupied slots (prefill starts on
+        subsequent ticks), adopting cached prompt prefixes when a donor
+        slot shares one (vLLM-APC semantics: the final prompt token is
+        always recomputed so its logits seed decoding)."""
         admitted = False
-        for slot, occupant in self._slots.items():
-            if occupant is not None:
-                continue
+        while any(o is None for o in self._slots.values()):
             try:
                 req = self._waiting.get_nowait()
             except queue.Empty:
                 break
             if req.preloaded is not None:
+                slot = self._take_slot()
                 try:
                     self._admit_prefilled(req, slot)
                 except Exception as e:  # noqa: BLE001 - bad KV payload
@@ -494,14 +542,78 @@ class LLMEngine:
                     self._fail(req, f"KV import failed: {e!r}")
                 admitted = True
                 continue
+            donor, adopt, retired = self._best_prefix(req.prompt_ids)
+            req.prefilled_len = 0
+            if retired and donor is not None:
+                # Zero-copy: admit straight into the retired slot whose KV
+                # already holds the prefix.
+                slot = donor
+                self._prefix_cached.pop(slot, None)
+                req.prefilled_len = adopt
+                self.prefix_hits += 1
+                self.prefix_tokens_saved += adopt
+            else:
+                slot = self._take_slot()
+                if donor is not None and adopt >= self.PREFIX_COPY_MIN:
+                    try:
+                        self.cache = copy_prefix_kv(
+                            self.model_cfg, self.cache, jnp.int32(donor),
+                            jnp.int32(slot))
+                        req.prefilled_len = adopt
+                        self.prefix_hits += 1
+                        self.prefix_tokens_saved += adopt
+                    except Exception as e:  # noqa: BLE001
+                        # copy_prefix_kv DONATES the cache: a failed
+                        # dispatch consumed its buffers, so this is a
+                        # device-failure event, not a per-request fallback
+                        # — rebuild, then admit this request cold.
+                        logger.exception("prefix copy failed")
+                        self._recover_device_failure(
+                            f"prefix copy failed: {e!r}")
+                        req.prefilled_len = 0
             # next_pos < 0 marks "still prefilling" (prefilled_len tracks
             # progress); _finish frees by identity.
             req.next_pos = -1
-            req.prefilled_len = 0
             req.last_slot = slot
             self._slots[slot] = req
             admitted = True
         return admitted
+
+    def _take_slot(self) -> int:
+        """An unoccupied slot: prefer one with no cached prefix; otherwise
+        evict the least-recently-used prefix entry."""
+        fresh = [s for s, o in self._slots.items()
+                 if o is None and s not in self._prefix_cached]
+        if fresh:
+            return fresh[0]
+        slot = min((s for s, o in self._slots.items() if o is None),
+                   key=lambda s: self._prefix_cached.get(s, ((), 0.0))[1])
+        self._prefix_cached.pop(slot, None)
+        return slot
+
+    def _best_prefix(self, prompt_ids: list[int]):
+        """(donor_slot, usable_prefix_len, donor_is_retired) — longest
+        common prefix across donors, capped at len(prompt)-1. Retired
+        donors win ties (adoption is zero-copy)."""
+        cap = len(prompt_ids) - 1
+        best_slot, best_p, best_retired = None, 0, False
+        if cap <= 0:
+            return best_slot, best_p, best_retired
+        # Snapshot both registries: release_slot (user threads) pops
+        # _prefix_live concurrently; iterating the live dict would raise
+        # "dictionary changed size during iteration" mid-admit.
+        for slot, toks in list(self._prefix_live.items()):
+            p = _lcp(prompt_ids, toks, cap)
+            if p > best_p:
+                best_slot, best_p, best_retired = slot, p, False
+        for slot, (toks, _) in list(self._prefix_cached.items()):
+            p = _lcp(prompt_ids, toks, cap)
+            if p > best_p or (p == best_p and p > 0 and not best_retired):
+                best_slot, best_p, best_retired = slot, p, True
+        if best_slot is not None and best_retired:
+            self._prefix_cached[best_slot] = (
+                self._prefix_cached[best_slot][0], time.monotonic())
+        return best_slot, best_p, best_retired
 
     def _admit_prefilled(self, req: GenerationRequest, slot: int) -> None:
         """KV import: write the shipped prefill into this slot and enter
@@ -532,6 +644,7 @@ class LLMEngine:
         req.next_pos = p
         req.last_slot = slot
         self._slots[slot] = req
+        self._prefix_live[slot] = tuple(req.prompt_ids)  # imported KV = donor
         self._emit(req, first_token)
 
     def _prefill_step(self) -> bool:
@@ -567,6 +680,9 @@ class LLMEngine:
                     jnp.int32(p), jnp.int32(slot))
                 req.prefilled_len += take
                 if req.prefilled_len >= p:  # final chunk: sample 1st token
+                    # The slot now holds the full prompt's KV: it becomes a
+                    # prefix donor for later shared-prefix requests.
+                    self._prefix_live[slot] = tuple(req.prompt_ids)
                     tok = self._sample_one(logits[None], [req])[0]
                     req.next_pos = p
                     self._emit(req, int(tok))
@@ -594,6 +710,8 @@ class LLMEngine:
             else:
                 self._fail(req, err)
         self._slots = {i: None for i in range(self.max_slots)}
+        self._prefix_live.clear()
+        self._prefix_cached.clear()
         self.cache = init_kv_cache(self.model_cfg, self.max_slots,
                                    self.max_seq)
 
@@ -672,8 +790,14 @@ class LLMEngine:
         for slot, r in self._slots.items():
             if r is req:
                 req.last_slot = slot
+                toks = self._prefix_live.pop(slot, None)
                 if not req.hold_slot:
                     self._slots[slot] = None
+                    if toks is not None and reason != "error":
+                        # Retire, don't discard: the slot's KV stays intact
+                        # until the slot is reclaimed, so an identical or
+                        # shared-prefix prompt admits with zero prefill.
+                        self._prefix_cached[slot] = (toks, time.monotonic())
         if req.stream_queue is not None:
             req.stream_queue.put(None)
         self._requests.pop(req.request_id, None)

@@ -72,8 +72,10 @@ class ProxyActor:
                     body=body,
                 )
                 try:
+                    hint = (self.headers.get("x-route-hint")
+                            or _prefix_route_hint(body))
                     gen = proxy._get_handle(dep).options(
-                        stream=True).remote(req)
+                        stream=True, route_hint=hint).remote(req)
                     gen.timeout = 60.0  # bound a wedged replica per chunk
                     if gen.streaming:
                         # SSE/chunk streaming: write each produced chunk as
@@ -154,6 +156,38 @@ class ProxyActor:
 
     def shutdown(self) -> None:
         self._server.shutdown()
+
+
+def _prefix_route_hint(body: bytes) -> str | None:
+    """Prefix-affinity hint for LLM-shaped requests (reference:
+    routing_policies/prefix_aware): requests sharing a prompt prefix hash
+    to the same hint, so the router sends them to the replica whose engine
+    already holds that prefix's KV (engine-side reuse: LLMEngine prefix
+    cache). Non-JSON / non-LLM bodies get no hint (pow-2 routing)."""
+    if not body or len(body) > 1 << 20:
+        return None
+    try:
+        payload = json.loads(body)
+    except Exception:
+        return None
+    if not isinstance(payload, dict):
+        return None
+    text = None
+    if isinstance(payload.get("prompt"), str):
+        text = payload["prompt"]
+    elif isinstance(payload.get("messages"), list) and payload["messages"]:
+        first = payload["messages"][0]
+        if isinstance(first, dict) and isinstance(first.get("content"), str):
+            text = first["content"]
+    if not text:
+        return None
+    import hashlib
+
+    # Hash a FIXED-size head block so the divergent tail never enters the
+    # hint: prompts sharing >= 128 chars (the system-prompt shape) map to
+    # one replica. Prefixes shorter than the block scatter — acceptable,
+    # their prefill is cheap anyway.
+    return hashlib.sha1(text[:128].encode("utf-8", "ignore")).hexdigest()[:16]
 
 
 def _encode(result) -> tuple[int, str, bytes]:

@@ -405,3 +405,74 @@ def test_pd_serving_app():
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+
+
+def test_prefix_cache_exact_rehit_zero_copy():
+    """Re-submitting the same prompt adopts the retired slot's KV: only the
+    final prompt token is recomputed, and greedy output is identical
+    (reference: vLLM automatic prefix caching semantics)."""
+    cfg = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64)
+    eng = LLMEngine(cfg)
+    try:
+        prompt = list(range(2, 34))  # 32 tokens
+        r1 = eng.generate(prompt, SamplingParams(max_tokens=6))
+        assert eng.prefix_hits == 0
+        r2 = eng.generate(prompt, SamplingParams(max_tokens=6))
+        assert eng.prefix_hits == 1
+        assert eng.prefix_tokens_saved == len(prompt) - 1
+        assert r1.token_ids == r2.token_ids
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_cache_shared_prefix_correctness():
+    """A request sharing only a PREFIX with a cached prompt must produce
+    exactly what a cold engine produces for the same prompt — the adopted
+    KV plus the recomputed tail must be equivalent to a full prefill."""
+    prefix = list(range(2, 34))            # 32 shared tokens
+    prompt_b = prefix + [40, 41, 42, 43]   # diverges after the prefix
+
+    cold = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64))
+    try:
+        expect = cold.generate(prompt_b, SamplingParams(max_tokens=6))
+    finally:
+        cold.shutdown()
+
+    eng = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64))
+    try:
+        eng.generate(prefix, SamplingParams(max_tokens=4))  # seeds the cache
+        got = eng.generate(prompt_b, SamplingParams(max_tokens=6))
+        assert eng.prefix_hits == 1
+        assert eng.prefix_tokens_saved == len(prefix)  # capped at donor len
+        assert got.token_ids == expect.token_ids
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_cache_live_donor_copy():
+    """Adoption from a donor whose request is STILL RUNNING copies the KV
+    line to the new slot; outputs match the cold engine."""
+    import time as _t
+
+    prefix = list(range(2, 34))
+    prompt_b = prefix + [45, 46]
+
+    cold = LLMEngine(LLMConfig(model="tiny", max_num_seqs=3, max_seq_len=96))
+    try:
+        expect = cold.generate(prompt_b, SamplingParams(max_tokens=5))
+    finally:
+        cold.shutdown()
+
+    eng = LLMEngine(LLMConfig(model="tiny", max_num_seqs=3, max_seq_len=96))
+    try:
+        long_req = eng.submit(prefix, SamplingParams(max_tokens=48))
+        deadline = _t.time() + 60
+        while not eng._prefix_live and _t.time() < deadline:
+            _t.sleep(0.01)  # wait for the donor's prefill to complete
+        assert eng._prefix_live, "donor prefill never completed"
+        got = eng.generate(prompt_b, SamplingParams(max_tokens=5))
+        assert eng.prefix_hits >= 1
+        assert got.token_ids == expect.token_ids
+        long_req.done.wait(60)
+    finally:
+        eng.shutdown()
