@@ -34,6 +34,15 @@ class NodeInfo:
     alive: bool = True
     pending_demands: list = field(default_factory=list)  # autoscaler feed
     transfer_addr: tuple | None = None  # native object-transfer server
+    # Optimistic per-resource holds for placements issued within the
+    # current heartbeat window (back-to-back placements must not all see
+    # the node as free). Kept OUT of ``available`` so the resource views
+    # the autoscaler/elastic policies read stay truthful; the next
+    # heartbeat replaces them with the daemon's own accounting.
+    optimistic: dict = field(default_factory=dict)
+
+    def effective(self, key: str) -> float:
+        return self.available.get(key, 0.0) - self.optimistic.get(key, 0.0)
 
 
 @dataclass
@@ -390,6 +399,7 @@ class HeadServer:
             return {"ok": False, "reregister": True}
         info.last_heartbeat = time.monotonic()
         info.available = available
+        info.optimistic.clear()
         if resources is not None:
             info.resources = resources  # totals change as PG bundles commit
         info.pending_demands = pending_demands or []
@@ -501,14 +511,14 @@ class HeadServer:
             if not all(n.resources.get(k, 0.0) >= v
                        for k, v in resources.items()):
                 continue
-            free = sum(n.available.get(k, 0.0) for k in ("CPU",))
+            free = sum(n.effective(k) for k in ("CPU",))
             feasible.append((-free, n.node_id, n))
             # Prefer nodes that can host the actor NOW — picking by totals
             # alone stacks same-resource actors onto one node while its
             # twin sits idle (the daemon would park the extra actor in its
-            # wait-for-resources loop).
-            if all(n.available.get(k, 0.0) >= v
-                   for k, v in resources.items()):
+            # wait-for-resources loop). "Now" includes the optimistic holds
+            # of placements already issued this heartbeat window.
+            if all(n.effective(k) >= v for k, v in resources.items()):
                 ready.append((-free, n.node_id, n))
         pool = ready or feasible
         if not pool:
@@ -536,12 +546,12 @@ class HeadServer:
         conn = self._node_conns.get(node.node_id)
         if conn is None:
             return False
-        # Optimistic availability decrement: the daemon's own accounting
-        # arrives with the next heartbeat, but back-to-back placements must
-        # not all see the same node as free (placement would stack
-        # same-resource actors on one node).
+        # Optimistic per-resource hold: back-to-back placements must not
+        # all see the same node as free. Never mutates ``available``
+        # (truthful resource views matter to the elastic/autoscaler
+        # policies); the next heartbeat replaces it with daemon truth.
         for k, v in placement.items():
-            node.available[k] = node.available.get(k, 0.0) - v
+            node.optimistic[k] = node.optimistic.get(k, 0.0) + v
         # Ask the node daemon to place the actor in a fresh/pooled worker
         # (reference: GcsActorScheduler leases a worker from the raylet).
         await conn.notify(

@@ -587,7 +587,9 @@ class NodeDaemon:
             w = self._idle_worker(pristine_only=True)
             if w is None:
                 self._fork_worker()
-                for _ in range(600):
+                deadline = time.monotonic() + \
+                    get_config().worker_start_timeout_s
+                while time.monotonic() < deadline:
                     await asyncio.sleep(0.05)
                     w = self._idle_worker(pristine_only=True)
                     if w is not None:

@@ -173,8 +173,8 @@ class ClusterRuntime:
         # source's egress stays bounded under a simultaneous fan-out.
         self._replicas: dict[ObjectID, set[str]] = {}
         self._reported_holder: dict[ObjectID, str] = {}  # oid -> owner hex
+        self._borrow_cache: dict[ObjectID, float] = {}  # released-borrow ts
         self._referrals: dict[ObjectID, list[float]] = {}  # issue stamps
-        self._refer_rr: dict[ObjectID, int] = {}
         self.refer_counts: dict[ObjectID, dict[str, int]] = {}  # observability
         self._io = EventLoopThread.get()
         self.head = RpcClient(head_host, head_port)
@@ -282,10 +282,11 @@ class ClusterRuntime:
             return None
         stamps.append(now)
         self._referrals[object_id] = stamps
-        i = self._refer_rr.get(object_id, 0)
-        self._refer_rr[object_id] = i + 1
-        pick = copies[i % len(copies)]
+        # Least-referred copy wins: spreads load deterministically as new
+        # copies join (an index-based round-robin can keep landing on the
+        # primary while the copy list grows under it).
         counts = self.refer_counts.setdefault(object_id, {})
+        pick = min(copies, key=lambda c: counts.get(c, 0))
         counts[pick] = counts.get(pick, 0) + 1
         return pick
 
@@ -385,11 +386,38 @@ class ClusterRuntime:
             return {"missing": True}
         return {"data": data, "total": total}
 
+    def _retract_holder(self, oid: ObjectID) -> None:
+        """If we advertised ourselves as a relay holder, retract — the
+        owner must not refer pullers to a copy we dropped. Best-effort,
+        off-thread (GC paths call this)."""
+        owner_hex = self._reported_holder.pop(oid, None)
+        if owner_hex is None or self._shutdown:
+            return
+
+        async def _retract():
+            try:
+                addr = await self._aresolve_worker_addr(owner_hex)
+                if addr is not None:
+                    peer = await self._apeer(addr)
+                    await peer.call("report_holder", oid=oid.hex(),
+                                    worker_id=self.worker_id.hex(),
+                                    remove=True, timeout=5)
+            except Exception:
+                pass
+
+        try:
+            self._io.loop.call_soon_threadsafe(lambda: spawn_task(_retract()))
+        except RuntimeError:
+            pass  # loop shut down
+
     async def _handle_free_object(self, conn, oid: str):
         # Owner-directed free: drop every local copy, including the node
         # arena's (the owner has decided the object is dead).
         object_id = ObjectID.from_hex(oid)
         self.store.delete(object_id)
+        self._reported_holder.pop(object_id, None)  # owner is deleting: no
+        # retract round-trip needed
+        self._borrow_cache.pop(object_id, None)
         if self.shm is not None:
             try:
                 self.shm.delete(object_id.binary())
@@ -497,34 +525,29 @@ class ClusterRuntime:
         return cached[1].get(node_id)
 
     # ------------------------------------------------------------------ put/get
+    # Released borrowed copies stay servable this long (relay cache).
+    BORROW_CACHE_TTL_S = 30.0
+    BORROW_CACHE_MAX = 256
+
     def _release_object(self, oid: ObjectID, rec=None) -> None:
-        self.store.delete(oid)
+        # Borrowed copies OUTLIVE the borrow (plasma semantics: a pulled
+        # object stays in the store until evicted or owner-freed, not
+        # deleted the moment the borrower's local refcount drops) — that is
+        # what makes a puller a useful relay holder beyond the lifetime of
+        # its own task. Bounded: a TTL + count cap sweep deletes old
+        # entries and retracts their relay adverts (no owner broadcast
+        # exists to do it for us).
+        owns = rec is None or rec.owner_id == self.worker_id
+        if owns:
+            self.store.delete(oid)
+        else:
+            self._borrow_cache[oid] = time.monotonic()
         self._recovery_attempts.pop(oid, None)
         self._replicas.pop(oid, None)
         self._location_sizes.pop(oid, None)
         self._referrals.pop(oid, None)
-        self._refer_rr.pop(oid, None)
         self.refer_counts.pop(oid, None)
-        # If we advertised ourselves as a relay holder for this object,
-        # retract it — the owner would keep referring pullers to a copy we
-        # just dropped. Best-effort, off-thread (GC paths call this).
-        owner_hex = self._reported_holder.pop(oid, None)
-        if owner_hex is not None and not self._shutdown:
-            async def _retract():
-                try:
-                    addr = await self._aresolve_worker_addr(owner_hex)
-                    if addr is not None:
-                        peer = await self._apeer(addr)
-                        await peer.call("report_holder", oid=oid.hex(),
-                                        worker_id=self.worker_id.hex(),
-                                        remove=True, timeout=5)
-                except Exception:
-                    pass
-            try:
-                self._io.loop.call_soon_threadsafe(
-                    lambda: spawn_task(_retract()))
-            except RuntimeError:
-                pass  # loop shut down
+        self._sweep_borrow_cache()
         # Lineage GC: drop the retained spec once its last return is
         # released (reference: lineage released with the object refs).
         if rec is not None and rec.lineage_task is not None:
@@ -538,12 +561,26 @@ class ClusterRuntime:
         # delete from it — a borrower releasing its cache must not GC data
         # other processes still reference (reference: owner-driven GC,
         # reference_counter.h).
-        owns = rec is not None and rec.owner_id == self.worker_id
-        if owns and self.shm is not None:
+        if rec is not None and rec.owner_id == self.worker_id \
+                and self.shm is not None:
             try:
                 self.shm.delete(oid.binary())
             except Exception:
                 pass
+
+    def _sweep_borrow_cache(self) -> None:
+        now = time.monotonic()
+        expired = [o for o, t in self._borrow_cache.items()
+                   if now - t > self.BORROW_CACHE_TTL_S]
+        over = len(self._borrow_cache) - len(expired) - self.BORROW_CACHE_MAX
+        if over > 0:
+            by_age = sorted((t, o) for o, t in self._borrow_cache.items()
+                            if o not in set(expired))
+            expired.extend(o for _, o in by_age[:over])
+        for o in expired:
+            self._borrow_cache.pop(o, None)
+            self.store.delete(o)
+            self._retract_holder(o)
 
     def _store_blob(self, oid: ObjectID, blob, owner) -> None:
         """Large blobs land in the node shm arena (visible to every local
@@ -754,6 +791,10 @@ class ClusterRuntime:
             return None
         total = first["total"]
         if total <= self.PULL_CHUNK:
+            # Cache single-chunk pulls like the multi-chunk path does —
+            # an uncached borrow re-transfers on every get AND can never
+            # join the relay set (report_holder requires a local copy).
+            self.store.put(ref.id, first["data"], ref.owner_id)
             return first["data"]
         return self._pull_chunked(peer, ref, first["data"], total)
 

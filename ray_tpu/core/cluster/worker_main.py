@@ -522,6 +522,19 @@ def main():
     import signal
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # Honor a platform pin for jax-using task/actor code. The env var
+    # JAX_PLATFORMS alone is NOT enough in environments whose
+    # sitecustomize pre-imports jax with a device-tunnel platform
+    # registered (its init can hang without a live device); the config
+    # update must land before any backend initialization.
+    plat = os.environ.get("RTPU_JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
     _parent_watchdog()
     wp = WorkerProcess()
     wp.serve_forever()

@@ -170,6 +170,10 @@ class SharedMemoryStore:
                 raise KeyError(object_id)
             rc = self._libh.store_get(self._h, idb, ctypes.byref(off),
                                       ctypes.byref(size))
+        if rc == ERR_NOT_SEALED:
+            # Mid-write by another process: indistinguishable from "not
+            # here yet" for a reader — callers poll/retry on KeyError.
+            raise KeyError(object_id)
         if rc != OK:
             raise ShmStoreError(f"get failed rc={rc}")
         return memoryview(self._mm)[off.value:off.value + size.value]

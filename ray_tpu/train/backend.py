@@ -48,6 +48,15 @@ def _init_jax_distributed(coordinator_addr: str, num_processes: int,
     )
 
 
+def _set_slice_env(env: dict) -> dict:
+    """Runs ON each worker: install the multi-slice coordinator env and
+    report it back for verification."""
+    import os
+
+    os.environ.update(env)
+    return {k: os.environ.get(k) for k in env}
+
+
 @dataclass
 class JaxBackendConfig(BackendConfig):
     """Bring up a jax.distributed world across the worker group.
@@ -55,10 +64,17 @@ class JaxBackendConfig(BackendConfig):
     ``distributed=False`` (default for single-host tests) skips
     jax.distributed and leaves each worker with its local devices — gradient
     sync then goes through ray_tpu.collective's host backend instead.
+
+    ``num_slices > 1`` marks a multi-slice (DCN) topology: each worker gets
+    the MEGASCALE_* coordinator env for its slice BEFORE jax.distributed
+    init (reference: v2/jax/config.py:147 injecting
+    ray.util.tpu.get_tpu_coordinator_env_vars — slice_id = rank //
+    workers_per_slice; libtpu reads these at first device init).
     """
 
     backend_name: str = "jax"
     distributed: bool = False
+    num_slices: int = 1
 
     def make_backend(self) -> "JaxBackend":
         return JaxBackend(self)
@@ -67,16 +83,33 @@ class JaxBackendConfig(BackendConfig):
 class JaxBackend(Backend):
     def __init__(self, cfg: JaxBackendConfig):
         self.cfg = cfg
+        self.slice_env_applied: list[dict] = []  # per-rank, for asserts
 
     def on_start(self, worker_group, coordinator_addr: str | None) -> None:
-        if not self.cfg.distributed:
-            return
         import ray_tpu
 
         n = len(worker_group.workers)
+        if self.cfg.num_slices > 1:
+            from ray_tpu.util.tpu import get_tpu_coordinator_env_vars
+
+            if n % self.cfg.num_slices != 0:
+                raise ValueError(
+                    f"{n} workers not divisible into "
+                    f"{self.cfg.num_slices} slices")
+            per_slice = n // self.cfg.num_slices
+            self.slice_env_applied = ray_tpu.get([
+                w.exec_fn.remote(
+                    _set_slice_env,
+                    get_tpu_coordinator_env_vars(
+                        coordinator_addr or "127.0.0.1:0",
+                        self.cfg.num_slices, rank // per_slice))
+                for rank, w in enumerate(worker_group.workers)
+            ], timeout=300)
+        if not self.cfg.distributed:
+            return
         # Every worker initializes against worker 0's coordinator address
         # (reference: v2/jax/config.py:84).
         ray_tpu.get([
-            w._exec.remote(_init_jax_distributed, coordinator_addr, n, rank)
+            w.exec_fn.remote(_init_jax_distributed, coordinator_addr, n, rank)
             for rank, w in enumerate(worker_group.workers)
         ], timeout=300)

@@ -87,7 +87,7 @@ class TrainWorker:
     def ping(self) -> str:
         return "pong"
 
-    def _exec(self, fn, *args, **kwargs):
+    def exec_fn(self, fn, *args, **kwargs):
         """Run an arbitrary function in this worker (backend setup hooks)."""
         return fn(*args, **kwargs)
 

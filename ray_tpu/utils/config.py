@@ -34,6 +34,12 @@ class Config:
     # --- scheduling (reference: raylet scheduling policy knobs) ---
     scheduler_spread_threshold: float = 0.5  # hybrid policy: local-first until this load
     worker_lease_timeout_s: float = 30.0
+    # Actor placement: how long a fresh worker fork may take to register
+    # before the placement fails. Worker boot imports the framework (and
+    # often jax) — seconds of CPU each; concurrent forks on small hosts
+    # serialize, so this must be generous (reference: worker startup is
+    # bounded by worker_register_timeout_seconds).
+    worker_start_timeout_s: float = 120.0
     max_workers_per_node: int = 64
     worker_idle_ttl_s: float = 60.0  # idle pooled workers are reaped after this
     worker_startup_concurrency: int = 8
