@@ -116,7 +116,7 @@ def main() -> None:
         print(json.dumps({"error": "no successful requests"}))
         sys.exit(1)
     ttfts_ms = np.array(ttfts) * 1e3
-    warm_ms = np.array(warm or [float("nan")]) * 1e3
+    warm_ms = np.array(warm) * 1e3 if warm else None
     out = {
         "model": label,
         "hardware": "tpu" if on_tpu else "cpu",
@@ -130,8 +130,10 @@ def main() -> None:
         "prefix_cache": {
             "cold_ttft_ms": round(cold_ttft * 1e3, 1)
             if cold_ttft is not None else None,
-            "hit_ttft_ms_p50": round(float(np.percentile(warm_ms, 50)), 1),
-            "hit_ttft_ms_min": round(float(warm_ms.min()), 1),
+            "hit_ttft_ms_p50": round(float(np.percentile(warm_ms, 50)), 1)
+            if warm_ms is not None else None,
+            "hit_ttft_ms_min": round(float(warm_ms.min()), 1)
+            if warm_ms is not None else None,
         },
     }
     with open("PERF_SERVE.json", "w") as f:

@@ -574,8 +574,9 @@ class ClusterRuntime:
                    if now - t > self.BORROW_CACHE_TTL_S]
         over = len(self._borrow_cache) - len(expired) - self.BORROW_CACHE_MAX
         if over > 0:
+            exp = set(expired)
             by_age = sorted((t, o) for o, t in self._borrow_cache.items()
-                            if o not in set(expired))
+                            if o not in exp)
             expired.extend(o for _, o in by_age[:over])
         for o in expired:
             self._borrow_cache.pop(o, None)

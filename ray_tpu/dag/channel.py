@@ -197,3 +197,58 @@ class StoreChannel:
         for ns_prefix in (f"chan/{self.name}/", f"chancur/{self.name}/"):
             for key in self._runtime.kv_keys(prefix=ns_prefix, ns="channels"):
                 self._runtime.kv_del(key, ns="channels")
+
+
+class DeviceChannel:
+    """Device-array channel: jax.Array values cross the wire as raw
+    host bytes + aval and land back ON DEVICE at the reader via
+    jax.device_put (reference: the accelerator channels of
+    experimental/channel/ — torch_tensor_accelerator_channel.py moves
+    tensors through the device transport registered in
+    accelerator_context.py:222; here the transport is jax host transfer,
+    with ICI send/recv available through the registered communicator for
+    in-mesh collectives).
+
+    Wraps any inner channel (Local or Store) for the control/bytes path.
+    Non-array values pass through unchanged, so mixed schedules work.
+    """
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.name = inner.name
+
+    def connect(self, runtime) -> "DeviceChannel":
+        self.inner.connect(runtime)
+        return self
+
+    def write(self, value: Any) -> None:
+        try:
+            import jax
+            import numpy as np
+
+            if isinstance(value, jax.Array):
+                host = np.asarray(value)
+                self.inner.write(("__jax_array__", host.tobytes(),
+                                  host.shape, str(host.dtype)))
+                return
+        except ImportError:
+            pass
+        self.inner.write(value)
+
+    def read(self, reader_index: int = 0, timeout: float | None = None) -> Any:
+        value = self.inner.read(reader_index, timeout=timeout)
+        if isinstance(value, tuple) and len(value) == 4 and \
+                value[0] == "__jax_array__":
+            import jax
+            import numpy as np
+
+            _, raw, shape, dtype = value
+            return jax.device_put(
+                np.frombuffer(raw, dtype=dtype).reshape(shape))
+        return value
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def destroy(self) -> None:
+        self.inner.destroy()

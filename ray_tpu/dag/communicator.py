@@ -54,7 +54,35 @@ class CollectiveCommunicator(Communicator):
                                group_name=group_name)
 
 
-_communicators: dict[str, Communicator] = {"collective": CollectiveCommunicator()}
+class JaxDeviceCommunicator(CollectiveCommunicator):
+    """Device transport for jax arrays (the TPU analogue of the
+    reference's NCCL communicator registration,
+    accelerator_context.py:222): p2p send/recv lower to the collective
+    layer's XLA backend (ICI send/recv inside shard_map on TPU,
+    xla_backend.py:209/:229; host fallback off-mesh) — inherited from
+    CollectiveCommunicator — with recv landing on device and channel
+    traffic wrapped in DeviceChannel (device_put at the reader)."""
+
+    name = "jax_device"
+
+    def recv(self, group_name: str, src_rank: int, *, tensor_shape=None,
+             dtype=None):
+        import jax
+
+        out = super().recv(group_name, src_rank, tensor_shape=tensor_shape,
+                           dtype=dtype)
+        return jax.device_put(out)
+
+    def wrap_channel(self, chan):
+        from ray_tpu.dag.channel import DeviceChannel
+
+        return DeviceChannel(chan)
+
+
+_communicators: dict[str, Communicator] = {
+    "collective": CollectiveCommunicator(),
+    "jax_device": JaxDeviceCommunicator(),
+}
 _default = "collective"
 
 

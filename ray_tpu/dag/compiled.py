@@ -29,10 +29,37 @@ from ray_tpu.dag.dag_node import (
 _DRIVER = "__driver__"
 
 
-def _actor_loop(instance, ops: list[dict], error_channel):
+def _overlap_plan(ops: list[dict]) -> list[list[tuple[int, int]]]:
+    """The overlapped-execution schedule pass (reference:
+    compiled_dag_node.py:2042 _generate_overlapped_execution_schedule —
+    reorders communication ops ahead of compute so transfers run while
+    earlier ops compute).
+
+    posts[j] = channel reads (op_index, arg_position) that become SAFE to
+    issue once op ``j-1`` has written (j=0: at schedule start). A read is
+    held back only by an intra-schedule producer (an earlier op of THIS
+    actor writing the same channel); everything else posts at start, so
+    its byte transfer overlaps the compute of every earlier op."""
+    posts: list[list[tuple[int, int]]] = [[] for _ in ops]
+    for i, op in enumerate(ops):
+        for pos, (kind, chan, _idx) in enumerate(op["reads"]):
+            if kind != "chan":
+                continue
+            j = 0
+            for k in range(i):
+                if ops[k]["write"] is chan:
+                    j = k + 1
+            posts[j].append((i, pos))
+    return posts
+
+
+def _actor_loop(instance, ops: list[dict], error_channel,
+                overlap: bool = False):
     """Installed into each participating actor: runs its static schedule
     until the upstream channels close (reference: the per-actor loop a
-    compiled DAG executes instead of per-call RPC)."""
+    compiled DAG executes instead of per-call RPC). With ``overlap``, the
+    _overlap_plan pass posts channel reads early on a transfer thread so
+    inbound byte movement runs concurrently with compute."""
     from ray_tpu.core.worker import global_worker
 
     rt = global_worker.runtime
@@ -43,6 +70,15 @@ def _actor_loop(instance, ops: list[dict], error_channel):
         if op["write"] is not None:
             op["write"].connect(rt)
     error_channel.connect(rt)
+
+    posts = _overlap_plan(ops) if overlap else None
+    executor = None
+    if overlap:
+        from concurrent.futures import ThreadPoolExecutor
+
+        executor = ThreadPoolExecutor(max_workers=2,
+                                      thread_name_prefix="dag-xfer")
+
     def cascade_close():
         # This loop is the writer of its output channels: closing them here
         # (with this process's write cursor) unwinds downstream loops in turn.
@@ -52,20 +88,36 @@ def _actor_loop(instance, ops: list[dict], error_channel):
                     op["write"].close()
                 except BaseException:
                     pass
+        if executor is not None:
+            executor.shutdown(wait=False)
+
+    futs: dict[tuple[int, int], Any] = {}
+
+    def post(j: int) -> None:
+        for (i, pos) in posts[j]:
+            kind, chan, reader_idx = ops[i]["reads"][pos]
+            futs[(i, pos)] = executor.submit(chan.read, reader_idx)
 
     while True:
         try:
-            for op in ops:
+            if overlap:
+                post(0)
+            for i, op in enumerate(ops):
                 args = []
-                for kind, chan_or_val, reader_idx in op["reads"]:
-                    if kind == "chan":
-                        args.append(chan_or_val.read(reader_idx))
-                    else:
+                for pos, (kind, chan_or_val, reader_idx) in \
+                        enumerate(op["reads"]):
+                    if kind != "chan":
                         args.append(chan_or_val)
+                    elif overlap:
+                        args.append(futs.pop((i, pos)).result())
+                    else:
+                        args.append(chan_or_val.read(reader_idx))
                 kwargs = {k: v for k, v in op["const_kwargs"].items()}
                 result = getattr(instance, op["method"])(*args, **kwargs)
                 if op["write"] is not None:
                     op["write"].write(result)
+                if overlap and i + 1 < len(ops):
+                    post(i + 1)
         except ChannelClosed:
             cascade_close()
             return "closed"
@@ -81,7 +133,14 @@ def _actor_loop(instance, ops: list[dict], error_channel):
 
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode):
+    def __init__(self, root: DAGNode, *, _overlap_execution: bool = False,
+                 _device_channels: bool = False):
+        """``_overlap_execution`` turns on the overlapped schedule pass
+        (reference: compiled_dag_node.py:2042) — channel reads post early
+        on a transfer thread so inbound bytes move while earlier ops
+        compute. ``_device_channels`` wraps every channel in DeviceChannel
+        so jax arrays land on the reader's device (reference: the
+        accelerator channel registered via accelerator_context.py:222)."""
         import ray_tpu
         from ray_tpu.core.worker import global_worker
 
@@ -91,15 +150,21 @@ class CompiledDAG:
         self._root = root
         self._rt = global_worker.runtime
         self._local = global_worker.mode == "local"
+        self._overlap = _overlap_execution
+        self._device_channels = _device_channels
         self._torn_down = False
         self._dag_id = uuid.uuid4().hex[:12]  # globally unique channel prefix
         self._compile()
 
     # ------------------------------------------------------------------ compile
     def _make_channel(self, name: str, num_readers: int):
-        if self._local:
-            return LocalChannel(name, num_readers)
-        return StoreChannel(name, num_readers)
+        chan = (LocalChannel(name, num_readers) if self._local
+                else StoreChannel(name, num_readers))
+        if self._device_channels:
+            from ray_tpu.dag.channel import DeviceChannel
+
+            chan = DeviceChannel(chan)
+        return chan
 
     def _compile(self):
         nodes = self._root.walk()
@@ -201,7 +266,8 @@ class CompiledDAG:
         for key, ops in schedules.items():
             handle = self._handles[key]
             self._loop_refs.append(
-                handle._call_fn(_actor_loop, ops, self._error_channels[key]))
+                handle._call_fn(_actor_loop, ops, self._error_channels[key],
+                                self._overlap))
         for chan in self._error_channels.values():
             chan.connect(self._rt)
 
