@@ -29,28 +29,28 @@ from ray_tpu.dag.dag_node import (
 _DRIVER = "__driver__"
 
 
-def _overlap_plan(ops: list[dict]) -> list[list[tuple[int, int]]]:
+def _overlap_plan(ops: list[dict]) -> list[tuple[int, int]]:
     """The overlapped-execution schedule pass (reference:
     compiled_dag_node.py:2042 _generate_overlapped_execution_schedule —
     reorders communication ops ahead of compute so transfers run while
     earlier ops compute).
 
-    posts[j] = channel reads (op_index, arg_position) that become SAFE to
-    issue once op ``j-1`` has written (j=0: at schedule start). A read is
-    held back only by an intra-schedule producer (an earlier op of THIS
-    actor writing the same channel); everything else posts at start, so
-    its byte transfer overlaps the compute of every earlier op."""
-    posts: list[list[tuple[int, int]]] = [[] for _ in ops]
+    Returns the channel reads (op_index, arg_position) that are SAFE to
+    post at schedule start: those with NO intra-schedule producer (an
+    earlier op of THIS actor writing the same channel). Dependent reads
+    stay inline in the loop — posting them to a bounded transfer pool
+    could starve a read the loop's own progress needs (FIFO worker
+    assignment deadlock), while start-posted reads only wait on OTHER
+    actors, whose progress this actor's compute never gates through the
+    transfer pool."""
+    start_posts: list[tuple[int, int]] = []
     for i, op in enumerate(ops):
         for pos, (kind, chan, _idx) in enumerate(op["reads"]):
             if kind != "chan":
                 continue
-            j = 0
-            for k in range(i):
-                if ops[k]["write"] is chan:
-                    j = k + 1
-            posts[j].append((i, pos))
-    return posts
+            if not any(ops[k]["write"] is chan for k in range(i)):
+                start_posts.append((i, pos))
+    return start_posts
 
 
 def _actor_loop(instance, ops: list[dict], error_channel,
@@ -76,7 +76,10 @@ def _actor_loop(instance, ops: list[dict], error_channel,
     if overlap:
         from concurrent.futures import ThreadPoolExecutor
 
-        executor = ThreadPoolExecutor(max_workers=2,
+        # One worker per posted read: every posted read gets a thread, so
+        # no read the loop waits on can be starved behind another blocked
+        # read (posted reads block only on OTHER actors' progress).
+        executor = ThreadPoolExecutor(max_workers=max(1, len(posts)),
                                       thread_name_prefix="dag-xfer")
 
     def cascade_close():
@@ -93,22 +96,22 @@ def _actor_loop(instance, ops: list[dict], error_channel,
 
     futs: dict[tuple[int, int], Any] = {}
 
-    def post(j: int) -> None:
-        for (i, pos) in posts[j]:
+    def post_all() -> None:
+        for (i, pos) in posts:
             kind, chan, reader_idx = ops[i]["reads"][pos]
             futs[(i, pos)] = executor.submit(chan.read, reader_idx)
 
     while True:
         try:
             if overlap:
-                post(0)
+                post_all()
             for i, op in enumerate(ops):
                 args = []
                 for pos, (kind, chan_or_val, reader_idx) in \
                         enumerate(op["reads"]):
                     if kind != "chan":
                         args.append(chan_or_val)
-                    elif overlap:
+                    elif overlap and (i, pos) in futs:
                         args.append(futs.pop((i, pos)).result())
                     else:
                         args.append(chan_or_val.read(reader_idx))
@@ -116,8 +119,6 @@ def _actor_loop(instance, ops: list[dict], error_channel,
                 result = getattr(instance, op["method"])(*args, **kwargs)
                 if op["write"] is not None:
                     op["write"].write(result)
-                if overlap and i + 1 < len(ops):
-                    post(i + 1)
         except ChannelClosed:
             cascade_close()
             return "closed"
@@ -161,9 +162,12 @@ class CompiledDAG:
         chan = (LocalChannel(name, num_readers) if self._local
                 else StoreChannel(name, num_readers))
         if self._device_channels:
-            from ray_tpu.dag.channel import DeviceChannel
+            from ray_tpu.dag.communicator import (
+                get_accelerator_communicator,
+            )
 
-            chan = DeviceChannel(chan)
+            chan = get_accelerator_communicator("jax_device").wrap_channel(
+                chan)
         return chan
 
     def _compile(self):

@@ -13,6 +13,7 @@ from ray_tpu.rl.env import CartPoleEnv, VectorEnv, make_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.bc import BC, BCConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.impala import IMPALA, ImpalaConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
 
@@ -21,6 +22,7 @@ __all__ = [
     "EnvRunner", "EnvRunnerGroup",
     "PPO", "PPOConfig",
     "DQN", "DQNConfig",
+    "IMPALA", "ImpalaConfig",
     "BC", "BCConfig",
     "ReplayBuffer", "PrioritizedReplayBuffer",
 ]

@@ -1,0 +1,268 @@
+"""IMPALA: asynchronous actor-learner RL with V-trace off-policy correction.
+
+Capability parity with the reference's async algorithm family (reference:
+rllib/algorithms/impala/impala.py — env-runner actors push rollouts
+continuously, the learner consumes them WITHOUT a synchronization barrier,
+and V-trace corrects for the policy lag between the behaviour policy that
+sampled and the target policy being updated; stale rollouts beyond a bound
+are dropped). TPU-native shape: the learner update is one jitted function
+(V-trace backward scan + policy/value losses); rollout collection stays on
+CPU env-runner actors.
+
+Async protocol here: every runner actor keeps ONE sample() call in flight.
+``step()`` waits for the first completed rollouts (ray_tpu.wait), applies
+the V-trace update per rollout, then pushes fresh weights to exactly the
+runners that delivered and resubmits their next sample — so slow runners
+never stall fast ones and the learner never waits for a full barrier
+(reference: impala's aggregation of ready batches only).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.ppo import _act, init_policy, mlp_apply
+from ray_tpu.tune.trainable import Trainable
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, dones, last_value,
+           gamma: float, rho_clip: float = 1.0, c_clip: float = 1.0):
+    """V-trace targets (Espeholt et al. 2018, eq. 1) over [T, N] arrays.
+
+    Returns (vs, pg_advantages): vs are the corrected value targets; the
+    policy gradient uses rho_t * (r_t + gamma*vs_{t+1} - V(x_t)).
+    """
+    not_done = 1.0 - dones.astype(jnp.float32)
+    rho = jnp.minimum(rho_clip, jnp.exp(target_logp - behavior_logp))
+    c = jnp.minimum(c_clip, jnp.exp(target_logp - behavior_logp))
+    next_values = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    deltas = rho * (rewards + gamma * next_values * not_done - values)
+
+    T = rewards.shape[0]
+
+    def scan_fn(acc, t):
+        # acc = vs_{t+1} - V(x_{t+1}) correction term
+        acc = deltas[t] + gamma * not_done[t] * c[t] * acc
+        return acc, acc
+
+    _, corr = jax.lax.scan(scan_fn, jnp.zeros_like(last_value),
+                           jnp.arange(T - 1, -1, -1))
+    corr = corr[::-1]
+    vs = values + corr
+    next_vs = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * next_vs * not_done - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def impala_update(optimizer, cfg_static, params, opt_state, batch):
+    """One V-trace actor-critic update over a [T, N] rollout batch."""
+    gamma, rho_clip, c_clip, vf_coef, ent_coef = cfg_static
+
+    def loss_fn(p):
+        logits = mlp_apply(p["pi"], batch["obs"])          # [T, N, A]
+        values = mlp_apply(p["vf"], batch["obs"])[..., 0]  # [T, N]
+        last_value = mlp_apply(p["vf"], batch["last_obs"])[..., 0]  # [N]
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        vs, pg_adv = vtrace(batch["logp"], logp, batch["rewards"], values,
+                            batch["dones"], last_value, gamma, rho_clip,
+                            c_clip)
+        pg = -(pg_adv * logp).mean()
+        vf = 0.5 * ((values - vs) ** 2).mean()
+        ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        return pg + vf_coef * vf - ent_coef * ent, (pg, vf, ent)
+
+    (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    pg, vf, ent = aux
+    return params, opt_state, {"policy_loss": pg, "vf_loss": vf,
+                               "entropy": ent}
+
+
+@dataclass
+class ImpalaConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 0          # 0 = inline (sync fallback for tests)
+    num_envs_per_runner: int = 8
+    rollout_len: int = 64
+    lr: float = 5e-4
+    gamma: float = 0.99
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    hidden: int = 64
+    # Async knobs: how many completed rollouts step() consumes, and how
+    # many learner versions a rollout's behaviour policy may lag before it
+    # is dropped (reference: impala's max stale gradient/requeue bounds).
+    rollouts_per_step: int = 2
+    max_staleness: int = 4
+    seed: int = 0
+    extra: dict = field(default_factory=dict)
+
+    def build(self) -> "IMPALA":
+        return IMPALA({"impala_config": self})
+
+
+class IMPALA(Trainable):
+    """Async actor-learner (reference: impala.py). Under Tune like any
+    Trainable; ``num_env_runners=0`` degrades to a synchronous inline
+    loop (still V-trace-corrected — useful for small tests)."""
+
+    def setup(self, config: dict) -> None:
+        cfg = config.get("impala_config") or ImpalaConfig(
+            **{k: v for k, v in config.items()
+               if k in ImpalaConfig.__dataclass_fields__})
+        self.cfg = cfg
+        probe = make_env(cfg.env, seed=cfg.seed)
+        self.params = init_policy(jax.random.PRNGKey(cfg.seed),
+                                  probe.observation_size, probe.num_actions,
+                                  cfg.hidden)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.weight_version = 0
+        self._dropped_stale = 0
+        self._return_window: list[float] = []
+
+        def policy_factory(params=None):
+            def act(p, obs, seed):
+                a, lp, v = _act(p, jnp.asarray(obs), seed)
+                return np.asarray(a), np.asarray(lp), np.asarray(v)
+            return act, None
+
+        self._factory = policy_factory
+        if cfg.num_env_runners == 0:
+            self._local = EnvRunner(cfg.env, cfg.num_envs_per_runner,
+                                    cfg.rollout_len, policy_factory,
+                                    seed=cfg.seed)
+            self._actors = []
+        else:
+            import ray_tpu
+
+            RunnerActor = ray_tpu.remote(EnvRunner)
+            self._local = None
+            self._actors = [
+                RunnerActor.options(num_cpus=0).remote(
+                    cfg.env, cfg.num_envs_per_runner, cfg.rollout_len,
+                    policy_factory, seed=cfg.seed + i * 1000)
+                for i in range(cfg.num_env_runners)
+            ]
+            # Prime the async pipeline: push v0 weights, start one sample
+            # per runner; each in-flight ref is tagged with the version its
+            # behaviour policy came from.
+            host = jax.tree.map(np.asarray, self.params)
+            ray_tpu.get([a.set_weights.remote(host) for a in self._actors],
+                        timeout=300)
+            self._inflight = {
+                a.sample.remote(): (a, self.weight_version)
+                for a in self._actors
+            }
+
+    # -- learner ------------------------------------------------------------
+    def _update_from(self, sample: dict) -> dict:
+        batch = {
+            "obs": jnp.asarray(sample["obs"]),
+            "actions": jnp.asarray(sample["actions"]),
+            "logp": jnp.asarray(sample["logp"]),
+            "rewards": jnp.asarray(sample["rewards"]),
+            "dones": jnp.asarray(sample["dones"]),
+            "last_obs": jnp.asarray(sample["last_obs"]),
+        }
+        static = (self.cfg.gamma, self.cfg.rho_clip, self.cfg.c_clip,
+                  self.cfg.vf_coef, self.cfg.ent_coef)
+        self.params, self.opt_state, stats = impala_update(
+            self.optimizer, static, self.params, self.opt_state, batch)
+        self.weight_version += 1
+        self._return_window.extend(sample["episode_returns"])
+        return stats
+
+    def step(self) -> dict:
+        cfg = self.cfg
+        stats: dict = {}
+        steps_sampled = 0
+        if self._local is not None:
+            self._local.set_weights(self.params)
+            sample = self._local.sample()
+            stats = self._update_from(sample)
+            steps_sampled = sample["obs"].shape[0] * sample["obs"].shape[1]
+        else:
+            import ray_tpu
+
+            consumed = 0
+            while consumed < cfg.rollouts_per_step:
+                ready, _ = ray_tpu.wait(list(self._inflight),
+                                        num_returns=1, timeout=120)
+                if not ready:
+                    raise TimeoutError("no rollout arrived within 120s")
+                ref = ready[0]
+                actor, version = self._inflight.pop(ref)
+                try:
+                    sample = ray_tpu.get(ref, timeout=60)
+                except ray_tpu.ActorDiedError:
+                    # Replace the dead runner (and track the replacement,
+                    # or cleanup() would kill the dead handle and leak the
+                    # live one); its rollout is lost.
+                    RunnerActor = ray_tpu.remote(EnvRunner)
+                    dead = actor
+                    actor = RunnerActor.options(num_cpus=0).remote(
+                        cfg.env, cfg.num_envs_per_runner, cfg.rollout_len,
+                        self._factory, seed=cfg.seed + consumed * 7919)
+                    self._actors = [actor if a is dead else a
+                                    for a in self._actors]
+                    sample = None
+                if sample is not None and \
+                        self.weight_version - version <= cfg.max_staleness:
+                    stats = self._update_from(sample)
+                    steps_sampled += (sample["obs"].shape[0]
+                                      * sample["obs"].shape[1])
+                    consumed += 1
+                elif sample is not None:
+                    self._dropped_stale += 1
+                # Continuation: fresh weights to THIS runner only, then its
+                # next rollout starts — no barrier with the other runners.
+                host = jax.tree.map(np.asarray, self.params)
+                actor.set_weights.remote(host)
+                self._inflight[actor.sample.remote()] = (
+                    actor, self.weight_version)
+        self._return_window = self._return_window[-100:]
+        mean_ret = (float(np.mean(self._return_window))
+                    if self._return_window else 0.0)
+        return {
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled": steps_sampled,
+            "weight_version": self.weight_version,
+            "dropped_stale_rollouts": self._dropped_stale,
+            **{k: float(v) for k, v in stats.items()},
+        }
+
+    def save_checkpoint(self) -> Any:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "iteration": self.iteration,
+                "weight_version": self.weight_version}
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, checkpoint["params"])
+        self.iteration = checkpoint["iteration"]
+        self.weight_version = checkpoint.get("weight_version", 0)
+
+    def cleanup(self) -> None:
+        if self._actors:
+            import ray_tpu
+
+            for a in self._actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass

@@ -213,3 +213,71 @@ def test_bc_offline_training(rt_start):
     # checkpoint round-trips
     ckpt = algo.save_checkpoint()
     algo.load_checkpoint(ckpt)
+
+
+def test_vtrace_reduces_to_gae_like_targets_on_policy():
+    """On-policy (behavior == target), V-trace vs targets equal the
+    discounted n-step returns bootstrapped from V (rho = c = 1)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl.impala import vtrace
+
+    T, N = 5, 3
+    rng = np.random.default_rng(0)
+    rewards = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    dones = jnp.zeros((T, N), bool)
+    last_value = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    logp = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+
+    vs, pg_adv = vtrace(logp, logp, rewards, values, dones, last_value,
+                        gamma=0.9, rho_clip=1.0, c_clip=1.0)
+    # manual n-step backward recursion with rho=c=1
+    expect = np.zeros((T, N), np.float32)
+    nxt = np.asarray(last_value)
+    corr = np.zeros((N,), np.float32)
+    for t in reversed(range(T)):
+        delta = np.asarray(rewards)[t] + 0.9 * nxt - np.asarray(values)[t]
+        corr = delta + 0.9 * corr
+        expect[t] = np.asarray(values)[t] + corr
+        nxt = np.asarray(values)[t]
+    np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_impala_solves_cartpole_inline():
+    """V-trace learner reaches the reward threshold (reference: rllib
+    IMPALA CartPole runs)."""
+    from ray_tpu.rl.impala import ImpalaConfig
+
+    algo = ImpalaConfig(num_envs_per_runner=8, rollout_len=64, lr=5e-4,
+                        seed=0).build()
+    best = 0.0
+    for _ in range(120):
+        r = algo.train_step()
+        best = max(best, r["episode_return_mean"])
+        if best >= 150.0:
+            break
+    algo.cleanup()
+    assert best >= 150.0, f"IMPALA failed to learn CartPole: best {best}"
+
+
+def test_impala_async_runners(rt_start):
+    """Async actor-learner loop: runner actors keep rollouts in flight,
+    the learner consumes ready ones without a barrier, weight versions
+    advance, and stale rollouts beyond the bound are dropped (reference:
+    impala.py async EnvRunner sampling + max staleness)."""
+    from ray_tpu.rl.impala import ImpalaConfig
+
+    algo = ImpalaConfig(num_env_runners=2, num_envs_per_runner=4,
+                        rollout_len=16, rollouts_per_step=2,
+                        max_staleness=1, seed=1).build()
+    try:
+        r1 = algo.train_step()
+        r2 = algo.train_step()
+        assert r2["weight_version"] >= r1["weight_version"] >= 1
+        assert r1["num_env_steps_sampled"] > 0
+        assert "policy_loss" in r2
+        # async pipeline stays primed: one in-flight sample per runner
+        assert len(algo._inflight) == 2
+    finally:
+        algo.cleanup()
