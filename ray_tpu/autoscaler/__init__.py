@@ -5,6 +5,11 @@ from ray_tpu.autoscaler.node_provider import (
     NodeProvider,
     TpuSliceProvider,
 )
+from ray_tpu.autoscaler.gcp import (
+    GceNodeProvider,
+    GcpTpuQueuedResourceClient,
+    tpu_slice_provider_from_gcp,
+)
 from ray_tpu.autoscaler.scheduler import bin_pack_demands
 
 __all__ = [
@@ -16,6 +21,9 @@ __all__ = [
     "InstanceStatus",
     "NodeProvider",
     "FakeMultiNodeProvider",
+    "GceNodeProvider",
+    "GcpTpuQueuedResourceClient",
+    "tpu_slice_provider_from_gcp",
     "TpuSliceProvider",
     "bin_pack_demands",
 ]

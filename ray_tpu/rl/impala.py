@@ -143,6 +143,7 @@ class IMPALA(Trainable):
             return act, None
 
         self._factory = policy_factory
+        self._spawn_count = 0
         if cfg.num_env_runners == 0:
             self._local = EnvRunner(cfg.env, cfg.num_envs_per_runner,
                                     cfg.rollout_len, policy_factory,
@@ -151,14 +152,9 @@ class IMPALA(Trainable):
         else:
             import ray_tpu
 
-            RunnerActor = ray_tpu.remote(EnvRunner)
             self._local = None
-            self._actors = [
-                RunnerActor.options(num_cpus=0).remote(
-                    cfg.env, cfg.num_envs_per_runner, cfg.rollout_len,
-                    policy_factory, seed=cfg.seed + i * 1000)
-                for i in range(cfg.num_env_runners)
-            ]
+            self._actors = [self._spawn_runner()
+                            for _ in range(cfg.num_env_runners)]
             # Prime the async pipeline: push v0 weights, start one sample
             # per runner; each in-flight ref is tagged with the version its
             # behaviour policy came from.
@@ -169,6 +165,19 @@ class IMPALA(Trainable):
                 a.sample.remote(): (a, self.weight_version)
                 for a in self._actors
             }
+
+    def _spawn_runner(self):
+        """One runner actor with a never-repeating seed (a replacement that
+        reused a live runner's seed would replay identical env streams)."""
+        import ray_tpu
+
+        cfg = self.cfg
+        RunnerActor = ray_tpu.remote(EnvRunner)
+        seed = cfg.seed + self._spawn_count * 1000
+        self._spawn_count += 1
+        return RunnerActor.options(num_cpus=0).remote(
+            cfg.env, cfg.num_envs_per_runner, cfg.rollout_len,
+            self._factory, seed=seed)
 
     # -- learner ------------------------------------------------------------
     def _update_from(self, sample: dict) -> dict:
@@ -214,11 +223,8 @@ class IMPALA(Trainable):
                     # Replace the dead runner (and track the replacement,
                     # or cleanup() would kill the dead handle and leak the
                     # live one); its rollout is lost.
-                    RunnerActor = ray_tpu.remote(EnvRunner)
                     dead = actor
-                    actor = RunnerActor.options(num_cpus=0).remote(
-                        cfg.env, cfg.num_envs_per_runner, cfg.rollout_len,
-                        self._factory, seed=cfg.seed + consumed * 7919)
+                    actor = self._spawn_runner()
                     self._actors = [actor if a is dead else a
                                     for a in self._actors]
                     sample = None
