@@ -14,6 +14,13 @@ from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.bc import BC, BCConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.impala import IMPALA, ImpalaConfig
+from ray_tpu.rl.multi_agent import (
+    CoordinationGame,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
 
@@ -23,6 +30,8 @@ __all__ = [
     "PPO", "PPOConfig",
     "DQN", "DQNConfig",
     "IMPALA", "ImpalaConfig",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "CoordinationGame",
+    "MultiAgentPPO", "MultiAgentPPOConfig",
     "BC", "BCConfig",
     "ReplayBuffer", "PrioritizedReplayBuffer",
 ]

@@ -1,0 +1,268 @@
+"""Multi-agent RL: MultiAgentEnv protocol, env runner, and multi-policy PPO.
+
+Capability parity with the reference's multi-agent stack (reference:
+rllib/env/multi_agent_env.py MultiAgentEnv — dict-keyed obs/reward/done per
+agent with the "__all__" episode terminator; rllib/env/
+multi_agent_env_runner.py collects per-agent trajectories and a
+policy_mapping_fn routes each agent to the policy that acts for (and trains
+on) its experience; algorithms then update every policy on its own batch).
+TPU-native shape: per-policy updates are the existing jitted PPO update —
+multi-agency is pure batch routing around it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.ppo import _act, compute_gae, init_policy, ppo_update
+from ray_tpu.tune.trainable import Trainable
+
+
+class MultiAgentEnv:
+    """Dict-keyed multi-agent episode protocol (reference:
+    multi_agent_env.py): reset() -> {agent: obs}; step({agent: action}) ->
+    (obs, rewards, dones) dicts, with dones["__all__"] ending the episode."""
+
+    agent_ids: tuple[str, ...] = ()
+    observation_size: int = 0
+    num_actions: int = 0
+
+    def reset(self) -> dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: dict[str, int]):
+        raise NotImplementedError
+
+
+class CoordinationGame(MultiAgentEnv):
+    """Two agents earn +1 each step their actions MATCH; episodes last
+    ``horizon`` steps. Observations: one-hot of the previous joint action
+    plus the step fraction — enough signal for independent policies to
+    lock onto one equilibrium. Optimal per-agent episode return ==
+    horizon."""
+
+    agent_ids = ("a0", "a1")
+    observation_size = 5
+    num_actions = 2
+
+    def __init__(self, horizon: int = 16, seed: int = 0):
+        self.horizon = horizon
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._last = (0, 0)
+
+    def _obs(self) -> dict[str, np.ndarray]:
+        joint = np.zeros(4, np.float32)
+        joint[self._last[0] * 2 + self._last[1]] = 1.0
+        frac = np.array([self._t / self.horizon], np.float32)
+        o = np.concatenate([joint, frac])
+        return {a: o.copy() for a in self.agent_ids}
+
+    def reset(self) -> dict[str, np.ndarray]:
+        self._t = 0
+        self._last = (int(self._rng.integers(2)), int(self._rng.integers(2)))
+        return self._obs()
+
+    def step(self, actions: dict[str, int]):
+        self._t += 1
+        a0, a1 = int(actions["a0"]), int(actions["a1"])
+        self._last = (a0, a1)
+        r = 1.0 if a0 == a1 else 0.0
+        rewards = {a: r for a in self.agent_ids}
+        done = self._t >= self.horizon
+        dones = {a: done for a in self.agent_ids}
+        dones["__all__"] = done
+        return self._obs(), rewards, dones
+
+
+def make_multi_agent_env(name: str, seed: int = 0,
+                         **kwargs) -> MultiAgentEnv:
+    if name == "CoordinationGame":
+        return CoordinationGame(seed=seed, **kwargs)
+    raise ValueError(f"unknown multi-agent env {name!r}")
+
+
+class MultiAgentEnvRunner:
+    """Per-agent trajectory collection with policy routing (reference:
+    multi_agent_env_runner.py): each step, every live agent's observation
+    goes to the policy policy_mapping_fn assigns it; experience lands in
+    that POLICY's batch. sample() returns {policy_id: [T, K, ...]} where K
+    is the number of agent slots mapped to the policy."""
+
+    def __init__(self, env_name: str, rollout_len: int,
+                 policy_mapping_fn: Callable[[str], str],
+                 act_fns: dict[str, Callable], seed: int = 0,
+                 env_kwargs: dict | None = None):
+        self.env = make_multi_agent_env(env_name, seed=seed,
+                                        **(env_kwargs or {}))
+        self.rollout_len = rollout_len
+        self.policy_mapping_fn = policy_mapping_fn
+        self.act_fns = act_fns
+        self.params: dict[str, Any] = {}
+        self._seed = seed
+        self._step = 0
+        self._obs = self.env.reset()
+        self._episode_return = 0.0
+        self._episode_returns: list[float] = []
+        # Fixed slot order per policy: [T, K] batches need stable columns.
+        self._slots: dict[str, list[str]] = {}
+        for agent in self.env.agent_ids:
+            pid = self.policy_mapping_fn(agent)
+            self._slots.setdefault(pid, []).append(agent)
+
+    def set_weights(self, params: dict[str, Any]) -> None:
+        self.params = params
+
+    def sample(self) -> dict[str, dict]:
+        T = self.rollout_len
+        env = self.env
+        out: dict[str, dict] = {}
+        for pid, agents in self._slots.items():
+            K = len(agents)
+            out[pid] = {
+                "obs": np.zeros((T, K, env.observation_size), np.float32),
+                "actions": np.zeros((T, K), np.int32),
+                "logp": np.zeros((T, K), np.float32),
+                "values": np.zeros((T, K), np.float32),
+                "rewards": np.zeros((T, K), np.float32),
+                "dones": np.zeros((T, K), np.bool_),
+            }
+        for t in range(T):
+            self._step += 1
+            actions: dict[str, int] = {}
+            for pid, agents in self._slots.items():
+                obs = np.stack([self._obs[a] for a in agents])
+                a, lp, v = self.act_fns[pid](
+                    self.params[pid], obs,
+                    self._seed * 100_003 + self._step)
+                b = out[pid]
+                b["obs"][t] = obs
+                b["actions"][t], b["logp"][t], b["values"][t] = a, lp, v
+                for k, agent in enumerate(agents):
+                    actions[agent] = int(a[k])
+            self._obs, rewards, dones = env.step(actions)
+            self._episode_return += float(np.mean(list(rewards.values())))
+            for pid, agents in self._slots.items():
+                b = out[pid]
+                b["rewards"][t] = [rewards[a] for a in agents]
+                b["dones"][t] = [dones[a] for a in agents]
+            if dones.get("__all__"):
+                self._episode_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                self._obs = env.reset()
+        # Bootstrap values from the current obs under each policy.
+        for pid, agents in self._slots.items():
+            obs = np.stack([self._obs[a] for a in agents])
+            _, _, last_v = self.act_fns[pid](
+                self.params[pid], obs, self._seed * 100_003 + self._step + 1)
+            out[pid]["last_values"] = np.asarray(last_v, np.float32)
+        out["__episode_returns__"] = self._episode_returns
+        self._episode_returns = []
+        return out
+
+
+@dataclass
+class MultiAgentPPOConfig:
+    env: str = "CoordinationGame"
+    env_kwargs: dict = field(default_factory=dict)
+    # policy_ids + mapping: default = one shared policy for every agent
+    # (reference: the shared-policy default of multi-agent configs).
+    policies: tuple[str, ...] = ("shared",)
+    policy_mapping: dict = field(default_factory=dict)  # agent -> policy
+    num_env_runners: int = 0
+    rollout_len: int = 128
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip: float = 0.2
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    num_minibatches: int = 4
+    num_epochs: int = 4
+    hidden: int = 32
+    seed: int = 0
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO({"ma_config": self})
+
+
+class MultiAgentPPO(Trainable):
+    """Independent/shared-policy PPO over a MultiAgentEnv (reference:
+    rllib multi-agent training — each policy updates on the batch its
+    agents produced)."""
+
+    def setup(self, config: dict) -> None:
+        cfg = config.get("ma_config") or MultiAgentPPOConfig(
+            **{k: v for k, v in config.items()
+               if k in MultiAgentPPOConfig.__dataclass_fields__})
+        self.cfg = cfg
+        probe = make_multi_agent_env(cfg.env, seed=cfg.seed,
+                                     **cfg.env_kwargs)
+
+        def mapping(agent: str) -> str:
+            return cfg.policy_mapping.get(agent, cfg.policies[0])
+
+        self.mapping = mapping
+        self.policies: dict[str, Any] = {}
+        self.opt_states: dict[str, Any] = {}
+        self.optimizer = optax.adam(cfg.lr)
+        for i, pid in enumerate(cfg.policies):
+            self.policies[pid] = init_policy(
+                jax.random.PRNGKey(cfg.seed + i), probe.observation_size,
+                probe.num_actions, cfg.hidden)
+            self.opt_states[pid] = self.optimizer.init(self.policies[pid])
+
+        def act(p, obs, seed):
+            a, lp, v = _act(p, jnp.asarray(obs), seed)
+            return np.asarray(a), np.asarray(lp), np.asarray(v)
+
+        self._runner = MultiAgentEnvRunner(
+            cfg.env, cfg.rollout_len, mapping,
+            {pid: act for pid in cfg.policies}, seed=cfg.seed,
+            env_kwargs=cfg.env_kwargs)
+        self._return_window: list[float] = []
+
+    def step(self) -> dict:
+        cfg = self.cfg
+        self._runner.set_weights(self.policies)
+        sample = self._runner.sample()
+        self._return_window.extend(sample.pop("__episode_returns__"))
+        stats: dict = {}
+        static = (cfg.clip, cfg.vf_coef, cfg.ent_coef, cfg.num_minibatches,
+                  cfg.num_epochs)
+        for pid, s in sample.items():
+            adv, ret = compute_gae(
+                jnp.asarray(s["rewards"]), jnp.asarray(s["values"]),
+                jnp.asarray(s["dones"]), jnp.asarray(s["last_values"]),
+                cfg.gamma, cfg.gae_lambda)
+            batch = {
+                "obs": jnp.asarray(
+                    s["obs"].reshape(-1, s["obs"].shape[-1])),
+                "actions": jnp.asarray(s["actions"].reshape(-1)),
+                "logp": jnp.asarray(s["logp"].reshape(-1)),
+                "advantages": jnp.asarray(np.asarray(adv).reshape(-1)),
+                "returns": jnp.asarray(np.asarray(ret).reshape(-1)),
+            }
+            self.policies[pid], self.opt_states[pid], pstats = ppo_update(
+                self.optimizer, static, self.policies[pid],
+                self.opt_states[pid], batch, cfg.seed + self.iteration)
+            stats.update({f"{pid}/{k}": float(v) for k, v in pstats.items()})
+        self._return_window = self._return_window[-100:]
+        mean_ret = (float(np.mean(self._return_window))
+                    if self._return_window else 0.0)
+        return {"episode_return_mean": mean_ret,
+                "policies": list(self.policies), **stats}
+
+    def save_checkpoint(self) -> Any:
+        return {"policies": jax.tree.map(np.asarray, self.policies),
+                "iteration": self.iteration}
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.policies = jax.tree.map(jnp.asarray, checkpoint["policies"])
+        self.iteration = checkpoint["iteration"]

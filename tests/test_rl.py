@@ -281,3 +281,78 @@ def test_impala_async_runners(rt_start):
         assert len(algo._inflight) == 2
     finally:
         algo.cleanup()
+
+
+def test_multi_agent_env_protocol():
+    """Dict-keyed obs/rewards/dones with the __all__ terminator
+    (reference: multi_agent_env.py protocol)."""
+    from ray_tpu.rl.multi_agent import CoordinationGame
+
+    env = CoordinationGame(horizon=3, seed=0)
+    obs = env.reset()
+    assert set(obs) == {"a0", "a1"}
+    for t in range(3):
+        obs, rew, dones = env.step({"a0": 1, "a1": 1})
+        assert rew == {"a0": 1.0, "a1": 1.0}  # matched actions
+        assert dones["__all__"] == (t == 2)
+
+
+def test_multi_agent_runner_policy_routing():
+    """policy_mapping_fn routes each agent's experience into its policy's
+    batch (reference: multi_agent_env_runner.py + policy mapping)."""
+    import numpy as np
+
+    from ray_tpu.rl.multi_agent import MultiAgentEnvRunner
+
+    def act(params, obs, seed):
+        n = obs.shape[0]
+        return (np.full(n, params, np.int32), np.zeros(n, np.float32),
+                np.zeros(n, np.float32))
+
+    runner = MultiAgentEnvRunner(
+        "CoordinationGame", rollout_len=8,
+        policy_mapping_fn=lambda a: "p0" if a == "a0" else "p1",
+        act_fns={"p0": act, "p1": act}, seed=0)
+    runner.set_weights({"p0": 0, "p1": 1})  # p0 always acts 0, p1 acts 1
+    out = runner.sample()
+    out.pop("__episode_returns__")
+    assert set(out) == {"p0", "p1"}
+    assert out["p0"]["obs"].shape == (8, 1, 5)
+    assert (out["p0"]["actions"] == 0).all()
+    assert (out["p1"]["actions"] == 1).all()
+    # mismatched actions -> zero reward everywhere
+    assert (out["p0"]["rewards"] == 0).all()
+
+
+def test_multi_agent_shared_policy_learns_coordination():
+    """Shared-policy PPO reaches near-optimal coordination (reference:
+    rllib multi-agent training runs)."""
+    from ray_tpu.rl.multi_agent import MultiAgentPPOConfig
+
+    algo = MultiAgentPPOConfig(rollout_len=128, lr=1e-3, seed=0).build()
+    best = 0.0
+    for _ in range(80):
+        r = algo.train_step()
+        best = max(best, r["episode_return_mean"])
+        if best >= 14.0:
+            break
+    assert best >= 14.0, f"no coordination learned: best {best}"
+
+
+def test_multi_agent_independent_policies():
+    """Two independent policies (one per agent) train on disjoint batches
+    and still coordinate."""
+    from ray_tpu.rl.multi_agent import MultiAgentPPOConfig
+
+    algo = MultiAgentPPOConfig(
+        policies=("left", "right"),
+        policy_mapping={"a0": "left", "a1": "right"},
+        rollout_len=128, lr=1e-3, seed=2).build()
+    best = 0.0
+    for _ in range(80):
+        r = algo.train_step()
+        best = max(best, r["episode_return_mean"])
+        if best >= 14.0:
+            break
+    assert best >= 14.0, f"independent policies failed: best {best}"
+    assert set(r["policies"]) == {"left", "right"}
