@@ -174,6 +174,12 @@ class ClusterRuntime:
         self._replicas: dict[ObjectID, set[str]] = {}
         self._reported_holder: dict[ObjectID, str] = {}  # oid -> owner hex
         self._borrow_cache: dict[ObjectID, float] = {}  # released-borrow ts
+        # Borrowed copies promoted to primary by the owner after it lost its
+        # own copy: exempt from the TTL sweep until owner-freed. The lock
+        # makes pin-vs-sweep atomic (pin handler runs on the io loop, the
+        # sweep on caller threads).
+        self._pinned_borrows: set[ObjectID] = set()
+        self._borrow_lock = threading.Lock()
         self._referrals: dict[ObjectID, list[float]] = {}  # issue stamps
         self.refer_counts: dict[ObjectID, dict[str, int]] = {}  # observability
         self._io = EventLoopThread.get()
@@ -222,6 +228,7 @@ class ClusterRuntime:
         self.server.register("report_location", self._handle_report_location)
         self.server.register("report_lost", self._handle_report_lost)
         self.server.register("report_holder", self._handle_report_holder)
+        self.server.register("pin_object", self._handle_pin_object)
         self.server.register("ping", self._handle_ping)
         self.addr = self._io.run(self.server.start())
         # Workers learn their node from the forking daemon's env; a DRIVER
@@ -339,6 +346,18 @@ class ClusterRuntime:
             await asyncio.sleep(0.01)
         return {"pending": True}
 
+    async def _handle_pin_object(self, conn, oid: str):
+        """The owner promoted our cached copy to primary: exempt it from
+        the borrow-cache TTL sweep so it stays servable until the owner
+        frees the object."""
+        object_id = ObjectID.from_hex(oid)
+        with self._borrow_lock:
+            if self._local_size(object_id) is None:
+                return {"ok": True, "present": False}
+            self._pinned_borrows.add(object_id)
+            self._borrow_cache.pop(object_id, None)
+        return {"ok": True, "present": True}
+
     async def _handle_report_holder(self, conn, oid: str, worker_id: str,
                                     remove: bool = False):
         """A puller cached a servable copy (add it to the relay set and
@@ -418,6 +437,7 @@ class ClusterRuntime:
         self._reported_holder.pop(object_id, None)  # owner is deleting: no
         # retract round-trip needed
         self._borrow_cache.pop(object_id, None)
+        self._pinned_borrows.discard(object_id)
         if self.shm is not None:
             try:
                 self.shm.delete(object_id.binary())
@@ -452,11 +472,54 @@ class ClusterRuntime:
             return {"ok": True, "state": "present"}  # a replica died, not us
         # Primary gone — promote a surviving relay replica before resorting
         # to recompute: a live copy beats lineage reconstruction (and is
-        # the only option for put() objects, which have no lineage).
+        # the only option for put() objects, which have no lineage). The
+        # promoted copy is a borrow-cache entry the holder would sweep
+        # after BORROW_CACHE_TTL_S without knowing it became load-bearing —
+        # pin it there before answering "present" (a dangling promotion
+        # permanently loses put() objects).
         reps = self._replicas.get(object_id)
         if reps:
-            self._locations[object_id] = next(iter(reps))
-            return {"ok": True, "state": "present"}
+            # Pin candidates CONCURRENTLY under one bounded budget: the
+            # borrower's report_lost RPC allows ~10 s, and sequential 5 s
+            # timeouts against two dead holders would overrun it (the
+            # caller would see RpcError and re-issue report_lost while
+            # this handler still runs).
+            async def _try_pin(candidate: str) -> str | None:
+                try:
+                    addr = await self._aresolve_worker_addr(candidate)
+                    if addr is None:
+                        return None
+                    peer = await self._apeer(addr)
+                    res = await peer.call("pin_object", oid=oid, timeout=4)
+                    return candidate if res.get("present") else None
+                except Exception:
+                    return None
+
+            candidates = sorted(reps)
+            tasks = [asyncio.ensure_future(_try_pin(c)) for c in candidates]
+            pinned = None
+            try:
+                for fut in asyncio.as_completed(tasks, timeout=6):
+                    try:
+                        got = await fut
+                    except Exception:
+                        continue
+                    if got is not None:
+                        pinned = got
+                        break
+            except (TimeoutError, asyncio.TimeoutError):
+                pass
+            for t in tasks:
+                t.cancel()
+            if pinned is not None:
+                # Drop candidates that definitively failed their pin; keep
+                # the pinned one and any whose attempt was cut short.
+                failed = {c for c, t in zip(candidates, tasks)
+                          if t.done() and not t.cancelled()
+                          and t.exception() is None and t.result() is None}
+                reps.difference_update(failed - {pinned})
+                self._locations[object_id] = pinned
+                return {"ok": True, "state": "present"}
         self._locations.pop(object_id, None)
         self._replicas.pop(object_id, None)
         ok = self._recover_object(object_id)
@@ -540,7 +603,7 @@ class ClusterRuntime:
         owns = rec is None or rec.owner_id == self.worker_id
         if owns:
             self.store.delete(oid)
-        else:
+        elif oid not in self._pinned_borrows:
             self._borrow_cache[oid] = time.monotonic()
         self._recovery_attempts.pop(oid, None)
         self._replicas.pop(oid, None)
@@ -579,8 +642,15 @@ class ClusterRuntime:
                             if o not in exp)
             expired.extend(o for _, o in by_age[:over])
         for o in expired:
-            self._borrow_cache.pop(o, None)
-            self.store.delete(o)
+            with self._borrow_lock:
+                if o in self._pinned_borrows:
+                    # Promoted to primary between list computation and
+                    # delete (pin_object landed mid-sweep): the copy is
+                    # load-bearing now.
+                    self._borrow_cache.pop(o, None)
+                    continue
+                self._borrow_cache.pop(o, None)
+                self.store.delete(o)
             self._retract_holder(o)
 
     def _store_blob(self, oid: ObjectID, blob, owner) -> None:

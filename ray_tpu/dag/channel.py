@@ -199,6 +199,22 @@ class StoreChannel:
                 self._runtime.kv_del(key, ns="channels")
 
 
+class _DeviceArrayEnvelope:
+    """Out-of-band marker for device arrays in transit. A private class
+    (not an in-band tuple sentinel) so no user value can ever be mistaken
+    for an encoded array — pattern-matching user data corrupts payloads."""
+
+    __slots__ = ("raw", "shape", "dtype")
+
+    def __init__(self, raw: bytes, shape, dtype: str):
+        self.raw = raw
+        self.shape = shape
+        self.dtype = dtype
+
+    def __reduce__(self):
+        return (_DeviceArrayEnvelope, (self.raw, self.shape, self.dtype))
+
+
 class DeviceChannel:
     """Device-array channel: jax.Array values cross the wire as raw
     host bytes + aval and land back ON DEVICE at the reader via
@@ -228,8 +244,8 @@ class DeviceChannel:
 
             if isinstance(value, jax.Array):
                 host = np.asarray(value)
-                self.inner.write(("__jax_array__", host.tobytes(),
-                                  host.shape, str(host.dtype)))
+                self.inner.write(_DeviceArrayEnvelope(
+                    host.tobytes(), host.shape, str(host.dtype)))
                 return
         except ImportError:
             pass
@@ -237,14 +253,13 @@ class DeviceChannel:
 
     def read(self, reader_index: int = 0, timeout: float | None = None) -> Any:
         value = self.inner.read(reader_index, timeout=timeout)
-        if isinstance(value, tuple) and len(value) == 4 and \
-                value[0] == "__jax_array__":
+        if isinstance(value, _DeviceArrayEnvelope):
             import jax
             import numpy as np
 
-            _, raw, shape, dtype = value
             return jax.device_put(
-                np.frombuffer(raw, dtype=dtype).reshape(shape))
+                np.frombuffer(value.raw, dtype=value.dtype)
+                .reshape(value.shape))
         return value
 
     def close(self) -> None:

@@ -147,7 +147,14 @@ class DeploymentHandle:
                     return ray_tpu.get(controller.listen.remote(kv, timeout),
                                        timeout=timeout + 30)
 
-                self._poll = LongPollClient(listen, [key])
+                def on_update(_key, _snap):
+                    # Wake router assign loops parked on saturation — a new
+                    # replica set may have capacity.
+                    r = self._router
+                    if r is not None:
+                        r.notify_replicas_changed()
+
+                self._poll = LongPollClient(listen, [key], callback=on_update)
                 # Seed synchronously so the first request doesn't race the
                 # poll thread.
                 seed = ray_tpu.get(

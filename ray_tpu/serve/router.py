@@ -35,32 +35,55 @@ class Router:
         max_ongoing_requests (router-side queuing, reference behavior).
 
         ``route_hint`` biases placement for cache locality: the same hint
-        routes to the same replica while it has capacity (reference:
-        multiplexed-model routing, request_router/multiplex + the
-        prefix-aware policy in llm routing_policies/prefix_aware — both are
-        affinity-by-key over the replica set)."""
+        routes to the same replica while that replica's load stays within a
+        bounded delta of the least-loaded one (reference: multiplexed-model
+        routing, request_router/multiplex + the prefix-aware policy in llm
+        routing_policies/prefix_aware — affinity-by-key with a balance
+        threshold, so a shared system prompt can't pin a whole deployment
+        to one replica).
+
+        Admission is event-driven: when every replica is saturated the
+        caller parks on a Condition that is notified on request completion
+        and on replica-set changes — no sleep-poll (reference:
+        serve/_private/router.py:510 wakes assign loops on config/ongoing-
+        request events)."""
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        while True:
-            replicas = self._get_replicas()
-            if replicas:
-                chosen = self._choose(replicas, route_hint=route_hint)
-                if chosen is not None:
-                    break
-            if _time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no available replica for {self._deployment!r} "
-                    f"within {timeout}s")
-            _time.sleep(0.01)
-
-        handle = ray_tpu.get_actor(chosen.actor_name, namespace="serve")
         with self._lock:
-            self._inflight[chosen.replica_id] = \
-                self._inflight.get(chosen.replica_id, 0) + 1
+            while True:
+                replicas = self._get_replicas()
+                chosen = (self._choose_locked(replicas, route_hint)
+                          if replicas else None)
+                if chosen is not None:
+                    self._inflight[chosen.replica_id] = \
+                        self._inflight.get(chosen.replica_id, 0) + 1
+                    break
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no available replica for {self._deployment!r} "
+                        f"within {timeout}s")
+                # Bounded wait: replica-set changes arrive via
+                # notify_replicas_changed(), completions via _release();
+                # the 0.5 s cap only covers lost-notify edge cases.
+                self._not_saturated.wait(timeout=min(remaining, 0.5))
+
+        try:
+            handle = ray_tpu.get_actor(chosen.actor_name, namespace="serve")
+        except Exception:
+            # Replica vanished between the long-poll snapshot and submission:
+            # give the slot back (a leaked increment would read as permanent
+            # saturation) and surface the error to the caller.
+            self._release(chosen.replica_id)
+            raise
         if stream:
-            gen = handle.handle_request_streaming.options(
-                num_returns="streaming").remote(method_name, args, kwargs)
+            try:
+                gen = handle.handle_request_streaming.options(
+                    num_returns="streaming").remote(method_name, args, kwargs)
+            except Exception:
+                self._release(chosen.replica_id)
+                raise
 
             done = threading.Event()
 
@@ -69,51 +92,74 @@ class Router:
                 # (keeps max_ongoing_requests honest for long-lived SSE).
                 if not done.is_set():
                     done.set()
-                    with self._lock:
-                        self._inflight[chosen.replica_id] -= 1
+                    self._release(chosen.replica_id)
 
             return gen, on_stream_done
-        ref = handle.handle_request.remote(method_name, args, kwargs)
+        try:
+            ref = handle.handle_request.remote(method_name, args, kwargs)
+        except Exception:
+            self._release(chosen.replica_id)
+            raise
 
         def _done():
             try:
                 ray_tpu.wait([ref], num_returns=1, timeout=None,
                              fetch_local=False)
             finally:
-                with self._lock:
-                    self._inflight[chosen.replica_id] -= 1
+                self._release(chosen.replica_id)
         threading.Thread(target=_done, daemon=True).start()
         return ref
 
-    def _choose(self, replicas: list[ReplicaInfo],
-                route_hint: str | None = None) -> ReplicaInfo | None:
+    def _release(self, replica_id: str) -> None:
         with self._lock:
-            if route_hint is not None:
-                # Rendezvous hashing: every router maps the same hint to the
-                # same replica without coordination; saturation falls back
-                # to load-based choice (losing only cache locality).
-                import zlib
+            self._inflight[replica_id] -= 1
+            self._not_saturated.notify_all()
 
-                ranked = sorted(
-                    replicas,
-                    key=lambda r: zlib.crc32(
-                        f"{route_hint}:{r.replica_id}".encode()),
-                )
-                for r in ranked:
-                    if self._inflight.get(r.replica_id, 0) < \
-                            r.max_ongoing_requests:
-                        return r
-                return None
-            candidates = (self._rng.sample(replicas, 2)
-                          if len(replicas) >= 2 else list(replicas))
-            best, best_load = None, None
-            for r in candidates:
+    def notify_replicas_changed(self) -> None:
+        """Wake parked assign loops after a replica-set update (called from
+        the long-poll callback in DeploymentHandle)."""
+        with self._lock:
+            self._not_saturated.notify_all()
+
+    # How far above the least-loaded replica a hint-preferred replica may
+    # be before load balancing overrides cache locality.
+    HINT_BALANCE_DELTA = 2
+
+    def _choose_locked(self, replicas: list[ReplicaInfo],
+                       route_hint: str | None = None) -> ReplicaInfo | None:
+        if route_hint is not None:
+            # Rendezvous hashing: every router maps the same hint to the
+            # same replica without coordination — but only while the hinted
+            # replica's load stays within HINT_BALANCE_DELTA of the
+            # least-loaded replica. Beyond that, locality yields to pow-2
+            # balancing (a deployment-wide shared prefix must not pin all
+            # traffic to one replica while siblings idle).
+            import zlib
+
+            min_load = min(self._inflight.get(r.replica_id, 0)
+                           for r in replicas)
+            ranked = sorted(
+                replicas,
+                key=lambda r: zlib.crc32(
+                    f"{route_hint}:{r.replica_id}".encode()),
+            )
+            for r in ranked:
                 load = self._inflight.get(r.replica_id, 0)
                 if load >= r.max_ongoing_requests:
                     continue
-                if best_load is None or load < best_load:
-                    best, best_load = r, load
-            return best
+                if load - min_load <= self.HINT_BALANCE_DELTA:
+                    return r
+                break  # hinted replica overloaded — balance instead
+        candidates = (self._rng.sample(replicas, 2)
+                      if len(replicas) >= 2 else list(replicas))
+        best, best_load = None, None
+        for r in candidates:
+            load = self._inflight.get(r.replica_id, 0)
+            if load >= r.max_ongoing_requests:
+                continue
+            if best_load is None or load < best_load:
+                best, best_load = r, load
+        return best
 
     def metrics(self) -> dict[str, int]:
         with self._lock:

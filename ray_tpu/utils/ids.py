@@ -32,6 +32,19 @@ _COUNTER = itertools.count(int.from_bytes(os.urandom(6), "little"))
 _MASK64 = (1 << 64) - 1
 
 
+def _reseed_after_fork() -> None:
+    # A fork()ed child inherits _RAND_BASE and the counter position and
+    # would emit the parent's exact id stream — silent ObjectID/TaskID
+    # collisions. Redraw the per-process entropy in the child.
+    global _RAND_BASE, _RAND64, _COUNTER
+    _RAND_BASE = os.urandom(16)
+    _RAND64 = int.from_bytes(_RAND_BASE[8:], "little")
+    _COUNTER = itertools.count(int.from_bytes(os.urandom(6), "little"))
+
+
+os.register_at_fork(after_in_child=_reseed_after_fork)
+
+
 def _unique_bytes(n: int) -> bytes:
     c = next(_COUNTER) & _MASK64
     if n <= 8:

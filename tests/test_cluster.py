@@ -749,3 +749,64 @@ def test_broadcast_relay_distribution(tmp_path):
         c.shutdown()
         (global_worker.runtime, global_worker.worker_id,
          global_worker.node_id, global_worker.mode) = old
+
+
+def test_promoted_relay_copy_is_pinned():
+    """When the owner loses its primary copy and promotes a borrower's
+    cached copy, it pins the copy at the holder first — otherwise the
+    borrow-cache TTL sweep deletes the only surviving bytes and a put()
+    object (no lineage) is permanently lost (ADVICE r3)."""
+    c = Cluster()
+    n1 = c.add_node(num_cpus=1, node_id="pin-owner")
+    n2 = c.add_node(num_cpus=1, node_id="pin-holder")
+    rt_owner = c.connect(n1)
+    rt_b = c.connect(n2)
+    try:
+        payload = b"p" * (2 * 1024 * 1024)  # >= RELAY_MIN_BYTES
+        ref = rt_owner.put(payload)
+        # Borrower pulls + caches the copy and reports itself a holder.
+        assert rt_b.get([ref], timeout=60) == [payload]
+        deadline = time.monotonic() + 10
+        while rt_b.worker_id.hex() not in \
+                rt_owner._replicas.get(ref.id, set()):
+            assert time.monotonic() < deadline, "holder never reported"
+            time.sleep(0.05)
+        # Borrower releases: its copy moves to the TTL'd borrow cache.
+        class _Rec:
+            owner_id = rt_owner.worker_id
+            lineage_task = None
+        rt_b._release_object(ref.id, _Rec())
+        assert ref.id in rt_b._borrow_cache
+        # The owner loses its primary (simulated crash of its store).
+        rt_owner.store.delete(ref.id)
+        if rt_owner.shm is not None:
+            try:
+                rt_owner.shm.delete(ref.id.binary())
+            except Exception:
+                pass
+        # A borrower reports the loss; the owner must promote AND pin.
+        res = rt_b._peer(rt_owner.addr).call(
+            "report_lost", oid=ref.id.hex(),
+            holder=rt_owner.worker_id.hex(), timeout=15)
+        assert res["state"] == "present"
+        assert rt_owner._locations[ref.id] == rt_b.worker_id.hex()
+        assert ref.id in rt_b._pinned_borrows
+        assert ref.id not in rt_b._borrow_cache
+        # The sweep must not touch the pinned copy even past TTL.
+        old_ttl = type(rt_b).BORROW_CACHE_TTL_S
+        try:
+            type(rt_b).BORROW_CACHE_TTL_S = 0.0
+            rt_b._sweep_borrow_cache()
+        finally:
+            type(rt_b).BORROW_CACHE_TTL_S = old_ttl
+        assert rt_b._local_size(ref.id) is not None, "sweep deleted the pin"
+        # And a third party can still fetch the bytes end-to-end.
+        rt_c = c.connect(n1)
+        try:
+            assert rt_c.get([ref], timeout=60) == [payload]
+        finally:
+            rt_c.shutdown()
+    finally:
+        rt_b.shutdown()
+        rt_owner.shutdown()
+        c.shutdown()

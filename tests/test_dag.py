@@ -414,3 +414,25 @@ class TestOverlappedExecution:
         comm = get_accelerator_communicator("jax_device")
         assert comm.name == "jax_device"
         assert hasattr(comm, "wrap_channel")
+
+
+def test_device_channel_no_inband_sentinel(rt_start):
+    """A user value shaped like the old in-band marker tuple must pass
+    through a DeviceChannel unmodified (ADVICE r3: out-of-band envelope,
+    never pattern-match user data)."""
+    from ray_tpu.dag.channel import DeviceChannel, LocalChannel
+
+    chan = DeviceChannel(LocalChannel("sentinel-test", num_readers=1))
+    booby_trap = ("__jax_array__", b"\x00\x00\x00\x00", (1,), "int32")
+    chan.write(booby_trap)
+    out = chan.read(0, timeout=5)
+    assert out == booby_trap and isinstance(out, tuple)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    arr = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    chan.write(arr)
+    out = chan.read(0, timeout=5)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+    chan.inner.destroy()

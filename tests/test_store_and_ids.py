@@ -146,3 +146,34 @@ def test_config_env_override(monkeypatch):
     assert cfg2.scheduler_spread_threshold == 0.9
     with pytest.raises(ValueError):
         Config.load(overrides={"nope": 1})
+
+
+def test_ids_reseed_after_fork():
+    """A fork()ed child must not replay the parent's id stream (ADVICE r3:
+    cached _RAND_BASE/_COUNTER are inherited; os.register_at_fork reseeds)."""
+    import os
+
+    from ray_tpu.utils.ids import TaskID, JobID
+
+    job = JobID.from_random()
+    r, w = os.pipe()
+    pid = os.fork()
+    if pid == 0:  # child
+        try:
+            ids = b"".join(TaskID.of(job).binary() for _ in range(8))
+            os.write(w, ids)
+        finally:
+            os._exit(0)
+    os.close(w)
+    child_ids = b""
+    while True:
+        chunk = os.read(r, 4096)
+        if not chunk:
+            break
+        child_ids += chunk
+    os.close(r)
+    os.waitpid(pid, 0)
+    child_set = {child_ids[i:i + 16] for i in range(0, len(child_ids), 16)}
+    parent_set = {TaskID.of(job).binary() for _ in range(8)}
+    assert len(child_set) == 8
+    assert not (child_set & parent_set), "fork replayed the parent id stream"
