@@ -13,6 +13,7 @@ from ray_tpu.train.spmd import make_llama_train_step
 remat = sys.argv[1] if len(sys.argv) > 1 else "dots+"
 batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
 layers = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+opt_name = sys.argv[4] if len(sys.argv) > 4 else "adamw"
 
 cfg = LlamaConfig(
     vocab_size=32128, hidden_size=2048, intermediate_size=8192,
@@ -21,7 +22,12 @@ cfg = LlamaConfig(
 )
 seq = 2048
 mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
-opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
+if opt_name == "lowmem":
+    from ray_tpu.train.optim import adamw_lowmem
+
+    opt = adamw_lowmem(3e-4, weight_decay=0.1)
+else:
+    opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
 step_fn, init_state, shard = make_llama_train_step(
     cfg, mesh, optimizer=opt, attn_impl="flash", remat=remat,
 )

@@ -484,42 +484,44 @@ class ClusterRuntime:
             # timeouts against two dead holders would overrun it (the
             # caller would see RpcError and re-issue report_lost while
             # this handler still runs).
-            async def _try_pin(candidate: str) -> str | None:
+            async def _try_pin(candidate: str) -> str:
+                """'pinned' | 'dead' (no copy / holder gone — drop it) |
+                'unknown' (timeout/stall — the copy may still exist)."""
                 try:
                     addr = await self._aresolve_worker_addr(candidate)
                     if addr is None:
-                        return None
+                        return "dead"  # head says the worker is gone
                     peer = await self._apeer(addr)
                     res = await peer.call("pin_object", oid=oid, timeout=4)
-                    return candidate if res.get("present") else None
+                    return "pinned" if res.get("present") else "dead"
                 except Exception:
-                    return None
+                    return "unknown"
 
             candidates = sorted(reps)
             tasks = [asyncio.ensure_future(_try_pin(c)) for c in candidates]
-            pinned = None
             try:
-                for fut in asyncio.as_completed(tasks, timeout=6):
-                    try:
-                        got = await fut
-                    except Exception:
-                        continue
-                    if got is not None:
-                        pinned = got
-                        break
-            except (TimeoutError, asyncio.TimeoutError):
-                pass
-            for t in tasks:
-                t.cancel()
+                await asyncio.wait(tasks, timeout=6)
+            finally:
+                for t in tasks:
+                    t.cancel()
+            verdicts = {c: (t.result() if t.done() and not t.cancelled()
+                            and t.exception() is None else "unknown")
+                        for c, t in zip(candidates, tasks)}
+            reps.difference_update(
+                c for c, s in verdicts.items() if s == "dead")
+            pinned = next((c for c in candidates
+                           if verdicts[c] == "pinned"), None)
             if pinned is not None:
-                # Drop candidates that definitively failed their pin; keep
-                # the pinned one and any whose attempt was cut short.
-                failed = {c for c, t in zip(candidates, tasks)
-                          if t.done() and not t.cancelled()
-                          and t.exception() is None and t.result() is None}
-                reps.difference_update(failed - {pinned})
                 self._locations[object_id] = pinned
                 return {"ok": True, "state": "present"}
+            if reps:
+                # Some holders were merely slow/unreachable-right-now: do
+                # NOT forget them — a transient stall must not turn into
+                # permanent loss of a put() object. The borrower retries
+                # and the next report_lost re-attempts the pin; candidates
+                # the head declares dead were dropped above, so the set
+                # only shrinks and this terminates.
+                return {"ok": True, "state": "recovering"}
         self._locations.pop(object_id, None)
         self._replicas.pop(object_id, None)
         ok = self._recover_object(object_id)

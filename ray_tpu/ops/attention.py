@@ -33,6 +33,13 @@ NEG_INF = -1e30
 # taken off-TPU too) — lets CPU tests exercise the exact kernel code.
 INTERPRET = False
 
+# Fused dq+dkv backward (one kernel, 5 matmuls per block pair instead of 7
+# across the split kernels). RTPU_FLASH_FUSED_BWD=0 falls back to the split
+# dq / dkv kernels.
+import os as _os
+
+FUSED_BWD = _os.environ.get("RTPU_FLASH_FUSED_BWD", "1") != "0"
+
 
 def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
     """Expand KV heads to match query heads (GQA)."""
@@ -312,6 +319,118 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dq_ref, dk_ref, dv_ref, *, kv_seq_len: int,
+                            block_k: int, sm_scale: float, causal: bool,
+                            block_q: int):
+    """Fused backward: ONE pass over (q block, kv block) pairs computes
+    dq, dk and dv together — the split dq/dkv kernels each recompute
+    s = q·kᵀ, p and dp = dO·vᵀ for every pair (7 matmuls/pair across the
+    two kernels); fused needs 5 and reads q/k/v/dO/lse/Δ once.
+
+    Grid: (batch*heads, q_blocks). dq is written per q block. dk/dv are
+    f32 accumulators whose index map is CONSTANT over the q dimension, so
+    the block stays VMEM-resident across the whole q sweep and is flushed
+    to HBM once per (batch, head) when the grid row changes."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    q = q_ref[...]                       # [bq, d] bf16
+    do = do_ref[...]                     # [bq, d] bf16
+    lse = lse_ref[0, :]                  # [bq] f32
+    delta = delta_ref[0, :]              # [bq] f32
+    nkv = kv_seq_len // block_k
+
+    def body(j, dq):
+        kslc = pl.ds(j * block_k, block_k)
+        k = k_ref[kslc, :]
+        v = v_ref[kslc, :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])    # [bq, bk]
+        dp = jnp.dot(do.astype(v.dtype), v.T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dv_ref[kslc, :] += jnp.dot(p.astype(do.dtype).T, do,
+                                   preferred_element_type=jnp.float32)
+        dk_ref[kslc, :] += jnp.dot(ds.astype(q.dtype).T, q,
+                                   preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32)
+
+    if causal:
+        upper = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, nkv)
+    else:
+        upper = nkv
+    d = q_ref.shape[-1]
+    dq = lax.fori_loop(0, upper, body,
+                       jnp.zeros((q.shape[0], d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_fused_pallas(q, k, v, out, lse, g, causal: bool,
+                            sm_scale: float,
+                            block_q: int = 512, block_k: int = 512):
+    """Single-kernel backward (see _flash_bwd_fused_kernel). dk/dv come
+    back per *query* head in f32 (caller folds GQA groups and casts)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    rep = h // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * hkv, skv, d)
+    vf = v.reshape(b * hkv, skv, d)
+    dof = g.reshape(b * h, sq, d).astype(q.dtype)
+    lsef = _rows_3d(lse, b * h, sq)
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    deltaf = _rows_3d(delta, b * h, sq)
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_fused_kernel, kv_seq_len=skv,
+                          block_k=block_k, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // rep, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // rep, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, skv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=INTERPRET,
+    )(qf, kf, vf, dof, lsef, deltaf)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, skv, d),
+            dv.reshape(b, h, skv, d))
+
+
 def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, sm_scale: float,
                       block_q: int = 512, block_k: int = 512):
     """GQA-native like the forward: k/v stay [B, Hkv, S, D]; dk/dv come back
@@ -417,7 +536,8 @@ def _flash_bwd(causal, sm_scale, use_pallas, res, g):
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if lse is not None:
         h, hkv = q.shape[1], k.shape[1]
-        dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale)
+        bwd = _flash_bwd_fused_pallas if FUSED_BWD else _flash_bwd_pallas
+        dq, dk, dv = bwd(q, k, v, out, lse, g, causal, scale)
         if hkv != h:  # GQA: fold the repeated query-head groups back
             b, _, skv, d = dk.shape
             rep = h // hkv

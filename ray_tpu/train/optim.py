@@ -1,0 +1,101 @@
+"""Memory-compact optimizers for HBM-bound TPU training.
+
+New work relative to the reference framework (Ray delegates optimizers to
+torch; a TPU-native framework owns its optimizer memory layout — the
+reference's train layer surface is train_loop_utils.py prepare_optimizer).
+
+On a single v5e chip (15.75 GB usable HBM) a 1.1B-param model with stock
+AdamW costs params 2.2 GB (bf16) + mu 2.2 GB (bf16) + nu **4.4 GB (f32)**
+— the f32 second moment alone is the difference between the fast
+activation-saving remat modes fitting or OOMing. ``adamw_lowmem`` stores
+BOTH moments in a compact dtype (default bfloat16) while doing all update
+math in f32: each step dequantizes, updates, and re-rounds, so the only
+loss is storage rounding (~0.4 % relative for bf16), which second-moment
+EMAs tolerate (the same trade 8-bit Adam makes much more aggressively).
+
+Composition stays pure optax: ``scale_by_adam_compact`` is a
+GradientTransformation chained with weight decay + lr, so it drops into
+``make_train_step(optimizer=...)`` unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ScaleByAdamCompactState(NamedTuple):
+    count: chex.Array
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def scale_by_adam_compact(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    moment_dtype: jnp.dtype = jnp.bfloat16,
+) -> optax.GradientTransformation:
+    """Adam scaling with BOTH moments stored in ``moment_dtype``.
+
+    optax's ``scale_by_adam`` exposes ``mu_dtype`` but always keeps nu in
+    the param dtype's width (f32 for f32/bf16 params after its internal
+    promotion) — for large models nu is the single largest optimizer
+    buffer. All arithmetic here runs in f32; only storage is compact.
+    """
+
+    def init_fn(params):
+        mu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=moment_dtype), params)
+        nu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=moment_dtype), params)
+        return ScaleByAdamCompactState(
+            count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** cf
+        bc2 = 1.0 - b2 ** cf
+
+        def upd(g, m, v):
+            g32 = g.astype(jnp.float32)
+            m32 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
+            v32 = b2 * v.astype(jnp.float32) + (1.0 - b2) * g32 * g32
+            step = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+            return step, m32.astype(moment_dtype), v32.astype(moment_dtype)
+
+        flat_u, treedef = jax.tree.flatten(updates)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        out = [upd(g, m, v) for g, m, v in zip(flat_u, flat_m, flat_v)]
+        steps = treedef.unflatten([o[0] for o in out])
+        mu = treedef.unflatten([o[1] for o in out])
+        nu = treedef.unflatten([o[2] for o in out])
+        return steps, ScaleByAdamCompactState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adamw_lowmem(
+    learning_rate: optax.ScalarOrSchedule = 3e-4,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    moment_dtype: jnp.dtype = jnp.bfloat16,
+    mask: Optional[optax.MaskOrFn] = None,
+) -> optax.GradientTransformation:
+    """AdamW with compact moment storage — ~2x less optimizer HBM than
+    ``optax.adamw(mu_dtype=bf16)`` (which still keeps nu in f32)."""
+    tx = [scale_by_adam_compact(b1=b1, b2=b2, eps=eps,
+                                moment_dtype=moment_dtype)]
+    if weight_decay:
+        tx.append(optax.add_decayed_weights(weight_decay, mask=mask))
+    tx.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*tx)

@@ -86,11 +86,13 @@ def test_flash_pallas_interpret_matches_reference():
     np.testing.assert_allclose(np.asarray(lse), ref_lse, atol=2e-2, rtol=2e-2)
 
 
+@pytest.mark.parametrize("fused", [True, False])
 @pytest.mark.parametrize("h,hkv,causal", [(2, 2, True), (4, 2, True),
                                           (2, 2, False)])
-def test_flash_pallas_backward_matches_reference(h, hkv, causal):
-    """Gradient equivalence of the Pallas dq/dk/dv kernels (interpret mode)
-    against autodiff through attention_reference — incl. the GQA fold."""
+def test_flash_pallas_backward_matches_reference(h, hkv, causal, fused):
+    """Gradient equivalence of the Pallas backward kernels (interpret mode)
+    against autodiff through attention_reference — incl. the GQA fold —
+    for BOTH the fused dq+dkv kernel and the split-kernel fallback."""
     import ray_tpu.ops.attention as attn_mod
 
     q, k, v = _qkv(b=1, h=h, hkv=hkv, s=256, d=64)
@@ -101,11 +103,14 @@ def test_flash_pallas_backward_matches_reference(h, hkv, causal):
         return lambda q, k, v: (f(q, k, v).astype(jnp.float32) * w).sum()
 
     attn_mod.INTERPRET = True
+    old_fused = attn_mod.FUSED_BWD
+    attn_mod.FUSED_BWD = fused
     try:
         g = jax.grad(loss(lambda q, k, v: flash_attention(
             q, k, v, causal, None, True)), argnums=(0, 1, 2))(q, k, v)
     finally:
         attn_mod.INTERPRET = False
+        attn_mod.FUSED_BWD = old_fused
     g_ref = jax.grad(loss(lambda q, k, v: attention_reference(
         q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
     for name, a, b in zip("dq dk dv".split(), g, g_ref):
@@ -263,3 +268,32 @@ def test_llama_loss_fused_matches_unfused():
     plain = loss_fn(cfg, params, tokens, targets, attn_impl="blockwise",
                     remat=False, fused_ce=False)
     np.testing.assert_allclose(fused, plain, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_fused_backward_multiblock(causal):
+    """Multi-q-block case (s > block_q): exercises the fused kernel's
+    dk/dv revisiting accumulation across the sequential grid dimension
+    (the s=256 cases above fit one block and never re-enter)."""
+    import ray_tpu.ops.attention as attn_mod
+
+    q, k, v = _qkv(b=1, h=1, hkv=1, s=1024, d=64)
+
+    def loss(f):
+        return lambda q, k, v: (f(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    attn_mod.INTERPRET = True
+    old = attn_mod.FUSED_BWD
+    attn_mod.FUSED_BWD = True
+    try:
+        g = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal, None, True)), argnums=(0, 1, 2))(q, k, v)
+    finally:
+        attn_mod.INTERPRET = False
+        attn_mod.FUSED_BWD = old
+    g_ref = jax.grad(loss(lambda q, k, v: attention_reference(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), g, g_ref):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        denom = max(np.abs(b).max(), 1e-9)
+        assert np.abs(a - b).max() / denom < 2e-2, name
