@@ -65,6 +65,10 @@ class ActorInfo:
     node_affinity: str | None = None
     affinity_soft: bool = False
     labels: dict | None = None
+    # Serialized runtime_env (JSON): the placing daemon needs it BEFORE
+    # unpickling the spec — a container env changes how the worker is forked
+    # (runtime_env/container.py), and the daemon must not unpickle user code.
+    env_json: str = ""
 
 
 class HeadServer:
@@ -467,7 +471,7 @@ class HeadServer:
         resources: dict, name: str | None, namespace: str, max_restarts: int,
         lifetime: str = "non_detached",
         node_affinity: str | None = None, labels: dict | None = None,
-        affinity_soft: bool = False,
+        affinity_soft: bool = False, env_json: str = "",
     ):
         if name:
             key = (namespace, name)
@@ -477,7 +481,7 @@ class HeadServer:
             actor_id=actor_id, spec_blob=spec_blob, resources=dict(resources),
             name=name, namespace=namespace, max_restarts=max_restarts,
             lifetime=lifetime, node_affinity=node_affinity,
-            affinity_soft=affinity_soft, labels=labels,
+            affinity_soft=affinity_soft, labels=labels, env_json=env_json,
         )
         self.actors[actor_id] = info
         if name:
@@ -556,7 +560,7 @@ class HeadServer:
         # (reference: GcsActorScheduler leases a worker from the raylet).
         await conn.notify(
             "place_actor", actor_id=info.actor_id, spec_blob=info.spec_blob,
-            resources=info.resources,
+            resources=info.resources, env_json=info.env_json,
         )
         return True
 

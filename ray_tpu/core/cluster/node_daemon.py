@@ -35,8 +35,12 @@ class WorkerProc:
     # Runtime-env brand: None = pristine (never ran an env'd task); once a
     # worker runs a task with a runtime_env it can only be reused for that
     # env (reference: worker_pool.h PopWorkerRequest runtime-env hash match —
-    # env application is irreversible in-process).
+    # env application is irreversible in-process). Containerized workers are
+    # branded at FORK (they run inside their env's image).
     env_hash: str | None = None
+    # Fork nonce: joins the registration RPC to this record (pids diverge
+    # across a container boundary).
+    nonce: str = ""
 
 
 @dataclass
@@ -47,6 +51,11 @@ class _PendingLease:
 
 
 class NodeDaemon:
+    # Consecutive container-worker boot failures per env before pending
+    # leases for that env are failed with a diagnostic (instead of
+    # crash-forking the runner forever while the client blocks).
+    CONTAINER_BOOT_RETRIES = 3
+
     def __init__(
         self,
         head_host: str,
@@ -65,6 +74,9 @@ class NodeDaemon:
         self.labels = labels or {}
         self.workers: dict[str, WorkerProc] = {}  # keyed by worker_id
         self._unregistered: list[WorkerProc] = []  # forked, not yet registered
+        # env_hash -> consecutive boot failures of its container workers
+        # (cleared on a successful registration).
+        self._container_fails: dict[str, int] = {}
         self._pending: list[_PendingLease] = []
         self._head: AsyncRpcClient | None = None
         self._leases: dict[str, WorkerProc] = {}
@@ -219,9 +231,14 @@ class NodeDaemon:
                 pass
 
     # ------------------------------------------------------------------ workers
-    def _fork_worker(self) -> WorkerProc:
+    def _fork_worker(self, container: dict | None = None,
+                     brand: str | None = None) -> WorkerProc:
         # reference: WorkerPool::StartWorkerProcess — fork via the language
         # worker command; here: python -m ray_tpu.core.cluster.worker_main.
+        # ``container`` wraps the command in the container runner
+        # (runtime_env/container.py, reference: runtime_env/image_uri.py)
+        # and ``brand`` pre-brands the worker with its env hash — a
+        # containerized worker can never be re-branded, it IS the env.
         import ray_tpu
 
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
@@ -232,32 +249,42 @@ class NodeDaemon:
         env["RTPU_NODE_DAEMON"] = f"{self.rpc.host}:{self.rpc.port}"
         env["RTPU_NODE_ID"] = self.node_id
         env["RTPU_PARENT_PID"] = str(os.getpid())
+        nonce = uuid.uuid4().hex
+        env["RTPU_WORKER_NONCE"] = nonce
         if self.shm_name:
             env["RTPU_SHM_NAME"] = self.shm_name
+        cmd = [sys.executable, "-m", "ray_tpu.core.cluster.worker_main"]
+        if container is not None:
+            from ray_tpu.runtime_env.container import wrap_worker_command
+
+            cmd = wrap_worker_command(cmd, env, container)
         log_dir = os.path.join(get_config().temp_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         log = open(os.path.join(log_dir, f"worker-{self.node_id[:8]}-{time.time_ns()}.log"), "wb")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.cluster.worker_main"],
+            cmd,
             env=env, stdout=log, stderr=subprocess.STDOUT,
             start_new_session=True,
         )
         log.close()
-        wp = WorkerProc(worker_id="", proc=proc)
+        wp = WorkerProc(worker_id="", proc=proc, env_hash=brand, nonce=nonce)
         self._unregistered.append(wp)
         return wp
 
     async def _register_worker_proc(self, conn: ServerConnection, worker_id: str,
-                                    host: str, port: int, pid: int):
+                                    host: str, port: int, pid: int,
+                                    nonce: str = ""):
         wp = None
         for cand in self._unregistered:
-            if cand.proc.pid == pid:
+            if (nonce and cand.nonce == nonce) or cand.proc.pid == pid:
                 wp = cand
                 break
         if wp is None:
             wp = WorkerProc(worker_id=worker_id, proc=None)  # adopted (tests)
         else:
             self._unregistered.remove(wp)
+            if wp.env_hash:  # container worker booted fine: clear its budget
+                self._container_fails.pop(wp.env_hash, None)
         wp.worker_id = worker_id
         wp.addr = (host, port)
         wp.idle_since = time.monotonic()
@@ -272,10 +299,15 @@ class NodeDaemon:
             await asyncio.sleep(cfg.worker_idle_ttl_s / 4)
             now = time.monotonic()
             # Forked-but-never-registered corpses must not count against the
-            # startup-concurrency budget forever.
+            # startup-concurrency budget forever. A dead CONTAINER fork
+            # counts toward its env's failure budget — a bad image_uri must
+            # surface as a lease error, not an infinite crash-fork loop.
             for wp in list(self._unregistered):
                 if wp.proc is not None and wp.proc.poll() is not None:
                     self._unregistered.remove(wp)
+                    if wp.env_hash:
+                        n = self._container_fails.get(wp.env_hash, 0) + 1
+                        self._container_fails[wp.env_hash] = n
                     self._try_grant()
             for wid, w in list(self.workers.items()):
                 if (
@@ -432,11 +464,14 @@ class NodeDaemon:
         return best
 
     def _idle_worker(self, env_hash: str = "",
-                     pristine_only: bool = False) -> WorkerProc | None:
+                     pristine_only: bool = False,
+                     exact_only: bool = False) -> WorkerProc | None:
         """Idle worker whose env brand matches: exact env match first, then a
         pristine worker (which the grant brands). A worker branded with a
         different env is never handed out — its os.environ/sys.path/cwd
-        mutations would leak into the task."""
+        mutations would leak into the task. Container envs pass
+        ``exact_only``: a pristine plain-process worker cannot be re-homed
+        into an image, only a worker forked FOR that env matches."""
         pristine = None
         for w in self.workers.values():
             if w.lease_id is not None or w.actor_id is not None or w.addr is None:
@@ -445,21 +480,35 @@ class NodeDaemon:
                 return w
             if w.env_hash is None and pristine is None:
                 pristine = w
-        return pristine
+        return None if exact_only else pristine
 
     def _try_grant(self):
+        from ray_tpu.runtime_env.container import container_spec
+
         cfg = get_config()
         still: list[_PendingLease] = []
-        need_workers = 0
+        unmet: list[_PendingLease] = []
         for req in self._pending:
             if req.fut.done():
                 continue
             if not self._fits(req.resources):
                 still.append(req)
                 continue
-            w = self._idle_worker(req.env_hash)
+            container = container_spec(req.env_hash)
+            if container is not None and \
+                    self._container_fails.get(req.env_hash, 0) >= \
+                    self.CONTAINER_BOOT_RETRIES:
+                req.fut.set_result({"error": (
+                    f"container worker for image "
+                    f"{container['image_uri']!r} failed to start "
+                    f"{self.CONTAINER_BOOT_RETRIES} times — check the "
+                    "image reference and the container runner "
+                    "(RTPU_CONTAINER_RUNNER)")})
+                continue
+            w = self._idle_worker(req.env_hash,
+                                  exact_only=container is not None)
             if w is None:
-                need_workers += 1
+                unmet.append(req)
                 still.append(req)
                 continue
             lease_id = uuid.uuid4().hex
@@ -480,14 +529,32 @@ class NodeDaemon:
         # of CPU, which on small hosts starves the very tasks being scheduled
         # (reference: worker_pool.cc starts processes against
         # num_initial_python_workers/startup caps, not per-request).
+        # Container requests fork a worker FOR their env (brand at birth):
+        # count one fork per distinct container env, dedup so ten queued
+        # tasks of one env don't fork ten containers in a pass.
         starting = len(self._unregistered)
         to_start = min(
-            need_workers - starting,
+            len(unmet) - starting,
             cfg.worker_startup_concurrency - starting,
             cfg.max_workers_per_node - len(self.workers) - starting,
         )
-        for _ in range(max(0, to_start)):
-            self._fork_worker()
+        if to_start <= 0:
+            return
+        started = 0
+        seen_container_envs = {
+            w.env_hash for w in self._unregistered if w.env_hash}
+        for req in unmet:
+            if started >= to_start:
+                break
+            container = container_spec(req.env_hash)
+            if container is not None:
+                if req.env_hash in seen_container_envs:
+                    continue  # a matching container worker is already booting
+                seen_container_envs.add(req.env_hash)
+                self._fork_worker(container=container, brand=req.env_hash)
+            else:
+                self._fork_worker()
+            started += 1
 
     async def _return_lease(self, conn: ServerConnection, lease_id: str):
         w = self._leases.pop(lease_id, None)
@@ -564,9 +631,13 @@ class NodeDaemon:
         return {"ok": True}
 
     # ------------------------------------------------------------------ actors
-    async def _place_actor(self, actor_id: str, spec_blob: bytes, resources: dict):
+    async def _place_actor(self, actor_id: str, spec_blob: bytes,
+                           resources: dict, env_json: str = ""):
         # Dedicated worker per actor (reference: actor creation leases a worker
         # which then becomes the actor's home for its lifetime).
+        from ray_tpu.runtime_env.container import container_spec
+
+        container = container_spec(env_json)
         try:
             if not self._fits(resources):
                 if not self._feasible(resources):
@@ -582,16 +653,24 @@ class NodeDaemon:
                     await self._head.call("actor_failed", actor_id=actor_id,
                                           reason="timed out waiting for resources")
                     return
-            # Actors get a pristine worker: the creation spec's runtime_env is
-            # applied by init_actor, and the worker is dedicated until death.
-            w = self._idle_worker(pristine_only=True)
+            # Actors get a pristine worker: the creation spec's runtime_env
+            # is applied by init_actor, and the worker is dedicated until
+            # death. A container env instead forks a worker INSIDE the image
+            # (branded at birth, matched exactly).
+            def find_idle():
+                if container is not None:
+                    return self._idle_worker(env_json, exact_only=True)
+                return self._idle_worker(pristine_only=True)
+
+            w = find_idle()
             if w is None:
-                self._fork_worker()
+                self._fork_worker(container=container,
+                                  brand=env_json if container else None)
                 deadline = time.monotonic() + \
                     get_config().worker_start_timeout_s
                 while time.monotonic() < deadline:
                     await asyncio.sleep(0.05)
-                    w = self._idle_worker(pristine_only=True)
+                    w = find_idle()
                     if w is not None:
                         break
                 else:

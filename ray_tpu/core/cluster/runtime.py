@@ -26,6 +26,7 @@ records the location, and readers fetch from the holder.
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import threading
 import time
@@ -498,19 +499,35 @@ class ClusterRuntime:
                     return "unknown"
 
             candidates = sorted(reps)
-            tasks = [asyncio.ensure_future(_try_pin(c)) for c in candidates]
+            tasks = {asyncio.ensure_future(_try_pin(c)): c
+                     for c in candidates}
+            pinned = None
+            pending = set(tasks)
+            deadline = asyncio.get_running_loop().time() + 6.0
             try:
-                await asyncio.wait(tasks, timeout=6)
+                # First success wins IMMEDIATELY — one live holder must not
+                # wait out a stalled one's 4 s timeout. Slower verdicts that
+                # did arrive still prune head-confirmed-dead candidates.
+                while pending and pinned is None:
+                    remaining = deadline - asyncio.get_running_loop().time()
+                    if remaining <= 0:
+                        break
+                    done, pending = await asyncio.wait(
+                        pending, timeout=remaining,
+                        return_when=asyncio.FIRST_COMPLETED)
+                    if not done:
+                        break  # overall budget exhausted
+                    for t in done:
+                        c = tasks[t]
+                        verdict = (t.result() if t.exception() is None
+                                   else "unknown")
+                        if verdict == "dead":
+                            reps.discard(c)
+                        elif verdict == "pinned" and pinned is None:
+                            pinned = c
             finally:
-                for t in tasks:
+                for t in pending:
                     t.cancel()
-            verdicts = {c: (t.result() if t.done() and not t.cancelled()
-                            and t.exception() is None else "unknown")
-                        for c, t in zip(candidates, tasks)}
-            reps.difference_update(
-                c for c, s in verdicts.items() if s == "dead")
-            pinned = next((c for c in candidates
-                           if verdicts[c] == "pinned"), None)
             if pinned is not None:
                 self._locations[object_id] = pinned
                 return {"ok": True, "state": "present"}
@@ -1529,6 +1546,8 @@ class ClusterRuntime:
 
     # ------------------------------------------------------------------ actors
     def create_actor(self, spec: ActorCreationSpec) -> None:
+        from ray_tpu.runtime_env.container import canonical_env_json
+
         spec.owner_id = self.worker_id
         strategy = spec.scheduling_strategy
         res = self.head.call(
@@ -1542,6 +1561,7 @@ class ClusterRuntime:
             lifetime=spec.lifetime,
             node_affinity=strategy.node_id_hex if strategy.kind == "NODE_AFFINITY" else None,
             affinity_soft=strategy.soft,
+            env_json=canonical_env_json(getattr(spec, "runtime_env", None)),
         )
         if not res.get("ok"):
             raise ValueError(res.get("error", "actor registration failed"))

@@ -140,6 +140,9 @@ class WorkerProcess:
             worker_id=self.runtime.worker_id.hex(),
             host=self.runtime.addr[0], port=self.runtime.addr[1],
             pid=os.getpid(),
+            # Containerized workers see a different pid than the daemon's
+            # Popen (the runner's); the fork nonce is the reliable join key.
+            nonce=os.environ.get("RTPU_WORKER_NONCE", ""),
         )
         # Ship task events to the head on an interval so driver-side
         # timeline/state-API see cluster-wide execution (reference:

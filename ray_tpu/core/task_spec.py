@@ -68,12 +68,12 @@ class TaskSpec:
         # release, and the env canonicalization walks the whole env dict.
         cached = getattr(self, "_sched_key", None)
         if cached is None:
-            import json
-
             # Canonical JSON: runtime_env values are nested dicts/lists,
-            # which are unhashable as raw tuple members.
-            env_key = (json.dumps(self.runtime_env, sort_keys=True, default=str)
-                       if self.runtime_env else "")
+            # which are unhashable as raw tuple members. MUST be the shared
+            # canonicalizer — the daemon matches worker brands on it.
+            from ray_tpu.runtime_env.container import canonical_env_json
+
+            env_key = canonical_env_json(self.runtime_env)
             res_key = tuple(sorted(self.resources.items()))
             s = self.scheduling_strategy
             strat_key = (s.kind, s.node_id_hex, s.soft)

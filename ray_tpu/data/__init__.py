@@ -40,6 +40,7 @@ from ray_tpu.data.datasource import (
     BinaryDatasource,
     CSVDatasource,
     Datasource,
+    ImageDatasource,
     ItemsDatasource,
     JSONDatasource,
     NumpyDatasource,
@@ -79,6 +80,14 @@ def read_numpy(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
 
 def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
     return Dataset([Read(BinaryDatasource(paths), parallelism)])
+
+
+def read_images(paths, *, size: tuple[int, int] | None = None,
+                mode: str = "RGB", parallelism: int = -1) -> Dataset:
+    """Decoded images as an ``image`` column (reference:
+    ray.data.read_images / datasource/image_datasource.py)."""
+    return Dataset([Read(ImageDatasource(paths, size=size, mode=mode),
+                         parallelism)])
 
 
 def from_pandas(df) -> Dataset:
@@ -152,6 +161,7 @@ __all__ = [
     "range",
     "read_binary_files",
     "read_csv",
+    "read_images",
     "read_datasource",
     "read_json",
     "read_numpy",

@@ -189,6 +189,45 @@ class BinaryDatasource(FileDatasource):
         return {"bytes": col, "path": pcol}
 
 
+class ImageDatasource(FileDatasource):
+    """Decoded images, one row per file (reference capability:
+    python/ray/data/datasource/image_datasource.py — decode via PIL into an
+    ``image`` ndarray column plus the source ``path``).
+
+    ``size=(h, w)`` resizes at read time (rows then stack into one dense
+    [N, h, w, C] batch per block — the shape a trainer wants); without it,
+    variable-shape arrays ride an object column. ``mode`` converts color
+    space (default RGB).
+    """
+
+    suffixes = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def __init__(self, paths, size: tuple[int, int] | None = None,
+                 mode: str = "RGB"):
+        super().__init__(paths)
+        self._size = size
+        self._mode = mode
+
+    def read_file(self, path: str) -> Block:
+        Image = _import_pil()
+
+        with Image.open(path) as im:
+            if self._mode:
+                im = im.convert(self._mode)
+            if self._size is not None:
+                h, w = self._size
+                im = im.resize((w, h))  # PIL takes (width, height)
+            arr = np.asarray(im)
+        if self._size is not None:
+            img_col = arr[None]  # dense [1, h, w, C]
+        else:
+            img_col = np.empty(1, dtype=object)
+            img_col[0] = arr
+        pcol = np.empty(1, dtype=object)
+        pcol[0] = path
+        return {"image": img_col, "path": pcol}
+
+
 # ---------------------------------------------------------------------------
 # write tasks
 
@@ -213,6 +252,13 @@ def _import_pd():
         import pandas as pd
 
         return pd
+
+
+def _import_pil():
+    with _IMPORT_LOCK:
+        from PIL import Image
+
+        return Image
 
 
 def write_block_parquet(block: Block, path: str, index: int) -> str:

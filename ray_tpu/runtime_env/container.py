@@ -1,0 +1,109 @@
+"""Container runtime_env: run workers inside an image.
+
+Capability parity with the reference's ``image_uri`` runtime-env plugin
+(reference: python/ray/_private/runtime_env/image_uri.py — the worker
+command is wrapped in ``podman run`` with the host network, the session
+dir mounted, and the worker env forwarded via ``-e``): a task or actor
+declaring ``runtime_env={"image_uri": ...}`` gets a worker process whose
+entire lifetime runs inside that container.
+
+The wrapping happens at WORKER FORK time in the node daemon (the reference
+wraps in the raylet's worker-pool startup for the same reason): an already
+running Python process cannot move itself into an image, so container envs
+brand their worker at birth and are only ever matched by exact env hash.
+
+The container runner binary is ``podman`` by default and is injectable via
+``RTPU_CONTAINER_RUNNER`` — tests point it at a stub that mimics the
+``run`` CLI, so the command-construction and env-propagation contract is
+exercised without a container daemon on the box.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+def canonical_env_json(env: dict | None) -> str:
+    """THE canonical serialized form of a runtime_env ("" when empty).
+
+    Worker-brand matching in the node daemon compares these strings
+    byte-for-byte across three producers (task scheduling keys, actor
+    registration, container fork branding) — every producer must call this
+    one function (reference: runtime-env hash in worker_pool.h plays the
+    same role)."""
+    if not env:
+        return ""
+    return json.dumps(env, sort_keys=True, default=str)
+
+
+def container_spec(env: dict | None) -> dict | None:
+    """Extract the container request from a runtime_env dict (or its JSON
+    string form, which is what rides the lease protocol as env_hash)."""
+    if not env:
+        return None
+    if isinstance(env, str):
+        try:
+            env = json.loads(env)
+        except ValueError:
+            return None
+    if not isinstance(env, dict):
+        return None
+    uri = env.get("image_uri")
+    if not uri:
+        return None
+    return {"image_uri": uri,
+            "run_options": list(env.get("container_run_options") or ())}
+
+
+def validate_container_fields(env: dict) -> None:
+    uri = env.get("image_uri")
+    if uri is not None and not isinstance(uri, str):
+        raise TypeError("image_uri must be an image reference string")
+    opts = env.get("container_run_options")
+    if opts is not None and (
+            not isinstance(opts, (list, tuple))
+            or not all(isinstance(o, str) for o in opts)):
+        raise TypeError("container_run_options must be a list of strings")
+
+
+def runner_binary() -> str:
+    return os.environ.get("RTPU_CONTAINER_RUNNER", "podman")
+
+
+def wrap_worker_command(cmd: list[str], env: dict[str, str],
+                        spec: dict[str, Any]) -> list[str]:
+    """Build the containerized worker command.
+
+    - host network/IPC: the worker must reach head/daemon ports and the
+      node's shared-memory arena (reference wraps with --network=host).
+    - the package root and temp dir are bind-mounted so the framework code
+      and log/shm paths resolve identically inside the image.
+    - the ENTIRE worker environment is forwarded with ``-e`` — that is the
+      env-propagation contract (runtime_env env_vars, RTPU_* bootstrap
+      addresses, PYTHONPATH all cross the boundary).
+    """
+    import ray_tpu
+    from ray_tpu.utils.config import get_config
+
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    temp_dir = get_config().temp_dir
+    out = [runner_binary(), "run", "--rm",
+           "--network=host", "--ipc=host", "--pid=host",
+           "-v", f"{pkg_root}:{pkg_root}:ro",
+           "-v", f"{temp_dir}:{temp_dir}"]
+    for k, v in sorted(env.items()):
+        out += ["-e", f"{k}={v}"]
+    out += list(spec.get("run_options") or ())
+    out.append(spec["image_uri"])
+    # The host interpreter's absolute path (a venv, typically) does not
+    # exist inside the image: run the IMAGE's python3. The framework code
+    # itself arrives via the pkg_root bind-mount + forwarded PYTHONPATH
+    # (reference expects the image to carry a compatible runtime the same
+    # way).
+    if cmd and os.path.basename(cmd[0]).startswith("python"):
+        cmd = ["python3"] + list(cmd[1:])
+    out += list(cmd)
+    return out

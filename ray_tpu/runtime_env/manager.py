@@ -35,7 +35,9 @@ class RuntimeEnvManager:
 
     @staticmethod
     def env_key(env: dict | None) -> str:
-        return json.dumps(env or {}, sort_keys=True, default=str)
+        from ray_tpu.runtime_env.container import canonical_env_json
+
+        return canonical_env_json(env) or "{}"
 
     def ensure(self, env: dict | None, runtime) -> None:
         """Apply ``env`` to this process (idempotent per env)."""
@@ -54,6 +56,10 @@ class RuntimeEnvManager:
                 raise RuntimeError(
                     f"runtime_env[{field!r}] is not supported: the execution "
                     "image is immutable. Ship code with working_dir/py_modules.")
+        # image_uri is satisfied at worker FORK time (the node daemon wraps
+        # the worker command in the container runner — runtime_env/
+        # container.py); by the time this code runs we are already inside
+        # the image, so there is nothing to apply worker-side.
         for k, v in (env.get("env_vars") or {}).items():
             os.environ[k] = v
         wd = env.get("working_dir")

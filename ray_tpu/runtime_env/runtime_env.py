@@ -23,6 +23,7 @@ from typing import Any
 
 _KNOWN_FIELDS = {
     "env_vars", "working_dir", "py_modules", "pip", "conda", "uv", "config",
+    "image_uri", "container_run_options",
 }
 
 
@@ -33,8 +34,11 @@ class RuntimeEnv(dict):
                  working_dir: str | None = None,
                  py_modules: list[str] | None = None,
                  pip: Any = None, conda: Any = None, uv: Any = None,
-                 config: dict | None = None, **extra):
+                 config: dict | None = None,
+                 image_uri: str | None = None,
+                 container_run_options: list[str] | None = None, **extra):
         super().__init__()
+        from ray_tpu.runtime_env.container import validate_container_fields
         from ray_tpu.runtime_env.plugin import get_plugins
 
         plugin_fields = set(get_plugins())
@@ -43,6 +47,17 @@ class RuntimeEnv(dict):
             raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
         for k in set(extra) & plugin_fields:
             self[k] = extra[k]  # plugin-owned; its validate() runs at setup
+        if image_uri is not None or container_run_options is not None:
+            probe = {"image_uri": image_uri,
+                     "container_run_options": container_run_options}
+            validate_container_fields(probe)
+            if container_run_options is not None and image_uri is None:
+                raise ValueError(
+                    "container_run_options requires image_uri")
+            if image_uri is not None:
+                self["image_uri"] = image_uri
+            if container_run_options is not None:
+                self["container_run_options"] = list(container_run_options)
         if env_vars is not None:
             if not all(isinstance(k, str) and isinstance(v, str)
                        for k, v in env_vars.items()):

@@ -15,6 +15,7 @@ from ray_tpu.tune.schedulers import (
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    TPESearcher,
     Searcher,
     choice,
     grid_search,
@@ -32,7 +33,7 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TuneResult", "Trial",
     "Trainable", "report", "get_checkpoint",
     "grid_search", "uniform", "loguniform", "quniform", "randint", "choice",
-    "sample_from", "Searcher", "BasicVariantGenerator",
+    "sample_from", "Searcher", "BasicVariantGenerator", "TPESearcher",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
 ]

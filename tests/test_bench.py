@@ -5,17 +5,18 @@ outage must yield a COMPARABLE number (last good TPU result, tagged), not a
 CPU-fallback figure with vs_baseline 0.0 (round-3 verdict weak #1)."""
 
 import json
+import os
 import subprocess
 import sys
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _run_bench(env_extra, script="bench.py"):
-    import os
-
     env = dict(os.environ)
     env.update(env_extra)
     r = subprocess.run([sys.executable, script], capture_output=True,
-                       text=True, timeout=120, env=env, cwd="/root/repo")
+                       text=True, timeout=120, env=env, cwd=_REPO_ROOT)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, f"expected exactly one JSON line: {r.stdout!r}"

@@ -191,3 +191,142 @@ class TestClusterRuntimeEnv:
         register_plugin(ImgPlugin())
         env = RuntimeEnv.from_dict({"image_uri_test": "img://x"})
         assert env["image_uri_test"] == "img://x"
+
+
+_STUB_RUNNER = r'''#!/usr/bin/env python3
+"""Stub container runner mimicking the `podman run` CLI: parses the flags
+wrap_worker_command emits, then execs the worker with ONLY the -e-propagated
+environment (so missing propagation breaks the worker boot, like a real
+container would)."""
+import os
+import sys
+
+args = sys.argv[1:]
+assert args and args[0] == "run", args
+args = args[1:]
+env = {}
+i = 0
+while i < len(args):
+    a = args[i]
+    if a in ("--rm", "--network=host", "--ipc=host", "--pid=host"):
+        i += 1
+    elif a == "-v":
+        i += 2
+    elif a == "-e":
+        k, v = args[i + 1].split("=", 1)
+        env[k] = v
+        i += 2
+    else:
+        break
+image = args[i]
+cmd = args[i + 1:]
+env["RTPU_CONTAINERIZED_IMAGE"] = image
+os.execvpe(cmd[0], cmd, env)
+'''
+
+
+class TestContainerRuntimeEnv:
+    def test_wrap_worker_command_shape(self):
+        """Command construction contract (reference:
+        _private/runtime_env/image_uri.py podman wrapping)."""
+        from ray_tpu.runtime_env.container import wrap_worker_command
+
+        cmd = wrap_worker_command(
+            ["python", "-m", "worker"],
+            {"RTPU_HEAD": "h:1", "MY": "x"},
+            {"image_uri": "docker.io/img:tag", "run_options": ["--gpus=all"]},
+        )
+        assert cmd[0:2] == ["podman", "run"]
+        img_at = cmd.index("docker.io/img:tag")
+        # The host interpreter path is swapped for the image's python3.
+        assert cmd[img_at + 1:] == ["python3", "-m", "worker"]
+        head = cmd[:img_at]
+        assert "--network=host" in head and "--rm" in head
+        assert "--gpus=all" in head  # run_options precede the image
+        # every env pair is forwarded
+        pairs = [head[i + 1] for i, a in enumerate(head) if a == "-e"]
+        assert "RTPU_HEAD=h:1" in pairs and "MY=x" in pairs
+
+    def test_validation(self):
+        from ray_tpu.runtime_env import RuntimeEnv
+
+        env = RuntimeEnv(image_uri="img:1",
+                         container_run_options=["--cpus=2"])
+        assert env["image_uri"] == "img:1"
+        with pytest.raises(TypeError):
+            RuntimeEnv(image_uri=123)
+        with pytest.raises(ValueError):
+            RuntimeEnv(container_run_options=["--x"])  # without image_uri
+
+    def test_container_worker_end_to_end(self, tmp_path, monkeypatch):
+        """A task with image_uri runs in a worker launched THROUGH the
+        container runner: the image marker is visible, runtime_env env_vars
+        propagate across the -e boundary, and plain tasks still get plain
+        (non-containerized) workers."""
+        import stat
+
+        import ray_tpu
+
+        stub = tmp_path / "stub_podman.py"
+        stub.write_text(_STUB_RUNNER)
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv("RTPU_CONTAINER_RUNNER", str(stub))
+
+        ray_tpu.shutdown()
+        ray_tpu.init(address="local-cluster", num_cpus=2)
+        try:
+            @ray_tpu.remote(runtime_env={"image_uri": "example.com/app:v7",
+                                         "env_vars": {"APP_FLAG": "on"}})
+            def inside():
+                return (os.environ.get("RTPU_CONTAINERIZED_IMAGE"),
+                        os.environ.get("APP_FLAG"))
+
+            img, flag = ray_tpu.get(inside.remote(), timeout=120)
+            assert img == "example.com/app:v7"
+            assert flag == "on"
+
+            @ray_tpu.remote
+            def outside():
+                return os.environ.get("RTPU_CONTAINERIZED_IMAGE")
+
+            assert ray_tpu.get(outside.remote(), timeout=120) is None
+
+            # Actors: the dedicated worker is containerized too.
+            @ray_tpu.remote(runtime_env={"image_uri": "example.com/app:v7"})
+            class Probe:
+                def image(self):
+                    return os.environ.get("RTPU_CONTAINERIZED_IMAGE")
+
+            a = Probe.remote()
+            assert ray_tpu.get(a.image.remote(),
+                               timeout=120) == "example.com/app:v7"
+        finally:
+            ray_tpu.shutdown()
+
+    def test_bad_image_fails_task_with_diagnostic(self, tmp_path, monkeypatch):
+        """A container that cannot boot (runner exits nonzero) surfaces as a
+        TaskError naming the image after a bounded number of boot attempts —
+        never an infinite crash-fork loop with a hung client."""
+        import ray_tpu
+        from ray_tpu.core.exceptions import TaskError
+        from ray_tpu.utils.config import get_config
+
+        crasher = tmp_path / "crasher.py"
+        crasher.write_text("#!/usr/bin/env python3\nraise SystemExit(125)\n")
+        crasher.chmod(0o755)
+        monkeypatch.setenv("RTPU_CONTAINER_RUNNER", str(crasher))
+        # Fast corpse reaping so the failure budget is spent quickly.
+        monkeypatch.setenv("RTPU_WORKER_IDLE_TTL_S", "1")
+
+        ray_tpu.shutdown()
+        ray_tpu.init(address="local-cluster", num_cpus=2)
+        try:
+            @ray_tpu.remote(runtime_env={"image_uri": "no.such/image:404"})
+            def doomed():
+                return 1
+
+            with pytest.raises(TaskError) as ei:
+                ray_tpu.get(doomed.remote(), timeout=120)
+            assert "no.such/image:404" in str(ei.value)
+        finally:
+            ray_tpu.shutdown()
