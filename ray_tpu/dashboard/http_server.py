@@ -95,10 +95,12 @@ class DashboardServer:
 
             return handler
 
-        from ray_tpu.dashboard.ui import INDEX_HTML
+        from ray_tpu.dashboard.ui import static_asset
 
-        self.add_route("GET", "/",
-                       lambda p, b: (INDEX_HTML, "text/html; charset=utf-8"))
+        self.add_route("GET", "/", lambda p, b: static_asset("index.html"))
+        self.add_route("GET", "/app.js", lambda p, b: static_asset("app.js"))
+        self.add_route("GET", "/app.css",
+                       lambda p, b: static_asset("app.css"))
         self.add_route("GET", "/api/version", lambda p, b: {"version": __version__})
         self.add_route("GET", "/api/nodes", listing(state_api.list_nodes))
         self.add_route("GET", "/api/actors", listing(state_api.list_actors))
@@ -122,6 +124,18 @@ class DashboardServer:
             }
 
         self.add_route("GET", "/api/cluster_status", cluster_status)
+
+        # Per-node log browsing (reference: dashboard log_manager endpoints
+        # over the agent; here the state API proxies to node daemons).
+        self.add_route(
+            "GET", "/api/logs",
+            lambda p, b: state_api.list_logs(node_id=p.get("node_id")))
+        self.add_route(
+            "GET", "/api/logs/get",
+            lambda p, b: (state_api.get_log(
+                p["filename"], p["node_id"],
+                tail_bytes=int(p.get("tail_bytes", 65536))),
+                "text/plain; charset=utf-8"))
 
     # ------------------------------------------------------------------ lifecycle
     def start(self) -> tuple[str, int]:

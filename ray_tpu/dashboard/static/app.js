@@ -1,0 +1,383 @@
+/* ray_tpu dashboard SPA — hand-written, no build toolchain.
+ *
+ * Capability parity with the reference's React client
+ * (python/ray/dashboard/client/): live cluster state over the same JSON
+ * endpoints this server already exposes — nodes / actors / tasks /
+ * placement groups / jobs tables with auto-refresh, a per-node log viewer,
+ * and overview stat tiles with sparklines fed from polled state history.
+ */
+"use strict";
+
+const POLL_MS = 2500;
+const HISTORY = 60; // sparkline points kept per metric (~2.5 min)
+
+// ---------------------------------------------------------------- utilities
+
+async function getJSON(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(`${path}: HTTP ${r.status}`);
+  return r.json();
+}
+
+function el(tag, attrs = {}, ...children) {
+  const node = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs)) {
+    if (k === "class") node.className = v;
+    else if (k.startsWith("on")) node.addEventListener(k.slice(2), v);
+    else node.setAttribute(k, v);
+  }
+  for (const c of children) {
+    node.append(c instanceof Node ? c : document.createTextNode(String(c)));
+  }
+  return node;
+}
+
+function shortId(v) {
+  return typeof v === "string" && v.length > 14 ? v.slice(0, 12) + "…" : v;
+}
+
+const STATE_CLASS = {
+  ALIVE: "good", RUNNING: "good", FINISHED: "good", SUCCEEDED: "good",
+  CREATED: "neutral", PENDING: "warning", PENDING_CREATION: "warning",
+  QUEUED: "warning", RESTARTING: "serious", RECONSTRUCTING: "serious",
+  STOPPED: "neutral", DEAD: "critical", FAILED: "critical",
+  REMOVED: "neutral",
+};
+
+function badge(state) {
+  const cls = STATE_CLASS[state] || "neutral";
+  return el("span", { class: `badge ${cls}` }, state ?? "—");
+}
+
+// ------------------------------------------------------------- sparklines
+
+const tip = el("div", { id: "viz-tip" });
+document.body.append(tip);
+
+function sparkline(points, { width = 200, height = 36, label = "" } = {}) {
+  const svg = document.createElementNS("http://www.w3.org/2000/svg", "svg");
+  svg.setAttribute("viewBox", `0 0 ${width} ${height}`);
+  svg.setAttribute("preserveAspectRatio", "none");
+  if (points.length < 2) return svg;
+  const max = Math.max(...points, 1e-9);
+  const min = Math.min(...points, 0);
+  const span = max - min || 1;
+  const xs = points.map((_, i) => (i / (points.length - 1)) * width);
+  const ys = points.map(p => height - 3 - ((p - min) / span) * (height - 6));
+  const line = xs.map((x, i) => `${i ? "L" : "M"}${x.toFixed(1)},${ys[i].toFixed(1)}`).join("");
+  const fill = `${line}L${width},${height}L0,${height}Z`;
+  const mk = (d, cls) => {
+    const p = document.createElementNS("http://www.w3.org/2000/svg", "path");
+    p.setAttribute("d", d);
+    p.setAttribute("class", cls);
+    return p;
+  };
+  svg.append(mk(fill, "spark-fill"), mk(line, "spark-line"));
+  // hover layer: nearest-point crosshair tooltip
+  const dot = document.createElementNS("http://www.w3.org/2000/svg", "circle");
+  dot.setAttribute("r", "3");
+  dot.setAttribute("class", "spark-dot");
+  dot.style.display = "none";
+  svg.append(dot);
+  svg.addEventListener("mousemove", ev => {
+    const rect = svg.getBoundingClientRect();
+    const fx = ((ev.clientX - rect.left) / rect.width) * width;
+    let best = 0;
+    for (let i = 1; i < xs.length; i++) {
+      if (Math.abs(xs[i] - fx) < Math.abs(xs[best] - fx)) best = i;
+    }
+    dot.style.display = "";
+    dot.setAttribute("cx", xs[best]);
+    dot.setAttribute("cy", ys[best]);
+    const ago = ((points.length - 1 - best) * POLL_MS) / 1000;
+    tip.style.display = "block";
+    tip.style.left = `${ev.clientX + 12}px`;
+    tip.style.top = `${ev.clientY + 12}px`;
+    tip.textContent = `${label}: ${points[best]} (${ago.toFixed(0)}s ago)`;
+  });
+  svg.addEventListener("mouseleave", () => {
+    dot.style.display = "none";
+    tip.style.display = "none";
+  });
+  return svg;
+}
+
+// --------------------------------------------------------------- overview
+
+const history = new Map(); // metric name -> number[]
+
+function record(name, value) {
+  if (!Number.isFinite(value)) return;
+  const arr = history.get(name) || [];
+  arr.push(value);
+  while (arr.length > HISTORY) arr.shift();
+  history.set(name, arr);
+}
+
+function tile(label, value, sparkKey) {
+  const t = el("div", { class: "tile" },
+    el("div", { class: "label" }, label),
+    el("div", { class: "value" }, value));
+  const pts = history.get(sparkKey) || [];
+  t.append(sparkline(pts, { label }));
+  return t;
+}
+
+async function renderOverview(view) {
+  const [status, summary, nodes, actors] = await Promise.all([
+    getJSON("/api/cluster_status"), getJSON("/api/task_summary"),
+    getJSON("/api/nodes"), getJSON("/api/actors"),
+  ]);
+  const total = status.cluster_resources || {};
+  const avail = status.available_resources || {};
+  // summary shape: {task_name: {STATE: count, ...}, ...}
+  const byState = {};
+  for (const states of Object.values(summary || {})) {
+    for (const [s, n] of Object.entries(states)) {
+      byState[s] = (byState[s] || 0) + n;
+    }
+  }
+  const running = byState.RUNNING || 0;
+  const finished = byState.FINISHED || 0;
+  const failed = byState.FAILED || 0;
+  const aliveNodes = nodes.filter(n => n.alive).length;
+  const aliveActors = actors.filter(a => a.state === "ALIVE").length;
+  const cpuUsed = (total.CPU || 0) - (avail.CPU || 0);
+
+  record("running", running);
+  record("finished", finished);
+  record("cpu_used", cpuUsed);
+  record("actors", aliveActors);
+
+  view.replaceChildren(
+    el("h2", {}, "Cluster"),
+    el("div", { class: "tiles" },
+      tile("Tasks running", running, "running"),
+      tile("Tasks finished", finished, "finished"),
+      tile("CPUs in use", cpuUsed, "cpu_used"),
+      tile("Live actors", aliveActors, "actors")),
+    el("h2", {}, "Resources"),
+    el("div", {},
+      ...Object.keys(total).sort().map(k => {
+        const used = (total[k] || 0) - (avail[k] || 0);
+        const pct = total[k] ? (used / total[k]) * 100 : 0;
+        return el("div", { class: "resbar" },
+          el("span", { class: "name" }, k),
+          el("span", { class: "track" },
+            el("span", { class: "used", style: `width:${pct.toFixed(1)}%` })),
+          el("span", { class: "nums" },
+            `${used.toFixed(1)} / ${(total[k] || 0).toFixed(1)}`));
+      })),
+    el("h2", {}, "Health"),
+    el("div", {},
+      el("span", {}, `${aliveNodes}/${nodes.length} nodes alive · `),
+      el("span", {}, `${failed} failed tasks `),
+      failed ? badge("FAILED") : badge("ALIVE")));
+}
+
+// ----------------------------------------------------------------- tables
+
+function table(rows, columns, filterText) {
+  const needle = (filterText || "").toLowerCase();
+  const filtered = needle
+    ? rows.filter(r => JSON.stringify(r).toLowerCase().includes(needle))
+    : rows;
+  const thead = el("tr", {}, ...columns.map(c => el("th", {}, c.title)));
+  const body = filtered.map(r =>
+    el("tr", {}, ...columns.map(c => {
+      const v = c.get(r);
+      return el("td", { class: c.mono ? "mono" : "" },
+        v instanceof Node ? v : (v ?? "—"));
+    })));
+  return el("table", {}, thead, ...body);
+}
+
+const ROW_CAP = 500; // DOM rows per table; auto-refresh rebuilds every poll
+
+function tableTab(endpoint, columns) {
+  let filter = "";
+  return async view => {
+    const rows = (await getJSON(endpoint)).slice(0, ROW_CAP);
+    // Refresh in place: replacing the <input> mid-keystroke would steal
+    // focus/caret every poll, so reuse it and swap only the table.
+    let input = view.querySelector("input[type=text]");
+    if (!input) {
+      input = el("input", {
+        type: "text", placeholder: "filter…", value: filter,
+      });
+      view.replaceChildren(
+        el("div", { class: "toolbar" }, input,
+          el("span", { class: "muted" })),
+        table([], columns, ""));
+    }
+    const redraw = rs => {
+      const old = view.querySelector("table");
+      if (old) old.replaceWith(table(rs, columns, filter));
+    };
+    input.oninput = ev => {
+      filter = ev.target.value;
+      redraw(rows);
+    };
+    view.querySelector(".muted").textContent = `${rows.length} rows`;
+    redraw(rows);
+  };
+}
+
+const TABS = {
+  overview: { title: "Overview", render: renderOverview },
+  nodes: {
+    title: "Nodes",
+    render: tableTab("/api/nodes", [
+      { title: "Node", get: r => shortId(r.node_id), mono: true },
+      { title: "State", get: r => badge(r.alive ? "ALIVE" : "DEAD") },
+      { title: "Address", get: r => Array.isArray(r.addr)
+          ? r.addr.join(":") : r.addr, mono: true },
+      { title: "CPU", get: r => r.resources && r.resources.CPU },
+      { title: "TPU", get: r => r.resources && (r.resources.TPU ?? "—") },
+      { title: "Labels", get: r => JSON.stringify(r.labels || {}), mono: true },
+    ]),
+  },
+  actors: {
+    title: "Actors",
+    render: tableTab("/api/actors", [
+      { title: "Actor", get: r => shortId(r.actor_id), mono: true },
+      { title: "Name", get: r => r.name },
+      { title: "Namespace", get: r => r.namespace },
+      { title: "State", get: r => badge(r.state) },
+      { title: "Node", get: r => shortId(r.node_id), mono: true },
+      { title: "Restarts", get: r => r.restarts },
+      { title: "Death reason", get: r => r.death_reason },
+    ]),
+  },
+  tasks: {
+    title: "Tasks",
+    render: tableTab("/api/tasks?limit=500", [
+      { title: "Task", get: r => shortId(r.task_id), mono: true },
+      { title: "Name", get: r => r.name },
+      { title: "State", get: r => badge(r.state) },
+      { title: "Worker", get: r => shortId(r.worker_id), mono: true },
+      { title: "Duration", get: r => (r.start_ts && r.end_ts)
+          ? `${(r.end_ts - r.start_ts).toFixed(3)}s` : "—" },
+    ]),
+  },
+  pgs: {
+    title: "Placement Groups",
+    render: tableTab("/api/placement_groups", [
+      { title: "Group", get: r => shortId(r.placement_group_id), mono: true },
+      { title: "Name", get: r => r.name },
+      { title: "State", get: r => badge(r.state) },
+      { title: "Strategy", get: r => r.strategy },
+      { title: "Bundles", get: r => r.bundles != null
+          ? JSON.stringify(r.bundles) : "—", mono: true },
+    ]),
+  },
+  jobs: {
+    title: "Jobs",
+    render: async view => {
+      let rows = [];
+      try {
+        rows = await getJSON("/api/jobs/list");
+      } catch {
+        view.replaceChildren(
+          el("p", { class: "muted" },
+            "Job manager not running in this session."));
+        return;
+      }
+      view.replaceChildren(table(rows, [
+        { title: "Job", get: r => r.submission_id || r.job_id, mono: true },
+        { title: "Status", get: r => badge(r.status) },
+        { title: "Entrypoint", get: r => r.entrypoint, mono: true },
+        { title: "Message", get: r => r.message },
+      ]));
+    },
+  },
+  logs: {
+    title: "Logs",
+    render: async view => {
+      const nodes = await getJSON("/api/nodes");
+      const sel = el("select", {},
+        ...nodes.map(n => el("option", { value: n.node_id },
+          `${shortId(n.node_id)} (${n.alive ? "ALIVE" : "DEAD"})`)));
+      const list = el("div", { class: "loglist" });
+      const pre = el("pre", { class: "logview" }, "select a file…");
+      async function loadList() {
+        const files = await getJSON(
+          `/api/logs?node_id=${encodeURIComponent(sel.value)}`);
+        list.replaceChildren(...files.map(f =>
+          el("a", {
+            href: "#logs", onclick: async ev => {
+              ev.preventDefault();
+              const r = await fetch(
+                `/api/logs/get?node_id=${encodeURIComponent(sel.value)}` +
+                `&filename=${encodeURIComponent(f.filename || f)}`);
+              pre.textContent = await r.text();
+            },
+          }, f.filename || f)));
+      }
+      sel.addEventListener("change", loadList);
+      view.replaceChildren(
+        el("div", { class: "toolbar" }, "Node: ", sel),
+        list, pre);
+      if (nodes.length) await loadList();
+    },
+    manual: true, // no auto-refresh: would clobber an open log view
+  },
+};
+
+// ------------------------------------------------------------------ shell
+
+let active = location.hash.replace("#", "") || "overview";
+let timer = null;
+
+function nav() {
+  const tabs = document.getElementById("tabs");
+  // href navigation fires hashchange, which drives switchTab — no onclick
+  // (a second handler would double-fetch every endpoint per click).
+  tabs.replaceChildren(...Object.entries(TABS).map(([key, t]) =>
+    el("a", {
+      href: `#${key}`, class: key === active ? "active" : "",
+    }, t.title)));
+}
+
+async function refresh() {
+  const view = document.getElementById("view");
+  const conn = document.getElementById("conn");
+  try {
+    await TABS[active].render(view);
+    conn.classList.remove("down");
+    conn.title = "connected";
+  } catch (e) {
+    conn.classList.add("down");
+    conn.title = `disconnected: ${e}`;
+  }
+}
+
+function schedule() {
+  if (timer) clearInterval(timer);
+  timer = setInterval(() => {
+    if (document.getElementById("auto").checked && !TABS[active].manual) {
+      refresh();
+    }
+  }, POLL_MS);
+}
+
+function switchTab(key) {
+  active = key;
+  const view = document.getElementById("view");
+  if (view.dataset.tab !== key) {
+    view.dataset.tab = key;
+    view.replaceChildren(); // don't let tab A's widgets leak into tab B
+  }
+  nav();
+  refresh();
+  schedule();
+}
+
+window.addEventListener("hashchange", () => {
+  const key = location.hash.replace("#", "");
+  if (TABS[key]) switchTab(key);
+});
+
+nav();
+refresh();
+schedule();

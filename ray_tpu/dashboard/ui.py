@@ -1,142 +1,45 @@
-"""Dashboard web UI: a single-file HTML client over the JSON API.
+"""Dashboard web client assets.
 
-Capability parity with the reference's React dashboard client (reference:
-python/ray/dashboard/client/ — overview, nodes, actors, tasks, jobs views
-over the same JSON API). Here the client is one dependency-free page that
-polls /api/* and renders tables; it is served at "/" by the dashboard
-HTTP server (http_server.py).
+Capability parity with the reference's dashboard client
+(reference: python/ray/dashboard/client/ — a React SPA over the dashboard's
+JSON API): here a hand-written single-page app with zero build toolchain —
+``static/index.html`` + ``static/app.js`` + ``static/app.css`` — serving
+live nodes/actors/tasks/placement-group/job tables with auto-refresh, a
+per-node log viewer, and overview stat tiles with sparklines. The server
+(http_server.py) serves these files and the same /api endpoints the
+reference client consumes.
 """
 
 from __future__ import annotations
 
-INDEX_HTML = """<!doctype html>
-<html lang="en">
-<head>
-<meta charset="utf-8">
-<title>ray_tpu dashboard</title>
-<meta name="viewport" content="width=device-width, initial-scale=1">
-<style>
-  :root {
-    --bg: #ffffff; --fg: #1a1a22; --muted: #667085; --line: #e4e7ec;
-    --card: #f8fafc; --accent: #4355f9; --ok: #16a34a; --bad: #dc2626;
-  }
-  @media (prefers-color-scheme: dark) {
-    :root { --bg:#101318; --fg:#e6e8ee; --muted:#98a2b3; --line:#2a2f3a;
-            --card:#181c24; --accent:#8ba3ff; --ok:#4ade80; --bad:#f87171; }
-  }
-  * { box-sizing: border-box; }
-  body { margin:0; background:var(--bg); color:var(--fg);
-         font:14px/1.45 system-ui, sans-serif; }
-  header { display:flex; align-items:baseline; gap:12px; padding:14px 20px;
-           border-bottom:1px solid var(--line); }
-  header h1 { font-size:16px; margin:0; }
-  header .ver { color:var(--muted); font-size:12px; }
-  header .upd { margin-left:auto; color:var(--muted); font-size:12px; }
-  main { padding:16px 20px; max-width:1200px; margin:0 auto; }
-  .tiles { display:grid; grid-template-columns:repeat(auto-fit,minmax(150px,1fr));
-           gap:10px; margin-bottom:18px; }
-  .tile { background:var(--card); border:1px solid var(--line);
-          border-radius:8px; padding:10px 12px; }
-  .tile .k { color:var(--muted); font-size:12px; }
-  .tile .v { font-size:20px; font-weight:600; margin-top:2px; }
-  section { margin-bottom:22px; }
-  section h2 { font-size:13px; text-transform:uppercase; letter-spacing:.04em;
-               color:var(--muted); margin:0 0 8px; }
-  table { width:100%; border-collapse:collapse; background:var(--card);
-          border:1px solid var(--line); border-radius:8px; overflow:hidden; }
-  th, td { text-align:left; padding:6px 10px; border-bottom:1px solid var(--line);
-           font-size:13px; white-space:nowrap; overflow:hidden;
-           text-overflow:ellipsis; max-width:320px; }
-  th { color:var(--muted); font-weight:500; font-size:12px; }
-  tr:last-child td { border-bottom:none; }
-  .s-ok { color:var(--ok); } .s-bad { color:var(--bad); }
-  .empty { color:var(--muted); padding:8px 10px; font-size:13px; }
-  a { color:var(--accent); }
-</style>
-</head>
-<body>
-<header>
-  <h1>ray_tpu</h1><span class="ver" id="version"></span>
-  <span class="upd" id="updated"></span>
-</header>
-<main>
-  <div class="tiles" id="tiles"></div>
-  <section><h2>Nodes</h2><div id="nodes"></div></section>
-  <section><h2>Actors</h2><div id="actors"></div></section>
-  <section><h2>Task summary</h2><div id="tasksum"></div></section>
-  <section><h2>Placement groups</h2><div id="pgs"></div></section>
-  <section><h2>Jobs</h2><div id="jobs"></div></section>
-  <section><h2>Links</h2>
-    <div class="empty"><a href="/metrics">/metrics</a> (Prometheus) ·
-      <a href="/api/timeline">/api/timeline</a> ·
-      <a href="/api/tasks">/api/tasks</a> ·
-      <a href="/api/traces">/api/traces</a></div>
-  </section>
-</main>
-<script>
-const fmt = (x) => typeof x === "number" && !Number.isInteger(x)
-    ? x.toFixed(2) : String(x);
-// Cluster-supplied strings (actor names, job entrypoints, labels) are
-// untrusted: escape before any innerHTML insertion (stored-XSS guard).
-const esc = (s) => String(s).replace(/[&<>"']/g, (c) => ({
-  "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
-function table(el, rows, cols) {
-  const div = document.getElementById(el);
-  if (!rows || !rows.length) { div.innerHTML = '<div class="empty">none</div>'; return; }
-  let h = "<table><tr>" + cols.map(c => `<th>${esc(c[0])}</th>`).join("") + "</tr>";
-  for (const r of rows.slice(0, 50)) {
-    h += "<tr>" + cols.map(c => {
-      let v = typeof c[1] === "function" ? c[1](r) : r[c[1]];
-      if (v === undefined || v === null) v = "";
-      if (typeof v === "object") v = JSON.stringify(v);
-      const cls = /ALIVE|RUNNING|SUCCEEDED|FINISHED|true/.test(String(v)) ? "s-ok"
-                : /DEAD|FAILED|ERROR/.test(String(v)) ? "s-bad" : "";
-      return `<td class="${cls}">${esc(fmt(v))}</td>`;
-    }).join("") + "</tr>";
-  }
-  div.innerHTML = h + "</table>";
+import os
+
+_STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "static")
+
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".js": "text/javascript; charset=utf-8",
+    ".css": "text/css; charset=utf-8",
 }
-async function j(url) {
-  try { const r = await fetch(url); return r.ok ? await r.json() : null; }
-  catch (e) { return null; }
-}
-async function refresh() {
-  const [ver, status, nodes, actors, tasksum, pgs, jobs] = await Promise.all([
-    j("/api/version"), j("/api/cluster_status"), j("/api/nodes"),
-    j("/api/actors"), j("/api/task_summary"), j("/api/placement_groups"),
-    j("/api/jobs/list"),
-  ]);
-  if (ver) document.getElementById("version").textContent = "v" + ver.version;
-  const tiles = [];
-  if (status) {
-    const total = status.cluster_resources || {}, avail = status.available_resources || {};
-    for (const k of Object.keys(total)) {
-      if (k.includes("node:") || k.includes("-head")) continue;
-      tiles.push([k, `${fmt(avail[k] ?? 0)} / ${fmt(total[k])}`]);
-    }
-  }
-  if (nodes) tiles.push(["nodes", nodes.length]);
-  if (actors) tiles.push(["actors", actors.length]);
-  document.getElementById("tiles").innerHTML = tiles.map(
-    ([k, v]) => `<div class="tile"><div class="k">${esc(k)}</div><div class="v">${esc(v)}</div></div>`
-  ).join("");
-  table("nodes", nodes, [["id", "node_id"], ["state", r => r.alive ? "ALIVE" : "DEAD"],
-    ["address", r => (r.addr || []).join ? r.addr.join(":") : r.addr],
-    ["resources", "resources"], ["available", "available"], ["labels", "labels"]]);
-  table("actors", actors, [["id", "actor_id"], ["class", "class_name"],
-    ["name", "name"], ["state", "state"], ["node", "node_id"],
-    ["restarts", "num_restarts"]]);
-  const ts = tasksum ? Object.entries(tasksum).map(([k, v]) => ({state: k, count: v})) : [];
-  table("tasksum", ts, [["state", "state"], ["count", "count"]]);
-  table("pgs", pgs, [["id", "pg_id"], ["state", "state"], ["strategy", "strategy"],
-    ["bundles", "bundles"]]);
-  table("jobs", jobs, [["id", r => r.job_id || r.submission_id], ["status", "status"],
-    ["entrypoint", "entrypoint"], ["start", "start_time"], ["end", "end_time"]]);
-  document.getElementById("updated").textContent =
-      "updated " + new Date().toLocaleTimeString();
-}
-refresh(); setInterval(refresh, 3000);
-</script>
-</body>
-</html>
-"""
+
+
+def static_asset(name: str) -> tuple[str, str]:
+    """(body, content_type) for a bundled client asset."""
+    base = os.path.basename(name)  # no traversal
+    path = os.path.join(_STATIC_DIR, base)
+    with open(path, encoding="utf-8") as f:
+        body = f.read()
+    ext = os.path.splitext(base)[1]
+    return body, _CONTENT_TYPES.get(ext, "application/octet-stream")
+
+
+def index_html() -> str:
+    return static_asset("index.html")[0]
+
+
+# Back-compat alias (older callers imported the template constant).
+def __getattr__(name: str):
+    if name == "INDEX_HTML":
+        return index_html()
+    raise AttributeError(name)

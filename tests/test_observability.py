@@ -235,11 +235,25 @@ class TestDashboard:
             assert ctype == "text/plain"
             _, body = get("/api/timeline")
             assert isinstance(json.loads(body), list)
-            # web UI at the root: html that targets the JSON API routes
+            # web UI at the root: an SPA shell that loads the app module
             ctype, body = get("/")
             assert ctype == "text/html"
             page = body.decode()
-            assert "/api/cluster_status" in page and "</html>" in page
+            assert "/app.js" in page and "</html>" in page
+            ctype, body = get("/app.js")
+            assert ctype == "text/javascript"
+            app = body.decode()
+            # the client drives the same JSON API surface
+            for ep in ("/api/cluster_status", "/api/nodes", "/api/actors",
+                       "/api/tasks", "/api/placement_groups",
+                       "/api/jobs/list", "/api/logs"):
+                assert ep in app, ep
+            ctype, _ = get("/app.css")
+            assert ctype == "text/css"
+            # per-node log endpoints exist (cluster mode returns data; the
+            # in-process runtime yields an empty listing)
+            _, body = get("/api/logs")
+            assert json.loads(body) == []
         finally:
             srv.stop()
 
