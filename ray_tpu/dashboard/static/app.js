@@ -123,11 +123,18 @@ function tile(label, value, sparkKey) {
   return t;
 }
 
-async function renderOverview(view) {
+// Internal scheduling markers, not schedulable resources: PG-derived keys
+// and accelerator head/host markers must not render as utilization bars.
+function isMarkerResource(key) {
+  return /(^node:)|(^bundle_)|(_pg_)|(-head$)/.test(key);
+}
+
+async function renderOverview(view, key) {
   const [status, summary, nodes, actors] = await Promise.all([
     getJSON("/api/cluster_status"), getJSON("/api/task_summary"),
     getJSON("/api/nodes"), getJSON("/api/actors"),
   ]);
+  if (view.dataset.tab !== key) return; // stale render: tab changed
   const total = status.cluster_resources || {};
   const avail = status.available_resources || {};
   // summary shape: {task_name: {STATE: count, ...}, ...}
@@ -158,7 +165,7 @@ async function renderOverview(view) {
       tile("Live actors", aliveActors, "actors")),
     el("h2", {}, "Resources"),
     el("div", {},
-      ...Object.keys(total).sort().map(k => {
+      ...Object.keys(total).filter(k => !isMarkerResource(k)).sort().map(k => {
         const used = (total[k] || 0) - (avail[k] || 0);
         const pct = total[k] ? (used / total[k]) * 100 : 0;
         return el("div", { class: "resbar" },
@@ -192,12 +199,15 @@ function table(rows, columns, filterText) {
   return el("table", {}, thead, ...body);
 }
 
-const ROW_CAP = 500; // DOM rows per table; auto-refresh rebuilds every poll
+const ROW_CAP = 500; // rows per table; server-side limited AND DOM-capped
 
 function tableTab(endpoint, columns) {
+  const sep = endpoint.includes("?") ? "&" : "?";
+  const url = `${endpoint}${sep}limit=${ROW_CAP}`;
   let filter = "";
-  return async view => {
-    const rows = (await getJSON(endpoint)).slice(0, ROW_CAP);
+  return async (view, key) => {
+    const rows = (await getJSON(url)).slice(0, ROW_CAP);
+    if (view.dataset.tab !== key) return; // stale render: tab changed
     // Refresh in place: replacing the <input> mid-keystroke would steal
     // focus/caret every poll, so reuse it and swap only the table.
     let input = view.querySelector("input[type=text]");
@@ -218,7 +228,8 @@ function tableTab(endpoint, columns) {
       filter = ev.target.value;
       redraw(rows);
     };
-    view.querySelector(".muted").textContent = `${rows.length} rows`;
+    view.querySelector(".muted").textContent =
+      rows.length >= ROW_CAP ? `first ${ROW_CAP} rows` : `${rows.length} rows`;
     redraw(rows);
   };
 }
@@ -251,7 +262,7 @@ const TABS = {
   },
   tasks: {
     title: "Tasks",
-    render: tableTab("/api/tasks?limit=500", [
+    render: tableTab("/api/tasks", [
       { title: "Task", get: r => shortId(r.task_id), mono: true },
       { title: "Name", get: r => r.name },
       { title: "State", get: r => badge(r.state) },
@@ -273,16 +284,18 @@ const TABS = {
   },
   jobs: {
     title: "Jobs",
-    render: async view => {
+    render: async (view, key) => {
       let rows = [];
       try {
         rows = await getJSON("/api/jobs/list");
       } catch {
+        if (view.dataset.tab !== key) return;
         view.replaceChildren(
           el("p", { class: "muted" },
             "Job manager not running in this session."));
         return;
       }
+      if (view.dataset.tab !== key) return; // stale render: tab changed
       view.replaceChildren(table(rows, [
         { title: "Job", get: r => r.submission_id || r.job_id, mono: true },
         { title: "Status", get: r => badge(r.status) },
@@ -293,8 +306,9 @@ const TABS = {
   },
   logs: {
     title: "Logs",
-    render: async view => {
+    render: async (view, key) => {
       const nodes = await getJSON("/api/nodes");
+      if (view.dataset.tab !== key) return; // stale render: tab changed
       const sel = el("select", {},
         ...nodes.map(n => el("option", { value: n.node_id },
           `${shortId(n.node_id)} (${n.alive ? "ALIVE" : "DEAD"})`)));
@@ -326,8 +340,10 @@ const TABS = {
 
 // ------------------------------------------------------------------ shell
 
-let active = location.hash.replace("#", "") || "overview";
+const initialHash = location.hash.replace("#", "");
+let active = TABS[initialHash] ? initialHash : "overview";
 let timer = null;
+let inFlightTab = null;
 
 function nav() {
   const tabs = document.getElementById("tabs");
@@ -340,15 +356,24 @@ function nav() {
 }
 
 async function refresh() {
+  // Single-flight PER TAB: a slow poll must not stack on itself, but a
+  // tab switch may start rendering immediately (the stale-render guards
+  // make the superseded render a no-op).
+  const tab = active;
+  if (inFlightTab === tab) return;
+  inFlightTab = tab;
   const view = document.getElementById("view");
   const conn = document.getElementById("conn");
+  if (!view.dataset.tab) view.dataset.tab = tab;
   try {
-    await TABS[active].render(view);
+    await TABS[tab].render(view, tab);
     conn.classList.remove("down");
     conn.title = "connected";
   } catch (e) {
     conn.classList.add("down");
     conn.title = `disconnected: ${e}`;
+  } finally {
+    if (inFlightTab === tab) inFlightTab = null;
   }
 }
 
