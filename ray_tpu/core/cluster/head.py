@@ -86,6 +86,9 @@ class HeadServer:
         # (reference: GCS backed by redis_store_client.cc; raylets
         # reconnect via HandleNotifyGCSRestart, node_manager.cc:1050).
         self._persist_path = persist_path
+        # Bumped on every node add/death/drain; heartbeat replies ship the
+        # peer map only to daemons whose seen version is stale.
+        self._membership_version = 0
         self._dirty = False
         self._persist_task: asyncio.Task | None = None
         self._write_fut = None  # in-flight executor write, if any
@@ -392,12 +395,14 @@ class HeadServer:
         )
         conn.meta["node_id"] = node_id
         self._node_conns[node_id] = conn
+        self._membership_version += 1
         await self.publish("node_events", event="added", node_id=node_id)
         return {"ok": True}
 
     async def _heartbeat(self, conn: ServerConnection, node_id: str, available: dict,
                          resources: dict | None = None,
-                         pending_demands: list | None = None):
+                         pending_demands: list | None = None,
+                         peers_version: int = -1):
         info = self.nodes.get(node_id)
         if info is None:
             return {"ok": False, "reregister": True}
@@ -407,7 +412,20 @@ class HeadServer:
         if resources is not None:
             info.resources = resources  # totals change as PG bundles commit
         info.pending_demands = pending_demands or []
-        return {"ok": True}
+        # Membership piggyback, VERSIONED: daemons seed their peer-gossip
+        # rings from this (the head stays the membership authority; VIEW
+        # dissemination rides daemon-to-daemon gossip — reference:
+        # ray_syncer.h bidi streams take resource-view fan-out off the
+        # GCS's back). The peer map is only shipped when membership
+        # actually changed — otherwise every heartbeat would carry an
+        # O(n) map, O(n^2) head egress per period.
+        out = {"ok": True, "membership_version": self._membership_version}
+        if peers_version != self._membership_version:
+            out["peers"] = {
+                nid: list(n.addr) for nid, n in self.nodes.items()
+                if n.alive and nid != node_id
+            }
+        return out
 
     async def _drain_node(self, conn: ServerConnection, node_id: str):
         # Graceful removal (reference: NodeManager::HandleDrainRaylet :2129).
@@ -415,6 +433,7 @@ class HeadServer:
         if info:
             info.alive = False
             self._drop_daemon_client(node_id)
+            self._membership_version += 1
             await self.publish("node_events", event="removed", node_id=node_id)
         return {"ok": True}
 
@@ -440,6 +459,7 @@ class HeadServer:
                 if node.alive and now - node.last_heartbeat > threshold:
                     node.alive = False
                     self._drop_daemon_client(node.node_id)
+                    self._membership_version += 1
                     await self.publish("node_events", event="died", node_id=node.node_id)
                     await self._fail_actors_on_node(node.node_id)
 

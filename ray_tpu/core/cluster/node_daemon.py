@@ -77,6 +77,22 @@ class NodeDaemon:
         # env_hash -> consecutive boot failures of its container workers
         # (cleared on a successful registration).
         self._container_fails: dict[str, int] = {}
+        # Peer-gossiped resource views (reference: src/ray/ray_syncer/ —
+        # resource-view dissemination scales peer-to-peer instead of
+        # fanning every update through the control plane). node_id ->
+        # {version: [epoch, counter], available, resources, addr, ts}.
+        # MEMBERSHIP is head-authoritative: the heartbeat reply piggybacks
+        # the alive-peer map (versioned, shipped only on change) which
+        # REPLACES _gossip_peers wholesale — dead/drained nodes fall out of
+        # both the ring and the view on the next heartbeat.
+        self._gossip_view: dict[str, dict] = {}
+        self._gossip_peers: dict[str, tuple[str, int]] = {}
+        self._gossip_peers_version = -1
+        self._gossip_clients: dict[tuple[str, int], AsyncRpcClient] = {}
+        # Version epoch = wall clock at daemon start: a restarted daemon's
+        # fresh entries must beat its pre-restart versions cached at peers.
+        self._gossip_epoch = time.time()
+        self._gossip_counter = 0
         self._pending: list[_PendingLease] = []
         self._head: AsyncRpcClient | None = None
         self._leases: dict[str, WorkerProc] = {}
@@ -131,6 +147,7 @@ class NodeDaemon:
         r("list_logs", self._list_logs)
         r("tail_log", self._tail_log)
         r("prestart_workers", self._prestart_workers)
+        r("gossip", self._handle_gossip)
 
     async def _prestart_workers(self, conn, n: int = 0):
         """Warm the worker pool ahead of demand (reference:
@@ -203,11 +220,18 @@ class NodeDaemon:
         loop = asyncio.get_running_loop()
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._reap_loop()))
+        self._bg.append(loop.create_task(self._gossip_loop()))
         return addr
 
     async def stop(self):
         for t in self._bg:
             t.cancel()
+        for cli in list(self._gossip_clients.values()):
+            try:
+                await cli.close()
+            except Exception:
+                pass
+        self._gossip_clients.clear()
         for w in list(self.workers.values()) + self._unregistered:
             try:
                 w.proc.terminate()
@@ -338,20 +362,127 @@ class NodeDaemon:
         cfg = get_config()
         while True:
             try:
-                await self._head.call(
+                res = await self._head.call(
                     "heartbeat", node_id=self.node_id,
                     available=self.available, resources=self.resources,
                     # Pending lease demands feed the autoscaler (reference:
                     # raylet reports resource load to GcsResourceManager for
                     # GcsAutoscalerStateManager).
                     pending_demands=[r.resources for r in self._pending
-                                     if not r.fut.done()])
+                                     if not r.fut.done()],
+                    peers_version=self._gossip_peers_version)
+                # Authoritative membership for the gossip ring (view data
+                # itself travels daemon-to-daemon, not through the head):
+                # wholesale replacement prunes dead/drained nodes from the
+                # ring AND evicts their stale view entries.
+                if "peers" in res:
+                    self._gossip_peers = {
+                        nid: tuple(addr)
+                        for nid, addr in (res["peers"] or {}).items()}
+                    self._gossip_peers_version = res.get(
+                        "membership_version", -1)
+                    for nid in list(self._gossip_view):
+                        if nid not in self._gossip_peers:
+                            self._gossip_view.pop(nid, None)
             except Exception:
                 # Head down/restarted: reconnect and re-register so a
                 # restarted control plane rebuilds its node view (reference:
                 # raylet HandleNotifyGCSRestart, node_manager.cc:1050).
                 await self._reconnect_head()
             await asyncio.sleep(cfg.health_check_period_s / 2)
+
+    # ---------------------------------------------------------------- gossip
+    # Peer resource-view dissemination (reference: src/ray/ray_syncer/
+    # ray_syncer.h:91 — versioned per-node view messages over bidi streams;
+    # here: periodic push-pull anti-entropy rounds between random peers).
+    # The head remains the MEMBERSHIP authority; the VIEW used for spillback
+    # decisions converges peer-to-peer, so at scale the head no longer
+    # mediates every load-balancing read.
+    GOSSIP_FANOUT = 2
+    GOSSIP_TTL_ROUNDS = 6  # entries older than this many periods go stale
+
+    def _own_gossip_entry(self) -> dict:
+        self._gossip_counter += 1
+        return {
+            "version": [self._gossip_epoch, self._gossip_counter],
+            "available": dict(self.available),
+            "resources": dict(self.resources),
+            "addr": [self.rpc.host, self.rpc.port],
+            "ts": time.monotonic(),
+        }
+
+    def _merge_gossip(self, view: dict) -> None:
+        now = time.monotonic()
+        for nid, entry in view.items():
+            if nid == self.node_id:
+                continue
+            if self._gossip_peers and nid not in self._gossip_peers:
+                continue  # not in head-authoritative membership: ignore
+            have = self._gossip_view.get(nid)
+            if have is None or tuple(entry["version"]) > \
+                    tuple(have["version"]):
+                entry = dict(entry)
+                entry["ts"] = now  # receipt time; sender clocks don't align
+                self._gossip_view[nid] = entry
+
+    async def _handle_gossip(self, conn, view: dict):
+        """Push-pull exchange: merge the caller's view, reply with ours."""
+        self._merge_gossip(view)
+        out = dict(self._gossip_view)
+        out[self.node_id] = self._own_gossip_entry()
+        return {"view": out}
+
+    async def _gossip_loop(self):
+        import random
+
+        cfg = get_config()
+        period = cfg.health_check_period_s / 2
+        while True:
+            await asyncio.sleep(period)
+            peers = [(nid, addr) for nid, addr in self._gossip_peers.items()
+                     if nid != self.node_id]
+            if not peers:
+                continue
+            view = dict(self._gossip_view)
+            view[self.node_id] = self._own_gossip_entry()
+            for nid, addr in random.sample(
+                    peers, min(self.GOSSIP_FANOUT, len(peers))):
+                try:
+                    cli = self._gossip_clients.get(addr)
+                    if cli is None:
+                        cli = AsyncRpcClient(*addr)
+                        await cli.connect()
+                        self._gossip_clients[addr] = cli
+                    res = await cli.call("gossip", view=view, timeout=5)
+                    self._merge_gossip(res.get("view") or {})
+                except Exception:
+                    # Unreachable peer: drop the cached client; the entry
+                    # ages out via GOSSIP_TTL_ROUNDS.
+                    cli = self._gossip_clients.pop(addr, None)
+                    if cli is not None:
+                        try:
+                            await cli.close()
+                        except Exception:
+                            pass
+
+    def _gossip_nodes_view(self) -> dict | None:
+        """The gossiped cluster view in list_nodes shape (None when the
+        ring hasn't converged yet — callers fall back to the head)."""
+        if not self._gossip_view:
+            return None
+        cfg = get_config()
+        ttl = (cfg.health_check_period_s / 2) * self.GOSSIP_TTL_ROUNDS
+        now = time.monotonic()
+        out = {}
+        for nid, e in self._gossip_view.items():
+            out[nid] = {
+                "addr": list(e["addr"]),
+                "resources": e["resources"],
+                "available": e["available"],
+                "alive": (now - e["ts"]) < ttl,
+                "labels": {},
+            }
+        return out
 
     async def _reconnect_head(self) -> None:
         try:
@@ -394,11 +525,11 @@ class NodeDaemon:
                              timeout: float | None = None, env_hash: str = "",
                              allow_spill: bool = True):
         if not self._feasible(resources):
-            # Spillback: find a feasible node from the head's view
-            # (reference: cluster_lease_manager spills to best remote node).
+            # Spillback: find a feasible node from the gossiped peer view
+            # (head fallback while the ring converges) — reference:
+            # cluster_lease_manager spills to best remote node.
             if allow_spill:
-                nodes = await self._head.call("list_nodes")
-                best = self._spill_target(nodes, resources, key="resources")
+                best = await self._find_spill(resources, key="resources")
                 if best is not None:
                     return {"spill": best}
             return {"error": f"infeasible resource demand {resources}"}
@@ -432,19 +563,31 @@ class NodeDaemon:
             # grants (each hop re-queues behind a fresh worker start).
             if not allow_spill or self._fits(req.resources):
                 continue
-            try:
-                nodes = await self._head.call("list_nodes")
-            except Exception:
-                continue
-            if fut.done():  # granted while we were asking the head
+            best = await self._find_spill(resources, key="available")
+            if fut.done():  # granted while we were looking
                 return fut.result()
-            best = self._spill_target(nodes, resources, key="available")
             if best is not None:
                 # No await between the done-check and removal: the grant
                 # path runs on this loop, so this hand-off is atomic.
                 self._pending = [p for p in self._pending if p is not req]
                 fut.cancel()
                 return {"spill": best}
+
+    async def _find_spill(self, resources: dict, key: str) -> list | None:
+        """Spill target from the gossiped peer view first (no head
+        round-trip on the hot path); a gossip MISS still consults the head
+        — a partial or stale ring must not hide a feasible node the head
+        knows about."""
+        nodes = self._gossip_nodes_view()
+        if nodes is not None:
+            best = self._spill_target(nodes, resources, key=key)
+            if best is not None:
+                return best
+        try:
+            nodes = await self._head.call("list_nodes")
+        except Exception:
+            return None
+        return self._spill_target(nodes, resources, key=key)
 
     def _spill_target(self, nodes: dict, resources: dict,
                       key: str) -> list | None:

@@ -810,3 +810,57 @@ def test_promoted_relay_copy_is_pinned():
         rt_b.shutdown()
         rt_owner.shutdown()
         c.shutdown()
+
+
+def test_gossip_resource_view_converges_and_spills():
+    """Resource views disseminate daemon-to-daemon (reference:
+    src/ray/ray_syncer/ bidi-stream view sync — the head seeds MEMBERSHIP
+    only): every daemon converges to a full peer view, and spillback
+    decisions use the gossiped view without a head list_nodes round-trip."""
+    c = Cluster()
+    d1 = c.add_node(num_cpus=1, node_id="gsp-1")
+    d2 = c.add_node(num_cpus=4, node_id="gsp-2")
+    d3 = c.add_node(num_cpus=2, node_id="gsp-3")
+    try:
+        # 1. convergence: each daemon's gossiped view covers all peers.
+        deadline = time.monotonic() + 20
+        daemons = {"gsp-1": d1, "gsp-2": d2, "gsp-3": d3}
+        while time.monotonic() < deadline:
+            ok = all(
+                set(d._gossip_view) >= (set(daemons) - {nid})
+                for nid, d in daemons.items())
+            if ok:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                {nid: sorted(d._gossip_view) for nid, d in daemons.items()})
+        # availability data rode the ring, not the head
+        view = d1._gossip_nodes_view()
+        assert view["gsp-2"]["resources"]["CPU"] == 4.0
+        assert view["gsp-2"]["alive"] and view["gsp-3"]["alive"]
+
+        # 2. spillback resolves from the gossiped view even when the head
+        # cannot answer list_nodes.
+        orig_call = d1._head.call
+
+        async def no_list_nodes(method, **kw):
+            if method == "list_nodes":
+                raise RuntimeError("head view unavailable (simulated)")
+            return await orig_call(method, **kw)
+
+        d1._head.call = no_list_nodes
+        try:
+            rt = c.connect(d1)
+            try:
+                res = rt._io.run(d1._request_lease(
+                    None, {"CPU": 3.0}, timeout=5))
+                # gsp-1 (1 CPU) can't fit 3 CPUs; gossip view says gsp-2 can.
+                assert res.get("spill"), res
+                assert tuple(res["spill"]) == (d2.rpc.host, d2.rpc.port)
+            finally:
+                rt.shutdown()
+        finally:
+            d1._head.call = orig_call
+    finally:
+        c.shutdown()
