@@ -47,6 +47,7 @@ from ray_tpu.data.datasource import (
     ParquetDatasource,
     RangeDatasource,
     ReadTask,
+    TFRecordDatasource,
 )
 
 
@@ -88,6 +89,15 @@ def read_images(paths, *, size: tuple[int, int] | None = None,
     ray.data.read_images / datasource/image_datasource.py)."""
     return Dataset([Read(ImageDatasource(paths, size=size, mode=mode),
                          parallelism)])
+
+
+def read_tfrecords(paths, *, raw: bool = False,
+                   validate_data_crc: bool = False,
+                   parallelism: int = -1) -> Dataset:
+    """tf.train.Example records as columns (reference:
+    ray.data.read_tfrecords) — decoded without a tensorflow dependency."""
+    return Dataset([Read(TFRecordDatasource(
+        paths, raw=raw, validate_data_crc=validate_data_crc), parallelism)])
 
 
 def from_pandas(df) -> Dataset:
@@ -162,6 +172,7 @@ __all__ = [
     "read_binary_files",
     "read_csv",
     "read_images",
+    "read_tfrecords",
     "read_datasource",
     "read_json",
     "read_numpy",

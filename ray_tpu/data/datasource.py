@@ -228,6 +228,38 @@ class ImageDatasource(FileDatasource):
         return {"image": img_col, "path": pcol}
 
 
+class TFRecordDatasource(FileDatasource):
+    """tf.train.Example records decoded into columns (reference:
+    datasource/tfrecords_datasource.py) — no tensorflow dependency, the
+    framing + proto wire format are parsed directly (data/tfrecord.py).
+    ``raw=True`` skips Example parsing and yields one ``data`` bytes
+    column (arbitrary payloads, e.g. serialized tensors)."""
+
+    suffixes = (".tfrecord", ".tfrecords")
+
+    def __init__(self, paths, raw: bool = False,
+                 validate_data_crc: bool = False):
+        super().__init__(paths)
+        self._raw = raw
+        self._validate = validate_data_crc
+
+    def read_file(self, path: str) -> Block:
+        from ray_tpu.data.tfrecord import (
+            example_rows_to_block,
+            parse_example,
+            read_records,
+        )
+
+        records = list(read_records(path,
+                                    validate_data_crc=self._validate))
+        if self._raw:
+            col = np.empty(len(records), object)
+            for i, r in enumerate(records):
+                col[i] = r
+            return {"data": col}
+        return example_rows_to_block([parse_example(r) for r in records])
+
+
 # ---------------------------------------------------------------------------
 # write tasks
 

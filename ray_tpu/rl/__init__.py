@@ -9,12 +9,13 @@ process shapes but the policy/value networks, GAE, and the PPO update are
 pure JAX (jit-compiled, mesh-shardable) instead of torch.
 """
 
-from ray_tpu.rl.env import CartPoleEnv, VectorEnv, make_env
+from ray_tpu.rl.env import CartPoleEnv, PendulumEnv, VectorEnv, make_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.bc import BC, BCConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.impala import IMPALA, ImpalaConfig
 from ray_tpu.rl.multi_agent import (
+    ChaseGame,
     CoordinationGame,
     MultiAgentEnv,
     MultiAgentEnvRunner,
@@ -22,15 +23,17 @@ from ray_tpu.rl.multi_agent import (
     MultiAgentPPOConfig,
 )
 from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.sac import SAC, SACConfig
 from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
 
 __all__ = [
-    "CartPoleEnv", "VectorEnv", "make_env",
+    "CartPoleEnv", "PendulumEnv", "VectorEnv", "make_env",
     "EnvRunner", "EnvRunnerGroup",
     "PPO", "PPOConfig",
+    "SAC", "SACConfig",
     "DQN", "DQNConfig",
     "IMPALA", "ImpalaConfig",
-    "MultiAgentEnv", "MultiAgentEnvRunner", "CoordinationGame",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "CoordinationGame", "ChaseGame",
     "MultiAgentPPO", "MultiAgentPPOConfig",
     "BC", "BCConfig",
     "ReplayBuffer", "PrioritizedReplayBuffer",

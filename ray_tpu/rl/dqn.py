@@ -149,12 +149,15 @@ class DQN(Trainable):
         samples = self.runners.sample((self.params, self._epsilon()))
         for s in samples:
             T, N = s["rewards"].shape
-            next_obs = np.concatenate(
-                [s["obs"][1:], s["last_obs"][None]], axis=0)
+            # next_obs carries the TRUE pre-reset successors (truncation
+            # bootstrapping must target V(final state), not V(reset state)).
             self.buffer.add_batch(
                 s["obs"].reshape(T * N, -1), s["actions"].reshape(-1),
-                s["rewards"].reshape(-1), next_obs.reshape(T * N, -1),
-                s["dones"].reshape(-1).astype(np.float32))
+                s["rewards"].reshape(-1),
+                s["next_obs"].reshape(T * N, -1),
+                # True terminations only: TD targets bootstrap through
+                # time-limit truncations (term/trunc split).
+                s["terminals"].reshape(-1).astype(np.float32))
             self.env_steps += T * N
             self._return_window.extend(s["episode_returns"])
 

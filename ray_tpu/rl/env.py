@@ -63,7 +63,57 @@ class CartPoleEnv:
         return (self._state.astype(np.float32), 1.0, terminated, truncated)
 
 
-_ENV_REGISTRY = {"CartPole-v1": CartPoleEnv}
+class PendulumEnv:
+    """Pendulum-v1 physics: swing up and balance with bounded torque.
+
+    Continuous control: obs = [cos th, sin th, th_dot], action = torque in
+    [-2, 2]; reward = -(th^2 + 0.1 th_dot^2 + 0.001 a^2); 200-step
+    episodes (no termination). Same constants as the gym classic."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    observation_size = 3
+    action_size = 1
+    continuous = True
+    action_limit = MAX_TORQUE  # |action| bound, part of the env protocol
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._th = 0.0
+        self._th_dot = 0.0
+        self._steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._th), np.sin(self._th), self._th_dot],
+                        np.float32)
+
+    def reset(self) -> np.ndarray:
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._th_dot = self._rng.uniform(-1.0, 1.0)
+        self._steps = 0
+        return self._obs()
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.MAX_TORQUE, self.MAX_TORQUE))
+        th = ((self._th + np.pi) % (2 * np.pi)) - np.pi  # angle-normalize
+        cost = th**2 + 0.1 * self._th_dot**2 + 0.001 * u**2
+        self._th_dot += (3 * self.G / (2 * self.L) * np.sin(self._th)
+                         + 3.0 / (self.M * self.L**2) * u) * self.DT
+        self._th_dot = float(np.clip(self._th_dot, -self.MAX_SPEED,
+                                     self.MAX_SPEED))
+        self._th += self._th_dot * self.DT
+        self._steps += 1
+        return self._obs(), -float(cost), False, self._steps >= self.MAX_STEPS
+
+
+_ENV_REGISTRY = {"CartPole-v1": CartPoleEnv, "Pendulum-v1": PendulumEnv}
 
 
 def register_env(name: str, ctor) -> None:
@@ -86,6 +136,7 @@ class VectorEnv:
         self.num_envs = num_envs
         self.episode_returns = np.zeros(num_envs)
         self.completed_returns: list[float] = []
+        self.last_terminals = np.zeros(num_envs, np.bool_)
 
     def reset(self) -> np.ndarray:
         self.episode_returns[:] = 0.0
@@ -93,10 +144,13 @@ class VectorEnv:
 
     def step(self, actions: np.ndarray):
         obs, rewards, dones = [], [], []
+        terms, finals = [], []
         for i, (env, a) in enumerate(zip(self.envs, actions)):
-            o, r, term, trunc = env.step(int(a))
+            o, r, term, trunc = env.step(
+                a if getattr(env, "continuous", False) else int(a))
             self.episode_returns[i] += r
             done = term or trunc
+            final = o  # the TRUE successor obs, before any auto-reset
             if done:
                 self.completed_returns.append(self.episode_returns[i])
                 self.episode_returns[i] = 0.0
@@ -104,6 +158,14 @@ class VectorEnv:
             obs.append(o)
             rewards.append(r)
             dones.append(done)
+            terms.append(term)
+            finals.append(final)
+        # TD targets must bootstrap THROUGH time-limit truncations (only
+        # true terminations have zero future value) — gym's term/trunc
+        # split. last_final_obs carries the pre-reset successor obs so the
+        # truncation bootstrap targets V(final state), not V(reset state).
+        self.last_terminals = np.asarray(terms, np.bool_)
+        self.last_final_obs = np.stack(finals).astype(np.float32)
         return (np.stack(obs), np.asarray(rewards, np.float32),
                 np.asarray(dones, np.bool_))
 

@@ -40,23 +40,32 @@ class EnvRunner:
         bootstrap values the learner's GAE needs."""
         T, N = self.rollout_len, self.vec.num_envs
         obs_b = np.zeros((T, N, self.obs.shape[-1]), np.float32)
-        act_b = np.zeros((T, N), np.int32)
+        act_b = None  # allocated from the first action batch: discrete
+        # policies emit [N] ints, continuous ones [N, act_dim] floats
         logp_b = np.zeros((T, N), np.float32)
         val_b = np.zeros((T, N), np.float32)
         rew_b = np.zeros((T, N), np.float32)
         done_b = np.zeros((T, N), np.bool_)
+        term_b = np.zeros((T, N), np.bool_)
+        next_obs_b = np.zeros((T, N, self.obs.shape[-1]), np.float32)
         for t in range(T):
             self._step += 1
             actions, logp, value = self.act_fn(self.params, self.obs,
                                                self._seed * 100_003 + self._step)
+            if act_b is None:
+                act_b = np.zeros((T,) + np.shape(actions),
+                                 np.asarray(actions).dtype)
             obs_b[t] = self.obs
             act_b[t], logp_b[t], val_b[t] = actions, logp, value
             self.obs, rew_b[t], done_b[t] = self.vec.step(actions)
+            term_b[t] = self.vec.last_terminals
+            next_obs_b[t] = self.vec.last_final_obs  # pre-reset successors
         _, _, last_value = self.act_fn(self.params, self.obs,
                                        self._seed * 100_003 + self._step + 1)
         return {
             "obs": obs_b, "actions": act_b, "logp": logp_b, "values": val_b,
-            "rewards": rew_b, "dones": done_b, "last_values": last_value,
+            "rewards": rew_b, "dones": done_b, "terminals": term_b,
+            "next_obs": next_obs_b, "last_values": last_value,
             "last_obs": np.asarray(self.obs, np.float32),  # for 1-step targets
             "episode_returns": self.vec.drain_episode_returns(),
         }

@@ -81,10 +81,80 @@ class CoordinationGame(MultiAgentEnv):
         return self._obs(), rewards, dones
 
 
+class ChaseGame(MultiAgentEnv):
+    """Mixed cooperative-competitive pursuit on a ring (the predator-prey
+    shape of rllib's multi-agent examples): two predators share a team
+    objective — corner the prey — while the prey's reward is zero-sum
+    against them. Exercises heterogeneous policies (predator vs prey
+    objectives), one policy serving MULTIPLE agent slots, and true
+    terminations (capture) alongside time-limit truncation.
+
+    Ring of ``size`` cells; actions {left, stay, right}. Capture (any
+    predator on the prey's cell): predators +5, prey -5, episode ends.
+    Per step: predators -0.05 (time pressure), prey +0.05 (survival)."""
+
+    agent_ids = ("pred0", "pred1", "prey")
+    observation_size = 5
+    num_actions = 3
+
+    def __init__(self, size: int = 12, horizon: int = 64, seed: int = 0):
+        self.size = size
+        self.horizon = horizon
+        self._rng = np.random.default_rng(seed)
+        self._pos = {a: 0 for a in self.agent_ids}
+        self._t = 0
+        self.captures = 0
+        self.episodes = 0
+
+    def _rel(self, a: str, b: str) -> tuple[float, float]:
+        ang = 2 * np.pi * (self._pos[b] - self._pos[a]) / self.size
+        return np.sin(ang), np.cos(ang)
+
+    def _obs(self) -> dict[str, np.ndarray]:
+        frac = self._t / self.horizon
+        out = {}
+        for a in self.agent_ids:
+            others = [x for x in self.agent_ids if x != a]
+            feats = []
+            for o in others:
+                feats.extend(self._rel(a, o))
+            feats.append(frac)
+            out[a] = np.asarray(feats, np.float32)
+        return out
+
+    def reset(self) -> dict[str, np.ndarray]:
+        self._t = 0
+        cells = self._rng.choice(self.size, size=3, replace=False)
+        for a, c in zip(self.agent_ids, cells):
+            self._pos[a] = int(c)
+        return self._obs()
+
+    def step(self, actions: dict[str, int]):
+        self._t += 1
+        for a in self.agent_ids:
+            self._pos[a] = (self._pos[a] + int(actions[a]) - 1) % self.size
+        caught = (self._pos["prey"] == self._pos["pred0"]
+                  or self._pos["prey"] == self._pos["pred1"])
+        if caught:
+            rewards = {"pred0": 5.0, "pred1": 5.0, "prey": -5.0}
+        else:
+            rewards = {"pred0": -0.05, "pred1": -0.05, "prey": 0.05}
+        done = caught or self._t >= self.horizon
+        if done:
+            self.episodes += 1
+            if caught:
+                self.captures += 1
+        dones = {a: done for a in self.agent_ids}
+        dones["__all__"] = done
+        return self._obs(), rewards, dones
+
+
 def make_multi_agent_env(name: str, seed: int = 0,
                          **kwargs) -> MultiAgentEnv:
     if name == "CoordinationGame":
         return CoordinationGame(seed=seed, **kwargs)
+    if name == "ChaseGame":
+        return ChaseGame(seed=seed, **kwargs)
     raise ValueError(f"unknown multi-agent env {name!r}")
 
 
@@ -110,6 +180,8 @@ class MultiAgentEnvRunner:
         self._obs = self.env.reset()
         self._episode_return = 0.0
         self._episode_returns: list[float] = []
+        self._agent_return = {a: 0.0 for a in self.env.agent_ids}
+        self._agent_returns: list[dict[str, float]] = []
         # Fixed slot order per policy: [T, K] batches need stable columns.
         self._slots: dict[str, list[str]] = {}
         for agent in self.env.agent_ids:
@@ -148,6 +220,8 @@ class MultiAgentEnvRunner:
                     actions[agent] = int(a[k])
             self._obs, rewards, dones = env.step(actions)
             self._episode_return += float(np.mean(list(rewards.values())))
+            for a, r in rewards.items():
+                self._agent_return[a] += float(r)
             for pid, agents in self._slots.items():
                 b = out[pid]
                 b["rewards"][t] = [rewards[a] for a in agents]
@@ -155,6 +229,8 @@ class MultiAgentEnvRunner:
             if dones.get("__all__"):
                 self._episode_returns.append(self._episode_return)
                 self._episode_return = 0.0
+                self._agent_returns.append(dict(self._agent_return))
+                self._agent_return = {a: 0.0 for a in env.agent_ids}
                 self._obs = env.reset()
         # Bootstrap values from the current obs under each policy.
         for pid, agents in self._slots.items():
@@ -164,6 +240,8 @@ class MultiAgentEnvRunner:
             out[pid]["last_values"] = np.asarray(last_v, np.float32)
         out["__episode_returns__"] = self._episode_returns
         self._episode_returns = []
+        out["__agent_episode_returns__"] = self._agent_returns
+        self._agent_returns = []
         return out
 
 
@@ -227,6 +305,7 @@ class MultiAgentPPO(Trainable):
             {pid: act for pid in cfg.policies}, seed=cfg.seed,
             env_kwargs=cfg.env_kwargs)
         self._return_window: list[float] = []
+        self._policy_returns: dict[str, list[float]] = {}
 
     def step(self) -> dict:
         cfg = self.cfg
@@ -234,6 +313,18 @@ class MultiAgentPPO(Trainable):
         sample = self._runner.sample()
         self._return_window.extend(sample.pop("__episode_returns__"))
         stats: dict = {}
+        # Per-POLICY mean episode return: in mixed-sum envs the all-agent
+        # mean washes out (predator gains cancel prey losses).
+        for ep in sample.pop("__agent_episode_returns__", []):
+            by_pid: dict[str, list[float]] = {}
+            for agent, ret in ep.items():
+                by_pid.setdefault(self.mapping(agent), []).append(ret)
+            for pid, rets in by_pid.items():
+                self._policy_returns.setdefault(pid, []).append(
+                    float(np.mean(rets)))
+        for pid, window in self._policy_returns.items():
+            self._policy_returns[pid] = window[-100:]
+            stats[f"{pid}/episode_return_mean"] = float(np.mean(window))
         static = (cfg.clip, cfg.vf_coef, cfg.ent_coef, cfg.num_minibatches,
                   cfg.num_epochs)
         for pid, s in sample.items():

@@ -16,11 +16,17 @@ import numpy as np
 class ReplayBuffer:
     """Uniform ring buffer over (obs, action, reward, next_obs, done)."""
 
-    def __init__(self, capacity: int, obs_size: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_size: int, seed: int = 0,
+                 action_size: int | None = None):
+        """``action_size=None`` stores scalar discrete actions (int32);
+        an int stores continuous action vectors (float32, [capacity, A])."""
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_size), np.float32)
         self.next_obs = np.zeros((capacity, obs_size), np.float32)
-        self.actions = np.zeros((capacity,), np.int32)
+        if action_size is None:
+            self.actions = np.zeros((capacity,), np.int32)
+        else:
+            self.actions = np.zeros((capacity, action_size), np.float32)
         self.rewards = np.zeros((capacity,), np.float32)
         self.dones = np.zeros((capacity,), np.float32)
         self._rng = np.random.default_rng(seed)
@@ -60,8 +66,10 @@ class PrioritizedReplayBuffer(ReplayBuffer):
     transitions are sampled at least once."""
 
     def __init__(self, capacity: int, obs_size: int, *, alpha: float = 0.6,
-                 beta: float = 0.4, seed: int = 0):
-        super().__init__(capacity, obs_size, seed=seed)
+                 beta: float = 0.4, seed: int = 0,
+                 action_size: int | None = None):
+        super().__init__(capacity, obs_size, seed=seed,
+                         action_size=action_size)
         self.alpha, self.beta = alpha, beta
         self._prio = np.zeros((capacity,), np.float64)
         self._max_prio = 1.0
