@@ -62,7 +62,10 @@ def make_train_step(
                                          mu_dtype=jnp.bfloat16)
 
     param_sh = tree_shardings(mesh, logical_axes, rules)
-    batch_sh = NamedSharding(mesh, rules.spec("batch", None))
+    # Leading-axis-only spec: rank-agnostic (tokens [B,S], images
+    # [B,H,W,C], labels [B] all shard their batch dim; trailing dims
+    # replicate).
+    batch_sh = NamedSharding(mesh, rules.spec("batch"))
 
     def init_state() -> TrainState:
         params = jax.jit(init_fn, out_shardings=param_sh)(
@@ -137,6 +140,30 @@ def make_mixtral_train_step(
             cfg, p, tokens, targets, attn_impl=attn_impl, remat=remat),
         init_fn=partial(mixtral.init_params, cfg),
         logical_axes=mixtral.param_logical_axes(cfg),
+        rules=rules, optimizer=optimizer, seed=seed,
+    )
+
+
+def make_vit_train_step(
+    cfg,
+    mesh: Mesh,
+    rules: ShardingRules | None = None,
+    optimizer: optax.GradientTransformation | None = None,
+    attn_impl: str = "flash",
+    remat: bool | str = False,
+    seed: int = 0,
+) -> tuple[Callable, Callable, Callable]:
+    """ViT specialization: batch shards over (dp, fsdp) on the leading
+    image axis, attention heads / MLP over tp — identical machinery to
+    the llama step (models/vit.py holds the model)."""
+    from ray_tpu.models import vit
+
+    return make_train_step(
+        mesh,
+        loss=lambda p, images, labels: vit.loss_fn(
+            cfg, p, images, labels, attn_impl=attn_impl, remat=remat),
+        init_fn=partial(vit.init_params, cfg),
+        logical_axes=vit.param_logical_axes(cfg),
         rules=rules, optimizer=optimizer, seed=seed,
     )
 

@@ -93,3 +93,91 @@ def test_sharded_forward_matches_single(tiny, cpu_mesh_devices):
 def test_llama3_8b_param_count():
     cfg = LlamaConfig.llama3_8b()
     assert abs(cfg.num_params() - 8.03e9) / 8.03e9 < 0.01
+
+
+class TestViT:
+    def test_forward_shapes_and_cls(self):
+        from ray_tpu.models.vit import ViTConfig, forward, init_params, patchify
+        import jax
+        import jax.numpy as jnp
+
+        cfg = ViTConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        imgs = jax.random.uniform(jax.random.PRNGKey(1), (3, 16, 16, 3))
+        patches = patchify(cfg, imgs)
+        assert patches.shape == (3, 16, 4 * 4 * 3)  # (16/4)^2 patches
+        logits = forward(cfg, params, imgs, attn_impl="blockwise")
+        assert logits.shape == (3, 10)
+        assert jnp.isfinite(logits).all()
+
+    def test_patchify_preserves_pixels(self):
+        from ray_tpu.models.vit import ViTConfig, patchify
+        import numpy as np
+
+        cfg = ViTConfig.tiny()
+        imgs = np.arange(16 * 16 * 3, dtype=np.float32).reshape(1, 16, 16, 3)
+        patches = np.asarray(patchify(cfg, imgs))
+        # first patch row 0 == image rows 0..3, cols 0..3 flattened
+        expect = imgs[0, :4, :4, :].reshape(-1)
+        np.testing.assert_array_equal(patches[0, 0], expect)
+
+    def test_spmd_train_step_learns(self, cpu_mesh_devices):
+        """make_vit_train_step over a dp*tp mesh: loss decreases on a
+        learnable synthetic task (brightness-quadrant classification)."""
+        import numpy as np
+        import optax
+
+        from ray_tpu.models.vit import ViTConfig, make_vit_train_step
+        from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        cfg = ViTConfig.tiny()
+        mesh = build_mesh(MeshSpec(dp=2, tp=2), cpu_mesh_devices[:4])
+        step, init, shard = make_vit_train_step(
+            cfg, mesh, optimizer=optax.adam(1e-3), attn_impl="blockwise")
+        state = init()
+        rng = np.random.default_rng(0)
+        # Label = which quadrant is brightest; linearly separable from
+        # patch features.
+        imgs = rng.uniform(0, 0.3, (16, 16, 16, 3)).astype(np.float32)
+        labels = rng.integers(0, 4, 16).astype(np.int32)
+        for n, lab in enumerate(labels):
+            r0, c0 = (lab // 2) * 8, (lab % 2) * 8
+            imgs[n, r0:r0 + 8, c0:c0 + 8] += 0.6
+        losses = []
+        for _ in range(20):
+            state, m = step(state, shard(imgs), shard(labels))
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+    def test_vit_consumes_read_images(self, tmp_path):
+        """Multimodal loop closed: data.read_images feeds the ViT train
+        step directly (decoded uint8 batches -> float images -> loss)."""
+        import numpy as np
+        import optax
+        from PIL import Image
+
+        import jax
+        import ray_tpu
+        import ray_tpu.data as rdata
+        from ray_tpu.models.vit import ViTConfig, make_vit_train_step
+        from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        for i in range(8):
+            arr = np.full((20, 20, 3), 20 * i, dtype=np.uint8)
+            Image.fromarray(arr).save(tmp_path / f"c{i % 2}_{i}.png")
+        ray_tpu.init()
+        try:
+            ds = rdata.read_images(str(tmp_path), size=(16, 16))
+            batch = next(iter(ds.iter_batches(batch_size=8)))
+        finally:
+            ray_tpu.shutdown()
+        imgs = batch["image"].astype(np.float32) / 255.0
+        labels = np.asarray(
+            [int(p.split("/")[-1][1]) for p in batch["path"]], np.int32)
+        cfg = ViTConfig.tiny()
+        mesh = build_mesh(MeshSpec(dp=1), jax.devices("cpu")[:1])
+        step, init, shard = make_vit_train_step(
+            cfg, mesh, optimizer=optax.adam(1e-3), attn_impl="blockwise")
+        state = init()
+        state, m = step(state, shard(imgs), shard(labels))
+        assert np.isfinite(float(m["loss"]))
