@@ -45,21 +45,38 @@ class LLMConfig:
     # placement_group_config): {"bundles": [{...}, ...], "strategy": "PACK"}.
     # Bundle 0 hosts the replica actor; the rest reserve TP/PP worker hosts.
     placement_group_config: dict | None = None
+    # Speculative decoding (reference capability: vLLM speculative decoding
+    # behind the llm serving stack): a small draft model proposes
+    # speculative_tokens greedily; the target verifies them in ONE forward.
+    # Greedy (temperature==0) requests only — output is provably identical
+    # to vanilla greedy decoding regardless of draft quality.
+    speculative_model: LlamaConfig | str | None = None
+    speculative_tokens: int = 4
+    speculative_checkpoint_path: str | None = None
 
     def model_config(self) -> LlamaConfig:
-        if isinstance(self.model, LlamaConfig):
-            cfg = self.model
-        elif self.model == "tiny":
-            from dataclasses import replace
-            # vocab 512 so the byte tokenizer (256 bytes + specials) fits
-            cfg = replace(LlamaConfig.tiny(), vocab_size=512)
-        elif self.model in ("llama3-8b", "llama3_8b"):
-            cfg = LlamaConfig.llama3_8b()
-        elif self.model in ("llama3-1b", "llama3_1b"):
-            cfg = LlamaConfig.llama3_1b()
-        else:
-            raise ValueError(f"unknown model {self.model!r}")
-        if self.dtype is not None and cfg.dtype != self.dtype:
-            from dataclasses import replace
-            cfg = replace(cfg, dtype=self.dtype)
-        return cfg
+        return _resolve_model(self.model, self.dtype)
+
+    def draft_model_config(self) -> LlamaConfig | None:
+        if self.speculative_model is None:
+            return None
+        return _resolve_model(self.speculative_model, self.dtype)
+
+
+def _resolve_model(model: "LlamaConfig | str", dtype: str | None) -> LlamaConfig:
+    if isinstance(model, LlamaConfig):
+        cfg = model
+    elif model == "tiny":
+        from dataclasses import replace
+        # vocab 512 so the byte tokenizer (256 bytes + specials) fits
+        cfg = replace(LlamaConfig.tiny(), vocab_size=512)
+    elif model in ("llama3-8b", "llama3_8b"):
+        cfg = LlamaConfig.llama3_8b()
+    elif model in ("llama3-1b", "llama3_1b"):
+        cfg = LlamaConfig.llama3_1b()
+    else:
+        raise ValueError(f"unknown model {model!r}")
+    if dtype is not None and cfg.dtype != dtype:
+        from dataclasses import replace
+        cfg = replace(cfg, dtype=dtype)
+    return cfg

@@ -190,9 +190,8 @@ def prefill_chunk(cfg: LlamaConfig, params, cache, tokens, kv_len, length,
     return {"k": new_k, "v": new_v}, last
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def decode_step(cfg: LlamaConfig, params, cache, tokens, positions,
-                write_mask=None):
+def _decode_step_impl(cfg: LlamaConfig, params, cache, tokens, positions,
+                      write_mask=None):
     """One decode step for EVERY slot.
 
     tokens: [B] (last sampled token per slot), positions: [B] (where each
@@ -242,6 +241,96 @@ def decode_step(cfg: LlamaConfig, params, cache, tokens, positions,
     x, (new_k, new_v) = lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
     logits = _lm_head(cfg, params, x[:, 0, :])
+    return {"k": new_k, "v": new_v}, logits
+
+
+decode_step = partial(jax.jit, static_argnums=(0,),
+                      donate_argnums=(2,))(_decode_step_impl)
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (reference capability: the vLLM speculative-decoding
+# path behind the reference's llm serving stack). Decode is HBM-bound on
+# TPU — one token per full weight read; verifying K draft tokens in one
+# forward amortizes the weight traffic K-fold when the draft is right.
+# Rollback is FREE in this cache design: entries written beyond the
+# accepted prefix sit at positions >= next_pos, which every later read
+# masks (kv_pos <= position) and every later write overwrites.
+
+
+@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2,))
+def draft_propose(cfg: LlamaConfig, params, cache, token0, positions0,
+                  k: int, write_mask):
+    """Greedy-propose ``k`` tokens with the draft model in ONE dispatch
+    (lax.scan over its decode step). Writes draft KV for token0 and the
+    first k-1 proposals. Returns (cache, proposals [B, k])."""
+
+    def step(carry, _):
+        c, tok, pos = carry
+        c, logits = _decode_step_impl(cfg, params, c, tok, pos, write_mask)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (c, nxt, pos + 1), nxt
+
+    # k+1 iterations: the extra step writes the LAST proposal's KV inside
+    # this same dispatch (its own proposal is discarded), so a
+    # full-acceptance tick needs no separate one-token catch-up prefill.
+    (cache, _, _), toks = lax.scan(step, (cache, token0, positions0),
+                                   None, length=k + 1)
+    return cache, toks.T[:, :k]  # [B, k]
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def spec_verify_step(cfg: LlamaConfig, params, cache, tokens, positions0,
+                     write_mask):
+    """Target forward over K tokens per slot in one pass.
+
+    tokens: [B, K] — the last sampled token followed by K-1 draft
+    proposals; positions0: [B] — where tokens[:, 0] is written. Writes
+    K/V for all K positions (contiguous) and returns (cache,
+    logits [B, K, V]): logits[:, j] scores the token at position
+    positions0 + j + 1, which is what acceptance compares against."""
+    b, k = tokens.shape
+    max_seq = cache["k"].shape[3]
+    x = params["embed_tokens"][tokens]  # [B, K, H]
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling)
+    n_rep = cfg.num_heads // cfg.num_kv_heads
+    positions = positions0[:, None] + jnp.arange(k)[None, :]  # [B, K]
+    # query at positions0+i attends kv through positions0+i
+    kv_mask = (jnp.arange(max_seq)[None, None, :]
+               <= positions[:, :, None])[:, None]  # [B, 1, K, S]
+
+    def write(cache_l, new, p0):
+        # cache_l: [B, Hkv, S, D]; new: [B, Hkv, K, D]; p0: [B]
+        def upd(c, n, p, en):
+            updated = lax.dynamic_update_slice(c, n.astype(c.dtype),
+                                               (0, p, 0))
+            return jnp.where(en, updated, c)
+        return jax.vmap(upd)(cache_l, new, p0, write_mask)
+
+    def body(x, scanned):
+        lp, k_l, v_l = scanned
+        xn = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, kk, v = _project_qkv(cfg, lp, xn, b, k)
+        q = apply_rope(q, positions, inv_freq)
+        kk = apply_rope(kk, positions, inv_freq)
+        k_l = write(k_l, kk, positions0)
+        v_l = write(v_l, v, positions0)
+        kr = _repeat_kv(k_l.astype(x.dtype), n_rep)  # [B, H, S, D]
+        vr = _repeat_kv(v_l.astype(x.dtype), n_rep)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kr).astype(jnp.float32)
+        scores = scores / np.sqrt(cfg.head_dim)
+        scores = scores + jnp.where(kv_mask, 0.0, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vr)
+        o = o.transpose(0, 2, 1, 3).reshape(b, k, -1)
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        x = _mlp(cfg, lp, x)
+        return x, (k_l, v_l)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    logits = _lm_head(cfg, params, x)  # [B, K, V]
     return {"k": new_k, "v": new_v}, logits
 
 
@@ -299,6 +388,7 @@ class GenerationRequest:
     preloaded: tuple | None = None  # (kv_k, kv_v, first_token) P/D import
     last_slot: int = -1  # slot the request last occupied (KV export)
     hold_slot: bool = False  # keep the slot (and its KV) after finishing
+    draft_len: int = 0  # draft-cache positions filled (speculative decoding)
 
 
 @dataclass
@@ -335,6 +425,31 @@ class LLMEngine:
             self._shard_for_tp(config.tensor_parallel_size)
         self.cache = init_kv_cache(self.model_cfg, self.max_slots,
                                    self.max_seq)
+
+        # Speculative decoding: draft model + its own KV cache. The draft
+        # must share the tokenizer's vocab space with the target.
+        self.draft_cfg = config.draft_model_config()
+        self.spec_k = max(1, int(config.speculative_tokens))
+        self.draft_params = None
+        self.draft_cache = None
+        self.spec_ticks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        if self.draft_cfg is not None:
+            if self.draft_cfg.vocab_size != self.model_cfg.vocab_size:
+                raise ValueError(
+                    "speculative draft must share the target's vocab "
+                    f"({self.draft_cfg.vocab_size} != "
+                    f"{self.model_cfg.vocab_size})")
+            dp = None
+            if config.speculative_checkpoint_path:
+                dp = _load_checkpoint(config.speculative_checkpoint_path)
+            if dp is None:
+                dp = init_params(self.draft_cfg,
+                                 jax.random.PRNGKey(config.seed + 7))
+            self.draft_params = dp
+            self.draft_cache = init_kv_cache(self.draft_cfg,
+                                             self.max_slots, self.max_seq)
 
         self._slots: dict[int, GenerationRequest | None] = {
             i: None for i in range(self.max_slots)}
@@ -480,11 +595,19 @@ class LLMEngine:
 
     def stats(self) -> dict:
         active = sum(1 for r in self._slots.values() if r is not None)
-        return {"active": active, "waiting": self._waiting.qsize(),
-                "slots": self.max_slots,
-                "prefix_hits": self.prefix_hits,
-                "prefix_tokens_saved": self.prefix_tokens_saved,
-                "prefix_cached_slots": len(self._prefix_cached)}
+        out = {"active": active, "waiting": self._waiting.qsize(),
+               "slots": self.max_slots,
+               "prefix_hits": self.prefix_hits,
+               "prefix_tokens_saved": self.prefix_tokens_saved,
+               "prefix_cached_slots": len(self._prefix_cached)}
+        if self.draft_cfg is not None:
+            out["spec_ticks"] = self.spec_ticks
+            out["spec_proposed"] = self.spec_proposed
+            out["spec_accepted"] = self.spec_accepted
+            out["spec_acceptance"] = (
+                round(self.spec_accepted / self.spec_proposed, 3)
+                if self.spec_proposed else 0.0)
+        return out
 
     # ---- scheduler ----
 
@@ -513,6 +636,21 @@ class LLMEngine:
         decoding = {s: r for s, r in self._slots.items()
                     if r is not None and r.next_pos >= 0
                     and not r.done.is_set()}
+        if decoding and self.draft_params is not None:
+            # Speculative path serves greedy requests with spec headroom;
+            # the rest (stochastic sampling, near end-of-cache) ride the
+            # normal decode in the same tick.
+            spec = {s: r for s, r in decoding.items()
+                    if r.sampling.temperature <= 0.0
+                    and r.next_pos + self.spec_k + 1 < self.max_seq}
+            rest = {s: r for s, r in decoding.items() if s not in spec}
+            if spec:
+                self._spec_decode(spec)
+                worked = True
+            if rest:
+                self._decode(rest)
+                worked = True
+            return worked
         if decoding:
             self._decode(decoding)
             worked = True
@@ -660,17 +798,9 @@ class LLMEngine:
                 continue
             self._prefill_rr = slot
             p = len(req.prompt_ids)
-            chunk = self.config.prefill_chunk
-            bucket = self.config.prefill_bucket_min
-            remaining = p - req.prefilled_len
-            while bucket < min(remaining, chunk):
-                bucket *= 2
-            # Clamp to the cache tail: a window crossing max_seq would make
-            # dynamic_update_slice clamp its start index and silently
-            # overwrite earlier positions.
-            bucket = min(bucket, self.max_seq - req.prefilled_len)
+            bucket, take = self._chunk_bucket(req.prefilled_len,
+                                              p - req.prefilled_len)
             toks = np.zeros((bucket,), np.int32)
-            take = min(remaining, bucket)
             toks[:take] = req.prompt_ids[req.prefilled_len:
                                          req.prefilled_len + take]
             try:
@@ -714,6 +844,11 @@ class LLMEngine:
         self._prefix_cached.clear()
         self.cache = init_kv_cache(self.model_cfg, self.max_slots,
                                    self.max_seq)
+        if self.draft_cfg is not None:
+            # The draft cache may have been donated by the failing
+            # speculative dispatch — rebuild it alongside.
+            self.draft_cache = init_kv_cache(self.draft_cfg,
+                                             self.max_slots, self.max_seq)
 
     def _decode(self, active: dict[int, GenerationRequest]) -> None:
         tokens = np.zeros((self.max_slots,), np.int32)
@@ -744,6 +879,118 @@ class LLMEngine:
         for slot, req in active.items():
             req.next_pos += 1
             self._emit(req, int(sampled[slot]))
+
+    def _spec_decode(self, active: dict[int, GenerationRequest]) -> None:
+        """One speculative tick: draft proposes spec_k tokens per slot in
+        one dispatch, the target verifies them (+ the bonus position) in
+        one forward, and each slot advances by accepted+1 tokens. Greedy
+        acceptance makes the output IDENTICAL to vanilla greedy decoding
+        whatever the draft proposes; stale KV beyond the accepted prefix
+        is masked/overwritten by position bookkeeping (free rollback)."""
+        k = self.spec_k
+        # Draft catch-up: any slot whose draft cache lags (fresh prompt,
+        # prefix adoption, PD import, all-k-accepted tail) prefills the
+        # missing span — cheap, the draft is small by construction.
+        fallback = {}
+        for slot, req in active.items():
+            if req.draft_len < req.next_pos and \
+                    not self._draft_catch_up(slot, req):
+                fallback[slot] = req  # draft broken: plain decode
+        if fallback:
+            self._decode(fallback)
+            # That decode may have hit _recover_device_failure, which fails
+            # every slotted request and rebuilds the caches — speculating
+            # for dead requests would waste two dispatches and skew stats.
+            active = {s: r for s, r in active.items()
+                      if s not in fallback and not r.done.is_set()
+                      and self._slots.get(s) is r}
+        if not active:
+            return
+        token0 = np.zeros((self.max_slots,), np.int32)
+        pos0 = np.zeros((self.max_slots,), np.int32)
+        write = np.zeros((self.max_slots,), bool)
+        for slot, req in active.items():
+            token0[slot] = req.out_tokens[-1]
+            pos0[slot] = req.next_pos
+            write[slot] = True
+        try:
+            self.draft_cache, proposals = draft_propose(
+                self.draft_cfg, self.draft_params, self.draft_cache,
+                jnp.asarray(token0), jnp.asarray(pos0), k,
+                jnp.asarray(write))
+            proposals = np.asarray(proposals)  # [B, k]
+            verify_tokens = np.concatenate(
+                [token0[:, None], proposals], axis=1)  # [B, k+1]
+            self.cache, logits = spec_verify_step(
+                self.model_cfg, self.params, self.cache,
+                jnp.asarray(verify_tokens), jnp.asarray(pos0),
+                jnp.asarray(write))
+            greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [B, k+1]
+        except Exception as e:  # noqa: BLE001 - caches donated & lost
+            logger.exception("speculative step failed (%d active)",
+                             len(active))
+            self._recover_device_failure(f"speculative decode failed: {e!r}")
+            return
+        self.spec_ticks += 1
+        for slot, req in active.items():
+            accepted = 0
+            while accepted < k and \
+                    proposals[slot, accepted] == greedy[slot, accepted]:
+                accepted += 1
+            self.spec_proposed += k
+            self.spec_accepted += accepted
+            emit = [int(t) for t in proposals[slot, :accepted]]
+            emit.append(int(greedy[slot, accepted]))  # corrected/bonus
+            for tok in emit:
+                if req.done.is_set():
+                    break
+                req.next_pos += 1
+                self._emit(req, tok)
+            # Draft KV is valid through the accepted prefix; draft_propose
+            # writes k+1 entries, covering even the all-accepted case.
+            req.draft_len = req.next_pos
+
+    def _chunk_bucket(self, start: int, remaining: int) -> tuple[int, int]:
+        """(bucket, take) for one prefill chunk starting at ``start``:
+        power-of-two bucket from prefill_bucket_min, capped at
+        prefill_chunk, and CLAMPED to the cache tail — a window crossing
+        max_seq would make dynamic_update_slice clamp its start index and
+        silently overwrite earlier positions."""
+        bucket = self.config.prefill_bucket_min
+        while bucket < min(remaining, self.config.prefill_chunk):
+            bucket *= 2
+        bucket = min(bucket, self.max_seq - start)
+        return bucket, min(remaining, bucket)
+
+    def _draft_catch_up(self, slot: int, req: GenerationRequest) -> bool:
+        """Prefill the draft cache for positions draft_len..next_pos-1
+        (the tokens already consumed by the target)."""
+        seq = list(req.prompt_ids) + req.out_tokens[:-1]
+        start = req.draft_len
+        try:
+            while start < req.next_pos:
+                bucket, take = self._chunk_bucket(start,
+                                                  req.next_pos - start)
+                toks = np.zeros((bucket,), np.int32)
+                toks[:take] = seq[start:start + take]
+                self.draft_cache, _ = prefill_chunk(
+                    self.draft_cfg, self.draft_params, self.draft_cache,
+                    jnp.asarray(toks), jnp.int32(start),
+                    jnp.int32(start + take), jnp.int32(slot))
+                start += take
+            req.draft_len = req.next_pos
+            return True
+        except Exception:  # noqa: BLE001 - draft trouble must not kill
+            # the request; the caller falls back to plain decode. The
+            # failed dispatch DONATED the draft cache — rebuild it, and
+            # mark every speculating request's draft state cold.
+            logger.exception("draft catch-up failed for %s", req.request_id)
+            self.draft_cache = init_kv_cache(self.draft_cfg,
+                                             self.max_slots, self.max_seq)
+            for r in self._slots.values():
+                if r is not None:
+                    r.draft_len = 0
+            return False
 
     def _sample_one(self, logits, reqs) -> np.ndarray:
         b = logits.shape[0]

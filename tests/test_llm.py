@@ -476,3 +476,112 @@ def test_prefix_cache_live_donor_copy():
         long_req.done.wait(60)
     finally:
         eng.shutdown()
+
+
+class TestSpeculativeDecoding:
+    def test_spec_verify_matches_sequential_decode(self, tiny):
+        """spec_verify_step over K tokens produces the same logits and
+        cache as K sequential decode_step calls."""
+        from ray_tpu.llm.engine import spec_verify_step
+
+        cfg, params = tiny
+        K = 3
+        prompt = np.array([5, 7, 11, 13], np.int32)
+        toks = np.array([17, 19, 23], np.int32)  # K tokens to consume
+        c1 = init_kv_cache(cfg, max_slots=2, max_seq=32)
+        c1, _ = prefill(cfg, params, c1, jnp.asarray(prompt),
+                        jnp.int32(len(prompt)), jnp.int32(0))
+        c2 = jax.tree.map(jnp.copy, c1)
+
+        seq_logits = []
+        for j, t in enumerate(toks):
+            c1, lg = decode_step(
+                cfg, params, c1,
+                jnp.asarray([t, 0], np.int32),
+                jnp.asarray([len(prompt) + j, 0], np.int32),
+                jnp.asarray([True, False]))
+            seq_logits.append(np.asarray(lg[0]))
+
+        c2, logits = spec_verify_step(
+            cfg, params, c2,
+            jnp.asarray(np.stack([toks, np.zeros_like(toks)])),
+            jnp.asarray([len(prompt), 0], np.int32),
+            jnp.asarray([True, False]))
+        for j in range(K):
+            np.testing.assert_allclose(np.asarray(logits[0, j]),
+                                       seq_logits[j], rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(c1["k"]), np.asarray(c2["k"]),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_spec_output_identical_perfect_draft(self):
+        """Draft == target: outputs must match vanilla greedy exactly and
+        acceptance must be (near) total."""
+        from ray_tpu.models.llama import init_params as ip
+
+        tgt_params = ip(LLMConfig(model="tiny").model_config(),
+                        jax.random.PRNGKey(3))
+        base = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2,
+                                   max_seq_len=64), params=tgt_params)
+        spec = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2,
+                                   max_seq_len=64,
+                                   speculative_model="tiny",
+                                   speculative_tokens=3),
+                         params=tgt_params)
+        spec.draft_params = tgt_params  # perfect draft
+        try:
+            sp = SamplingParams(max_tokens=24, temperature=0.0)
+            r0 = base.generate("hello tpu", sampling=sp)
+            r1 = spec.generate("hello tpu", sampling=sp)
+            assert r1.token_ids == r0.token_ids
+            st = spec.stats()
+            assert st["spec_ticks"] > 0
+            assert st["spec_acceptance"] > 0.9, st
+        finally:
+            base.shutdown()
+            spec.shutdown()
+
+    def test_spec_output_identical_bad_draft(self):
+        """The correctness invariant: a DIFFERENT (randomly-initialized)
+        draft still yields exactly the vanilla greedy output — speculation
+        only changes speed, never results."""
+        from ray_tpu.models.llama import init_params as ip
+
+        tgt_params = ip(LLMConfig(model="tiny").model_config(),
+                        jax.random.PRNGKey(3))
+        base = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2,
+                                   max_seq_len=64), params=tgt_params)
+        spec = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2,
+                                   max_seq_len=64,
+                                   speculative_model="tiny",
+                                   speculative_tokens=4),
+                         params=tgt_params)  # draft params: seed+7 random
+        try:
+            sp = SamplingParams(max_tokens=20, temperature=0.0)
+            for prompt in ("abc", "speculate this"):
+                r0 = base.generate(prompt, sampling=sp)
+                r1 = spec.generate(prompt, sampling=sp)
+                assert r1.token_ids == r0.token_ids, prompt
+            st = spec.stats()
+            assert st["spec_ticks"] > 0
+        finally:
+            base.shutdown()
+            spec.shutdown()
+
+    def test_spec_mixed_batch_stochastic_falls_back(self):
+        """Stochastic requests ride the normal decode path while greedy
+        requests speculate — both finish correctly in one engine."""
+        eng = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2,
+                                  max_seq_len=64,
+                                  speculative_model="tiny",
+                                  speculative_tokens=3))
+        try:
+            greedy = eng.submit("aaa", sampling=SamplingParams(
+                max_tokens=12, temperature=0.0))
+            warm = eng.submit("bbb", sampling=SamplingParams(
+                max_tokens=12, temperature=0.8, seed=1))
+            assert greedy.done.wait(60) and warm.done.wait(60)
+            assert greedy.error is None and warm.error is None
+            assert len(greedy.out_tokens) > 0 and len(warm.out_tokens) > 0
+            assert eng.stats()["spec_ticks"] > 0
+        finally:
+            eng.shutdown()
