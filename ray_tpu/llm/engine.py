@@ -198,34 +198,51 @@ def _decode_step_impl(cfg: LlamaConfig, params, cache, tokens, positions,
     token is written/attends from). write_mask: [B] bool — slots mid-prefill
     or empty must not have garbage K/V written into their cache (False =
     keep the existing cache line). Returns (cache, logits [B, V]).
-    """
-    b = tokens.shape[0]
-    max_seq = cache["k"].shape[3]
-    x = params["embed_tokens"][tokens][:, None, :]  # [B, 1, H]
-    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-    n_rep = cfg.num_heads // cfg.num_kv_heads
-    kv_mask = (jnp.arange(max_seq)[None] <= positions[:, None])[:, None, None]
-    if write_mask is None:
-        write_mask = jnp.ones((b,), bool)
 
-    def write(cache_l, new, pos):
-        # cache_l: [B, Hkv, S, D] (this layer), new: [B, Hkv, 1, D]
+    Exactly the K=1 case of the multi-token body speculative verification
+    uses — ONE implementation of the masked-attention/KV-write math, so
+    the two paths can never diverge.
+    """
+    if write_mask is None:
+        write_mask = jnp.ones(tokens.shape, bool)
+    cache, logits = _multi_token_impl(cfg, params, cache, tokens[:, None],
+                                      positions, write_mask)
+    return cache, logits[:, 0]
+
+
+def _multi_token_impl(cfg: LlamaConfig, params, cache, tokens, positions0,
+                      write_mask):
+    """Consume K tokens per slot in one pass against the KV cache.
+
+    tokens: [B, K]; positions0: [B] — tokens[:, j] is written at
+    positions0 + j (contiguous); query j attends kv through its own
+    position. Returns (cache, logits [B, K, V])."""
+    b, k = tokens.shape
+    max_seq = cache["k"].shape[3]
+    x = params["embed_tokens"][tokens]  # [B, K, H]
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling)
+    n_rep = cfg.num_heads // cfg.num_kv_heads
+    positions = positions0[:, None] + jnp.arange(k)[None, :]  # [B, K]
+    kv_mask = (jnp.arange(max_seq)[None, None, :]
+               <= positions[:, :, None])[:, None]  # [B, 1, K, S]
+
+    def write(cache_l, new, p0):
+        # cache_l: [B, Hkv, S, D]; new: [B, Hkv, K, D]; p0: [B]
         def upd(c, n, p, en):
-            cur = lax.dynamic_slice(c, (0, p, 0), (c.shape[0], 1, c.shape[2]))
-            n = jnp.where(en, n.astype(c.dtype), cur)
-            return lax.dynamic_update_slice(c, n, (0, p, 0))
-        return jax.vmap(upd)(cache_l, new, pos, write_mask)
+            updated = lax.dynamic_update_slice(c, n.astype(c.dtype),
+                                               (0, p, 0))
+            return jnp.where(en, updated, c)
+        return jax.vmap(upd)(cache_l, new, p0, write_mask)
 
     def body(x, scanned):
         lp, k_l, v_l = scanned
         xn = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q, k, v = _project_qkv(cfg, lp, xn, b, 1)
-        q = jax.vmap(lambda qq, p: apply_rope(qq[None], p[None], inv_freq)[0])(
-            q, positions)
-        k = jax.vmap(lambda kk, p: apply_rope(kk[None], p[None], inv_freq)[0])(
-            k, positions)
-        k_l = write(k_l, k, positions)
-        v_l = write(v_l, v, positions)
+        q, kk, v = _project_qkv(cfg, lp, xn, b, k)
+        q = apply_rope(q, positions, inv_freq)
+        kk = apply_rope(kk, positions, inv_freq)
+        k_l = write(k_l, kk, positions0)
+        v_l = write(v_l, v, positions0)
         kr = _repeat_kv(k_l.astype(x.dtype), n_rep)  # [B, H, S, D]
         vr = _repeat_kv(v_l.astype(x.dtype), n_rep)
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, kr).astype(jnp.float32)
@@ -233,14 +250,14 @@ def _decode_step_impl(cfg: LlamaConfig, params, cache, tokens, positions,
         scores = scores + jnp.where(kv_mask, 0.0, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         o = jnp.einsum("bhqk,bhkd->bhqd", probs, vr)
-        o = o.transpose(0, 2, 1, 3).reshape(b, 1, -1)
+        o = o.transpose(0, 2, 1, 3).reshape(b, k, -1)
         x = x + (o @ lp["wo"]).astype(x.dtype)
         x = _mlp(cfg, lp, x)
         return x, (k_l, v_l)
 
     x, (new_k, new_v) = lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
-    logits = _lm_head(cfg, params, x[:, 0, :])
+    logits = _lm_head(cfg, params, x)  # [B, K, V]
     return {"k": new_k, "v": new_v}, logits
 
 
@@ -282,56 +299,16 @@ def draft_propose(cfg: LlamaConfig, params, cache, token0, positions0,
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def spec_verify_step(cfg: LlamaConfig, params, cache, tokens, positions0,
                      write_mask):
-    """Target forward over K tokens per slot in one pass.
+    """Target forward over K tokens per slot in one pass (the jitted
+    multi-token body decode_step is the K=1 case of).
 
-    tokens: [B, K] — the last sampled token followed by K-1 draft
+    tokens: [B, K] — the last sampled token followed by the draft
     proposals; positions0: [B] — where tokens[:, 0] is written. Writes
     K/V for all K positions (contiguous) and returns (cache,
     logits [B, K, V]): logits[:, j] scores the token at position
     positions0 + j + 1, which is what acceptance compares against."""
-    b, k = tokens.shape
-    max_seq = cache["k"].shape[3]
-    x = params["embed_tokens"][tokens]  # [B, K, H]
-    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
-                                cfg.rope_scaling)
-    n_rep = cfg.num_heads // cfg.num_kv_heads
-    positions = positions0[:, None] + jnp.arange(k)[None, :]  # [B, K]
-    # query at positions0+i attends kv through positions0+i
-    kv_mask = (jnp.arange(max_seq)[None, None, :]
-               <= positions[:, :, None])[:, None]  # [B, 1, K, S]
-
-    def write(cache_l, new, p0):
-        # cache_l: [B, Hkv, S, D]; new: [B, Hkv, K, D]; p0: [B]
-        def upd(c, n, p, en):
-            updated = lax.dynamic_update_slice(c, n.astype(c.dtype),
-                                               (0, p, 0))
-            return jnp.where(en, updated, c)
-        return jax.vmap(upd)(cache_l, new, p0, write_mask)
-
-    def body(x, scanned):
-        lp, k_l, v_l = scanned
-        xn = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q, kk, v = _project_qkv(cfg, lp, xn, b, k)
-        q = apply_rope(q, positions, inv_freq)
-        kk = apply_rope(kk, positions, inv_freq)
-        k_l = write(k_l, kk, positions0)
-        v_l = write(v_l, v, positions0)
-        kr = _repeat_kv(k_l.astype(x.dtype), n_rep)  # [B, H, S, D]
-        vr = _repeat_kv(v_l.astype(x.dtype), n_rep)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kr).astype(jnp.float32)
-        scores = scores / np.sqrt(cfg.head_dim)
-        scores = scores + jnp.where(kv_mask, 0.0, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vr)
-        o = o.transpose(0, 2, 1, 3).reshape(b, k, -1)
-        x = x + (o @ lp["wo"]).astype(x.dtype)
-        x = _mlp(cfg, lp, x)
-        return x, (k_l, v_l)
-
-    x, (new_k, new_v) = lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
-    logits = _lm_head(cfg, params, x)  # [B, K, V]
-    return {"k": new_k, "v": new_v}, logits
+    return _multi_token_impl(cfg, params, cache, tokens, positions0,
+                             write_mask)
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
@@ -891,21 +868,15 @@ class LLMEngine:
         # Draft catch-up: any slot whose draft cache lags (fresh prompt,
         # prefix adoption, PD import, all-k-accepted tail) prefills the
         # missing span — cheap, the draft is small by construction.
-        fallback = {}
         for slot, req in active.items():
             if req.draft_len < req.next_pos and \
                     not self._draft_catch_up(slot, req):
-                fallback[slot] = req  # draft broken: plain decode
-        if fallback:
-            self._decode(fallback)
-            # That decode may have hit _recover_device_failure, which fails
-            # every slotted request and rebuilds the caches — speculating
-            # for dead requests would waste two dispatches and skew stats.
-            active = {s: r for s, r in active.items()
-                      if s not in fallback and not r.done.is_set()
-                      and self._slots.get(s) is r}
-        if not active:
-            return
+                # The failed dispatch reset the WHOLE draft state (cache
+                # rebuilt, every draft_len zeroed) — slots that caught up
+                # earlier this tick are invalid too. Plain-decode the whole
+                # tick; catch-up re-runs for everyone next tick.
+                self._decode(active)
+                return
         token0 = np.zeros((self.max_slots,), np.int32)
         pos0 = np.zeros((self.max_slots,), np.int32)
         write = np.zeros((self.max_slots,), bool)
