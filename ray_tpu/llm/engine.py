@@ -229,10 +229,14 @@ def _multi_token_impl(cfg: LlamaConfig, params, cache, tokens, positions0,
 
     def write(cache_l, new, p0):
         # cache_l: [B, Hkv, S, D]; new: [B, Hkv, K, D]; p0: [B]
+        # Slice-merge-write touches only the K-row window: a full-line
+        # jnp.where(en, updated, c) would read+write the whole [Hkv, S, D]
+        # cache line per slot per layer on every decode step.
         def upd(c, n, p, en):
-            updated = lax.dynamic_update_slice(c, n.astype(c.dtype),
-                                               (0, p, 0))
-            return jnp.where(en, updated, c)
+            window = lax.dynamic_slice(
+                c, (0, p, 0), (c.shape[0], n.shape[1], c.shape[2]))
+            merged = jnp.where(en, n.astype(c.dtype), window)
+            return lax.dynamic_update_slice(c, merged, (0, p, 0))
         return jax.vmap(upd)(cache_l, new, p0, write_mask)
 
     def body(x, scanned):
@@ -366,6 +370,8 @@ class GenerationRequest:
     last_slot: int = -1  # slot the request last occupied (KV export)
     hold_slot: bool = False  # keep the slot (and its KV) after finishing
     draft_len: int = 0  # draft-cache positions filled (speculative decoding)
+    draft_fail_count: int = 0  # consecutive draft catch-up failures
+    spec_disabled: bool = False  # excluded from speculation (see _spec_decode)
 
 
 @dataclass
@@ -865,6 +871,18 @@ class LLMEngine:
         whatever the draft proposes; stale KV beyond the accepted prefix
         is masked/overwritten by position bookkeeping (free rollback)."""
         k = self.spec_k
+        # Requests whose draft catch-up keeps failing are speculation-
+        # disabled (bounded blast radius: one bad request must not turn
+        # speculation off engine-wide forever) — plain-decode those, then
+        # run the speculative tick for the rest.
+        spec_active = {s: r for s, r in active.items() if not r.spec_disabled}
+        plain_active = {s: r for s, r in active.items() if r.spec_disabled}
+        if not spec_active:
+            self._decode(active)
+            return
+        if plain_active:
+            self._decode(plain_active)
+        active = spec_active
         # Draft catch-up: any slot whose draft cache lags (fresh prompt,
         # prefix adoption, PD import, all-k-accepted tail) prefills the
         # missing span — cheap, the draft is small by construction.
@@ -874,7 +892,8 @@ class LLMEngine:
                 # The failed dispatch reset the WHOLE draft state (cache
                 # rebuilt, every draft_len zeroed) — slots that caught up
                 # earlier this tick are invalid too. Plain-decode the whole
-                # tick; catch-up re-runs for everyone next tick.
+                # tick; catch-up re-runs for everyone next tick (minus any
+                # request _draft_catch_up just speculation-disabled).
                 self._decode(active)
                 return
         token0 = np.zeros((self.max_slots,), np.int32)
@@ -950,12 +969,22 @@ class LLMEngine:
                     jnp.int32(start + take), jnp.int32(slot))
                 start += take
             req.draft_len = req.next_pos
+            req.draft_fail_count = 0
             return True
         except Exception:  # noqa: BLE001 - draft trouble must not kill
             # the request; the caller falls back to plain decode. The
             # failed dispatch DONATED the draft cache — rebuild it, and
-            # mark every speculating request's draft state cold.
+            # mark every speculating request's draft state cold. A request
+            # that fails catch-up repeatedly (e.g. a span that OOMs the
+            # draft prefill every tick) is speculation-disabled so it
+            # stops zeroing everyone else's draft state each tick.
             logger.exception("draft catch-up failed for %s", req.request_id)
+            req.draft_fail_count += 1
+            if req.draft_fail_count >= 3:
+                req.spec_disabled = True
+                logger.warning("disabling speculation for %s after %d "
+                               "failed draft catch-ups", req.request_id,
+                               req.draft_fail_count)
             self.draft_cache = init_kv_cache(self.draft_cfg,
                                              self.max_slots, self.max_seq)
             for r in self._slots.values():

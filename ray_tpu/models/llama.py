@@ -167,9 +167,10 @@ def _layer(cfg: LlamaConfig, x, layer_params, inv_freq, positions,
     v = v.transpose(0, 2, 1, 3)
     q = checkpoint_name(apply_rope(q, positions, inv_freq), "rope_out")
     k = checkpoint_name(apply_rope(k, positions, inv_freq), "rope_out")
+    v = checkpoint_name(v, "v_out")
     o = _attention(cfg, q, k, v, attn_impl, sp_axis)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.num_heads * cfg.head_dim)
-    x = x + (o @ lp["wo"]).astype(dt)
+    x = x + checkpoint_name((o @ lp["wo"]).astype(dt), "attn_proj")
 
     # -- mlp (SwiGLU) -------------------------------------------------------
     xn = checkpoint_name(rms_norm(x, lp["mlp_norm"], cfg.norm_eps),
@@ -182,6 +183,13 @@ def _layer(cfg: LlamaConfig, x, layer_params, inv_freq, positions,
 
 def _remat_wrap(layer_fn, remat):
     """remat policy: True/'full' = recompute everything (min memory),
+    'attn' = save ONLY the attention residuals (rope'd q/k, v, flash
+    out+lse) and the attention output projection — the backward pass
+    never re-runs the attention kernel, but the wide SwiGLU activations
+    ([B,S,intermediate], the two biggest per-layer tensors) are
+    recomputed from the saved attn_proj (one cheap residual-add + norm +
+    two matmuls). ~3x less activation HBM than 'dots' for ~18% more
+    step FLOPs — the fit-enabling mode for HBM-bound configs,
     'dots' = save matmul outputs (jax.checkpoint_policies.checkpoint_dots)
     plus the flash-attention residuals (out, lse) — so the backward pass
     neither recomputes the matmuls nor re-runs the attention kernel,
@@ -190,6 +198,10 @@ def _remat_wrap(layer_fn, remat):
     False/'none' = save all."""
     if remat in (False, "none"):
         return layer_fn
+    if remat == "attn":
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "flash_resid", "rope_out", "v_out", "attn_proj")
+        return jax.checkpoint(layer_fn, policy=policy)
     if remat in ("dots", "dots+"):
         names = ("flash_resid",) if remat == "dots" else (
             "flash_resid", "norm_out", "rope_out")

@@ -320,26 +320,28 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                            dq_ref, dk_ref, dv_ref, *, kv_seq_len: int,
-                            block_k: int, sm_scale: float, causal: bool,
-                            block_q: int):
+                            dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                            kv_seq_len: int, block_k: int, sm_scale: float,
+                            causal: bool, block_q: int):
     """Fused backward: ONE pass over (q block, kv block) pairs computes
     dq, dk and dv together — the split dq/dkv kernels each recompute
     s = q·kᵀ, p and dp = dO·vᵀ for every pair (7 matmuls/pair across the
     two kernels); fused needs 5 and reads q/k/v/dO/lse/Δ once.
 
-    Grid: (batch*heads, q_blocks). dq is written per q block. dk/dv are
-    f32 accumulators whose index map is CONSTANT over the q dimension, so
-    the block stays VMEM-resident across the whole q sweep and is flushed
-    to HBM once per (batch, head) when the grid row changes."""
+    Grid: (batch*heads, q_blocks). dq is written per q block. dk/dv
+    accumulate in f32 VMEM scratch across the whole q sweep (scratch
+    persists over the sequential inner grid dim) and flush ONCE to HBM in
+    the kernel's native dtype at the last q block — the HBM buffers stay
+    bf16-sized instead of the f32 accumulator layout."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
+    nq = pl.num_programs(1)
 
     @pl.when(qi == 0)
     def _init():
-        dk_ref[...] = jnp.zeros_like(dk_ref)
-        dv_ref[...] = jnp.zeros_like(dv_ref)
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
     q = q_ref[...]                       # [bq, d] bf16
     do = do_ref[...]                     # [bq, d] bf16
@@ -360,9 +362,9 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jnp.dot(do.astype(v.dtype), v.T,
                      preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
-        dv_ref[kslc, :] += jnp.dot(p.astype(do.dtype).T, do,
+        dv_acc[kslc, :] += jnp.dot(p.astype(do.dtype).T, do,
                                    preferred_element_type=jnp.float32)
-        dk_ref[kslc, :] += jnp.dot(ds.astype(q.dtype).T, q,
+        dk_acc[kslc, :] += jnp.dot(ds.astype(q.dtype).T, q,
                                    preferred_element_type=jnp.float32)
         return dq + jnp.dot(ds.astype(k.dtype), k,
                             preferred_element_type=jnp.float32)
@@ -377,12 +379,18 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        jnp.zeros((q.shape[0], d), jnp.float32))
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
 
 def _flash_bwd_fused_pallas(q, k, v, out, lse, g, causal: bool,
                             sm_scale: float,
                             block_q: int = 512, block_k: int = 512):
     """Single-kernel backward (see _flash_bwd_fused_kernel). dk/dv come
-    back per *query* head in f32 (caller folds GQA groups and casts)."""
+    back per *query* head in the input dtype (caller folds GQA groups in
+    f32); the f32 accumulation lives in VMEM scratch, not HBM."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -419,8 +427,12 @@ def _flash_bwd_fused_pallas(q, k, v, out, lse, g, causal: bool,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, skv, d), jnp.float32),
-            jax.ShapeDtypeStruct((b * h, skv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, skv, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((skv, d), jnp.float32),
+            pltpu.VMEM((skv, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),

@@ -567,6 +567,41 @@ class TestSpeculativeDecoding:
             base.shutdown()
             spec.shutdown()
 
+    def test_spec_disabled_after_repeated_catchup_failure(self):
+        """A request whose draft catch-up fails persistently is
+        speculation-disabled after 3 attempts (bounded blast radius) —
+        it still completes via plain decode, and the engine keeps
+        speculating for later requests instead of staying dark."""
+        eng = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2,
+                                  max_seq_len=64,
+                                  speculative_model="tiny",
+                                  speculative_tokens=3))
+        orig = eng._draft_catch_up.__func__
+
+        def failing(self_, slot, req):
+            if req.request_id == victim.request_id:
+                req.draft_fail_count += 1
+                if req.draft_fail_count >= 3:
+                    req.spec_disabled = True
+                return False
+            return orig(self_, slot, req)
+
+        try:
+            eng._draft_catch_up = failing.__get__(eng)
+            victim = eng.submit("doomed draft", sampling=SamplingParams(
+                max_tokens=10, temperature=0.0))
+            assert victim.done.wait(60) and victim.error is None
+            assert victim.spec_disabled
+            assert len(victim.out_tokens) == 10
+            # Engine must still speculate for a healthy follow-up request.
+            healthy = eng.submit("fine", sampling=SamplingParams(
+                max_tokens=10, temperature=0.0))
+            assert healthy.done.wait(60) and healthy.error is None
+            assert not healthy.spec_disabled
+            assert eng.stats()["spec_ticks"] > 0
+        finally:
+            eng.shutdown()
+
     def test_spec_mixed_batch_stochastic_falls_back(self):
         """Stochastic requests ride the normal decode path while greedy
         requests speculate — both finish correctly in one engine."""
