@@ -1,0 +1,126 @@
+"""Per-block timing on the real chip: flash attention fwd/bwd and MLP
+fwd/bwd at the bench geometry, vs the measured matmul ceiling (~152 TF/s).
+
+Answers: where do the ~140 ms of backward overhead in the 1B step go?
+(profile_step.py: fwd-only 137 ms, fwd+bwd dots 476 ms, ideal bwd 2x fwd.)
+
+Protocol notes (axon tunnel):
+- Per-jit dispatch+fetch costs ~70-100 ms, so each op is chained inside one
+  jit via lax.scan and the per-iter time is the SLOPE between a short and a
+  long chain (cancels the fixed cost).
+- Backward passes pull a RANDOM cotangent through vjp — a sum() loss hands
+  XLA an all-ones cotangent it can simplify (matmul-by-ones becomes a
+  reduction), undercounting real backward cost.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import flash_attention
+
+B, S, H, KV, HD = 4, 2048, 32, 8, 64
+HID, INTER = 2048, 8192
+LAYERS = 16
+L1, L2 = 16, 112
+
+
+def timed_slope_chain(make_step, carry0, reps=5):
+    """Per-iteration time of make_step via two chain lengths in one jit."""
+
+    def run_for(length):
+        @jax.jit
+        def run(c):
+            def body(c, _):
+                return make_step(c), None
+            c, _ = lax.scan(body, c, None, length=length)
+            return jax.tree_util.tree_reduce(
+                lambda a, x: a + x.ravel()[0].astype(jnp.float32), c, 0.0)
+        return run
+
+    r1, r2 = run_for(L1), run_for(L2)
+    float(r1(carry0)); float(r2(carry0))  # compile both
+    slopes = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); float(r1(carry0)); t1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); float(r2(carry0)); t2 = time.perf_counter() - t0
+        slopes.append((t2 - t1) / (L2 - L1))
+    slopes.sort()
+    return slopes[len(slopes) // 2]
+
+
+key = jax.random.PRNGKey(0)
+q = jax.random.normal(key, (B, H, S, HD), jnp.bfloat16)
+k = jax.random.normal(key, (B, KV, S, HD), jnp.bfloat16)
+v = jax.random.normal(key, (B, KV, S, HD), jnp.bfloat16)
+cot_o = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, HD), jnp.bfloat16)
+
+
+def attn_fwd_step(c):
+    qq, kk, vv = c
+    o = flash_attention(qq, kk, vv, causal=True)
+    return (qq + 1e-30 * o, kk, vv)
+
+
+def attn_bwd_step(c):
+    qq, kk, vv = c
+    _, vjp = jax.vjp(lambda a, b, d: flash_attention(a, b, d, causal=True),
+                     qq, kk, vv)
+    dq, dk, dv = vjp(cot_o)
+    return (qq + 1e-30 * dq, kk + 1e-30 * dk, vv + 1e-30 * dv)
+
+
+t_fwd = timed_slope_chain(attn_fwd_step, (q, k, v))
+t_bwd = timed_slope_chain(attn_bwd_step, (q, k, v))
+fl = 2 * 2 * B * H * S * S * HD / 2  # causal
+print(f"attn fwd      : {t_fwd*1e3:7.2f} ms  {fl/t_fwd/1e12:6.1f} TF/s "
+      f"(x{LAYERS} layers = {t_fwd*LAYERS*1e3:.0f} ms)", flush=True)
+print(f"attn bwd(+fwd): {t_bwd*1e3:7.2f} ms  {3.5*fl/t_bwd/1e12:6.1f} TF/s "
+      f"(x{LAYERS} = {t_bwd*LAYERS*1e3:.0f} ms)", flush=True)
+
+wg = jax.random.normal(key, (HID, INTER), jnp.bfloat16) * 0.02
+wu = jax.random.normal(key, (HID, INTER), jnp.bfloat16) * 0.02
+wd = jax.random.normal(key, (INTER, HID), jnp.bfloat16) * 0.02
+x = jax.random.normal(key, (B * S, HID), jnp.bfloat16)
+cot_x = jax.random.normal(jax.random.PRNGKey(2), (B * S, HID), jnp.bfloat16)
+
+
+def mlp(xx, g, u, d):
+    return (jax.nn.silu(xx @ g) * (xx @ u)) @ d
+
+
+def mlp_fwd_step(c):
+    xx, g, u, d = c
+    o = mlp(xx, g, u, d)
+    return (xx + 1e-30 * o, g, u, d)
+
+
+def mlp_bwd_step(c):
+    xx, g, u, d = c
+    _, vjp = jax.vjp(mlp, xx, g, u, d)
+    dx, dg, du, dd = vjp(cot_x)
+    return (xx + 1e-30 * dx, g + 1e-30 * dg, u + 1e-30 * du, d + 1e-30 * dd)
+
+
+t_mf = timed_slope_chain(mlp_fwd_step, (x, wg, wu, wd))
+t_mb = timed_slope_chain(mlp_bwd_step, (x, wg, wu, wd))
+mfl = 2 * 3 * B * S * HID * INTER
+print(f"mlp fwd       : {t_mf*1e3:7.2f} ms  {mfl/t_mf/1e12:6.1f} TF/s "
+      f"(x{LAYERS} = {t_mf*LAYERS*1e3:.0f} ms)", flush=True)
+print(f"mlp bwd(+fwd) : {t_mb*1e3:7.2f} ms  {3*mfl/t_mb/1e12:6.1f} TF/s "
+      f"(x{LAYERS} = {t_mb*LAYERS*1e3:.0f} ms)", flush=True)
+
+wq = jax.random.normal(key, (HID, HID), jnp.bfloat16) * 0.02
+
+
+def qo_step(c):
+    xx, w = c
+    o = xx @ w
+    return (xx + 1e-30 * o, w)
+
+
+t_qf = timed_slope_chain(qo_step, (x, wq))
+qfl = 2 * B * S * HID * HID
+print(f"qo proj       : {t_qf*1e3:7.2f} ms  {qfl/t_qf/1e12:6.1f} TF/s",
+      flush=True)

@@ -833,7 +833,10 @@ class LLMEngine:
             self.draft_cache = init_kv_cache(self.draft_cfg,
                                              self.max_slots, self.max_seq)
 
-    def _decode(self, active: dict[int, GenerationRequest]) -> None:
+    def _decode(self, active: dict[int, GenerationRequest]) -> bool:
+        """Returns False iff a device failure wiped the engine state
+        (_recover_device_failure ran) — callers mid-tick must then abandon
+        the rest of the tick rather than dispatch into rebuilt caches."""
         tokens = np.zeros((self.max_slots,), np.int32)
         positions = np.zeros((self.max_slots,), np.int32)
         write = np.zeros((self.max_slots,), bool)
@@ -849,7 +852,7 @@ class LLMEngine:
         except Exception as e:  # noqa: BLE001 - cache donated & lost
             logger.exception("decode step failed (%d active)", len(active))
             self._recover_device_failure(f"decode failed: {e!r}")
-            return
+            return False
         try:
             reqs = [active.get(s) for s in range(self.max_slots)]
             sampled = self._sample_one(logits, reqs)
@@ -858,10 +861,11 @@ class LLMEngine:
             logger.exception("sampling failed (%d active)", len(active))
             for req in active.values():
                 self._fail(req, f"sampling failed: {e!r}")
-            return
+            return True
         for slot, req in active.items():
             req.next_pos += 1
             self._emit(req, int(sampled[slot]))
+        return True
 
     def _spec_decode(self, active: dict[int, GenerationRequest]) -> None:
         """One speculative tick: draft proposes spec_k tokens per slot in
@@ -880,8 +884,11 @@ class LLMEngine:
         if not spec_active:
             self._decode(active)
             return
-        if plain_active:
-            self._decode(plain_active)
+        if plain_active and not self._decode(plain_active):
+            # The plain half hit a device failure: every slot (including
+            # the speculative ones) was failed and both caches rebuilt —
+            # nothing valid remains for the speculative half of this tick.
+            return
         active = spec_active
         # Draft catch-up: any slot whose draft cache lags (fresh prompt,
         # prefix adoption, PD import, all-k-accepted tail) prefills the

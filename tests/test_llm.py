@@ -576,18 +576,21 @@ class TestSpeculativeDecoding:
                                   max_seq_len=64,
                                   speculative_model="tiny",
                                   speculative_tokens=3))
-        orig = eng._draft_catch_up.__func__
+        # Fail the victim's DRAFT prefill dispatches at the device-call
+        # layer so the real _draft_catch_up except path (fail counting,
+        # disable-at-3, draft-cache rebuild) is what runs — not a stub
+        # re-implementing it.
+        import ray_tpu.llm.engine as engine_mod
+        orig_prefill = engine_mod.prefill_chunk
 
-        def failing(self_, slot, req):
-            if req.request_id == victim.request_id:
-                req.draft_fail_count += 1
-                if req.draft_fail_count >= 3:
-                    req.spec_disabled = True
-                return False
-            return orig(self_, slot, req)
+        def failing_prefill(cfg, params, cache, toks, start, end, slot):
+            if cfg is eng.draft_cfg and \
+                    eng._slots.get(int(slot)) is victim:
+                raise RuntimeError("injected draft prefill failure")
+            return orig_prefill(cfg, params, cache, toks, start, end, slot)
 
         try:
-            eng._draft_catch_up = failing.__get__(eng)
+            engine_mod.prefill_chunk = failing_prefill
             victim = eng.submit("doomed draft", sampling=SamplingParams(
                 max_tokens=10, temperature=0.0))
             assert victim.done.wait(60) and victim.error is None
@@ -600,6 +603,58 @@ class TestSpeculativeDecoding:
             assert not healthy.spec_disabled
             assert eng.stats()["spec_ticks"] > 0
         finally:
+            engine_mod.prefill_chunk = orig_prefill
+            eng.shutdown()
+
+    def test_spec_tick_abandoned_after_plain_decode_device_failure(self):
+        """Mixed tick: the plain-decode half hits a device failure, which
+        fails every request and rebuilds both caches. The speculative half
+        must then be abandoned — dispatching the draft against the rebuilt
+        state would emit garbage into already-failed requests."""
+        eng = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2,
+                                  max_seq_len=64,
+                                  speculative_model="tiny",
+                                  speculative_tokens=3))
+        import ray_tpu.llm.engine as engine_mod
+        orig_decode = engine_mod.decode_step
+        orig_propose = engine_mod.draft_propose
+        spec_dispatch_after_failure = []
+        failed_once = []
+
+        def both_decode_ready():
+            return (plain.out_tokens and spec.out_tokens
+                    and not plain.done.is_set() and not spec.done.is_set())
+
+        def failing_decode(*a, **kw):
+            # Fail only the mixed tick — when both requests decode in the
+            # same tick — so the injection deterministically hits the
+            # plain half of _spec_decode with the spec half pending.
+            if both_decode_ready():
+                failed_once.append(True)
+                raise RuntimeError("injected device failure")
+            return orig_decode(*a, **kw)
+
+        def recording_propose(*a, **kw):
+            if failed_once:
+                spec_dispatch_after_failure.append(True)
+            return orig_propose(*a, **kw)
+
+        try:
+            engine_mod.decode_step = failing_decode
+            engine_mod.draft_propose = recording_propose
+            plain = eng.submit("plain one", sampling=SamplingParams(
+                max_tokens=32, temperature=0.0))
+            plain.spec_disabled = True  # ride the plain half of the tick
+            spec = eng.submit("spec one", sampling=SamplingParams(
+                max_tokens=32, temperature=0.0))
+            assert plain.done.wait(60) and spec.done.wait(60)
+            assert plain.error is not None
+            assert spec.error is not None
+            assert not spec_dispatch_after_failure, (
+                "speculative half dispatched after device recovery")
+        finally:
+            engine_mod.decode_step = orig_decode
+            engine_mod.draft_propose = orig_propose
             eng.shutdown()
 
     def test_spec_mixed_batch_stochastic_falls_back(self):
