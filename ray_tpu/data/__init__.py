@@ -47,7 +47,9 @@ from ray_tpu.data.datasource import (
     ParquetDatasource,
     RangeDatasource,
     ReadTask,
+    SQLDatasource,
     TFRecordDatasource,
+    WebDatasetDatasource,
 )
 
 
@@ -98,6 +100,26 @@ def read_tfrecords(paths, *, raw: bool = False,
     ray.data.read_tfrecords) — decoded without a tensorflow dependency."""
     return Dataset([Read(TFRecordDatasource(
         paths, raw=raw, validate_data_crc=validate_data_crc), parallelism)])
+
+
+def read_sql(sql: str, connection_factory, *,
+             shard_column: str | None = None, num_shards: int = 1,
+             parallelism: int = -1) -> Dataset:
+    """Rows from any DB-API 2.0 database (reference: ray.data.read_sql).
+    ``connection_factory`` is a zero-arg callable returning a fresh
+    connection; with ``shard_column``/``num_shards`` the query range-
+    partitions into parallel read tasks."""
+    return Dataset([Read(SQLDatasource(
+        sql, connection_factory, shard_column=shard_column,
+        num_shards=num_shards), parallelism)])
+
+
+def read_webdataset(paths, *, decode_images: bool = True,
+                    parallelism: int = -1) -> Dataset:
+    """WebDataset tar shards, one sample per key (reference:
+    ray.data.read_webdataset). Columns named by member extension."""
+    return Dataset([Read(WebDatasetDatasource(
+        paths, decode_images=decode_images), parallelism)])
 
 
 def from_pandas(df) -> Dataset:
@@ -172,10 +194,12 @@ __all__ = [
     "read_binary_files",
     "read_csv",
     "read_images",
+    "read_sql",
     "read_tfrecords",
     "read_datasource",
     "read_json",
     "read_numpy",
+    "read_webdataset",
     "read_parquet",
 ]
 

@@ -389,6 +389,20 @@ class Dataset:
 
         return self._write(path, write_block_json)
 
+    def write_sql(self, sql: str, connection_factory) -> int:
+        """Insert every row through a DB-API 2.0 connection (reference:
+        Dataset.write_sql). ``sql`` is an INSERT with positional
+        placeholders matching the block's column order; each block runs
+        one executemany in its own remote task. Returns rows written."""
+        from ray_tpu.data.datasource import write_block_sql
+
+        api = _api()
+        ctx = DataContext.get_current()
+        write_remote = api.remote(num_cpus=ctx.task_num_cpus)(write_block_sql)
+        refs = [write_remote.remote(ref, sql, connection_factory)
+                for ref, _m in self._execute()]
+        return sum(api.get(refs))
+
     def __repr__(self) -> str:
         labels = [getattr(op, "label", type(op).__name__) for op in self._ops]
         return f"Dataset({' -> '.join(labels)})"

@@ -260,6 +260,143 @@ class TFRecordDatasource(FileDatasource):
         return example_rows_to_block([parse_example(r) for r in records])
 
 
+class SQLDatasource(Datasource):
+    """Rows from a DB-API 2.0 database (reference capability:
+    python/ray/data/read_api.py read_sql — sql + zero-arg connection
+    factory). Works with any DB-API driver; sqlite3 (stdlib) in tests.
+
+    Unsharded, the query runs as ONE read task. With ``shard_column`` (a
+    NUMERIC column) + ``num_shards``, the table is range-partitioned by
+    bound predicates computed from MIN/MAX so shards read in parallel —
+    the same strategy as the reference's sharded read_sql. Bounds are
+    inlined as numeric literals (driver paramstyles differ; numbers are
+    portable), and rows with a NULL shard key ride the first shard so
+    sharding never silently drops rows.
+    """
+
+    def __init__(self, sql: str, connection_factory: Callable[[], Any],
+                 shard_column: str | None = None, num_shards: int = 1):
+        self._sql = sql
+        self._factory = connection_factory
+        self._shard_column = shard_column
+        self._num_shards = max(1, num_shards)
+
+    @staticmethod
+    def _fetch(factory, sql, params=()) -> Block:
+        conn = factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql, params)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        return block_from_rows([dict(zip(cols, r)) for r in rows])
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        factory, sql = self._factory, self._sql
+        if self._shard_column is None or self._num_shards == 1:
+            return [ReadTask(lambda: self._fetch(factory, sql))]
+        col = self._shard_column
+        conn = factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(f"SELECT MIN({col}), MAX({col}) "  # noqa: S608
+                        f"FROM ({sql}) __rtpu_bounds")
+            lo, hi = cur.fetchone()
+        finally:
+            conn.close()
+        if lo is None:  # empty result set (or all-NULL shard column)
+            return [ReadTask(lambda: self._fetch(factory, sql))]
+        if not isinstance(lo, (int, float)) or isinstance(lo, bool):
+            raise ValueError(
+                f"shard_column {col!r} must be numeric for range "
+                f"sharding (got {type(lo).__name__}); omit shard_column "
+                f"to read unsharded")
+        tasks = []
+        span = (hi - lo) / self._num_shards
+        for i in range(self._num_shards):
+            a = lo + span * i
+            b = hi if i == self._num_shards - 1 else lo + span * (i + 1)
+            # last shard closes the interval so MAX rows aren't dropped
+            op = "<=" if i == self._num_shards - 1 else "<"
+            pred = f"({col} >= {a!r} AND {col} {op} {b!r})"
+            if i == 0:  # NULL keys satisfy no range predicate
+                pred = f"({pred} OR {col} IS NULL)"
+            shard_sql = (f"SELECT * FROM ({sql}) __rtpu_shard "  # noqa: S608
+                         f"WHERE {pred}")
+            tasks.append(ReadTask(
+                lambda s=shard_sql: self._fetch(factory, s)))
+        return tasks
+
+
+class WebDatasetDatasource(FileDatasource):
+    """WebDataset-style tar shards (reference capability:
+    python/ray/data/read_api.py read_webdataset): each shard is a .tar whose
+    members group into samples by key = basename up to the first dot; the
+    remaining extension names the column. One read task per shard — the
+    natural parallel unit.
+
+    Decoding: .json → parsed object, .txt/.cls → str (cls additionally int
+    when it parses), image extensions → decoded ndarray when PIL is
+    available (else raw bytes), everything else → bytes. Columns are named
+    by the FULL extension ("seg.png"), decode dispatches on the last
+    segment ("png") — standard WebDataset member naming.
+    """
+
+    suffixes = (".tar",)
+    _IMG_EXT = ("png", "jpg", "jpeg", "bmp", "gif", "webp")
+
+    def __init__(self, paths, decode_images: bool = True):
+        super().__init__(paths)
+        self._decode_images = decode_images
+
+    def _decode(self, ext: str, data: bytes):
+        import io
+        import json
+
+        ext = ext.rsplit(".", 1)[-1]  # "seg.png" decodes as "png"
+        if ext == "json":
+            return json.loads(data)
+        if ext in ("txt", "text"):
+            return data.decode()
+        if ext == "cls":
+            text = data.decode().strip()
+            try:
+                return int(text)
+            except ValueError:
+                return text
+        if ext in self._IMG_EXT and self._decode_images:
+            try:
+                Image = _import_pil()
+                with Image.open(io.BytesIO(data)) as im:
+                    return np.asarray(im.convert("RGB"))
+            except ImportError:
+                return data
+        return data
+
+    def read_file(self, path: str) -> Block:
+        import tarfile
+
+        samples: dict[str, dict] = {}
+        order: list[str] = []
+        with tarfile.open(path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                if "." in base:
+                    key, ext = base.split(".", 1)
+                else:
+                    key, ext = base, "bin"
+                data = tf.extractfile(member).read()
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext.lower()] = self._decode(ext.lower(), data)
+        return block_from_rows([samples[k] for k in order])
+
+
 # ---------------------------------------------------------------------------
 # write tasks
 
@@ -321,6 +458,26 @@ def write_block_json(block: Block, path: str, index: int) -> str:
         for row in BlockAccessor(block).iter_rows():
             f.write(json.dumps(row, default=_json_default) + "\n")
     return out
+
+
+def write_block_sql(block: Block, sql: str, connection_factory) -> int:
+    """executemany one block's rows through a fresh DB-API connection.
+    Values are converted to Python scalars (drivers reject numpy types)."""
+    from ray_tpu.data.block import BlockAccessor
+
+    rows = []
+    for row in BlockAccessor(block).iter_rows():
+        rows.append(tuple(v.item() if isinstance(v, np.generic) else v
+                          for v in row.values()))
+    if not rows:
+        return 0
+    conn = connection_factory()
+    try:
+        conn.cursor().executemany(sql, rows)
+        conn.commit()
+    finally:
+        conn.close()
+    return len(rows)
 
 
 def _json_default(v: Any):

@@ -11,7 +11,9 @@ pure JAX (jit-compiled, mesh-shardable) instead of torch.
 
 from ray_tpu.rl.env import CartPoleEnv, PendulumEnv, VectorEnv, make_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.appo import APPO, APPOConfig
 from ray_tpu.rl.bc import BC, BCConfig
+from ray_tpu.rl.cql import CQL, CQLConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.impala import IMPALA, ImpalaConfig
 from ray_tpu.rl.multi_agent import (
@@ -33,6 +35,8 @@ __all__ = [
     "SAC", "SACConfig",
     "DQN", "DQNConfig",
     "IMPALA", "ImpalaConfig",
+    "APPO", "APPOConfig",
+    "CQL", "CQLConfig",
     "MultiAgentEnv", "MultiAgentEnvRunner", "CoordinationGame", "ChaseGame",
     "MultiAgentPPO", "MultiAgentPPOConfig",
     "BC", "BCConfig",

@@ -1,0 +1,85 @@
+"""APPO: asynchronous PPO — IMPALA's actor-learner protocol with PPO's
+clipped-surrogate objective on V-trace-corrected advantages.
+
+Capability parity with the reference's async-PPO family (reference:
+rllib/algorithms/appo/appo.py — APPO subclasses IMPALA's execution plan and
+swaps the loss for the clipped surrogate over V-trace advantages, so the
+learner tolerates behaviour-policy lag AND bounds the per-update policy
+step). The runner protocol, staleness bounds, and elastic runner handling
+are inherited from the IMPALA implementation (ray_tpu/rl/impala.py); only
+the jitted update differs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl.impala import IMPALA, ImpalaConfig, vtrace
+from ray_tpu.rl.ppo import mlp_apply
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def appo_update(optimizer, cfg_static, params, opt_state, batch):
+    """One clipped-surrogate update over a [T, N] rollout batch with
+    V-trace advantages (reference: appo_torch_learner loss)."""
+    gamma, rho_clip, c_clip, vf_coef, ent_coef, clip_eps = cfg_static
+
+    def loss_fn(p):
+        logits = mlp_apply(p["pi"], batch["obs"])          # [T, N, A]
+        values = mlp_apply(p["vf"], batch["obs"])[..., 0]  # [T, N]
+        last_value = mlp_apply(p["vf"], batch["last_obs"])[..., 0]
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        vs, pg_adv = vtrace(batch["logp"], logp, batch["rewards"], values,
+                            batch["dones"], last_value, gamma, rho_clip,
+                            c_clip)
+        adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+        ratio = jnp.exp(logp - batch["logp"])
+        clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
+        pg = -jnp.minimum(ratio * adv, clipped * adv).mean()
+        vf = 0.5 * ((values - vs) ** 2).mean()
+        ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        return pg + vf_coef * vf - ent_coef * ent, (pg, vf, ent)
+
+    (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    pg, vf, ent = aux
+    return params, opt_state, {"policy_loss": pg, "vf_loss": vf,
+                               "entropy": ent}
+
+
+@dataclass
+class APPOConfig(ImpalaConfig):
+    clip_eps: float = 0.3
+
+    def build(self) -> "APPO":
+        return APPO({"appo_config": self})
+
+
+class APPO(IMPALA):
+    """Async PPO (reference: appo.py). Everything but the update — runner
+    fan-out, staleness drop, weight push — is the IMPALA machinery."""
+
+    def setup(self, config: dict) -> None:
+        cfg = config.get("appo_config")
+        if cfg is None:
+            cfg = APPOConfig(**{k: v for k, v in config.items()
+                                if k in APPOConfig.__dataclass_fields__})
+        super().setup({"impala_config": cfg})
+
+    def _update_from(self, sample: dict) -> dict:
+        static = (self.cfg.gamma, self.cfg.rho_clip, self.cfg.c_clip,
+                  self.cfg.vf_coef, self.cfg.ent_coef, self.cfg.clip_eps)
+        self.params, self.opt_state, stats = appo_update(
+            self.optimizer, static, self.params, self.opt_state,
+            self._batch_from(sample))
+        self.weight_version += 1
+        self._return_window.extend(sample["episode_returns"])
+        return stats

@@ -1,0 +1,158 @@
+"""CQL: conservative Q-learning — offline RL over logged transitions.
+
+Capability parity with the reference's offline value-based family
+(reference: rllib/algorithms/cql/cql.py — CQL adds a conservative
+regularizer to the TD loss so Q-values of actions absent from the dataset
+are pushed DOWN, preventing the offline-RL failure mode where argmax-Q
+exploits overestimated out-of-distribution actions). Discrete CQL(H):
+
+    loss = TD_huber + alpha * mean( logsumexp_a Q(s, a) - Q(s, a_data) )
+
+The dataset is a ray_tpu.data Dataset with obs/actions/rewards/next_obs/
+dones columns (the same layout BC and the replay buffer use); batches
+stream through iter_batches, the update is jitted, and a target network
+tracks the online net like DQN's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.ppo import init_mlp, mlp_apply
+from ray_tpu.tune.trainable import Trainable
+
+
+@partial(jax.jit, static_argnums=(0,))
+def cql_update(optimizer, params, target_params, opt_state, batch,
+               gamma, alpha):
+    def loss_fn(p):
+        q = mlp_apply(p, batch["obs"])                       # [B, A]
+        q_sa = jnp.take_along_axis(q, batch["actions"][:, None], 1)[:, 0]
+        q_next = mlp_apply(target_params, batch["next_obs"]).max(-1)
+        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+            jax.lax.stop_gradient(q_next)
+        td = optax.huber_loss(q_sa, target).mean()
+        # Conservative gap: how far OOD actions sit above the data action.
+        gap = (jax.nn.logsumexp(q, axis=-1) - q_sa).mean()
+        return td + alpha * gap, (td, gap)
+
+    (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    td, gap = aux
+    return optax.apply_updates(params, updates), opt_state, td, gap
+
+
+@dataclass
+class CQLConfig:
+    env: str = "CartPole-v1"           # spaces + optional evaluation
+    dataset: Any = None                # obs/actions/rewards/next_obs/dones
+    lr: float = 1e-3
+    gamma: float = 0.99
+    alpha: float = 1.0                 # conservative-regularizer weight
+    batch_size: int = 256
+    epochs_per_step: int = 1
+    target_update_every: int = 32      # updates between target-net syncs
+    hidden: int = 64
+    evaluation_episodes: int = 0
+    seed: int = 0
+    extra: dict = field(default_factory=dict)
+
+    def build(self) -> "CQL":
+        return CQL({"cql_config": self})
+
+
+class CQL(Trainable):
+    """Offline conservative Q-learning (reference: cql.py)."""
+
+    def setup(self, config: dict) -> None:
+        cfg = config.get("cql_config") or CQLConfig(
+            **{k: v for k, v in config.items()
+               if k in CQLConfig.__dataclass_fields__})
+        if cfg.dataset is None:
+            raise ValueError("CQLConfig.dataset is required (offline data)")
+        self.cfg = cfg
+        probe = make_env(cfg.env, seed=cfg.seed)
+        self.params = init_mlp(
+            jax.random.PRNGKey(cfg.seed),
+            [probe.observation_size, cfg.hidden, cfg.hidden,
+             probe.num_actions])
+        self.target_params = self.params
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._updates = 0
+
+    def step(self) -> dict:
+        cfg = self.cfg
+        td_sum = gap_sum = 0.0
+        seen = 0
+        for _ in range(cfg.epochs_per_step):
+            for batch in cfg.dataset.iter_batches(
+                    batch_size=cfg.batch_size,
+                    local_shuffle_buffer_size=4 * cfg.batch_size,
+                    local_shuffle_seed=cfg.seed + self.iteration):
+                jb = {
+                    "obs": jnp.asarray(np.asarray(batch["obs"], np.float32)),
+                    "actions": jnp.asarray(
+                        np.asarray(batch["actions"], np.int32)),
+                    "rewards": jnp.asarray(
+                        np.asarray(batch["rewards"], np.float32)),
+                    "next_obs": jnp.asarray(
+                        np.asarray(batch["next_obs"], np.float32)),
+                    "dones": jnp.asarray(
+                        np.asarray(batch["dones"], np.float32)),
+                }
+                self.params, self.opt_state, td, gap = cql_update(
+                    self.optimizer, self.params, self.target_params,
+                    self.opt_state, jb, cfg.gamma, cfg.alpha)
+                n = len(jb["actions"])
+                td_sum += float(td) * n
+                gap_sum += float(gap) * n
+                seen += n
+                self._updates += 1
+                if self._updates % cfg.target_update_every == 0:
+                    self.target_params = self.params
+        denom = max(seen, 1)
+        out = {"td_loss": td_sum / denom,
+               "conservative_gap": gap_sum / denom,
+               "num_samples_trained": seen}
+        if cfg.evaluation_episodes > 0:
+            out["episode_return_mean"] = self._evaluate(
+                cfg.evaluation_episodes)
+        return out
+
+    def _evaluate(self, episodes: int) -> float:
+        returns = []
+        env = make_env(self.cfg.env, seed=self.cfg.seed + 10_000)
+        for _ in range(episodes):
+            obs = env.reset()
+            total, done, steps = 0.0, False, 0
+            while not done and steps < 1000:
+                a = int(np.asarray(
+                    mlp_apply(self.params, jnp.asarray(obs[None]))
+                ).argmax(-1)[0])
+                obs, r, term, trunc = env.step(a)
+                done = term or trunc
+                total += r
+                steps += 1
+            returns.append(total)
+        return float(np.mean(returns))
+
+    def save_checkpoint(self) -> Any:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "target_params": jax.tree.map(np.asarray, self.target_params),
+                "updates": self._updates, "iteration": self.iteration}
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, checkpoint["params"])
+        self.target_params = jax.tree.map(jnp.asarray,
+                                          checkpoint["target_params"])
+        self._updates = checkpoint["updates"]
+        self.iteration = checkpoint["iteration"]

@@ -180,8 +180,11 @@ class IMPALA(Trainable):
             self._factory, seed=seed)
 
     # -- learner ------------------------------------------------------------
-    def _update_from(self, sample: dict) -> dict:
-        batch = {
+    @staticmethod
+    def _batch_from(sample: dict) -> dict:
+        """Device arrays for the jitted update — shared by every
+        actor-learner algorithm riding this runner protocol (APPO)."""
+        return {
             "obs": jnp.asarray(sample["obs"]),
             "actions": jnp.asarray(sample["actions"]),
             "logp": jnp.asarray(sample["logp"]),
@@ -189,10 +192,13 @@ class IMPALA(Trainable):
             "dones": jnp.asarray(sample["dones"]),
             "last_obs": jnp.asarray(sample["last_obs"]),
         }
+
+    def _update_from(self, sample: dict) -> dict:
         static = (self.cfg.gamma, self.cfg.rho_clip, self.cfg.c_clip,
                   self.cfg.vf_coef, self.cfg.ent_coef)
         self.params, self.opt_state, stats = impala_update(
-            self.optimizer, static, self.params, self.opt_state, batch)
+            self.optimizer, static, self.params, self.opt_state,
+            self._batch_from(sample))
         self.weight_version += 1
         self._return_window.extend(sample["episode_returns"])
         return stats
