@@ -1,0 +1,106 @@
+"""Slope-time the optimizer update alone at the bench model geometry.
+
+Isolates the ~50 ms/step "optimizer_ms" residual from PERF_STEP.json:
+is it HBM-bandwidth (expected ~18 ms for bf16 moments at 819 GB/s) or
+fusion/launch overhead? Usage:
+  PYTHONPATH=. python devbench/prof_optim.py [variant ...]
+variants: compact (bench default), adamw (stock optax), fused (pallas).
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.llama import LlamaConfig, init_params
+from ray_tpu.train.optim import adamw_lowmem
+
+L1, L2 = 3, 10
+
+
+def timed_slope(step, state0, reps=5):
+    """Donating slope timer: each call consumes the previous state (no
+    input copies — the 1B state + moments barely fit HBM twice)."""
+    def run_for(n):
+        @functools.partial(jax.jit, donate_argnums=0)
+        def run(s):
+            def body(s, _):
+                return step(s), None
+            s, _ = lax.scan(body, s, None, length=n)
+            # Scalar probe: fetching it host-side is what actually waits for
+            # the computation on the axon tunnel (block_until_ready on the
+            # remote arrays returns early).
+            probe = jax.tree_util.tree_reduce(
+                lambda a, x: a + x.ravel()[0].astype(jnp.float32), s, 0.0)
+            return s, probe
+        return run
+
+    def call(r, s):
+        s, probe = r(s)
+        float(probe)
+        return s
+
+    r1, r2 = run_for(L1), run_for(L2)
+    s = call(r1, state0)
+    s = call(r2, s)
+    slopes = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        s = call(r1, s)
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        s = call(r2, s)
+        t2 = time.perf_counter() - t0
+        slopes.append((t2 - t1) / (L2 - L1))
+    slopes.sort()
+    return slopes[len(slopes) // 2]
+
+
+cfg = LlamaConfig.llama3_1b()
+params = jax.jit(lambda k: init_params(cfg, k))(jax.random.PRNGKey(0))
+nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
+nparams = sum(x.size for x in jax.tree.leaves(params))
+print(f"params: {nparams/1e9:.2f}B, {nbytes/1e9:.2f} GB, "
+      f"{len(jax.tree.leaves(params))} tensors")
+# Masters live on HOST — each bench() materializes a fresh device copy and
+# the device state is donated away; keeping device masters alive would not
+# leave room for params + moments + grads twice in 15.75 GB HBM.
+params = jax.tree.map(lambda x: jax.device_get(x), params)
+grads = jax.tree.map(lambda p: (p * 1e-3).astype(p.dtype), params)
+
+import optax
+
+
+def bench(name, opt):
+    # Fresh device copies — timed_slope donates (consumes) its state.
+    p0 = jax.device_put(params)
+    g0 = jax.device_put(grads)
+    opt_state = jax.jit(opt.init)(p0)
+    mom_bytes = sum(x.nbytes for x in jax.tree.leaves(opt_state))
+    state0 = (p0, opt_state, g0)
+
+    def step(s):
+        p, os_, g = s
+        updates, os2 = opt.update(g, os_, p)
+        p2 = optax.apply_updates(p, updates)
+        return (p2, os2, g)
+
+    t = timed_slope(step, state0)
+    # traffic: read g + read/write p + read/write moments
+    traffic = nbytes * 2 + nbytes + mom_bytes * 2
+    print(f"{name:12s} {t*1e3:7.2f} ms  opt_state {mom_bytes/1e9:.2f} GB  "
+          f"~{traffic/1e9:.1f} GB traffic -> {traffic/t/1e9:.0f} GB/s eff",
+          flush=True)
+
+
+WHICH = set(sys.argv[1:]) or {"compact", "adamw"}
+if "compact" in WHICH:
+    bench("compact", adamw_lowmem(3e-4, weight_decay=0.1))
+if "adamw" in WHICH:
+    bench("stock adamw", optax.adamw(3e-4, weight_decay=0.1,
+                                     mu_dtype=jnp.bfloat16))
+if "fused" in WHICH:
+    from ray_tpu.train.optim import adamw_fused
+    bench("fused", adamw_fused(3e-4, weight_decay=0.1))

@@ -28,6 +28,8 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634  # log2(e): kernels run base-2 softmax (exp2 is
+LN2 = 0.6931471805599453    # the VPU-native transcendental; exp = mul+exp2)
 
 # When True, Pallas kernels run in interpreter mode (and the Pallas path is
 # taken off-TPU too) — lets CPU tests exercise the exact kernel code.
@@ -145,20 +147,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_seq_len: int,
     # the fast path (~6x slower). Scale is applied to the f32 logits.
 
     nkv = kv_seq_len // block_k
+    scale2 = sm_scale * LOG2E  # base-2 logits: p = exp2(s2 - m2)
 
-    def body(j, carry):
+    def body(j, carry, masked):
         o, m, l = carry
         k = k_ref[pl.ds(j * block_k, block_k), :]
         v = v_ref[pl.ds(j * block_k, block_k), :]
         s = jnp.dot(q, k.T,
-                    preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        if causal:
+                    preferred_element_type=jnp.float32) * scale2  # [bq, bk]
+        if masked:
             qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
         o_new = o * alpha[:, None] + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
@@ -171,15 +174,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_seq_len: int,
     l0 = jnp.zeros((q.shape[0],), jnp.float32)
 
     if causal:
-        # Skip fully-masked KV blocks beyond this Q block's diagonal.
         upper = lax.div((qi + 1) * block_q + block_k - 1, block_k)
         upper = jnp.minimum(upper, nkv)
+        o, m, l = lax.fori_loop(
+            0, upper, functools.partial(body, masked=True), (o0, m0, l0))
     else:
-        upper = nkv
-    o, m, l = lax.fori_loop(0, upper, body, (o0, m0, l0))
+        o, m, l = lax.fori_loop(
+            0, nkv, functools.partial(body, masked=False), (o0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, :] = m + jnp.log(l)
+    lse_ref[0, :] = (m + jnp.log2(l)) * LN2  # natural-log lse (external contract)
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
@@ -248,19 +252,20 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     q = q_ref[...]                       # [bq, d] bf16
     do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[0, :]                  # [bq] f32
+    lse2 = lse_ref[0, :] * LOG2E         # [bq] f32, base-2
     delta = delta_ref[0, :]              # [bq] f32
     nkv = kv_seq_len // block_k
+    scale2 = sm_scale * LOG2E
 
     def body(j, dq):
         k = k_ref[pl.ds(j * block_k, block_k), :]
         v = v_ref[pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale2
         if causal:
             qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])    # [bq, bk]
+        p = jnp.exp2(s - lse2[:, None])  # [bq, bk]
         dp = jnp.dot(do.astype(v.dtype), v.T,
                      preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
@@ -289,19 +294,20 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[...]                       # [bk, d] bf16
     v = v_ref[...]
     nq = q_seq_len // block_q
+    scale2 = sm_scale * LOG2E
 
     def body(i, carry):
         dk, dv = carry
         q = q_ref[pl.ds(i * block_q, block_q), :]
         do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        lse2 = lse_ref[0, pl.ds(i * block_q, block_q)] * LOG2E
         delta = delta_ref[0, pl.ds(i * block_q, block_q)]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale2
         if causal:
             qpos = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])    # [bq, bk]
+        p = jnp.exp2(s - lse2[:, None])  # [bq, bk]
         dv = dv + jnp.dot(p.astype(do.dtype).T, do,
                           preferred_element_type=jnp.float32)
         dp = jnp.dot(do.astype(v.dtype), v.T,
@@ -345,20 +351,21 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[...]                       # [bq, d] bf16
     do = do_ref[...]                     # [bq, d] bf16
-    lse = lse_ref[0, :]                  # [bq] f32
+    lse2 = lse_ref[0, :] * LOG2E         # [bq] f32, base-2 (p = exp2(s2-lse2))
     delta = delta_ref[0, :]              # [bq] f32
     nkv = kv_seq_len // block_k
+    scale2 = sm_scale * LOG2E
 
     def body(j, dq):
         kslc = pl.ds(j * block_k, block_k)
         k = k_ref[kslc, :]
         v = v_ref[kslc, :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale2
         if causal:
             qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])    # [bq, bk]
+        p = jnp.exp2(s - lse2[:, None])  # [bq, bk]
         dp = jnp.dot(do.astype(v.dtype), v.T,
                      preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
