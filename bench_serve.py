@@ -53,8 +53,32 @@ def main() -> None:
     port = serve.http_port()
     url = f"http://127.0.0.1:{port}/v1/chat/completions"
 
-    # Warm the engine (first compile).
-    _one_request(url, max_tokens=4)
+    # Warm EVERY steady-state shape before timing — first compile through
+    # the tunnel is tens of seconds and must not land inside the
+    # measurement (the r04 cold run's p90 TTFT was compile time, not
+    # serving time):
+    #   - prefill bucket for the short prompts,
+    #   - burst-decode shapes {8,4,2,1} plus the single-step decode path:
+    #     prefill emits token 1, so max_tokens=16 leaves 15 = 8+4+2+1 —
+    #     aligned requests walk exactly that ladder,
+    #   - sampling + admission under concurrency.
+    warm_threads = [
+        threading.Thread(target=_safe_request,
+                         args=(url,), kwargs={"max_tokens": 16,
+                                              "seed": 900 + i})
+        for i in range(concurrency)
+    ]
+    for t in warm_threads:
+        t.start()
+    for t in warm_threads:
+        t.join()
+    # Prefix-phase shapes: same token LENGTH as phase B's shared prefix
+    # (same chunk buckets) but zero common prefix (first char differs), so
+    # phase B's cold request stays genuinely cold. The second call warms
+    # the rehit path (donor adoption + tail-chunk bucket).
+    warm_prefix = "Xou are a careful assistant. " * (40 if on_tpu else 8)
+    _safe_request(url, max_tokens=8, prefix=warm_prefix, seed=980)
+    _safe_request(url, max_tokens=8, prefix=warm_prefix, seed=981)
 
     ttfts, totals, tokens_out = [], [], []
     lock = threading.Lock()
@@ -133,6 +157,17 @@ def main() -> None:
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
+
+
+def _safe_request(url: str, max_tokens: int, seed: int = 0,
+                  prefix: str | None = None):
+    """Warmup helper: a failed warm request must not kill the bench."""
+    try:
+        return _one_request(url, max_tokens=max_tokens, seed=seed,
+                            prefix=prefix)
+    except Exception as e:  # noqa: BLE001
+        print(f"warmup request failed: {e}", file=sys.stderr)
+        return None
 
 
 def _one_request(url: str, max_tokens: int, seed: int = 0,

@@ -1,0 +1,71 @@
+"""Split engine-core throughput from the serve-stack overhead.
+
+bench_serve.py (proxy → router → replica → engine, SSE streaming) measures
+~41 tok/s on the chip; this drives LLMEngine DIRECTLY with the same
+geometry/load so the difference attributes the gap.
+
+PYTHONPATH=. python devbench/prof_engine.py [tiny]
+"""
+import sys
+import threading
+import time
+
+from ray_tpu.llm import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import LLMEngine
+
+tiny = "tiny" in sys.argv[1:]
+cfg = LLMConfig(model="tiny" if tiny else "llama3_1b",
+                max_num_seqs=8, max_seq_len=256 if tiny else 1024,
+                dtype=None if tiny else "bfloat16")
+eng = LLMEngine(cfg)
+
+import os
+N = int(os.environ.get("RTPU_PROF_N", "48"))
+CONC, MAXTOK = 8, 32
+print("warming...", flush=True)
+eng.generate("warm " * 4, SamplingParams(max_tokens=15))
+
+sem = threading.Semaphore(CONC)
+lock = threading.Lock()
+stats = {"tokens": 0, "ttfts": []}
+
+
+def worker(i):
+    with sem:
+        t0 = time.perf_counter()
+        first = []
+
+        # generate() is blocking; use submit + stream queue for TTFT
+        req = eng.submit(f"benchmark prompt {i} " * 4,
+                         sampling=SamplingParams(max_tokens=MAXTOK),
+                         stream=True)
+        q = req.stream_queue
+        n = 0
+        while True:
+            tok = q.get(timeout=300)
+            if tok is None:
+                break
+            if not first:
+                first.append(time.perf_counter() - t0)
+            n += 1
+        with lock:
+            stats["tokens"] += n
+            stats["ttfts"].append(first[0] if first else -1)
+
+
+t0 = time.perf_counter()
+threads = [threading.Thread(target=worker, args=(i,)) for i in range(N)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+wall = time.perf_counter() - t0
+ttfts_ordered = [t for t in stats["ttfts"] if t >= 0]
+qt = max(1, len(ttfts_ordered) // 4)
+print(f"ttft first-quartile mean {sum(ttfts_ordered[:qt])/qt*1e3:.0f} ms, "
+      f"last-quartile mean {sum(ttfts_ordered[-qt:])/qt*1e3:.0f} ms")
+ttfts = sorted(ttfts_ordered)
+print(f"engine-direct: {stats['tokens']} tokens in {wall:.1f}s = "
+      f"{stats['tokens']/wall:.1f} tok/s; "
+      f"ttft p50 {ttfts[len(ttfts)//2]*1e3:.0f} ms", flush=True)
+eng.shutdown()

@@ -1,0 +1,158 @@
+"""Timestamp every SSE frame of a few concurrent requests through the full
+serve stack, to localize where the TPU serve path loses time
+(bench_serve ~41 tok/s vs engine-direct ~130 tok/s).
+
+PYTHONPATH=. python devbench/prof_serve_frames.py
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.llm import LLMConfig
+from ray_tpu.llm.serving import build_openai_app
+
+import os as _os
+if _os.environ.get("RTPU_PROF_TINY") == "1":
+    cfg = LLMConfig(model="tiny", max_num_seqs=8, max_seq_len=256)
+else:
+    cfg = LLMConfig(model="llama3_1b", max_num_seqs=8, max_seq_len=1024,
+                    dtype="bfloat16")
+url = None
+import sys as _sys
+if "sustained" not in _sys.argv:
+    ray_tpu.init()
+    serve.run(build_openai_app(cfg), route_prefix="/", http=True)
+    url = f"http://127.0.0.1:{serve.http_port()}/v1/chat/completions"
+
+
+def req(i, frames, max_tokens=24):
+    body = json.dumps({
+        "messages": [{"role": "user", "content": f"benchmark prompt {i} " * 4}],
+        "max_tokens": max_tokens, "temperature": 0.0, "stream": True,
+    }).encode()
+    r = urllib.request.Request(url, data=body,
+                               headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    buf = b""
+    with urllib.request.urlopen(r, timeout=300) as resp:
+        while True:
+            chunk = resp.read1(8192)
+            if not chunk:
+                break
+            buf += chunk
+            fs = buf.split(b"\n\n")
+            buf = fs.pop()
+            now = time.perf_counter() - t0
+            for f in fs:
+                if f.startswith(b"data:") and b'"content"' in f:
+                    frames.append(now)
+
+
+_MAIN = "sustained" not in _sys.argv
+
+# warm
+def _light_probe():
+    w = []
+    req(990, w, max_tokens=15)
+    print(f"warm: {len(w)} frames, last at {w[-1]:.2f}s")
+
+    f1 = []
+    req(1, f1)
+    gaps = [f1[i] - f1[i - 1] for i in range(1, len(f1))]
+    print(f"single: ttft {f1[0]*1e3:.0f} ms, {len(f1)} frames, "
+          f"gaps ms: {[round(g*1e3) for g in gaps]}")
+
+    all_frames = [[] for _ in range(4)]
+    ts = [threading.Thread(target=req, args=(10 + i, all_frames[i]))
+          for i in range(4)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    tot = sum(len(f) for f in all_frames)
+    print(f"4-conc: {tot} tokens in {wall:.1f}s = {tot/wall:.0f} tok/s")
+    for i, f in enumerate(all_frames):
+        gaps = [round((f[j] - f[j-1]) * 1e3) for j in range(1, len(f))]
+        print(f"  r{i}: ttft {f[0]*1e3:.0f} ms gaps {gaps}")
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def sustained(n=40, conc=8, max_tokens=32, prefix_warm=False):
+    import numpy as np
+    ray_tpu.init()
+    serve.run(build_openai_app(cfg), route_prefix="/", http=True)
+    u = f"http://127.0.0.1:{serve.http_port()}/v1/chat/completions"
+    globals()["url"] = u
+    w = []
+    req(991, w, max_tokens=15)
+    if prefix_warm:  # replicate bench_serve's long-prefix warm requests
+        shared = "Xou are a careful assistant. " * 40
+        body = json.dumps({"messages": [
+            {"role": "user", "content": shared + "question 980"}],
+            "max_tokens": 8, "temperature": 0.0, "stream": True}).encode()
+        for _ in range(2):
+            rq = urllib.request.Request(
+                u, data=body, headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(rq, timeout=300) as resp:
+                while resp.read1(8192):
+                    pass
+        print("prefix warm done")
+    sem = threading.Semaphore(conc)
+    out = []
+    lock = threading.Lock()
+
+    def worker(i):
+        with sem:
+            frames = []
+            t0 = time.perf_counter()
+            try:
+                req(i, frames, max_tokens=max_tokens)
+            except Exception as e:
+                print("fail", i, e)
+                return
+            with lock:
+                out.append((frames, time.perf_counter() - t0))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    tot = sum(len(f) for f, _ in out)
+    # degradation curve: completion order TTFT, first vs last quartile
+    qt = max(1, len(out) // 4)
+    early = [f[0] for f, _ in out[:qt] if f]
+    late = [f[0] for f, _ in out[-qt:] if f]
+    print(f"ttft first-quartile mean {sum(early)/len(early)*1e3:.0f} ms, "
+          f"last-quartile mean {sum(late)/len(late)*1e3:.0f} ms")
+    ttfts = sorted(f[0] for f, _ in out if f)
+    print(f"sustained: {tot} tokens / {wall:.1f}s = {tot/wall:.0f} tok/s, "
+          f"ttft p50 {ttfts[len(ttfts)//2]*1e3:.0f} ms "
+          f"p90 {ttfts[int(len(ttfts)*0.9)]*1e3:.0f} ms")
+    # biggest inter-frame gaps across all requests
+    gaps = []
+    for f, _ in out:
+        gaps += [f[i] - f[i-1] for i in range(1, len(f))]
+    gaps.sort()
+    print(f"frame gaps ms: p50 {gaps[len(gaps)//2]*1e3:.0f} "
+          f"p90 {gaps[int(len(gaps)*.9)]*1e3:.0f} "
+          f"p99 {gaps[int(len(gaps)*.99)]*1e3:.0f} max {gaps[-1]*1e3:.0f}")
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    if "sustained" in _sys.argv:
+        n = 100 if "n100" in _sys.argv else 40
+        sustained(n=n, prefix_warm="prefixwarm" in _sys.argv)
+    else:
+        _light_probe()

@@ -1,0 +1,107 @@
+"""Bisect the serve-stack degradation: drive the deployment HANDLE directly
+(router → replica actor → engine via the runtime's streaming generator) with
+bench-shaped load, skipping the HTTP proxy. Compare with
+prof_serve_frames.py (full stack) and prof_engine.py (engine only).
+
+RTPU_PROF_TINY=1 JAX_PLATFORMS=cpu PYTHONPATH=. python devbench/prof_serve_handle.py
+"""
+import os
+import threading
+import time
+
+import ray_tpu
+import ray_tpu.core.worker
+from ray_tpu import serve
+from ray_tpu.llm import LLMConfig
+from ray_tpu.llm.serving import build_openai_app
+from ray_tpu.serve.http_proxy import Request
+import json
+
+if os.environ.get("RTPU_PROF_TINY") == "1":
+    cfg = LLMConfig(model="tiny", max_num_seqs=8, max_seq_len=256)
+else:
+    cfg = LLMConfig(model="llama3_1b", max_num_seqs=8, max_seq_len=1024,
+                    dtype="bfloat16")
+
+N = int(os.environ.get("RTPU_PROF_N", "100"))
+CONC, MAXTOK = 8, 32
+
+ray_tpu.init()
+app = serve.run(build_openai_app(cfg), route_prefix="/", http=False)
+handle = serve.get_deployment_handle("LLMServer")
+
+
+def one(i, stats=None):
+    body = json.dumps({
+        "messages": [{"role": "user", "content": f"benchmark prompt {i} " * 4}],
+        "max_tokens": MAXTOK, "temperature": 0.0, "stream": True,
+    }).encode()
+    req = Request(method="POST", path="/v1/chat/completions",
+                  query_params={}, headers={}, body=body)
+    t0 = time.perf_counter()
+    gen = handle.options(stream=True).remote(req)
+    assert gen.streaming  # forces the meta fetch
+    t_meta = time.perf_counter() - t0
+    first, n = None, 0
+    for chunk in gen:
+        if isinstance(chunk, str) and '"content"' in chunk:
+            if first is None:
+                first = time.perf_counter() - t0
+            n += 1
+    return first, n, t_meta
+
+
+print("warm:", one(991)[:2])
+
+sem = threading.Semaphore(CONC)
+lock = threading.Lock()
+out = []
+
+
+def _sizes():
+    rt = ray_tpu.core.worker.global_worker.runtime
+    store = getattr(rt, "store", None)
+    data = getattr(store, "_data", None) or getattr(store, "_objects", {})
+    refs = getattr(rt, "refs", None)
+    counts = {}
+    for attr in dir(refs):
+        v = getattr(refs, attr, None)
+        if isinstance(v, (dict, set)) and not attr.startswith("__"):
+            counts[attr] = len(v)
+    return len(data), counts, len(getattr(rt, "_released", []))
+
+
+def worker(i):
+    with sem:
+        try:
+            ttft, n, t_meta = one(i)
+        except Exception as e:  # noqa: BLE001
+            print("fail", i, repr(e)[:120])
+            return
+        with lock:
+            out.append((ttft, n))
+            if len(out) % 20 == 0:
+                r = handle._router
+                inflight = dict(getattr(r, "_inflight", {}))
+                print(f"[done={len(out)}] ttft={ttft*1e3:.0f}ms "
+                      f"meta={t_meta*1e3:.0f}ms "
+                      f"threads={threading.active_count()} "
+                      f"router_inflight={inflight}", flush=True)
+
+
+ts = [threading.Thread(target=worker, args=(i,)) for i in range(N)]
+t0 = time.perf_counter()
+for t in ts:
+    t.start()
+for t in ts:
+    t.join()
+wall = time.perf_counter() - t0
+tot = sum(n for _, n in out)
+qt = max(1, len(out) // 4)
+early = [t for t, _ in out[:qt] if t]
+late = [t for t, _ in out[-qt:] if t]
+print(f"handle-direct: {tot} tokens / {wall:.1f}s = {tot/wall:.0f} tok/s; "
+      f"ttft first-q {sum(early)/len(early)*1e3:.0f} ms, "
+      f"last-q {sum(late)/len(late)*1e3:.0f} ms")
+serve.shutdown()
+ray_tpu.shutdown()

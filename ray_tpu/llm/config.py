@@ -53,6 +53,22 @@ class LLMConfig:
     speculative_model: LlamaConfig | str | None = None
     speculative_tokens: int = 4
     speculative_checkpoint_path: str | None = None
+    # Burst decoding: run up to this many decode+sample steps in ONE jitted
+    # dispatch (lax.scan feeds each sampled token into the next step on
+    # device). Amortizes the host→device dispatch + token-fetch roundtrip —
+    # the dominant per-token cost whenever the accelerator is remote or the
+    # model is small — across D tokens; 1 restores step-per-dispatch. The
+    # burst length adapts down (powers of two) near request token budgets,
+    # so only {8,4,2} shapes ever compile. Sampling inside a burst supports
+    # temperature/top-p; a top-k request in the batch falls back to
+    # single-step ticks.
+    decode_burst: int = 8
+    # Prefill chunks dispatched per scheduler tick. The tick defers every
+    # prefill's first-token fetch until after its decode dispatch, so a
+    # bigger budget admits a burst of new requests in ONE roundtrip instead
+    # of one tick each — at the cost of that many chunks of prefill compute
+    # between decode steps (time-per-output-token under prefill load).
+    prefill_chunks_per_tick: int = 4
 
     def model_config(self) -> LlamaConfig:
         return _resolve_model(self.model, self.dtype)

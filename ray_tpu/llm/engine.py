@@ -14,6 +14,13 @@ wrapper:
   free slots (bucketed prefill) and then decodes ALL active slots in one
   batched jitted step — new requests join mid-flight without stalling
   running ones.
+- **Roundtrip-lean scheduling**: decode runs up to ``decode_burst`` steps
+  per dispatch (sampled tokens fed forward on device via lax.scan), and a
+  tick's prefill first-token fetches are deferred until its decode work is
+  queued — so one tick costs ONE host⇄device roundtrip regardless of how
+  many prefills and decode tokens it covers. This is what makes the engine
+  fast when the accelerator is remote (tunneled) or the model is small
+  enough that dispatch latency rivals compute.
 - **Sampling on-device**: temperature/top-k/top-p in fp32 logits, one
   fused jit; greedy when temperature == 0.
 - Cache buffers are donated through jit so XLA updates them in place.
@@ -269,6 +276,31 @@ decode_step = partial(jax.jit, static_argnums=(0,),
                       donate_argnums=(2,))(_decode_step_impl)
 
 
+@partial(jax.jit, static_argnums=(0, 9, 10), donate_argnums=(2,))
+def decode_burst(cfg: LlamaConfig, params, cache, token0, positions0,
+                 write_mask, temps, top_ps, key, steps: int,
+                 need_top_p: bool = True):
+    """``steps`` chained decode+sample ticks in ONE dispatch: the sampled
+    token feeds the next step on device (lax.scan), so the host⇄device
+    roundtrip — which dominates per-token latency for small models and for
+    remote/tunneled accelerators — is paid once per ``steps`` tokens
+    instead of per token. Greedy/temperature/top-p sampling only (top-k
+    needs a static k; the engine falls back to single-step ticks).
+    Returns (cache, tokens [steps, B])."""
+
+    def step(carry, j):
+        c, tok, pos = carry
+        c, logits = _decode_step_impl(cfg, params, c, tok, pos, write_mask)
+        nxt = sample_tokens(logits.astype(jnp.float32), temps, top_ps, 0,
+                            jax.random.fold_in(key, j),
+                            need_top_p).astype(jnp.int32)
+        return (c, nxt, pos + 1), nxt
+
+    (cache, _, _), toks = lax.scan(step, (cache, token0, positions0),
+                                   jnp.arange(steps))
+    return cache, toks
+
+
 # ---------------------------------------------------------------------------
 # Speculative decoding (reference capability: the vLLM speculative-decoding
 # path behind the reference's llm serving stack). Decode is HBM-bound on
@@ -333,23 +365,34 @@ def copy_prefix_kv(cfg: LlamaConfig, cache, src_slot, dst_slot):
     }
 
 
-@partial(jax.jit, static_argnums=(3,))
-def sample_tokens(logits, temps, top_ps, top_k: int, key):
-    """logits [B, V] fp32; temps/top_ps [B]. Greedy where temp == 0."""
+@partial(jax.jit, static_argnums=(3, 5))
+def sample_tokens(logits, temps, top_ps, top_k: int, key,
+                  need_top_p: bool = True):
+    """logits [B, V] fp32; temps/top_ps [B]. Greedy where temp == 0.
+
+    ``need_top_p=False`` (static) skips the vocab-wide argsort + cumsum of
+    nucleus filtering — with top_p == 1.0 the filter keeps every token
+    anyway (cum − p < 1 holds for all p > 0), and the sort over V=128k per
+    step is BY FAR the most expensive op in the sampler (it dwarfs greedy
+    argmax and even rivals a 1B decode forward). The engine passes it
+    per-batch: only when some active request actually sets top_p < 1."""
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     if top_k > 0:
         kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
         scaled = jnp.where(scaled < kth, NEG_INF, scaled)
-    # top-p: keep the smallest prefix of sorted probs with cumsum <= p
-    sorted_idx = jnp.argsort(-scaled, axis=-1)
-    sorted_logits = jnp.take_along_axis(scaled, sorted_idx, axis=-1)
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep_sorted = cum - probs < top_ps[:, None]  # always keep the first
-    keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(logits.shape[0])[:, None], sorted_idx].set(keep_sorted)
-    masked = jnp.where(keep, scaled, NEG_INF)
+    if need_top_p:
+        # top-p: keep the smallest prefix of sorted probs with cumsum <= p
+        sorted_idx = jnp.argsort(-scaled, axis=-1)
+        sorted_logits = jnp.take_along_axis(scaled, sorted_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = cum - probs < top_ps[:, None]  # always keep the first
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], sorted_idx].set(keep_sorted)
+        masked = jnp.where(keep, scaled, NEG_INF)
+    else:
+        masked = scaled
     sampled = jax.random.categorical(key, masked, axis=-1)
     return jnp.where(temps <= 0.0, greedy, sampled)
 
@@ -610,12 +653,21 @@ class LLMEngine:
                 self._work.clear()
 
     def _tick(self) -> bool:
-        """One scheduler step: at most ONE prefill chunk, then one decode
-        batch over the decoding slots. Chunking + the one-per-tick cap stop
-        a long prompt from head-of-line-blocking every active decode
-        (reference shape: vLLM chunked prefill scheduling)."""
+        """One scheduler step: a bounded budget of prefill chunks (their
+        first-token fetches deferred), then one decode batch over the
+        decoding slots. Chunking + the per-tick budget stop a long prompt
+        from head-of-line-blocking every active decode (reference shape:
+        vLLM chunked prefill scheduling); deferring the prefill fetches
+        until the decode work is queued means the whole tick pays ONE
+        host⇄device roundtrip however many prefills it ran."""
         worked = self._admit()
-        worked = self._prefill_step() or worked
+        deferred: list = []
+        budget = max(1, int(getattr(self.config,
+                                    "prefill_chunks_per_tick", 1) or 1))
+        for _ in range(budget):
+            if not self._prefill_step(deferred):
+                break
+            worked = True
         decoding = {s: r for s, r in self._slots.items()
                     if r is not None and r.next_pos >= 0
                     and not r.done.is_set()}
@@ -633,11 +685,31 @@ class LLMEngine:
             if rest:
                 self._decode(rest)
                 worked = True
+            self._resolve_prefills(deferred)
             return worked
         if decoding:
             self._decode(decoding)
             worked = True
+        self._resolve_prefills(deferred)
         return worked
+
+    def _resolve_prefills(self, deferred: list) -> None:
+        """Fetch the deferred first tokens (dispatched in _prefill_step)
+        and start those requests decoding. Runs AFTER the tick's decode
+        dispatch so the fetch overlaps the queued device work."""
+        for req, out in deferred:
+            if req.done.is_set():  # failed meanwhile (device recovery)
+                continue
+            try:
+                tok = int(np.asarray(out)[0])
+            except Exception as e:  # noqa: BLE001 - async dispatch error
+                # surfaces at materialization; engine state is suspect.
+                logger.exception("deferred prefill sample failed for %s",
+                                 req.request_id)
+                self._recover_device_failure(f"prefill failed: {e!r}")
+                return
+            req.next_pos = len(req.prompt_ids)
+            self._emit(req, tok)
 
     # Minimum adopted-prefix length that justifies a cross-slot KV copy
     # (the copy moves whole cache lines; tiny prefixes aren't worth it).
@@ -768,10 +840,16 @@ class LLMEngine:
         self._prefix_live[slot] = tuple(req.prompt_ids)  # imported KV = donor
         self._emit(req, first_token)
 
-    def _prefill_step(self) -> bool:
+    def _prefill_step(self, deferred: list) -> bool:
         """Run ONE chunk of ONE prefilling request, rotating across slots so
         concurrent long prompts interleave chunks (true round-robin — a
-        lowest-slot rescan would monopolize prefill for one prompt)."""
+        lowest-slot rescan would monopolize prefill for one prompt).
+
+        A final chunk's first-token sample is DISPATCHED but not fetched:
+        (req, device_tokens) is appended to ``deferred`` for the caller to
+        resolve after it has queued the tick's decode work — one
+        host⇄device roundtrip per tick instead of one per prefill (the
+        fetch is the expensive part on remote/tunneled devices)."""
         slots = list(self._slots.keys())
         n = len(slots)
         for i in range(n):
@@ -779,8 +857,13 @@ class LLMEngine:
             req = self._slots.get(slot)
             if req is None or req.next_pos >= 0:
                 continue
-            self._prefill_rr = slot
             p = len(req.prompt_ids)
+            if req.prefilled_len >= p:
+                # Fully prefilled, first-token fetch still deferred this
+                # tick — re-prefilling would dispatch a zero-take chunk and
+                # sample (emit!) a duplicate first token.
+                continue
+            self._prefill_rr = slot
             bucket, take = self._chunk_bucket(req.prefilled_len,
                                               p - req.prefilled_len)
             toks = np.zeros((bucket,), np.int32)
@@ -796,9 +879,8 @@ class LLMEngine:
                     # The slot now holds the full prompt's KV: it becomes a
                     # prefix donor for later shared-prefix requests.
                     self._prefix_live[slot] = tuple(req.prompt_ids)
-                    tok = self._sample_one(logits[None], [req])[0]
-                    req.next_pos = p
-                    self._emit(req, int(tok))
+                    out = self._sample_dispatch(logits[None], [req])
+                    deferred.append((req, out))
             except Exception as e:  # noqa: BLE001 - e.g. OOM on long prompt
                 logger.exception("prefill failed for %s", req.request_id)
                 self._recover_device_failure(f"prefill failed: {e!r}")
@@ -833,10 +915,39 @@ class LLMEngine:
             self.draft_cache = init_kv_cache(self.draft_cfg,
                                              self.max_slots, self.max_seq)
 
+    def _burst_len(self, active: dict[int, GenerationRequest]) -> int:
+        """Largest safe burst length for this decode batch. The decode
+        batch is the STATIC slot array, so a request finishing mid-burst
+        costs nothing extra — the host just stops emitting its tokens
+        (max_tokens/EOS truncation happens in _emit) and the spare KV
+        writes are overwritten on slot reuse. The only hard bound is the
+        KV cache end (a burst must never write past max_seq); rounded down
+        to a power of two so only {8,4,2} burst shapes ever compile.
+        1 means take the classic single-step path."""
+        burst = int(getattr(self.config, "decode_burst", 1) or 1)
+        if burst <= 1:
+            return 1
+        budget = 0  # largest remaining token budget across the batch:
+        # bounding by the MAX (not min) wastes no tail steps when every
+        # request is nearly done, yet a single long request still gets
+        # full-length bursts (short ones just stop emitting early).
+        for req in active.values():
+            if req.sampling.top_k:  # static-k sampling: single-step only
+                return 1
+            burst = min(burst, self.max_seq - 1 - req.next_pos)
+            budget = max(budget,
+                         req.sampling.max_tokens - len(req.out_tokens))
+        burst = min(burst, budget)
+        d = 1
+        while d * 2 <= burst:
+            d *= 2
+        return max(d, 1)
+
     def _decode(self, active: dict[int, GenerationRequest]) -> bool:
         """Returns False iff a device failure wiped the engine state
         (_recover_device_failure ran) — callers mid-tick must then abandon
         the rest of the tick rather than dispatch into rebuilt caches."""
+        burst = self._burst_len(active)
         tokens = np.zeros((self.max_slots,), np.int32)
         positions = np.zeros((self.max_slots,), np.int32)
         write = np.zeros((self.max_slots,), bool)
@@ -844,6 +955,9 @@ class LLMEngine:
             tokens[slot] = req.out_tokens[-1]
             positions[slot] = req.next_pos
             write[slot] = True
+        if burst > 1:
+            return self._decode_burst(active, burst, tokens, positions,
+                                      write)
         try:
             self.cache, logits = decode_step(
                 self.model_cfg, self.params, self.cache,
@@ -865,6 +979,40 @@ class LLMEngine:
         for slot, req in active.items():
             req.next_pos += 1
             self._emit(req, int(sampled[slot]))
+        return True
+
+    def _decode_burst(self, active: dict[int, GenerationRequest],
+                      burst: int, tokens, positions, write) -> bool:
+        """Emit ``burst`` tokens per active slot from one device dispatch.
+        A request finishing mid-burst (EOS/stop token) simply stops
+        emitting; the extra KV the device wrote past its end sits at
+        positions a later slot reuse overwrites (same free-rollback
+        property speculative decoding relies on)."""
+        temps = np.zeros((self.max_slots,), np.float32)
+        top_ps = np.ones((self.max_slots,), np.float32)
+        for slot, req in active.items():
+            temps[slot] = req.sampling.temperature
+            top_ps[slot] = req.sampling.top_p
+        need_top_p = bool((top_ps < 1.0).any())
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        try:
+            self.cache, toks = decode_burst(
+                self.model_cfg, self.params, self.cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(write), jnp.asarray(temps),
+                jnp.asarray(top_ps), sub, burst, need_top_p)
+            toks = np.asarray(toks)  # [burst, max_slots]
+        except Exception as e:  # noqa: BLE001 - cache donated & lost
+            logger.exception("burst decode failed (%d active, burst %d)",
+                             len(active), burst)
+            self._recover_device_failure(f"decode failed: {e!r}")
+            return False
+        for j in range(burst):
+            for slot, req in active.items():
+                if req.done.is_set():
+                    continue
+                req.next_pos += 1
+                self._emit(req, int(toks[j, slot]))
         return True
 
     def _spec_decode(self, active: dict[int, GenerationRequest]) -> None:
@@ -999,7 +1147,9 @@ class LLMEngine:
                     r.draft_len = 0
             return False
 
-    def _sample_one(self, logits, reqs) -> np.ndarray:
+    def _sample_dispatch(self, logits, reqs):
+        """Dispatch sampling on device; returns the (unfetched) token
+        array so callers can defer the host roundtrip."""
         b = logits.shape[0]
         temps = np.zeros((b,), np.float32)
         top_ps = np.ones((b,), np.float32)
@@ -1012,9 +1162,12 @@ class LLMEngine:
             if r.sampling.top_k:
                 top_k = max(top_k, r.sampling.top_k)
         self._rng_key, sub = jax.random.split(self._rng_key)
-        out = sample_tokens(logits.astype(jnp.float32), jnp.asarray(temps),
-                            jnp.asarray(top_ps), top_k, sub)
-        return np.asarray(out)
+        return sample_tokens(logits.astype(jnp.float32), jnp.asarray(temps),
+                             jnp.asarray(top_ps), top_k, sub,
+                             bool((top_ps < 1.0).any()))
+
+    def _sample_one(self, logits, reqs) -> np.ndarray:
+        return np.asarray(self._sample_dispatch(logits, reqs))
 
     def _emit(self, req: GenerationRequest, token: int) -> None:
         req.out_tokens.append(token)

@@ -181,3 +181,6 @@ def shutdown() -> None:
         ray_tpu.kill(controller)
     except Exception:
         pass
+    from ray_tpu.serve.handle import _reset_routers
+
+    _reset_routers()

@@ -87,6 +87,12 @@ class LongPollClient:
                     return
                 time.sleep(0.2)
                 continue
+            if not isinstance(updates, dict):
+                # Defensive: a malformed/stale reply (e.g. from an actor
+                # mid-restart) must degrade to "no update", not kill the
+                # poll thread — a dead poller silently freezes the replica
+                # cache for the process's lifetime.
+                continue
             for key, (ver, snap) in updates.items():
                 self._versions[key] = ver
                 self._cache[key] = snap

@@ -326,6 +326,7 @@ def test_engine_recovers_from_device_failure(monkeypatch):
 
     eng = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64))
     real_decode = eng_mod.decode_step
+    real_burst = eng_mod.decode_burst
     boom = {"n": 0}
 
     def flaky_decode(*a, **kw):
@@ -334,8 +335,15 @@ def test_engine_recovers_from_device_failure(monkeypatch):
             raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
         return real_decode(*a, **kw)
 
+    def flaky_burst(*a, **kw):
+        if boom["n"] == 0:
+            boom["n"] += 1
+            raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
+        return real_burst(*a, **kw)
+
     try:
         monkeypatch.setattr(eng_mod, "decode_step", flaky_decode)
+        monkeypatch.setattr(eng_mod, "decode_burst", flaky_burst)
         req = eng.submit([1, 2, 3], SamplingParams(max_tokens=4))
         assert req.done.wait(60)
         assert req.error and "decode failed" in req.error
@@ -591,11 +599,15 @@ class TestSpeculativeDecoding:
 
         try:
             engine_mod.prefill_chunk = failing_prefill
+            # max_tokens must span >= 3 fallback ticks: each failed
+            # catch-up tick now burst-decodes up to decode_burst tokens, so
+            # a short request could finish before the 3rd failure disables
+            # speculation.
             victim = eng.submit("doomed draft", sampling=SamplingParams(
-                max_tokens=10, temperature=0.0))
+                max_tokens=30, temperature=0.0))
             assert victim.done.wait(60) and victim.error is None
             assert victim.spec_disabled
-            assert len(victim.out_tokens) == 10
+            assert len(victim.out_tokens) == 30
             # Engine must still speculate for a healthy follow-up request.
             healthy = eng.submit("fine", sampling=SamplingParams(
                 max_tokens=10, temperature=0.0))
@@ -617,6 +629,7 @@ class TestSpeculativeDecoding:
                                   speculative_tokens=3))
         import ray_tpu.llm.engine as engine_mod
         orig_decode = engine_mod.decode_step
+        orig_burst = engine_mod.decode_burst
         orig_propose = engine_mod.draft_propose
         spec_dispatch_after_failure = []
         failed_once = []
@@ -634,6 +647,12 @@ class TestSpeculativeDecoding:
                 raise RuntimeError("injected device failure")
             return orig_decode(*a, **kw)
 
+        def failing_burst(*a, **kw):
+            if both_decode_ready():
+                failed_once.append(True)
+                raise RuntimeError("injected device failure")
+            return orig_burst(*a, **kw)
+
         def recording_propose(*a, **kw):
             if failed_once:
                 spec_dispatch_after_failure.append(True)
@@ -641,6 +660,7 @@ class TestSpeculativeDecoding:
 
         try:
             engine_mod.decode_step = failing_decode
+            engine_mod.decode_burst = failing_burst
             engine_mod.draft_propose = recording_propose
             plain = eng.submit("plain one", sampling=SamplingParams(
                 max_tokens=32, temperature=0.0))
@@ -654,6 +674,7 @@ class TestSpeculativeDecoding:
                 "speculative half dispatched after device recovery")
         finally:
             engine_mod.decode_step = orig_decode
+            engine_mod.decode_burst = orig_burst
             engine_mod.draft_propose = orig_propose
             eng.shutdown()
 
@@ -673,5 +694,64 @@ class TestSpeculativeDecoding:
             assert greedy.error is None and warm.error is None
             assert len(greedy.out_tokens) > 0 and len(warm.out_tokens) > 0
             assert eng.stats()["spec_ticks"] > 0
+        finally:
+            eng.shutdown()
+
+
+class TestBurstDecoding:
+    """decode_burst: D chained decode+sample steps per dispatch
+    (engine.py decode_burst) must be invisible to outputs."""
+
+    def test_burst_matches_single_step_greedy(self):
+        base = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64,
+                         decode_burst=1)
+        burst = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64,
+                          decode_burst=4)
+        e1, e2 = LLMEngine(base), LLMEngine(burst)
+        try:
+            for prompt, n in [("hello burst", 13), ("x", 3), ("abc", 8)]:
+                r1 = e1.generate(prompt, SamplingParams(max_tokens=n))
+                r2 = e2.generate(prompt, SamplingParams(max_tokens=n))
+                assert r1.token_ids == r2.token_ids, (prompt, n)
+                assert r2.finish_reason == r1.finish_reason
+        finally:
+            e1.shutdown()
+            e2.shutdown()
+
+    def test_burst_concurrent_isolated(self):
+        """Burst ticks over a mixed batch: each request's output matches
+        its solo regeneration (no cross-slot contamination inside the
+        scanned steps)."""
+        cfg = LLMConfig(model="tiny", max_num_seqs=4, max_seq_len=64,
+                        decode_burst=8)
+        eng = LLMEngine(cfg)
+        try:
+            results = [None] * 4
+            def gen(i):
+                results[i] = eng.generate(f"burst prompt {i}",
+                                          SamplingParams(max_tokens=10))
+            threads = [threading.Thread(target=gen, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert all(r is not None for r in results)
+            solo = eng.generate("burst prompt 2",
+                                SamplingParams(max_tokens=10))
+            assert solo.token_ids == results[2].token_ids
+        finally:
+            eng.shutdown()
+
+    def test_top_k_falls_back_to_single_step(self):
+        """top-k sampling can't ride the burst (static k); the engine must
+        still serve it correctly via single-step ticks."""
+        cfg = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=64,
+                        decode_burst=8)
+        eng = LLMEngine(cfg)
+        try:
+            r = eng.generate("topk prompt", SamplingParams(
+                max_tokens=6, temperature=0.8, top_k=5, seed=1))
+            assert 0 < len(r.token_ids) <= 6
         finally:
             eng.shutdown()
