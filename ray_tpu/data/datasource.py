@@ -314,10 +314,20 @@ class SQLDatasource(Datasource):
                 f"sharding (got {type(lo).__name__}); omit shard_column "
                 f"to read unsharded")
         tasks = []
-        span = (hi - lo) / self._num_shards
+        int_bounds = isinstance(lo, int) and isinstance(hi, int)
+
+        def bound(i: int):
+            # Integer columns get EXACT integer bounds — float math loses
+            # precision above 2**53 (ns-epoch timestamps, snowflake ids)
+            # and a rounded-up lower bound silently excludes the MIN rows
+            # from every shard.
+            if int_bounds:
+                return lo + (hi - lo) * i // self._num_shards
+            return lo + (hi - lo) / self._num_shards * i
+
         for i in range(self._num_shards):
-            a = lo + span * i
-            b = hi if i == self._num_shards - 1 else lo + span * (i + 1)
+            a = bound(i)
+            b = hi if i == self._num_shards - 1 else bound(i + 1)
             # last shard closes the interval so MAX rows aren't dropped
             op = "<=" if i == self._num_shards - 1 else "<"
             pred = f"({col} >= {a!r} AND {col} {op} {b!r})"
@@ -384,11 +394,16 @@ class WebDatasetDatasource(FileDatasource):
             for member in tf:
                 if not member.isfile():
                     continue
-                base = os.path.basename(member.name)
+                # WebDataset convention: the sample key is the member PATH
+                # up to the first dot of the basename — basename-only keys
+                # would merge train/0001.jpg and val/0001.jpg into one
+                # sample (silent loss on per-class-directory shards).
+                dirpart, base = os.path.split(member.name)
                 if "." in base:
-                    key, ext = base.split(".", 1)
+                    stem, ext = base.split(".", 1)
                 else:
-                    key, ext = base, "bin"
+                    stem, ext = base, "bin"
+                key = f"{dirpart}/{stem}" if dirpart else stem
                 data = tf.extractfile(member).read()
                 if key not in samples:
                     samples[key] = {"__key__": key}

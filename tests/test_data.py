@@ -529,6 +529,19 @@ def test_read_sql_and_write_sql(rt, tmp_path):
     assert len(with_null) == 21
     assert any(r["id"] is None for r in with_null)
 
+    # int64-range shard keys (snowflake ids, ns timestamps): bounds must
+    # stay exact integers — float bounds round above 2**53 and silently
+    # drop the MIN rows from every shard's predicate.
+    conn = factory()
+    conn.execute("CREATE TABLE big (id INTEGER, name TEXT)")
+    big_ids = [2**63 - 3, 2**63 - 2, 2**63 - 1]
+    conn.executemany("INSERT INTO big VALUES (?, ?)",
+                     [(i, f"b{i}") for i in big_ids])
+    conn.commit(); conn.close()
+    big = rd.read_sql("SELECT * FROM big", factory,
+                      shard_column="id", num_shards=2).take_all()
+    assert sorted(r["id"] for r in big) == big_ids
+
     # non-numeric shard columns are rejected loudly, not silently wrong
     with pytest.raises(Exception, match="numeric"):
         rd.read_sql("SELECT * FROM items WHERE id IS NOT NULL", factory,
@@ -579,6 +592,15 @@ def test_read_webdataset(rt, tmp_path):
     assert rows[4]["json"] == {"idx": 4}
     # one read task per shard
     assert len(rd.read_webdataset(str(tmp_path)).materialize()._refs_meta) == 2
+
+    # directory-scoped keys: train/0001 and val/0001 are DIFFERENT samples
+    # (basename-only keys would silently merge them)
+    with tarfile.open(tmp_path / "s2.tar", "w") as tf:
+        add(tf, "train/0001.txt", b"train one")
+        add(tf, "val/0001.txt", b"val one")
+    scoped = rd.read_webdataset(str(tmp_path / "s2.tar")).take_all()
+    assert sorted(r["__key__"] for r in scoped) == ["train/0001", "val/0001"]
+    assert sorted(r["txt"] for r in scoped) == ["train one", "val one"]
 
 
 def test_read_webdataset_images(rt, tmp_path):
