@@ -39,8 +39,11 @@ def main() -> None:
     from ray_tpu.llm.serving import build_openai_app
 
     if on_tpu:
+        # decode_burst=16: on the tunneled chip the per-tick roundtrip
+        # (~150 ms) dominates, so a deeper burst halves roundtrips again
+        # (ladder 16+8+4+2+1 = 31 = max_tokens-1 after the prefill token).
         cfg = LLMConfig(model="llama3_1b", max_num_seqs=8, max_seq_len=1024,
-                        dtype="bfloat16")
+                        dtype="bfloat16", decode_burst=16)
         n_requests, concurrency, max_tokens = 100, 8, 32
         label = "llama_1b"
     else:
@@ -58,13 +61,15 @@ def main() -> None:
     # measurement (the r04 cold run's p90 TTFT was compile time, not
     # serving time):
     #   - prefill bucket for the short prompts,
-    #   - burst-decode shapes {8,4,2,1} plus the single-step decode path:
-    #     prefill emits token 1, so max_tokens=16 leaves 15 = 8+4+2+1 —
-    #     aligned requests walk exactly that ladder,
+    #   - every burst-decode shape plus the single-step decode path:
+    #     prefill emits token 1, so max_tokens = decode_burst*2 leaves
+    #     2D-1 = D + D/2 + ... + 1 — aligned requests walk exactly the
+    #     full power-of-two ladder,
     #   - sampling + admission under concurrency.
+    warm_tokens = 2 * getattr(cfg, "decode_burst", 8)
     warm_threads = [
         threading.Thread(target=_safe_request,
-                         args=(url,), kwargs={"max_tokens": 16,
+                         args=(url,), kwargs={"max_tokens": warm_tokens,
                                               "seed": 900 + i})
         for i in range(concurrency)
     ]
