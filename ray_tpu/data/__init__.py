@@ -122,6 +122,37 @@ def read_webdataset(paths, *, decode_images: bool = True,
         paths, decode_images=decode_images), parallelism)])
 
 
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: list | None = None, client_factory=None,
+               num_shards: int = 1, parallelism: int = -1) -> Dataset:
+    """Documents from MongoDB (reference: ray.data.read_mongo).
+    ``client_factory`` injects a pymongo-shaped client; omitted, pymongo
+    connects to ``uri``."""
+    from ray_tpu.data.datasource import MongoDatasource
+
+    return Dataset([Read(MongoDatasource(
+        uri, database, collection, pipeline=pipeline,
+        client_factory=client_factory, num_shards=num_shards), parallelism)])
+
+
+def read_bigquery(table: str, *, client_factory, max_streams: int = 8,
+                  parallelism: int = -1) -> Dataset:
+    """BigQuery table via Storage-API-shaped read streams (reference:
+    ray.data.read_bigquery); one read task per stream."""
+    from ray_tpu.data.datasource import BigQueryDatasource
+
+    return Dataset([Read(BigQueryDatasource(
+        table, client_factory, max_streams=max_streams), parallelism)])
+
+
+def read_delta(table_path: str, *, parallelism: int = -1) -> Dataset:
+    """A Delta Lake table by replaying its _delta_log transaction log
+    (reference: table-format lakes via delta-rs); one task per live file."""
+    from ray_tpu.data.datasource import DeltaLakeDatasource
+
+    return Dataset([Read(DeltaLakeDatasource(table_path), parallelism)])
+
+
 def from_pandas(df) -> Dataset:
     from ray_tpu.data.block import block_from_pandas
 
@@ -200,6 +231,9 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_webdataset",
+    "read_mongo",
+    "read_bigquery",
+    "read_delta",
     "read_parquet",
 ]
 

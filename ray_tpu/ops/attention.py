@@ -552,6 +552,283 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, sm_scale: float,
             dv.reshape(b, h, skv, d))
 
 
+# ---------------------------------------------------------------------------
+# Chunk kernels: flash attention between a LOCAL q block and a VISITING K/V
+# chunk whose global positions are runtime values (ring attention rotates
+# chunks with lax.ppermute, so offsets are traced axis_index products, not
+# Python ints). Causality is data-driven via position-vector inputs, the
+# kernel emits (out, lse), and the backward supports an lse cotangent —
+# the online cross-chunk combiner differentiates through both.
+#
+# These deliberately DUPLICATE the static-causal kernels above rather than
+# generalize them: the static path's compile-time diagonal skip (upper
+# bound on the kv loop) is worth ~2x on long causal self-attention and
+# cannot survive runtime positions. Optimization levers landed in one pair
+# (ones-column row-sum, scale folding — see PERF_STEP.json) must be
+# mirrored in the other.
+
+
+def _flash_chunk_fwd_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
+                            o_ref, lse_ref, *, kv_seq_len: int, block_k: int,
+                            sm_scale: float, causal: bool):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...]
+    scale2 = sm_scale * LOG2E
+    qs = (q.astype(jnp.float32) * scale2).astype(q.dtype)
+    qpos = qpos_ref[0, :]                # [bq] i32, GLOBAL positions
+    nkv = kv_seq_len // block_k
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(qs, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            kpos = kpos_ref[0, pl.ds(j * block_k, block_k)]
+            s = jnp.where(kpos[None, :] <= qpos[:, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m - m_new)
+        v1 = jnp.concatenate(
+            [v, jnp.ones((v.shape[0], 1), v.dtype)], axis=1)
+        ov = jnp.dot(p.astype(v.dtype), v1,
+                     preferred_element_type=jnp.float32)
+        d_ = v.shape[1]
+        l_new = l * alpha + lax.slice(ov, (0, d_), (ov.shape[0], d_ + 1))[:, 0]
+        o_new = o * alpha[:, None] + lax.slice(ov, (0, 0), (ov.shape[0], d_))
+        return o_new, m_new, l_new
+
+    d = q_ref.shape[-1]
+    o0 = jnp.zeros((q.shape[0], d), jnp.float32)
+    m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), jnp.float32)
+    # No static diagonal skip: chunk visibility depends on runtime offsets,
+    # and visiting chunks are all-visible or all-masked except the one
+    # diagonal chunk per ring sweep — a full pass wastes ~(1/2n) of work.
+    o, m, l = lax.fori_loop(0, nkv, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :] = (m + jnp.log2(l)) * LN2
+
+
+def _flash_chunk_bwd_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref,
+                            lse_ref, delta_ref, glse_ref,
+                            dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                            kv_seq_len: int, block_k: int, sm_scale: float,
+                            causal: bool):
+    """Fused dq/dk/dv for one chunk pair, with the lse-cotangent term:
+    ds = p ∘ (dO·vᵀ − Δ + g_lse) — lse depends on s with dlse/ds = p, so
+    a cotangent on lse adds a per-row bias inside the p product."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    nq = pl.num_programs(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[...]
+    do = do_ref[...]
+    lse2 = lse_ref[0, :] * LOG2E
+    rowbias = glse_ref[0, :] - delta_ref[0, :]  # (g_lse − Δ) per row
+    qpos = qpos_ref[0, :]
+    nkv = kv_seq_len // block_k
+    scale2 = sm_scale * LOG2E
+    qs = (q.astype(jnp.float32) * scale2).astype(q.dtype)
+    q_sc = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+
+    def body(j, dq):
+        kslc = pl.ds(j * block_k, block_k)
+        k = k_ref[kslc, :]
+        v = v_ref[kslc, :]
+        s = jnp.dot(qs, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            kpos = kpos_ref[0, kslc]
+            s = jnp.where(kpos[None, :] <= qpos[:, None], s, NEG_INF)
+        p = jnp.exp2(s - lse2[:, None])
+        dp = jnp.dot(do.astype(v.dtype), v.T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp + rowbias[:, None])
+        k_sc = (k.astype(jnp.float32) * sm_scale).astype(k.dtype)
+        dv_acc[kslc, :] += jnp.dot(p.astype(do.dtype).T, do,
+                                   preferred_element_type=jnp.float32)
+        dk_acc[kslc, :] += jnp.dot(ds.astype(q.dtype).T, q_sc,
+                                   preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds.astype(k.dtype), k_sc,
+                            preferred_element_type=jnp.float32)
+
+    d = q_ref.shape[-1]
+    dq = lax.fori_loop(0, nkv, body,
+                       jnp.zeros((q.shape[0], d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _chunk_specs(b, h, hkv, sq, skv, d, block_q):
+    from jax.experimental import pallas as pl
+
+    rep = h // hkv
+    return [
+        pl.BlockSpec((None, 1, block_q), lambda i, j: (0, 0, j)),  # qpos
+        pl.BlockSpec((None, 1, skv), lambda i, j: (0, 0, 0)),      # kpos
+        pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # q
+        pl.BlockSpec((None, skv, d), lambda i, j: (i // rep, 0, 0)),
+        pl.BlockSpec((None, skv, d), lambda i, j: (i // rep, 0, 0)),
+    ]
+
+
+def _chunk_blocks(sq: int, skv: int, block_q: int, block_k: int):
+    """Block sizes that DIVIDE the chunk — ring shards can be any S/N, and
+    a floor-divided grid would silently drop the tail rows/columns."""
+    block_q = min(block_q, sq)
+    while block_q > 8 and sq % block_q:
+        block_q //= 2
+    block_k = min(block_k, skv)
+    while block_k > 8 and skv % block_k:
+        block_k //= 2
+    if sq % block_q or skv % block_k:
+        raise ValueError(
+            f"flash_attention_chunk needs seq lengths with a power-of-two "
+            f"block divisor >= 8 (got sq={sq}, skv={skv})")
+    return block_q, block_k
+
+
+def _flash_chunk_fwd_pallas(q, k, v, qpos, kpos, causal, sm_scale,
+                            block_q: int = 512, block_k: int = 512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    block_q, block_k = _chunk_blocks(sq, skv, block_q, block_k)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * hkv, skv, d)
+    vf = v.reshape(b * hkv, skv, d)
+    qposf = qpos.astype(jnp.int32).reshape(1, 1, sq)
+    kposf = kpos.astype(jnp.int32).reshape(1, 1, skv)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_chunk_fwd_kernel, kv_seq_len=skv,
+                          block_k=block_k, sm_scale=sm_scale, causal=causal),
+        grid=(b * h, sq // block_q),
+        in_specs=_chunk_specs(b, h, hkv, sq, skv, d, block_q),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=INTERPRET,
+    )(qposf, kposf, qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _flash_chunk_bwd_pallas(q, k, v, qpos, kpos, out, lse, g_out, g_lse,
+                            causal, sm_scale,
+                            block_q: int = 512, block_k: int = 512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    block_q, block_k = _chunk_blocks(sq, skv, block_q, block_k)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * hkv, skv, d)
+    vf = v.reshape(b * hkv, skv, d)
+    dof = g_out.reshape(b * h, sq, d).astype(q.dtype)
+    lsef = _rows_3d(lse, b * h, sq)
+    delta = (g_out.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    deltaf = _rows_3d(delta, b * h, sq)
+    glsef = _rows_3d(g_lse.astype(jnp.float32), b * h, sq)
+    qposf = qpos.astype(jnp.int32).reshape(1, 1, sq)
+    kposf = kpos.astype(jnp.int32).reshape(1, 1, skv)
+
+    row = pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_chunk_bwd_kernel, kv_seq_len=skv,
+                          block_k=block_k, sm_scale=sm_scale, causal=causal),
+        grid=(b * h, sq // block_q),
+        in_specs=_chunk_specs(b, h, hkv, sq, skv, d, block_q) + [
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # dO
+            row, row, row,                                   # lse, Δ, g_lse
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((skv, d), jnp.float32),
+            pltpu.VMEM((skv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=INTERPRET,
+    )(qposf, kposf, qf, kf, vf, dof, lsef, deltaf, glsef)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, skv, d),
+            dv.reshape(b, h, skv, d))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def flash_attention_chunk(q, k, v, qpos, kpos, causal: bool = True,
+                          sm_scale: float | None = None):
+    """(out, lse) for local q against one visiting K/V chunk, with
+    GLOBAL positions supplied as arrays (qpos [Sq], kpos [Skv] — runtime
+    values, e.g. ring-step offsets from lax.axis_index). lse is natural-log
+    and differentiable, so cross-chunk online combiners (ring attention)
+    backprop exactly. GQA-native like flash_attention."""
+    return _chunk_fwd(q, k, v, qpos, kpos, causal, sm_scale)[0]
+
+
+def _chunk_fwd(q, k, v, qpos, kpos, causal, sm_scale):
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _flash_chunk_fwd_pallas(q, k, v, qpos, kpos, causal, scale)
+    return (out, lse), (q, k, v, qpos, kpos, out, lse)
+
+
+def _chunk_bwd(causal, sm_scale, res, cts):
+    q, k, v, qpos, kpos, out, lse = res
+    g_out, g_lse = cts
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    h, hkv = q.shape[1], k.shape[1]
+    dq, dk, dv = _flash_chunk_bwd_pallas(
+        q, k, v, qpos, kpos, out, lse, g_out, g_lse, causal, scale)
+    if hkv != h:  # GQA fold
+        b, _, skv, d = dk.shape
+        rep = h // hkv
+        dk = dk.astype(jnp.float32).reshape(b, hkv, rep, skv, d).sum(2)
+        dv = dv.astype(jnp.float32).reshape(b, hkv, rep, skv, d).sum(2)
+    import numpy as _np
+
+    # Integer position inputs carry float0 cotangents (jax's convention
+    # for non-differentiable array args under custom_vjp).
+    zq = _np.zeros(qpos.shape, dtype=jax.dtypes.float0)
+    zk = _np.zeros(kpos.shape, dtype=jax.dtypes.float0)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            zq, zk)
+
+
+flash_attention_chunk.defvjp(_chunk_fwd, _chunk_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: float | None = None, use_pallas: bool = True):

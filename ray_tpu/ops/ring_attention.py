@@ -52,16 +52,53 @@ def _ring_step_combine(q, k, v, o, m, l, scale, causal, q_offset, kv_offset,
 
 
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
-                         sm_scale: float | None = None):
-    """Per-shard body (call inside shard_map). q/k/v: local [B, H, S/N, D]."""
+                         sm_scale: float | None = None,
+                         impl: str = "auto"):
+    """Per-shard body (call inside shard_map). q/k/v: local [B, H, S/N, D].
+
+    ``impl``: "flash" runs each ring step through the Pallas chunk kernel
+    (ops/attention.py flash_attention_chunk — data-driven causal positions,
+    differentiable lse) and combines chunks by (out, lse) log-sum-exp;
+    "einsum" is the materialized-score XLA path; "auto" picks flash on TPU.
+    """
     b, h, sq, d = q.shape
-    h_kv = k.shape[1]
-    k = _repeat_kv(k, h)
-    v = _repeat_kv(v, h)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     chunk = sq
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "einsum"
+
+    if impl == "flash":
+        from ray_tpu.ops.attention import flash_attention_chunk
+
+        qpos = my * chunk + jnp.arange(sq, dtype=jnp.int32)
+
+        def stepf(t, carry):
+            o, lse_acc, kc, vc = carry
+            src = (my - t) % n
+            kpos = src * chunk + jnp.arange(chunk, dtype=jnp.int32)
+            o_t, lse_t = flash_attention_chunk(q, kc, vc, qpos, kpos,
+                                               causal, scale)
+            # log-sum-exp combine of normalized per-chunk results; a fully
+            # masked chunk arrives with lse ~ -inf and weight 0.
+            lse_new = jnp.logaddexp(lse_acc, lse_t)
+            w_old = jnp.exp(lse_acc - lse_new)[..., None]
+            w_new = jnp.exp(lse_t - lse_new)[..., None]
+            o = o * w_old + o_t.astype(jnp.float32) * w_new
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            return o, lse_new, kc, vc
+
+        o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+        lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+        o, _, _, _ = lax.fori_loop(0, n, stepf, (o0, lse0, k, v))
+        return o.astype(q.dtype)
+
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
 
     o0 = jnp.zeros((b, h, sq, d), jnp.float32)
     m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
@@ -69,7 +106,6 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
 
     # Ring: at step t, this device holds the chunk originally owned by
     # (my - t) mod n; chunks travel to the next-higher index each step.
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(t, carry):
         o, m, l, kc, vc = carry
@@ -90,20 +126,21 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "sp",
                            causal: bool = True,
                            sm_scale: float | None = None,
-                           batch_axes=None):
+                           batch_axes=None, impl: str = "auto"):
     """Global-array entry: shard seq dim over ``axis``, run the ring.
 
     ``batch_axes``: optional mesh axes to shard the batch dim over (e.g.
     ("dp", "fsdp") in a combined dp×sp mesh)."""
     spec = P(batch_axes, None, axis, None)
-    fn = shard_map_ring(mesh, axis, causal, sm_scale, spec)
+    fn = shard_map_ring(mesh, axis, causal, sm_scale, spec, impl)
     return fn(q, k, v)
 
 
 @functools.lru_cache(maxsize=64)
-def shard_map_ring(mesh: Mesh, axis: str, causal: bool, sm_scale, spec: P):
+def shard_map_ring(mesh: Mesh, axis: str, causal: bool, sm_scale, spec: P,
+                   impl: str = "auto"):
     body = functools.partial(ring_attention_local, axis_name=axis,
-                             causal=causal, sm_scale=sm_scale)
+                             causal=causal, sm_scale=sm_scale, impl=impl)
 
     @jax.jit
     def fn(q, k, v):

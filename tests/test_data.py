@@ -621,3 +621,181 @@ def test_read_webdataset_images(rt, tmp_path):
     raw = rd.read_webdataset(str(tmp_path / "img.tar"),
                              decode_images=False).take_all()[0]
     assert raw["png"] == png
+
+
+def test_read_mongo_with_injected_client(rt):
+    """read_mongo via an injected pymongo-shaped client (reference:
+    ray.data.read_mongo) — pipeline pushdown + skip/limit sharding."""
+    docs = [{"_id": i, "name": f"d{i}", "score": i * 1.5,
+             "tags": ["a", "b", "c"]} for i in range(10)]
+
+    class FakeColl:
+        def aggregate(self, stages):
+            out = list(docs)
+            for st in stages:
+                if "$match" in st:
+                    f = st["$match"]
+                    out = [d for d in out
+                           if all(d.get(k) == v for k, v in f.items())]
+                elif "$unwind" in st:
+                    field = st["$unwind"].lstrip("$")
+                    out = [{**d, field: x} for d in out for x in d[field]]
+                elif "$sort" in st:
+                    (k, direc), = st["$sort"].items()
+                    out = sorted(out, key=lambda d: d[k],
+                                 reverse=direc < 0)
+                elif "$skip" in st:
+                    out = out[st["$skip"]:]
+                elif "$limit" in st:
+                    out = out[:st["$limit"]]
+                elif "$count" in st:
+                    out = [{st["$count"]: len(out)}]
+            return iter(out)
+
+    class FakeDB(dict):
+        def __getitem__(self, k):
+            return FakeColl()
+
+    class FakeClient(dict):
+        def __getitem__(self, k):
+            return FakeDB()
+        def close(self):
+            pass
+
+    ds = rd.read_mongo("mongodb://fake", "db", "c",
+                       client_factory=FakeClient)
+    rows = sorted(ds.take_all(), key=lambda r: r["_id"])
+    assert len(rows) == 10 and rows[3]["name"] == "d3"
+
+    sharded = rd.read_mongo("mongodb://fake", "db", "c",
+                            client_factory=FakeClient, num_shards=3)
+    assert len(sharded.materialize()._refs_meta) == 3
+    assert sorted(r["_id"] for r in sharded.take_all()) == list(range(10))
+
+    piped = rd.read_mongo("mongodb://fake", "db", "c",
+                          pipeline=[{"$match": {"name": "d7"}}],
+                          client_factory=FakeClient).take_all()
+    assert [r["_id"] for r in piped] == [7]
+
+    # cardinality-changing pipeline + sharding: shard windows partition the
+    # PIPELINE OUTPUT (count runs after the pipeline), nothing dropped
+    unwound = rd.read_mongo("mongodb://fake", "db", "c",
+                            pipeline=[{"$unwind": "$tags"}],
+                            client_factory=FakeClient,
+                            num_shards=4).take_all()
+    assert len(unwound) == 30  # 10 docs x 3 tags
+
+
+def test_read_bigquery_with_injected_client(rt):
+    """read_bigquery over Storage-API-shaped streams: one task per
+    stream, rows concatenated (reference: ray.data.read_bigquery)."""
+    stream_rows = {
+        "s0": [{"id": 0, "v": "a"}, {"id": 1, "v": "b"}],
+        "s1": [{"id": 2, "v": "c"}],
+        "s2": [{"id": 3, "v": "d"}, {"id": 4, "v": "e"}],
+    }
+
+    class FakeBQ:
+        def create_read_session(self, table, max_streams):
+            assert table == "proj.ds.tbl"
+            return list(stream_rows)[:max_streams]
+
+        def read_rows(self, stream_id):
+            return iter(stream_rows[stream_id])
+
+    ds = rd.read_bigquery("proj.ds.tbl", client_factory=FakeBQ)
+    assert len(ds.materialize()._refs_meta) == 3
+    assert sorted(r["id"] for r in ds.take_all()) == [0, 1, 2, 3, 4]
+
+    capped = rd.read_bigquery("proj.ds.tbl", client_factory=FakeBQ,
+                              max_streams=2)
+    assert sorted(r["id"] for r in capped.take_all()) == [0, 1, 2]
+
+
+def test_read_delta_replays_transaction_log(rt, tmp_path):
+    """read_delta: _delta_log add/remove replay + partitionValues as
+    literal columns (reference: delta-rs-backed read_delta)."""
+    import json as js
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = tmp_path / "dl"
+    (root / "_delta_log").mkdir(parents=True)
+
+    def write_part(name, ids):
+        pq.write_table(pa.table({"id": pa.array(ids, pa.int64())}),
+                       root / name)
+
+    write_part("f0.parquet", [0, 1])
+    write_part("f1.parquet", [2, 3])
+    write_part("f2.parquet", [4, 5])
+
+    def commit(version, actions):
+        with open(root / "_delta_log" / f"{version:020d}.json", "w") as f:
+            for a in actions:
+                f.write(js.dumps(a) + "\n")
+
+    commit(0, [{"add": {"path": "f0.parquet",
+                        "partitionValues": {"split": "train"}}},
+               {"add": {"path": "f1.parquet",
+                        "partitionValues": {"split": "val"}}}])
+    # commit 1 compacts f1 away and adds f2
+    commit(1, [{"remove": {"path": "f1.parquet"}},
+               {"add": {"path": "f2.parquet",
+                        "partitionValues": {"split": "val"}}}])
+
+    rows = sorted(rd.read_delta(str(root)).take_all(),
+                  key=lambda r: r["id"])
+    assert [r["id"] for r in rows] == [0, 1, 4, 5]  # f1's rows are gone
+    assert [r["split"] for r in rows] == ["train", "train", "val", "val"]
+
+    with pytest.raises(FileNotFoundError, match="_delta_log"):
+        rd.read_delta(str(tmp_path / "nope")).take_all()
+
+
+def test_read_delta_from_checkpoint(rt, tmp_path):
+    """Checkpointed table with vacuumed pre-checkpoint commits: the live
+    set seeds from the parquet checkpoint, JSON replay resumes after it."""
+    import json as js
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = tmp_path / "dlc"
+    log = root / "_delta_log"
+    log.mkdir(parents=True)
+
+    def write_part(name, ids):
+        pq.write_table(pa.table({"id": pa.array(ids, pa.int64())}),
+                       root / name)
+
+    write_part("old.parquet", [0, 1])
+    write_part("kept.parquet", [2])
+    write_part("new.parquet", [3, 4])
+
+    # checkpoint at version 5 holds the folded state: old + kept added,
+    # old later removed (checkpoints carry surviving remove tombstones)
+    ck_rows = [
+        {"add": {"path": "old.parquet", "partitionValues": {}},
+         "remove": None},
+        {"add": {"path": "kept.parquet", "partitionValues": {"p": "k"}},
+         "remove": None},
+        {"add": None, "remove": {"path": "old.parquet"}},
+    ]
+    pq.write_table(pa.Table.from_pylist(ck_rows),
+                   log / f"{5:020d}.checkpoint.parquet")
+    with open(log / "_last_checkpoint", "w") as f:
+        js.dump({"version": 5, "size": len(ck_rows)}, f)
+    # a stale pre-checkpoint commit that must be ignored (already folded)
+    with open(log / f"{5:020d}.json", "w") as f:
+        f.write(js.dumps({"add": {"path": "old.parquet",
+                                  "partitionValues": {}}}) + "\n")
+    # post-checkpoint commit adds new.parquet
+    with open(log / f"{6:020d}.json", "w") as f:
+        f.write(js.dumps({"add": {"path": "new.parquet",
+                                  "partitionValues": {"p": "n"}}}) + "\n")
+
+    rows = sorted(rd.read_delta(str(root)).take_all(),
+                  key=lambda r: r["id"])
+    assert [r["id"] for r in rows] == [2, 3, 4]  # old.parquet stays dead
