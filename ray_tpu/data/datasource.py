@@ -447,21 +447,12 @@ class MongoDatasource(Datasource):
                 "injectable client_factory") from e
         return pymongo.MongoClient(self._uri)
 
-    def _fetch(self, skip: int, limit: int | None) -> Block:
+    def _fetch(self, extra_stages: list | None = None) -> Block:
         client = self._client()
         try:
             coll = client[self._db][self._coll]
-            stages = list(self._pipeline)
-            if skip or limit is not None:
-                # Deterministic order across shard windows: without a sort,
-                # skip/limit windows on a live collection may overlap or
-                # miss rows between the shards' independent aggregations.
-                stages.append({"$sort": {"_id": 1}})
-            if skip:
-                stages.append({"$skip": skip})
-            if limit is not None:
-                stages.append({"$limit": limit})
-            rows = [dict(d) for d in coll.aggregate(stages)]
+            rows = [dict(d) for d in coll.aggregate(
+                list(self._pipeline) + list(extra_stages or []))]
         finally:
             close = getattr(client, "close", None)
             if close:
@@ -470,25 +461,50 @@ class MongoDatasource(Datasource):
 
     def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
         if self._num_shards == 1:
-            return [ReadTask(lambda: self._fetch(0, None))]
+            return [ReadTask(lambda: self._fetch())]
+        if self._pipeline:
+            # skip/limit windows over pipeline OUTPUT are only correct
+            # under a total order, and pipelines can project _id away or
+            # emit ties ($unwind) that MongoDB's unstable sort splits
+            # differently per shard — silent row loss/duplication. The
+            # reference likewise shards the raw collection (splitVector),
+            # not pipeline output.
+            raise ValueError(
+                "read_mongo: num_shards > 1 cannot be combined with a "
+                "pipeline (no total order over pipeline output to "
+                "partition on); shard the raw collection and apply the "
+                "pipeline per shard upstream, or use num_shards=1")
         client = self._client()
         try:
             coll = client[self._db][self._coll]
-            # Count the PIPELINE OUTPUT, not the raw collection — stages
-            # like $unwind/$match change cardinality and skip/limit windows
-            # partition what the pipeline emits.
-            counted = list(coll.aggregate(
-                list(self._pipeline) + [{"$count": "n"}]))
-            total = counted[0]["n"] if counted else 0
+            total = coll.count_documents({})
+            per = max(1, (total + self._num_shards - 1) // self._num_shards)
+            # _id range partition (every document has a unique, indexed
+            # _id): boundary docs at the shard edges make closed/open
+            # [lo, hi) predicates that are deterministic under concurrent
+            # writes — unlike skip/limit windows.
+            bounds = []
+            for i in range(1, self._num_shards):
+                edge = list(coll.aggregate([
+                    {"$sort": {"_id": 1}}, {"$skip": i * per},
+                    {"$limit": 1}, {"$project": {"_id": 1}}]))
+                bounds.append(edge[0]["_id"] if edge else None)
         finally:
             close = getattr(client, "close", None)
             if close:
                 close()
-        per = max(1, (total + self._num_shards - 1) // self._num_shards)
-        return [
-            ReadTask(lambda s=i * per: self._fetch(s, per))
-            for i in range(self._num_shards)
-        ]
+        tasks = []
+        prev = None
+        for hi in bounds + [None]:
+            match: dict = {}
+            if prev is not None:
+                match["$gte"] = prev
+            if hi is not None:
+                match["$lt"] = hi
+            stage = [{"$match": {"_id": match}}] if match else []
+            tasks.append(ReadTask(lambda st=stage: self._fetch(st)))
+            prev = hi
+        return tasks
 
 
 class BigQueryDatasource(Datasource):
@@ -542,7 +558,6 @@ class DeltaLakeDatasource(Datasource):
         self._root = table_path
 
     def _live_files(self) -> list[tuple[str, dict]]:
-        import glob as _glob
         import json as _json
 
         log_dir = os.path.join(self._root, "_delta_log")

@@ -63,6 +63,13 @@ class LLMConfig:
     # temperature/top-p; a top-k request in the batch falls back to
     # single-step ticks.
     decode_burst: int = 8
+    # Pipeline bursts: in steady-state decode (no admissions/prefills
+    # pending, budgets allow a full second burst) the NEXT burst is
+    # dispatched BEFORE the current one's tokens are fetched, feeding the
+    # on-device last token forward — the host⇄device roundtrip overlaps
+    # the next burst's compute instead of serializing with it. Output is
+    # identical (emission truncates finished requests either way).
+    decode_pipeline: bool = True
     # Prefill chunks dispatched per scheduler tick. The tick defers every
     # prefill's first-token fetch until after its decode dispatch, so a
     # bigger budget admits a burst of new requests in ONE roundtrip instead

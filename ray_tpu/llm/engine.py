@@ -497,6 +497,9 @@ class LLMEngine:
         self._waiting: queue.Queue[GenerationRequest] = queue.Queue()
         self._requests: dict[str, GenerationRequest] = {}
         self._rng_key = jax.random.PRNGKey(config.seed + 1)
+        # Pipelined decode: (active snapshot, burst, device tokens) of a
+        # chained burst awaiting resolution at the next tick's start.
+        self._pending_burst = None
         self._stop = threading.Event()
         self._work = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -651,6 +654,12 @@ class LLMEngine:
             if not worked:
                 self._work.wait(timeout=0.02)
                 self._work.clear()
+        # Drain a chained burst so its requests get their final tokens
+        # instead of hanging to their timeouts.
+        try:
+            self._resolve_pending_burst()
+        except Exception:  # noqa: BLE001 - shutdown path
+            pass
 
     def _tick(self) -> bool:
         """One scheduler step: a bounded budget of prefill chunks (their
@@ -660,7 +669,12 @@ class LLMEngine:
         vLLM chunked prefill scheduling); deferring the prefill fetches
         until the decode work is queued means the whole tick pays ONE
         host⇄device roundtrip however many prefills it ran."""
-        worked = self._admit()
+        # Resolve the pipelined burst FIRST: its emissions may finish
+        # requests and free slots, and admission must only reuse a slot
+        # after that resolution (device order then guarantees any stale
+        # chained writes are overwritten by the new prefill).
+        worked = self._resolve_pending_burst()
+        worked = self._admit() or worked
         deferred: list = []
         budget = max(1, int(getattr(self.config,
                                     "prefill_chunks_per_tick", 1) or 1))
@@ -894,6 +908,7 @@ class LLMEngine:
         request's context lived there: fail them all, then rebuild a fresh
         cache so the engine keeps serving NEW traffic."""
         self._cache_gen += 1  # invalidates in-flight prefill_only exports
+        self._pending_burst = None  # chained into the lost cache
         for req in list(self._slots.values()):
             if req is None:
                 continue
@@ -987,7 +1002,13 @@ class LLMEngine:
         A request finishing mid-burst (EOS/stop token) simply stops
         emitting; the extra KV the device wrote past its end sits at
         positions a later slot reuse overwrites (same free-rollback
-        property speculative decoding relies on)."""
+        property speculative decoding relies on).
+
+        In steady state a SECOND burst is chained before this one's
+        tokens are fetched (see _should_chain), feeding the on-device
+        last token forward — the fetch roundtrip then overlaps the next
+        burst's compute. The chained burst is resolved at the next tick's
+        start (_resolve_pending_burst)."""
         temps = np.zeros((self.max_slots,), np.float32)
         top_ps = np.ones((self.max_slots,), np.float32)
         for slot, req in active.items():
@@ -1001,19 +1022,69 @@ class LLMEngine:
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(write), jnp.asarray(temps),
                 jnp.asarray(top_ps), sub, burst, need_top_p)
+            if self._should_chain(active, burst):
+                self._rng_key, sub2 = jax.random.split(self._rng_key)
+                self.cache, toks2 = decode_burst(
+                    self.model_cfg, self.params, self.cache,
+                    toks[burst - 1], jnp.asarray(positions) + burst,
+                    jnp.asarray(write), jnp.asarray(temps),
+                    jnp.asarray(top_ps), sub2, burst, need_top_p)
+                self._pending_burst = (dict(active), burst, toks2)
             toks = np.asarray(toks)  # [burst, max_slots]
         except Exception as e:  # noqa: BLE001 - cache donated & lost
             logger.exception("burst decode failed (%d active, burst %d)",
                              len(active), burst)
             self._recover_device_failure(f"decode failed: {e!r}")
             return False
+        self._emit_burst(active, burst, toks)
+        return True
+
+    def _should_chain(self, active: dict[int, GenerationRequest],
+                      burst: int) -> bool:
+        """Chain a second burst only when the device would otherwise sit
+        idle through the fetch: steady decode (nothing waiting to admit,
+        no prefilling slot, no draft model interleaving the cache), every
+        slot has cache headroom for TWO bursts, and someone still needs
+        more than one burst of tokens."""
+        if burst <= 1 or not getattr(self.config, "decode_pipeline", False):
+            return False
+        if self._pending_burst is not None or self.draft_params is not None:
+            return False
+        if not self._waiting.empty():
+            return False
+        for r in self._slots.values():
+            if r is not None and r.next_pos < 0:
+                return False  # a prefill wants the next tick
+        budget = 0
+        for req in active.values():
+            if self.max_seq - 1 - req.next_pos < 2 * burst:
+                return False
+            budget = max(budget,
+                         req.sampling.max_tokens - len(req.out_tokens))
+        return budget > burst
+
+    def _resolve_pending_burst(self) -> bool:
+        """Fetch + emit the burst chained by the previous tick."""
+        if self._pending_burst is None:
+            return False
+        active, burst, toks_dev = self._pending_burst
+        self._pending_burst = None
+        try:
+            toks = np.asarray(toks_dev)
+        except Exception as e:  # noqa: BLE001 - surfaces at materialization
+            logger.exception("pipelined burst failed (%d slots)", len(active))
+            self._recover_device_failure(f"decode failed: {e!r}")
+            return True
+        self._emit_burst(active, burst, toks)
+        return True
+
+    def _emit_burst(self, active, burst: int, toks) -> None:
         for j in range(burst):
             for slot, req in active.items():
                 if req.done.is_set():
                     continue
                 req.next_pos += 1
                 self._emit(req, int(toks[j, slot]))
-        return True
 
     def _spec_decode(self, active: dict[int, GenerationRequest]) -> None:
         """One speculative tick: draft proposes spec_k tokens per slot in

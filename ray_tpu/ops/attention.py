@@ -685,18 +685,24 @@ def _chunk_specs(b, h, hkv, sq, skv, d, block_q):
 
 
 def _chunk_blocks(sq: int, skv: int, block_q: int, block_k: int):
-    """Block sizes that DIVIDE the chunk — ring shards can be any S/N, and
-    a floor-divided grid would silently drop the tail rows/columns."""
-    block_q = min(block_q, sq)
-    while block_q > 8 and sq % block_q:
-        block_q //= 2
-    block_k = min(block_k, skv)
-    while block_k > 8 and skv % block_k:
-        block_k //= 2
+    """POWER-OF-TWO block sizes that DIVIDE the chunk — ring shards can be
+    any S/N, a floor-divided grid would silently drop the tail, and Mosaic
+    tiling needs 8-aligned blocks (so an unaligned length must fail loudly
+    here, not with an opaque TPU compile error)."""
+
+    def pick(n: int, cap: int) -> int:
+        b = min(cap, 1 << (n.bit_length() - 1))  # largest pow2 <= n
+        while b > 8 and n % b:
+            b //= 2
+        return b
+
+    block_q = pick(sq, block_q)
+    block_k = pick(skv, block_k)
     if sq % block_q or skv % block_k:
         raise ValueError(
             f"flash_attention_chunk needs seq lengths with a power-of-two "
-            f"block divisor >= 8 (got sq={sq}, skv={skv})")
+            f"block divisor >= 8 (got sq={sq}, skv={skv}); pad the ring "
+            f"shard length or use impl='einsum'")
     return block_q, block_k
 
 

@@ -629,14 +629,23 @@ def test_read_mongo_with_injected_client(rt):
     docs = [{"_id": i, "name": f"d{i}", "score": i * 1.5,
              "tags": ["a", "b", "c"]} for i in range(10)]
 
+    def _match_one(d, flt):
+        for k, v in flt.items():
+            if isinstance(v, dict):  # operator form: {$gte: a, $lt: b}
+                if "$gte" in v and not d.get(k) >= v["$gte"]:
+                    return False
+                if "$lt" in v and not d.get(k) < v["$lt"]:
+                    return False
+            elif d.get(k) != v:
+                return False
+        return True
+
     class FakeColl:
         def aggregate(self, stages):
             out = list(docs)
             for st in stages:
                 if "$match" in st:
-                    f = st["$match"]
-                    out = [d for d in out
-                           if all(d.get(k) == v for k, v in f.items())]
+                    out = [d for d in out if _match_one(d, st["$match"])]
                 elif "$unwind" in st:
                     field = st["$unwind"].lstrip("$")
                     out = [{**d, field: x} for d in out for x in d[field]]
@@ -648,9 +657,15 @@ def test_read_mongo_with_injected_client(rt):
                     out = out[st["$skip"]:]
                 elif "$limit" in st:
                     out = out[:st["$limit"]]
+                elif "$project" in st:
+                    keep = [k for k, v in st["$project"].items() if v]
+                    out = [{k: d[k] for k in keep if k in d} for d in out]
                 elif "$count" in st:
                     out = [{st["$count"]: len(out)}]
             return iter(out)
+
+        def count_documents(self, flt):
+            return len(docs)
 
     class FakeDB(dict):
         def __getitem__(self, k):
@@ -677,12 +692,17 @@ def test_read_mongo_with_injected_client(rt):
                           client_factory=FakeClient).take_all()
     assert [r["_id"] for r in piped] == [7]
 
-    # cardinality-changing pipeline + sharding: shard windows partition the
-    # PIPELINE OUTPUT (count runs after the pipeline), nothing dropped
+    # cardinality-changing pipeline + sharding is rejected LOUDLY: there
+    # is no total order over pipeline output to partition on (unstable
+    # sorts over $unwind ties silently drop/duplicate rows on real mongo)
+    with pytest.raises(Exception, match="num_shards"):
+        rd.read_mongo("mongodb://fake", "db", "c",
+                      pipeline=[{"$unwind": "$tags"}],
+                      client_factory=FakeClient, num_shards=4).take_all()
+    # pipeline without sharding handles cardinality changes fine
     unwound = rd.read_mongo("mongodb://fake", "db", "c",
                             pipeline=[{"$unwind": "$tags"}],
-                            client_factory=FakeClient,
-                            num_shards=4).take_all()
+                            client_factory=FakeClient).take_all()
     assert len(unwound) == 30  # 10 docs x 3 tags
 
 

@@ -755,3 +755,20 @@ class TestBurstDecoding:
             assert 0 < len(r.token_ids) <= 6
         finally:
             eng.shutdown()
+
+    def test_pipelined_bursts_match_unpipelined(self):
+        """Chained bursts (decode_pipeline) must be output-invisible:
+        long generations where chaining engages every steady tick."""
+        base = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=128,
+                         decode_burst=4, decode_pipeline=False)
+        piped = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=128,
+                          decode_burst=4, decode_pipeline=True)
+        e1, e2 = LLMEngine(base), LLMEngine(piped)
+        try:
+            for prompt, n in [("pipeline me", 40), ("zz", 21)]:
+                r1 = e1.generate(prompt, SamplingParams(max_tokens=n))
+                r2 = e2.generate(prompt, SamplingParams(max_tokens=n))
+                assert r1.token_ids == r2.token_ids, (prompt, n)
+        finally:
+            e1.shutdown()
+            e2.shutdown()
