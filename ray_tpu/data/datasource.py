@@ -504,6 +504,11 @@ class MongoDatasource(Datasource):
             stage = [{"$match": {"_id": match}}] if match else []
             tasks.append(ReadTask(lambda st=stage: self._fetch(st)))
             prev = hi
+            if hi is None:
+                # No boundary doc at this edge (total < num_shards or the
+                # collection shrank): this task already took [prev, ∞) —
+                # further shards would re-read the whole collection.
+                break
         return tasks
 
 

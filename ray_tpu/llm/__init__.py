@@ -13,12 +13,13 @@ from ray_tpu.llm.serving import (
     build_llm_deployment,
     build_openai_app,
 )
+from ray_tpu.llm.hf import config_from_hf, convert_hf_llama
 from ray_tpu.llm.tokenizer import ByteTokenizer, get_tokenizer
 
 __all__ = [
     "LLMConfig", "SamplingParams", "LLMEngine", "GenerationResult",
     "LLMServer", "build_llm_deployment", "build_openai_app",
-    "ByteTokenizer", "get_tokenizer",
+    "ByteTokenizer", "get_tokenizer", "convert_hf_llama", "config_from_hf",
 ]
 
 # usage telemetry (local-only, opt-out — reference: usage_lib auto-records

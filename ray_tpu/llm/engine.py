@@ -441,7 +441,22 @@ class LLMEngine:
         self.max_slots = config.max_num_seqs
 
         if params is None and config.checkpoint_path:
-            params = _load_checkpoint(config.checkpoint_path)
+            import os as _os
+
+            if _os.path.isfile(_os.path.join(config.checkpoint_path,
+                                             "config.json")):
+                # HuggingFace checkpoint directory: geometry comes from the
+                # checkpoint itself (reference: ray.llm passes HF ids to
+                # vLLM; here llm/hf.py converts weights directly).
+                from ray_tpu.llm.hf import convert_hf_llama
+
+                self.model_cfg, params = convert_hf_llama(
+                    config.checkpoint_path, dtype=config.dtype)
+                self.max_seq = config.max_seq_len or self.model_cfg.max_seq_len
+                if self.tokenizer.vocab_size > self.model_cfg.vocab_size:
+                    raise ValueError("tokenizer vocab exceeds model vocab")
+            else:
+                params = _load_checkpoint(config.checkpoint_path)
         if params is None:
             params = init_params(self.model_cfg,
                                  jax.random.PRNGKey(config.seed))

@@ -687,6 +687,11 @@ def test_read_mongo_with_injected_client(rt):
     assert len(sharded.materialize()._refs_meta) == 3
     assert sorted(r["_id"] for r in sharded.take_all()) == list(range(10))
 
+    # more shards than documents: empty boundaries must not duplicate rows
+    over = rd.read_mongo("mongodb://fake", "db", "c",
+                         client_factory=FakeClient, num_shards=12)
+    assert sorted(r["_id"] for r in over.take_all()) == list(range(10))
+
     piped = rd.read_mongo("mongodb://fake", "db", "c",
                           pipeline=[{"$match": {"name": "d7"}}],
                           client_factory=FakeClient).take_all()
