@@ -435,9 +435,6 @@ class LLMEngine:
         self.config = config
         self.model_cfg = config.model_config()
         self.tokenizer = get_tokenizer(config.tokenizer)
-        if self.tokenizer.vocab_size > self.model_cfg.vocab_size:
-            raise ValueError("tokenizer vocab exceeds model vocab")
-        self.max_seq = config.max_seq_len or self.model_cfg.max_seq_len
         self.max_slots = config.max_num_seqs
 
         if params is None and config.checkpoint_path:
@@ -452,11 +449,14 @@ class LLMEngine:
 
                 self.model_cfg, params = convert_hf_llama(
                     config.checkpoint_path, dtype=config.dtype)
-                self.max_seq = config.max_seq_len or self.model_cfg.max_seq_len
-                if self.tokenizer.vocab_size > self.model_cfg.vocab_size:
-                    raise ValueError("tokenizer vocab exceeds model vocab")
             else:
                 params = _load_checkpoint(config.checkpoint_path)
+        # Validate against the FINAL geometry — an HF checkpoint replaces
+        # config.model's placeholder, and its (usually larger) vocab is
+        # what the tokenizer must fit in.
+        self.max_seq = config.max_seq_len or self.model_cfg.max_seq_len
+        if self.tokenizer.vocab_size > self.model_cfg.vocab_size:
+            raise ValueError("tokenizer vocab exceeds model vocab")
         if params is None:
             params = init_params(self.model_cfg,
                                  jax.random.PRNGKey(config.seed))

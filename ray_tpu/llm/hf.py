@@ -78,9 +78,19 @@ def config_from_hf(hf_cfg: dict, dtype: str | None = None) -> LlamaConfig:
     )
 
 
-def _state_dict_numpy(model) -> dict[str, np.ndarray]:
-    return {k: v.detach().to("cpu").float().numpy()
-            for k, v in model.state_dict().items()}
+class _LazyStateDict:
+    """Tensor-at-a-time view of a torch state_dict: each take() converts
+    ONE tensor to fp32 numpy and drops the reference afterwards, keeping
+    conversion peak memory near one model copy instead of three (an 8B
+    checkpoint would otherwise hold torch + a full fp32 numpy state dict
+    + the stacked copies simultaneously)."""
+
+    def __init__(self, model):
+        self._sd = dict(model.state_dict())
+
+    def take(self, name: str) -> np.ndarray:
+        t = self._sd.pop(name)
+        return t.detach().to("cpu").float().numpy()
 
 
 def convert_hf_llama(source, dtype: str | None = None
@@ -100,30 +110,34 @@ def convert_hf_llama(source, dtype: str | None = None
         # differ from Llama-3's (5e5) — hand-rolled defaults here would
         # silently diverge from what transformers loaded.
         hf_cfg = model.config.to_dict()
-        sd = _state_dict_numpy(model)
     else:
+        model = source
         hf_cfg = source.config.to_dict()
-        sd = _state_dict_numpy(source)
+    sd = _LazyStateDict(model)
 
     cfg = config_from_hf(hf_cfg, dtype)
     dt = cfg.jnp_dtype
     L = cfg.num_layers
 
     def take(name: str, transpose: bool) -> np.ndarray:
-        w = sd[name]
-        return w.T if transpose else w
+        w = sd.take(name)
+        return np.ascontiguousarray(w.T) if transpose else w
 
-    layers: dict[str, np.ndarray] = {}
+    layers: dict[str, jnp.ndarray] = {}
     for hf_name, (ours, tr) in _LAYER_MAP.items():
-        per_layer = [take(f"model.layers.{i}.{hf_name}", tr)
-                     for i in range(L)]
-        layers[ours] = np.stack(per_layer, axis=0)
+        # Stack then cast per PARAMETER (not the whole model at once): the
+        # fp32 staging buffer for one stacked tensor is freed before the
+        # next parameter converts.
+        stacked = np.stack([take(f"model.layers.{i}.{hf_name}", tr)
+                            for i in range(L)], axis=0)
+        layers[ours] = jnp.asarray(stacked, dt)
+        del stacked
 
     params = {
-        "embed_tokens": jnp.asarray(sd["model.embed_tokens.weight"], dt),
-        "final_norm": jnp.asarray(sd["model.norm.weight"], dt),
-        "layers": {k: jnp.asarray(v, dt) for k, v in layers.items()},
+        "embed_tokens": jnp.asarray(sd.take("model.embed_tokens.weight"), dt),
+        "final_norm": jnp.asarray(sd.take("model.norm.weight"), dt),
+        "layers": layers,
     }
     if not cfg.tie_embeddings:
-        params["lm_head"] = jnp.asarray(sd["lm_head.weight"].T, dt)
+        params["lm_head"] = jnp.asarray(sd.take("lm_head.weight").T, dt)
     return cfg, params
