@@ -1,0 +1,451 @@
+"""Head-packed flash-attention experiments on the real chip.
+
+Hypothesis (VERDICT r4 task 1): the fwd kernel is VPU-bound at head_dim 64
+— per-block online-softmax VPU work on 512x512 f32 score blocks rivals the
+K=64 MXU time. Packing P q-heads that share one GQA kv-head into a single
+kernel invocation (row-concat into [P*block_q, d] tiles) makes every matmul
+and VPU op P x larger (amortizing per-op overheads and keeping the MXU fed)
+without losing the causal block-skip granularity.
+
+Variants:
+  v0: current flash_attention fwd (baseline)
+  bq1024 / bk1024 / bq1024bk1024: block-size sweep on the baseline kernel
+  pack2 / pack4: P q-heads row-packed per invocation
+
+Run:  python devbench/prof_flash_pack.py [--check]
+"""
+import argparse
+import functools
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import (
+    LOG2E, LN2, NEG_INF, _flash_fwd_pallas, attention_reference)
+
+B, S, H, KV, HD = 4, 2048, 32, 8, 64
+L1, L2 = 8, 56
+
+
+def timed_slope_chain(make_step, carry0, reps=5):
+    def run_for(length):
+        @jax.jit
+        def run(c):
+            def body(c, _):
+                return make_step(c), None
+            c, _ = lax.scan(body, c, None, length=length)
+            return jax.tree_util.tree_reduce(
+                lambda a, x: a + x.ravel()[0].astype(jnp.float32), c, 0.0)
+        return run
+
+    r1, r2 = run_for(L1), run_for(L2)
+    float(r1(carry0)); float(r2(carry0))
+    slopes = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); float(r1(carry0)); t1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); float(r2(carry0)); t2 = time.perf_counter() - t0
+        slopes.append((t2 - t1) / (L2 - L1))
+    slopes.sort()
+    return slopes[len(slopes) // 2]
+
+
+# --------------------------------------------------------------------------
+# Packed forward kernel: P q-heads sharing one kv head per grid row.
+# --------------------------------------------------------------------------
+
+def _packed_fwd_epi_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                           kv_seq_len, block_k, sm_scale, causal, block_q,
+                           pack):
+    """Like _packed_fwd_kernel but the causal mask runs only on the partial
+    diagonal blocks: a mask-free fori_loop over fully-visible kv blocks, then
+    a statically-unrolled masked epilogue for the (at most ceil(bq/bk)+1)
+    partial blocks."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[...]
+    p_, bq, d = q.shape
+    q2 = q.reshape(p_ * bq, d)
+    scale2 = sm_scale * LOG2E
+    qs = (q2.astype(jnp.float32) * scale2).astype(q2.dtype)
+    nkv = kv_seq_len // block_k
+    rows = p_ * bq
+    row_iota = lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+    qpos = qi * bq + lax.rem(row_iota, bq)
+
+    def make_body(masked):
+        def body(j, carry):
+            o, m, l = carry
+            k = k_ref[pl.ds(j * block_k, block_k), :]
+            v = v_ref[pl.ds(j * block_k, block_k), :]
+            s = jnp.dot(qs, k.T, preferred_element_type=jnp.float32)
+            if masked:
+                kpos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(kpos <= qpos, s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            p = jnp.exp2(s - m_new[:, None])
+            alpha = jnp.exp2(m - m_new)
+            v1 = jnp.concatenate(
+                [v, jnp.ones((v.shape[0], 1), v.dtype)], axis=1)
+            ov = jnp.dot(p.astype(v.dtype), v1,
+                         preferred_element_type=jnp.float32)
+            l_new = l * alpha + lax.slice(ov, (0, d), (rows, d + 1))[:, 0]
+            o_new = o * alpha[:, None] + lax.slice(ov, (0, 0), (rows, d))
+            return o_new, m_new, l_new
+        return body
+
+    o0 = jnp.zeros((rows, d), jnp.float32)
+    m0 = jnp.full((rows,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rows,), jnp.float32)
+    if not causal:
+        o, m, l = lax.fori_loop(0, nkv, make_body(False), (o0, m0, l0))
+    else:
+        # kv block j is fully visible iff (j+1)*bk - 1 <= qi*bq (min qpos).
+        full = lax.div(qi * bq, block_k)
+        upper = jnp.minimum(lax.div((qi + 1) * bq + block_k - 1, block_k),
+                            nkv)
+        carry = lax.fori_loop(0, full, make_body(False), (o0, m0, l0))
+        # Partial-diagonal epilogue: at most ceil(bq/bk)+? blocks; unroll a
+        # static worst case of n_partial = upper-full <= ceil(bq/bk) blocks
+        # guarded by pl.when-free select (masked body is idempotent for
+        # fully-masked blocks? NO — run only real ones via fori_loop).
+        carry = lax.fori_loop(full, upper, make_body(True), carry)
+        o, m, l = carry
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l[:, None]).astype(o_ref.dtype).reshape(p_, bq, d)
+    lse_ref[...] = ((m + jnp.log2(l)) * LN2).reshape(p_, bq)
+
+
+def _packed_fwd_inl_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                           kv_seq_len, block_k, sm_scale, causal, block_q,
+                           pack):
+    """block_q == block_k variant: exactly ONE partial (diagonal) kv block
+    per q block, unrolled as straight-line code after a mask-free fori_loop
+    — not a second loop (split loops pipeline worse, r4 + epi variant).
+    The diagonal mask is the same local triangular pattern for every qi."""
+    from jax.experimental import pallas as pl
+
+    assert block_q == block_k
+    qi = pl.program_id(1)
+    q = q_ref[...]
+    p_, bq, d = q.shape
+    q2 = q.reshape(p_ * bq, d)
+    scale2 = sm_scale * LOG2E
+    qs = (q2.astype(jnp.float32) * scale2).astype(q2.dtype)
+    nkv = kv_seq_len // block_k
+    rows = p_ * bq
+
+    def make_body(masked):
+        def body(j, carry):
+            o, m, l = carry
+            k = k_ref[pl.ds(j * block_k, block_k), :]
+            v = v_ref[pl.ds(j * block_k, block_k), :]
+            s = jnp.dot(qs, k.T, preferred_element_type=jnp.float32)
+            if masked:
+                # Diagonal block: local triangular mask, identical for all qi.
+                lq = lax.rem(lax.broadcasted_iota(jnp.int32, s.shape, 0), bq)
+                lk = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(lk <= lq, s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            p = jnp.exp2(s - m_new[:, None])
+            alpha = jnp.exp2(m - m_new)
+            v1 = jnp.concatenate(
+                [v, jnp.ones((v.shape[0], 1), v.dtype)], axis=1)
+            ov = jnp.dot(p.astype(v.dtype), v1,
+                         preferred_element_type=jnp.float32)
+            l_new = l * alpha + lax.slice(ov, (0, d), (rows, d + 1))[:, 0]
+            o_new = o * alpha[:, None] + lax.slice(ov, (0, 0), (rows, d))
+            return o_new, m_new, l_new
+        return body
+
+    o0 = jnp.zeros((rows, d), jnp.float32)
+    m0 = jnp.full((rows,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rows,), jnp.float32)
+    if not causal:
+        o, m, l = lax.fori_loop(0, nkv, make_body(False), (o0, m0, l0))
+    else:
+        carry = lax.fori_loop(0, qi, make_body(False), (o0, m0, l0))
+        o, m, l = make_body(True)(qi, carry)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l[:, None]).astype(o_ref.dtype).reshape(p_, bq, d)
+    lse_ref[...] = ((m + jnp.log2(l)) * LN2).reshape(p_, bq)
+
+
+def packed_fwd_inl(q, k, v, causal, sm_scale, pack=2, block_q=512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    rep = h // hkv
+    assert rep % pack == 0 and h % pack == 0
+    block_q = min(block_q, sq)
+    block_k = block_q
+    g = b * h // pack
+    qf = q.reshape(g, pack, sq, d)
+    kf = k.reshape(b * hkv, skv, d)
+    vf = v.reshape(b * hkv, skv, d)
+    kv_div = rep // pack
+
+    kernel = functools.partial(
+        _packed_fwd_inl_kernel, kv_seq_len=skv, block_k=block_k,
+        sm_scale=sm_scale, causal=causal, block_q=block_q, pack=pack)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(g, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, pack, block_q, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // kv_div, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // kv_div, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, pack, block_q, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((None, pack, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, pack, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((g, pack, sq), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def packed_fwd_epi(q, k, v, causal, sm_scale, pack=2, block_q=512,
+                   block_k=512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    rep = h // hkv
+    assert rep % pack == 0 and h % pack == 0
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    g = b * h // pack
+    qf = q.reshape(g, pack, sq, d)
+    kf = k.reshape(b * hkv, skv, d)
+    vf = v.reshape(b * hkv, skv, d)
+    kv_div = rep // pack
+
+    kernel = functools.partial(
+        _packed_fwd_epi_kernel, kv_seq_len=skv, block_k=block_k,
+        sm_scale=sm_scale, causal=causal, block_q=block_q, pack=pack)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(g, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, pack, block_q, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // kv_div, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // kv_div, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, pack, block_q, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((None, pack, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, pack, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((g, pack, sq), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_seq_len,
+                       block_k, sm_scale, causal, block_q, pack):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[...]                        # [pack, bq, d]
+    p_, bq, d = q.shape
+    q2 = q.reshape(p_ * bq, d)
+    scale2 = sm_scale * LOG2E
+    qs = (q2.astype(jnp.float32) * scale2).astype(q2.dtype)
+    nkv = kv_seq_len // block_k
+
+    rows = p_ * bq
+    # Row r of the packed block is query position qi*bq + (r mod bq).
+    row_iota = lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+    qpos = qi * bq + lax.rem(row_iota, bq)
+
+    def body(j, carry, masked):
+        o, m, l = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(qs, k.T, preferred_element_type=jnp.float32)
+        if masked:
+            kpos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m - m_new)
+        v1 = jnp.concatenate([v, jnp.ones((v.shape[0], 1), v.dtype)], axis=1)
+        ov = jnp.dot(p.astype(v.dtype), v1, preferred_element_type=jnp.float32)
+        l_new = l * alpha + lax.slice(ov, (0, d), (rows, d + 1))[:, 0]
+        o_new = o * alpha[:, None] + lax.slice(ov, (0, 0), (rows, d))
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((rows, d), jnp.float32)
+    m0 = jnp.full((rows,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rows,), jnp.float32)
+    if causal:
+        upper = lax.div((qi + 1) * bq + block_k - 1, block_k)
+        upper = jnp.minimum(upper, nkv)
+        o, m, l = lax.fori_loop(0, upper,
+                                functools.partial(body, masked=True),
+                                (o0, m0, l0))
+    else:
+        o, m, l = lax.fori_loop(0, nkv, functools.partial(body, masked=False),
+                                (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l[:, None]).astype(o_ref.dtype).reshape(p_, bq, d)
+    lse_ref[...] = ((m + jnp.log2(l)) * LN2).reshape(p_, bq)
+
+
+def packed_fwd(q, k, v, causal, sm_scale, pack=2, block_q=512, block_k=512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    rep = h // hkv
+    assert rep % pack == 0 and h % pack == 0
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    g = b * h // pack                    # head-group grid rows
+    # [b, h, s, d] -> [b*h/pack, pack, s, d]: adjacent heads share kv.
+    qf = q.reshape(g, pack, sq, d)
+    kf = k.reshape(b * hkv, skv, d)
+    vf = v.reshape(b * hkv, skv, d)
+    kv_div = rep // pack                 # grid rows per kv head
+
+    kernel = functools.partial(
+        _packed_fwd_kernel, kv_seq_len=skv, block_k=block_k,
+        sm_scale=sm_scale, causal=causal, block_q=block_q, pack=pack)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(g, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, pack, block_q, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // kv_div, 0, 0)),
+            pl.BlockSpec((None, skv, d), lambda i, j: (i // kv_div, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, pack, block_q, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((None, pack, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, pack, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((g, pack, sq), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--only", default="")
+    args = ap.parse_args()
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv_, _ = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (B, H, S, HD), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, KV, S, HD), jnp.bfloat16)
+    v = jax.random.normal(kv_, (B, KV, S, HD), jnp.bfloat16)
+    scale = 1.0 / math.sqrt(HD)
+
+    if args.check:
+        # Small-geometry correctness vs reference on the chip.
+        qs = q[:1, :8, :1024]; ks = k[:1, :2, :1024]; vs = v[:1, :2, :1024]
+        ref = attention_reference(qs, ks, vs, causal=True, sm_scale=scale)
+        for pack in (2, 4):
+            got, _ = packed_fwd(qs, ks, vs, True, scale, pack=pack)
+            err = jnp.max(jnp.abs(got.astype(jnp.float32) -
+                                  ref.astype(jnp.float32)))
+            print(f"pack{pack} max|err| = {float(err):.5f}")
+        for pack, bq in ((2, 512), (4, 256), (4, 512)):
+            got, _ = packed_fwd_epi(qs, ks, vs, True, scale, pack=pack,
+                                    block_q=bq)
+            err = jnp.max(jnp.abs(got.astype(jnp.float32) -
+                                  ref.astype(jnp.float32)))
+            print(f"epi_pack{pack}_bq{bq} max|err| = {float(err):.5f}")
+        for pack, bq in ((2, 512), (4, 512), (4, 256)):
+            got, _ = packed_fwd_inl(qs, ks, vs, True, scale, pack=pack,
+                                    block_q=bq)
+            err = jnp.max(jnp.abs(got.astype(jnp.float32) -
+                                  ref.astype(jnp.float32)))
+            print(f"inl_pack{pack}_bq{bq} max|err| = {float(err):.5f}")
+        return
+
+    flops = 2 * 2 * B * H * S * S * HD / 2  # causal fwd QK^T + PV
+
+    def mk(fn):
+        def step(c):
+            o, _ = fn(c, k, v)
+            return o
+        return step
+
+    # NOTE: _flash_fwd_pallas now IS the packed+inline-diag kernel (the r5
+    # winner landed in ops/attention.py), so "prod" measures the shipped
+    # path; the historical block-size sweep of the old kernel was removed
+    # because the old kernel no longer exists (it forced block_k=block_q
+    # under inline_diag, making those labels lie).
+    variants = {
+        "prod": lambda q_, k_, v_: _flash_fwd_pallas(
+            q_, k_, v_, True, scale),
+        "pack2": lambda q_, k_, v_: packed_fwd(q_, k_, v_, True, scale, 2),
+        "pack4": lambda q_, k_, v_: packed_fwd(q_, k_, v_, True, scale, 4),
+        "pack2_bk1024": lambda q_, k_, v_: packed_fwd(
+            q_, k_, v_, True, scale, 2, block_k=1024),
+        "pack4_bk1024": lambda q_, k_, v_: packed_fwd(
+            q_, k_, v_, True, scale, 4, block_k=1024),
+        "pack4_bq256": lambda q_, k_, v_: packed_fwd(
+            q_, k_, v_, True, scale, 4, block_q=256),
+        "pack4_bq256_bk256": lambda q_, k_, v_: packed_fwd(
+            q_, k_, v_, True, scale, 4, block_q=256, block_k=256),
+        "epi_pack4_bq256": lambda q_, k_, v_: packed_fwd_epi(
+            q_, k_, v_, True, scale, 4, block_q=256),
+        "epi_pack4_bq512": lambda q_, k_, v_: packed_fwd_epi(
+            q_, k_, v_, True, scale, 4, block_q=512),
+        "epi_pack2_bq512": lambda q_, k_, v_: packed_fwd_epi(
+            q_, k_, v_, True, scale, 2, block_q=512),
+        "epi_pack4_bq256_bk256": lambda q_, k_, v_: packed_fwd_epi(
+            q_, k_, v_, True, scale, 4, block_q=256, block_k=256),
+        "inl_pack4_bq512": lambda q_, k_, v_: packed_fwd_inl(
+            q_, k_, v_, True, scale, 4, block_q=512),
+        "inl_pack2_bq512": lambda q_, k_, v_: packed_fwd_inl(
+            q_, k_, v_, True, scale, 2, block_q=512),
+        "inl_pack4_bq256": lambda q_, k_, v_: packed_fwd_inl(
+            q_, k_, v_, True, scale, 4, block_q=256),
+        "inl_pack1_bq512": lambda q_, k_, v_: packed_fwd_inl(
+            q_, k_, v_, True, scale, 1, block_q=512),
+        "inl_pack2_bq1024": lambda q_, k_, v_: packed_fwd_inl(
+            q_, k_, v_, True, scale, 2, block_q=1024),
+        "inl_pack4_bq1024": lambda q_, k_, v_: packed_fwd_inl(
+            q_, k_, v_, True, scale, 4, block_q=1024),
+    }
+    for name, fn in variants.items():
+        if args.only and args.only not in name:
+            continue
+        try:
+            ms = timed_slope_chain(mk(fn), q) * 1e3
+            print(f"{name:20s} {ms:7.3f} ms  {flops / (ms * 1e-3) / 1e12:6.1f} TF/s")
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:20s} FAILED: {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

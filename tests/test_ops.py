@@ -88,7 +88,11 @@ def test_flash_pallas_interpret_matches_reference():
 
 @pytest.mark.parametrize("fused", [True, False])
 @pytest.mark.parametrize("h,hkv,causal", [(2, 2, True), (4, 2, True),
-                                          (2, 2, False)])
+                                          (2, 2, False),
+                                          # rep=4: pack=4 kernel path + the
+                                          # kv_div>1 remainder fold — the
+                                          # geometry production Llama uses.
+                                          (8, 2, True), (8, 1, False)])
 def test_flash_pallas_backward_matches_reference(h, hkv, causal, fused):
     """Gradient equivalence of the Pallas backward kernels (interpret mode)
     against autodiff through attention_reference — incl. the GQA fold —
