@@ -37,6 +37,29 @@ def init(
             return
         raise RayTpuError("already initialized; call shutdown() first")
 
+    import os
+
+    if address is None:
+        # Reference: RAY_ADDRESS env steers a bare ray.init() to a running
+        # cluster (python/ray/_private/services.py canonicalize_bootstrap).
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
+    if address == "auto":
+        # Reference: ray.init("auto") finds the cluster started by
+        # `ray start` on this host. Our `start` writes head.addr.
+        from ray_tpu.scripts.start import default_temp_dir
+
+        addr_file = os.path.join(default_temp_dir(), "head.addr")
+        try:
+            with open(addr_file) as f:
+                address = f.read().strip()
+        except OSError:
+            raise RayTpuError(
+                "address='auto' but no running cluster found (no "
+                f"{addr_file}); run `python -m ray_tpu start --head` first, "
+                "and if it was started with a custom --temp-dir, set "
+                "RAY_TPU_TEMP_DIR to that directory"
+            ) from None
+
     global_worker.job_id = JobID.from_random()
     if address is None:
         from ray_tpu.core.local_runtime import LocalRuntime

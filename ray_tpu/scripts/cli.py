@@ -158,7 +158,13 @@ def main(argv: list[str] | None = None) -> int:
     gp.add_argument("--tail", type=int, default=20_000)
     sub.add_parser("memory")
 
+    from ray_tpu.scripts.start import add_parsers as _add_start_parsers
+
+    _add_start_parsers(sub)
+
     args = p.parse_args(argv)
+    if hasattr(args, "_fn"):  # start/stop/serve-* carry their handler
+        return args._fn(args)
     cmds = {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
             "timeline": cmd_timeline, "logs": cmd_logs, "memory": cmd_memory}
     return cmds[args.command](args)

@@ -1,0 +1,348 @@
+"""Standalone cluster bring-up: ``python -m ray_tpu start / stop``.
+
+Capability parity with the reference's deployment entrypoint (reference:
+python/ray/scripts/scripts.py:681 ``ray start`` and _private/node.py:1351
+start_ray_processes): ``start --head`` runs a HeadServer (plus, by default,
+a local NodeDaemon) as a real long-lived OS process; ``start
+--address=<head>`` joins a worker node. This is how the framework comes up
+on an actual TPU pod — one ``start`` per TPU host, head on the CPU
+coordinator — instead of only embedded in a driver process.
+
+Process model: ``start`` without ``--block`` spawns a detached child
+(``python -m ray_tpu serve-head|serve-node ...``) whose stdout/stderr go to
+``<temp-dir>/*.log``, waits for the child's readiness file, prints the
+address, and returns — the child keeps running after the shell exits
+(``start_new_session``). ``--block`` serves in the foreground (for
+containers / systemd). ``stop`` terminates every pid recorded under the
+temp dir (SIGTERM, then SIGKILL after a grace period).
+
+The temp-dir layout (default ``/tmp/ray_tpu``, override ``--temp-dir`` or
+``RAY_TPU_TEMP_DIR``):
+
+    head.addr            "host:port" — written by the head once serving;
+                         ``ray_tpu.init(address="auto")`` reads it
+    head.pid / head.log
+    node-<id>.pid / .ready / .log
+    head_state/          head WAL + snapshots (crash recovery)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+
+def default_temp_dir() -> str:
+    return os.environ.get("RAY_TPU_TEMP_DIR", "/tmp/ray_tpu")
+
+
+def _node_resources(num_cpus: float | None, resources_json: str | None,
+                    ) -> dict[str, float]:
+    """CPU count plus auto-detected TPU chips (reference: ray start's
+    ResourceSpec resolution, _private/resource_spec.py)."""
+    totals: dict[str, float] = {
+        "CPU": float(num_cpus if num_cpus is not None
+                     else (os.cpu_count() or 1)),
+    }
+    try:
+        from ray_tpu.accelerators.tpu import TpuAcceleratorManager
+
+        totals.update(TpuAcceleratorManager().get_current_node_resources())
+    except Exception:
+        pass
+    if resources_json:
+        totals.update({k: float(v)
+                       for k, v in json.loads(resources_json).items()})
+    return totals
+
+
+def _node_labels(labels_json: str | None) -> dict[str, str]:
+    labels: dict[str, str] = {}
+    try:
+        from ray_tpu.accelerators.tpu import TpuAcceleratorManager
+
+        labels.update(TpuAcceleratorManager().get_current_node_labels())
+    except Exception:
+        pass
+    if labels_json:
+        labels.update(json.loads(labels_json))
+    return labels
+
+
+def _write(path: str, text: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)  # atomic: readers never see a partial file
+
+
+def _serve_until_signal(stop_cb) -> int:
+    """Foreground-serve on the io-loop thread until SIGTERM/SIGINT."""
+    import threading
+
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    stop_cb()
+    return 0
+
+
+def serve_head(args) -> int:
+    """Run HeadServer (+ a local NodeDaemon unless --head-only) in this
+    process until signalled."""
+    from ray_tpu.core.cluster.client import start_head, start_node
+    from ray_tpu.core.cluster.protocol import EventLoopThread
+
+    temp = args.temp_dir
+    os.makedirs(temp, exist_ok=True)
+    persist = args.persist or os.path.join(temp, "head_state")
+    head = start_head(host=args.host, port=args.port, persist_path=persist)
+    daemons = []
+    if not args.head_only:
+        daemons.append(start_node(
+            head.rpc.host, head.rpc.port,
+            _node_resources(args.num_cpus, args.resources),
+            _node_labels(args.labels), node_id=uuid.uuid4().hex))
+    _write(os.path.join(temp, "head.pid"), str(os.getpid()))
+    _write(os.path.join(temp, "head.addr"),
+           f"{head.rpc.host}:{head.rpc.port}")
+    print(f"ray_tpu head serving at {head.rpc.host}:{head.rpc.port}",
+          flush=True)
+
+    def stop():
+        io = EventLoopThread.get()
+        for d in daemons:
+            try:
+                io.run(d.stop())
+            except Exception:
+                pass
+        try:
+            io.run(head.stop())
+        except Exception:
+            pass
+
+    return _serve_until_signal(stop)
+
+
+def serve_node(args) -> int:
+    """Run a NodeDaemon joined to --address in this process until
+    signalled."""
+    from ray_tpu.core.cluster.client import start_node
+    from ray_tpu.core.cluster.protocol import EventLoopThread
+
+    args.address = getattr(args, "address", None)
+    if not args.address:
+        print("error: serve-node requires --address=<head host:port>",
+              file=sys.stderr)
+        return 2
+    temp = args.temp_dir
+    os.makedirs(temp, exist_ok=True)
+    host, port = args.address.rsplit(":", 1)
+    node_id = args.node_id or uuid.uuid4().hex
+    daemon = start_node(host, int(port),
+                        _node_resources(args.num_cpus, args.resources),
+                        _node_labels(args.labels), node_id=node_id)
+    _write(os.path.join(temp, f"node-{node_id}.pid"), str(os.getpid()))
+    _write(os.path.join(temp, f"node-{node_id}.ready"),
+           f"{daemon.rpc.host}:{daemon.rpc.port}")
+    print(f"ray_tpu node {node_id} joined {args.address}", flush=True)
+
+    def stop():
+        try:
+            EventLoopThread.get().run(daemon.stop())
+        except Exception:
+            pass
+
+    return _serve_until_signal(stop)
+
+
+def _spawn_detached(serve_cmd: str, args, ready_file: str,
+                    log_name: str, timeout: float = 30.0) -> str:
+    """Start ``python -m ray_tpu <serve_cmd> ...`` detached; wait for the
+    readiness file and return its contents."""
+    temp = args.temp_dir
+    os.makedirs(temp, exist_ok=True)
+    if os.path.exists(ready_file):
+        os.unlink(ready_file)
+    argv = [sys.executable, "-m", "ray_tpu", serve_cmd,
+            "--temp-dir", temp]
+    passthrough = {
+        "host": "--host", "port": "--port", "address": "--address",
+        "num_cpus": "--num-cpus", "resources": "--resources",
+        "labels": "--labels", "persist": "--persist",
+        "node_id": "--node-id",
+    }
+    for attr, flag in passthrough.items():
+        val = getattr(args, attr, None)
+        if val is not None:
+            argv += [flag, str(val)]
+    if getattr(args, "head_only", False):
+        argv.append("--head-only")
+    log = open(os.path.join(temp, log_name), "ab")
+    proc = subprocess.Popen(argv, stdout=log, stderr=log,
+                            start_new_session=True)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(ready_file):
+            with open(ready_file) as f:
+                return f.read().strip()
+        if proc.poll() is not None:
+            with open(os.path.join(temp, log_name), "rb") as f:
+                tail = f.read()[-2000:].decode(errors="replace")
+            raise RuntimeError(
+                f"{serve_cmd} exited with {proc.returncode}:\n{tail}")
+        time.sleep(0.05)
+    proc.terminate()
+    raise TimeoutError(f"{serve_cmd} did not become ready in {timeout}s")
+
+
+def cmd_start(args) -> int:
+    # --address may come from the top-level parser or the subcommand
+    # (argparse.SUPPRESS on the subparser keeps whichever was given).
+    args.address = getattr(args, "address", None)
+    if args.head and args.address:
+        print("error: pass either --head or --address, not both",
+              file=sys.stderr)
+        return 2
+    if not args.head and not args.address:
+        print("error: pass --head to start a head, or --address=<head> to "
+              "join one", file=sys.stderr)
+        return 2
+    if args.head:
+        if args.block:
+            return serve_head(args)
+        addr = _spawn_detached(
+            "serve-head", args, os.path.join(args.temp_dir, "head.addr"),
+            "head.log")
+        print(f"ray_tpu head started at {addr}")
+        print(f'connect with ray_tpu.init(address="{addr}") or '
+              f'init(address="auto"); add nodes with\n'
+              f"  python -m ray_tpu start --address={addr}")
+        return 0
+    args.node_id = getattr(args, "node_id", None) or uuid.uuid4().hex
+    if args.block:
+        return serve_node(args)
+    _spawn_detached(
+        "serve-node", args,
+        os.path.join(args.temp_dir, f"node-{args.node_id}.ready"),
+        f"node-{args.node_id}.log")
+    print(f"ray_tpu node {args.node_id} joined {args.address}")
+    return 0
+
+
+def _is_ray_tpu_proc(pid: int) -> bool:
+    """Guard against pid recycling: only signal pids whose cmdline still
+    looks like a ray_tpu serve process (a SIGKILLed daemon leaves its .pid
+    file behind and the OS may hand the number to an innocent process)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().replace(b"\0", b" ")
+    except OSError:
+        # No procfs (or no permission to read it): fall back to signalling —
+        # the caller still handles kill errors.
+        return True
+    return b"ray_tpu" in cmdline
+
+
+def cmd_stop(args) -> int:
+    """Terminate every process recorded under the temp dir (reference:
+    ``ray stop``, scripts.py:1038)."""
+    temp = args.temp_dir
+    if not os.path.isdir(temp):
+        print("nothing to stop")
+        return 0
+    pids = []
+    for name in sorted(os.listdir(temp)):
+        if not name.endswith(".pid"):
+            continue
+        path = os.path.join(temp, name)
+        try:
+            with open(path) as f:
+                pid = int(f.read().strip())
+        except (OSError, ValueError):
+            os.unlink(path)
+            continue
+        try:
+            if _is_ray_tpu_proc(pid):
+                os.kill(pid, signal.SIGTERM)
+                pids.append((name, pid))
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        os.unlink(path)
+    deadline = time.monotonic() + args.grace_period
+    alive = dict(pids)
+    while alive and time.monotonic() < deadline:
+        for name, pid in list(alive.items()):
+            try:
+                os.kill(pid, 0)
+            except (ProcessLookupError, PermissionError, OSError):
+                del alive[name]
+        time.sleep(0.1)
+    for name, pid in alive.items():
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+    for name in os.listdir(temp):
+        if name.endswith((".ready", ".addr")):
+            try:
+                os.unlink(os.path.join(temp, name))
+            except OSError:
+                pass
+    print(f"stopped {len(pids)} process(es)"
+          + (f" ({len(alive)} force-killed)" if alive else ""))
+    return 0
+
+
+def add_parsers(sub) -> None:
+    """Wire start/stop/serve-* into the top-level CLI subparsers."""
+    st = sub.add_parser("start", help="start head or worker-node processes")
+    st.add_argument("--head", action="store_true")
+    st.add_argument("--address", default=argparse.SUPPRESS,
+                    help="head host:port to join (worker node mode)")
+    st.add_argument("--host", default="127.0.0.1",
+                    help="bind host for the head (use a routable IP for "
+                         "multi-host clusters)")
+    st.add_argument("--port", type=int, default=6379)
+    st.add_argument("--num-cpus", type=float, default=None, dest="num_cpus")
+    st.add_argument("--resources", default=None,
+                    help='JSON resource overrides, e.g. \'{"TPU": 4}\'')
+    st.add_argument("--labels", default=None, help="JSON node labels")
+    st.add_argument("--persist", default=None,
+                    help="head WAL/snapshot dir (default <temp-dir>/head_state)")
+    st.add_argument("--head-only", action="store_true", dest="head_only",
+                    help="do not run a local node daemon next to the head")
+    st.add_argument("--block", action="store_true",
+                    help="serve in the foreground instead of daemonizing")
+    st.add_argument("--temp-dir", default=default_temp_dir(), dest="temp_dir")
+    st.add_argument("--node-id", default=None, dest="node_id")
+    st.set_defaults(_fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop all ray_tpu processes on this host")
+    sp.add_argument("--temp-dir", default=default_temp_dir(), dest="temp_dir")
+    sp.add_argument("--grace-period", type=float, default=5.0,
+                    dest="grace_period")
+    sp.set_defaults(_fn=cmd_stop)
+
+    for name, fn in (("serve-head", serve_head), ("serve-node", serve_node)):
+        pp = sub.add_parser(name)
+        pp.add_argument("--host", default="127.0.0.1")
+        pp.add_argument("--port", type=int, default=6379)
+        pp.add_argument("--address", default=argparse.SUPPRESS)
+        pp.add_argument("--num-cpus", type=float, default=None,
+                        dest="num_cpus")
+        pp.add_argument("--resources", default=None)
+        pp.add_argument("--labels", default=None)
+        pp.add_argument("--persist", default=None)
+        pp.add_argument("--head-only", action="store_true", dest="head_only")
+        pp.add_argument("--temp-dir", default=default_temp_dir(),
+                        dest="temp_dir")
+        pp.add_argument("--node-id", default=None, dest="node_id")
+        pp.set_defaults(_fn=fn)
