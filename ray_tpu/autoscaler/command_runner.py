@@ -1,0 +1,79 @@
+"""Command runners: how the autoscaler executes bootstrap commands on a
+provisioned machine.
+
+Capability parity with the reference's command-runner layer (reference:
+python/ray/autoscaler/_private/command_runner.py — SSHCommandRunner sets up
+freshly provisioned nodes over SSH; LocalNodeProvider runs on-host): the
+autoscaler provisions capacity through a NodeProvider and then *joins* it to
+the cluster by running ``python -m ray_tpu start --address=<head>`` through
+one of these runners. GCE instances normally bootstrap via their
+startup-script metadata instead (ray_tpu/autoscaler/gcp.py:_startup_script);
+the SSH runner covers images where startup scripts are unavailable and
+on-prem/bare-metal hosts.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Callable, Sequence
+
+
+class CommandRunner:
+    """Executes a command on a target machine; raises on failure."""
+
+    def run(self, cmd: Sequence[str], timeout: float = 120.0) -> str:
+        raise NotImplementedError
+
+
+class LocalCommandRunner(CommandRunner):
+    """Runs on this host (reference: LocalNodeProvider's on-host setup).
+    Used by SubprocessNodeProvider to bootstrap fake 'machines' as real OS
+    processes, and for single-host deployments."""
+
+    def run(self, cmd: Sequence[str], timeout: float = 120.0) -> str:
+        proc = subprocess.run(list(cmd), capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"command {cmd!r} failed ({proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}")
+        return proc.stdout
+
+
+class SshCommandRunner(CommandRunner):
+    """Runs over SSH on a remote host (reference: SSHCommandRunner,
+    command_runner.py:214). ``exec_fn`` is injectable so air-gapped tests
+    can stub the transport."""
+
+    def __init__(self, host: str, user: str = "root",
+                 ssh_key: str | None = None,
+                 ssh_options: Sequence[str] | None = None,
+                 exec_fn: Callable[..., "subprocess.CompletedProcess"]
+                 | None = None):
+        self.host = host
+        self.user = user
+        self.ssh_key = ssh_key
+        self.ssh_options = list(ssh_options or (
+            "-o", "StrictHostKeyChecking=no",
+            "-o", "ConnectTimeout=10",
+            "-o", "BatchMode=yes",
+        ))
+        self._exec = exec_fn or (lambda argv, timeout: subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout))
+
+    def run(self, cmd: Sequence[str], timeout: float = 120.0) -> str:
+        import shlex
+
+        argv = ["ssh", *self.ssh_options]
+        if self.ssh_key:
+            argv += ["-i", self.ssh_key]
+        argv.append(f"{self.user}@{self.host}")
+        # The remote side word-splits; quote so JSON args (--resources
+        # '{"TPU": 4}') survive intact.
+        argv.append(" ".join(shlex.quote(c) for c in cmd))
+        proc = self._exec(argv, timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"ssh to {self.host} failed ({proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}")
+        return proc.stdout

@@ -14,6 +14,9 @@ injectable hook so tests and air-gapped environments stub it).
 from __future__ import annotations
 
 import itertools
+import json
+import os
+import sys
 import uuid
 from typing import Callable
 
@@ -82,6 +85,95 @@ class FakeMultiNodeProvider(NodeProvider):
     def runtime_node_id(self, cloud_id: str) -> str | None:
         rec = self._nodes.get(cloud_id)
         return rec["node_id"] if rec and rec["status"] == "running" else None
+
+
+class SubprocessNodeProvider(NodeProvider):
+    """Provisions 'machines' as real detached OS processes via the
+    ``ray_tpu start`` bootstrap path (reference:
+    fake_multi_node/node_provider.py:237, which boots real raylet
+    processes). This is the e2e stand-in for cloud bootstrap: the provider
+    allocates capacity, then a CommandRunner joins it to the cluster
+    exactly the way a GCE startup script or SSH setup would — so the test
+    exercises demand → provision → ``start`` → join → schedule."""
+
+    def __init__(self, head_addr: str, base_temp_dir: str,
+                 runner=None, python: str | None = None):
+        from ray_tpu.autoscaler.command_runner import LocalCommandRunner
+
+        self.head_addr = head_addr
+        self.base_temp_dir = base_temp_dir
+        self.runner = runner or LocalCommandRunner()
+        self.python = python or sys.executable
+        self._nodes: dict[str, dict] = {}  # cloud_id -> {node_id, temp_dir}
+
+    def _pid(self, rec: dict) -> int | None:
+        # Through the runner (not the local filesystem) so the same
+        # provider works when the runner targets a remote host over SSH.
+        path = os.path.join(rec["temp_dir"], f"node-{rec['node_id']}.pid")
+        try:
+            return int(self.runner.run(["cat", path], timeout=20).strip())
+        except Exception:
+            return None
+
+    def launch_node(self, node_type: str, resources: dict[str, float],
+                    labels: dict[str, str] | None = None) -> str:
+        node_id = f"sub-{uuid.uuid4().hex[:8]}"
+        temp_dir = os.path.join(self.base_temp_dir, node_id)
+        cmd = [self.python, "-m", "ray_tpu", "start",
+               "--address", self.head_addr,
+               "--node-id", node_id,
+               "--temp-dir", temp_dir,
+               "--num-cpus", str(resources.get("CPU", 1)),
+               "--resources", json.dumps(
+                   {k: v for k, v in resources.items() if k != "CPU"})]
+        if labels:
+            cmd += ["--labels", json.dumps(labels)]
+        self.runner.run(cmd)
+        cloud_id = f"subproc-{node_id}"
+        self._nodes[cloud_id] = {"node_id": node_id, "temp_dir": temp_dir}
+        return cloud_id
+
+    def terminate_node(self, cloud_id: str) -> None:
+        rec = self._nodes.get(cloud_id)
+        if rec is None:
+            return
+        try:
+            self.runner.run([self.python, "-m", "ray_tpu", "stop",
+                             "--temp-dir", rec["temp_dir"]])
+        except Exception:
+            # Best effort fallback: signal the daemon directly rather than
+            # leaking a detached process; keep going either way (matches
+            # FakeMultiNodeProvider's swallow-errors contract so one bad
+            # node can't abort the autoscaler round).
+            pid = self._pid(rec)
+            if pid is not None:
+                try:
+                    self.runner.run(["kill", str(pid)], timeout=20)
+                except Exception:
+                    pass
+        self._nodes.pop(cloud_id, None)
+
+    def node_status(self, cloud_id: str) -> str:
+        rec = self._nodes.get(cloud_id)
+        if rec is None:
+            return "terminated"
+        pid = self._pid(rec)
+        if pid is None:
+            return "pending"
+        try:
+            # Liveness + identity in one: a recycled pid whose cmdline no
+            # longer says ray_tpu must read as failed, not running
+            # (same hazard as scripts/start.py _is_ray_tpu_proc).
+            self.runner.run(
+                ["grep", "-q", "ray_tpu", f"/proc/{pid}/cmdline"],
+                timeout=20)
+            return "running"
+        except Exception:
+            return "failed"
+
+    def runtime_node_id(self, cloud_id: str) -> str | None:
+        rec = self._nodes.get(cloud_id)
+        return rec["node_id"] if rec else None
 
 
 class TpuSliceProvider(NodeProvider):

@@ -5,6 +5,7 @@ python/ray/autoscaler/v2/tests/ — FSM transition asserts, scheduler
 bin-packing, FakeMultiNodeProvider end-to-end scale up/down).
 """
 
+import os
 import time
 
 import pytest
@@ -159,6 +160,117 @@ class TestEndToEnd:
             assert terminated, "idle node was not terminated"
         finally:
             ray_tpu.shutdown()
+
+
+class TestSubprocessBootstrap:
+    """e2e over the real ``start`` bootstrap path (reference:
+    fake_multi_node/node_provider.py:237 + command_runner.py): demand →
+    provider launches a node as a detached OS process via the CLI → it
+    joins over TCP → the pending task schedules there → idle scale-down
+    ``stop``s the process."""
+
+    def test_demand_boots_real_process_node(self, tmp_path):
+        import ray_tpu
+        from ray_tpu.autoscaler.node_provider import SubprocessNodeProvider
+        from ray_tpu.core.worker import global_worker
+
+        ray_tpu.shutdown()
+        ray_tpu.init(address="local-cluster", num_cpus=1)
+        try:
+            rt = global_worker.runtime
+            config = AutoscalingConfig(
+                node_types={"cpu2": NodeTypeConfig(
+                    {"CPU": 2.0, "boot": 1.0}, max_workers=2)},
+                idle_timeout_s=1.0,
+            )
+            provider = SubprocessNodeProvider(
+                f"{rt._head_host}:{rt._head_port}", str(tmp_path))
+            scaler = Autoscaler(config, provider, rt.head)
+
+            @ray_tpu.remote(num_cpus=1)
+            def hold(sec):
+                time.sleep(sec)
+                return os.environ.get("RTPU_NODE_ID", "")
+
+            # Saturate the 1-CPU head so later probes cannot land there.
+            refs = [hold.remote(18) for _ in range(3)]
+            deadline = time.monotonic() + 20
+            launched = {}
+            while time.monotonic() < deadline and not launched:
+                launched = scaler.update()["launched"]
+                time.sleep(0.5)
+            assert launched.get("cpu2", 0) >= 1, "no scale-up happened"
+            (cloud_id, rec), = list(provider._nodes.items())[:1]
+            assert provider.node_status(cloud_id) == "running"
+            pid = provider._pid(rec)
+            assert pid is not None
+
+            # RAY_RUNNING once the daemon registered under its node id.
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                scaler.update()
+                if scaler.instances.instances((InstanceStatus.RAY_RUNNING,)):
+                    break
+                time.sleep(0.5)
+            assert scaler.instances.instances((InstanceStatus.RAY_RUNNING,))
+
+            # New work requiring the booted node type's marker resource
+            # must schedule on the freshly booted process node.
+            probes = [hold.options(num_cpus=1, resources={"boot": 0.1})
+                      .remote(0) for _ in range(2)]
+            homes = ray_tpu.get(probes, timeout=60)
+            assert all(h.startswith("sub-") for h in homes), homes
+            assert ray_tpu.get(refs, timeout=60)
+
+            # Idle scale-down stops the OS process.
+            deadline = time.monotonic() + 20
+            terminated = []
+            while time.monotonic() < deadline and not terminated:
+                terminated = scaler.update()["terminated"]
+                time.sleep(0.5)
+            assert terminated, "idle node was not terminated"
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    os.kill(pid, 0)
+                    time.sleep(0.2)
+                except ProcessLookupError:
+                    break
+            else:
+                raise AssertionError("node process still alive after stop")
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestCommandRunners:
+    def test_local_runner_runs_and_raises(self):
+        from ray_tpu.autoscaler.command_runner import LocalCommandRunner
+
+        out = LocalCommandRunner().run(["echo", "hi"])
+        assert out.strip() == "hi"
+        with pytest.raises(RuntimeError):
+            LocalCommandRunner().run(["false"])
+
+    def test_ssh_runner_builds_command(self):
+        from ray_tpu.autoscaler.command_runner import SshCommandRunner
+
+        seen = {}
+
+        def fake_exec(argv, timeout):
+            seen["argv"] = argv
+            import subprocess
+
+            return subprocess.CompletedProcess(argv, 0, stdout="done",
+                                               stderr="")
+
+        r = SshCommandRunner("10.0.0.5", user="worker", ssh_key="/k",
+                             exec_fn=fake_exec)
+        assert r.run(["python", "-m", "ray_tpu", "start",
+                      "--address=h:1"]) == "done"
+        argv = seen["argv"]
+        assert argv[0] == "ssh" and "worker@10.0.0.5" in argv
+        assert "-i" in argv and "/k" in argv
+        assert argv[-1] == "python -m ray_tpu start --address=h:1"
 
 
 class TestGcpProvider:
