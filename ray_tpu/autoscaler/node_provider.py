@@ -109,11 +109,17 @@ class SubprocessNodeProvider(NodeProvider):
     def _pid(self, rec: dict) -> int | None:
         # Through the runner (not the local filesystem) so the same
         # provider works when the runner targets a remote host over SSH.
+        # The pid is fixed for the node's lifetime — cache it so status
+        # polls don't re-read the file (an SSH round-trip per poll).
+        if rec.get("pid") is not None:
+            return rec["pid"]
         path = os.path.join(rec["temp_dir"], f"node-{rec['node_id']}.pid")
         try:
-            return int(self.runner.run(["cat", path], timeout=20).strip())
+            rec["pid"] = int(
+                self.runner.run(["cat", path], timeout=20).strip())
         except Exception:
             return None
+        return rec["pid"]
 
     def launch_node(self, node_type: str, resources: dict[str, float],
                     labels: dict[str, str] | None = None) -> str:

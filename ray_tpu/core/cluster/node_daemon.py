@@ -17,6 +17,7 @@ import subprocess
 import sys
 import time
 import uuid
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ray_tpu.core.cluster.protocol import AsyncRpcClient, RpcServer, ServerConnection
@@ -41,6 +42,11 @@ class WorkerProc:
     # Fork nonce: joins the registration RPC to this record (pids diverge
     # across a container boundary).
     nonce: str = ""
+    # Owner (submitting core-worker id) of the current lease — the memory
+    # monitor's kill policy groups victims by owner (reference:
+    # raylet/worker_killing_policy_group_by_owner.cc).
+    owner: str = ""
+    lease_granted_at: float = 0.0
 
 
 @dataclass
@@ -48,6 +54,7 @@ class _PendingLease:
     resources: dict[str, float]
     fut: asyncio.Future
     env_hash: str = ""
+    owner: str = ""
 
 
 class NodeDaemon:
@@ -94,6 +101,10 @@ class NodeDaemon:
         self._gossip_epoch = time.time()
         self._gossip_counter = 0
         self._pending: list[_PendingLease] = []
+        # worker_id -> why the daemon killed it (the owner's runtime asks
+        # via the worker_fate RPC to turn a dropped connection into a
+        # typed error, e.g. OutOfMemoryError). Bounded.
+        self._worker_fates: "OrderedDict[str, dict]" = OrderedDict()
         self._head: AsyncRpcClient | None = None
         self._leases: dict[str, WorkerProc] = {}
         self._actor_workers: dict[str, WorkerProc] = {}
@@ -148,6 +159,7 @@ class NodeDaemon:
         r("tail_log", self._tail_log)
         r("prestart_workers", self._prestart_workers)
         r("gossip", self._handle_gossip)
+        r("worker_fate", self._worker_fate)
 
     async def _prestart_workers(self, conn, n: int = 0):
         """Warm the worker pool ahead of demand (reference:
@@ -221,6 +233,8 @@ class NodeDaemon:
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._reap_loop()))
         self._bg.append(loop.create_task(self._gossip_loop()))
+        if get_config().memory_monitor_interval_s > 0:
+            self._bg.append(loop.create_task(self._memory_watch_loop()))
         return addr
 
     async def stop(self):
@@ -353,10 +367,148 @@ class NodeDaemon:
                             w.lease_id = None
                             w.resources = {}
                     if w.actor_id and self._head:
+                        fate = self._worker_fates.get(w.worker_id) or {}
+                        reason = (
+                            f"worker OOM-killed by the node memory monitor "
+                            f"(rss {fate.get('rss', 0)} of node limit "
+                            f"{fate.get('limit', 0)} bytes)"
+                            if fate.get("oom") else
+                            f"worker process exited with {w.proc.returncode}")
                         await self._head.call(
                             "actor_failed", actor_id=w.actor_id,
-                            reason=f"worker process exited with {w.proc.returncode}",
+                            reason=reason,
                         )
+
+    # ------------------------------------------------------------- memory
+    # Node memory defense (reference: _private/memory_monitor.py:97 polls
+    # node usage; raylet/worker_killing_policy_group_by_owner.cc picks the
+    # victim): a runaway task must be killed before it takes down the
+    # whole TPU host's daemon.
+
+    @staticmethod
+    def _detect_memory_limit() -> int:
+        """cgroup limit if confined, else MemTotal."""
+        for path in ("/sys/fs/cgroup/memory.max",
+                     "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+            try:
+                with open(path) as f:
+                    raw = f.read().strip()
+                if raw.isdigit() and int(raw) < 1 << 60:
+                    return int(raw)
+            except OSError:
+                continue
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        return int(line.split()[1]) * 1024
+        except OSError:
+            pass
+        return 0
+
+    @staticmethod
+    def _rss_bytes(pid: int) -> int:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, ValueError, IndexError):
+            return 0
+
+    def _pick_oom_victim(self) -> WorkerProc | None:
+        """Group-by-owner policy (reference:
+        worker_killing_policy_group_by_owner.cc): tasks are grouped by
+        their submitting owner; the victim is the NEWEST task from the
+        LARGEST group (the greediest owner pays, retries keep fairness).
+        Actor workers only when no task worker is killable, then idle
+        pool workers as a last resort (a leaked allocation can live in an
+        idle worker too — skipping them would wedge the watcher)."""
+        busy = [w for w in self.workers.values()
+                if w.lease_id is not None and w.proc is not None]
+        if busy:
+            groups: dict[str, list[WorkerProc]] = {}
+            for w in busy:
+                groups.setdefault(w.owner, []).append(w)
+            biggest = max(groups.values(),
+                          key=lambda g: (len(g),
+                                         max(x.lease_granted_at for x in g)))
+            return max(biggest, key=lambda w: w.lease_granted_at)
+        actors = [w for w in self.workers.values()
+                  if w.actor_id is not None and w.proc is not None]
+        if actors:
+            return max(actors, key=lambda w: w.idle_since)
+        idle = [w for w in self.workers.values() if w.proc is not None]
+        if idle:
+            return max(idle, key=lambda w: self._rss_bytes(w.proc.pid))
+        return None
+
+    def _record_fate(self, worker_id: str, fate: dict) -> None:
+        self._worker_fates[worker_id] = fate
+        while len(self._worker_fates) > 256:
+            self._worker_fates.popitem(last=False)
+
+    async def _worker_fate(self, conn, worker_id: str = ""):
+        return self._worker_fates.get(worker_id) or {}
+
+    @staticmethod
+    def _node_used_bytes() -> int:
+        """Node-level used memory (MemTotal - MemAvailable), the same
+        signal the reference memory monitor polls — catches pressure from
+        ANY process on the host, not just workers."""
+        total = avail = 0
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1]) * 1024
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1]) * 1024
+        except OSError:
+            return 0
+        return max(0, total - avail)
+
+    async def _memory_watch_loop(self):
+        """Two triggers, one kill policy:
+        - node pressure: host used memory above the threshold of the
+          detected node limit — the daemon sacrifices a worker before the
+          kernel OOM killer picks a victim itself (possibly this daemon).
+        - worker budget: sum of worker RSS above the threshold of
+          memory_limit_bytes (when configured) — polices the workers'
+          share on hosts where the daemon co-exists with other services,
+          and gives tests a hermetic trigger."""
+        cfg = get_config()
+        node_limit = self._detect_memory_limit()
+        budget = cfg.memory_limit_bytes
+        if not node_limit and not budget:
+            return
+        while True:
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
+            usage = limit = 0
+            if node_limit:
+                node_used = self._node_used_bytes()
+                if node_used > cfg.memory_usage_threshold * node_limit:
+                    usage, limit = node_used, node_limit
+            if not limit and budget:
+                wsum = sum(self._rss_bytes(w.proc.pid)
+                           for w in self.workers.values()
+                           if w.proc is not None)
+                if wsum > cfg.memory_usage_threshold * budget:
+                    usage, limit = wsum, budget
+            if not limit:
+                continue
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            rss = self._rss_bytes(victim.proc.pid)
+            self._record_fate(victim.worker_id, {
+                "oom": True, "rss": rss, "usage": usage, "limit": limit,
+                "node_id": self.node_id,
+            })
+            try:
+                victim.proc.kill()  # SIGKILL; the reap loop cleans up
+            except OSError:
+                pass
+            # Give the kill a poll cycle to land before re-measuring.
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
 
     async def _heartbeat_loop(self):
         cfg = get_config()
@@ -523,7 +675,7 @@ class NodeDaemon:
 
     async def _request_lease(self, conn: ServerConnection, resources: dict,
                              timeout: float | None = None, env_hash: str = "",
-                             allow_spill: bool = True):
+                             allow_spill: bool = True, owner: str = ""):
         if not self._feasible(resources):
             # Spillback: find a feasible node from the gossiped peer view
             # (head fallback while the ring converges) — reference:
@@ -534,7 +686,7 @@ class NodeDaemon:
                     return {"spill": best}
             return {"error": f"infeasible resource demand {resources}"}
         fut = asyncio.get_running_loop().create_future()
-        req = _PendingLease(dict(resources), fut, env_hash)
+        req = _PendingLease(dict(resources), fut, env_hash, owner)
         self._pending.append(req)
         self._try_grant()
         cfg = get_config()
@@ -658,6 +810,8 @@ class NodeDaemon:
             w.lease_id = lease_id
             if req.env_hash:
                 w.env_hash = req.env_hash  # branded for this env from now on
+            w.owner = req.owner
+            w.lease_granted_at = time.monotonic()
             w.resources = req.resources
             self._take_resources(req.resources)
             self._leases[lease_id] = w

@@ -46,6 +46,7 @@ from ray_tpu.core.exceptions import (
     GetTimeoutError,
     LeaseTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     TaskCancelledError,
     TaskError,
 )
@@ -716,7 +717,8 @@ class ClusterRuntime:
         for ref in refs:
             data = self._fetch(ref, deadline)
             value = serialization.deserialize(data)
-            if isinstance(value, (TaskError, ActorDiedError, TaskCancelledError)):
+            if isinstance(value, (TaskError, ActorDiedError, TaskCancelledError,
+                          OutOfMemoryError)):
                 raise value
             out.append(value)
         return out
@@ -1193,10 +1195,8 @@ class ClusterRuntime:
             if getattr(e, "sent", True):
                 item.attempts += 1
             if item.attempts > max(item.spec.max_retries, 0):
-                self._store_error_local(
-                    item.return_ids,
-                    TaskError(RuntimeError(f"system failure: {e}"),
-                              task_desc=item.spec.name))
+                err = await self._terminal_push_error(w, e, item.spec.name)
+                self._store_error_local(item.return_ids, err)
             else:
                 await asyncio.sleep(get_config().task_retry_delay_s)
                 ks.queue.append(item)
@@ -1238,10 +1238,9 @@ class ClusterRuntime:
                 if sent:
                     item.attempts += 1
                 if item.attempts > max(item.spec.max_retries, 0):
-                    self._store_error_local(
-                        item.return_ids,
-                        TaskError(RuntimeError(f"system failure: {e}"),
-                                  task_desc=item.spec.name))
+                    err = await self._terminal_push_error(
+                        w, e, item.spec.name)
+                    self._store_error_local(item.return_ids, err)
                 else:
                     retry.append(item)
             if retry:
@@ -1362,7 +1361,8 @@ class ClusterRuntime:
                 daemon, pinned = await self._lease_entry_daemon(ks)
                 res = await daemon.call("request_lease", resources=ks.resources,
                                         env_hash=ks.env_hash, timeout=None,
-                                        allow_spill=not pinned)
+                                        allow_spill=not pinned,
+                                        owner=self.worker_id.hex())
                 hops = 0
                 while res.get("spill") and hops < 4:
                     daemon = await self._apeer(tuple(res["spill"]))
@@ -1371,7 +1371,8 @@ class ClusterRuntime:
                     res = await daemon.call("request_lease",
                                             resources=ks.resources,
                                             env_hash=ks.env_hash, timeout=None,
-                                            allow_spill=hops < 3)
+                                            allow_spill=hops < 3,
+                                            owner=self.worker_id.hex())
                     hops += 1
                 if res.get("spill"):
                     raise ValueError(
@@ -1468,6 +1469,37 @@ class ClusterRuntime:
             self._recovering.discard(oid)
             self.store.put(oid, blob, self.worker_id)
         self._notify_waiters()
+
+    async def _worker_kill_fate(self, w: _LeasedWorker) -> dict:
+        """Why did the daemon kill this worker (empty if it just died)?
+        Turns a dropped worker connection into a typed error — e.g. the
+        memory monitor's OOM kill (reference: the raylet attaches a
+        death-cause to task failures, node_manager.cc)."""
+        try:
+            return (await w.daemon.call(
+                "worker_fate", worker_id=w.worker_id)) or {}
+        except Exception:
+            return {}
+
+    @staticmethod
+    def _oom_error(fate: dict, task_desc: str) -> OutOfMemoryError:
+        return OutOfMemoryError(
+            f"task {task_desc} was killed by the node memory monitor on "
+            f"node {fate.get('node_id', '?')}: worker rss "
+            f"{fate.get('rss', 0)} bytes, node worker usage "
+            f"{fate.get('usage', 0)} of limit {fate.get('limit', 0)} bytes")
+
+    async def _terminal_push_error(self, w: _LeasedWorker, e: Exception,
+                                   task_desc: str):
+        """Error for a task whose system-retry budget is exhausted: a
+        typed OutOfMemoryError when the daemon killed the worker for
+        memory, else a generic system-failure TaskError. The fate RPC is
+        only paid here, not on retried failures."""
+        fate = await self._worker_kill_fate(w)
+        if fate.get("oom"):
+            return self._oom_error(fate, task_desc)
+        return TaskError(RuntimeError(f"system failure: {e}"),
+                         task_desc=task_desc)
 
     async def _return_dead_lease(self, w: _LeasedWorker) -> None:
         try:

@@ -23,7 +23,12 @@ import cloudpickle
 
 from ray_tpu.core.cluster.protocol import EventLoopThread
 from ray_tpu.core.cluster.runtime import ClusterRuntime
-from ray_tpu.core.exceptions import ActorDiedError, TaskCancelledError, TaskError
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    OutOfMemoryError,
+    TaskCancelledError,
+    TaskError,
+)
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
 from ray_tpu.utils import serialization
@@ -234,7 +239,8 @@ class WorkerProcess:
                 i += 1
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, (TaskError, ActorDiedError,
-                                      TaskCancelledError)) \
+                                      TaskCancelledError,
+                                      OutOfMemoryError)) \
                 else TaskError(e, task_desc=spec.name)
             return {"results": [{"data": serialization.serialize(err)}],
                     "stream_error": True}
@@ -290,7 +296,9 @@ class WorkerProcess:
             finally:
                 set_task_context(None, None, None)
         except BaseException as e:  # noqa: BLE001
-            err = e if isinstance(e, (TaskError, ActorDiedError, TaskCancelledError)) \
+            err = e if isinstance(e, (TaskError, ActorDiedError,
+                                      TaskCancelledError,
+                                      OutOfMemoryError)) \
                 else TaskError(e, task_desc=spec.name)
             blob = serialization.serialize(err)
             return {"results": [{"data": blob} for _ in return_ids]}
@@ -452,7 +460,9 @@ class WorkerProcess:
                 reply = {"results": self._package_results(spec, return_ids,
                                                           result)}
         except BaseException as e:  # noqa: BLE001
-            err = e if isinstance(e, (TaskError, ActorDiedError, TaskCancelledError)) \
+            err = e if isinstance(e, (TaskError, ActorDiedError,
+                                      TaskCancelledError,
+                                      OutOfMemoryError)) \
                 else TaskError(e, task_desc=spec.method_name or "")
             reply = {"results": [{"data": serialization.serialize(err)}
                                  for _ in return_ids]}

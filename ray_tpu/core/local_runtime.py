@@ -30,6 +30,7 @@ from ray_tpu.core.events import global_event_buffer
 from ray_tpu.core.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    OutOfMemoryError,
     TaskCancelledError,
     TaskError,
 )
@@ -213,7 +214,8 @@ class LocalRuntime:
                 except TimeoutError:
                     raise GetTimeoutError(f"get() timed out waiting for {ref}") from None
                 value = serialization.deserialize(data)
-                if isinstance(value, (TaskError, ActorDiedError, TaskCancelledError)):
+                if isinstance(value, (TaskError, ActorDiedError, TaskCancelledError,
+                          OutOfMemoryError)):
                     raise value
                 out.append(value)
         return out

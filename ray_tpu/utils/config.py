@@ -67,6 +67,15 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     task_events_buffer_size: int = 10000
 
+    # --- memory monitor (reference: _private/memory_monitor.py:97 +
+    # raylet/worker_killing_policy_group_by_owner.cc) ---
+    memory_monitor_interval_s: float = 0.5  # 0 disables the watcher
+    memory_usage_threshold: float = 0.95
+    # Optional worker-memory budget: when set, the watcher also kills when
+    # the sum of worker RSS exceeds threshold*budget (node-level pressure
+    # against the detected cgroup/MemTotal limit always applies).
+    memory_limit_bytes: int = 0
+
     # --- tpu ---
     tpu_visible_chips_env: str = "TPU_VISIBLE_CHIPS"
     tpu_premapped_buffer_bytes: int = 0  # 0 = library default

@@ -1,0 +1,123 @@
+"""Node memory defense: the daemon's memory watcher kills runaway workers.
+
+Capability parity with the reference's OOM protection (reference:
+python/ray/_private/memory_monitor.py:97 +
+src/ray/raylet/worker_killing_policy_group_by_owner.cc, tested by
+python/ray/tests/test_memory_pressure.py): a task that allocates without
+bound is SIGKILLed by the daemon, the job fails with a typed
+OutOfMemoryError, and the daemon itself survives to run more work.
+"""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def oom_cluster():
+    # Small worker-memory budget so the watcher trips fast; aggressive poll.
+    os.environ["RTPU_MEMORY_LIMIT_BYTES"] = str(1200 * 1024 * 1024)
+    os.environ["RTPU_MEMORY_USAGE_THRESHOLD"] = "0.9"
+    os.environ["RTPU_MEMORY_MONITOR_INTERVAL_S"] = "0.2"
+    from ray_tpu.utils import config as config_mod
+
+    config_mod.set_config(config_mod.Config.load())
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    rt = c.connect()
+    import ray_tpu
+    from ray_tpu.core.worker import global_worker
+
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.mode = "cluster"
+    try:
+        yield c
+    finally:
+        ray_tpu.shutdown()
+        for k in ("RTPU_MEMORY_LIMIT_BYTES", "RTPU_MEMORY_USAGE_THRESHOLD",
+                  "RTPU_MEMORY_MONITOR_INTERVAL_S"):
+            os.environ.pop(k, None)
+        config_mod.set_config(config_mod.Config.load())
+
+
+def test_unbounded_malloc_killed_with_oom_error(oom_cluster):
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=0)
+    def hog():
+        x = []
+        while True:
+            # Touch the pages so RSS actually grows.
+            x.append(bytearray(b"\xff" * (64 * 1024 * 1024)))
+            time.sleep(0.01)
+
+    with pytest.raises(ray_tpu.OutOfMemoryError, match="memory monitor"):
+        ray_tpu.get(hog.remote(), timeout=120)
+
+    # The daemon survived the kill: fresh work still runs.
+    @ray_tpu.remote
+    def ok():
+        return 42
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 42
+
+
+def test_oom_retry_budget_then_typed_error(oom_cluster):
+    """OOM kills consume the task's retry budget; the terminal error is
+    still the typed OutOfMemoryError, not a generic system failure."""
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=1)
+    def hog():
+        x = []
+        while True:
+            x.append(bytearray(b"\xff" * (64 * 1024 * 1024)))
+            time.sleep(0.01)
+
+    t0 = time.monotonic()
+    with pytest.raises(ray_tpu.OutOfMemoryError):
+        ray_tpu.get(hog.remote(), timeout=240)
+    assert time.monotonic() - t0 < 240
+
+
+def test_group_by_owner_policy_unit():
+    """Victim selection: newest task from the largest owner group;
+    actors only as fallback (reference:
+    worker_killing_policy_group_by_owner.cc)."""
+    from ray_tpu.core.cluster.node_daemon import NodeDaemon, WorkerProc
+
+    class _P:  # fake Popen
+        def __init__(self, pid):
+            self.pid = pid
+
+    def wp(wid, owner="", lease=None, actor=None, granted=0.0):
+        w = WorkerProc(worker_id=wid, proc=_P(os.getpid()))
+        w.owner = owner
+        w.lease_id = lease
+        w.actor_id = actor
+        w.lease_granted_at = granted
+        return w
+
+    daemon = NodeDaemon.__new__(NodeDaemon)  # policy is state-free
+    daemon.workers = {
+        "a1": wp("a1", owner="A", lease="l1", granted=1.0),
+        "a2": wp("a2", owner="A", lease="l2", granted=3.0),
+        "b1": wp("b1", owner="B", lease="l3", granted=9.0),
+        "c1": wp("c1", actor="act-1"),
+    }
+    # Owner A has the most tasks; its newest (a2) is the victim — not B's
+    # newer task, not the actor.
+    assert daemon._pick_oom_victim().worker_id == "a2"
+
+    # No task workers: actor becomes the victim.
+    daemon.workers = {"c1": wp("c1", actor="act-1")}
+    assert daemon._pick_oom_victim().worker_id == "c1"
+
+    # Nothing at all: no victim.
+    daemon.workers = {}
+    assert daemon._pick_oom_victim() is None
