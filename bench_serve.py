@@ -39,16 +39,23 @@ def main() -> None:
     from ray_tpu.llm.serving import build_openai_app
 
     if on_tpu:
-        # decode_burst=16: on the tunneled chip the per-tick roundtrip
-        # (~150 ms) dominates, so a deeper burst halves roundtrips again
-        # (ladder 16+8+4+2+1 = 31 = max_tokens-1 after the prefill token).
+        # decode_burst=16: per-tick fixed costs (dispatch + fetch + host
+        # work) dominate through the tunnel, so deep bursts win. The r5
+        # sweep also showed max_num_seqs must MATCH the expected load:
+        # decode is KV-bandwidth-bound and the static slot batch reads
+        # every slot's KV each step, so 16 slots at concurrency 8 cost
+        # ~15% throughput for no TTFT gain (170 vs 196 tok/s); burst 8
+        # paid per-tick overheads twice for 140 tok/s. Full numbers in
+        # PERF_SERVE_NOTES.md.
         cfg = LLMConfig(model="llama3_1b", max_num_seqs=8, max_seq_len=1024,
                         dtype="bfloat16", decode_burst=16)
         n_requests, concurrency, max_tokens = 100, 8, 32
+        sweep_concurrency = [1, 4, 16]
         label = "llama_1b"
     else:
         cfg = LLMConfig(model="tiny", max_num_seqs=4, max_seq_len=256)
         n_requests, concurrency, max_tokens = 12, 3, 16
+        sweep_concurrency = [1]
         label = "tiny_cpu"
 
     ray_tpu.init()
@@ -85,31 +92,31 @@ def main() -> None:
     _safe_request(url, max_tokens=8, prefix=warm_prefix, seed=980)
     _safe_request(url, max_tokens=8, prefix=warm_prefix, seed=981)
 
-    ttfts, totals, tokens_out = [], [], []
-    lock = threading.Lock()
-    sem = threading.Semaphore(concurrency)
+    ttfts, totals, tokens_out, wall = _run_phase(
+        url, n_requests, concurrency, max_tokens)
 
-    def worker(i):
-        with sem:
-            try:
-                ttft, total, ntok = _one_request(url, max_tokens=max_tokens,
-                                                 seed=i)
-            except Exception as e:  # noqa: BLE001
-                print(f"request {i} failed: {e}", file=sys.stderr)
-                return
-            with lock:
-                ttfts.append(ttft)
-                totals.append(total)
-                tokens_out.append(ntok)
-
-    threads = [threading.Thread(target=worker, args=(i,))
-               for i in range(n_requests)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
+    # Throughput-vs-TTFT frontier: the same workload at other concurrency
+    # levels, so admission-policy regressions (e.g. decode bursts starving
+    # prefills) are visible instead of hiding behind the single headline
+    # point.
+    sweep = []
+    for c in sweep_concurrency:
+        n = max(3 * c, 12)
+        try:
+            s_ttfts, _s_totals, s_tok, s_wall = _run_phase(
+                url, n, c, max_tokens, seed0=3000 + 100 * c)
+        except Exception as e:  # noqa: BLE001
+            print(f"sweep c={c} failed: {e}", file=sys.stderr)
+            continue
+        if s_ttfts:
+            sm = np.array(s_ttfts) * 1e3
+            sweep.append({
+                "concurrency": c,
+                "requests": len(s_ttfts),
+                "ttft_ms_p50": round(float(np.percentile(sm, 50)), 1),
+                "ttft_ms_p90": round(float(np.percentile(sm, 90)), 1),
+                "tokens_per_sec_total": round(sum(s_tok) / s_wall, 1),
+            })
 
     # ---- phase B: shared-prefix TTFT (prefix KV-cache reuse) ------------
     # One long shared prefix (a system-prompt shape): the first request
@@ -147,6 +154,7 @@ def main() -> None:
                     "p99": round(float(np.percentile(ttfts_ms, 99)), 1)},
         "tokens_per_sec_total": round(sum(tokens_out) / wall, 1),
         "mean_request_s": round(float(np.mean(totals)), 3),
+        "concurrency_sweep": sweep,
         "prefix_cache": {
             "cold_ttft_ms": round(cold_ttft * 1e3, 1)
             if cold_ttft is not None else None,
@@ -162,6 +170,36 @@ def main() -> None:
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
+
+
+def _run_phase(url: str, n_requests: int, concurrency: int,
+               max_tokens: int, seed0: int = 0):
+    ttfts, totals, tokens_out = [], [], []
+    lock = threading.Lock()
+    sem = threading.Semaphore(concurrency)
+
+    def worker(i):
+        with sem:
+            try:
+                ttft, total, ntok = _one_request(url, max_tokens=max_tokens,
+                                                 seed=i)
+            except Exception as e:  # noqa: BLE001
+                print(f"request {i} failed: {e}", file=sys.stderr)
+                return
+            with lock:
+                ttfts.append(ttft)
+                totals.append(total)
+                tokens_out.append(ntok)
+
+    threads = [threading.Thread(target=worker, args=(seed0 + i,))
+               for i in range(n_requests)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return ttfts, totals, tokens_out, wall
 
 
 def _safe_request(url: str, max_tokens: int, seed: int = 0,

@@ -33,6 +33,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Any, Callable
@@ -365,6 +366,191 @@ def copy_prefix_kv(cfg: LlamaConfig, cache, src_slot, dst_slot):
     }
 
 
+# ---------------------------------------------------------------------------
+# Block-pooled KV cache (reference capability: vLLM PagedAttention behind
+# ray.llm — vllm_models.py:148 — re-designed TPU-first). The pool is
+# [layers, num_blocks, Hkv, block_size, D]; a per-slot block TABLE maps
+# virtual position p to pool block table[slot, p // block_size]. All
+# shapes are static: tables are int32 arrays, reads gather the slot's
+# blocks into a virtual [max_blocks*block_size] sequence (the same masked
+# attention the dense path runs), writes scatter whole blocks (prefill —
+# chunks are block-aligned) or single rows (decode). No device-side page
+# tables, no dynamic shapes — XLA sees gathers and scatters it can fuse.
+
+
+def init_kv_cache_blocked(cfg: LlamaConfig, num_blocks: int,
+                          block_size: int):
+    shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_size,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.jnp_dtype),
+            "v": jnp.zeros(shape, cfg.jnp_dtype)}
+
+
+def _gather_slot_kv(kv_l, table_row, dtype):
+    """kv_l [NB, Hkv, bs, D] + table_row [MB] -> [1, Hkv, MB*bs, D]
+    virtual sequence for one slot."""
+    g = kv_l[table_row]                       # [MB, Hkv, bs, D]
+    mb, hkv, bs, d = g.shape
+    return g.transpose(1, 0, 2, 3).reshape(1, hkv, mb * bs, d).astype(dtype)
+
+
+def _gather_batch_kv(kv_l, tables, dtype):
+    """kv_l [NB, Hkv, bs, D] + tables [B, MB] -> [B, Hkv, MB*bs, D]."""
+    g = kv_l[tables]                          # [B, MB, Hkv, bs, D]
+    b, mb, hkv, bs, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, hkv, mb * bs, d).astype(
+        dtype)
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def prefill_chunk_blocked(cfg: LlamaConfig, params, cache, table_row,
+                          tokens, kv_len, length):
+    """Blocked-cache chunked prefill for ONE slot. ``table_row`` [MB] is
+    the slot's block table; the engine guarantees kv_len and the chunk
+    bucket are multiples of block_size, so the chunk writes whole blocks.
+    Returns (cache, last-token logits [V])."""
+    c = tokens.shape[0]
+    bs = cache["k"].shape[3]
+    mb = table_row.shape[0]
+    nblk = c // bs
+    x = params["embed_tokens"][tokens][None]  # [1, C, H]
+    positions = kv_len + jnp.arange(c)
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling)
+    n_rep = cfg.num_heads // cfg.num_kv_heads
+    kpos = jnp.arange(mb * bs)
+    mask = (kpos[None, :] <= positions[:, None]) & (kpos[None, :] < length)
+    mask = mask[None, None]
+    blk0 = kv_len // bs  # first block index within the table (traced)
+
+    def body(x, scanned):
+        lp, k_l, v_l = scanned  # [NB, Hkv, bs, D]
+        b, c_, _ = x.shape
+        xn = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(cfg, lp, xn, b, c_)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        # Whole-block writes: chunk j lands in pool block table[blk0+j].
+        kb = k[0].astype(k_l.dtype)  # [Hkv, C, D]
+        vb = v[0].astype(v_l.dtype)
+        for j in range(nblk):
+            blk = table_row[blk0 + j]
+            k_l = k_l.at[blk].set(
+                lax.dynamic_slice_in_dim(kb, j * bs, bs, 1))
+            v_l = v_l.at[blk].set(
+                lax.dynamic_slice_in_dim(vb, j * bs, bs, 1))
+        ks = _gather_slot_kv(k_l, table_row, x.dtype)
+        vs = _gather_slot_kv(v_l, table_row, x.dtype)
+        kr, vr = _repeat_kv(ks, n_rep), _repeat_kv(vs, n_rep)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kr).astype(jnp.float32)
+        scores = scores / np.sqrt(cfg.head_dim) + jnp.where(mask, 0.0,
+                                                            NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vr)
+        o = o.transpose(0, 2, 1, 3).reshape(b, c_, -1)
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        x = _mlp(cfg, lp, x)
+        return x, (k_l, v_l)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    logits = _lm_head(cfg, params, x[0])  # [C, V]
+    last = logits[jnp.clip(length - 1 - kv_len, 0, c - 1)]
+    return {"k": new_k, "v": new_v}, last
+
+
+def _multi_token_impl_blocked(cfg: LlamaConfig, params, cache, tables,
+                              tokens, positions0, write_mask):
+    """Blocked-cache analog of _multi_token_impl: K tokens per slot
+    against the pool through per-slot block tables [B, MB]. Decode writes
+    are row scatters (block = tables[b, p//bs], row = p%bs); masked slots
+    scatter out of bounds and are dropped."""
+    b, k = tokens.shape
+    _, nb, _, bs, _ = cache["k"].shape
+    mb = tables.shape[1]
+    x = params["embed_tokens"][tokens]  # [B, K, H]
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling)
+    n_rep = cfg.num_heads // cfg.num_kv_heads
+    positions = positions0[:, None] + jnp.arange(k)[None, :]  # [B, K]
+    kv_mask = (jnp.arange(mb * bs)[None, None, :]
+               <= positions[:, :, None])[:, None]  # [B, 1, K, S]
+    # Per-token pool coordinates; masked writes target block NB → dropped.
+    blk = jnp.take_along_axis(tables, positions // bs, axis=1)  # [B, K]
+    blk = jnp.where(write_mask[:, None], blk, nb)
+    row = positions % bs
+
+    def write(cache_l, new):
+        # cache_l [NB, Hkv, bs, D]; new [B, Hkv, K, D] -> rows [B, K, Hkv, D]
+        rows = new.transpose(0, 2, 1, 3).astype(cache_l.dtype)
+        return cache_l.at[blk, :, row, :].set(rows, mode="drop")
+
+    def body(x, scanned):
+        lp, k_l, v_l = scanned
+        xn = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, kk, v = _project_qkv(cfg, lp, xn, b, k)
+        q = apply_rope(q, positions, inv_freq)
+        kk = apply_rope(kk, positions, inv_freq)
+        k_l = write(k_l, kk)
+        v_l = write(v_l, v)
+        kr = _repeat_kv(_gather_batch_kv(k_l, tables, x.dtype), n_rep)
+        vr = _repeat_kv(_gather_batch_kv(v_l, tables, x.dtype), n_rep)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kr).astype(jnp.float32)
+        scores = scores / np.sqrt(cfg.head_dim)
+        scores = scores + jnp.where(kv_mask, 0.0, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vr)
+        o = o.transpose(0, 2, 1, 3).reshape(b, k, -1)
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        x = _mlp(cfg, lp, x)
+        return x, (k_l, v_l)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    logits = _lm_head(cfg, params, x)  # [B, K, V]
+    return {"k": new_k, "v": new_v}, logits
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def decode_step_blocked(cfg: LlamaConfig, params, cache, tables, tokens,
+                        positions, write_mask):
+    cache, logits = _multi_token_impl_blocked(
+        cfg, params, cache, tables, tokens[:, None], positions, write_mask)
+    return cache, logits[:, 0]
+
+
+@partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(2,))
+def decode_burst_blocked(cfg: LlamaConfig, params, cache, tables, token0,
+                         positions0, write_mask, temps, top_ps, key,
+                         steps: int, need_top_p: bool = True):
+    """Blocked-cache decode_burst: the engine pre-allocates blocks
+    covering positions0+steps for every active slot before dispatch."""
+
+    def step(carry, j):
+        c, tok, pos = carry
+        c, logits = _multi_token_impl_blocked(
+            cfg, params, c, tables, tok[:, None], pos, write_mask)
+        nxt = sample_tokens(logits[:, 0].astype(jnp.float32), temps,
+                            top_ps, 0, jax.random.fold_in(key, j),
+                            need_top_p).astype(jnp.int32)
+        return (c, nxt, pos + 1), nxt
+
+    (cache, _, _), toks = lax.scan(step, (cache, token0, positions0),
+                                   jnp.arange(steps))
+    return cache, toks
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def copy_blocks(cache, src_blocks, dst_blocks):
+    """Copy pool blocks src[i] → dst[i], all layers (prefix adoption in
+    blocked mode — content copy; block sharing would need refcounts the
+    preemption path doesn't justify yet)."""
+    return {
+        "k": cache["k"].at[:, dst_blocks].set(cache["k"][:, src_blocks]),
+        "v": cache["v"].at[:, dst_blocks].set(cache["v"][:, src_blocks]),
+    }
+
+
 @partial(jax.jit, static_argnums=(3, 5))
 def sample_tokens(logits, temps, top_ps, top_k: int, key,
                   need_top_p: bool = True):
@@ -415,6 +601,7 @@ class GenerationRequest:
     draft_len: int = 0  # draft-cache positions filled (speculative decoding)
     draft_fail_count: int = 0  # consecutive draft catch-up failures
     spec_disabled: bool = False  # excluded from speculation (see _spec_decode)
+    arrival_seq: int = 0  # admission order; blocked-KV preemption evicts newest
 
 
 @dataclass
@@ -464,8 +651,34 @@ class LLMEngine:
         self.mesh = None
         if config.tensor_parallel_size > 1:
             self._shard_for_tp(config.tensor_parallel_size)
-        self.cache = init_kv_cache(self.model_cfg, self.max_slots,
-                                   self.max_seq)
+        # KV layout: dense [slots, max_seq] lines, or the block pool (see
+        # the blocked-cache section above and LLMConfig.kv_block_size).
+        self.block_size = int(getattr(config, "kv_block_size", 0) or 0)
+        self.blocked = self.block_size > 0
+        if self.blocked:
+            if config.speculative_model is not None:
+                raise ValueError(
+                    "speculative decoding requires the dense KV layout "
+                    "(kv_block_size=0)")
+            if self.block_size & (self.block_size - 1):
+                raise ValueError("kv_block_size must be a power of two")
+            if self.max_seq % self.block_size:
+                raise ValueError(
+                    "max_seq_len must be a multiple of kv_block_size")
+            self.blocks_per_slot = self.max_seq // self.block_size
+            self.num_blocks = int(
+                getattr(config, "kv_num_blocks", 0)
+                or (self.max_slots * self.blocks_per_slot + 1) // 2)
+            self.cache = init_kv_cache_blocked(
+                self.model_cfg, self.num_blocks, self.block_size)
+            self._tables = np.zeros(
+                (self.max_slots, self.blocks_per_slot), np.int32)
+            self._free_blocks: list[int] = list(range(self.num_blocks))
+            self._slot_nblk = [0] * self.max_slots
+            self.preemptions = 0
+        else:
+            self.cache = init_kv_cache(self.model_cfg, self.max_slots,
+                                       self.max_seq)
 
         # Speculative decoding: draft model + its own KV cache. The draft
         # must share the tokenizer's vocab space with the target.
@@ -510,6 +723,9 @@ class LLMEngine:
         self._cache_gen = 0  # bumped when a device failure rebuilds the cache
         self._prefill_rr = -1  # last slot that ran a prefill chunk
         self._waiting: queue.Queue[GenerationRequest] = queue.Queue()
+        # Preempted (blocked-KV) requests re-admit ahead of the queue.
+        self._preempted: deque[GenerationRequest] = deque()
+        self._arrival_seq = 0
         self._requests: dict[str, GenerationRequest] = {}
         self._rng_key = jax.random.PRNGKey(config.seed + 1)
         # Pipelined decode: (active snapshot, burst, device tokens) of a
@@ -533,6 +749,8 @@ class LLMEngine:
             request_id=uuid.uuid4().hex[:12], prompt_ids=ids,
             sampling=sampling,
             stream_queue=queue.Queue() if stream else None)
+        self._arrival_seq += 1
+        req.arrival_seq = self._arrival_seq
         self._requests[req.request_id] = req
         self._waiting.put(req)
         self._work.set()
@@ -557,6 +775,10 @@ class LLMEngine:
                      sampling: SamplingParams | None = None) -> dict:
         """Run ONLY the prompt prefill; return the KV slice + first sampled
         token for hand-off to a decode engine."""
+        if self.blocked:
+            raise ValueError(
+                "prefill/decode disaggregation exports dense KV lines; "
+                "run the prefill engine with kv_block_size=0")
         sampling = sampling or SamplingParams()
         ids = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
                else list(prompt))
@@ -601,12 +823,18 @@ class LLMEngine:
             if r is req:
                 self._slots[slot] = None
                 self._prefix_live.pop(slot, None)
+                if self.blocked:
+                    self._free_slot_blocks(slot)
         self._work.set()
 
     def submit_prefilled(self, payload: dict,
                          sampling: SamplingParams | None = None,
                          stream: bool = False) -> GenerationRequest:
         """Continue decoding from a shipped prefill (KV import)."""
+        if self.blocked:
+            raise ValueError(
+                "KV import writes dense KV lines; run the decode engine "
+                "with kv_block_size=0")
         sampling = sampling or SamplingParams()
         req = GenerationRequest(
             request_id=uuid.uuid4().hex[:12],
@@ -644,6 +872,11 @@ class LLMEngine:
                "prefix_hits": self.prefix_hits,
                "prefix_tokens_saved": self.prefix_tokens_saved,
                "prefix_cached_slots": len(self._prefix_cached)}
+        if self.blocked:
+            out["kv_blocks_total"] = self.num_blocks
+            out["kv_blocks_free"] = len(self._free_blocks)
+            out["kv_block_size"] = self.block_size
+            out["preemptions"] = self.preemptions
         if self.draft_cfg is not None:
             out["spec_ticks"] = self.spec_ticks
             out["spec_proposed"] = self.spec_proposed
@@ -684,18 +917,32 @@ class LLMEngine:
         vLLM chunked prefill scheduling); deferring the prefill fetches
         until the decode work is queued means the whole tick pays ONE
         host⇄device roundtrip however many prefills it ran."""
-        # Resolve the pipelined burst FIRST: its emissions may finish
-        # requests and free slots, and admission must only reuse a slot
-        # after that resolution (device order then guarantees any stale
-        # chained writes are overwritten by the new prefill).
-        worked = self._resolve_pending_burst()
-        worked = self._admit() or worked
+        # Admit into CURRENTLY-empty slots and dispatch their prefill
+        # chunks BEFORE blocking on the pipelined burst's fetch: the
+        # prefill rides the device queue behind the in-flight burst and
+        # its first token is ready ~one prefill after that burst, instead
+        # of TTFT paying a full extra burst+chain. This is safe because a
+        # slot that is empty now was freed at or before the pending
+        # burst's dispatch, so that burst's write mask provably excludes
+        # it — only slots freed BY the pending resolve (mid-burst
+        # finishes) must wait for it, and those are still occupied here.
+        worked = self._admit()
         deferred: list = []
+        # ONE chunk budget for the whole tick, split across the passes —
+        # the second pass only spends what the first left over, so
+        # prefill_chunks_per_tick keeps its documented meaning.
         budget = max(1, int(getattr(self.config,
                                     "prefill_chunks_per_tick", 1) or 1))
-        for _ in range(budget):
-            if not self._prefill_step(deferred):
-                break
+        spent = 0
+        while spent < budget and self._prefill_step(deferred):
+            spent += 1
+            worked = True
+        # Resolve the pipelined burst next: its emissions may finish
+        # requests and free slots for the SECOND admission pass below.
+        worked = self._resolve_pending_burst() or worked
+        worked = self._admit() or worked
+        while spent < budget and self._prefill_step(deferred):
+            spent += 1
             worked = True
         decoding = {s: r for s, r in self._slots.items()
                     if r is not None and r.next_pos >= 0
@@ -744,6 +991,11 @@ class LLMEngine:
     # (the copy moves whole cache lines; tiny prefixes aren't worth it).
     PREFIX_COPY_MIN = 16
 
+    # Decode-burst cap while a slot is mid-prefill (see _burst_len):
+    # bounds how long the next prefill chunk waits behind decode work
+    # while keeping most of the burst's dispatch amortization.
+    PREFILL_PRIORITY_BURST = 8
+
     def _admit(self) -> bool:
         """Move waiting requests into unoccupied slots (prefill starts on
         subsequent ticks), adopting cached prompt prefixes when a donor
@@ -752,7 +1004,7 @@ class LLMEngine:
         admitted = False
         while any(o is None for o in self._slots.values()):
             try:
-                req = self._waiting.get_nowait()
+                req = self._next_waiting()
             except queue.Empty:
                 break
             if req.preloaded is not None:
@@ -766,6 +1018,39 @@ class LLMEngine:
                 continue
             donor, adopt, retired = self._best_prefix(req.prompt_ids)
             req.prefilled_len = 0
+            if self.blocked:
+                # Block-pool prefix adoption: whole-block content copy
+                # from a LIVE donor (no retired-slot cache — finished
+                # requests release their blocks back to the pool).
+                slot = self._take_slot()
+                adopt = (adopt // self.block_size) * self.block_size
+                if (donor is not None and not retired
+                        and adopt >= max(self.PREFIX_COPY_MIN,
+                                         self.block_size)
+                        # preempt=False: with eviction allowed the victim
+                        # could be the DONOR, whose freed blocks would be
+                        # re-issued as the copy's destination while its
+                        # table row still points at them.
+                        and self._ensure_blocks(slot, adopt - 1,
+                                                preempt=False)):
+                    nb = adopt // self.block_size
+                    src = jnp.asarray(self._tables[donor, :nb])
+                    dst = jnp.asarray(self._tables[slot, :nb])
+                    try:
+                        self.cache = copy_blocks(self.cache, src, dst)
+                        req.prefilled_len = adopt
+                        self.prefix_hits += 1
+                        self.prefix_tokens_saved += adopt
+                    except Exception as e:  # noqa: BLE001 - donated cache
+                        logger.exception("block prefix copy failed")
+                        self._recover_device_failure(
+                            f"prefix copy failed: {e!r}")
+                        req.prefilled_len = 0
+                req.next_pos = -1
+                req.last_slot = slot
+                self._slots[slot] = req
+                admitted = True
+                continue
             if retired and donor is not None:
                 # Zero-copy: admit straight into the retired slot whose KV
                 # already holds the prefix.
@@ -800,6 +1085,89 @@ class LLMEngine:
             self._slots[slot] = req
             admitted = True
         return admitted
+
+    # ---- blocked-KV pool accounting (scheduler thread only) ----
+
+    def _ensure_blocks(self, slot: int, upto_pos: int,
+                       preempt: bool = True) -> bool:
+        """Grow ``slot``'s block table to cover position ``upto_pos``,
+        preempting the newest other request on pool exhaustion (unless
+        ``preempt`` is False — e.g. a speculative chained burst is never
+        worth an eviction). False if the pool cannot cover it."""
+        need = min(upto_pos // self.block_size + 1, self.blocks_per_slot)
+        while self._slot_nblk[slot] < need:
+            if not self._free_blocks and not (
+                    preempt and self._preempt_for_blocks(slot)):
+                return False
+            self._tables[slot, self._slot_nblk[slot]] = \
+                self._free_blocks.pop()
+            self._slot_nblk[slot] += 1
+        return True
+
+    def _free_slot_blocks(self, slot: int) -> None:
+        n = self._slot_nblk[slot]
+        if n:
+            self._free_blocks.extend(int(b) for b in self._tables[slot, :n])
+            self._slot_nblk[slot] = 0
+
+    def _preempt_for_blocks(self, exclude_slot: int) -> bool:
+        """Evict the NEWEST other request (vLLM preemption order: latest
+        arrivals yield to earlier ones) by recompute: free its blocks and
+        requeue it; on readmission its prompt+generated tokens re-prefill
+        and decoding continues — emitted tokens are never re-emitted."""
+        victims = [(s, r) for s, r in self._slots.items()
+                   if r is not None and s != exclude_slot
+                   and not r.done.is_set() and not r.hold_slot
+                   and r.preloaded is None]
+        if not victims:
+            return False
+        # An in-flight chained burst still emits for its snapshot: resolve
+        # it first so a preempted request can't receive its tokens.
+        self._resolve_pending_burst()
+        victims = [(s, r) for s, r in victims
+                   if self._slots.get(s) is r and not r.done.is_set()]
+        if not victims:
+            return False
+        slot, req = max(victims, key=lambda sr: sr[1].arrival_seq)
+        self._preempt_slot(slot, req)
+        return True
+
+    def _preempt_slot(self, slot: int, req: "GenerationRequest") -> None:
+        self.preemptions += 1
+        self._prefix_live.pop(slot, None)
+        self._slots[slot] = None
+        self._free_slot_blocks(slot)
+        req.prompt_ids = list(req.prompt_ids) + list(req.out_tokens)
+        req.prefilled_len = 0
+        req.next_pos = -1
+        if len(req.prompt_ids) >= self.max_seq:
+            self._finish(req, "length")
+        else:
+            self._preempted.append(req)
+
+    def _ensure_decode_blocks(self, active: dict, burst: int) -> dict:
+        """Cover positions next_pos..next_pos+burst-1 for every active
+        slot before a decode dispatch; a slot the pool cannot cover (even
+        after evicting newer requests) is itself preempted."""
+        out = {}
+        for slot, req in active.items():
+            if self._slots.get(slot) is not req or req.done.is_set():
+                continue  # evicted by an earlier slot's ensure
+            if self._ensure_blocks(slot, req.next_pos + burst - 1):
+                out[slot] = req
+            else:
+                self._preempt_slot(slot, req)
+        # A LATER slot's ensure may have evicted a request accepted above —
+        # dispatching it anyway would write through its stale table into
+        # blocks the pool already re-issued. Re-filter against live slots.
+        return {s: r for s, r in out.items()
+                if self._slots.get(s) is r and not r.done.is_set()}
+
+    def _next_waiting(self) -> "GenerationRequest":
+        """Preempted requests re-admit ahead of fresh arrivals."""
+        if self._preempted:
+            return self._preempted.popleft()
+        return self._waiting.get_nowait()
 
     def _take_slot(self) -> int:
         """An unoccupied slot: prefer one with no cached prefix; otherwise
@@ -898,11 +1266,25 @@ class LLMEngine:
             toks = np.zeros((bucket,), np.int32)
             toks[:take] = req.prompt_ids[req.prefilled_len:
                                          req.prefilled_len + take]
+            if self.blocked and not self._ensure_blocks(
+                    slot, req.prefilled_len + bucket - 1):
+                self._slots[slot] = None
+                self._free_slot_blocks(slot)
+                self._fail(req, "KV block pool exhausted "
+                                f"({self.num_blocks} blocks x "
+                                f"{self.block_size} tokens)")
+                return True
             try:
-                self.cache, logits = prefill_chunk(
-                    self.model_cfg, self.params, self.cache,
-                    jnp.asarray(toks), jnp.int32(req.prefilled_len),
-                    jnp.int32(p), jnp.int32(slot))
+                if self.blocked:
+                    self.cache, logits = prefill_chunk_blocked(
+                        self.model_cfg, self.params, self.cache,
+                        jnp.asarray(self._tables[slot]), jnp.asarray(toks),
+                        jnp.int32(req.prefilled_len), jnp.int32(p))
+                else:
+                    self.cache, logits = prefill_chunk(
+                        self.model_cfg, self.params, self.cache,
+                        jnp.asarray(toks), jnp.int32(req.prefilled_len),
+                        jnp.int32(p), jnp.int32(slot))
                 req.prefilled_len += take
                 if req.prefilled_len >= p:  # final chunk: sample 1st token
                     # The slot now holds the full prompt's KV: it becomes a
@@ -937,8 +1319,15 @@ class LLMEngine:
         self._slots = {i: None for i in range(self.max_slots)}
         self._prefix_live.clear()
         self._prefix_cached.clear()
-        self.cache = init_kv_cache(self.model_cfg, self.max_slots,
-                                   self.max_seq)
+        if self.blocked:
+            self.cache = init_kv_cache_blocked(
+                self.model_cfg, self.num_blocks, self.block_size)
+            self._tables[:] = 0
+            self._free_blocks = list(range(self.num_blocks))
+            self._slot_nblk = [0] * self.max_slots
+        else:
+            self.cache = init_kv_cache(self.model_cfg, self.max_slots,
+                                       self.max_seq)
         if self.draft_cfg is not None:
             # The draft cache may have been donated by the failing
             # speculative dispatch — rebuild it alongside.
@@ -957,6 +1346,18 @@ class LLMEngine:
         burst = int(getattr(self.config, "decode_burst", 1) or 1)
         if burst <= 1:
             return 1
+        # Prefill priority (reference shape: vLLM chunked-prefill
+        # scheduling): while a slot is mid-prefill, long decode bursts
+        # head-of-line-block its next chunk for burst×step_ms. Cap the
+        # burst so the scheduler returns to the prefill quickly;
+        # steady-state decode (no prefilling slot) keeps full bursts.
+        # (Capping on a non-empty admission queue as well was measured
+        # 18% WORSE end-to-end on the tunneled chip: the closed-loop
+        # arrival pattern made the cap near-permanent, and with tick cost
+        # ≈ RTT + work, halving the work per tick just slowed everyone.)
+        if any(r is not None and r.next_pos < 0 and not r.done.is_set()
+               for r in self._slots.values()):
+            burst = min(burst, self.PREFILL_PRIORITY_BURST)
         budget = 0  # largest remaining token budget across the batch:
         # bounding by the MAX (not min) wastes no tail steps when every
         # request is nearly done, yet a single long request still gets
@@ -978,6 +1379,10 @@ class LLMEngine:
         (_recover_device_failure ran) — callers mid-tick must then abandon
         the rest of the tick rather than dispatch into rebuilt caches."""
         burst = self._burst_len(active)
+        if self.blocked:
+            active = self._ensure_decode_blocks(active, burst)
+            if not active:
+                return True
         tokens = np.zeros((self.max_slots,), np.int32)
         positions = np.zeros((self.max_slots,), np.int32)
         write = np.zeros((self.max_slots,), bool)
@@ -989,10 +1394,16 @@ class LLMEngine:
             return self._decode_burst(active, burst, tokens, positions,
                                       write)
         try:
-            self.cache, logits = decode_step(
-                self.model_cfg, self.params, self.cache,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(write))
+            if self.blocked:
+                self.cache, logits = decode_step_blocked(
+                    self.model_cfg, self.params, self.cache,
+                    jnp.asarray(self._tables), jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(write))
+            else:
+                self.cache, logits = decode_step(
+                    self.model_cfg, self.params, self.cache,
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(write))
         except Exception as e:  # noqa: BLE001 - cache donated & lost
             logger.exception("decode step failed (%d active)", len(active))
             self._recover_device_failure(f"decode failed: {e!r}")
@@ -1032,18 +1443,41 @@ class LLMEngine:
         need_top_p = bool((top_ps < 1.0).any())
         self._rng_key, sub = jax.random.split(self._rng_key)
         try:
-            self.cache, toks = decode_burst(
-                self.model_cfg, self.params, self.cache,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(write), jnp.asarray(temps),
-                jnp.asarray(top_ps), sub, burst, need_top_p)
-            if self._should_chain(active, burst):
-                self._rng_key, sub2 = jax.random.split(self._rng_key)
-                self.cache, toks2 = decode_burst(
+            if self.blocked:
+                self.cache, toks = decode_burst_blocked(
                     self.model_cfg, self.params, self.cache,
-                    toks[burst - 1], jnp.asarray(positions) + burst,
+                    jnp.asarray(self._tables), jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(write),
+                    jnp.asarray(temps), jnp.asarray(top_ps), sub, burst,
+                    need_top_p)
+            else:
+                self.cache, toks = decode_burst(
+                    self.model_cfg, self.params, self.cache,
+                    jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(write), jnp.asarray(temps),
-                    jnp.asarray(top_ps), sub2, burst, need_top_p)
+                    jnp.asarray(top_ps), sub, burst, need_top_p)
+            chain = self._should_chain(active, burst)
+            if chain and self.blocked:
+                # A chain must never evict someone: skip it unless every
+                # slot's blocks for the second burst are already coverable.
+                chain = all(self._ensure_blocks(
+                    s, r.next_pos + 2 * burst - 1, preempt=False)
+                    for s, r in active.items())
+            if chain:
+                self._rng_key, sub2 = jax.random.split(self._rng_key)
+                if self.blocked:
+                    self.cache, toks2 = decode_burst_blocked(
+                        self.model_cfg, self.params, self.cache,
+                        jnp.asarray(self._tables), toks[burst - 1],
+                        jnp.asarray(positions) + burst, jnp.asarray(write),
+                        jnp.asarray(temps), jnp.asarray(top_ps), sub2,
+                        burst, need_top_p)
+                else:
+                    self.cache, toks2 = decode_burst(
+                        self.model_cfg, self.params, self.cache,
+                        toks[burst - 1], jnp.asarray(positions) + burst,
+                        jnp.asarray(write), jnp.asarray(temps),
+                        jnp.asarray(top_ps), sub2, burst, need_top_p)
                 self._pending_burst = (dict(active), burst, toks2)
             toks = np.asarray(toks)  # [burst, max_slots]
         except Exception as e:  # noqa: BLE001 - cache donated & lost
@@ -1065,7 +1499,7 @@ class LLMEngine:
             return False
         if self._pending_burst is not None or self.draft_params is not None:
             return False
-        if not self._waiting.empty():
+        if not self._waiting.empty() or self._preempted:
             return False
         for r in self._slots.values():
             if r is not None and r.next_pos < 0:
@@ -1188,6 +1622,11 @@ class LLMEngine:
         max_seq would make dynamic_update_slice clamp its start index and
         silently overwrite earlier positions."""
         bucket = self.config.prefill_bucket_min
+        if self.blocked:
+            # Chunks write whole pool blocks: buckets are power-of-two
+            # multiples of block_size and starts stay block-aligned
+            # (take == bucket on every non-final chunk).
+            bucket = max(bucket, self.block_size)
         while bucket < min(remaining, self.config.prefill_chunk):
             bucket *= 2
         bucket = min(bucket, self.max_seq - start)
@@ -1286,6 +1725,11 @@ class LLMEngine:
                 toks = self._prefix_live.pop(slot, None)
                 if not req.hold_slot:
                     self._slots[slot] = None
+                    if self.blocked:
+                        # Pool mode: blocks go back to the pool instead of
+                        # retiring as a cached prefix line.
+                        self._free_slot_blocks(slot)
+                        continue
                     if toks is not None and reason != "error":
                         # Retire, don't discard: the slot's KV stays intact
                         # until the slot is reclaimed, so an identical or
