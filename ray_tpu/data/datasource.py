@@ -420,10 +420,14 @@ class MongoDatasource(Datasource):
     driverless environments use a fake; omitted, pymongo is imported and
     connected to ``uri``).
 
-    Sharding: ``num_shards`` skip/limit-partitions the (pipelined)
-    collection so shards read in parallel. The reference delegates range
-    splitting to the mongo cluster (splitVector); skip/limit is the
-    driver-portable equivalent at this scale."""
+    Sharding: ``num_shards`` partitions the collection by _id ranges
+    whose boundaries are the documents at even rank offsets (sorted by
+    _id, one count + N skip probes) so shards read in parallel;
+    combining ``pipeline`` with ``num_shards > 1`` raises (a pipeline can
+    reorder/reshape documents, making _id ranges meaningless). The
+    reference delegates range splitting to the mongo cluster
+    (splitVector); _id-range partitioning is the driver-portable
+    equivalent at this scale."""
 
     def __init__(self, uri: str, database: str, collection: str,
                  pipeline: list | None = None,

@@ -602,6 +602,7 @@ class GenerationRequest:
     draft_fail_count: int = 0  # consecutive draft catch-up failures
     spec_disabled: bool = False  # excluded from speculation (see _spec_decode)
     arrival_seq: int = 0  # admission order; blocked-KV preemption evicts newest
+    prefill_gen: int = 0  # bumped on preemption: stale deferred fetches no-op
 
 
 @dataclass
@@ -928,6 +929,17 @@ class LLMEngine:
         # finishes) must wait for it, and those are still occupied here.
         worked = self._admit()
         deferred: list = []
+        try:
+            return self._tick_inner(deferred) or worked
+        finally:
+            # An exception between a prefill dispatch and its resolution
+            # must not strand the deferred first-token fetches — the
+            # requests would report prefilled but never start decoding
+            # (hang to client timeout). Whatever survived, resolve it.
+            self._resolve_prefills(deferred)
+
+    def _tick_inner(self, deferred: list) -> bool:
+        worked = False
         # ONE chunk budget for the whole tick, split across the passes —
         # the second pass only spends what the first left over, so
         # prefill_chunks_per_tick keeps its documented meaning.
@@ -961,20 +973,24 @@ class LLMEngine:
             if rest:
                 self._decode(rest)
                 worked = True
-            self._resolve_prefills(deferred)
             return worked
         if decoding:
             self._decode(decoding)
             worked = True
-        self._resolve_prefills(deferred)
         return worked
 
     def _resolve_prefills(self, deferred: list) -> None:
         """Fetch the deferred first tokens (dispatched in _prefill_step)
         and start those requests decoding. Runs AFTER the tick's decode
         dispatch so the fetch overlaps the queued device work."""
-        for req, out in deferred:
+        for req, gen, out in deferred:
             if req.done.is_set():  # failed meanwhile (device recovery)
+                continue
+            if gen != req.prefill_gen:
+                # Preempted (and possibly re-admitted) after this fetch was
+                # dispatched: the token belongs to a KV state that no
+                # longer exists — emitting it would duplicate the first
+                # token of the re-prefill.
                 continue
             try:
                 tok = int(np.asarray(out)[0])
@@ -1124,6 +1140,8 @@ class LLMEngine:
         # An in-flight chained burst still emits for its snapshot: resolve
         # it first so a preempted request can't receive its tokens.
         self._resolve_pending_burst()
+        if self._free_blocks:
+            return True  # the resolve's finishes freed enough — no eviction
         victims = [(s, r) for s, r in victims
                    if self._slots.get(s) is r and not r.done.is_set()]
         if not victims:
@@ -1140,6 +1158,7 @@ class LLMEngine:
         req.prompt_ids = list(req.prompt_ids) + list(req.out_tokens)
         req.prefilled_len = 0
         req.next_pos = -1
+        req.prefill_gen += 1  # invalidate in-flight deferred fetches
         if len(req.prompt_ids) >= self.max_seq:
             self._finish(req, "length")
         else:
@@ -1291,7 +1310,7 @@ class LLMEngine:
                     # prefix donor for later shared-prefix requests.
                     self._prefix_live[slot] = tuple(req.prompt_ids)
                     out = self._sample_dispatch(logits[None], [req])
-                    deferred.append((req, out))
+                    deferred.append((req, req.prefill_gen, out))
             except Exception as e:  # noqa: BLE001 - e.g. OOM on long prompt
                 logger.exception("prefill failed for %s", req.request_id)
                 self._recover_device_failure(f"prefill failed: {e!r}")

@@ -777,7 +777,7 @@ def _chunk_blocks(sq: int, skv: int, block_q: int, block_k: int):
 
     block_q = pick(sq, block_q)
     block_k = pick(skv, block_k)
-    if sq % block_q or skv % block_k:
+    if block_q < 8 or block_k < 8 or sq % block_q or skv % block_k:
         raise ValueError(
             f"flash_attention_chunk needs seq lengths with a power-of-two "
             f"block divisor >= 8 (got sq={sq}, skv={skv}); pad the ring "
@@ -809,7 +809,10 @@ def _flash_chunk_fwd_pallas(q, k, v, qpos, kpos, causal, sm_scale,
             pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            # f32 out: the cross-chunk log-sum-exp combiner accumulates in
+            # f32 and casts ONCE at the end — a bf16 out here would add
+            # one rounding per ring step (error growing with ring size).
+            jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
             jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
