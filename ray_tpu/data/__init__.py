@@ -191,7 +191,9 @@ def from_blocks(blocks: list[Block]) -> MaterializedDataset:
     import ray_tpu
 
     refs_meta = [
-        (ray_tpu.put(b), {"num_rows": BlockAccessor(b).num_rows()})
+        (ray_tpu.put(b),
+         {"num_rows": BlockAccessor(b).num_rows(),
+          "size_bytes": BlockAccessor(b).size_bytes()})
         for b in blocks
     ]
     return MaterializedDataset(refs_meta)

@@ -39,12 +39,26 @@ def _slice_block(block: Block, start: int, end: int):
 
 class ActorPoolStrategy:
     """compute= argument for map_batches (reference capability:
-    ray.data.ActorPoolStrategy — actor-pool map operator for stateful or
-    accelerator-bound transforms)."""
+    ray.data.ActorPoolStrategy — autoscaling actor-pool map operator for
+    stateful or accelerator-bound transforms). ``min_size``/``max_size``
+    make the pool elastic: it grows while the stage's input queue outruns
+    the actors and shrinks back when they idle (reference:
+    _internal/execution/operators/actor_pool_map_operator.py)."""
 
-    def __init__(self, size: int = 2, *, num_cpus: float = 1.0,
+    def __init__(self, size: int | None = None, *, min_size: int | None = None,
+                 max_size: int | None = None, num_cpus: float = 1.0,
                  num_tpus: float = 0.0, resources: dict | None = None):
-        self.size = size
+        if size is None and min_size is None and max_size is None:
+            size = 2
+        self.min_size = int(min_size if min_size is not None
+                            else (size if size is not None else 1))
+        self.max_size = int(max_size if max_size is not None
+                            else (size if size is not None
+                                  else self.min_size))
+        if self.min_size < 1 or self.max_size < self.min_size:
+            raise ValueError(
+                f"invalid pool bounds [{self.min_size}, {self.max_size}]")
+        self.size = self.min_size  # initial size (back-compat attribute)
         self.num_cpus = num_cpus
         self.num_tpus = num_tpus
         self.resources = resources or {}
@@ -67,10 +81,31 @@ class _MapWorker:
 class _StageExec:
     """Runtime state of one map stage."""
 
-    def __init__(self, stage: FusedMapStage, ctx: DataContext, api):
+    # Wall-clock seconds of continuous idleness before an elastic pool
+    # retires one actor above min_size (ticks would shrink a warm pool
+    # sitting behind a slow upstream stage in milliseconds).
+    POOL_IDLE_S = 10.0
+
+    def __init__(self, stage: FusedMapStage, ctx: DataContext, api,
+                 n_stages: int = 1):
         self.stage = stage
         self.ctx = ctx
         self.api = api
+        # Per-stage byte budget measured against the node's object-store
+        # arena (reference: ResourceManager op budgets against
+        # object_store_memory): the stages of a pipeline collectively get
+        # object_store_budget_fraction of the arena.
+        try:
+            from ray_tpu.utils.config import get_config
+
+            arena = get_config().object_store_memory_bytes
+        except Exception:
+            arena = 0
+        self.byte_budget = ctx.max_output_bytes_buffered
+        if arena:
+            share = int(arena * ctx.object_store_budget_fraction
+                        / max(1, n_stages))
+            self.byte_budget = min(self.byte_budget, max(share, 1 << 20))
         self.input_queue: collections.deque = collections.deque()
         self.upstream_done = False
         # meta_ref -> (block_ref, actor_index|None, seq)
@@ -87,15 +122,53 @@ class _StageExec:
         )
         self._pool = None
         self._pool_load: list[int] = []
+        self._pool_idle_since: float | None = None
+        self._actor_cls = None
+        self._fn_ref = None
         if isinstance(stage.compute, ActorPoolStrategy):
             comp = stage.compute
-            actor_cls = api.remote(
+            self._actor_cls = api.remote(
                 num_cpus=comp.num_cpus, num_tpus=comp.num_tpus,
                 resources=comp.resources,
             )(_MapWorker)
-            fn_ref = api.put(stage.block_fn)
-            self._pool = [actor_cls.remote(fn_ref) for _ in range(comp.size)]
-            self._pool_load = [0] * comp.size
+            self._fn_ref = api.put(stage.block_fn)
+            self._pool = [self._actor_cls.remote(self._fn_ref)
+                          for _ in range(comp.min_size)]
+            self._pool_load = [0] * comp.min_size
+
+    def _autoscale_pool(self) -> None:
+        """Elastic pool sizing: grow while the queue outruns the actors
+        AND the stage can actually launch (a stage throttled by its output
+        byte budget must not ramp actors that can do no work), capped by
+        the in-flight task limit; retire an idle actor after a quiet
+        wall-clock spell (down to min_size)."""
+        import time as _time
+
+        comp = self.stage.compute
+        if self._pool is None or comp.min_size == comp.max_size:
+            return
+        cap = min(comp.max_size, self.ctx.max_tasks_in_flight_per_stage)
+        if (len(self.input_queue) > 2 * len(self._pool)
+                and len(self._pool) < cap and self.can_launch()):
+            self._pool.append(self._actor_cls.remote(self._fn_ref))
+            self._pool_load.append(0)
+            self._pool_idle_since = None
+            return
+        busy = len(self.input_queue) + sum(self._pool_load)
+        if busy == 0 and len(self._pool) > comp.min_size:
+            now = _time.monotonic()
+            if self._pool_idle_since is None:
+                self._pool_idle_since = now
+            elif now - self._pool_idle_since >= self.POOL_IDLE_S:
+                self._pool_idle_since = now
+                actor = self._pool.pop()  # retire the newest
+                self._pool_load.pop()
+                try:
+                    self.api.kill(actor)
+                except Exception:
+                    pass
+        else:
+            self._pool_idle_since = None
 
     @property
     def done(self) -> bool:
@@ -116,11 +189,12 @@ class _StageExec:
         buffered = sum(m.get("size_bytes", 0) for _, m in self.outputs)
         buffered += sum(m.get("size_bytes", 0)
                         for _, m in self._pending_out.values())
-        if buffered >= self.ctx.max_output_bytes_buffered:
+        if buffered >= self.byte_budget:
             return False  # byte budget (reference: ResourceManager)
         return True
 
     def launch(self) -> None:
+        self._autoscale_pool()
         while self.can_launch():
             block_ref, _meta = self.input_queue.popleft()
             seq = self._seq_in
@@ -213,9 +287,10 @@ def _stream_segment(initial, pending_source, stages, ctx, api):
     """Streaming loop over map/limit stages (no barriers inside)."""
     limit_remaining: dict[int, int] = {}
     execs: list[_StageExec | LimitOp] = []
+    n_map_stages = sum(1 for st in stages if isinstance(st, FusedMapStage))
     for st in stages:
         if isinstance(st, FusedMapStage):
-            execs.append(_StageExec(st, ctx, api))
+            execs.append(_StageExec(st, ctx, api, n_stages=n_map_stages))
         elif isinstance(st, LimitOp):
             limit_remaining[id(st)] = st.limit
             execs.append(st)

@@ -17,7 +17,11 @@ from ray_tpu.data.context import DataContext
 
 
 def _meta(block: Block) -> dict:
-    return {"num_rows": BlockAccessor(block).num_rows()}
+    acc = BlockAccessor(block)
+    # size_bytes rides along so downstream all-to-alls can size their
+    # partition count from real bytes (shuffle_partitions) — without it a
+    # chained shuffle would fall back to the 8-partition floor.
+    return {"num_rows": acc.num_rows(), "size_bytes": acc.size_bytes()}
 
 
 # -- map-side partitioners (run as remote tasks, num_returns=P) -------------
@@ -200,6 +204,21 @@ def aggregate_block(block: Block, key: str | None, aggs: list[AggregateFn]) -> B
 # -- AllToAll builders (driver-side; each returns fn(list[(ref,meta)])) ------
 
 
+def shuffle_partitions(refs_meta, ctx) -> int:
+    """All-to-all fan-out: at least the configured default (capped by the
+    block count), grown so each reduce partition targets at most
+    target_shuffle_partition_bytes of data — a reduce task materializes
+    one partition in memory, so this bound (not the dataset size) is what
+    its footprint scales with. Blocks between rounds live as object-store
+    refs, and the arena spills to disk under pressure: together that is
+    the external-sort path."""
+    n = len(refs_meta)
+    base = max(1, min(ctx.default_shuffle_partitions, n))
+    total = sum((m or {}).get("size_bytes", 0) for _, m in refs_meta)
+    by_bytes = -(-total // max(1, ctx.target_shuffle_partition_bytes))
+    return max(base, min(int(by_bytes), ctx.max_shuffle_partitions))
+
+
 def _two_round(api, refs_meta, partition_fn, partition_args,
                reduce_fn, reduce_args, num_parts: int):
     ctx = DataContext.get_current()
@@ -226,10 +245,16 @@ def make_sort_fn(key: str, descending: bool, api):
         if not refs_meta:
             return []
         ctx = DataContext.get_current()
-        num_parts = min(ctx.default_shuffle_partitions, len(refs_meta))
+        num_parts = shuffle_partitions(refs_meta, ctx)
+        # ~20 samples per eventual boundary, spread over the blocks — a
+        # fixed 20/block was sized for the old <=8-partition cap and makes
+        # high fan-out boundaries far too noisy to honor the per-partition
+        # byte target.
+        per_block = min(1000, max(20, (20 * num_parts)
+                                  // max(1, len(refs_meta)) + 1))
         sample = api.remote(num_cpus=0)(_sample_boundaries)
         samples = api.get(
-            [sample.remote(ref, key, 20) for ref, _ in refs_meta]
+            [sample.remote(ref, key, per_block) for ref, _ in refs_meta]
         )
         allv = np.concatenate([s for s in samples if len(s)]) if any(
             len(s) for s in samples
@@ -255,7 +280,7 @@ def make_random_shuffle_fn(seed: int | None, api):
         if not refs_meta:
             return []
         ctx = DataContext.get_current()
-        num_parts = min(ctx.default_shuffle_partitions, len(refs_meta))
+        num_parts = shuffle_partitions(refs_meta, ctx)
         base = seed if seed is not None else 0xC0FFEE
         out = []
         part_remote = api.remote(num_cpus=ctx.task_num_cpus,
@@ -331,7 +356,7 @@ def make_groupby_fn(key: str, aggs: list[AggregateFn], api):
         if not refs_meta:
             return []
         ctx = DataContext.get_current()
-        num_parts = min(ctx.default_shuffle_partitions, len(refs_meta))
+        num_parts = shuffle_partitions(refs_meta, ctx)
         return _two_round(
             api, refs_meta,
             _partition_by_hash, (key, num_parts),
@@ -349,7 +374,7 @@ def make_groupby_shuffle_only_fn(key: str, api):
         if not refs_meta:
             return []
         ctx = DataContext.get_current()
-        num_parts = min(ctx.default_shuffle_partitions, len(refs_meta))
+        num_parts = shuffle_partitions(refs_meta, ctx)
         return _two_round(
             api, refs_meta,
             _partition_by_hash, (key, num_parts),
@@ -522,9 +547,8 @@ def make_join_fn(right_dataset, key: str, how: str, api):
     def run(left_refs_meta):
         right_refs_meta = list(right_dataset._execute())
         ctx = DataContext.get_current()
-        num_parts = max(1, min(ctx.default_shuffle_partitions,
-                               max(len(left_refs_meta),
-                                   len(right_refs_meta), 1)))
+        num_parts = max(shuffle_partitions(left_refs_meta, ctx),
+                        shuffle_partitions(right_refs_meta, ctx), 1)
         part_remote = api.remote(num_cpus=ctx.task_num_cpus,
                                  num_returns=num_parts)(_partition_by_hash)
         join_remote = api.remote(num_cpus=ctx.task_num_cpus,

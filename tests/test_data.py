@@ -824,3 +824,108 @@ def test_read_delta_from_checkpoint(rt, tmp_path):
     rows = sorted(rd.read_delta(str(root)).take_all(),
                   key=lambda r: r["id"])
     assert [r["id"] for r in rows] == [2, 3, 4]  # old.parquet stays dead
+
+
+def test_shuffle_partitions_scale_with_bytes(rt):
+    """Spill-aware shuffle sizing (reference: push-based shuffle target
+    partition size): the all-to-all fan-out grows with total bytes so one
+    reduce task never materializes more than ~target_shuffle_partition_bytes
+    — datasets larger than the arena sort through bounded-memory tasks
+    backed by the spilling object store."""
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data.shuffle import shuffle_partitions
+
+    ctx = DataContext.get_current()
+    old = ctx.target_shuffle_partition_bytes
+    try:
+        ctx.target_shuffle_partition_bytes = 1024
+        # 40 blocks x 1 KB => 40 partitions even though the default is 8.
+        fake = [(None, {"size_bytes": 1024}) for _ in range(40)]
+        assert shuffle_partitions(fake, ctx) == 40
+        # Small data keeps the default floor.
+        small = [(None, {"size_bytes": 1}) for _ in range(40)]
+        assert shuffle_partitions(small, ctx) == 8
+        # The cap bounds runaway fan-out.
+        huge = [(None, {"size_bytes": 10 * 1024 * 1024})] * 100
+        assert shuffle_partitions(huge, ctx) == ctx.max_shuffle_partitions
+
+        # End-to-end: a sort forced into many partitions is still correct.
+        rng = np.random.default_rng(1)
+        vals = rng.permutation(300).tolist()
+        ds = rd.from_items([{"v": v} for v in vals]).sort("v")
+        assert [r["v"] for r in ds.take_all()] == sorted(vals)
+    finally:
+        ctx.target_shuffle_partition_bytes = old
+
+
+def test_stage_byte_budget_derived_from_arena(rt):
+    """The executor's per-stage buffered-bytes budget is capped by the
+    object-store share (reference: ResourceManager op budgets)."""
+    import os
+
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data.executor import _StageExec
+    from ray_tpu.data.plan import FusedMapStage
+    from ray_tpu.utils import config as config_mod
+
+    import ray_tpu
+
+    stage = FusedMapStage(block_fn=lambda b: b, label="t", compute=None)
+    prior = os.environ.get("RTPU_OBJECT_STORE_MEMORY_BYTES")
+    os.environ["RTPU_OBJECT_STORE_MEMORY_BYTES"] = str(64 * 1024 * 1024)
+    config_mod.set_config(config_mod.Config.load())
+    try:
+        ctx = DataContext.get_current()
+        ex = _StageExec(stage, ctx, ray_tpu, n_stages=4)
+        # 64 MB arena * 0.5 fraction / 4 stages = 8 MB per stage.
+        assert ex.byte_budget == 8 * 1024 * 1024
+    finally:
+        if prior is None:
+            os.environ.pop("RTPU_OBJECT_STORE_MEMORY_BYTES")
+        else:
+            os.environ["RTPU_OBJECT_STORE_MEMORY_BYTES"] = prior
+        config_mod.set_config(config_mod.Config.load())
+
+
+def test_actor_pool_autoscales_up_and_down(rt):
+    """Elastic actor pools (reference: actor_pool_map_operator autoscaling):
+    a deep input queue grows the pool toward max_size; idleness shrinks it
+    back to min_size."""
+    import time as _t
+
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data.executor import _StageExec
+    from ray_tpu.data.plan import FusedMapStage
+
+    import ray_tpu
+
+    def slow(block):
+        _t.sleep(0.2)
+        return block
+
+    comp = rd.ActorPoolStrategy(min_size=1, max_size=3, num_cpus=0.1)
+    stage = FusedMapStage(block_fn=slow, label="t", compute=comp)
+    ex = _StageExec(stage, DataContext.get_current(), ray_tpu, n_stages=1)
+    ex.POOL_IDLE_S = 0.2  # fast wall-clock shrink for the test
+    try:
+        assert len(ex._pool) == 1
+        refs = [ray_tpu.put({"id": np.arange(4)}) for _ in range(12)]
+        for r in refs:
+            ex.input_queue.append((r, {"num_rows": 4, "size_bytes": 32}))
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline and (ex.input_queue or ex.in_flight):
+            ex.launch()
+            if ex.in_flight:
+                ready, _ = ray_tpu.wait(list(ex.in_flight.keys()),
+                                        num_returns=1, timeout=0.2)
+                ex.collect_ready(ready)
+        assert len(ex._pool) > 1, "pool never scaled up"
+        # Drain and idle: the pool shrinks back to min_size.
+        deadline = _t.monotonic() + 15
+        while _t.monotonic() < deadline and len(ex._pool) > 1:
+            ex.launch()
+            _t.sleep(0.05)
+        assert len(ex._pool) == 1, "pool never scaled back down"
+        assert len(ex.outputs) == 12
+    finally:
+        ex.shutdown()
