@@ -54,9 +54,14 @@ def measure_memcpy() -> float:
     return n * SIZE / (time.perf_counter() - t0) / 1e9
 
 
-def measure_single_pull(c: "Cluster") -> float:
+def measure_single_pull(c: "Cluster") -> tuple[float, float]:
     """One 64 MB cross-node pull, warm connections — the per-transfer
-    ceiling of the object path on this box."""
+    ceiling of the object path on this box. Returns (bytes_GBps,
+    ndarray_GBps): bytes payloads pay one final materialization copy;
+    ndarrays deserialize ZERO-COPY as read-only views pinned over the
+    puller's arena (plasma semantics)."""
+    import numpy as np
+
     n1 = c.add_node(num_cpus=1, node_id="egress-sp-a")
     n2 = c.add_node(num_cpus=1, node_id="egress-sp-b")
     rt_a = c.connect(n1)
@@ -67,7 +72,13 @@ def measure_single_pull(c: "Cluster") -> float:
         ref2 = rt_a.put(b"y" * SIZE)
         t0 = time.perf_counter()
         rt_b.get([ref2], timeout=120)
-        return SIZE / (time.perf_counter() - t0) / 1e9
+        bytes_gbps = SIZE / (time.perf_counter() - t0) / 1e9
+        ref3 = rt_a.put(np.full(SIZE, 7, np.uint8))
+        t0 = time.perf_counter()
+        (arr,) = rt_b.get([ref3], timeout=120)
+        nd_gbps = SIZE / (time.perf_counter() - t0) / 1e9
+        assert arr.flags.writeable is False and int(arr[0]) == 7
+        return bytes_gbps, nd_gbps
     finally:
         rt_b.shutdown()
         rt_a.shutdown()
@@ -111,7 +122,7 @@ def main() -> None:
     loopback_gbps = measure_loopback()
 
     c = Cluster()
-    single_pull_gbps = measure_single_pull(c)
+    single_pull_gbps, single_pull_ndarray_gbps = measure_single_pull(c)
     src = c.add_node(num_cpus=1, node_id="egress-src")
     for i in range(N_NODES):
         c.add_node(num_cpus=2, node_id=f"egress-{i}")
@@ -163,16 +174,18 @@ def main() -> None:
             "memcpy_GBps": round(memcpy_gbps, 3),
             "loopback_GBps": round(loopback_gbps, 3),
             "single_pull_GBps": round(single_pull_gbps, 3),
+            "single_pull_ndarray_GBps": round(single_pull_ndarray_gbps, 3),
             "analysis": (
                 "Relay egress bound holds: the source serves at most its "
                 "referral budget and later pulls ride relay copies "
                 "(distinct_serving_copies > 1; same-node consumers share "
-                "the arena with no transfer at all). The aggregate is "
-                "box-bound, not relay-bound: a single warm pull runs at "
-                "single_pull_GBps ~= memcpy/5 (socket send+recv, arena "
-                "write+read, deserialize copy — five 64MB traversals on "
-                "ONE core), and the fan-out's concurrent transfers + 8 "
-                "worker processes share that same core."
+                "the arena with no transfer at all). r5 zero-copy work: "
+                "the server sends via sendfile() (no user-space read of "
+                "the arena), the puller recvs straight into its arena, "
+                "and get() deserializes from a pinned arena view — bytes "
+                "payloads pay exactly one materialization copy, ndarrays "
+                "none (read-only views, plasma semantics). r4's warm "
+                "pull traversed the payload ~5x (0.357 GB/s)."
             ),
         }
         print(json.dumps(result, indent=2))

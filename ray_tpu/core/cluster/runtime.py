@@ -689,11 +689,16 @@ class ClusterRuntime:
         self.store.put(oid, b"".join(parts) if len(parts) > 1 else parts[0],
                        owner)
 
-    def _local_blob(self, oid: ObjectID) -> bytes | None:
+    def _local_blob(self, oid: ObjectID, as_view: bool = False):
+        """Local blob; with as_view=True a shm hit returns a pinned
+        ArenaView (zero-copy consumption in get()); peer-serving RPC
+        paths keep bytes."""
         if self.store.contains(oid):
             return self.store.get(oid)
         if self.shm is not None:
             try:
+                if as_view:
+                    return self.shm.get_view(oid.binary())
                 return self.shm.get_bytes(oid.binary())
             except KeyError:
                 pass
@@ -725,7 +730,7 @@ class ClusterRuntime:
 
     def _fetch(self, ref: ObjectRef, deadline: float | None) -> bytes:
         # 1. local (process store, then node shm arena)
-        local = self._local_blob(ref.id)
+        local = self._local_blob(ref.id, as_view=True)
         if local is not None:
             return local
         owner_hex = ref.owner_id.hex() if ref.owner_id else None
@@ -846,7 +851,7 @@ class ClusterRuntime:
             oid = ref.id.binary()
             if self.shm is not None:
                 if self.shm.contains(oid):
-                    return self.shm.get_bytes(oid)
+                    return self.shm.get_view(oid)
                 total = transfer.pull_to_store(self.shm.name, oid,
                                                xfer[0], xfer[1])
                 if total is None:
@@ -854,7 +859,10 @@ class ClusterRuntime:
                 # Sealing into the arena bypasses store.on_seal — wake
                 # concurrent wait()ers on this ref like the RPC path does.
                 self._notify_waiters()
-                return self.shm.get_bytes(oid)
+                # Pinned view, not bytes: get() deserializes straight out
+                # of the arena (large arrays zero-copy) instead of paying
+                # an arena->bytes traversal plus a deserialize copy.
+                return self.shm.get_view(oid)
             data = transfer.fetch_to_buffer(ref.id.binary(), xfer[0],
                                             xfer[1])
             if data is not None:

@@ -178,6 +178,12 @@ class SharedMemoryStore:
             raise ShmStoreError(f"get failed rc={rc}")
         return memoryview(self._mm)[off.value:off.value + size.value]
 
+    def get_view(self, object_id: bytes) -> "ArenaView":
+        """Pinned zero-copy view (see ArenaView): the object stays
+        refcounted in the arena until the view (or anything borrowing its
+        buffer, e.g. a zero-copy numpy array) is garbage-collected."""
+        return ArenaView(self, bytes(object_id), self.get(object_id))
+
     def get_bytes(self, object_id: bytes) -> bytes:
         view = self.get(object_id)
         try:
@@ -296,3 +302,45 @@ class SharedMemoryStore:
             self.close()
         except Exception:
             pass
+
+
+class ArenaView:
+    """A pinned window into the shm arena: holds the store refcount (so
+    spill/eviction skip the object) and the mmap buffer until released or
+    garbage-collected. Exposes the buffer protocol (PEP 688), so
+    np.frombuffer(ArenaView(...)) builds a ZERO-COPY array whose base
+    keeps the pin alive — the reference's plasma get() returns read-only
+    arrays with exactly this lifetime contract."""
+
+    __slots__ = ("view", "_store", "_oid", "_released")
+
+    def __init__(self, store: SharedMemoryStore, object_id: bytes,
+                 view: memoryview):
+        self.view = view
+        self._store = store
+        self._oid = object_id
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        try:
+            self.view.release()
+        finally:
+            try:
+                self._store.release(self._oid)
+            except Exception:
+                pass
+
+    def __del__(self):  # noqa: D105
+        self.release()
+
+    def __buffer__(self, flags):  # PEP 688 (Python >= 3.12)
+        return memoryview(self.view)
+
+    def __len__(self) -> int:
+        return len(self.view)
+
+    def __bool__(self) -> bool:
+        return True

@@ -190,12 +190,9 @@ def from_huggingface(hf_dataset, *, rows_per_block: int = 4096) -> Dataset:
 def from_blocks(blocks: list[Block]) -> MaterializedDataset:
     import ray_tpu
 
-    refs_meta = [
-        (ray_tpu.put(b),
-         {"num_rows": BlockAccessor(b).num_rows(),
-          "size_bytes": BlockAccessor(b).size_bytes()})
-        for b in blocks
-    ]
+    from ray_tpu.data.shuffle import _meta
+
+    refs_meta = [(ray_tpu.put(b), _meta(b)) for b in blocks]
     return MaterializedDataset(refs_meta)
 
 

@@ -22,14 +22,19 @@
 // process maps the shm segment by NAME, so no handles cross libraries.
 
 #include <atomic>
+#include <cstdio>
 #include <cstdint>
 #include <cstring>
 #include <thread>
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sys/mman.h>
+#include <sys/sendfile.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 namespace {
@@ -95,9 +100,40 @@ struct RespHdr {
 struct ServerState {
   Store* store;
   int lfd;
+  // shm fd for sendfile(): the kernel streams arena pages straight into
+  // the socket, skipping the user-space read traversal of WriteFull — on
+  // a one-core box every saved 64 MB pass is throughput (the warm-pull
+  // profile showed the copy count, not the wire, as the bound). -1 =>
+  // fall back to WriteFull.
+  int shm_fd = -1;
   std::atomic<bool> stopping{false};
   std::atomic<int> active{0};
 };
+
+// Stream [off, off+n) of the shm file to the socket via sendfile;
+// falls back to false on any error (caller then closes the connection —
+// mid-payload there is no way to resynchronize the stream).
+bool SendFromArena(ServerState* st, int fd, uint64_t off, uint64_t n) {
+  if (st->shm_fd < 0) {
+    return WriteFull(fd, store_base(st->store) + off, n);
+  }
+  off_t pos = static_cast<off_t>(off);
+  uint64_t left = n;
+  while (left > 0) {
+    ssize_t w = sendfile(fd, st->shm_fd, &pos, left);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      if (w < 0 && (errno == EINVAL || errno == ENOSYS)) {
+        // sendfile unsupported for this fd pair: plain write the rest.
+        return WriteFull(fd, store_base(st->store) + static_cast<uint64_t>(pos),
+                         left);
+      }
+      return false;
+    }
+    left -= static_cast<uint64_t>(w);
+  }
+  return true;
+}
 
 void ServeConn(ServerState* st, int fd) {
   // st->active was incremented by the ACCEPT loop before this thread was
@@ -115,8 +151,7 @@ void ServeConn(ServerState* st, int fd) {
       uint64_t n = (start + want > total) ? total - start : want;
       h = RespHdr{0, total, n};
       bool ok = WriteFull(fd, &h, sizeof(h)) &&
-                (n == 0 ||
-                 WriteFull(fd, store_base(st->store) + off + start, n));
+                (n == 0 || SendFromArena(st, fd, off + start, n));
       store_release(st->store, req.id);
       if (!ok) break;
       continue;
@@ -234,6 +269,15 @@ void* transfer_server_start2(const char* shm_name, const char* host,
   ServerState* st = new ServerState();
   st->store = store;
   st->lfd = lfd;
+  {
+    // Re-open the segment by name for sendfile (objstore closes its fd
+    // after mmap). Read-only is enough; failure just disables sendfile.
+    const char* nm = shm_name;
+    while (*nm == '/') nm++;  // shm_open-style names may carry a slash
+    char path[300];
+    snprintf(path, sizeof(path), "/dev/shm/%s", nm);
+    st->shm_fd = open(path, O_RDONLY | O_CLOEXEC);
+  }
   std::thread([st]() {
     while (true) {
       int cfd = accept(st->lfd, nullptr, nullptr);
@@ -258,6 +302,7 @@ void* transfer_server_start2(const char* shm_name, const char* host,
     // Drain in-flight connections before unmapping the arena (a serving
     // thread reading a freed mapping would be use-after-free).
     while (st->active.load() != 0) usleep(1000);
+    if (st->shm_fd >= 0) close(st->shm_fd);
     store_close(st->store);
     delete st;
   }).detach();
