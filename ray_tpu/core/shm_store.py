@@ -337,7 +337,10 @@ class ArenaView:
         self.release()
 
     def __buffer__(self, flags):  # PEP 688 (Python >= 3.12)
-        return memoryview(self.view)
+        # READ-ONLY: consumers must not be able to flip writeable back on
+        # and mutate the sealed object in the shared arena under every
+        # other process holding the ref.
+        return memoryview(self.view).toreadonly()
 
     def __len__(self) -> int:
         return len(self.view)

@@ -15,6 +15,7 @@ from ray_tpu.rl.appo import APPO, APPOConfig
 from ray_tpu.rl.bc import BC, BCConfig
 from ray_tpu.rl.cql import CQL, CQLConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.dreamer import Dreamer, DreamerConfig
 from ray_tpu.rl.impala import IMPALA, ImpalaConfig
 from ray_tpu.rl.multi_agent import (
     ChaseGame,
@@ -24,6 +25,7 @@ from ray_tpu.rl.multi_agent import (
     MultiAgentPPO,
     MultiAgentPPOConfig,
 )
+from ray_tpu.rl.marwil import MARWIL, MARWILConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.sac import SAC, SACConfig
 from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
@@ -40,6 +42,8 @@ __all__ = [
     "MultiAgentEnv", "MultiAgentEnvRunner", "CoordinationGame", "ChaseGame",
     "MultiAgentPPO", "MultiAgentPPOConfig",
     "BC", "BCConfig",
+    "MARWIL", "MARWILConfig",
+    "Dreamer", "DreamerConfig",
     "ReplayBuffer", "PrioritizedReplayBuffer",
 ]
 

@@ -1,0 +1,455 @@
+"""Dreamer: model-based RL — world model + imagination-trained actor-critic.
+
+Capability parity with the reference's model-based family (reference:
+rllib/algorithms/dreamerv3/ — RSSM world model trained on replayed
+sequences, actor/critic trained entirely on imagined latent rollouts,
+a Tune Trainable like every other algorithm). Re-designed compactly for
+low-dimensional observations in pure JAX:
+
+- RSSM-lite: deterministic GRU core h, Gaussian stochastic latent z with
+  prior p(z|h) and posterior q(z|h, enc(o)); decoder/reward/continue heads
+  on [h, z]. KL(q‖p) with free bits, is_first resets inside the scan (the
+  replay samples windows that may cross episode boundaries, as DreamerV3's
+  does).
+- Imagination: from detached posterior states, the actor rolls the model
+  forward H steps through the prior; the critic regresses λ-returns over
+  imagined rewards/continues, the (discrete-action) actor follows REINFORCE
+  with the critic baseline + entropy bonus — DreamerV3's discrete-action
+  estimator.
+
+This fills the model-based archetype of the algorithm matrix (sync
+on-policy = PPO, off-policy replay = DQN, async = IMPALA/APPO, offline =
+BC/CQL/MARWIL, continuous max-entropy = SAC, multi-agent = MultiAgentPPO).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.ppo import init_mlp, mlp_apply
+from ray_tpu.tune.trainable import Trainable
+
+
+# ---------------------------------------------------------------- model ----
+
+def _init_gru(key, in_size: int, det: int):
+    k1, k2 = jax.random.split(key)
+    s = np.sqrt(1.0 / max(in_size, 1))
+    return {
+        "wx": jax.random.normal(k1, (in_size, 3 * det)) * s,
+        "wh": jax.random.normal(k2, (det, 3 * det)) * np.sqrt(1.0 / det),
+        "b": jnp.zeros((3 * det,)),
+    }
+
+
+def _gru(p, h, x):
+    xg = x @ p["wx"] + p["b"]
+    hg = h @ p["wh"]
+    xr, xu, xc = jnp.split(xg, 3, axis=-1)
+    hr, hu, hc = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    u = jax.nn.sigmoid(xu + hu)
+    cand = jnp.tanh(xc + r * hc)
+    return u * h + (1 - u) * cand
+
+
+def _dist(params, x):
+    out = mlp_apply(params, x)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(log_std, -5.0, 2.0)
+
+
+def _sample(mean, log_std, key):
+    return mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+
+
+def _kl(mq, lq, mp, lp):
+    """KL(N(mq,lq) ‖ N(mp,lp)) per-dim, summed."""
+    vq, vp = jnp.exp(2 * lq), jnp.exp(2 * lp)
+    return 0.5 * ((vq + (mq - mp) ** 2) / vp - 1.0 + 2 * (lp - lq)).sum(-1)
+
+
+def init_world_model(key, obs: int, acts: int, det: int, latent: int,
+                     hidden: int):
+    ks = jax.random.split(key, 9)
+    feat = det + latent
+    return {
+        "enc": init_mlp(ks[0], [obs, hidden, hidden], scale_last=0.3),
+        "gru": _init_gru(ks[1], latent + acts, det),
+        "prior": init_mlp(ks[2], [det, hidden, 2 * latent], scale_last=0.1),
+        "post": init_mlp(ks[3], [det + hidden, hidden, 2 * latent],
+                         scale_last=0.1),
+        "dec": init_mlp(ks[4], [feat, hidden, obs], scale_last=0.3),
+        "rew": init_mlp(ks[5], [feat, hidden, 1], scale_last=0.3),
+        "cont": init_mlp(ks[6], [feat, hidden, 1], scale_last=0.3),
+        "actor": init_mlp(ks[7], [feat, hidden, acts]),
+        "critic": init_mlp(ks[8], [feat, hidden, 1], scale_last=0.3),
+    }
+
+
+def _obs_step(p, h, z, action_1h, obs, is_first, key):
+    """One posterior step: resets at is_first, GRU advance, posterior z."""
+    mask = (1.0 - is_first)[..., None]
+    h, z = h * mask, z * mask
+    h = _gru(p["gru"], h, jnp.concatenate([z, action_1h * mask], -1))
+    e = mlp_apply(p["enc"], obs)
+    mq, lq = _dist(p["post"], jnp.concatenate([h, e], -1))
+    z = _sample(mq, lq, key)
+    return h, z, (mq, lq)
+
+
+def _img_step(p, h, z, action_1h, key, mean_latent: bool = True):
+    """One prior (imagination) step. mean_latent=True rolls the MODE of
+    the prior — on near-deterministic control tasks sampled latent noise
+    swamps the action's effect on the trajectory and the actor's
+    advantage signal drowns (the same reason DreamerV3 keeps latent
+    stochasticity small via free bits)."""
+    h = _gru(p["gru"], h, jnp.concatenate([z, action_1h], -1))
+    mp, lp = _dist(p["prior"], h)
+    return h, (mp if mean_latent else _sample(mp, lp, key))
+
+
+# ---------------------------------------------------------------- loss -----
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def dreamer_update(optimizer, cfg_static, num_actions, params, opt_state,
+                   batch, rew_bounds, key):
+    """One gradient step: world-model losses over [B, T] sequences, then
+    actor/critic on imagined rollouts from the posterior states."""
+    horizon, gamma, lam, free_bits, ent_coef = cfg_static
+
+    def loss_fn(p):
+        obs = batch["obs"]          # [B, T, O]
+        acts = jax.nn.one_hot(batch["actions"], num_actions)  # [B, T, A]
+        B, T = obs.shape[:2]
+        det = p["gru"]["wh"].shape[0]
+        latent = p["prior"][-1]["b"].shape[0] // 2
+        k_seq, k_img, k_pol = jax.random.split(key, 3)
+
+        def wm_step(carry, inp):
+            h, z = carry
+            o_t, a_prev, first_t, k = inp
+            h, z, (mq, lq) = _obs_step(p, h, z, a_prev, o_t, first_t, k)
+            mp, lp = _dist(p["prior"], h)
+            feat = jnp.concatenate([h, z], -1)
+            return (h, z), (feat, mq, lq, mp, lp)
+
+        h0 = jnp.zeros((B, det))
+        z0 = jnp.zeros((B, latent))
+        a_prev = jnp.concatenate(
+            [jnp.zeros_like(acts[:, :1]), acts[:, :-1]], 1)
+        keys = jax.random.split(k_seq, T)
+        (_, _), (feats, mq, lq, mp, lp) = jax.lax.scan(
+            wm_step, (h0, z0),
+            (obs.transpose(1, 0, 2), a_prev.transpose(1, 0, 2),
+             batch["is_first"].T, keys))
+        feats = feats.transpose(1, 0, 2)      # [B, T, F]
+        tr = lambda x: x.transpose(1, 0, 2)   # noqa: E731
+
+        recon = mlp_apply(p["dec"], feats)
+        obs_loss = ((recon - obs) ** 2).mean()
+        rew_pred = mlp_apply(p["rew"], feats)[..., 0]
+        rew_loss = ((rew_pred - batch["rewards"]) ** 2).mean()
+        cont_logit = mlp_apply(p["cont"], feats)[..., 0]
+        cont_target = 1.0 - batch["dones"]
+        cont_loss = optax.sigmoid_binary_cross_entropy(
+            cont_logit, cont_target).mean()
+        # KL balancing (DreamerV3): train the prior toward the posterior
+        # harder than the posterior toward the prior, with free bits.
+        kl_pq = _kl(tr(mq), tr(lq), jax.lax.stop_gradient(tr(mp)),
+                    jax.lax.stop_gradient(tr(lp))).mean()
+        kl_prior = _kl(jax.lax.stop_gradient(tr(mq)),
+                       jax.lax.stop_gradient(tr(lq)), tr(mp),
+                       tr(lp)).mean()
+        kl_loss = (0.1 * jnp.maximum(kl_pq, free_bits)
+                   + 0.5 * jnp.maximum(kl_prior, free_bits))
+        wm_loss = obs_loss + rew_loss + cont_loss + kl_loss
+
+        # ---- imagination: actor/critic on dreamed latents --------------
+        # The WORLD MODEL IS FROZEN here (p_sg): the behavior losses must
+        # not backprop into the dynamics/reward/continue heads, or the
+        # model warps toward states that flatter the actor instead of
+        # modelling the environment (DreamerV3 trains the model and the
+        # behavior strictly separately).
+        p_sg = jax.tree.map(jax.lax.stop_gradient, p)
+        start = jax.lax.stop_gradient(
+            feats.reshape(B * T, -1))
+        h_i = start[:, :det]
+        z_i = start[:, det:]
+
+        def img(carry, k):
+            h, z = carry
+            feat = jnp.concatenate([h, z], -1)
+            logits = mlp_apply(p["actor"], feat)
+            ka, kz = jax.random.split(k)
+            a = jax.random.categorical(ka, logits)
+            logp = jax.nn.log_softmax(logits)
+            probs = jnp.exp(logp)
+            # Straight-through one-hot: the sampled action forward, the
+            # policy probabilities backward — actor gradients flow THROUGH
+            # the frozen model dynamics to the λ-returns (DreamerV1's
+            # dynamics-backprop estimator; far lower variance than
+            # REINFORCE on near-deterministic control).
+            a1h = jax.nn.one_hot(a, num_actions)
+            a1h = a1h + probs - jax.lax.stop_gradient(probs)
+            ent = -(probs * logp).sum(-1)
+            h2, z2 = _img_step(p_sg, h, z, a1h, kz)
+            feat2 = jnp.concatenate([h2, z2], -1)
+            # Clip imagined rewards to the range actually observed — an
+            # unbounded regression head extrapolates optimistically in
+            # out-of-distribution latents and the actor farms the error
+            # (the role DreamerV3's return normalization plays).
+            r = jnp.clip(mlp_apply(p_sg["rew"], feat2)[..., 0],
+                         rew_bounds[0], rew_bounds[1])
+            c = jax.nn.sigmoid(mlp_apply(p_sg["cont"], feat2)[..., 0])
+            v = mlp_apply(p_sg["critic"], feat2)[..., 0]
+            return (h2, z2), (feat, ent, r, c, v)
+
+        (_, _), (ifeat, ent, rews, conts, vals) = jax.lax.scan(
+            img, (h_i, z_i), jax.random.split(k_img, horizon))
+
+        # λ-returns over the imagined trajectory (bootstrapped, masked by
+        # the continue head). Differentiable w.r.t. the ACTIONS (through
+        # the frozen dynamics) — this is the actor's objective.
+        disc = gamma * conts
+        last = vals[-1]
+
+        def lam_ret(nxt, t):
+            ret = rews[t] + disc[t] * ((1 - lam) * vals[t] + lam * nxt)
+            return ret, ret
+
+        _, rets = jax.lax.scan(lam_ret, last,
+                               jnp.arange(horizon - 1, -1, -1))
+        rets = rets[::-1]                     # [H, N]
+        v_pred = mlp_apply(p["critic"],
+                           jax.lax.stop_gradient(ifeat))[..., 0]
+        critic_loss = ((v_pred - jax.lax.stop_gradient(rets)) ** 2).mean()
+        actor_loss = -(rets + ent_coef * ent).mean()
+
+        total = wm_loss + critic_loss + actor_loss
+        metrics = {"wm_loss": wm_loss, "obs_loss": obs_loss,
+                   "rew_loss": rew_loss, "kl": kl_pq,
+                   "critic_loss": critic_loss, "actor_loss": actor_loss,
+                   "imag_return": rets[0].mean()}
+        return total, metrics
+
+    (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, metrics
+
+
+@partial(jax.jit, static_argnums=(0,))
+def act_step(num_actions, params, h, z, a_prev, obs, is_first, key,
+             greedy):
+    """Policy step in the real env: EXACTLY the training scan's
+    _obs_step (mask → GRU advance with the previous action → posterior)
+    followed by an actor sample — any divergence between the acting
+    filter and the training filter is train/act distribution shift."""
+    ka, kz = jax.random.split(key)
+    a1h = jax.nn.one_hot(a_prev, num_actions)
+    h, z, _ = _obs_step(params, h, z, a1h, obs, is_first, kz)
+    logits = mlp_apply(params["actor"], jnp.concatenate([h, z], -1))
+    a = jnp.where(greedy, logits.argmax(-1),
+                  jax.random.categorical(ka, logits))
+    return a, h, z
+
+
+# ------------------------------------------------------------ trainable ----
+
+@dataclass
+class DreamerConfig:
+    env: str = "CartPole-v1"
+    num_envs: int = 8
+    seq_len: int = 16
+    batch_seqs: int = 16
+    horizon: int = 10
+    det: int = 64
+    latent: int = 16
+    hidden: int = 64
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    free_bits: float = 1.0
+    ent_coef: float = 1e-2
+    buffer_size: int = 50_000
+    env_steps_per_iter: int = 500
+    train_steps_per_iter: int = 40
+    learning_starts: int = 1000
+    seed: int = 0
+    extra: dict = field(default_factory=dict)
+
+    def build(self) -> "Dreamer":
+        return Dreamer({"dreamer_config": self})
+
+
+class Dreamer(Trainable):
+    """World-model RL driven inline (the recurrent filter state rides the
+    trainable; reference: dreamerv3.py training_step — sample, train WM,
+    imagine, train actor/critic)."""
+
+    def setup(self, config: dict) -> None:
+        cfg = config.get("dreamer_config") or DreamerConfig(
+            **{k: v for k, v in config.items()
+               if k in DreamerConfig.__dataclass_fields__})
+        self.cfg = cfg
+        self.envs = [make_env(cfg.env, seed=cfg.seed + i)
+                     for i in range(cfg.num_envs)]
+        probe = self.envs[0]
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        key = jax.random.PRNGKey(cfg.seed)
+        key, km = jax.random.split(key)
+        self.params = init_world_model(km, self.obs_size, self.num_actions,
+                                       cfg.det, cfg.latent, cfg.hidden)
+        self.optimizer = optax.chain(optax.clip_by_global_norm(100.0),
+                                     optax.adam(cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self.key = key
+        # Transition ring buffer (windows sampled across episode
+        # boundaries; is_first resets the filter inside the scan).
+        n = cfg.buffer_size
+        self._obs = np.zeros((n, self.obs_size), np.float32)
+        self._act = np.zeros((n,), np.int32)
+        self._rew = np.zeros((n,), np.float32)
+        self._done = np.zeros((n,), np.float32)
+        self._first = np.zeros((n,), np.float32)
+        self._idx = 0
+        self._full = False
+        self._np_rng = np.random.default_rng(cfg.seed + 97)
+        # live env state
+        # Running observation normalization (DreamerV3 uses symlog; for
+        # low-dim control the per-dimension scale spread is the issue —
+        # e.g. pole angle +-0.2 vs cart position +-2.4 — and an
+        # unnormalized MSE decoder underweights exactly the dimensions
+        # that decide termination).
+        self._obs_count = 1e-4
+        self._obs_mean = np.zeros((self.obs_size,), np.float64)
+        self._obs_m2 = np.ones((self.obs_size,), np.float64)
+        self._o = np.stack([e.reset() for e in self.envs])
+        self._h = jnp.zeros((cfg.num_envs, cfg.det))
+        self._z = jnp.zeros((cfg.num_envs, cfg.latent))
+        self._a_prev = np.zeros((cfg.num_envs,), np.int32)
+        self._is_first = np.ones((cfg.num_envs,), np.float32)
+        self._rew_lo, self._rew_hi = 0.0, 0.0
+        self._ep_ret = np.zeros((cfg.num_envs,))
+        self._ep_returns: list[float] = []
+        self.total_env_steps = 0
+
+    def _norm(self, o):
+        std = np.sqrt(self._obs_m2 / self._obs_count) + 1e-3
+        return ((o - self._obs_mean) / std).astype(np.float32)
+
+    def _track_obs(self, o):
+        self._obs_count += 1
+        d = o - self._obs_mean
+        self._obs_mean += d / self._obs_count
+        self._obs_m2 += d * (o - self._obs_mean)
+
+    # -- experience --------------------------------------------------------
+    def _push(self, o, a, r, d, first):
+        i = self._idx
+        self._track_obs(o)
+        self._obs[i], self._act[i] = o, a
+        self._rew[i], self._done[i], self._first[i] = r, d, first
+        self._idx = (i + 1) % self.cfg.buffer_size
+        self._full = self._full or self._idx == 0
+
+    def _collect(self, n_steps: int) -> None:
+        cfg = self.cfg
+        for _ in range(n_steps // cfg.num_envs):
+            self.key, k = jax.random.split(self.key)
+            a, self._h, self._z = act_step(
+                self.num_actions, self.params, self._h, self._z,
+                jnp.asarray(self._a_prev), jnp.asarray(self._norm(self._o)),
+                jnp.asarray(self._is_first), k, jnp.asarray(False))
+            a_np = np.asarray(a)
+            firsts = self._is_first.copy()
+            obs_before = self._o.copy()
+            for i, env in enumerate(self.envs):
+                o2, r, term, trunc = env.step(int(a_np[i]))
+                # The continue head models TERMINATION only — truncation
+                # is a horizon artifact, not environment death (DreamerV3
+                # distinguishes is_last from terminated the same way).
+                self._push(obs_before[i], int(a_np[i]), r, float(term),
+                           firsts[i])
+                self._rew_lo = min(self._rew_lo, float(r))
+                self._rew_hi = max(self._rew_hi, float(r))
+                self._ep_ret[i] += r
+                done = term or trunc
+                if done:
+                    o2 = env.reset()
+                    self._ep_returns.append(float(self._ep_ret[i]))
+                    self._ep_ret[i] = 0.0
+                    self._is_first[i] = 1.0
+                else:
+                    self._is_first[i] = 0.0
+                self._o[i] = o2
+            self._a_prev = a_np
+            self.total_env_steps += cfg.num_envs
+
+    def _sample_batch(self):
+        cfg = self.cfg
+        B = cfg.buffer_size
+        if self._full:
+            # Windows must not straddle the ring's write seam at _idx —
+            # that would splice the newest transitions onto ~buffer-old
+            # ones with no is_first reset at the junction. Offsets from
+            # _idx cover every valid start; modulo handles the wrap.
+            r = self._np_rng.integers(0, B - cfg.seq_len,
+                                      size=(cfg.batch_seqs,))
+            starts = (self._idx + r) % B
+        else:
+            hi = self._idx - cfg.seq_len
+            starts = self._np_rng.integers(0, max(1, hi),
+                                           size=(cfg.batch_seqs,))
+        idx = (starts[:, None] + np.arange(cfg.seq_len)[None, :]) % B
+        batch = {
+            "obs": jnp.asarray(self._norm(self._obs[idx])),
+            "actions": jnp.asarray(self._act[idx]),
+            "rewards": jnp.asarray(self._rew[idx]),
+            "dones": jnp.asarray(self._done[idx]),
+            "is_first": jnp.asarray(self._first[idx]),
+        }
+        return batch
+
+    # -- Trainable ---------------------------------------------------------
+    def step(self) -> dict:
+        cfg = self.cfg
+        self._collect(cfg.env_steps_per_iter)
+        metrics = {}
+        if self.total_env_steps >= cfg.learning_starts:
+            static = (cfg.horizon, cfg.gamma, cfg.lam, cfg.free_bits,
+                      cfg.ent_coef)
+            bounds = jnp.asarray([self._rew_lo, self._rew_hi])
+            for _ in range(cfg.train_steps_per_iter):
+                self.key, k = jax.random.split(self.key)
+                self.params, self.opt_state, metrics = dreamer_update(
+                    self.optimizer, static, self.num_actions, self.params,
+                    self.opt_state, self._sample_batch(), bounds, k)
+            metrics = {k_: float(v) for k_, v in metrics.items()}
+        recent = self._ep_returns[-20:]
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "env_steps": self.total_env_steps,
+            "episode_return_mean": (float(np.mean(recent))
+                                    if recent else 0.0),
+            **metrics,
+        }
+
+    def save_checkpoint(self):
+        return {"params": self.params, "opt_state": self.opt_state,
+                "iteration": self.iteration}
+
+    def load_checkpoint(self, ckpt) -> None:
+        self.params = ckpt["params"]
+        self.opt_state = ckpt["opt_state"]
+        self.iteration = ckpt["iteration"]

@@ -1,0 +1,150 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning.
+
+Capability parity with the reference's hybrid offline/imitation algorithm
+(reference: rllib/algorithms/marwil/marwil.py — behavior cloning weighted
+by exponentiated advantages: a critic regresses observed returns, and the
+policy's log-likelihood loss is scaled by exp(beta * advantage), so
+better-than-average actions in the dataset are imitated harder; beta=0
+degrades to plain BC). Offline data rides the same ray_tpu.data Dataset
+("obs", "actions", "returns" columns) the BC/CQL trainables use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.ppo import init_mlp, mlp_apply
+from ray_tpu.tune.trainable import Trainable
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def marwil_update(optimizer, beta, params, opt_state, ma_adv_norm, obs,
+                  actions, returns):
+    """One step: critic toward observed returns; policy NLL weighted by
+    exp(beta * advantage / sqrt(moving_avg(adv^2))) (reference:
+    marwil_torch_policy loss)."""
+
+    def loss_fn(p):
+        v = mlp_apply(p["vf"], obs)[..., 0]
+        adv = returns - v
+        critic_loss = (adv**2).mean()
+        logits = mlp_apply(p["pi"], obs)
+        logp = jax.nn.log_softmax(logits)
+        lp_a = jnp.take_along_axis(logp, actions[:, None], 1)[:, 0]
+        if beta == 0.0:
+            w = jnp.ones_like(lp_a)  # plain behavior cloning
+        else:
+            w = jnp.exp(beta * jax.lax.stop_gradient(adv)
+                        / jnp.maximum(jnp.sqrt(ma_adv_norm), 1e-3))
+            w = jnp.clip(w, 0.0, 20.0)  # bound exploding weights
+        policy_loss = -(w * lp_a).mean()
+        return policy_loss + 0.5 * critic_loss, (critic_loss, adv)
+
+    (loss, (critic_loss, adv)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    # EMA of squared advantages normalizes the exponent's scale
+    # (reference: marwil moving_average_sqd_adv_norm).
+    ma_adv_norm = 0.99 * ma_adv_norm + 0.01 * (adv**2).mean()
+    return params, opt_state, ma_adv_norm, loss, critic_loss
+
+
+@dataclass
+class MARWILConfig:
+    env: str = "CartPole-v1"            # spaces + evaluation
+    dataset: Any = None                 # "obs", "actions", "returns"
+    beta: float = 1.0                   # 0 => plain BC
+    lr: float = 1e-3
+    batch_size: int = 256
+    epochs_per_step: int = 1
+    hidden: int = 64
+    evaluation_episodes: int = 0
+    seed: int = 0
+    extra: dict = field(default_factory=dict)
+
+    def build(self) -> "MARWIL":
+        return MARWIL({"marwil_config": self})
+
+
+class MARWIL(Trainable):
+    def setup(self, config: dict) -> None:
+        cfg = config.get("marwil_config") or MARWILConfig(
+            **{k: v for k, v in config.items()
+               if k in MARWILConfig.__dataclass_fields__})
+        if cfg.dataset is None:
+            raise ValueError("MARWIL requires an offline dataset with "
+                             "'obs', 'actions' and 'returns' columns")
+        self.cfg = cfg
+        probe = make_env(cfg.env, seed=cfg.seed)
+        key = jax.random.PRNGKey(cfg.seed)
+        kp, kv = jax.random.split(key)
+        self.params = {
+            "pi": init_mlp(kp, [probe.observation_size, cfg.hidden,
+                                cfg.hidden, probe.num_actions]),
+            "vf": init_mlp(kv, [probe.observation_size, cfg.hidden,
+                                cfg.hidden, 1], scale_last=1.0),
+        }
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.ma_adv_norm = jnp.asarray(1.0)
+        self._eval_env = make_env(cfg.env, seed=cfg.seed + 1)
+
+    def step(self) -> dict:
+        cfg = self.cfg
+        loss = critic_loss = 0.0
+        n_batches = 0
+        for _ in range(cfg.epochs_per_step):
+            for batch in cfg.dataset.iter_batches(
+                    batch_size=cfg.batch_size):
+                obs = jnp.asarray(np.asarray(batch["obs"], np.float32))
+                acts = jnp.asarray(np.asarray(batch["actions"], np.int32))
+                rets = jnp.asarray(np.asarray(batch["returns"],
+                                              np.float32))
+                (self.params, self.opt_state, self.ma_adv_norm, loss,
+                 critic_loss) = marwil_update(
+                    self.optimizer, cfg.beta, self.params, self.opt_state,
+                    self.ma_adv_norm, obs, acts, rets)
+                n_batches += 1
+        out = {"training_iteration": self.iteration + 1,
+               "num_batches": n_batches,
+               "policy_loss": float(loss),
+               "critic_loss": float(critic_loss)}
+        if cfg.evaluation_episodes:
+            out["episode_return_mean"] = self.evaluate(
+                cfg.evaluation_episodes)
+        self.iteration += 1
+        return out
+
+    def evaluate(self, episodes: int) -> float:
+        total = 0.0
+        for _ in range(episodes):
+            o = self._eval_env.reset()
+            done = False
+            while not done:
+                logits = mlp_apply(self.params["pi"],
+                                   jnp.asarray(o, jnp.float32))
+                o, r, term, trunc = self._eval_env.step(
+                    int(np.asarray(logits).argmax()))
+                done = term or trunc
+                total += r
+        return total / episodes
+
+    def save_checkpoint(self):
+        return {"params": self.params, "opt_state": self.opt_state,
+                "ma_adv_norm": self.ma_adv_norm,
+                "iteration": self.iteration}
+
+    def load_checkpoint(self, ckpt) -> None:
+        self.params = ckpt["params"]
+        self.opt_state = ckpt["opt_state"]
+        self.ma_adv_norm = ckpt["ma_adv_norm"]
+        self.iteration = ckpt["iteration"]

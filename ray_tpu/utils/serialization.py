@@ -145,9 +145,8 @@ def deserialize(data) -> Any:
                 # releases on GC.
                 arr = np.frombuffer(_PinnedSlice(pin, body),
                                     dtype=np.dtype(dtype_str)).reshape(shape)
-                arr.flags.writeable = False
                 pin = None  # ownership moved to the array's base
-                return arr
+                return arr  # read-only: the exported buffer is readonly
             arr = np.frombuffer(body, dtype=np.dtype(dtype_str)).reshape(
                 shape)
             return arr.copy()  # writable
@@ -178,7 +177,9 @@ class _PinnedSlice:
         self._body = body
 
     def __buffer__(self, flags):  # PEP 688
-        return memoryview(self._body)
+        # READ-ONLY: a writable export would let callers flip the array's
+        # writeable flag back on and mutate the sealed arena object.
+        return memoryview(self._body).toreadonly()
 
 
 def dumps_function(fn) -> bytes:
