@@ -406,6 +406,13 @@ def main():
     variants = {
         "prod": lambda q_, k_, v_: _flash_fwd_pallas(
             q_, k_, v_, True, scale),
+        # Larger/smaller square blocks through the SHIPPED kernel — the
+        # r5 96 MB scoped-vmem raise may admit shapes the 16 MB default
+        # rejected.
+        "prod_bq1024": lambda q_, k_, v_: _flash_fwd_pallas(
+            q_, k_, v_, True, scale, block_q=1024),
+        "prod_bq256": lambda q_, k_, v_: _flash_fwd_pallas(
+            q_, k_, v_, True, scale, block_q=256),
         "pack2": lambda q_, k_, v_: packed_fwd(q_, k_, v_, True, scale, 2),
         "pack4": lambda q_, k_, v_: packed_fwd(q_, k_, v_, True, scale, 4),
         "pack2_bk1024": lambda q_, k_, v_: packed_fwd(
