@@ -13,6 +13,15 @@ from ray_tpu.rl.env import CartPoleEnv, PendulumEnv, VectorEnv, make_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.appo import APPO, APPOConfig
 from ray_tpu.rl.bc import BC, BCConfig
+from ray_tpu.rl.connectors import (
+    ClipActions,
+    ClipObservations,
+    Connector,
+    ConnectorPipeline,
+    FrameStack,
+    NormalizeObservations,
+    UnsquashActions,
+)
 from ray_tpu.rl.cql import CQL, CQLConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.dreamer import Dreamer, DreamerConfig
@@ -42,6 +51,8 @@ __all__ = [
     "MultiAgentEnv", "MultiAgentEnvRunner", "CoordinationGame", "ChaseGame",
     "MultiAgentPPO", "MultiAgentPPOConfig",
     "BC", "BCConfig",
+    "Connector", "ConnectorPipeline", "NormalizeObservations",
+    "FrameStack", "ClipObservations", "ClipActions", "UnsquashActions",
     "MARWIL", "MARWILConfig",
     "Dreamer", "DreamerConfig",
     "ReplayBuffer", "PrioritizedReplayBuffer",

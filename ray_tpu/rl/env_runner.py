@@ -16,11 +16,24 @@ from typing import Any, Callable
 import numpy as np
 
 
+def _frozen_apply(pipeline, x):
+    """Apply a pipeline without updating stateful connectors."""
+    if hasattr(pipeline, "frozen_apply"):
+        return pipeline.frozen_apply(x)
+    prior = getattr(pipeline, "frozen", False)
+    pipeline.frozen = True
+    try:
+        return pipeline(x)
+    finally:
+        pipeline.frozen = prior
+
+
 class EnvRunner:
     """One runner = N vectorized envs + a policy-apply function."""
 
     def __init__(self, env_name: str, num_envs: int, rollout_len: int,
-                 policy_factory: Callable, seed: int = 0):
+                 policy_factory: Callable, seed: int = 0,
+                 env_to_module=None, module_to_env=None):
         from ray_tpu.rl.env import VectorEnv
 
         self.vec = VectorEnv(env_name, num_envs, seed=seed)
@@ -28,7 +41,16 @@ class EnvRunner:
         # policy_factory() -> (act_fn, initial_params); act_fn(params, obs,
         # rng_seed) -> (actions, logp, value) as numpy.
         self.act_fn, self.params = policy_factory()
-        self.obs = self.vec.reset()
+        # Connector pipelines (reference: rllib/connectors/): observations
+        # flow through env_to_module before the policy; actions flow
+        # through module_to_env before the environment. Batches store the
+        # TRANSFORMED obs (what the model consumed) and the MODEL-space
+        # actions, so the learner trains in the model's space.
+        self.env_to_module = env_to_module
+        self.module_to_env = module_to_env
+        raw = self.vec.reset()
+        self.obs = (self.env_to_module(raw) if self.env_to_module
+                    else raw)
         self._seed = seed
         self._step = 0
 
@@ -48,6 +70,7 @@ class EnvRunner:
         done_b = np.zeros((T, N), np.bool_)
         term_b = np.zeros((T, N), np.bool_)
         next_obs_b = np.zeros((T, N, self.obs.shape[-1]), np.float32)
+
         for t in range(T):
             self._step += 1
             actions, logp, value = self.act_fn(self.params, self.obs,
@@ -57,9 +80,22 @@ class EnvRunner:
                                  np.asarray(actions).dtype)
             obs_b[t] = self.obs
             act_b[t], logp_b[t], val_b[t] = actions, logp, value
-            self.obs, rew_b[t], done_b[t] = self.vec.step(actions)
+            env_actions = (self.module_to_env(actions)
+                           if self.module_to_env else actions)
+            raw_obs, rew_b[t], done_b[t] = self.vec.step(env_actions)
             term_b[t] = self.vec.last_terminals
-            next_obs_b[t] = self.vec.last_final_obs  # pre-reset successors
+            raw_next = self.vec.last_final_obs  # pre-reset successors
+            if self.env_to_module is not None:
+                # next_obs passes through the pipeline WITHOUT mutating
+                # stateful connectors (it is a bootstrap input, not a
+                # policy step); episode boundaries reset per-env state.
+                next_obs_b[t] = _frozen_apply(self.env_to_module, raw_next)
+                for i in np.nonzero(done_b[t])[0]:
+                    self.env_to_module.reset(int(i))
+                self.obs = self.env_to_module(raw_obs)
+            else:
+                next_obs_b[t] = raw_next
+                self.obs = raw_obs
         _, _, last_value = self.act_fn(self.params, self.obs,
                                        self._seed * 100_003 + self._step + 1)
         return {
@@ -73,6 +109,20 @@ class EnvRunner:
     def ping(self) -> bool:
         return True
 
+    def connector_state(self) -> dict:
+        out = {}
+        if self.env_to_module is not None:
+            out["env_to_module"] = self.env_to_module.state_dict()
+        if self.module_to_env is not None:
+            out["module_to_env"] = self.module_to_env.state_dict()
+        return out
+
+    def set_connector_state(self, state: dict) -> None:
+        if self.env_to_module is not None and "env_to_module" in state:
+            self.env_to_module.set_state(state["env_to_module"])
+        if self.module_to_env is not None and "module_to_env" in state:
+            self.module_to_env.set_state(state["module_to_env"])
+
 
 class EnvRunnerGroup:
     """Fan-out sampling over runner actors; num_runners=0 runs inline
@@ -80,14 +130,21 @@ class EnvRunnerGroup:
 
     def __init__(self, env_name: str, *, num_runners: int = 0,
                  num_envs_per_runner: int = 8, rollout_len: int = 64,
-                 policy_factory: Callable, seed: int = 0):
+                 policy_factory: Callable, seed: int = 0,
+                 connector_factory: Callable | None = None):
+        """connector_factory() -> (env_to_module, module_to_env) pipelines,
+        built PER RUNNER (stateful connectors are runner-local)."""
         self._args = (env_name, num_envs_per_runner, rollout_len,
                       policy_factory)
+        self._connector_factory = connector_factory
         self._seed = seed
         self.num_runners = num_runners
         if num_runners == 0:
+            e2m, m2e = (connector_factory() if connector_factory
+                        else (None, None))
             self._local = EnvRunner(env_name, num_envs_per_runner,
-                                    rollout_len, policy_factory, seed=seed)
+                                    rollout_len, policy_factory, seed=seed,
+                                    env_to_module=e2m, module_to_env=m2e)
             self.actors = []
         else:
             self._local = None
@@ -97,8 +154,11 @@ class EnvRunnerGroup:
         import ray_tpu
 
         RunnerActor = ray_tpu.remote(EnvRunner)
+        e2m, m2e = (self._connector_factory()
+                    if self._connector_factory else (None, None))
         return RunnerActor.options(num_cpus=0).remote(
-            *self._args, seed=self._seed + idx * 1000)
+            *self._args, seed=self._seed + idx * 1000,
+            env_to_module=e2m, module_to_env=m2e)
 
     def sample(self, params) -> list[dict]:
         import ray_tpu
@@ -125,6 +185,34 @@ class EnvRunnerGroup:
         for i in dead:
             self.actors[i] = self._spawn(i + self._seed + 17)
         return out
+
+    def connector_state(self) -> dict:
+        """Rank-0 runner's connector state (checkpointing)."""
+        if self._local is not None:
+            return self._local.connector_state()
+        import ray_tpu
+
+        for a in self.actors:
+            try:
+                return ray_tpu.get(a.connector_state.remote(), timeout=60)
+            except ray_tpu.ActorDiedError:
+                continue
+        return {}
+
+    def set_connector_state(self, state: dict) -> None:
+        if not state:
+            return
+        if self._local is not None:
+            self._local.set_connector_state(state)
+            return
+        import ray_tpu
+
+        for a in self.actors:
+            try:
+                ray_tpu.get(a.set_connector_state.remote(state),
+                            timeout=60)
+            except ray_tpu.ActorDiedError:
+                pass
 
     def shutdown(self) -> None:
         import ray_tpu

@@ -154,6 +154,9 @@ class PPOConfig:
     num_epochs: int = 4
     hidden: int = 64
     seed: int = 0
+    # () -> (env_to_module, module_to_env) connector pipelines, built per
+    # runner (reference: rllib/connectors/ — see rl/connectors.py).
+    connector_factory: Any = None
     extra: dict = field(default_factory=dict)
 
     def build(self) -> "PPO":
@@ -170,6 +173,10 @@ class PPO(Trainable):
         self.cfg = cfg
         probe = make_env(cfg.env, seed=cfg.seed)
         obs_size, num_actions = probe.observation_size, probe.num_actions
+        if cfg.connector_factory is not None:
+            # Frame stacking etc. widen the policy's observation input.
+            e2m_probe, _ = cfg.connector_factory()
+            obs_size *= getattr(e2m_probe, "output_multiplier", 1)
         self.params = init_policy(jax.random.PRNGKey(cfg.seed), obs_size,
                                   num_actions, cfg.hidden)
         self.optimizer = optax.adam(cfg.lr)
@@ -185,7 +192,7 @@ class PPO(Trainable):
             cfg.env, num_runners=cfg.num_env_runners,
             num_envs_per_runner=cfg.num_envs_per_runner,
             rollout_len=cfg.rollout_len, policy_factory=policy_factory,
-            seed=cfg.seed)
+            seed=cfg.seed, connector_factory=cfg.connector_factory)
         self._return_window: list[float] = []
 
     def step(self) -> dict:
@@ -223,11 +230,16 @@ class PPO(Trainable):
 
     def save_checkpoint(self) -> Any:
         return {"params": jax.tree.map(np.asarray, self.params),
-                "iteration": self.iteration}
+                "iteration": self.iteration,
+                # A policy trained behind a running normalizer is only
+                # meaningful WITH that normalizer's statistics.
+                "connector_state": self.runners.connector_state()}
 
     def load_checkpoint(self, checkpoint: Any) -> None:
         self.params = jax.tree.map(jnp.asarray, checkpoint["params"])
         self.iteration = checkpoint["iteration"]
+        self.runners.set_connector_state(
+            checkpoint.get("connector_state", {}))
 
     def cleanup(self) -> None:
         self.runners.shutdown()
