@@ -247,6 +247,9 @@ def main() -> None:
     candidates = [
         (4, "attn", "flash", "lowmem"),
         (4, "attn+", "flash", "lowmem"),  # + saved SwiGLU gate (llama.py)
+        (5, "attn", "flash", "lowmem"),   # r5: the odd-batch tiling penalty
+        # vanished with the packed flash kernels (14,977 -> 16,707 tok/s;
+        # head-pack grid rows b*h/4 are even for any b) — b5 now ties b4.
         (8, "attn", "flash", "lowmem"),
         (4, "dots", "flash", "lowmem"),   # round-2 winner shape + compact moments
         # Dropped (r04 chip-verified OOM at compile): b16/attn, b8/dots,
