@@ -98,39 +98,98 @@ def get_group(group_name: str = "default"):
 
 
 # -- op surface (matches reference call signatures) ------------------------
+# Every op goes through _timed(): per-op latency + payload-bytes histograms
+# labeled (op, group). Latency is dispatch-to-return — for the host backend
+# that is the full collective; XLA ops dispatch asynchronously, so their
+# number reads as issue latency, not ICI completion (XProf owns that).
+
+_op_metrics = None
+_op_metrics_lock = threading.Lock()
+
+
+def _get_op_metrics():
+    global _op_metrics
+    with _op_metrics_lock:
+        if _op_metrics is not None:
+            return _op_metrics
+        from ray_tpu.util.metrics import Histogram
+
+        _op_metrics = (
+            Histogram("collective_op_latency_s",
+                      "collective op wall time (dispatch to return)",
+                      tag_keys=("op", "group")),
+            Histogram("collective_op_bytes",
+                      "collective op payload size in bytes",
+                      boundaries=[1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9],
+                      tag_keys=("op", "group")),
+        )
+    return _op_metrics
+
+
+def _timed(op: str, group_name: str, tensor, fn):
+    import time
+
+    t0 = time.perf_counter()
+    try:
+        return fn()
+    finally:
+        try:
+            lat, size = _get_op_metrics()
+            tags = {"op": op, "group": group_name}
+            lat.observe(time.perf_counter() - t0, tags=tags)
+            nbytes = getattr(tensor, "nbytes", None)
+            if nbytes:
+                size.observe(float(nbytes), tags=tags)
+        except Exception:
+            pass  # metrics must never fail a collective
 
 
 def allreduce(tensor, group_name: str = "default", op: str = "sum"):
-    return _manager.get(group_name).allreduce(tensor, op=op)
+    return _timed("allreduce", group_name, tensor,
+                  lambda: _manager.get(group_name).allreduce(tensor, op=op))
 
 
 def allgather(tensor, group_name: str = "default"):
-    return _manager.get(group_name).allgather(tensor)
+    return _timed("allgather", group_name, tensor,
+                  lambda: _manager.get(group_name).allgather(tensor))
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
-    return _manager.get(group_name).reducescatter(tensor, op=op)
+    return _timed(
+        "reducescatter", group_name, tensor,
+        lambda: _manager.get(group_name).reducescatter(tensor, op=op))
 
 
 def alltoall(tensor, group_name: str = "default"):
-    return _manager.get(group_name).alltoall(tensor)
+    return _timed("alltoall", group_name, tensor,
+                  lambda: _manager.get(group_name).alltoall(tensor))
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return _manager.get(group_name).broadcast(tensor, src_rank=src_rank)
+    return _timed(
+        "broadcast", group_name, tensor,
+        lambda: _manager.get(group_name).broadcast(tensor,
+                                                   src_rank=src_rank))
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: str = "sum"):
-    return _manager.get(group_name).reduce(tensor, dst_rank=dst_rank, op=op)
+    return _timed(
+        "reduce", group_name, tensor,
+        lambda: _manager.get(group_name).reduce(tensor, dst_rank=dst_rank,
+                                                op=op))
 
 
 def barrier(group_name: str = "default"):
-    return _manager.get(group_name).barrier()
+    return _timed("barrier", group_name, None,
+                  lambda: _manager.get(group_name).barrier())
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
-    return _manager.get(group_name).send(tensor, dst_rank)
+    return _timed("send", group_name, tensor,
+                  lambda: _manager.get(group_name).send(tensor, dst_rank))
 
 
 def recv(tensor_shape, dtype, src_rank: int, group_name: str = "default"):
-    return _manager.get(group_name).recv(tensor_shape, dtype, src_rank)
+    return _timed(
+        "recv", group_name, None,
+        lambda: _manager.get(group_name).recv(tensor_shape, dtype, src_rank))

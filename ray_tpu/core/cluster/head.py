@@ -104,6 +104,14 @@ class HeadServer:
         self.task_events: deque = deque(maxlen=100_000)
         self._task_events_total = 0  # monotone append count (cursor base)
         self._events_epoch = uuid.uuid4().hex  # head incarnation id
+        # Cluster telemetry (reference: the metrics agents pushing to the
+        # dashboard aggregator + GcsTaskManager's span-ish task attempts):
+        # per-source metric snapshots keyed by the reporter's stable source
+        # id (one per process), each tagged with its node; finished spans in
+        # a bounded ring. The dashboard renders /metrics from this table as
+        # a federated export with a node_id label per series.
+        self.telemetry: dict[str, dict] = {}  # source -> {node_id, ts, snapshot}
+        self.spans: deque = deque(maxlen=50_000)
         self._subs: dict[str, set[ServerConnection]] = {}  # channel -> conns
         self._node_conns: dict[str, ServerConnection] = {}
         self._register_handlers()
@@ -135,6 +143,9 @@ class HeadServer:
         r("state_snapshot", self._state_snapshot)
         r("report_task_events", self._report_task_events)
         r("get_task_events", self._get_task_events)
+        r("report_telemetry", self._report_telemetry)
+        r("get_telemetry", self._get_telemetry)
+        r("get_spans", self._get_spans)
         r("cluster_load", self._cluster_load)
         r("create_placement_group", self._create_pg)
         r("remove_placement_group", self._remove_pg)
@@ -883,6 +894,61 @@ class HeadServer:
         self.task_events.extend(events)
         self._task_events_total += len(events)
         return {"ok": True}
+
+    async def _report_telemetry(self, conn: ServerConnection,
+                                source: str, node_id: str = "",
+                                snapshot: dict | None = None,
+                                spans: list | None = None,
+                                events: list | None = None,
+                                dropped: int = 0):
+        """One batched push from a process's telemetry flusher: its metrics
+        snapshot (replaces the previous one for this source), finished
+        spans, and drained task events (reference: per-worker
+        TaskEventBuffer + metrics agent, federated at the GCS/dashboard).
+        ``dropped`` is the reporter's cumulative dropped-event count,
+        surfaced per source in the get_telemetry table."""
+        if snapshot is not None:
+            self.telemetry[source] = {
+                "node_id": node_id, "ts": time.time(),
+                "snapshot": snapshot, "dropped": int(dropped),
+            }
+            # Bounded: a churny cluster must not grow this forever. Evict
+            # DEAD sources first (silent past the liveness window — they've
+            # already fallen out of the export); only shed live reporters
+            # when the cap is still exceeded, stalest first.
+            if len(self.telemetry) > 512:
+                cutoff = time.time() - 60.0
+                for src, row in sorted(self.telemetry.items(),
+                                       key=lambda kv: kv[1]["ts"]):
+                    if len(self.telemetry) <= 512:
+                        break
+                    if row["ts"] < cutoff:
+                        self.telemetry.pop(src, None)
+                while len(self.telemetry) > 1024:  # hard cap: shed live rows
+                    src = min(self.telemetry,
+                              key=lambda s: self.telemetry[s]["ts"])
+                    self.telemetry.pop(src, None)
+        if spans:
+            self.spans.extend(spans)
+        if events:
+            self.task_events.extend(events)
+            self._task_events_total += len(events)
+        return {"ok": True}
+
+    async def _get_telemetry(self, conn: ServerConnection,
+                             max_age_s: float = 60.0):
+        """The per-node telemetry table: every live source's snapshot,
+        grouped by node. Sources silent for ``max_age_s`` are omitted
+        (dead workers must fall out of the export)."""
+        cutoff = time.time() - max_age_s
+        return {"sources": {
+            src: row for src, row in self.telemetry.items()
+            if row["ts"] >= cutoff
+        }}
+
+    async def _get_spans(self, conn: ServerConnection, limit: int = 50_000):
+        spans = list(self.spans)
+        return {"spans": spans[-limit:]}
 
     async def _state_snapshot(self, conn: ServerConnection):
         """Whole-cluster view for the state API (reference: the GCS tables

@@ -57,6 +57,10 @@ class _PendingLease:
     owner: str = ""
 
 
+# Which daemon flushes this process's telemetry (see _telemetry_loop).
+_process_telemetry_owner: str | None = None
+
+
 class NodeDaemon:
     # Consecutive container-worker boot failures per env before pending
     # leases for that env are failed with a diagnostic (instead of
@@ -233,11 +237,15 @@ class NodeDaemon:
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._reap_loop()))
         self._bg.append(loop.create_task(self._gossip_loop()))
+        self._bg.append(loop.create_task(self._telemetry_loop()))
         if get_config().memory_monitor_interval_s > 0:
             self._bg.append(loop.create_task(self._memory_watch_loop()))
         return addr
 
     async def stop(self):
+        global _process_telemetry_owner
+        if _process_telemetry_owner == self.node_id:
+            _process_telemetry_owner = None
         for t in self._bg:
             t.cancel()
         for cli in list(self._gossip_clients.values()):
@@ -359,6 +367,17 @@ class NodeDaemon:
                     # Worker process died.
                     self.workers.pop(wid, None)
                     if w.lease_id or w.actor_id:
+                        from ray_tpu.core import flight_recorder
+
+                        fate = self._worker_fates.get(w.worker_id) or {}
+                        flight_recorder.record(
+                            "worker_death",
+                            reason=(f"oom-killed rss={fate.get('rss', 0)}"
+                                    if fate.get("oom") else
+                                    f"exit code {w.proc.returncode}"),
+                            actor_id=w.actor_id or "",
+                            node_id=self.node_id,
+                            extra={"worker_id": w.worker_id})
                         self._release_resources(w.resources)
                         # Drop the lease record too: a later return_lease for
                         # it must not release the resources a second time.
@@ -509,6 +528,51 @@ class NodeDaemon:
                 pass
             # Give the kill a poll cycle to land before re-measuring.
             await asyncio.sleep(cfg.memory_monitor_interval_s)
+
+    async def _telemetry_loop(self):
+        """Push this daemon process's metric snapshot + spans + events to
+        the head (reference: the per-node metrics agent / dashboard agent).
+        The source key is (node, pid): when a driver shares this process
+        (local-cluster mode) its own flusher reports the same registry under
+        the same key, so the head overwrites instead of double-counting.
+        When SEVERAL daemons share one process (in-process test clusters)
+        only the first reports — the registry is process-wide, and the same
+        numbers under two node_ids would double-count cluster totals."""
+        global _process_telemetry_owner
+        if _process_telemetry_owner is None:
+            _process_telemetry_owner = self.node_id
+        if _process_telemetry_owner != self.node_id:
+            return
+        from ray_tpu.core.events import global_event_buffer
+        from ray_tpu.util import metrics, tracing
+
+        buf = global_event_buffer()
+        span_cursor = 0
+        source = f"{self.node_id}:{os.getpid()}"
+        last_snapshot: dict | None = None
+        last_sent = 0.0
+        while True:
+            period = get_config().telemetry_flush_interval_s
+            await asyncio.sleep(period if period > 0 else 0.5)
+            if period <= 0:
+                continue  # telemetry push disabled
+            try:
+                spans, span_cursor = tracing.flush_new(span_cursor)
+                events = buf.drain_dicts()
+                snapshot = metrics.registry().snapshot()
+                # Idle economy + keepalive (see the runtime flusher): skip
+                # unchanged pushes but stay inside the head's 60s window.
+                now = time.monotonic()
+                if not events and not spans and snapshot == last_snapshot \
+                        and now - last_sent < 20.0:
+                    continue
+                await self._head.call(
+                    "report_telemetry", source=source, node_id=self.node_id,
+                    snapshot=snapshot, spans=spans, events=events,
+                    dropped=buf.dropped, timeout=10)
+                last_snapshot, last_sent = snapshot, now
+            except Exception:
+                pass  # head unreachable: heartbeat loop handles reconnects
 
     async def _heartbeat_loop(self):
         cfg = get_config()

@@ -244,10 +244,20 @@ class ClusterRuntime:
                                             timeout=10).get("node_id", "")
             except Exception:
                 my_node = ""
+        self.my_node_id = my_node
         self.head.call("register_worker", worker_id=self.worker_id.hex(),
                        host=self.addr[0], port=self.addr[1],
                        node_id=my_node)
         self._reaper_task = self._io.spawn(self._lease_reaper())
+        # Telemetry flusher: EVERY cluster process (driver and worker alike)
+        # periodically pushes its metrics snapshot, new finished spans, and
+        # drained task events to the head in one batched RPC (reference:
+        # TaskEventBuffer flushing into GcsTaskManager + the metrics agent's
+        # push — never on the hot path, bounded batches, drop-oldest).
+        self._stop_flush = threading.Event()
+        self._span_cursor = 0
+        threading.Thread(target=self._telemetry_flusher, daemon=True,
+                         name="telemetry-flush").start()
         # Actor state invalidation via pubsub.
         self.head.aio.on_notify("pub", self._on_pub)
         self.head.call("subscribe", channel="actor_events")
@@ -265,6 +275,63 @@ class ClusterRuntime:
                 pass
 
         self.head.on_reconnect = _on_head_reconnect
+
+    # ------------------------------------------------------------------ telemetry
+    def _telemetry_flusher(self) -> None:
+        from ray_tpu.core.events import global_event_buffer
+        from ray_tpu.util import metrics, tracing
+
+        buf = global_event_buffer()
+        # Stable per-process source id: a daemon co-hosted with a driver
+        # (local-cluster mode) reports the same registry — keying by
+        # (node, pid) makes the second reporter overwrite, not double-count.
+        source = f"{self.my_node_id or 'driver'}:{os.getpid()}"
+        last_snapshot: dict | None = None
+        last_sent = 0.0
+        while not self._stop_flush.is_set():
+            period = get_config().telemetry_flush_interval_s
+            self._stop_flush.wait(period if period > 0 else 0.5)
+            if self._stop_flush.is_set() or self._shutdown:
+                return
+            if period <= 0:
+                continue  # telemetry push disabled
+            try:
+                # A node daemon co-hosted in this process (local-cluster /
+                # in-process test clusters) already reports this process's
+                # buffer+registry — a second reporter would double-ship
+                # spans and split events.
+                from ray_tpu.core.cluster import node_daemon as _nd
+
+                if _nd._process_telemetry_owner is not None:
+                    continue
+                events = buf.drain_dicts()
+                spans, self._span_cursor = tracing.flush_new(
+                    self._span_cursor)
+                snapshot = metrics.registry().snapshot()
+                # Idle-process economy: nothing new to report and the
+                # snapshot unchanged — skip the RPC, but keepalive well
+                # inside the head's 60s liveness window so the source
+                # doesn't age out of the federated export.
+                now = time.monotonic()
+                if not events and not spans and snapshot == last_snapshot \
+                        and now - last_sent < 20.0:
+                    continue
+                self.head.call(
+                    "report_telemetry", source=source,
+                    node_id=self.my_node_id, timeout=10,
+                    snapshot=snapshot, spans=spans, events=events,
+                    dropped=buf.dropped)
+                last_snapshot, last_sent = snapshot, now
+            except Exception:
+                pass  # head temporarily unreachable: drop (bounded loss)
+
+    def get_telemetry(self) -> dict:
+        """The head's per-node telemetry table (source -> node/snapshot)."""
+        return self.head.call("get_telemetry")
+
+    def cluster_spans(self) -> list[dict]:
+        """Finished spans flushed to the head from every node."""
+        return self.head.call("get_spans").get("spans", [])
 
     # ------------------------------------------------------------------ serving
     async def _handle_ping(self, conn, **kw):
@@ -875,12 +942,15 @@ class ClusterRuntime:
             return None
 
     def _fetch_from_holder(self, holder_hex: str, ref: ObjectRef) -> bytes | None:
+        from ray_tpu.core.transfer import observe_transfer
+
         addr, holder_node = self._resolve_worker(holder_hex)
         if addr is None:
             return None
         data = self._native_pull(holder_node, ref)
         if data is not None:
             return data
+        t0 = time.perf_counter()
         try:  # dead holder: connect refused (ctor) or reset (call)
             peer = self._peer(addr)
             first = peer.call("get_object_chunk", oid=ref.hex(), offset=0,
@@ -895,8 +965,12 @@ class ClusterRuntime:
             # an uncached borrow re-transfers on every get AND can never
             # join the relay set (report_holder requires a local copy).
             self.store.put(ref.id, first["data"], ref.owner_id)
+            observe_transfer("rpc_chunk", total, time.perf_counter() - t0)
             return first["data"]
-        return self._pull_chunked(peer, ref, first["data"], total)
+        data = self._pull_chunked(peer, ref, first["data"], total)
+        if data is not None:
+            observe_transfer("rpc_chunk", total, time.perf_counter() - t0)
+        return data
 
     def _pull_chunked(self, peer: RpcClient, ref: ObjectRef,
                       first: bytes, total: int) -> bytes | None:
@@ -1503,7 +1577,14 @@ class ClusterRuntime:
         typed OutOfMemoryError when the daemon killed the worker for
         memory, else a generic system-failure TaskError. The fate RPC is
         only paid here, not on retried failures."""
+        from ray_tpu.core import flight_recorder
+
         fate = await self._worker_kill_fate(w)
+        flight_recorder.record(
+            "worker_failure", reason=f"{type(e).__name__}: {e}",
+            node_id=self.my_node_id,
+            extra={"worker_id": w.worker_id, "task": task_desc,
+                   "fate": fate})
         if fate.get("oom"):
             return self._oom_error(fate, task_desc)
         return TaskError(RuntimeError(f"system failure: {e}"),
@@ -1714,6 +1795,12 @@ class ClusterRuntime:
                 self._actor_pump(st)
 
     def _fail_actor_queue(self, st: _ActorState, err: ActorDiedError) -> None:
+        from ray_tpu.core import flight_recorder
+
+        if "killed via kill()" not in (err.reason or ""):
+            flight_recorder.record("actor_death", reason=err.reason,
+                                   actor_id=st.actor_id,
+                                   node_id=self.my_node_id)
         for item in st.retrying:
             self._store_error_local(item.return_ids, err)
         st.retrying = []
@@ -1902,6 +1989,7 @@ class ClusterRuntime:
         if self._shutdown:
             return
         self._shutdown = True
+        self._stop_flush.set()
         try:
             self._reaper_task.cancel()
         except Exception:

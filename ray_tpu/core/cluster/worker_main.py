@@ -149,25 +149,10 @@ class WorkerProcess:
             # Popen (the runner's); the fork nonce is the reliable join key.
             nonce=os.environ.get("RTPU_WORKER_NONCE", ""),
         )
-        # Ship task events to the head on an interval so driver-side
-        # timeline/state-API see cluster-wide execution (reference:
-        # TaskEventBuffer flushes worker events into GcsTaskManager).
-        threading.Thread(target=self._event_flusher, daemon=True,
-                         name="event-flush").start()
-
-    def _event_flusher(self):
-        from ray_tpu.core.events import global_event_buffer
-
-        buf = global_event_buffer()
-        while not self._exit_event.is_set():
-            self._exit_event.wait(get_config().task_event_flush_interval_s)
-            batch = buf.drain_dicts()
-            if not batch:
-                continue
-            try:
-                self.runtime.head.call("report_task_events", events=batch)
-            except Exception:
-                pass  # head temporarily unreachable: drop (bounded loss)
+        # Task events, spans, and metric snapshots all reach the head via
+        # the runtime's telemetry flusher (ClusterRuntime._telemetry_flusher
+        # — reference: TaskEventBuffer flushing into GcsTaskManager plus the
+        # metrics agent push); workers need no extra thread here.
 
     # ------------------------------------------------------------------ tasks
     async def _push_task(self, conn, spec_blob: bytes):
@@ -300,6 +285,18 @@ class WorkerProcess:
                                       TaskCancelledError,
                                       OutOfMemoryError)) \
                 else TaskError(e, task_desc=spec.name)
+            if not isinstance(e, TaskCancelledError):
+                # Application exceptions are terminal in cluster mode: the
+                # submitter's retry budget only covers SYSTEM failures
+                # (worker death — RpcError/OSError on the push), so this
+                # path never fires for an attempt that will be retried.
+                from ray_tpu.core import flight_recorder
+
+                flight_recorder.record(
+                    "task_failure", reason=repr(e), task_id=tid_hex,
+                    node_id=self.node_id_hex,
+                    extra={"task": spec.name,
+                           "worker_id": self.runtime.worker_id.hex()})
             blob = serialization.serialize(err)
             return {"results": [{"data": blob} for _ in return_ids]}
         finally:

@@ -31,6 +31,25 @@ class TaskEvent:
     extra: dict = field(default_factory=dict)
 
 
+def _make_dropped_counter():
+    """Dropped events must be visible in /metrics, not just an attribute
+    nobody reads. Created at import so the series is scrapeable (HELP/TYPE)
+    before the first drop — an operator can tell "no drops yet" apart from
+    "not instrumented"."""
+    from ray_tpu.util.metrics import Counter
+
+    return Counter(
+        "task_events_dropped_total",
+        "task events dropped from a full in-process event buffer")
+
+
+_dropped_counter = _make_dropped_counter()
+
+
+def _count_dropped(n: float = 1.0) -> None:
+    _dropped_counter.inc(n)
+
+
 class TaskEventBuffer:
     """Bounded in-process ring of task events (oldest dropped first)."""
 
@@ -48,10 +67,17 @@ class TaskEventBuffer:
             job_id=extra.pop("job_id", ""),
             extra=extra,
         )
+        dropped = False
         with self._lock:
             if len(self._events) == self._events.maxlen:
                 self.dropped += 1
+                dropped = True
             self._events.append(ev)
+        if dropped:
+            try:
+                _count_dropped()
+            except Exception:
+                pass  # metrics must never fail the recording path
 
     def events(self) -> list[TaskEvent]:
         with self._lock:

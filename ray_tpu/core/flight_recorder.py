@@ -1,0 +1,178 @@
+"""Failure flight recorder: timestamped debug bundles on task/worker/actor
+failure.
+
+When a task fails terminally, a worker dies, or an actor is declared dead,
+the runtime dumps the last-N task events, the finished spans, and a metrics
+snapshot for this process into a JSON bundle under
+``<temp_dir>/flight_records/`` (reference capability: the post-mortem slice
+of the reference's dashboard — GcsTaskManager's retained failed-task events
+plus the metrics agent's last scrape — condensed into one artifact that
+survives the process). Bundles are bounded (oldest deleted) and recording is
+rate-limited so a failure storm can't turn the error path into a disk
+benchmark. Retrieval: ``ray_tpu.util.state.list_flight_records()`` /
+``get_flight_record()`` and ``python -m ray_tpu flight-records``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ray_tpu.utils.config import get_config
+
+_lock = threading.Lock()
+_last_record_ts = 0.0
+# Floor between dumps: failure bundles include the last-N events anyway, so
+# a suppressed dump's context lands in the next one.
+MIN_INTERVAL_S = 0.05
+EVENTS_TAIL = 500
+SPANS_TAIL = 500
+
+
+def records_dir() -> str:
+    return os.path.join(get_config().temp_dir, "flight_records")
+
+
+def record(kind: str, reason: str = "", task_id: str = "",
+           actor_id: str = "", node_id: str = "",
+           extra: dict | None = None) -> str | None:
+    """Dump a debug bundle; returns its path, or None when disabled,
+    rate-limited, or anything at all goes wrong (the failure path being
+    instrumented must never fail harder because of the recorder)."""
+    global _last_record_ts
+    try:
+        cfg = get_config()
+        if not cfg.flight_recorder_enabled:
+            return None
+        now = time.monotonic()
+        with _lock:
+            if now - _last_record_ts < MIN_INTERVAL_S:
+                return None
+            _last_record_ts = now
+        bundle = _build_bundle(kind, reason, task_id, actor_id, node_id,
+                               extra)
+        d = records_dir()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"fr-{time.time_ns()}-{kind}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, default=str)
+        os.replace(tmp, path)
+        _prune(d, cfg.flight_recorder_max_bundles)
+        return path
+    except Exception:
+        return None
+
+
+def _build_bundle(kind, reason, task_id, actor_id, node_id, extra) -> dict:
+    from ray_tpu.core import events as _events
+    from ray_tpu.util import metrics as _metrics
+    from ray_tpu.util import tracing as _tracing
+
+    import asyncio
+
+    try:
+        asyncio.get_running_loop()
+        on_io_loop = True
+    except RuntimeError:
+        on_io_loop = False
+    # Slice BEFORE converting: the rings hold up to 100k entries and some
+    # record() callers run on a node's control-plane event loop — asdict
+    # over the full ring there would stall heartbeats/lease handling.
+    try:
+        if on_io_loop:
+            # record() from an event-loop coroutine (actor-death paths):
+            # an RPC through the loop's own sync façade would deadlock, so
+            # settle for the local buffer + already-fetched cluster cache.
+            raw = _events.global_event_buffer().events()
+            raw.extend(_events._cluster_cache)
+        else:
+            # Include the head-collected cluster events so the bundle shows
+            # the failing task's full lifecycle even when its
+            # SUBMITTED/RUNNING halves live in other processes.
+            raw = _events.all_events()
+    except Exception:
+        raw = _events.global_event_buffer().events()
+    evs = [e if isinstance(e, dict) else _event_dict(e)
+           for e in raw[-EVENTS_TAIL:]]
+    from dataclasses import asdict as _asdict
+
+    spans = [_asdict(s) for s in _tracing.spans()[-SPANS_TAIL:]]
+    if not on_io_loop:
+        # Cluster mode: local spans alone miss the submitter's client span
+        # (it lives in the driver process and reaches the head via its
+        # telemetry flusher) — merge the head's view so a worker-side
+        # bundle still shows the whole trace.
+        try:
+            from ray_tpu.core.worker import global_worker
+
+            rt = global_worker.runtime
+            if rt is not None and hasattr(rt, "cluster_spans"):
+                have = {s["span_id"] for s in spans}
+                spans.extend(s for s in rt.cluster_spans()[-SPANS_TAIL:]
+                             if s.get("span_id") not in have)
+        except Exception:
+            pass  # head unreachable: local spans still useful
+    return {
+        "ts": time.time(),
+        "kind": kind,
+        "reason": reason,
+        "task_id": task_id,
+        "actor_id": actor_id,
+        "node_id": node_id,
+        "pid": os.getpid(),
+        "events": evs,
+        # Bounded already: ≤ SPANS_TAIL local + ≤ SPANS_TAIL head-merged
+        # (slicing the merged list would cut the local worker spans — the
+        # ones the bundle exists for — in favor of later-appended ones).
+        "spans": spans,
+        "metrics": _metrics.registry().snapshot(),
+        "extra": dict(extra or {}),
+    }
+
+
+def _event_dict(e) -> dict:
+    return {
+        "task_id": e.task_id, "name": e.name, "state": e.state, "ts": e.ts,
+        "worker_id": e.worker_id, "node_id": e.node_id,
+        "actor_id": e.actor_id, "job_id": e.job_id, "extra": e.extra,
+    }
+
+
+def _prune(d: str, keep: int) -> None:
+    names = sorted(n for n in os.listdir(d)
+                   if n.startswith("fr-") and n.endswith(".json"))
+    for n in names[:-keep] if keep > 0 else names:
+        try:
+            os.remove(os.path.join(d, n))
+        except OSError:
+            pass
+
+
+def list_records() -> list[dict]:
+    """Bundle index, newest last (name encodes the nanosecond timestamp)."""
+    d = records_dir()
+    out: list[dict] = []
+    try:
+        names = sorted(n for n in os.listdir(d)
+                       if n.startswith("fr-") and n.endswith(".json"))
+    except FileNotFoundError:
+        return out
+    for n in names:
+        parts = n[:-len(".json")].split("-", 2)
+        out.append({
+            "name": n,
+            "path": os.path.join(d, n),
+            "ts_ns": int(parts[1]) if len(parts) > 2 and
+            parts[1].isdigit() else 0,
+            "kind": parts[2] if len(parts) > 2 else "",
+        })
+    return out
+
+
+def get_record(name: str) -> dict:
+    path = os.path.join(records_dir(), os.path.basename(name))
+    with open(path) as f:
+        return json.load(f)

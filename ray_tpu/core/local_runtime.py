@@ -340,6 +340,12 @@ class LocalRuntime:
                     if spec.retry_exceptions and attempts <= spec.max_retries:
                         continue
                     self._store_error(return_ids, TaskError(e, task_desc=spec.name))
+                    from ray_tpu.core import flight_recorder
+
+                    flight_recorder.record(
+                        "task_failure", reason=repr(e),
+                        task_id=spec.task_id.hex(),
+                        extra={"task": spec.name, "attempts": attempts})
                     return
         finally:
             # Exactly once per task, regardless of retries.
@@ -567,8 +573,13 @@ class LocalRuntime:
         state.mailbox.put(None)
 
     def _mark_actor_dead(self, state: _ActorState, reason: str) -> None:
+        from ray_tpu.core import flight_recorder
+
         state.dead = True
         state.death_reason = reason
+        if "killed via kill()" not in reason:  # intentional kills aren't failures
+            flight_recorder.record("actor_death", reason=reason,
+                                   actor_id=state.spec.actor_id.hex())
         with self._lock:
             if state.spec.name:
                 self._named_actors.pop((state.spec.namespace, state.spec.name), None)

@@ -12,10 +12,49 @@ native, with Python only exchanging (host, port) endpoints.
 from __future__ import annotations
 
 import ctypes
+import time
 
 from ray_tpu._native import load_library
 
 _lib = None
+
+import threading as _threading
+
+_transfer_metrics = None
+_transfer_metrics_lock = _threading.Lock()
+
+
+def _get_transfer_metrics():
+    global _transfer_metrics
+    with _transfer_metrics_lock:
+        if _transfer_metrics is not None:
+            return _transfer_metrics
+        from ray_tpu.util.metrics import Histogram
+
+        _transfer_metrics = (
+            Histogram("transfer_latency_s",
+                      "object transfer wall time per pull",
+                      tag_keys=("path",)),
+            Histogram("transfer_bytes",
+                      "object transfer size in bytes per pull",
+                      boundaries=[1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10],
+                      tag_keys=("path",)),
+        )
+    return _transfer_metrics
+
+
+def observe_transfer(path: str, nbytes: int, seconds: float) -> None:
+    """Record one completed object pull. ``path`` names the data plane:
+    native_pull / native_fetch here, rpc_chunk / rpc_inline from the
+    runtime's fallback paths — the label that shows whether bytes are
+    riding the native plane or the slow path."""
+    try:
+        lat, size = _get_transfer_metrics()
+        tags = {"path": path}
+        lat.observe(seconds, tags=tags)
+        size.observe(float(nbytes), tags=tags)
+    except Exception:
+        pass  # metrics must never fail a transfer
 
 
 def lib() -> ctypes.CDLL:
@@ -68,12 +107,14 @@ def pull_to_store(local_shm: str, object_id: bytes, host: str,
     """Pull object_id from (host, port) straight into the local arena.
     Returns total bytes, or None if the holder doesn't have it (caller
     falls back to the RPC chunk path)."""
+    t0 = time.perf_counter()
     rc = lib().transfer_pull(local_shm.encode(), object_id, host.encode(),
                              port, chunk, conns)
     if rc == -2:
         return None  # not in the holder's arena
     if rc < 0:
         raise OSError(f"native pull failed (rc {rc})")
+    observe_transfer("native_pull", int(rc), time.perf_counter() - t0)
     return int(rc)
 
 
@@ -83,6 +124,7 @@ def fetch_to_buffer(object_id: bytes, host: str, port: int,
     """Pull into process memory (puller without an arena). None if the
     holder doesn't have the object in its arena."""
     l = lib()
+    t0 = time.perf_counter()
     total = l.transfer_size(host.encode(), port, object_id)
     if total == -2:
         return None
@@ -92,4 +134,5 @@ def fetch_to_buffer(object_id: bytes, host: str, port: int,
     if l.transfer_fetch_buf(host.encode(), port, object_id, buf,
                             total, chunk, conns) != 0:
         raise OSError("native fetch failed")
+    observe_transfer("native_fetch", int(total), time.perf_counter() - t0)
     return buf.raw

@@ -110,9 +110,65 @@ class DashboardServer:
                        listing(state_api.list_placement_groups))
         self.add_route("GET", "/api/objects", listing(state_api.list_objects))
         self.add_route("GET", "/api/timeline", lambda p, b: events.timeline())
-        self.add_route("GET", "/api/traces", lambda p, b: tracing.export())
-        self.add_route("GET", "/metrics",
-                       lambda p, b: metrics.registry().export_prometheus())
+
+        def traces(p, b):
+            # Local spans plus, in cluster mode, every node's spans flushed
+            # to the head (deduped — this process's spans also reach the
+            # head via its own telemetry flusher).
+            from ray_tpu.core.worker import global_worker
+
+            by_id = {s["span_id"]: s for s in tracing.export()}
+            rt = global_worker.runtime
+            if rt is not None and hasattr(rt, "cluster_spans"):
+                try:
+                    for s in rt.cluster_spans():
+                        by_id.setdefault(s.get("span_id"), s)
+                except Exception:
+                    pass  # head unreachable: local view still useful
+            return list(by_id.values())
+
+        self.add_route("GET", "/api/traces", traces)
+
+        def metrics_export(p, b):
+            # Federated Prometheus export (reference: the dashboard serving
+            # the aggregate of every node's metrics agent): in cluster mode
+            # each series carries a node_id label; per-node snapshots from
+            # several processes merge (counters/histograms sum, gauges keep
+            # the freshest). Local-only runtimes keep the plain export.
+            from ray_tpu.core.worker import global_worker
+
+            rt = global_worker.runtime
+            if rt is None or not hasattr(rt, "get_telemetry"):
+                return metrics.registry().export_prometheus()
+            try:
+                sources = rt.get_telemetry().get("sources", {})
+            except Exception:
+                return metrics.registry().export_prometheus()
+            by_node: dict[str, list] = {}
+            # Oldest-report-first per node: merge_snapshots keeps the LAST
+            # reporter's value for gauges, so sorting by report ts makes
+            # that the freshest one, as documented.
+            for row in sorted(sources.values(),
+                              key=lambda r: r.get("ts", 0.0)):
+                nid = row.get("node_id") or "head"
+                by_node.setdefault(nid, []).append(row.get("snapshot") or {})
+            if not by_node:
+                return metrics.registry().export_prometheus()
+            per_node = {nid: metrics.merge_snapshots(snaps)
+                        for nid, snaps in sorted(by_node.items())}
+            return metrics.export_prometheus_federated(per_node)
+
+        self.add_route("GET", "/metrics", metrics_export)
+
+        def flight_records(p, b):
+            from ray_tpu.core import flight_recorder
+
+            name = p.get("name")
+            if name:
+                return flight_recorder.get_record(name)
+            return flight_recorder.list_records()
+
+        self.add_route("GET", "/api/flight_records", flight_records)
 
         def cluster_status(p, b):
             from ray_tpu.core.worker import global_worker

@@ -77,19 +77,59 @@ def cmd_summary(args) -> int:
 
 
 def cmd_timeline(args) -> int:
-    """Chrome-trace JSON of task execution (reference: ray timeline)."""
+    """Chrome-trace JSON of task execution + spans (reference: ray
+    timeline). Object format ({"traceEvents": [...]}) so span rows and
+    metadata records can ride alongside the task slices."""
     api = _connect(args.address)
     from ray_tpu.core.events import TaskEvent, chrome_trace
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.util import tracing
 
     events = api.timeline() if hasattr(api, "timeline") else None
     if events is None:
-        from ray_tpu.core.worker import global_worker
-
         raw = global_worker.runtime.task_events()["events"]
         events = chrome_trace([TaskEvent(**e) for e in raw])
+    # Spans (local + cluster-flushed, deduped) as their own rows.
+    by_id = {s["span_id"]: s for s in tracing.export()}
+    rt = global_worker.runtime
+    if rt is not None and hasattr(rt, "cluster_spans"):
+        try:
+            for s in rt.cluster_spans():
+                by_id.setdefault(s.get("span_id"), s)
+        except Exception:
+            pass
+    for s in by_id.values():
+        events.append({
+            "name": s["name"], "cat": f"span:{s['kind']}", "ph": "X",
+            "ts": s["start_ts"] * 1e6,
+            "dur": max(0.0, (s["end_ts"] - s["start_ts"]) * 1e6),
+            "pid": "spans", "tid": s["trace_id"][:8],
+            "args": {"trace_id": s["trace_id"], "span_id": s["span_id"],
+                     "status": s["status"], **s.get("attributes", {})},
+        })
+    # Always at least the process-name metadata record: the file must load
+    # in chrome://tracing / Perfetto even when nothing ran yet.
+    events.append({"name": "process_name", "ph": "M", "pid": "spans",
+                   "args": {"name": "ray_tpu spans"}})
     with open(args.out, "w") as f:
-        json.dump(events, f)
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     print(f"wrote {len(events)} trace events to {args.out}")
+    return 0
+
+
+def cmd_flight_records(args) -> int:
+    """List (or dump one of) the failure flight-recorder bundles."""
+    from ray_tpu.util.state import get_flight_record, list_flight_records
+
+    if args.get:
+        print(json.dumps(get_flight_record(args.get), indent=2,
+                         default=str))
+        return 0
+    rows = list_flight_records(kind=args.kind)
+    if args.json:
+        print(json.dumps(rows, default=str))
+    else:
+        print(_fmt_table(rows, ["name", "kind", "ts_ns"]))
     return 0
 
 
@@ -152,6 +192,11 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("resource", choices=["tasks"])
     tp = sub.add_parser("timeline")
     tp.add_argument("--out", default="timeline.json")
+    fp = sub.add_parser("flight-records")
+    fp.add_argument("--get", default=None, help="dump one bundle by name")
+    fp.add_argument("--kind", default=None,
+                    help="filter: task_failure | worker_death | actor_death")
+    fp.add_argument("--json", action="store_true")
     gp = sub.add_parser("logs")
     gp.add_argument("glob", nargs="?", default=None)
     gp.add_argument("--list", action="store_true")
@@ -166,7 +211,8 @@ def main(argv: list[str] | None = None) -> int:
     if hasattr(args, "_fn"):  # start/stop/serve-* carry their handler
         return args._fn(args)
     cmds = {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
-            "timeline": cmd_timeline, "logs": cmd_logs, "memory": cmd_memory}
+            "timeline": cmd_timeline, "logs": cmd_logs, "memory": cmd_memory,
+            "flight-records": cmd_flight_records}
     return cmds[args.command](args)
 
 

@@ -14,6 +14,48 @@ from typing import Any
 
 from ray_tpu.utils import serialization
 
+_replica_metrics = None
+_replica_metrics_lock = threading.Lock()
+
+# Sub-second-centric buckets: TTFT/TPOT targets live in the 1 ms – 10 s
+# band (reference capability: the TTFT/TPOT numbers LLM-serving papers
+# compare on, PAPERS.md — readable off /metrics instead of bench scripts).
+_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _get_replica_metrics():
+    global _replica_metrics
+    with _replica_metrics_lock:
+        if _replica_metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+            _replica_metrics = {
+                "ttft": Histogram(
+                    "serve_ttft_s",
+                    "time to first result: request start to first output "
+                    "(first chunk when streaming, full result otherwise)",
+                    boundaries=_LATENCY_BUCKETS, tag_keys=("deployment",)),
+                "tpot": Histogram(
+                    "serve_tpot_s",
+                    "time per output token/chunk: gap between successive "
+                    "streamed chunks",
+                    boundaries=_LATENCY_BUCKETS, tag_keys=("deployment",)),
+                "latency": Histogram(
+                    "serve_request_latency_s",
+                    "full request latency at the replica",
+                    boundaries=_LATENCY_BUCKETS, tag_keys=("deployment",)),
+                "ongoing": Gauge(
+                    "serve_ongoing_requests",
+                    "requests currently executing on this replica",
+                    tag_keys=("deployment", "replica")),
+                "requests": Counter(
+                    "serve_replica_requests_total",
+                    "requests handled by this replica",
+                    tag_keys=("deployment", "replica")),
+            }
+        return _replica_metrics
+
 
 class ServeReplica:
     """Created by the controller with max_concurrency == max_ongoing_requests
@@ -34,8 +76,33 @@ class ServeReplica:
         self._total = 0
         self._lock = threading.Lock()
         self._started_at = time.time()
+        self._m = _get_replica_metrics()
+        self._dep_tag = {"deployment": deployment_name}
+        self._rep_tag = {"deployment": deployment_name,
+                         "replica": replica_id}
         if user_config is not None:
             self.reconfigure(user_config)
+
+    def _begin_request(self) -> None:
+        # Gauge set under the same lock as the counter: interleaved sets
+        # outside it could publish a stale ongoing value that sticks until
+        # the next request.
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+            try:
+                self._m["ongoing"].set(self._ongoing, tags=self._rep_tag)
+                self._m["requests"].inc(tags=self._rep_tag)
+            except Exception:
+                pass
+
+    def _end_request(self) -> None:
+        with self._lock:
+            self._ongoing -= 1
+            try:
+                self._m["ongoing"].set(self._ongoing, tags=self._rep_tag)
+            except Exception:
+                pass
 
     # -- data plane --
 
@@ -44,9 +111,8 @@ class ServeReplica:
 
         mux_id = kwargs.pop("__rtpu_mux_id", "")
         _set_multiplexed_model_id(mux_id)
-        with self._lock:
-            self._ongoing += 1
-            self._total += 1
+        self._begin_request()
+        t0 = time.perf_counter()
         try:
             if method_name == "__call__":
                 target = self._callable
@@ -56,10 +122,17 @@ class ServeReplica:
                         f"specify a method name")
             else:
                 target = getattr(self._callable, method_name)
-            return target(*args, **kwargs)
+            result = target(*args, **kwargs)
+            elapsed = time.perf_counter() - t0
+            try:
+                # Non-streaming: the full result IS the first output.
+                self._m["ttft"].observe(elapsed, tags=self._dep_tag)
+                self._m["latency"].observe(elapsed, tags=self._dep_tag)
+            except Exception:
+                pass
+            return result
         finally:
-            with self._lock:
-                self._ongoing -= 1
+            self._end_request()
 
     def handle_request_streaming(self, method_name: str, args: tuple,
                                  kwargs: dict):
@@ -73,9 +146,8 @@ class ServeReplica:
         from ray_tpu.serve.multiplex import _set_multiplexed_model_id
 
         _set_multiplexed_model_id(kwargs.pop("__rtpu_mux_id", ""))
-        with self._lock:
-            self._ongoing += 1
-            self._total += 1
+        self._begin_request()
+        t0 = time.perf_counter()
         try:
             if method_name == "__call__":
                 target = self._callable
@@ -85,18 +157,49 @@ class ServeReplica:
                     inspect.isgeneratorfunction(
                         getattr(target, "__call__", None)):
                 yield {"streaming": True}
-                yield from target(*args, **kwargs)
+                yield from self._instrumented_stream(
+                    target(*args, **kwargs), t0)
                 return
             result = target(*args, **kwargs)
             if inspect.isgenerator(result):
                 yield {"streaming": True}
-                yield from result
+                yield from self._instrumented_stream(result, t0)
                 return
             yield {"streaming": False}
+            elapsed = time.perf_counter() - t0
+            try:
+                self._m["ttft"].observe(elapsed, tags=self._dep_tag)
+                self._m["latency"].observe(elapsed, tags=self._dep_tag)
+            except Exception:
+                pass
             yield result
         finally:
-            with self._lock:
-                self._ongoing -= 1
+            self._end_request()
+
+    def _instrumented_stream(self, gen, t0: float):
+        """TTFT on the first user chunk, TPOT on each inter-chunk gap, full
+        latency at exhaustion — the streaming triple every serving
+        comparison quotes."""
+        last = None
+        try:
+            for chunk in gen:
+                now = time.perf_counter()
+                try:
+                    if last is None:
+                        self._m["ttft"].observe(now - t0, tags=self._dep_tag)
+                    else:
+                        self._m["tpot"].observe(now - last,
+                                                tags=self._dep_tag)
+                except Exception:
+                    pass
+                last = now
+                yield chunk
+        finally:
+            try:
+                self._m["latency"].observe(time.perf_counter() - t0,
+                                           tags=self._dep_tag)
+            except Exception:
+                pass
 
     # -- control plane --
 

@@ -15,6 +15,38 @@ from typing import Callable
 
 import ray_tpu
 from ray_tpu.serve.config import ReplicaInfo
+from ray_tpu.util import tracing
+
+_router_metrics = None
+_router_metrics_lock = threading.Lock()
+
+
+def _get_router_metrics():
+    """Process-wide router metrics: admission wait, parked-caller depth,
+    and request count per deployment (reference: serve's
+    ray_serve_num_router_requests / queued gauges). Lock-guarded creation:
+    two racing first-requests must not register two metric objects and
+    strand increments on the one the exporter can't see."""
+    global _router_metrics
+    with _router_metrics_lock:
+        if _router_metrics is not None:
+            return _router_metrics
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _router_metrics = {
+            "queue_wait": Histogram(
+                "serve_router_queue_wait_s",
+                "time a request waited in the router for a replica slot",
+                tag_keys=("deployment",)),
+            "queue_depth": Gauge(
+                "serve_router_queue_depth",
+                "callers currently parked waiting for replica capacity",
+                tag_keys=("deployment",)),
+            "requests": Counter(
+                "serve_router_requests_total",
+                "requests assigned to replicas", tag_keys=("deployment",)),
+        }
+    return _router_metrics
 
 
 class Router:
@@ -26,6 +58,7 @@ class Router:
         self._lock = threading.Lock()
         self._not_saturated = threading.Condition(self._lock)
         self._rng = random.Random()
+        self._waiting = 0  # callers parked for capacity (queue-depth gauge)
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
                        timeout: float = 30.0, stream: bool = False,
@@ -49,25 +82,40 @@ class Router:
         request events)."""
         import time as _time
 
-        deadline = _time.monotonic() + timeout
+        mtr = _get_router_metrics()
+        dep_tag = {"deployment": self._deployment}
+        t_enter = _time.monotonic()
+        deadline = t_enter + timeout
         with self._lock:
-            while True:
-                replicas = self._get_replicas()
-                chosen = (self._choose_locked(replicas, route_hint)
-                          if replicas else None)
-                if chosen is not None:
-                    self._inflight[chosen.replica_id] = \
-                        self._inflight.get(chosen.replica_id, 0) + 1
-                    break
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    raise TimeoutError(
-                        f"no available replica for {self._deployment!r} "
-                        f"within {timeout}s")
-                # Bounded wait: replica-set changes arrive via
-                # notify_replicas_changed(), completions via _release();
-                # the 0.5 s cap only covers lost-notify edge cases.
-                self._not_saturated.wait(timeout=min(remaining, 0.5))
+            parked = False
+            try:
+                while True:
+                    replicas = self._get_replicas()
+                    chosen = (self._choose_locked(replicas, route_hint)
+                              if replicas else None)
+                    if chosen is not None:
+                        self._inflight[chosen.replica_id] = \
+                            self._inflight.get(chosen.replica_id, 0) + 1
+                        break
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"no available replica for {self._deployment!r} "
+                            f"within {timeout}s")
+                    if not parked:
+                        parked = True
+                        self._waiting += 1
+                        mtr["queue_depth"].set(self._waiting, tags=dep_tag)
+                    # Bounded wait: replica-set changes arrive via
+                    # notify_replicas_changed(), completions via _release();
+                    # the 0.5 s cap only covers lost-notify edge cases.
+                    self._not_saturated.wait(timeout=min(remaining, 0.5))
+            finally:
+                if parked:
+                    self._waiting -= 1
+                    mtr["queue_depth"].set(self._waiting, tags=dep_tag)
+        mtr["queue_wait"].observe(_time.monotonic() - t_enter, tags=dep_tag)
+        mtr["requests"].inc(tags=dep_tag)
 
         try:
             handle = ray_tpu.get_actor(chosen.actor_name, namespace="serve")
@@ -79,8 +127,17 @@ class Router:
             raise
         if stream:
             try:
-                gen = handle.handle_request_streaming.options(
-                    num_returns="streaming").remote(method_name, args, kwargs)
+                # Client span around submission: inject() rides the
+                # TaskSpec, so the replica's execution shows up as a child
+                # of serve.request — one trace across processes.
+                with tracing.span(f"serve.request.{self._deployment}",
+                                  kind="client",
+                                  attributes={"method": method_name,
+                                              "replica": chosen.replica_id,
+                                              "stream": "true"}):
+                    gen = handle.handle_request_streaming.options(
+                        num_returns="streaming").remote(
+                            method_name, args, kwargs)
             except Exception:
                 self._release(chosen.replica_id)
                 raise
@@ -96,7 +153,11 @@ class Router:
 
             return gen, on_stream_done
         try:
-            ref = handle.handle_request.remote(method_name, args, kwargs)
+            with tracing.span(f"serve.request.{self._deployment}",
+                              kind="client",
+                              attributes={"method": method_name,
+                                          "replica": chosen.replica_id}):
+                ref = handle.handle_request.remote(method_name, args, kwargs)
         except Exception:
             self._release(chosen.replica_id)
             raise

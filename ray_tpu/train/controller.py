@@ -20,6 +20,36 @@ from ray_tpu.train.config import RunConfig, ScalingConfig
 from ray_tpu.train.worker_group import WorkerGroup
 
 
+import threading as _threading
+
+_metrics = None
+_metrics_lock = _threading.Lock()
+
+
+def _controller_metrics():
+    """Process-wide singletons: a fresh controller must extend these
+    counters, not re-register and zero them (lock-guarded so concurrent
+    controller constructions can't register duplicates)."""
+    global _metrics
+    with _metrics_lock:
+        if _metrics is not None:
+            return _metrics
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        _metrics = {
+            "restarts": Counter(
+                "train_restarts_total",
+                "worker-group restarts after failures", tag_keys=("run",)),
+            "failures": Counter(
+                "train_worker_failures_total",
+                "train workers that reported an error", tag_keys=("run",)),
+            "world": Gauge(
+                "train_world_size", "current worker-group world size",
+                tag_keys=("run",)),
+        }
+    return _metrics
+
+
 @dataclass
 class Result:
     metrics: dict[str, Any] = field(default_factory=dict)
@@ -56,6 +86,14 @@ class TrainController:
         self._callbacks = list(run_config.callbacks)
         self._run_name = name
         self._rank0_reports = 0  # callback iteration counter (rank-0 only)
+        # Controller-side run health (the worker-side throughput gauges live
+        # in train/session.py): restarts and failures as counters, the live
+        # world size as a gauge — the first things to look at when a run's
+        # tokens/sec sags.
+        m = _controller_metrics()
+        self._m_restarts = m["restarts"]
+        self._m_failures = m["failures"]
+        self._m_world = m["world"]
 
     def _cb(self, hook: str, *args) -> None:
         for cb in self._callbacks:
@@ -83,6 +121,7 @@ class TrainController:
             group = None
             try:
                 world = policy.decide_world_size(restart_count)
+                self._m_world.set(world, tags={"run": self._run_name})
                 group = WorkerGroup(
                     self.scaling, self.run_config.name or "train",
                     self.ckpt_manager.storage_path, num_workers=world,
@@ -109,6 +148,7 @@ class TrainController:
                 return result
             except Exception:  # noqa: BLE001 - worker/actor failures
                 restart_count += 1
+                self._m_restarts.inc(tags={"run": self._run_name})
                 if max_failures >= 0 and restart_count > max_failures:
                     self._status = "ERRORED"
                     result = Result(error=traceback.format_exc(),
@@ -135,6 +175,8 @@ class TrainController:
                     self.ckpt_manager.register(rep["checkpoint"], rep["metrics"])
                     self._cb("on_checkpoint", rep["checkpoint"], rep["metrics"])
             if status.errors:
+                self._m_failures.inc(len(status.errors),
+                                     tags={"run": self._run_name})
                 err = "\n".join(f"rank {r}: {e}"
                                 for r, e in status.errors.items())
                 if failures_left > 0:

@@ -30,6 +30,7 @@ class TrainContext:
     dataset_shards: dict = field(default_factory=dict)  # name -> DataIterator
     _reports: list[dict] = field(default_factory=list)
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
+    _last_report_ts: float = 0.0  # monotonic ts of the previous report()
 
     def get_world_rank(self) -> int:
         return self.world_rank
@@ -68,11 +69,86 @@ def get_context() -> TrainContext:
     return ctx
 
 
+_train_metrics = None
+_train_metrics_lock = threading.Lock()
+
+
+def _get_train_metrics():
+    """Lazy singletons: the gauges every report() updates. Created on the
+    worker that actually trains, so the federated /metrics shows them under
+    that worker's node_id (reference capability: the per-chip tokens/sec and
+    MFU numbers papers headline — PAPERS.md Gemma-on-TPU — readable off one
+    endpoint instead of living in code comments)."""
+    global _train_metrics
+    with _train_metrics_lock:
+        if _train_metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            _train_metrics = {
+                "step_time": Gauge(
+                    "train_step_time_s",
+                    "seconds between consecutive session.report() calls "
+                    "(the per-step wall time when reporting per step)",
+                    tag_keys=("rank",)),
+                "tokens_per_s": Gauge(
+                    "train_tokens_per_s",
+                    "training throughput: reported tokens / step time",
+                    tag_keys=("rank",)),
+                "mfu": Gauge(
+                    "train_mfu",
+                    "achieved model FLOPs utilization (0..1): reported "
+                    "flops / step time / peak_flops",
+                    tag_keys=("rank",)),
+                "reports": Counter(
+                    "train_reports_total", "session.report() calls",
+                    tag_keys=("rank",)),
+            }
+        return _train_metrics
+
+
+def _instrument_report(ctx: TrainContext, metrics: dict[str, Any]) -> None:
+    """Derive step-time / tokens-per-sec / MFU gauges from a report.
+    Recognized keys: ``tokens`` (or ``tokens_per_step``) per step, ``flops``
+    (or ``flops_per_step``) per step, ``peak_flops`` (else RTPU_PEAK_FLOPS
+    env), and direct ``tokens_per_s`` / ``mfu`` passthroughs."""
+    import os
+    import time
+
+    m = _get_train_metrics()
+    rank = {"rank": str(ctx.world_rank)}
+    m["reports"].inc(tags=rank)
+    now = time.monotonic()
+    last, ctx._last_report_ts = ctx._last_report_ts, now
+    step_time = (now - last) if last else 0.0
+    if step_time > 0:
+        m["step_time"].set(step_time, tags=rank)
+    if "tokens_per_s" in metrics:
+        m["tokens_per_s"].set(float(metrics["tokens_per_s"]), tags=rank)
+    elif step_time > 0:
+        tokens = metrics.get("tokens", metrics.get("tokens_per_step"))
+        if tokens:
+            m["tokens_per_s"].set(float(tokens) / step_time, tags=rank)
+    if "mfu" in metrics:
+        m["mfu"].set(float(metrics["mfu"]), tags=rank)
+    elif step_time > 0:
+        flops = metrics.get("flops", metrics.get("flops_per_step"))
+        peak = metrics.get("peak_flops") or \
+            float(os.environ.get("RTPU_PEAK_FLOPS", 0) or 0)
+        if flops and peak:
+            m["mfu"].set(float(flops) / step_time / float(peak), tags=rank)
+
+
 def report(metrics: dict[str, Any], checkpoint: str | None = None) -> None:
     """Report metrics (and optionally a checkpoint directory the worker has
     already written) to the controller. Non-blocking; the controller collects
-    reports when it polls."""
+    reports when it polls. Also feeds the train gauges
+    (train_step_time_s / train_tokens_per_s / train_mfu) so throughput is
+    readable off /metrics, not just the report stream."""
     ctx = get_context()
+    try:
+        _instrument_report(ctx, metrics)
+    except Exception:
+        pass  # metrics must never fail a training step
     with ctx._report_lock:
         ctx._reports.append({"metrics": dict(metrics), "checkpoint": checkpoint})
 

@@ -8,6 +8,12 @@ dashboard scrapes/aggregates them in Prometheus text exposition format.
 TPU-native note: no OpenCensus/OTel dependency — a lock-protected in-process
 registry with Prometheus text export keeps the hot path to a dict update, and
 the export shape identical to what the reference's metrics agent serves.
+
+Cluster federation (reference: the metrics agent pushing to the dashboard's
+aggregator): every process can ``snapshot()`` its registry into a
+wire-serializable dict; the head collects snapshots per node and the
+dashboard renders them with ``export_prometheus_federated`` — one endpoint,
+every series labeled with its ``node_id``.
 """
 
 from __future__ import annotations
@@ -136,42 +142,144 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
 
+    def snapshot(self) -> dict:
+        """Wire-serializable copy of every registered metric's state, the
+        unit the telemetry pipeline ships to the head (reference: the
+        OpenCensus snapshots the metrics agent exports). Series keys become
+        lists so the dict survives msgpack/JSON round-trips."""
+        entries = []
+        for m in self.metrics():
+            entry = {
+                "name": m.name, "type": m.prom_type,
+                "desc": m.description, "tag_keys": list(m.tag_keys),
+            }
+            if isinstance(m, Histogram):
+                buckets, sums, counts = m._hist_points()
+                entry["boundaries"] = [float(b) for b in m.boundaries]
+                entry["buckets"] = [[list(k), list(v)]
+                                    for k, v in buckets.items()]
+                entry["sums"] = [[list(k), v] for k, v in sums.items()]
+                entry["counts"] = [[list(k), v] for k, v in counts.items()]
+            else:
+                entry["points"] = [[list(k), v]
+                                   for k, v in m._points().items()]
+            entries.append(entry)
+        return {"metrics": entries}
+
     def export_prometheus(self) -> str:
         """Prometheus text exposition of every registered metric."""
         lines: list[str] = []
-        for m in self.metrics():
-            lines.append(f"# HELP {m.name} {m.description}")
-            lines.append(f"# TYPE {m.name} {m.prom_type}")
-            if isinstance(m, Histogram):
-                buckets, sums, counts = m._hist_points()
-                for key, bk in buckets.items():
-                    base = _labels(m.tag_keys, key)
-                    cum = 0
-                    for bound, n in zip(m.boundaries, bk):
-                        cum += n
-                        lines.append(
-                            f'{m.name}_bucket{_labels(m.tag_keys, key, ("le", repr(bound)))} {cum}'
-                        )
-                    cum += bk[-1]
-                    lines.append(
-                        f'{m.name}_bucket{_labels(m.tag_keys, key, ("le", "+Inf"))} {cum}')
-                    lines.append(f"{m.name}_sum{base} {sums.get(key, 0.0)}")
-                    lines.append(f"{m.name}_count{base} {int(counts.get(key, 0))}")
-            else:
-                for key, v in m._points().items():
-                    lines.append(f"{m.name}{_labels(m.tag_keys, key)} {v}")
+        for entry in self.snapshot()["metrics"]:
+            lines.append(f"# HELP {entry['name']} {entry['desc']}")
+            lines.append(f"# TYPE {entry['name']} {entry['type']}")
+            lines.extend(_render_entry(entry))
         return "\n".join(lines) + "\n"
 
 
+def merge_snapshots(snapshots: list[dict]) -> dict:
+    """Merge per-process snapshots into one (several workers on one node
+    report under the same node_id): counters and histograms sum, gauges
+    keep the last reporter's value. Histogram merges require identical
+    boundaries; a mismatched reporter's entry is kept as-is from the first."""
+    merged: dict[str, dict] = {}
+    for snap in snapshots:
+        for entry in snap.get("metrics", []):
+            have = merged.get(entry["name"])
+            if have is None:
+                import copy
+
+                merged[entry["name"]] = copy.deepcopy(entry)
+                continue
+            if entry["type"] == "histogram":
+                if have.get("boundaries") != entry.get("boundaries"):
+                    continue
+                for field, combine in (("buckets", "vec"), ("sums", "num"),
+                                       ("counts", "num")):
+                    idx = {tuple(k): v for k, v in have.get(field, [])}
+                    for k, v in entry.get(field, []):
+                        k = tuple(k)
+                        if k not in idx:
+                            idx[k] = v
+                        elif combine == "vec":
+                            idx[k] = [a + b for a, b in zip(idx[k], v)]
+                        else:
+                            idx[k] = idx[k] + v
+                    have[field] = [[list(k), v] for k, v in idx.items()]
+            else:
+                idx = {tuple(k): v for k, v in have.get("points", [])}
+                for k, v in entry.get("points", []):
+                    k = tuple(k)
+                    if entry["type"] == "counter":
+                        idx[k] = idx.get(k, 0.0) + v
+                    else:  # gauge: last reporter wins
+                        idx[k] = v
+                have["points"] = [[list(k), v] for k, v in idx.items()]
+    return {"metrics": list(merged.values())}
+
+
+def export_prometheus_federated(per_node: dict[str, dict]) -> str:
+    """Cluster-wide Prometheus text exposition: every node's snapshot with a
+    ``node_id`` label on each series, HELP/TYPE emitted once per metric name
+    (reference: the dashboard's federated /metrics over per-node agents)."""
+    by_name: dict[str, list[tuple[str, dict]]] = {}
+    for node_id, snap in per_node.items():
+        for entry in snap.get("metrics", []):
+            by_name.setdefault(entry["name"], []).append((node_id, entry))
+    lines: list[str] = []
+    for name, rows in by_name.items():
+        lines.append(f"# HELP {name} {rows[0][1]['desc']}")
+        lines.append(f"# TYPE {name} {rows[0][1]['type']}")
+        for node_id, entry in rows:
+            lines.extend(_render_entry(entry, extra=[("node_id", node_id)]))
+    return "\n".join(lines) + "\n"
+
+
+def _render_entry(entry: dict, extra: list[tuple] | None = None) -> list[str]:
+    """Exposition lines for one snapshot entry (shared by the local and
+    federated exporters so the two can never drift)."""
+    name, keys = entry["name"], tuple(entry["tag_keys"])
+    lines: list[str] = []
+    if entry["type"] == "histogram":
+        bounds = entry["boundaries"]
+        sums = {tuple(k): v for k, v in entry.get("sums", [])}
+        counts = {tuple(k): v for k, v in entry.get("counts", [])}
+        for key, bk in entry.get("buckets", []):
+            key = tuple(key)
+            base = _labels(keys, key, extra)
+            cum = 0
+            for bound, n in zip(bounds, bk):
+                cum += n
+                le = (extra or []) + [("le", _fmt_float(bound))]
+                lines.append(f"{name}_bucket{_labels(keys, key, le)} {cum}")
+            cum += bk[-1]
+            inf = (extra or []) + [("le", "+Inf")]
+            lines.append(f"{name}_bucket{_labels(keys, key, inf)} {cum}")
+            lines.append(f"{name}_sum{base} {sums.get(key, 0.0)}")
+            lines.append(f"{name}_count{base} {int(counts.get(key, 0))}")
+    else:
+        for key, v in entry.get("points", []):
+            lines.append(f"{name}{_labels(keys, tuple(key), extra)} {v}")
+    return lines
+
+
+def _fmt_float(v: float) -> str:
+    """Canonical float formatting for exposition values (`le` bounds):
+    always the shortest repr of the *float*, so integer boundaries render
+    identically to their float equivalents (5 -> "5.0", matching 5.0)."""
+    return repr(float(v))
+
+
 def _escape_label(value: str) -> str:
+    """The one escaping/validation point for every label value — tag values
+    and synthetic pairs (le, node_id) all pass through here."""
     return (str(value).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
 
 
-def _labels(keys: tuple, values: tuple, extra: tuple | None = None) -> str:
+def _labels(keys: tuple, values: tuple,
+            extra: list[tuple] | None = None) -> str:
     pairs = [(k, v) for k, v in zip(keys, values) if v != ""]
-    if extra:
-        pairs.append(extra)
+    pairs.extend(extra or ())
     if not pairs:
         return ""
     inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)

@@ -1,5 +1,7 @@
 from ray_tpu.util.state.api import (
+    get_flight_record,
     list_actors,
+    list_flight_records,
     list_nodes,
     list_objects,
     list_placement_groups,
@@ -9,7 +11,9 @@ from ray_tpu.util.state.api import (
 )
 
 __all__ = [
+    "get_flight_record",
     "list_actors",
+    "list_flight_records",
     "list_nodes",
     "list_objects",
     "list_placement_groups",

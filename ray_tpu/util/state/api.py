@@ -120,6 +120,28 @@ def summarize_tasks() -> dict[str, Any]:
     return summary
 
 
+def list_flight_records(kind: str | None = None) -> list[dict]:
+    """Debug bundles dumped by the failure flight recorder on this host
+    (task failures, worker deaths, actor deaths), oldest first. Each row
+    has ``name``/``path``/``kind``/``ts_ns``; load one with
+    ``get_flight_record(name)``."""
+    from ray_tpu.core import flight_recorder
+
+    rows = flight_recorder.list_records()
+    if kind:
+        rows = [r for r in rows if r["kind"] == kind]
+    return rows
+
+
+def get_flight_record(name: str) -> dict:
+    """Load one flight-recorder bundle: the failure's context ids plus the
+    last-N task events, finished spans, and a metrics snapshot captured at
+    failure time."""
+    from ray_tpu.core import flight_recorder
+
+    return flight_recorder.get_record(name)
+
+
 def list_logs(node_id: str | None = None) -> list[dict]:
     """Per-node worker log files (reference: `ray logs` listing via the
     dashboard agent). Cluster mode only; in-process runtimes have no

@@ -38,6 +38,7 @@ class Span:
 _enabled = False
 _ctx = threading.local()  # .trace_id, .span_id
 _spans: deque[Span] = deque(maxlen=100_000)
+_spans_total = 0  # monotone append count (flush cursor base)
 _lock = threading.Lock()
 
 
@@ -96,21 +97,27 @@ def span(name: str, kind: str = "internal", attributes: dict | None = None,
         trace_id=trace_id, span_id=_new_id(), parent_id=parent_id, name=name,
         kind=kind, start_ts=time.time(), attributes=dict(attributes or {}),
     )
-    prev = current_context()
+    # Save the raw thread-local slots (not current_context(), which collapses
+    # partial state to None): executor pool threads are reused across
+    # unrelated work, and an inexact restore leaks this span's ids into the
+    # next task that happens to land on the same thread.
+    prev_tid = getattr(_ctx, "trace_id", None)
+    prev_sid = getattr(_ctx, "span_id", None)
     _ctx.trace_id, _ctx.span_id = s.trace_id, s.span_id
     try:
         yield s
     except BaseException as e:
         s.status = f"ERROR: {type(e).__name__}"
+        s.attributes["exception.type"] = type(e).__name__
+        s.attributes["exception.message"] = str(e)
         raise
     finally:
         s.end_ts = time.time()
-        if prev:
-            _ctx.trace_id, _ctx.span_id = prev
-        else:
-            _ctx.trace_id = _ctx.span_id = None
+        _ctx.trace_id, _ctx.span_id = prev_tid, prev_sid
+        global _spans_total
         with _lock:
             _spans.append(s)
+            _spans_total += 1
 
 
 @contextlib.contextmanager
@@ -134,7 +141,35 @@ def export() -> list[dict]:
     return [asdict(s) for s in spans()]
 
 
+def flush_new(cursor: int, limit: int = 2000) -> tuple[list[dict], int]:
+    """Finished spans recorded since ``cursor`` as wire dicts, plus the new
+    cursor. The telemetry flusher ships these to the head WITHOUT removing
+    them locally (the in-process buffer stays useful for the flight recorder
+    and local /api/traces); attribute values are stringified so the batch
+    always survives msgpack. Bounded per call like the event flush
+    (reference: task_event_buffer.h kMaxNumTaskEventsToFlush)."""
+    import itertools
+
+    with _lock:
+        # _spans_total is monotone across clear() (cleared spans count as
+        # dropped), so a caller's cursor can never exceed it and there is
+        # no window where post-clear spans get skipped.
+        dropped = _spans_total - len(_spans)
+        start = max(0, min(cursor, _spans_total) - dropped)
+        batch = list(itertools.islice(_spans, start, start + limit))
+        new_cursor = dropped + start + len(batch)
+    out = [{
+        "trace_id": s.trace_id, "span_id": s.span_id,
+        "parent_id": s.parent_id, "name": s.name, "kind": s.kind,
+        "start_ts": s.start_ts, "end_ts": s.end_ts, "status": s.status,
+        "attributes": {k: str(v) for k, v in s.attributes.items()},
+    } for s in batch]
+    return out, new_cursor
+
+
 def clear() -> None:
+    # _spans_total deliberately NOT reset: it is the monotone cursor base
+    # for flush_new(), and cleared spans simply count as dropped.
     with _lock:
         _spans.clear()
 

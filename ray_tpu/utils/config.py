@@ -55,6 +55,9 @@ class Config:
 
     # --- control plane ---
     health_check_period_s: float = 1.0
+    # Superseded by telemetry_flush_interval_s (the batched telemetry push
+    # carries the task events); kept so existing RTPU_TASK_EVENT_* env
+    # settings don't error, but no longer read.
     task_event_flush_interval_s: float = 0.5
     health_check_timeout_s: float = 5.0
     health_check_failure_threshold: int = 5
@@ -75,6 +78,16 @@ class Config:
     # the sum of worker RSS exceeds threshold*budget (node-level pressure
     # against the detected cgroup/MemTotal limit always applies).
     memory_limit_bytes: int = 0
+
+    # --- observability ---
+    # Flight recorder: JSON debug bundles dumped on task failure / worker
+    # death / actor death under <temp_dir>/flight_records.
+    flight_recorder_enabled: bool = True
+    flight_recorder_max_bundles: int = 40
+    # Cluster telemetry: how often each process pushes its metric snapshot,
+    # finished spans, and drained task events to the head (<= 0 disables
+    # the push entirely).
+    telemetry_flush_interval_s: float = 0.5
 
     # --- tpu ---
     tpu_visible_chips_env: str = "TPU_VISIBLE_CHIPS"

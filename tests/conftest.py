@@ -24,6 +24,31 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def poll_until(predicate, timeout: float = 15.0, interval: float = 0.05,
+               desc: str = "condition"):
+    """Event-polling helper: spin on ``predicate`` with short sleeps until
+    it returns something truthy (returned) or the deadline passes
+    (AssertionError). Keeps observability tests deterministic without
+    sleep(>0.1) calls — poll fast, bound long."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while True:
+        value = predicate()
+        if value:
+            return value
+        if _time.monotonic() >= deadline:
+            raise AssertionError(f"timed out after {timeout}s waiting for {desc}")
+        _time.sleep(interval)
+
+
+@pytest.fixture
+def wait_for():
+    """Fixture handle for poll_until (conftest isn't importable as a module
+    from test files under rootdir-relative invocation)."""
+    return poll_until
+
+
 @pytest.fixture
 def rt_start():
     """In-process runtime with 8 fake CPUs and a fake 4-chip TPU host."""

@@ -55,6 +55,98 @@ class TestMetrics:
         with pytest.raises(ValueError):
             c.inc(tags={"bogus": "x"})
 
+    def test_le_canonical_float_format(self):
+        """Integer boundaries must render like their float equivalents
+        (le="5.0", not le="5") so scrapers see one canonical format."""
+        h = metrics.Histogram("test_int_bounds", "", boundaries=[1, 5])
+        h.observe(0.5)
+        h.observe(3)
+        text = metrics.registry().export_prometheus()
+        assert 'test_int_bounds_bucket{le="1.0"} 1' in text
+        assert 'test_int_bounds_bucket{le="5.0"} 2' in text
+        assert 'le="1"' not in text and 'le="5"' not in text
+
+    def test_label_escaping_shared_helper(self):
+        c = metrics.Counter("test_escape", "", tag_keys=("path",))
+        c.inc(tags={"path": 'a"b\\c\nd'})
+        text = metrics.registry().export_prometheus()
+        assert 'path="a\\"b\\\\c\\nd"' in text
+
+    def test_export_prometheus_concurrent_writers(self):
+        """N writer threads inc/observe while the main thread exports: no
+        exceptions, and the final export carries every increment."""
+        import threading
+
+        c = metrics.Counter("test_conc_total", "", tag_keys=("t",))
+        h = metrics.Histogram("test_conc_lat", "", boundaries=[0.5, 1.0])
+        n_threads, n_iters = 8, 300
+        start = threading.Barrier(n_threads + 1)
+        errors: list = []
+
+        def writer(idx: int):
+            try:
+                start.wait(timeout=10)
+                for _ in range(n_iters):
+                    c.inc(tags={"t": str(idx)})
+                    h.observe(0.25)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        start.wait(timeout=10)
+        exports = []
+        while any(t.is_alive() for t in threads):
+            exports.append(metrics.registry().export_prometheus())
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        final = metrics.registry().export_prometheus()
+        for i in range(n_threads):
+            assert f'test_conc_total{{t="{i}"}} {float(n_iters)}' in final
+        assert f"test_conc_lat_count {n_threads * n_iters}" in final
+        assert exports  # exporting concurrently never raised
+
+    def test_snapshot_merge_and_federated_export(self):
+        """Round-trip: registry -> snapshot -> (merge) -> federated text
+        with node_id labels on every series."""
+        c = metrics.Counter("test_fed_total", "reqs", tag_keys=("route",))
+        c.inc(2, tags={"route": "/x"})
+        g = metrics.Gauge("test_fed_depth", "")
+        g.set(3)
+        h = metrics.Histogram("test_fed_lat", "", boundaries=[1.0])
+        h.observe(0.5)
+        snap_a = metrics.registry().snapshot()
+        c.inc(3, tags={"route": "/x"})  # node B reports a later state
+        snap_b = metrics.registry().snapshot()
+        # Two processes on one node merge: counters sum, gauges last-write.
+        merged = metrics.merge_snapshots([snap_a, snap_b])
+        entry = next(e for e in merged["metrics"]
+                     if e["name"] == "test_fed_total")
+        assert dict((tuple(k), v) for k, v in entry["points"])[("/x",)] == 7.0
+        text = metrics.export_prometheus_federated(
+            {"nodeA": snap_a, "nodeB": snap_b})
+        assert 'test_fed_total{route="/x",node_id="nodeA"} 2.0' in text
+        assert 'test_fed_total{route="/x",node_id="nodeB"} 5.0' in text
+        assert 'test_fed_depth{node_id="nodeA"} 3.0' in text
+        assert 'test_fed_lat_bucket{node_id="nodeA",le="1.0"} 1' in text
+        # HELP/TYPE once per metric name, not once per node
+        assert text.count("# TYPE test_fed_total counter") == 1
+
+    def test_dropped_events_counter_exported(self):
+        buf = events.TaskEventBuffer(max_events=2)
+        for i in range(5):
+            buf.record(f"t{i}", "noisy", "SUBMITTED")
+        assert buf.dropped == 3
+        text = metrics.registry().export_prometheus()
+        assert "task_events_dropped_total" in text
+        value = next(
+            float(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("task_events_dropped_total "))
+        assert value >= 3
+
 
 class TestTaskEventsAndTimeline:
     def test_events_recorded(self, rt_start):
@@ -131,7 +223,47 @@ class TestTracing:
         with pytest.raises(RuntimeError):
             with tracing.span("bad"):
                 raise RuntimeError("no")
-        assert tracing.spans()[-1].status.startswith("ERROR")
+        s = tracing.spans()[-1]
+        assert s.status.startswith("ERROR")
+        assert s.attributes["exception.type"] == "RuntimeError"
+        assert s.attributes["exception.message"] == "no"
+
+    def test_span_context_restored_in_pool_threads(self):
+        """A span opened on an executor pool thread must not leak its ids
+        into the next task that reuses the same thread."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        tracing.enable_tracing()
+        pool = ThreadPoolExecutor(max_workers=1)
+
+        def traced_work():
+            with tracing.span("pooled-op"):
+                pass
+            return tracing.current_context()
+
+        def probe():
+            return tracing.current_context()
+
+        assert pool.submit(traced_work).result() is None
+        # Same thread, next task: no inherited context.
+        assert pool.submit(probe).result() is None
+        pool.shutdown()
+
+    def test_flush_new_keeps_local_spans(self):
+        tracing.enable_tracing()
+        with tracing.span("a"):
+            pass
+        with tracing.span("b"):
+            pass
+        batch, cursor = tracing.flush_new(0)
+        assert [s["name"] for s in batch] == ["a", "b"]
+        assert len(tracing.spans()) == 2  # flush is a copy, not a drain
+        batch2, cursor2 = tracing.flush_new(cursor)
+        assert batch2 == [] and cursor2 == cursor
+        with tracing.span("c"):
+            pass
+        batch3, _ = tracing.flush_new(cursor)
+        assert [s["name"] for s in batch3] == ["c"]
 
 
 class TestStateApi:
@@ -173,12 +305,10 @@ class TestStateApi:
 
 
 class TestClusterEvents:
-    def test_worker_events_reach_driver(self):
+    def test_worker_events_reach_driver(self, wait_for):
         """Worker-side RUNNING/FINISHED events flush to the head and appear in
         the driver's list_tasks and timeline (reference: TaskEventBuffer →
         GcsTaskManager → state API)."""
-        import time
-
         import ray_tpu
         from ray_tpu.util import state
 
@@ -190,14 +320,12 @@ class TestClusterEvents:
                 return 7
 
             assert ray_tpu.get(traced_task.remote()) == 7
-            deadline = time.monotonic() + 10
-            while time.monotonic() < deadline:
+
+            def finished():
                 rows = state.list_tasks(filters=[("name", "=", "traced_task")])
-                if rows and rows[0]["state"] == "FINISHED":
-                    break
-                time.sleep(0.2)
-            else:
-                raise AssertionError(f"worker events never arrived: {rows}")
+                return rows and rows[0]["state"] == "FINISHED"
+
+            wait_for(finished, timeout=15, desc="worker events at the head")
             trace = ray_tpu.timeline()
             assert any(ev["name"] == "traced_task" for ev in trace)
         finally:
@@ -342,8 +470,11 @@ def test_cli_timeline(rt_start, tmp_path, capsys):
     assert main(["timeline", "--out", out]) == 0
     import json as _json
 
-    events = _json.load(open(out))
-    assert isinstance(events, list)
+    doc = _json.load(open(out))
+    # Chrome-trace object format: task slices + span rows under traceEvents.
+    assert isinstance(doc, dict) and doc["traceEvents"]
+    names = {ev.get("name") for ev in doc["traceEvents"]}
+    assert "work" in names
 
 
 def test_usage_recording(rt_start, tmp_path, monkeypatch):
@@ -357,12 +488,268 @@ def test_usage_recording(rt_start, tmp_path, monkeypatch):
     assert "library:secret" not in usage.recorded_features()
 
 
+class TestFlightRecorder:
+    def test_failing_task_dumps_bundle(self, rt_start, tmp_path, wait_for,
+                                       monkeypatch):
+        """A terminally failing task produces a debug bundle with the task's
+        events, the client + worker spans, and a metrics snapshot —
+        retrievable via ray_tpu.util.state (reference capability: a
+        post-mortem slice of GcsTaskManager + the metrics agent)."""
+        import os
+
+        from ray_tpu.core import flight_recorder
+        from ray_tpu.utils.config import get_config
+
+        monkeypatch.setattr(get_config(), "temp_dir", str(tmp_path))
+        rt = rt_start
+        tracing.enable_tracing()
+        gate = str(tmp_path / "gate")
+
+        @rt.remote(max_retries=0)
+        def kaboom(gate_path):
+            import os as _os
+            import time as _time
+
+            deadline = _time.monotonic() + 5
+            while not _os.path.exists(gate_path) and \
+                    _time.monotonic() < deadline:
+                _time.sleep(0.005)
+            raise ValueError("flight-test")
+
+        with tracing.span("driver-submit", kind="client"):
+            ref = kaboom.remote(gate)
+        # Open the gate only once the client span is closed, so the bundle
+        # dumped at failure time deterministically contains it.
+        with open(gate, "w") as f:
+            f.write("go")
+        with pytest.raises(Exception):
+            rt.get(ref)
+
+        def bundle():
+            for rec in reversed(flight_recorder.list_records()):
+                b = flight_recorder.get_record(rec["name"])
+                if b["kind"] == "task_failure" and any(
+                        e["state"] == "FAILED" and e["name"] == "kaboom"
+                        for e in b["events"]):
+                    return b
+            return None
+
+        b = wait_for(bundle, timeout=10, desc="task_failure flight record")
+        assert "flight-test" in b["reason"]
+        span_names = {s["name"] for s in b["spans"]}
+        assert "driver-submit" in span_names  # client side
+        assert "kaboom" in span_names  # worker side
+        worker_span = next(s for s in b["spans"] if s["name"] == "kaboom")
+        client_span = next(s for s in b["spans"]
+                           if s["name"] == "driver-submit")
+        assert worker_span["trace_id"] == client_span["trace_id"]
+        assert b["metrics"]["metrics"]  # snapshot captured
+        assert os.path.dirname(bundle_path := flight_recorder.list_records()
+                               [-1]["path"]) == flight_recorder.records_dir()
+        assert os.path.exists(bundle_path)
+        # state API surface
+        from ray_tpu.util.state import get_flight_record, list_flight_records
+
+        rows = list_flight_records(kind="task_failure")
+        assert rows
+        assert get_flight_record(rows[-1]["name"])["kind"] == "task_failure"
+
+    def test_bundle_pruning(self, tmp_path, monkeypatch):
+        from ray_tpu.core import flight_recorder
+        from ray_tpu.utils.config import get_config
+
+        monkeypatch.setattr(get_config(), "temp_dir", str(tmp_path))
+        monkeypatch.setattr(get_config(), "flight_recorder_max_bundles", 3)
+        monkeypatch.setattr(flight_recorder, "MIN_INTERVAL_S", 0.0)
+        for i in range(6):
+            assert flight_recorder.record("task_failure", reason=f"r{i}")
+        rows = flight_recorder.list_records()
+        assert len(rows) == 3
+        assert flight_recorder.get_record(rows[-1]["name"])["reason"] == "r5"
+
+    def test_disabled(self, tmp_path, monkeypatch):
+        from ray_tpu.core import flight_recorder
+        from ray_tpu.utils.config import get_config
+
+        monkeypatch.setattr(get_config(), "temp_dir", str(tmp_path))
+        monkeypatch.setattr(get_config(), "flight_recorder_enabled", False)
+        assert flight_recorder.record("task_failure") is None
+        assert flight_recorder.list_records() == []
+
+
+class TestHotPathMetrics:
+    def test_train_report_gauges(self):
+        from ray_tpu.train import session
+
+        # Distinctive rank: other suites' Trainer runs report under ranks
+        # 0..n in this same process-wide registry.
+        ctx = session.TrainContext(world_rank=77)
+        session.set_context(ctx)
+        try:
+            session.report({"loss": 1.0, "tokens": 512})
+            session.report({"loss": 0.9, "tokens": 512,
+                            "flops": 1e9, "peak_flops": 1e12})
+        finally:
+            session.set_context(None)
+        text = metrics.registry().export_prometheus()
+        assert 'train_step_time_s{rank="77"}' in text
+        assert 'train_tokens_per_s{rank="77"}' in text
+        assert 'train_mfu{rank="77"}' in text
+        assert 'train_reports_total{rank="77"} 2.0' in text
+
+    def test_serve_replica_ttft_tpot(self):
+        from ray_tpu.serve.replica import ServeReplica
+        from ray_tpu.utils import serialization
+
+        def double(x):
+            return x * 2
+
+        rep = ServeReplica("obsdep", "r1", serialization.serialize(double),
+                           serialization.serialize(((), {})))
+        assert rep.handle_request("__call__", (21,), {}) == 42
+        text = metrics.registry().export_prometheus()
+        assert 'serve_ttft_s_count{deployment="obsdep"} 1' in text
+        assert 'serve_request_latency_s_count{deployment="obsdep"} 1' in text
+        assert 'serve_replica_requests_total{deployment="obsdep",' \
+               'replica="r1"} 1.0' in text
+
+        def gen(n):
+            for i in range(n):
+                yield i
+
+        rep2 = ServeReplica("obsgen", "r2", serialization.serialize(gen),
+                            serialization.serialize(((), {})))
+        chunks = list(rep2.handle_request_streaming("__call__", (3,), {}))
+        assert chunks[0] == {"streaming": True} and chunks[1:] == [0, 1, 2]
+        text = metrics.registry().export_prometheus()
+        assert 'serve_ttft_s_count{deployment="obsgen"} 1' in text
+        assert 'serve_tpot_s_count{deployment="obsgen"} 2' in text
+
+    def test_collective_op_metrics(self, cpu_mesh_devices):
+        import numpy as np
+
+        try:
+            import ray_tpu.collective as col
+        except ImportError as e:  # pre-existing env gap (jax.shard_map)
+            pytest.skip(f"collective backend unimportable here: {e}")
+
+        col.init_collective_group(backend="xla", group_name="obs_coll",
+                                  devices=cpu_mesh_devices, world_size=8)
+        try:
+            out = np.asarray(col.allreduce(np.ones(8, np.float32),
+                                           group_name="obs_coll"))
+            np.testing.assert_allclose(out, 8 * np.ones(8))
+        finally:
+            col.destroy_collective_group("obs_coll")
+        text = metrics.registry().export_prometheus()
+        assert 'collective_op_latency_s_count{op="allreduce",' \
+               'group="obs_coll"} 1' in text
+        assert 'collective_op_bytes_count{op="allreduce",' \
+               'group="obs_coll"} 1' in text
+
+
+class TestFederatedTelemetry:
+    def test_two_node_metrics_at_head(self, wait_for):
+        """Acceptance path: a 2-node cluster whose workers populate train +
+        serve metrics; the head's telemetry table and the dashboard's
+        /metrics show series from BOTH nodes under distinct node_id labels."""
+        import urllib.request as _rq
+
+        import ray_tpu
+        from ray_tpu.cluster_utils import Cluster
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.utils.ids import JobID
+
+        c = Cluster()
+        c.add_node(num_cpus=1, node_id="obsnodea")
+        c.add_node(num_cpus=1, node_id="obsnodeb")
+        rt = c.connect()
+        old = (global_worker.runtime, global_worker.worker_id,
+               global_worker.node_id, global_worker.mode,
+               global_worker.job_id)
+        global_worker.runtime = rt
+        global_worker.worker_id = rt.worker_id
+        global_worker.node_id = rt.node_id
+        global_worker.job_id = JobID.from_random()
+        global_worker.mode = "cluster"
+        try:
+            @ray_tpu.remote(num_cpus=1)
+            class Reporter:
+                def bump(self):
+                    from ray_tpu.serve.replica import ServeReplica
+                    from ray_tpu.train import session
+                    from ray_tpu.utils import serialization as ser
+
+                    ctx = session.TrainContext(world_rank=0)
+                    session.set_context(ctx)
+                    session.report({"tokens": 128})
+                    session.report({"tokens": 128})
+                    session.set_context(None)
+                    rep = ServeReplica(
+                        "fed", "r0", ser.serialize(lambda x: x),
+                        ser.serialize(((), {})))
+                    rep.handle_request("__call__", (1,), {})
+                    return True
+
+            # One 1-CPU actor per 1-CPU node: placement must spread them.
+            a, b = Reporter.remote(), Reporter.remote()
+            assert ray_tpu.get([a.bump.remote(), b.bump.remote()],
+                               timeout=120) == [True, True]
+
+            def both_nodes():
+                # Only WORKER-process sources count: this pytest process
+                # (driver + in-process daemons, source "<node>:<ourpid>")
+                # reports a registry other tests already filled with train
+                # series, which must not satisfy the wait before both
+                # Reporter workers actually flushed.
+                import os as _os
+
+                me = f":{_os.getpid()}"
+                nodes = set()
+                for src, row in rt.get_telemetry().get(
+                        "sources", {}).items():
+                    if src.endswith(me):
+                        continue
+                    for entry in (row.get("snapshot") or {}).get(
+                            "metrics", []):
+                        if entry["name"] == "train_step_time_s" and \
+                                entry.get("points"):
+                            nodes.add(row["node_id"])
+                return nodes if len(nodes) >= 2 else None
+
+            nodes = wait_for(both_nodes, timeout=30,
+                             desc="train metrics from both nodes")
+            assert nodes == {"obsnodea", "obsnodeb"}
+
+            from ray_tpu.dashboard.http_server import DashboardServer
+
+            srv = DashboardServer()
+            host, port = srv.start()
+            try:
+                with _rq.urlopen(f"http://{host}:{port}/metrics",
+                                 timeout=10) as r:
+                    text = r.read().decode()
+            finally:
+                srv.stop()
+            for nid in ("obsnodea", "obsnodeb"):
+                assert f'train_step_time_s{{rank="0",node_id="{nid}"}}' \
+                    in text, text[:2000]
+                assert f'train_tokens_per_s{{rank="0",node_id="{nid}"}}' \
+                    in text
+            assert 'serve_ttft_s_bucket{deployment="fed"' in text
+            assert 'serve_ttft_s_count{deployment="fed"' in text
+        finally:
+            rt.shutdown()
+            c.shutdown()
+            (global_worker.runtime, global_worker.worker_id,
+             global_worker.node_id, global_worker.mode,
+             global_worker.job_id) = old
+
+
 class TestLogs:
-    def test_list_and_tail_worker_logs(self):
+    def test_list_and_tail_worker_logs(self, wait_for):
         """Per-node worker log listing + tail through the daemons
         (reference: `ray logs` via the dashboard agent)."""
-        import time
-
         from ray_tpu.cluster_utils import Cluster
         from ray_tpu.core.remote_function import remote
         from ray_tpu.core.worker import global_worker
@@ -389,14 +776,20 @@ class TestLogs:
                 return 1
 
             assert ray_tpu.get(noisy.remote(), timeout=60) == 1
-            time.sleep(0.3)  # let the worker's write hit the file
-            logs = list_logs()
-            assert logs and all("filename" in l and "node_id" in l
-                                for l in logs)
-            found = any(
-                "log-marker-xyzzy" in get_log(l["filename"], l["node_id"])
-                for l in logs)
-            assert found, "worker print not found in any log file"
+
+            def marker_logged():
+                logs = list_logs()
+                if not logs:
+                    return None
+                assert all("filename" in l and "node_id" in l for l in logs)
+                if any("log-marker-xyzzy" in get_log(l["filename"],
+                                                     l["node_id"])
+                       for l in logs):
+                    return logs
+                return None
+
+            logs = wait_for(marker_logged, timeout=10,
+                            desc="worker print in a log file")
             with pytest.raises(FileNotFoundError):
                 get_log("../etc/passwd", logs[0]["node_id"])
         finally:
