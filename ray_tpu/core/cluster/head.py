@@ -34,6 +34,11 @@ class NodeInfo:
     alive: bool = True
     pending_demands: list = field(default_factory=list)  # autoscaler feed
     transfer_addr: tuple | None = None  # native object-transfer server
+    # Same-host zero-copy descriptor: {"shm_name": ..., "boot_id": ...}.
+    # A puller whose boot_id matches maps the node's arena directly and
+    # reads objects with no wire transfer at all (plasma-style same-host
+    # sharing, extended across co-hosted node daemons).
+    object_plane: dict | None = None
     # Optimistic per-resource holds for placements issued within the
     # current heartbeat window (back-to-back placements must not all see
     # the node as free). Kept OUT of ``available`` so the resource views
@@ -127,6 +132,7 @@ class HeadServer:
         r("list_nodes", self._list_nodes)
         r("register_worker", self._register_worker)
         r("resolve_worker", self._resolve_worker)
+        r("resolve_workers", self._resolve_workers)
         r("register_actor", self._register_actor)
         r("actor_ready", self._actor_ready)
         r("actor_failed", self._actor_failed)
@@ -397,12 +403,14 @@ class HeadServer:
         self, conn: ServerConnection, node_id: str, host: str, port: int,
         resources: dict, labels: dict | None = None,
         transfer_addr: list | None = None,
+        object_plane: dict | None = None,
     ):
         self._drop_daemon_client(node_id)  # re-registration: stale address
         self.nodes[node_id] = NodeInfo(
             node_id=node_id, addr=(host, port), resources=dict(resources),
             available=dict(resources), labels=labels or {},
             transfer_addr=tuple(transfer_addr) if transfer_addr else None,
+            object_plane=dict(object_plane) if object_plane else None,
         )
         conn.meta["node_id"] = node_id
         self._node_conns[node_id] = conn
@@ -455,6 +463,7 @@ class HeadServer:
                 "available": n.available, "alive": n.alive, "labels": n.labels,
                 "transfer_addr": (list(n.transfer_addr)
                                   if n.transfer_addr else None),
+                "object_plane": n.object_plane,
             }
             for nid, n in self.nodes.items()
         }
@@ -493,6 +502,21 @@ class HeadServer:
         host, port = row[0], row[1]
         node_id = row[2] if len(row) > 2 else ""
         return {"addr": [host, port], "node_id": node_id}
+
+    async def _resolve_workers(self, conn: ServerConnection,
+                               worker_ids: list):
+        """Batch directory lookup: one round trip resolves every serving
+        copy a multi-source referral named (the pull scheduler maps worker
+        hexes to node transfer endpoints before splitting ranges)."""
+        out = {}
+        for worker_id in worker_ids or ():
+            row = self.workers.get(worker_id)
+            if row is None:
+                out[worker_id] = None
+                continue
+            out[worker_id] = {"addr": [row[0], row[1]],
+                              "node_id": row[2] if len(row) > 2 else ""}
+        return {"workers": out}
 
     # ------------------------------------------------------------------ actors
     # FSM parity: reference gcs_actor_manager.cc — REGISTER → schedule (lease

@@ -221,6 +221,20 @@ class NodeDaemon:
     async def _ping(self, conn, **kw):
         return {"ok": True, "node_id": self.node_id}
 
+    def _object_plane_info(self) -> dict | None:
+        """Same-host zero-copy descriptor advertised through the head: a
+        puller on the SAME host (matching boot_id) maps this node's arena
+        by name and reads objects with no transfer at all — plasma-style
+        same-host sharing extended across co-hosted node daemons."""
+        if not self.shm_name:
+            return None
+        from ray_tpu.core.transfer import host_boot_id
+
+        boot_id = host_boot_id()
+        if not boot_id:
+            return None
+        return {"shm_name": self.shm_name, "boot_id": boot_id}
+
     async def start(self) -> tuple[str, int]:
         addr = await self.rpc.start()
         self._head = AsyncRpcClient(*self.head_addr)
@@ -232,6 +246,7 @@ class NodeDaemon:
             resources=self.resources, labels=self.labels,
             transfer_addr=(list(self.transfer_addr)
                            if self.transfer_addr else None),
+            object_plane=self._object_plane_info(),
         )
         loop = asyncio.get_running_loop()
         self._bg.append(loop.create_task(self._heartbeat_loop()))
@@ -405,25 +420,30 @@ class NodeDaemon:
     # whole TPU host's daemon.
 
     @staticmethod
-    def _detect_memory_limit() -> int:
-        """cgroup limit if confined, else MemTotal."""
-        for path in ("/sys/fs/cgroup/memory.max",
-                     "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+    def _detect_memory_limit() -> tuple[int, str]:
+        """(limit_bytes, source) — cgroup limit if confined, else MemTotal.
+        ``source`` ('cgroup2' | 'cgroup1' | 'meminfo') tells the usage
+        probe which accounting to read: comparing a cgroup limit against
+        whole-host /proc/meminfo usage either never fires (host >> cgroup)
+        or fires on other tenants' memory."""
+        for path, source in (
+                ("/sys/fs/cgroup/memory.max", "cgroup2"),
+                ("/sys/fs/cgroup/memory/memory.limit_in_bytes", "cgroup1")):
             try:
                 with open(path) as f:
                     raw = f.read().strip()
                 if raw.isdigit() and int(raw) < 1 << 60:
-                    return int(raw)
+                    return int(raw), source
             except OSError:
                 continue
         try:
             with open("/proc/meminfo") as f:
                 for line in f:
                     if line.startswith("MemTotal:"):
-                        return int(line.split()[1]) * 1024
+                        return int(line.split()[1]) * 1024, "meminfo"
         except OSError:
             pass
-        return 0
+        return 0, "meminfo"
 
     @staticmethod
     def _rss_bytes(pid: int) -> int:
@@ -469,10 +489,36 @@ class NodeDaemon:
         return self._worker_fates.get(worker_id) or {}
 
     @staticmethod
-    def _node_used_bytes() -> int:
-        """Node-level used memory (MemTotal - MemAvailable), the same
-        signal the reference memory monitor polls — catches pressure from
-        ANY process on the host, not just workers."""
+    def _node_used_bytes(source: str = "meminfo") -> int:
+        """Node-level used memory, read from the SAME accounting domain
+        the limit came from (reference: memory_monitor.py reads
+        memory.current/usage_in_bytes when cgroup-confined):
+        - cgroup2: memory.current of the confining cgroup.
+        - cgroup1: memory.usage_in_bytes minus the file cache (cache is
+          reclaimable — counting it would OOM-kill workers for page cache).
+        - meminfo: MemTotal - MemAvailable (limit was MemTotal) — catches
+          pressure from ANY process on the host, not just workers."""
+        if source == "cgroup2":
+            try:
+                with open("/sys/fs/cgroup/memory.current") as f:
+                    return int(f.read().strip())
+            except (OSError, ValueError):
+                pass  # cgroup vanished mid-run: fall back to meminfo
+        elif source == "cgroup1":
+            try:
+                with open(
+                        "/sys/fs/cgroup/memory/memory.usage_in_bytes") as f:
+                    used = int(f.read().strip())
+                cache = 0
+                with open("/sys/fs/cgroup/memory/memory.stat") as f:
+                    for line in f:
+                        if line.startswith("total_cache ") or \
+                                line.startswith("cache "):
+                            cache = int(line.split()[1])
+                            break
+                return max(0, used - cache)
+            except (OSError, ValueError, IndexError):
+                pass
         total = avail = 0
         try:
             with open("/proc/meminfo") as f:
@@ -495,7 +541,7 @@ class NodeDaemon:
           share on hosts where the daemon co-exists with other services,
           and gives tests a hermetic trigger."""
         cfg = get_config()
-        node_limit = self._detect_memory_limit()
+        node_limit, limit_source = self._detect_memory_limit()
         budget = cfg.memory_limit_bytes
         if not node_limit and not budget:
             return
@@ -503,7 +549,7 @@ class NodeDaemon:
             await asyncio.sleep(cfg.memory_monitor_interval_s)
             usage = limit = 0
             if node_limit:
-                node_used = self._node_used_bytes()
+                node_used = self._node_used_bytes(limit_source)
                 if node_used > cfg.memory_usage_threshold * node_limit:
                     usage, limit = node_used, node_limit
             if not limit and budget:
@@ -711,7 +757,8 @@ class NodeDaemon:
                 port=self.rpc.port, resources=self.resources,
                 labels=self.labels,
                 transfer_addr=(list(self.transfer_addr)
-                               if self.transfer_addr else None))
+                               if self.transfer_addr else None),
+                object_plane=self._object_plane_info())
             old, self._head = self._head, client
             try:
                 await old.close()

@@ -37,6 +37,7 @@ from ray_tpu.core.cluster.protocol import (
     AsyncRpcClient,
     EventLoopThread,
     RpcClient,
+    RpcConnectionLost,
     RpcError,
     RpcServer,
     spawn_task,
@@ -174,6 +175,12 @@ class ClusterRuntime:
         # all copies, with a bounded number of outstanding referrals so the
         # source's egress stays bounded under a simultaneous fan-out.
         self._replicas: dict[ObjectID, set[str]] = {}
+        # Workers whose pull of an owned object is still IN FLIGHT: their
+        # nodes serve landed ranges cut-through against the sealed-range
+        # watermark, so they count as (partial) serving copies for the
+        # multi-source range engine (reference: push_manager.h starts
+        # chunked pushes before the whole object arrives at a relay).
+        self._partials: dict[ObjectID, set[str]] = {}
         self._reported_holder: dict[ObjectID, str] = {}  # oid -> owner hex
         self._borrow_cache: dict[ObjectID, float] = {}  # released-borrow ts
         # Borrowed copies promoted to primary by the owner after it lost its
@@ -182,8 +189,19 @@ class ClusterRuntime:
         # sweep on caller threads).
         self._pinned_borrows: set[ObjectID] = set()
         self._borrow_lock = threading.Lock()
-        self._referrals: dict[ObjectID, list[float]] = {}  # issue stamps
+        # Per-source outstanding referral stamps (bounded in-flight pulls
+        # per serving copy): oid -> {worker hex -> [issue ts, ...]}.
+        self._referrals: dict[ObjectID, dict[str, list[float]]] = {}
+        # Outstanding referral GRANTS (ts, [sources charged]): freeing a
+        # slot must uncharge every source the grant stamped, or k-source
+        # referrals leak k-1 phantom in-flight entries per pull until the
+        # TTL and the budget throttles idle copies.
+        self._referral_grants: dict[ObjectID, deque] = {}
         self.refer_counts: dict[ObjectID, dict[str, int]] = {}  # observability
+        # Extra serving copies (worker hexes) for the pull currently in
+        # flight on a caller thread, stashed between the owner's referral
+        # and the native multi-source pull.
+        self._pull_extra: dict[ObjectID, tuple] = {}
         self._io = EventLoopThread.get()
         self.head = RpcClient(head_host, head_port)
         self._head_host, self._head_port = head_host, head_port
@@ -203,6 +221,13 @@ class ClusterRuntime:
         self._peer_lock = threading.Lock()
         self._actor_addr_cache: dict[str, tuple[str, int]] = {}
         self._holder_nodes: dict[str, str] = {}  # worker hex -> node hex
+        # worker hex -> (ts, addr, node): short-TTL directory cache — the
+        # pull hot path resolved the same holder through the head per get,
+        # and those round trips dwarfed the wire time of warm pulls.
+        self._worker_dir_cache: dict[str, tuple[float, tuple | None, str]] = {}
+        # Mapped peer-node arenas for same-host zero-copy reads
+        # (shm name -> attached SharedMemoryStore).
+        self._peer_arenas: dict[str, Any] = {}
         self._nodes_cache: tuple[float, dict] | None = None  # (ts, nodes)
         self._xfer_cache = None  # (ts, {node_id: transfer_addr})
         self._actor_states: dict[str, str] = {}
@@ -343,28 +368,57 @@ class ClusterRuntime:
     RELAY_REFERRALS_PER_COPY = 2
     REFERRAL_TTL_S = 15.0
 
-    def _pick_copy(self, object_id: ObjectID, primary: str) -> str | None:
-        """Choose which copy a puller should fetch from. Returns None when
-        the referral budget (bounded source egress) is exhausted — the
-        puller backs off and retries, by which time finished pulls have
-        become new copies and the budget has grown."""
-        copies = [primary] + [h for h in sorted(self._replicas.get(object_id, ()))
-                              if h != primary]
+    def _pick_copies(self, object_id: ObjectID, primary: str,
+                     exclude: str = "") -> list[str] | None:
+        """Choose the serving copies for one puller: a FULL copy leads (the
+        RPC fallback path needs a sealed object to chunk from) plus up to
+        ``transfer_max_sources - 1`` extra full/partial copies for the
+        multi-source range engine — partial copies serve their landed
+        ranges cut-through. Each source carries a bounded number of
+        outstanding referrals (per-source in-flight budget, the egress
+        bound of reference push_manager.h); returns None when every copy is
+        saturated — the puller backs off briefly, by which time in-flight
+        pulls have joined as partial copies and the budget has grown."""
         now = time.monotonic()
-        stamps = [t for t in self._referrals.get(object_id, ())
-                  if now - t < self.REFERRAL_TTL_S]
-        if len(stamps) >= self.RELAY_REFERRALS_PER_COPY * len(copies):
-            self._referrals[object_id] = stamps
-            return None
-        stamps.append(now)
-        self._referrals[object_id] = stamps
-        # Least-referred copy wins: spreads load deterministically as new
-        # copies join (an index-based round-robin can keep landing on the
-        # primary while the copy list grows under it).
+        per_src = self._referrals.setdefault(object_id, {})
+        for src in list(per_src):
+            fresh = [t for t in per_src[src]
+                     if now - t < self.REFERRAL_TTL_S]
+            if fresh:
+                per_src[src] = fresh
+            else:
+                del per_src[src]
+        full = [primary] + [h for h in sorted(self._replicas.get(object_id, ()))
+                            if h != primary and h != exclude]
+        partial = [h for h in sorted(self._partials.get(object_id, ()))
+                   if h not in full and h != exclude]
+
+        def load(src: str) -> int:
+            return len(per_src.get(src, ()))
+
+        budget = self.RELAY_REFERRALS_PER_COPY
+        open_full = [s for s in full if load(s) < budget]
+        if not open_full:
+            if not any(load(s) < budget for s in partial):
+                return None  # everything saturated: puller backs off
+            # Full copies are all at budget but partial relays have slack:
+            # lead with the least-loaded full copy anyway — the range
+            # engine spreads most bytes onto the partials.
+            open_full = [min(full, key=load)]
+        lead = min(open_full, key=load)
+        k = max(1, get_config().transfer_max_sources)
+        extras = sorted((s for s in full + partial
+                         if s != lead and load(s) < budget), key=load)
+        picked = [lead] + extras[:k - 1]
         counts = self.refer_counts.setdefault(object_id, {})
-        pick = min(copies, key=lambda c: counts.get(c, 0))
-        counts[pick] = counts.get(pick, 0) + 1
-        return pick
+        for s in picked:
+            per_src.setdefault(s, []).append(now)
+            counts[s] = counts.get(s, 0) + 1
+        grants = self._referral_grants.setdefault(object_id, deque())
+        grants.append((now, picked))
+        while grants and now - grants[0][0] >= self.REFERRAL_TTL_S:
+            grants.popleft()  # stamps already TTL-pruned above
+        return picked
 
     def _local_size(self, object_id: ObjectID) -> int | None:
         n = self.store.size(object_id)
@@ -373,7 +427,8 @@ class ClusterRuntime:
         return n
 
     async def _handle_get_object(self, conn, oid: str, timeout: float = 10.0,
-                                 poll_s: float | None = None):
+                                 poll_s: float | None = None,
+                                 requester: str = ""):
         """Long-poll object resolution. ``poll_s`` is the CALLER's budget —
         always shorter than its RPC timeout, so under load we answer
         'pending' (caller re-polls) instead of letting the RPC time out
@@ -385,14 +440,25 @@ class ClusterRuntime:
             size = self._local_size(object_id)
             if size is not None:
                 if size >= self.RELAY_MIN_BYTES:
-                    # Never inline large objects: refer the puller to a
-                    # copy (possibly us) so it uses the bounded chunk /
-                    # native-transfer path and joins the relay set.
-                    loc = self._pick_copy(object_id, self.worker_id.hex())
-                    if loc is None:
+                    # Never inline large objects: refer the puller to
+                    # serving copies (possibly us) so it uses the bounded
+                    # chunk / native-transfer path and joins the relay set.
+                    if await self._same_host_requester(requester,
+                                                      self.my_node_id):
+                        # Same-host puller: it reads the arena directly
+                        # (no egress) — bypass the referral budget.
+                        counts = self.refer_counts.setdefault(object_id, {})
+                        me = self.worker_id.hex()
+                        counts[me] = counts.get(me, 0) + 1
+                        return {"location": me, "locations": [me],
+                                "size": size, "budgeted": False}
+                    locs = self._pick_copies(object_id, self.worker_id.hex(),
+                                             exclude=requester)
+                    if locs is None:
                         await asyncio.sleep(0.05)
                         continue  # referral budget exhausted: brief backoff
-                    return {"location": loc}
+                    return {"location": locs[0], "locations": locs,
+                            "size": size}
                 data = await asyncio.get_running_loop().run_in_executor(
                     None, self._local_blob, object_id
                 )
@@ -407,13 +473,51 @@ class ClusterRuntime:
                     # are only freed by report_holder, which pullers send
                     # for large cached copies alone.
                     return {"location": holder}
-                loc = self._pick_copy(object_id, holder)
-                if loc is None:
+                holder_node = self._holder_nodes.get(holder)
+                if holder_node and await self._same_host_requester(
+                        requester, holder_node):
+                    counts = self.refer_counts.setdefault(object_id, {})
+                    counts[holder] = counts.get(holder, 0) + 1
+                    return {"location": holder, "locations": [holder],
+                            "size": known, "budgeted": False}
+                locs = self._pick_copies(object_id, holder,
+                                         exclude=requester)
+                if locs is None:
                     await asyncio.sleep(0.05)
                     continue
-                return {"location": loc}
+                return {"location": locs[0], "locations": locs,
+                        "size": known}
             await asyncio.sleep(0.01)
         return {"pending": True}
+
+    async def _same_host_requester(self, requester: str,
+                                   holder_node: str) -> bool:
+        """True when the requesting worker's node shares a host (boot id)
+        with the serving copy's node — its pull is a direct arena read
+        with no egress, so the referral budget doesn't apply. Best-effort:
+        any resolution failure returns False (budgeted path)."""
+        if not requester or not holder_node or \
+                not get_config().transfer_same_host_arena:
+            return False
+        try:
+            node = self._holder_nodes.get(requester)
+            if node is None:
+                res = await self.head.aio.call("resolve_worker",
+                                               worker_id=requester)
+                node = res.get("node_id") or ""
+                if node:
+                    self._holder_nodes[requester] = node
+            if not node:
+                return False
+            if node == holder_node:
+                return True
+            nodes = await self._nodes_cached()
+            plane_a = (nodes.get(node) or {}).get("object_plane") or {}
+            plane_b = (nodes.get(holder_node) or {}).get("object_plane") or {}
+            boot_a, boot_b = plane_a.get("boot_id"), plane_b.get("boot_id")
+            return bool(boot_a) and boot_a == boot_b
+        except Exception:
+            return False
 
     async def _handle_pin_object(self, conn, oid: str):
         """The owner promoted our cached copy to primary: exempt it from
@@ -427,37 +531,91 @@ class ClusterRuntime:
             self._borrow_cache.pop(object_id, None)
         return {"ok": True, "present": True}
 
+    def _free_referral_slot(self, object_id: ObjectID) -> None:
+        """A referred pull finished (copy cached, served same-host, or
+        failed): return the OLDEST outstanding grant, uncharging every
+        source it stamped (the TTL sweep reclaims any the reporter never
+        returns)."""
+        per_src = self._referrals.get(object_id)
+        grants = self._referral_grants.get(object_id)
+        if grants:
+            _, picked = grants.popleft()
+            if per_src:
+                for s in picked:
+                    stamps = per_src.get(s)
+                    if stamps:
+                        stamps.pop(0)
+                        if not stamps:
+                            del per_src[s]
+            return
+        if not per_src:
+            return
+        oldest = min((s for s in per_src if per_src[s]),
+                     key=lambda s: per_src[s][0], default=None)
+        if oldest is not None:
+            per_src[oldest].pop(0)
+            if not per_src[oldest]:
+                del per_src[oldest]
+
     async def _handle_report_holder(self, conn, oid: str, worker_id: str,
-                                    remove: bool = False):
-        """A puller cached a servable copy (add it to the relay set and
-        free one referral slot), or released its copy (``remove`` — stale
-        entries would send later pullers on failed-fetch detours)."""
+                                    remove: bool = False,
+                                    partial: bool = False,
+                                    done: bool = False):
+        """Relay-set bookkeeping from pullers:
+        - default: the puller cached a servable FULL copy — add it to the
+          relay set and free one referral slot.
+        - ``partial``: the puller STARTED a pull — its node serves landed
+          ranges cut-through, so it already counts as a serving copy for
+          the range engine.
+        - ``remove``: drop the worker's (partial or full) entry — stale
+          entries would send later pullers on failed-fetch detours.
+        - ``done``: the referred pull finished WITHOUT producing a copy
+          (same-host arena read, or a failed pull): free the slot that
+          referral held so waiting pullers don't sit out the TTL."""
         object_id = ObjectID.from_hex(oid)
-        if remove:
-            reps = self._replicas.get(object_id)
-            if reps is not None:
-                reps.discard(worker_id)
+        if remove or done:
+            if remove:
+                for table in (self._replicas, self._partials):
+                    entries = table.get(object_id)
+                    if entries is not None:
+                        entries.discard(worker_id)
+            if done:
+                self._free_referral_slot(object_id)
             return {"ok": True}
+        if partial:
+            # Never downgrade a full copy to partial (a stale in-flight
+            # advert can arrive after the completion report).
+            if worker_id not in self._replicas.get(object_id, ()):
+                self._partials.setdefault(object_id, set()).add(worker_id)
+            return {"ok": True}
+        partials = self._partials.get(object_id)
+        if partials is not None:
+            partials.discard(worker_id)
         self._replicas.setdefault(object_id, set()).add(worker_id)
-        stamps = self._referrals.get(object_id)
-        if stamps:
-            stamps.pop(0)
+        self._free_referral_slot(object_id)
         return {"ok": True}
 
     async def _handle_get_object_chunk(self, conn, oid: str, offset: int,
                                        length: int):
         """One chunk of a large object (reference: object transfer rides
         gRPC chunks, object_manager.proto + ObjectBufferPool). offset=0
-        additionally reports the total size so the puller can preallocate."""
+        additionally reports the total size so the puller can preallocate.
+        Serves CUT-THROUGH against the shm sealed-range watermark: an
+        object still landing on this node answers with whatever prefix of
+        the range is already valid (possibly empty — the puller retries)
+        instead of 'missing'."""
         object_id = ObjectID.from_hex(oid)
 
         def read():
             if self.shm is not None:
                 try:
-                    view = self.shm.get(object_id.binary())
+                    view, avail = self.shm.get_partial(object_id.binary())
                     try:
                         total = len(view)
-                        return bytes(view[offset:offset + length]), total
+                        end = min(offset + length, avail)
+                        chunk = bytes(view[offset:end]) \
+                            if end > offset else b""
+                        return chunk, total
                     finally:
                         view.release()
                         self.shm.release(object_id.binary())
@@ -473,6 +631,25 @@ class ClusterRuntime:
         if data is None:
             return {"missing": True}
         return {"data": data, "total": total}
+
+    def _report_holder_async(self, owner_addr, ref: ObjectRef, *,
+                             partial: bool = False,
+                             remove: bool = False) -> None:
+        """Fire-and-forget report_holder to the owner (in-flight advertise
+        / retraction) — never blocks the pull it describes."""
+        async def _send():
+            try:
+                peer = await self._apeer(tuple(owner_addr))
+                await peer.call("report_holder", oid=ref.hex(),
+                                worker_id=self.worker_id.hex(),
+                                partial=partial, remove=remove, timeout=5)
+            except Exception:
+                pass
+
+        try:
+            self._io.loop.call_soon_threadsafe(lambda: spawn_task(_send()))
+        except RuntimeError:
+            pass  # loop shut down
 
     def _retract_holder(self, oid: ObjectID) -> None:
         """If we advertised ourselves as a relay holder, retract — the
@@ -511,7 +688,12 @@ class ClusterRuntime:
             try:
                 self.shm.delete(object_id.binary())
             except Exception:
-                pass
+                # Pinned by in-process readers / cut-through servers:
+                # abort reclaims on the last release instead of leaking.
+                try:
+                    self.shm.abort(object_id.binary())
+                except Exception:
+                    pass
         return {"ok": True}
 
     async def _handle_report_location(self, conn, oid: str, holder: str,
@@ -531,9 +713,10 @@ class ClusterRuntime:
         from the relay set — the primary is intact."""
         object_id = ObjectID.from_hex(oid)
         if holder:
-            reps = self._replicas.get(object_id)
-            if reps is not None:
-                reps.discard(holder)
+            for table in (self._replicas, self._partials):
+                entries = table.get(object_id)
+                if entries is not None:
+                    entries.discard(holder)
         if self._local_contains(object_id):
             return {"ok": True, "state": "present"}
         if holder and holder != self._locations.get(object_id) \
@@ -655,12 +838,26 @@ class ClusterRuntime:
         return tuple(res["addr"]) if res.get("addr") else None
 
     def _resolve_worker(self, worker_hex: str) -> tuple[tuple | None, str]:
+        """Worker directory lookup, cached ~5s: a stale hit costs one
+        failed connect (failed pulls invalidate the entry, so the retry
+        re-resolves through the head), a cold hit costs a head round trip
+        per pull."""
+        now = time.monotonic()
+        hit = self._worker_dir_cache.get(worker_hex)
+        if hit is not None and now - hit[0] < 5.0:
+            return hit[1], hit[2]
         res = self.head.call("resolve_worker", worker_id=worker_hex)
         addr = tuple(res["addr"]) if res.get("addr") else None
-        return addr, res.get("node_id") or ""
+        node = res.get("node_id") or ""
+        self._worker_dir_cache[worker_hex] = (now, addr, node)
+        if node:
+            self._holder_nodes[worker_hex] = node
+        return addr, node
 
-    def _node_transfer_addr(self, node_id: str) -> tuple | None:
-        """Cached node_id -> native transfer-server address (5s TTL)."""
+    def _node_transfer_info(self, node_id: str) -> tuple | None:
+        """Cached node_id -> (transfer_addr, object_plane) for alive nodes
+        with a native data plane (5s TTL). object_plane carries the node's
+        arena name + host boot id for same-host zero-copy reads."""
         now = time.monotonic()
         cached = self._xfer_cache
         if cached is None or now - cached[0] > 5.0:
@@ -669,10 +866,15 @@ class ClusterRuntime:
             except Exception:
                 return None
             cached = self._xfer_cache = (now, {
-                nid: tuple(info["transfer_addr"])
+                nid: (tuple(info["transfer_addr"]),
+                      info.get("object_plane"))
                 for nid, info in nodes.items()
                 if info.get("alive") and info.get("transfer_addr")})
         return cached[1].get(node_id)
+
+    def _node_transfer_addr(self, node_id: str) -> tuple | None:
+        info = self._node_transfer_info(node_id)
+        return info[0] if info is not None else None
 
     # ------------------------------------------------------------------ put/get
     # Released borrowed copies stay servable this long (relay cache).
@@ -694,8 +896,10 @@ class ClusterRuntime:
             self._borrow_cache[oid] = time.monotonic()
         self._recovery_attempts.pop(oid, None)
         self._replicas.pop(oid, None)
+        self._partials.pop(oid, None)
         self._location_sizes.pop(oid, None)
         self._referrals.pop(oid, None)
+        self._referral_grants.pop(oid, None)
         self.refer_counts.pop(oid, None)
         self._sweep_borrow_cache()
         # Lineage GC: drop the retained spec once its last return is
@@ -716,7 +920,12 @@ class ClusterRuntime:
             try:
                 self.shm.delete(oid.binary())
             except Exception:
-                pass
+                # Pinned (zero-copy views / cut-through serving in flight):
+                # abort frees on the last release, plasma-style.
+                try:
+                    self.shm.abort(oid.binary())
+                except Exception:
+                    pass
 
     def _sweep_borrow_cache(self) -> None:
         now = time.monotonic()
@@ -815,6 +1024,7 @@ class ClusterRuntime:
                     data = self._fetch_from_holder(holder, ref)
                     if data is not None:
                         return data
+                    self._worker_dir_cache.pop(holder, None)  # re-resolve
                     holder_failures += 1
                     if holder_failures >= 2:
                         # Holder is gone: reconstruct from lineage by
@@ -852,7 +1062,8 @@ class ClusterRuntime:
             poll = min(remaining or 10.0, 10.0)
             try:
                 res = self._peer(addr).call("get_object", oid=ref.hex(),
-                                            poll_s=poll, timeout=poll + 5)
+                                            poll_s=poll, timeout=poll + 5,
+                                            requester=self.worker_id.hex())
             except TimeoutError:
                 # Long-poll overran under load (TimeoutError is an OSError
                 # subclass — it must NOT read as owner death); re-ask until
@@ -864,7 +1075,34 @@ class ClusterRuntime:
                 self.store.put(ref.id, res["data"], ref.owner_id)
                 return res["data"]
             if res.get("location"):
-                data = self._fetch_from_holder(res["location"], ref)
+                locations = res.get("locations") or [res["location"]]
+                size_hint = res.get("size") or 0
+                # A budgeted locations list means the owner charged a
+                # referral slot: exactly one report must hand it back
+                # (full-copy report, or done=True otherwise).
+                referred = res.get("locations") is not None \
+                    and res.get("budgeted", True)
+                # Cut-through advertise: tell the owner we are PULLING this
+                # object before the bytes move — our node serves landed
+                # ranges against the watermark, so later pullers can ride
+                # us mid-transfer (reference: push_manager relay trees,
+                # here started one hop earlier). Skipped when the copy is
+                # same-host readable (no bytes will land here).
+                advertise = (self.shm is not None and referred
+                             and size_hint >= self.RELAY_MIN_BYTES
+                             and not self._local_contains(ref.id))
+                if advertise:
+                    _, lead_node = self._resolve_worker(locations[0])
+                    if lead_node and self._peer_arena_plane(lead_node):
+                        advertise = False
+                if advertise:
+                    self._report_holder_async(addr, ref, partial=True)
+                    self._reported_holder[ref.id] = owner_hex
+                self._pull_extra[ref.id] = tuple(locations[1:])
+                try:
+                    data = self._fetch_from_holder(locations[0], ref)
+                finally:
+                    self._pull_extra.pop(ref.id, None)
                 if data is not None:
                     # Relay distribution: if we cached a servable copy,
                     # tell the owner so later pullers can fetch from US
@@ -879,7 +1117,25 @@ class ClusterRuntime:
                             self._reported_holder[ref.id] = owner_hex
                         except (RpcError, OSError):
                             pass
+                    elif referred:
+                        # Served without landing a local copy (same-host
+                        # arena read / process-local cache): hand the
+                        # referral slot back, retracting any stale
+                        # in-flight advert with it.
+                        self._report_holder_async(addr, ref, done=True,
+                                                  remove=advertise)
+                        self._reported_holder.pop(ref.id, None)
                     return data
+                if referred:
+                    # The pull failed: hand the slot back (and retract the
+                    # in-flight advert before the owner refers anyone else
+                    # to us).
+                    self._report_holder_async(addr, ref, done=True,
+                                              remove=advertise)
+                    self._reported_holder.pop(ref.id, None)
+                # The holder may have moved/died: drop its cached
+                # directory row so the retry re-resolves through the head.
+                self._worker_dir_cache.pop(locations[0], None)
                 holder_failures += 1
                 if holder_failures >= 2:
                     # Tell the owner its recorded holder is unreachable so
@@ -902,15 +1158,130 @@ class ClusterRuntime:
     PULL_CHUNK = 4 * 1024 * 1024
     PULL_WINDOW = 4  # concurrent chunk requests (bounded in-flight bytes)
 
+    def _pull_sources(self, holder_node: str,
+                      ref: ObjectRef) -> list[tuple]:
+        """Transfer endpoints for a pull: the lead holder's node plus the
+        extra serving copies the owner's referral handed out (full or
+        partial — partial nodes serve their landed ranges cut-through),
+        resolved to distinct node transfer addresses."""
+        sources = []
+        lead = self._node_transfer_addr(holder_node)
+        if lead is not None:
+            sources.append(tuple(lead))
+        extra = self._pull_extra.get(ref.id, ())
+        if extra:
+            nodes = self._worker_nodes_for(extra)
+            for whex in extra:
+                node = nodes.get(whex)
+                if not node or node == holder_node or node == self.my_node_id:
+                    continue
+                addr = self._node_transfer_addr(node)
+                if addr is not None and tuple(addr) not in sources:
+                    sources.append(tuple(addr))
+        return sources
+
+    def _worker_nodes_for(self, worker_hexes) -> dict[str, str]:
+        """worker hex -> node hex, batch-resolved through the head's
+        directory (one RPC for all unknown workers of a referral)."""
+        missing = [w for w in worker_hexes if w not in self._holder_nodes]
+        if missing:
+            try:
+                res = self.head.call("resolve_workers", worker_ids=missing,
+                                     timeout=5)
+                for whex, info in (res.get("workers") or {}).items():
+                    if info and info.get("node_id"):
+                        self._holder_nodes[whex] = info["node_id"]
+            except Exception:
+                pass  # unresolved workers just drop out of the source set
+        return {w: self._holder_nodes.get(w, "") for w in worker_hexes}
+
+    def _peer_arena_plane(self, holder_node: str) -> dict | None:
+        """The holder node's object-plane descriptor when its arena is
+        mappable from THIS process (same host boot id, distinct segment),
+        else None."""
+        if not get_config().transfer_same_host_arena:
+            return None
+        info = self._node_transfer_info(holder_node)
+        if info is None or not info[1]:
+            return None
+        plane = info[1]
+        name = plane.get("shm_name")
+        from ray_tpu.core import transfer
+
+        if not name or not transfer.host_boot_id() or \
+                plane.get("boot_id") != transfer.host_boot_id():
+            return None
+        if self.shm is not None and self.shm.name.lstrip("/") == \
+                name.lstrip("/"):
+            return None  # our own arena: the regular local path covers it
+        return plane
+
+    def _peer_arena_view(self, holder_node: str, ref: ObjectRef):
+        """Same-host zero-copy read: when the serving copy's arena lives on
+        THIS host (boot ids match), map the peer node's segment and return
+        a pinned view of the sealed object — no wire, no local copy
+        (plasma-style same-host sharing extended across co-hosted node
+        daemons; the shm store keeps all metadata in the segment, so the
+        cross-process pin/refcount protocol works from any process on the
+        host). Returns None when inapplicable — caller rides the transfer
+        engine (which also covers the mid-pull cut-through case)."""
+        plane = self._peer_arena_plane(holder_node)
+        if plane is None:
+            return None
+        name = plane["shm_name"]
+        peer = self._peer_arenas.get(name)
+        if peer is None:
+            try:
+                from ray_tpu.core.shm_store import SharedMemoryStore
+
+                peer = SharedMemoryStore(name, create=False)
+            except Exception:
+                return None  # segment gone (node died): transfer engine
+            self._peer_arenas[name] = peer
+        try:
+            t0 = time.perf_counter()
+            view = peer.get_view(ref.id.binary())
+        except Exception:
+            return None  # not sealed there (mid-pull) or evicted
+        from ray_tpu.core.transfer import observe_transfer
+
+        observe_transfer("arena_view", len(view), time.perf_counter() - t0)
+        return view
+
+    def _await_local_seal(self, ref: ObjectRef, timeout: float = 60.0):
+        """Another local process is already pulling this object into the
+        node arena: wait for its seal instead of moving the same bytes
+        twice. Returns a pinned view, or None when the foreign pull
+        aborted/stalled (caller pulls it itself / falls back)."""
+        oid = ref.id.binary()
+        deadline = time.monotonic() + timeout
+        last_mark, last_advance = -1, time.monotonic()
+        while time.monotonic() < deadline:
+            if self.shm.contains(oid):
+                return self.shm.get_view(oid)
+            prog = self.shm.progress(oid)
+            if prog is None:
+                return None  # aborted: take over
+            if prog[1] != last_mark:
+                last_mark, last_advance = prog[1], time.monotonic()
+            elif time.monotonic() - last_advance > 15.0:
+                return None  # stalled foreign pull: fall back
+            time.sleep(0.005)
+        return None
+
     def _native_pull(self, holder_node: str, ref: ObjectRef) -> bytes | None:
         """Arena-to-arena pull over the native data plane (src/transfer/
-        transfer.cc): zero Python in the byte path. Returns the bytes, or
-        None to fall back to the RPC chunk path (object not in the holder's
+        transfer.cc): zero Python in the byte path, ranges pipelined from
+        every serving copy the referral named. Returns the bytes/view, or
+        None to fall back to the RPC chunk path (object not in any source's
         arena, no transfer server, or any transport failure)."""
         if not holder_node:
             return None
-        xfer = self._node_transfer_addr(holder_node)
-        if xfer is None:
+        view = self._peer_arena_view(holder_node, ref)
+        if view is not None:
+            return view
+        sources = self._pull_sources(holder_node, ref)
+        if not sources:
             return None
         try:
             from ray_tpu.core import transfer
@@ -919,8 +1290,17 @@ class ClusterRuntime:
             if self.shm is not None:
                 if self.shm.contains(oid):
                     return self.shm.get_view(oid)
-                total = transfer.pull_to_store(self.shm.name, oid,
-                                               xfer[0], xfer[1])
+                try:
+                    total = transfer.pull_to_store(self.shm.name, oid,
+                                                   sources)
+                except transfer.ObjectInFlight:
+                    # A same-node puller beat us to it: ride its transfer.
+                    view = self._await_local_seal(ref)
+                    if view is not None:
+                        return view
+                    # Foreign pull aborted: one fresh attempt of our own.
+                    total = transfer.pull_to_store(self.shm.name, oid,
+                                                   sources)
                 if total is None:
                     return None
                 # Sealing into the arena bypasses store.on_seal — wake
@@ -930,8 +1310,7 @@ class ClusterRuntime:
                 # of the arena (large arrays zero-copy) instead of paying
                 # an arena->bytes traversal plus a deserialize copy.
                 return self.shm.get_view(oid)
-            data = transfer.fetch_to_buffer(ref.id.binary(), xfer[0],
-                                            xfer[1])
+            data = transfer.fetch_to_buffer(ref.id.binary(), sources)
             if data is not None:
                 # Cache like the RPC chunk path does, or every re-get of
                 # this ref re-transfers the whole object.
@@ -960,7 +1339,7 @@ class ClusterRuntime:
         if first.get("missing"):
             return None
         total = first["total"]
-        if total <= self.PULL_CHUNK:
+        if total <= self.PULL_CHUNK and len(first["data"]) == total:
             # Cache single-chunk pulls like the multi-chunk path does —
             # an uncached borrow re-transfers on every get AND can never
             # join the relay set (report_holder requires a local copy).
@@ -976,7 +1355,11 @@ class ClusterRuntime:
                       first: bytes, total: int) -> bytes | None:
         """Assemble a large object from pipelined chunk pulls, writing each
         chunk straight into its destination (the node shm arena when it
-        fits) — extra memory in flight is bounded by WINDOW × CHUNK."""
+        fits) — extra memory in flight is bounded by WINDOW × CHUNK. The
+        holder may itself be mid-pull (cut-through): short/empty chunk
+        replies are re-requested until the range lands. As contiguous
+        chunks land HERE, the local watermark is published so this node
+        relays the object before its own pull seals."""
         dest = None
         shm_backed = False
         if self.shm is not None:
@@ -990,22 +1373,53 @@ class ClusterRuntime:
         dest[:len(first)] = first
         oid_hex = ref.hex()
         chunk, window = self.PULL_CHUNK, self.PULL_WINDOW
+        n_chunks = (total + chunk - 1) // chunk
+        done = bytearray(n_chunks)
+        contig = [0]  # chunks contiguously complete (loop-thread only)
+
+        def mark_done(idx: int) -> None:
+            done[idx] = 1
+            advanced = False
+            while contig[0] < n_chunks and done[contig[0]]:
+                contig[0] += 1
+                advanced = True
+            if advanced and shm_backed:
+                self.shm.set_progress(ref.id.binary(),
+                                      min(contig[0] * chunk, total))
 
         async def pull():
             aio = peer.aio
             sem = asyncio.Semaphore(window)
 
-            async def one(off):
-                async with sem:
-                    r = await aio.call("get_object_chunk", oid=oid_hex,
-                                       offset=off, length=chunk, timeout=60)
-                if r.get("missing"):
-                    raise KeyError(oid_hex)
-                data = r["data"]
-                dest[off:off + len(data)] = data
+            async def one(idx):
+                end = min((idx + 1) * chunk, total)
+                cur = idx * chunk + (len(first) if idx == 0 else 0)
+                stalls = 0
+                while cur < end:
+                    async with sem:
+                        r = await aio.call("get_object_chunk", oid=oid_hex,
+                                           offset=cur, length=end - cur,
+                                           timeout=60)
+                    if r.get("missing"):
+                        raise KeyError(oid_hex)
+                    data = r["data"]
+                    if data:
+                        dest[cur:cur + len(data)] = data
+                        cur += len(data)
+                        stalls = 0
+                    else:
+                        # Holder's watermark hasn't reached this range yet.
+                        stalls += 1
+                        if stalls > 600:  # ~30 s without a byte: give up
+                            raise TimeoutError(oid_hex)
+                        await asyncio.sleep(0.05)
+                mark_done(idx)
 
-            tasks = [asyncio.ensure_future(one(off))
-                     for off in range(chunk, total, chunk)]
+            tasks = [asyncio.ensure_future(one(idx))
+                     for idx in range(1 if len(first) >= min(chunk, total)
+                                      else 0, n_chunks)]
+            if len(first) >= min(chunk, total):
+                mark_done(0)
             try:
                 await asyncio.gather(*tasks)
             except BaseException:
@@ -1022,7 +1436,9 @@ class ClusterRuntime:
         except Exception:
             if shm_backed:
                 try:
-                    self.shm.delete(ref.id.binary())
+                    # Abort, not delete: cut-through readers may already
+                    # pin the partial entry (last release reclaims).
+                    self.shm.abort(ref.id.binary())
                 except Exception:
                     pass
             return None
@@ -1399,6 +1815,38 @@ class ClusterRuntime:
                     pass  # head hiccup: fall through to the local daemon
         return self._daemon.aio, False
 
+    async def _refresh_daemon(self) -> bool:
+        """A node-daemon connection died (daemon SIGKILLed/crashed):
+        re-point self._daemon at a live daemon — our own node's if it came
+        back, else any alive node's — so lease traffic keeps flowing
+        (reference: raylet clients re-resolve through the GCS node table
+        after a raylet death). Returns True when a live daemon answered."""
+        if self._daemon is None:
+            return False
+        try:
+            nodes = await self.head.aio.call("list_nodes", timeout=10)
+        except Exception:
+            return False
+        candidates = sorted(
+            ((nid, tuple(info["addr"])) for nid, info in nodes.items()
+             if info.get("alive") and info.get("addr")),
+            key=lambda kv: (kv[0] != self.my_node_id, kv[0]))
+        for _nid, addr in candidates:
+            fresh = AsyncRpcClient(*addr)
+            try:
+                await asyncio.wait_for(fresh.connect(), timeout=5)
+            except Exception:
+                continue  # head hasn't noticed this death yet: next node
+            old = self._daemon._async
+            self._daemon._async = fresh
+            self.node_daemon_addr = addr
+            try:
+                await old.close()
+            except Exception:
+                pass
+            return True
+        return False
+
     async def _nodes_cached(self) -> dict:
         """TTL-cached head node view — the locality branch runs per lease
         request; an uncached list_nodes there would serialize lease
@@ -1440,22 +1888,33 @@ class ClusterRuntime:
         lease re-requested."""
         try:
             for _ in range(4):
-                daemon, pinned = await self._lease_entry_daemon(ks)
-                res = await daemon.call("request_lease", resources=ks.resources,
-                                        env_hash=ks.env_hash, timeout=None,
-                                        allow_spill=not pinned,
-                                        owner=self.worker_id.hex())
-                hops = 0
-                while res.get("spill") and hops < 4:
-                    daemon = await self._apeer(tuple(res["spill"]))
-                    # Final hop commits to its node: prevents spill
-                    # ping-pong when every node is briefly busy.
+                try:
+                    daemon, pinned = await self._lease_entry_daemon(ks)
                     res = await daemon.call("request_lease",
                                             resources=ks.resources,
                                             env_hash=ks.env_hash, timeout=None,
-                                            allow_spill=hops < 3,
+                                            allow_spill=not pinned,
                                             owner=self.worker_id.hex())
-                    hops += 1
+                    hops = 0
+                    while res.get("spill") and hops < 4:
+                        daemon = await self._apeer(tuple(res["spill"]))
+                        # Final hop commits to its node: prevents spill
+                        # ping-pong when every node is briefly busy.
+                        res = await daemon.call("request_lease",
+                                                resources=ks.resources,
+                                                env_hash=ks.env_hash,
+                                                timeout=None,
+                                                allow_spill=hops < 3,
+                                                owner=self.worker_id.hex())
+                        hops += 1
+                except (RpcConnectionLost, OSError):
+                    # The daemon died mid-lease (SIGKILL chaos): a
+                    # retryable INFRASTRUCTURE event, not a task failure —
+                    # re-resolve a live daemon and re-lease within this
+                    # retry budget instead of surfacing TaskError.
+                    await self._refresh_daemon()
+                    await asyncio.sleep(0.2)
+                    continue
                 if res.get("spill"):
                     raise ValueError(
                         f"lease spill chain exhausted for {ks.resources}")

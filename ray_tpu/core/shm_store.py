@@ -51,6 +51,14 @@ def _lib():
         lib.store_get.restype = ctypes.c_int
         lib.store_get.argtypes = [P, u8p, ctypes.POINTER(u64),
                                   ctypes.POINTER(u64)]
+        lib.store_get_partial.restype = ctypes.c_int
+        lib.store_get_partial.argtypes = [P, u8p, ctypes.POINTER(u64),
+                                          ctypes.POINTER(u64),
+                                          ctypes.POINTER(u64)]
+        lib.store_set_progress.restype = ctypes.c_int
+        lib.store_set_progress.argtypes = [P, u8p, u64]
+        lib.store_abort.restype = ctypes.c_int
+        lib.store_abort.argtypes = [P, u8p]
         lib.store_release.restype = ctypes.c_int
         lib.store_release.argtypes = [P, u8p]
         lib.store_contains.restype = ctypes.c_int
@@ -139,10 +147,16 @@ class SharedMemoryStore:
         pos = self._alloc(object_id, size)
         if pos is None:
             return  # idempotent
+        idb = _id_buf(bytes(object_id))
+        start = pos
         for p in parts:
             self._mm[pos:pos + len(p)] = p
             pos += len(p)
-        self._libh.store_seal(self._h, _id_buf(bytes(object_id)))
+            # Publish the watermark as each buffer lands: cut-through
+            # readers (the transfer plane) can start serving a multi-part
+            # put before the final seal.
+            self._libh.store_set_progress(self._h, idb, pos - start)
+        self._libh.store_seal(self._h, idb)
 
     def create(self, object_id: bytes, size: int) -> memoryview:
         """Allocate an unsealed entry and return a writable view into the
@@ -156,6 +170,54 @@ class SharedMemoryStore:
 
     def seal(self, object_id: bytes) -> None:
         self._libh.store_seal(self._h, _id_buf(bytes(object_id)))
+
+    def set_progress(self, object_id: bytes, watermark: int) -> None:
+        """Advance the sealed-range watermark of an unsealed entry: bytes
+        [0, watermark) are valid and may be served to cut-through readers
+        (monotone; seal() raises it to the full size). Chunked transfers
+        call this as contiguous ranges land so the node can relay the
+        object while its own pull is still in flight."""
+        self._libh.store_set_progress(self._h, _id_buf(bytes(object_id)),
+                                      watermark)
+
+    def progress(self, object_id: bytes) -> tuple[int, int] | None:
+        """(total_size, watermark) for a present entry — sealed or still
+        mid-transfer — or None when absent/aborted. The probe that lets a
+        second same-node reader wait for an in-flight pull instead of
+        starting a duplicate one."""
+        idb = _id_buf(bytes(object_id))
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        mark = ctypes.c_uint64()
+        rc = self._libh.store_get_partial(self._h, idb, ctypes.byref(off),
+                                          ctypes.byref(size),
+                                          ctypes.byref(mark))
+        if rc != OK:
+            return None
+        self._libh.store_release(self._h, idb)
+        return size.value, mark.value
+
+    def get_partial(self, object_id: bytes) -> tuple[memoryview, int]:
+        """Pinned view over a possibly-unsealed entry plus its watermark:
+        only [0, watermark) is valid. Caller must release(object_id). Used
+        by the RPC chunk server to serve ranges cut-through."""
+        idb = _id_buf(bytes(object_id))
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        mark = ctypes.c_uint64()
+        rc = self._libh.store_get_partial(self._h, idb, ctypes.byref(off),
+                                          ctypes.byref(size),
+                                          ctypes.byref(mark))
+        if rc != OK:
+            raise KeyError(object_id)
+        return (memoryview(self._mm)[off.value:off.value + size.value],
+                mark.value)
+
+    def abort(self, object_id: bytes) -> None:
+        """Drop a failed in-flight transfer. Unlike delete(), safe while
+        cut-through readers still pin the entry: memory is reclaimed by
+        the last release, and new lookups see 'missing' immediately."""
+        self._libh.store_abort(self._h, _id_buf(bytes(object_id)))
 
     def get(self, object_id: bytes) -> memoryview:
         """Zero-copy view; call release(object_id) when done."""

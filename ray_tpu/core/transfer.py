@@ -1,20 +1,25 @@
 """ctypes bindings for the native transfer data plane (src/transfer/
-transfer.cc): a per-node TCP server that streams object bytes directly out
-of the shm arena, and a parallel-range puller that lands them directly in
-the puller's arena.
+transfer.cc): a per-node TCP server that streams object byte ranges
+directly out of the shm arena — including CUT-THROUGH ranges of objects
+still mid-transfer, served against their sealed-range watermark — and a
+multi-source pipelined range puller that lands them directly in the
+puller's arena.
 
 Capability parity with the reference's object-manager data path (reference:
 src/ray/object_manager/object_manager.h + pull_manager.h:50 — chunked,
-bounded-parallel node-to-node transfer); here the entire byte path is
-native, with Python only exchanging (host, port) endpoints.
+bounded-parallel node-to-node transfer; push_manager.h chunked pipelined
+pushes); here the entire byte path is native, with Python only exchanging
+(host, port) endpoints chosen by the owner's referral table.
 """
 
 from __future__ import annotations
 
 import ctypes
+import os
 import time
 
 from ray_tpu._native import load_library
+from ray_tpu.utils.config import get_config
 
 _lib = None
 
@@ -23,13 +28,45 @@ import threading as _threading
 _transfer_metrics = None
 _transfer_metrics_lock = _threading.Lock()
 
+_MAX_SOURCES = 8  # keep in sync with kMaxSources in transfer.cc
+
+
+class ObjectInFlight(Exception):
+    """The object already exists in the local arena — sealed, or another
+    local puller is mid-transfer. The caller should wait for the seal
+    instead of starting a duplicate pull."""
+
+
+_boot_id_cache: list[str] = []
+
+
+def host_boot_id() -> str:
+    """Same-host shared-memory identity token: boot id PLUS the /dev/shm
+    mount identity (st_dev/st_ino). The boot id alone is not namespaced —
+    two containers on one host match on it while NOT sharing /dev/shm,
+    which would wrongly bypass the egress budget and then fail every
+    arena attach; each tmpfs mount has a distinct device id, so the
+    combined token only matches processes whose arenas are actually
+    mutually mappable. '' when the probe fails (same-host detection off)."""
+    if not _boot_id_cache:
+        token = ""
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                boot = f.read().strip()
+            st = os.stat("/dev/shm")
+            token = f"{boot}:{st.st_dev}:{st.st_ino}"
+        except OSError:
+            token = ""
+        _boot_id_cache.append(token)
+    return _boot_id_cache[0]
+
 
 def _get_transfer_metrics():
     global _transfer_metrics
     with _transfer_metrics_lock:
         if _transfer_metrics is not None:
             return _transfer_metrics
-        from ray_tpu.util.metrics import Histogram
+        from ray_tpu.util.metrics import Counter, Histogram
 
         _transfer_metrics = (
             Histogram("transfer_latency_s",
@@ -39,20 +76,37 @@ def _get_transfer_metrics():
                       "object transfer size in bytes per pull",
                       boundaries=[1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10],
                       tag_keys=("path",)),
+            Histogram("transfer_pull_sources",
+                      "distinct serving copies feeding one pull "
+                      "(pipeline width of the multi-source range engine)",
+                      boundaries=[1, 2, 3, 4, 6, 8],
+                      tag_keys=("path",)),
+            Counter("transfer_source_bytes",
+                    "bytes served per source endpoint across pulls "
+                    "(relay fan-out: how egress spreads over copies)",
+                    tag_keys=("path", "source")),
         )
     return _transfer_metrics
 
 
-def observe_transfer(path: str, nbytes: int, seconds: float) -> None:
+def observe_transfer(path: str, nbytes: int, seconds: float,
+                     source_bytes: dict[str, int] | None = None) -> None:
     """Record one completed object pull. ``path`` names the data plane:
     native_pull / native_fetch here, rpc_chunk / rpc_inline from the
     runtime's fallback paths — the label that shows whether bytes are
-    riding the native plane or the slow path."""
+    riding the native plane or the slow path. ``source_bytes`` maps
+    endpoint -> bytes it served (multi-source pulls): /metrics then shows
+    the pipeline width and the per-source byte split."""
     try:
-        lat, size = _get_transfer_metrics()
+        lat, size, width, src_ctr = _get_transfer_metrics()
         tags = {"path": path}
         lat.observe(seconds, tags=tags)
         size.observe(float(nbytes), tags=tags)
+        if source_bytes:
+            served = {s: b for s, b in source_bytes.items() if b > 0}
+            width.observe(float(len(served)) or 1.0, tags=tags)
+            for src, b in served.items():
+                src_ctr.inc(float(b), tags={"path": path, "source": src})
     except Exception:
         pass  # metrics must never fail a transfer
 
@@ -62,6 +116,8 @@ def lib() -> ctypes.CDLL:
     if _lib is None:
         l = load_library("transfer",
                          ["transfer/transfer.cc", "objstore/objstore.cc"])
+        u64 = ctypes.c_uint64
+        u64p = ctypes.POINTER(u64)
         l.transfer_server_start2.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int)]
@@ -71,15 +127,14 @@ def lib() -> ctypes.CDLL:
         l.transfer_size.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                     ctypes.c_char_p]
         l.transfer_size.restype = ctypes.c_int64
-        l.transfer_pull.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
-                                    ctypes.c_char_p, ctypes.c_int,
-                                    ctypes.c_uint64, ctypes.c_int]
-        l.transfer_pull.restype = ctypes.c_int64
-        l.transfer_fetch_buf.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                         ctypes.c_char_p, ctypes.c_char_p,
-                                         ctypes.c_uint64, ctypes.c_uint64,
-                                         ctypes.c_int]
-        l.transfer_fetch_buf.restype = ctypes.c_int
+        l.transfer_pull_multi.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                          ctypes.c_char_p, u64,
+                                          ctypes.c_int, ctypes.c_int, u64p]
+        l.transfer_pull_multi.restype = ctypes.c_int64
+        l.transfer_fetch_multi.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                           ctypes.c_char_p, u64, u64,
+                                           ctypes.c_int, ctypes.c_int, u64p]
+        l.transfer_fetch_multi.restype = ctypes.c_int
         _lib = l
     return _lib
 
@@ -101,38 +156,82 @@ def stop_server(handle: int) -> None:
     lib().transfer_server_stop(handle)
 
 
-def pull_to_store(local_shm: str, object_id: bytes, host: str,
-                  port: int, *, chunk: int = 8 * 1024 * 1024,
-                  conns: int = 4) -> int | None:
-    """Pull object_id from (host, port) straight into the local arena.
-    Returns total bytes, or None if the holder doesn't have it (caller
-    falls back to the RPC chunk path)."""
+def _endpoints_arg(sources) -> tuple[bytes, list[str]]:
+    labels = [f"{h}:{p}" for h, p in sources[:_MAX_SOURCES]]
+    return ";".join(labels).encode(), labels
+
+
+def _knobs(chunk, conns, depth, n_sources: int) -> tuple[int, int, int]:
+    cfg = get_config()
+    if chunk is None:
+        chunk = cfg.transfer_chunk_bytes
+    if conns is None:
+        # One stream per serving copy; a lone source gets a second stream
+        # so its sendfile overlaps our recv.
+        conns = max(2, n_sources)
+    if depth is None:
+        depth = cfg.transfer_pipeline_depth
+    return int(chunk), int(conns), int(depth)
+
+
+def pull_to_store(local_shm: str, object_id: bytes, sources,
+                  *, chunk: int | None = None, conns: int | None = None,
+                  depth: int | None = None) -> int | None:
+    """Pull object_id straight into the local arena from one or more
+    serving copies (``sources`` = [(host, port), ...]) — ranges are fetched
+    concurrently across the copies and pipelined per connection, and the
+    local watermark is published as they land, so this node relays the
+    object cut-through while still pulling it. Returns total bytes, or
+    None if no source has it (caller falls back to the RPC chunk path).
+    Raises ObjectInFlight when the object is already (being) stored
+    locally."""
+    eps, labels = _endpoints_arg(sources)
+    chunk, conns, depth = _knobs(chunk, conns, depth, len(labels))
+    per_src = (ctypes.c_uint64 * _MAX_SOURCES)()
     t0 = time.perf_counter()
-    rc = lib().transfer_pull(local_shm.encode(), object_id, host.encode(),
-                             port, chunk, conns)
+    rc = lib().transfer_pull_multi(local_shm.encode(), object_id, eps,
+                                   chunk, conns, depth, per_src)
     if rc == -2:
-        return None  # not in the holder's arena
+        return None  # no source has it in its arena
+    if rc == -4:
+        raise ObjectInFlight(object_id)
     if rc < 0:
         raise OSError(f"native pull failed (rc {rc})")
-    observe_transfer("native_pull", int(rc), time.perf_counter() - t0)
+    observe_transfer(
+        "native_pull", int(rc), time.perf_counter() - t0,
+        {labels[i]: int(per_src[i]) for i in range(len(labels))})
     return int(rc)
 
 
-def fetch_to_buffer(object_id: bytes, host: str, port: int,
-                    *, chunk: int = 8 * 1024 * 1024,
-                    conns: int = 4) -> bytes | None:
-    """Pull into process memory (puller without an arena). None if the
-    holder doesn't have the object in its arena."""
+def fetch_to_buffer(object_id: bytes, sources,
+                    *, chunk: int | None = None, conns: int | None = None,
+                    depth: int | None = None) -> bytes | None:
+    """Pull into process memory (puller without an arena). None if no
+    source has the object in its arena.
+
+    Rare fallback path (cluster processes normally have an arena): the
+    Python-side size probe plus the engine's own ProbeSources means two
+    probe round trips per endpoint — acceptable here, fold into one C
+    entry point if this path ever gets hot."""
     l = lib()
+    eps, labels = _endpoints_arg(sources)
+    chunk, conns, depth = _knobs(chunk, conns, depth, len(labels))
     t0 = time.perf_counter()
+    host, port = sources[0]
     total = l.transfer_size(host.encode(), port, object_id)
-    if total == -2:
-        return None
+    for host, port in sources[1:]:
+        if total >= 0:
+            break
+        total = l.transfer_size(host.encode(), port, object_id)
     if total < 0:
-        raise OSError("transfer_size failed")
+        return None
     buf = ctypes.create_string_buffer(int(total))
-    if l.transfer_fetch_buf(host.encode(), port, object_id, buf,
-                            total, chunk, conns) != 0:
+    per_src = (ctypes.c_uint64 * _MAX_SOURCES)()
+    if l.transfer_fetch_multi(eps, object_id,
+                              ctypes.cast(buf, ctypes.c_char_p), total,
+                              chunk, conns, depth, per_src) != 0:
         raise OSError("native fetch failed")
-    observe_transfer("native_fetch", int(total), time.perf_counter() - t0)
+    observe_transfer(
+        "native_fetch", int(total), time.perf_counter() - t0,
+        {labels[i]: int(per_src[i]) for i in range(len(labels))})
     return buf.raw

@@ -42,6 +42,56 @@ import os as _os
 
 FUSED_BWD = _os.environ.get("RTPU_FLASH_FUSED_BWD", "1") != "0"
 
+# Scoped-VMEM ceiling for the flash kernels, by TPU generation: v5e/v5p/v6
+# expose 128 MB of VMEM per core, where the compiler's default 16 MB scoped
+# limit is too tight for packed blocks but a flat 96 MB would OVERSUBSCRIBE
+# the 16 MB VMEM of v2-v4 (the compiler rejects or spills). Unknown chips
+# (and CPU interpret runs) keep the compiler default. Override with
+# RTPU_FLASH_VMEM_LIMIT_MB (0 = force the compiler default).
+_VMEM_LIMIT_MB_BY_GEN = {"v5": 96, "v6": 96, "v7": 96}
+_vmem_limit_cache: list = []  # [int | None] once resolved
+
+
+def _compiler_params(pltpu, **kwargs):
+    """pltpu.CompilerParams across jax versions (older releases ship it
+    as TPUCompilerParams; same fields)."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def _flash_vmem_limit_bytes() -> int | None:
+    """vmem_limit_bytes for pallas CompilerParams, derived from the
+    detected TPU generation; None means 'leave the compiler default'."""
+    if _vmem_limit_cache:
+        return _vmem_limit_cache[0]
+    limit: int | None = None
+    env = _os.environ.get("RTPU_FLASH_VMEM_LIMIT_MB")
+    if env is not None:
+        try:
+            mb = int(env)
+            limit = mb * 1024 * 1024 if mb > 0 else None
+        except ValueError:
+            limit = None
+    else:
+        try:
+            kind = jax.devices()[0].device_kind.lower()  # e.g. "tpu v5 lite"
+            gen = None
+            for tok in kind.replace("tpu", " ").split():
+                if tok.startswith("v") and len(tok) >= 2 and \
+                        tok[1].isdigit():
+                    gen = tok[:2]
+                    break
+            if gen is not None:
+                mb = _VMEM_LIMIT_MB_BY_GEN.get(gen)
+                if mb is not None:
+                    limit = mb * 1024 * 1024
+        except Exception:
+            limit = None  # backend unavailable: compiler default
+    _vmem_limit_cache.append(limit)
+    return limit
+
 
 def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
     """Expand KV heads to match query heads (GQA)."""
@@ -291,11 +341,14 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
             # the official kernel's 128-lane broadcast copy of every row.
             jax.ShapeDtypeStruct((g, pack, sq), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "arbitrary"),
-            # The default 16 MB scoped-vmem limit is too tight for packed
-            # blocks (v5e has 128 MB VMEM); leave headroom for pipelining.
-            vmem_limit_bytes=96 * 1024 * 1024,
+            # Generation-derived scoped-vmem ceiling (96 MB on 128 MB-VMEM
+            # chips, compiler default elsewhere) — leaves headroom for
+            # pipelining without oversubscribing small-VMEM generations.
+            **({"vmem_limit_bytes": _flash_vmem_limit_bytes()}
+               if _flash_vmem_limit_bytes() is not None else {}),
         ),
         interpret=INTERPRET,
     )(qf, kf, vf)
@@ -540,11 +593,14 @@ def _flash_bwd_fused_pallas(q, k, v, out, lse, g, causal: bool,
             pltpu.VMEM((skv, d), jnp.float32),
             pltpu.VMEM((skv, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "arbitrary"),
-            # The default 16 MB scoped-vmem limit is too tight for packed
-            # blocks (v5e has 128 MB VMEM); leave headroom for pipelining.
-            vmem_limit_bytes=96 * 1024 * 1024,
+            # Generation-derived scoped-vmem ceiling (96 MB on 128 MB-VMEM
+            # chips, compiler default elsewhere) — leaves headroom for
+            # pipelining without oversubscribing small-VMEM generations.
+            **({"vmem_limit_bytes": _flash_vmem_limit_bytes()}
+               if _flash_vmem_limit_bytes() is not None else {}),
         ),
         interpret=INTERPRET,
     )(qf, kf, vf, dof, lsef, deltaf)
@@ -594,7 +650,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, sm_scale: float,
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=INTERPRET,
@@ -621,7 +678,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, sm_scale: float,
             jax.ShapeDtypeStruct((b * h, skv, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, skv, d), q.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=INTERPRET,
@@ -815,11 +873,15 @@ def _flash_chunk_fwd_pallas(q, k, v, qpos, kpos, causal, sm_scale,
             jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
             jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "arbitrary"),
-            # Ring shards can be long (skv-sized K/V + f32 scratch); raise
-            # the 16 MB scoped-vmem default (v5e has 128 MB VMEM).
-            vmem_limit_bytes=96 * 1024 * 1024,
+            # Ring shards can be long (skv-sized K/V + f32 scratch):
+            # generation-derived scoped-vmem ceiling (see
+            # _flash_vmem_limit_bytes), compiler default on small-VMEM
+            # or unknown chips.
+            **({"vmem_limit_bytes": _flash_vmem_limit_bytes()}
+               if _flash_vmem_limit_bytes() is not None else {}),
         ),
         interpret=INTERPRET,
     )(qposf, kposf, qf, kf, vf)
@@ -869,11 +931,15 @@ def _flash_chunk_bwd_pallas(q, k, v, qpos, kpos, out, lse, g_out, g_lse,
             pltpu.VMEM((skv, d), jnp.float32),
             pltpu.VMEM((skv, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "arbitrary"),
-            # Ring shards can be long (skv-sized K/V + f32 scratch); raise
-            # the 16 MB scoped-vmem default (v5e has 128 MB VMEM).
-            vmem_limit_bytes=96 * 1024 * 1024,
+            # Ring shards can be long (skv-sized K/V + f32 scratch):
+            # generation-derived scoped-vmem ceiling (see
+            # _flash_vmem_limit_bytes), compiler default on small-VMEM
+            # or unknown chips.
+            **({"vmem_limit_bytes": _flash_vmem_limit_bytes()}
+               if _flash_vmem_limit_bytes() is not None else {}),
         ),
         interpret=INTERPRET,
     )(qposf, kposf, qf, kf, vf, dof, lsef, deltaf, glsef)

@@ -53,6 +53,25 @@ class Config:
     max_fused_object_count: int = 2000
     inline_object_max_bytes: int = 100 * 1024  # small results ride in RPC replies
 
+    # --- object transfer plane (reference: object_manager chunked transfer
+    # knobs, ray_config_def.h object_manager_default_chunk_size) ---
+    # Range size for chunked/pipelined pulls: each pull is split into
+    # fixed-size ranges fetched concurrently from multiple serving copies,
+    # and the cut-through watermark advances in units of this chunk.
+    transfer_chunk_bytes: int = 16 * 1024 * 1024
+    # Requests pipelined per transfer connection (the server streams range
+    # after range without a request/response latency gap).
+    transfer_pipeline_depth: int = 4
+    # Serving copies the owner hands one puller (pipelined multi-source
+    # pulls split ranges across them).
+    transfer_max_sources: int = 3
+    # Same-host zero-copy reads: a puller whose host boot id matches the
+    # holder node's maps that node's arena directly and serves get() from
+    # a pinned view — no wire transfer (plasma-style same-host sharing).
+    # Disable to force every cross-node pull onto the TCP range engine
+    # (e.g. when benchmarking the transfer plane itself).
+    transfer_same_host_arena: bool = True
+
     # --- control plane ---
     health_check_period_s: float = 1.0
     # Superseded by telemetry_flush_interval_s (the batched telemetry push

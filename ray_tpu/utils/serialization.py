@@ -22,6 +22,7 @@ import numpy as np
 _TAG_PICKLE = b"P"
 _TAG_NDARRAY = b"N"
 _TAG_RAW = b"R"  # pre-serialized bytes passthrough
+_TAG_BYTES = b"B"  # top-level bytes/bytearray: payload IS the value
 
 
 def _extract_refs(obj: Any) -> list:
@@ -103,6 +104,14 @@ def serialize_parts(obj: Any) -> list:
             _TAG_NDARRAY + len(header).to_bytes(4, "little") + header,
             memoryview(buf).cast("B"),
         ]
+    if type(obj) is bytes:
+        # Tag + raw payload, no pickle framing: the store scatter-writes
+        # the buffer without a serialize copy, and deserialize is ONE
+        # memcpy (cloudpickle round-trips a large bytes payload through
+        # the opcode scanner — measurably slower than memcpy on the
+        # multi-GB broadcast path). bytes ONLY: bytearray must round-trip
+        # as bytearray, so it stays on the pickle path.
+        return [_TAG_BYTES, memoryview(obj)]
     return [_TAG_PICKLE + cloudpickle.dumps(obj)]
 
 
@@ -121,8 +130,15 @@ def deserialize(data) -> Any:
     whole payload — on the warm-pull path that was a full extra traversal
     of the object). An ArenaView input additionally returns large arrays
     as ZERO-COPY read-only views over the shm arena, pinned until the
-    array is garbage-collected (reference: plasma get() returns read-only
-    numpy arrays backed by the object store)."""
+    array is garbage-collected.
+
+    READ-ONLY get() CONTRACT (reference: plasma-backed ray.get returns
+    read-only arrays): an ndarray materialized from a store-backed view is
+    never writable — on >= 3.12 via the PEP 688 __buffer__ export, on
+    older Pythons via a read-only frombuffer view whose finalizer holds
+    the arena pin, and even on the copying fallback the writeable flag is
+    cleared so behavior is uniform across Python versions and store
+    paths. Mutating consumers must copy explicitly (np.array(x))."""
     pin = None
     if hasattr(data, "view") and hasattr(data, "release"):  # ArenaView
         pin = data
@@ -147,11 +163,33 @@ def deserialize(data) -> Any:
                                     dtype=np.dtype(dtype_str)).reshape(shape)
                 pin = None  # ownership moved to the array's base
                 return arr  # read-only: the exported buffer is readonly
+            if pin is not None and isinstance(body, memoryview):
+                # < 3.12 (no Python-level __buffer__): still zero-copy.
+                # frombuffer over the read-only arena slice yields a
+                # READ-ONLY array (toreadonly() means nobody can flip
+                # writeable back on); the finalizer holds the pin until
+                # the last view into the buffer is collected (numpy keeps
+                # the base chain alive for every derived view).
+                import weakref
+
+                arr = np.frombuffer(body.toreadonly(),
+                                    dtype=np.dtype(dtype_str)).reshape(shape)
+                weakref.finalize(arr, pin.release)
+                pin = None  # ownership moved to the finalizer
+                return arr
             arr = np.frombuffer(body, dtype=np.dtype(dtype_str)).reshape(
-                shape)
-            return arr.copy()  # writable
+                shape).copy()
+            if pin is not None:
+                # Copying fallback for a store-backed view that couldn't be
+                # wrapped zero-copy: keep the read-only contract uniform —
+                # an array from the object store is NEVER writable, whether
+                # it is a pinned arena view or this private copy.
+                arr.flags.writeable = False
+            return arr
         if tag == _TAG_PICKLE:
             return cloudpickle.loads(payload)
+        if tag == _TAG_BYTES:
+            return bytes(payload)  # single memcpy out of the arena/buffer
         if tag == _TAG_RAW:
             return bytes(payload) if isinstance(payload, memoryview) \
                 else payload

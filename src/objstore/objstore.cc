@@ -35,6 +35,10 @@ enum EntryState : uint32_t {
   kCreated = 1,
   kSealed = 2,
   kTombstone = 3,
+  // A failed in-progress transfer that could not be freed because readers
+  // still pin it (cut-through serving): memory is reclaimed by the last
+  // store_release, and every new reader sees "not found".
+  kAborted = 4,
 };
 
 // Return codes (keep in sync with shm_store.py).
@@ -69,6 +73,12 @@ struct Entry {
   uint64_t offset;  // payload offset from segment base
   uint64_t size;    // payload size
   uint64_t last_access;
+  // Sealed-range watermark: bytes [0, progress) are valid while the entry
+  // is still kCreated (a chunked transfer landing ranges in order). Cut-
+  // through serving reads against this instead of waiting for seal; the
+  // writer advances it monotonically under the store mutex, which is the
+  // cross-process memory barrier making the landed bytes visible.
+  uint64_t progress;
 };
 
 // Boundary-tag heap block. Payload follows the header; prev_size enables
@@ -298,7 +308,15 @@ int store_destroy(const char* name) { return shm_unlink(name); }
 int store_create_object(Store* s, const uint8_t* id, uint64_t size,
                         uint64_t* offset_out) {
   Locker l(s);
-  if (FindEntry(s, id) != nullptr) return kErrExists;
+  Entry* prior = FindEntry(s, id);
+  if (prior != nullptr) {
+    if (prior->state != kAborted || prior->refcount > 0) return kErrExists;
+    // Fully-released aborted transfer: reclaim the slot for the re-pull.
+    HeapFree(s, prior->offset);
+    s->hdr->used_bytes -= prior->size;
+    s->hdr->num_objects -= 1;
+    prior->state = kTombstone;
+  }
   uint64_t off = HeapAlloc(s, size == 0 ? 1 : size);
   if (off == 0) return kErrOom;
   Entry* e = AllocEntry(s, id);
@@ -311,6 +329,7 @@ int store_create_object(Store* s, const uint8_t* id, uint64_t size,
   e->offset = off;
   e->size = size;
   e->last_access = ++s->hdr->lru_clock;
+  e->progress = 0;
   s->hdr->used_bytes += size;
   s->hdr->num_objects += 1;
   *offset_out = off;
@@ -320,9 +339,24 @@ int store_create_object(Store* s, const uint8_t* id, uint64_t size,
 int store_seal(Store* s, const uint8_t* id) {
   Locker l(s);
   Entry* e = FindEntry(s, id);
-  if (e == nullptr) return kErrNotFound;
+  if (e == nullptr || e->state == kAborted) return kErrNotFound;
   if (e->state == kSealed) return kOk;
   e->state = kSealed;
+  e->progress = e->size;
+  return kOk;
+}
+
+// Advance the sealed-range watermark of an in-progress (kCreated) entry.
+// Monotone max; sealing sets it to the full size. The store mutex is the
+// cross-process barrier: the writer memcpys the range FIRST, then publishes
+// it here, so any reader that observes the watermark sees the bytes.
+int store_set_progress(Store* s, const uint8_t* id, uint64_t watermark) {
+  Locker l(s);
+  Entry* e = FindEntry(s, id);
+  if (e == nullptr || e->state == kAborted || e->state == kTombstone)
+    return kErrNotFound;
+  if (watermark > e->size) watermark = e->size;
+  if (watermark > e->progress) e->progress = watermark;
   return kOk;
 }
 
@@ -331,7 +365,7 @@ int store_get(Store* s, const uint8_t* id, uint64_t* offset_out,
               uint64_t* size_out) {
   Locker l(s);
   Entry* e = FindEntry(s, id);
-  if (e == nullptr) return kErrNotFound;
+  if (e == nullptr || e->state == kAborted) return kErrNotFound;
   if (e->state != kSealed) return kErrNotSealed;
   e->refcount += 1;
   e->last_access = ++s->hdr->lru_clock;
@@ -340,11 +374,53 @@ int store_get(Store* s, const uint8_t* id, uint64_t* offset_out,
   return kOk;
 }
 
+// Pin + locate an object that may still be mid-transfer (cut-through read).
+// Succeeds for kCreated and kSealed entries; *progress_out is the valid
+// contiguous prefix ([0, progress) readable; == size when sealed).
+int store_get_partial(Store* s, const uint8_t* id, uint64_t* offset_out,
+                      uint64_t* size_out, uint64_t* progress_out) {
+  Locker l(s);
+  Entry* e = FindEntry(s, id);
+  if (e == nullptr || e->state == kAborted || e->state == kTombstone)
+    return kErrNotFound;
+  e->refcount += 1;
+  e->last_access = ++s->hdr->lru_clock;
+  *offset_out = e->offset;
+  *size_out = e->size;
+  *progress_out = e->progress;
+  return kOk;
+}
+
 int store_release(Store* s, const uint8_t* id) {
   Locker l(s);
   Entry* e = FindEntry(s, id);
   if (e == nullptr) return kErrNotFound;
   if (e->refcount > 0) e->refcount -= 1;
+  if (e->state == kAborted && e->refcount == 0) {
+    // Last cut-through reader of a failed transfer: reclaim now.
+    HeapFree(s, e->offset);
+    s->hdr->used_bytes -= e->size;
+    s->hdr->num_objects -= 1;
+    e->state = kTombstone;
+  }
+  return kOk;
+}
+
+// Abort an in-progress transfer: free immediately when unpinned, else mark
+// kAborted so cut-through readers drain (last release frees) and every new
+// lookup sees "not found".
+int store_abort(Store* s, const uint8_t* id) {
+  Locker l(s);
+  Entry* e = FindEntry(s, id);
+  if (e == nullptr || e->state == kTombstone) return kErrNotFound;
+  if (e->refcount > 0) {
+    e->state = kAborted;
+    return kOk;
+  }
+  HeapFree(s, e->offset);
+  s->hdr->used_bytes -= e->size;
+  s->hdr->num_objects -= 1;
+  e->state = kTombstone;
   return kOk;
 }
 

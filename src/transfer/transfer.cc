@@ -11,11 +11,21 @@
 // Server: one accept thread per node daemon; a connection carries repeated
 //   requests  [id:20][offset:u64][length:u64]   (length==0 → size probe)
 //   responses [status:u32][total:u64][n:u64][payload n bytes]
-//   status: 0 ok, 1 missing (not sealed in this node's arena).
-// Client: transfer_size() probes; transfer_pull() creates the object in the
-// local arena and fills it with `conns` parallel range connections (disjoint
-// ranges → lock-free writes); transfer_fetch_buf() fills a caller buffer for
-// pullers with no arena.
+//   status: 0 ok, 1 missing (not in this node's arena).
+//   CUT-THROUGH: objects still mid-transfer (created, unsealed) are served
+//   against their sealed-range watermark (objstore Entry::progress) — a
+//   relay node starts feeding downstream pullers as soon as ranges land in
+//   its arena, instead of store-and-forwarding behind its own seal. A range
+//   request may therefore come back SHORT (n < requested, possibly 0): the
+//   puller re-queues the remainder (against this or another source).
+// Client: a multi-source pipelined range engine. transfer_pull_multi()
+//   creates the object in the local arena and fills it by splitting the
+//   pull into fixed-size ranges fetched concurrently from several serving
+//   copies (one pooled connection per worker, several requests pipelined
+//   per connection so the server never idles between ranges), publishing
+//   the local contiguous watermark as ranges land — so this puller is
+//   itself a cut-through relay while its pull is still in flight. Failed
+//   sources get their in-flight ranges re-queued onto the survivors.
 //
 // C ABI throughout — consumed from Python via ctypes
 // (ray_tpu/core/transfer.py). Compiled together with objstore.cc; each
@@ -25,7 +35,12 @@
 #include <cstdio>
 #include <cstdint>
 #include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
 #include <thread>
+#include <unordered_map>
+#include <vector>
 
 #include <arpa/inet.h>
 #include <fcntl.h>
@@ -41,6 +56,16 @@ namespace {
 
 constexpr uint32_t kIdSize = 20;
 constexpr uint64_t kMaxChunk = 64ULL * 1024 * 1024;
+constexpr int kMaxSources = 8;
+constexpr int kMaxConns = 16;
+// Server-side bounded wait for the watermark to reach a requested range
+// before answering short — pullers schedule against advertised watermarks,
+// so this only rides out the last few ms of a racing range landing.
+constexpr int kServeWaitPollUs = 1000;
+constexpr int kServeWaitTotalUs = 20 * 1000;
+// Client-side: overall no-progress timeout before a pull fails (the caller
+// falls back to the RPC chunk path / a fresh referral).
+constexpr int64_t kStallTimeoutMs = 15 * 1000;
 
 // objstore.cc C API (linked into the same shared object).
 extern "C" {
@@ -49,11 +74,15 @@ Store* store_open(const char* name);
 void store_close(Store* s);
 int store_get(Store* s, const uint8_t* id, uint64_t* offset_out,
               uint64_t* size_out);
+int store_get_partial(Store* s, const uint8_t* id, uint64_t* offset_out,
+                      uint64_t* size_out, uint64_t* progress_out);
+int store_set_progress(Store* s, const uint8_t* id, uint64_t watermark);
 int store_release(Store* s, const uint8_t* id);
 int store_create_object(Store* s, const uint8_t* id, uint64_t size,
                         uint64_t* offset_out);
 int store_seal(Store* s, const uint8_t* id);
 int store_delete(Store* s, const uint8_t* id);
+int store_abort(Store* s, const uint8_t* id);
 uint8_t* store_base(Store* s);
 }
 
@@ -94,6 +123,10 @@ struct Req {
 struct RespHdr {
   uint32_t status;
   uint64_t total;
+  // Serving copy's sealed-range watermark at response time (== total when
+  // sealed): pullers schedule ranges below each source's watermark so a
+  // mid-transfer relay is never asked for bytes it doesn't have yet.
+  uint64_t avail;
   uint64_t n;
 } __attribute__((packed));
 
@@ -143,13 +176,37 @@ void ServeConn(ServerState* st, int fd) {
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   Req req;
   while (!st->stopping.load() && ReadFull(fd, &req, sizeof(req))) {
-    uint64_t off = 0, total = 0;
-    RespHdr h{1, 0, 0};
-    if (store_get(st->store, req.id, &off, &total) == 0) {
+    uint64_t off = 0, total = 0, avail = 0;
+    RespHdr h{1, 0, 0, 0};
+    // Cut-through: pin created OR sealed entries; avail is the sealed-
+    // range watermark (== total once sealed).
+    if (store_get_partial(st->store, req.id, &off, &total, &avail) == 0) {
       uint64_t start = req.offset > total ? total : req.offset;
       uint64_t want = req.length > kMaxChunk ? kMaxChunk : req.length;
-      uint64_t n = (start + want > total) ? total - start : want;
-      h = RespHdr{0, total, n};
+      if (start + want > total) want = total - start;
+      // Bounded wait for an unsealed object's watermark to cover at least
+      // the start of the range (pullers schedule below the advertised
+      // watermark, so this only rides out a racing range landing).
+      int waited = 0;
+      while (want > 0 && avail <= start && waited < kServeWaitTotalUs &&
+             !st->stopping.load()) {
+        store_release(st->store, req.id);
+        usleep(kServeWaitPollUs);
+        waited += kServeWaitPollUs;
+        if (store_get_partial(st->store, req.id, &off, &total, &avail) != 0) {
+          // Transfer aborted under us: the object is gone.
+          avail = 0;
+          off = UINT64_MAX;
+          break;
+        }
+      }
+      if (off == UINT64_MAX) {
+        if (!WriteFull(fd, &h, sizeof(h))) break;
+        continue;
+      }
+      uint64_t n = want;
+      if (start + n > avail) n = avail > start ? avail - start : 0;
+      h = RespHdr{0, total, avail, n};
       bool ok = WriteFull(fd, &h, sizeof(h)) &&
                 (n == 0 || SendFromArena(st, fd, off + start, n));
       store_release(st->store, req.id);
@@ -178,10 +235,62 @@ int Connect(const char* host, int port) {
   return fd;
 }
 
+// ---- client connection pool ------------------------------------------------
+// Warm pulls reuse connections across calls (and across objects): on a
+// same-host fan-out the connect/teardown pair per pull was measurable, and
+// the reference's object manager likewise keeps per-peer channels alive.
+
+std::mutex g_pool_mu;
+std::unordered_map<std::string, std::vector<int>>* g_pool = nullptr;
+
+std::string PoolKey(const char* host, int port) {
+  char buf[96];
+  snprintf(buf, sizeof(buf), "%s:%d", host, port);
+  return std::string(buf);
+}
+
+int PoolAcquire(const char* host, int port) {
+  std::string key = PoolKey(host, port);
+  for (;;) {
+    int fd = -1;
+    {
+      std::lock_guard<std::mutex> l(g_pool_mu);
+      if (g_pool != nullptr) {
+        auto it = g_pool->find(key);
+        if (it != g_pool->end() && !it->second.empty()) {
+          fd = it->second.back();
+          it->second.pop_back();
+        }
+      }
+    }
+    if (fd < 0) break;
+    // Stale check: a server that died while this fd sat pooled shows as
+    // readable-EOF (or buffered junk => protocol desync): discard.
+    char b;
+    ssize_t r = recv(fd, &b, 1, MSG_PEEK | MSG_DONTWAIT);
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return fd;
+    close(fd);
+  }
+  return Connect(host, port);
+}
+
+void PoolRelease(const char* host, int port, int fd) {
+  std::lock_guard<std::mutex> l(g_pool_mu);
+  if (g_pool == nullptr) g_pool = new std::unordered_map<std::string, std::vector<int>>();
+  auto& v = (*g_pool)[PoolKey(host, port)];
+  if (v.size() < 8) {
+    v.push_back(fd);
+  } else {
+    close(fd);
+  }
+}
+
 // One request/response on an open connection; payload lands at dest (may be
-// null when probing). Returns -1 on error, else sets *total and *got.
+// null when probing). Returns -1 on error, -2 missing, else sets
+// *total/*avail/*got.
 int RoundTrip(int fd, const uint8_t* id, uint64_t offset, uint64_t length,
-              uint8_t* dest, uint64_t* total, uint64_t* got) {
+              uint8_t* dest, uint64_t* total, uint64_t* avail,
+              uint64_t* got) {
   Req req;
   memcpy(req.id, id, kIdSize);
   req.offset = offset;
@@ -195,42 +304,316 @@ int RoundTrip(int fd, const uint8_t* id, uint64_t offset, uint64_t length,
     if (!ReadFull(fd, dest, h.n)) return -1;
   }
   *total = h.total;
+  *avail = h.avail;
   *got = h.n;
   return 0;
 }
 
-// Parallel range pull into dest[0..total).
-int PullRanges(const char* host, int port, const uint8_t* id, uint8_t* dest,
-               uint64_t total, uint64_t chunk, int conns) {
-  if (chunk == 0 || chunk > kMaxChunk) chunk = 8ULL * 1024 * 1024;
-  if (conns < 1) conns = 1;
-  if (conns > 16) conns = 16;
-  std::atomic<uint64_t> next{0};
-  std::atomic<int> failed{0};
-  auto worker = [&]() {
-    int fd = Connect(host, port);
-    if (fd < 0) {
-      failed.store(1);
-      return;
-    }
-    while (failed.load() == 0) {
-      uint64_t off = next.fetch_add(chunk);
-      if (off >= total) break;
-      uint64_t want = off + chunk > total ? total - off : chunk;
-      uint64_t t = 0, got = 0;
-      if (RoundTrip(fd, id, off, want, dest + off, &t, &got) != 0 ||
-          got != want) {
-        failed.store(1);
+// ---- multi-source pipelined range engine -----------------------------------
+
+struct Endpoint {
+  char host[80];
+  int port;
+};
+
+int ParseEndpoints(const char* s, Endpoint* out, int max_out) {
+  // "host:port;host:port;..." (';' separates — hosts are numeric IPs).
+  int n = 0;
+  const char* p = s;
+  while (p != nullptr && *p != '\0' && n < max_out) {
+    const char* colon = strchr(p, ':');
+    if (colon == nullptr) break;
+    size_t hlen = static_cast<size_t>(colon - p);
+    if (hlen == 0 || hlen >= sizeof(out[n].host)) break;
+    memcpy(out[n].host, p, hlen);
+    out[n].host[hlen] = '\0';
+    out[n].port = atoi(colon + 1);
+    n++;
+    const char* semi = strchr(colon, ';');
+    p = semi == nullptr ? nullptr : semi + 1;
+  }
+  return n;
+}
+
+int64_t NowMs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+struct PullState {
+  std::mutex mu;
+  std::deque<uint64_t> todo;        // chunk indices needing (more) bytes
+  std::vector<uint64_t> done_bytes; // per-chunk bytes landed
+  std::vector<uint8_t> complete;
+  uint64_t n_chunks = 0;
+  uint64_t completed = 0;
+  uint64_t contig = 0;              // chunks contiguously complete
+  uint64_t total = 0;
+  uint64_t chunk = 0;
+  uint8_t* dest = nullptr;
+  Store* local = nullptr;           // for watermark publishing (may be null)
+  const uint8_t* id = nullptr;
+  std::atomic<int64_t> last_progress_ms{0};
+  std::atomic<bool> failed{false};
+
+  uint64_t chunk_len(uint64_t c) const {
+    uint64_t off = c * chunk;
+    return off + chunk > total ? total - off : chunk;
+  }
+};
+
+void PullWorker(PullState* st, const Endpoint* ep, uint64_t start_avail,
+                int depth, std::atomic<uint64_t>* src_bytes) {
+  struct Inflight {
+    uint64_t c;
+    uint64_t off;   // absolute byte offset requested
+    uint64_t len;
+  };
+  int fd = PoolAcquire(ep->host, ep->port);
+  std::deque<Inflight> inflight;
+  bool conn_ok = fd >= 0;
+  // This source's sealed-range watermark as of its last response: only
+  // ranges below it are requested, so a mid-transfer relay is never asked
+  // for bytes it doesn't have (the ask would park a server thread and a
+  // pipeline slot behind an empty answer).
+  uint64_t avail = start_avail;
+  while (conn_ok && !st->failed.load()) {
+    // Fill the pipeline: the server streams range after range with no
+    // request/response latency gap (the next request is already queued in
+    // its socket buffer while it sendfiles the current one).
+    bool over_watermark = false;
+    while (static_cast<int>(inflight.size()) < depth) {
+      uint64_t c = 0;
+      uint64_t done = 0;
+      bool got_chunk = false;
+      {
+        std::lock_guard<std::mutex> l(st->mu);
+        // First chunk whose next byte this source already has.
+        for (size_t i = 0; i < st->todo.size(); i++) {
+          uint64_t cand = st->todo[i];
+          if (cand * st->chunk + st->done_bytes[cand] < avail ||
+              avail >= st->total) {
+            st->todo.erase(st->todo.begin() + i);
+            c = cand;
+            done = st->done_bytes[cand];
+            got_chunk = true;
+            break;
+          }
+        }
+        if (!got_chunk && !st->todo.empty()) over_watermark = true;
+      }
+      if (!got_chunk) break;
+      Req req;
+      memcpy(req.id, st->id, kIdSize);
+      req.offset = c * st->chunk + done;
+      req.length = st->chunk_len(c) - done;
+      if (!WriteFull(fd, &req, sizeof(req))) {
+        std::lock_guard<std::mutex> l(st->mu);
+        st->todo.push_front(c);
+        conn_ok = false;
         break;
       }
+      inflight.push_back({c, req.offset, req.length});
     }
-    close(fd);
-  };
-  std::thread threads[16];
-  int n = conns;
-  for (int i = 0; i < n; i++) threads[i] = std::thread(worker);
-  for (int i = 0; i < n; i++) threads[i].join();
-  return failed.load() == 0 ? 0 : -1;
+    if (!conn_ok) break;
+    if (inflight.empty()) {
+      {
+        std::lock_guard<std::mutex> l(st->mu);
+        if (st->completed == st->n_chunks) break;
+      }
+      if (NowMs() - st->last_progress_ms.load() > kStallTimeoutMs) {
+        st->failed.store(true);
+        break;
+      }
+      if (over_watermark) {
+        // Work remains but it's all above this source's watermark:
+        // re-probe (length 0) so a progressing relay re-admits us.
+        uint64_t t = 0, got = 0;
+        usleep(2000);
+        if (RoundTrip(fd, st->id, 0, 0, nullptr, &t, &avail, &got) != 0) {
+          conn_ok = false;
+          break;
+        }
+      } else {
+        // Remaining chunks are owned by other workers: linger briefly in
+        // case one fails and re-queues (then this source picks them up).
+        usleep(500);
+      }
+      continue;
+    }
+    RespHdr h;
+    if (!ReadFull(fd, &h, sizeof(h))) {
+      conn_ok = false;
+      break;
+    }
+    Inflight r = inflight.front();
+    inflight.pop_front();
+    if (h.status != 0 || h.n > r.len) {
+      // Source lost the object (aborted relay) or protocol violation:
+      // this source is done; its ranges go back to the survivors.
+      std::lock_guard<std::mutex> l(st->mu);
+      st->todo.push_front(r.c);
+      conn_ok = false;
+      break;
+    }
+    avail = h.avail;
+    if (h.n > 0) {
+      if (!ReadFull(fd, st->dest + r.off, h.n)) {
+        std::lock_guard<std::mutex> l(st->mu);
+        st->todo.push_front(r.c);
+        conn_ok = false;
+        break;
+      }
+      src_bytes->fetch_add(h.n);
+      st->last_progress_ms.store(NowMs());
+      bool publish = false;
+      uint64_t watermark = 0;
+      {
+        std::lock_guard<std::mutex> l(st->mu);
+        st->done_bytes[r.c] += h.n;
+        if (st->done_bytes[r.c] == st->chunk_len(r.c)) {
+          st->complete[r.c] = 1;
+          st->completed++;
+          while (st->contig < st->n_chunks && st->complete[st->contig]) {
+            st->contig++;
+          }
+          uint64_t wm = st->contig * st->chunk;
+          if (wm > st->total) wm = st->total;
+          watermark = wm;
+          publish = st->local != nullptr && st->contig > 0;
+        } else {
+          // Short range (source watermark): finish the remainder first —
+          // it is the contiguity blocker for downstream cut-through.
+          st->todo.push_front(r.c);
+        }
+      }
+      if (publish) {
+        // Outside the engine lock: the store mutex is cross-process.
+        store_set_progress(st->local, st->id, watermark);
+      }
+    } else {
+      // Raced the watermark to zero bytes: hand the range back and let
+      // the eligibility scan retry it when the source catches up.
+      {
+        std::lock_guard<std::mutex> l(st->mu);
+        st->todo.push_back(r.c);
+      }
+      if (NowMs() - st->last_progress_ms.load() > kStallTimeoutMs) {
+        st->failed.store(true);
+        break;
+      }
+      usleep(1000);
+    }
+  }
+  // Re-queue everything still owned by this worker, then retire the
+  // connection (healthy → back to the pool for the next pull).
+  {
+    std::lock_guard<std::mutex> l(st->mu);
+    for (auto it = inflight.rbegin(); it != inflight.rend(); ++it) {
+      st->todo.push_front(it->c);
+    }
+  }
+  if (fd >= 0) {
+    if (conn_ok && !st->failed.load()) {
+      PoolRelease(ep->host, ep->port, fd);
+    } else {
+      close(fd);
+    }
+  }
+}
+
+// Pull [0, total) of `id` into dest from up to kMaxSources endpoints.
+// Returns 0 on success, -1 on failure. per_source_bytes (len n_eps, may be
+// null) receives bytes served by each endpoint.
+int MultiPull(const Endpoint* eps, const bool* alive,
+              const uint64_t* avails, int n_eps,
+              const uint8_t* id, uint8_t* dest, uint64_t total,
+              Store* local, uint64_t chunk, int conns, int depth,
+              uint64_t* per_source_bytes) {
+  if (chunk == 0 || chunk > kMaxChunk) chunk = 8ULL * 1024 * 1024;
+  if (depth < 1) depth = 1;
+  if (depth > 32) depth = 32;
+  if (conns < 1) conns = 1;
+  if (conns > kMaxConns) conns = kMaxConns;
+
+  PullState st;
+  st.total = total;
+  st.chunk = chunk;
+  st.dest = dest;
+  st.local = local;
+  st.id = id;
+  st.n_chunks = total == 0 ? 0 : (total + chunk - 1) / chunk;
+  st.done_bytes.assign(st.n_chunks, 0);
+  st.complete.assign(st.n_chunks, 0);
+  for (uint64_t c = 0; c < st.n_chunks; c++) st.todo.push_back(c);
+  st.last_progress_ms.store(NowMs());
+  if (st.n_chunks == 0) return 0;
+
+  int live_idx[kMaxSources];
+  int n_live = 0;
+  for (int i = 0; i < n_eps && i < kMaxSources; i++) {
+    if (alive[i]) live_idx[n_live++] = i;
+  }
+  if (n_live == 0) return -1;
+  // `conns` = total connections; round-robined over the live sources so
+  // every source gets one and extras double up (a lone source overlaps its
+  // server-side sendfile with our recv on a second stream).
+  int n_workers = conns;
+  if (n_workers > kMaxConns) n_workers = kMaxConns;
+
+  std::atomic<uint64_t> src_bytes[kMaxSources];
+  for (int i = 0; i < kMaxSources; i++) src_bytes[i].store(0);
+
+  std::vector<std::thread> threads;
+  for (int w = 0; w < n_workers; w++) {
+    int src = live_idx[w % n_live];
+    threads.emplace_back(PullWorker, &st, &eps[src], avails[src], depth,
+                         &src_bytes[src]);
+  }
+  for (auto& t : threads) t.join();
+  if (per_source_bytes != nullptr) {
+    for (int i = 0; i < n_eps && i < kMaxSources; i++) {
+      per_source_bytes[i] = src_bytes[i].load();
+    }
+  }
+  bool done;
+  {
+    std::lock_guard<std::mutex> l(st.mu);
+    done = st.completed == st.n_chunks;
+  }
+  return done ? 0 : -1;
+}
+
+// Probe every endpoint for the object; fills alive[] and per-source
+// watermarks, and returns the total size, -2 when no endpoint has the
+// object, -1 when none is reachable.
+int64_t ProbeSources(const Endpoint* eps, int n_eps, const uint8_t* id,
+                     bool* alive, uint64_t* avails) {
+  int64_t total = -1;
+  bool any_conn = false;
+  for (int i = 0; i < n_eps; i++) {
+    alive[i] = false;
+    avails[i] = 0;
+    int fd = PoolAcquire(eps[i].host, eps[i].port);
+    if (fd < 0) continue;
+    uint64_t t = 0, avail = 0, got = 0;
+    int rc = RoundTrip(fd, id, 0, 0, nullptr, &t, &avail, &got);
+    if (rc == 0) {
+      alive[i] = true;
+      avails[i] = avail;
+      total = static_cast<int64_t>(t);
+      PoolRelease(eps[i].host, eps[i].port, fd);
+    } else if (rc == -2) {
+      any_conn = true;
+      PoolRelease(eps[i].host, eps[i].port, fd);
+    } else {
+      any_conn = true;
+      close(fd);
+    }
+  }
+  if (total >= 0) return total;
+  return any_conn ? -2 : -1;
 }
 
 }  // namespace
@@ -322,37 +705,54 @@ void transfer_server_stop(void* handle) {
 
 // ---- client ----------------------------------------------------------------
 
-// Size probe: total object bytes, -2 if the holder doesn't have it sealed
-// in its arena, -1 on connection error.
+// Size probe: total object bytes, -2 if the holder doesn't have it in its
+// arena (sealed or in flight), -1 on connection error.
 int64_t transfer_size(const char* host, int port, const uint8_t* id) {
-  int fd = Connect(host, port);
-  if (fd < 0) return -1;
-  uint64_t total = 0, got = 0;
-  int rc = RoundTrip(fd, id, 0, 0, nullptr, &total, &got);
-  close(fd);
-  if (rc == -2) return -2;
-  if (rc != 0) return -1;
-  return static_cast<int64_t>(total);
+  Endpoint ep;
+  snprintf(ep.host, sizeof(ep.host), "%s", host);
+  ep.port = port;
+  bool alive = false;
+  uint64_t avail = 0;
+  return ProbeSources(&ep, 1, id, &alive, &avail);
 }
 
-// Pull an object into the LOCAL arena `local_shm`: create, parallel range
-// fill, seal. Returns total bytes, -2 if missing at the holder, -3 if the
-// local arena can't hold it, -1 on transfer error.
-int64_t transfer_pull(const char* local_shm, const uint8_t* id,
-                      const char* host, int port, uint64_t chunk,
-                      int conns) {
-  int64_t total = transfer_size(host, port, id);
+// Pull an object into the LOCAL arena `local_shm` from multiple serving
+// copies ("host:port;host:port" — up to 8): create, pipelined multi-source
+// range fill (publishing the local cut-through watermark as ranges land),
+// seal. per_source_bytes (length = number of endpoints; may be null)
+// receives the bytes each endpoint served. Returns total bytes, -2 if no
+// endpoint has the object, -3 if the local arena can't hold it, -4 if the
+// object is already present/in-flight locally, -1 on transfer failure.
+int64_t transfer_pull_multi(const char* local_shm, const uint8_t* id,
+                            const char* endpoints, uint64_t chunk,
+                            int conns, int depth,
+                            uint64_t* per_source_bytes) {
+  Endpoint eps[kMaxSources];
+  int n_eps = ParseEndpoints(endpoints, eps, kMaxSources);
+  if (n_eps <= 0) return -1;
+  bool alive[kMaxSources] = {false};
+  uint64_t avails[kMaxSources] = {0};
+  int64_t total = ProbeSources(eps, n_eps, id, alive, avails);
   if (total < 0) return total;
+  // Open the local arena per pull: a cached mapping could go stale if a
+  // segment is ever destroyed and re-created under the same name (test
+  // clusters do), and the open is microseconds next to the transfer.
   Store* local = store_open(local_shm);
   if (local == nullptr) return -3;
   uint64_t off = 0;
   int rc = store_create_object(local, id, static_cast<uint64_t>(total), &off);
   int64_t result;
-  if (rc != 0) {
+  if (rc == -1) {
+    result = -4;  // exists (sealed or another puller in flight)
+  } else if (rc != 0) {
     result = -3;
-  } else if (PullRanges(host, port, id, store_base(local) + off,
-                        static_cast<uint64_t>(total), chunk, conns) != 0) {
-    store_delete(local, id);
+  } else if (MultiPull(eps, alive, avails, n_eps, id,
+                       store_base(local) + off,
+                       static_cast<uint64_t>(total), local, chunk, conns,
+                       depth, per_source_bytes) != 0) {
+    // Abort, not delete: cut-through readers may hold pins — the last
+    // release reclaims, and every new lookup sees "missing".
+    store_abort(local, id);
     result = -1;
   } else {
     store_seal(local, id);
@@ -362,12 +762,37 @@ int64_t transfer_pull(const char* local_shm, const uint8_t* id,
   return result;
 }
 
+// Single-source compatibility wrapper.
+int64_t transfer_pull(const char* local_shm, const uint8_t* id,
+                      const char* host, int port, uint64_t chunk,
+                      int conns) {
+  char eps[96];
+  snprintf(eps, sizeof(eps), "%s:%d", host, port);
+  return transfer_pull_multi(local_shm, id, eps, chunk, conns, 4, nullptr);
+}
+
 // Pull into a caller-provided buffer (puller without an arena). dest must
 // hold `total` bytes as returned by transfer_size. Returns 0 or -1.
+int transfer_fetch_multi(const char* endpoints, const uint8_t* id,
+                         uint8_t* dest, uint64_t total, uint64_t chunk,
+                         int conns, int depth, uint64_t* per_source_bytes) {
+  Endpoint eps[kMaxSources];
+  int n_eps = ParseEndpoints(endpoints, eps, kMaxSources);
+  if (n_eps <= 0) return -1;
+  bool alive[kMaxSources] = {false};
+  uint64_t avails[kMaxSources] = {0};
+  int64_t probed = ProbeSources(eps, n_eps, id, alive, avails);
+  if (probed < 0 || static_cast<uint64_t>(probed) != total) return -1;
+  return MultiPull(eps, alive, avails, n_eps, id, dest, total, nullptr,
+                   chunk, conns, depth, per_source_bytes);
+}
+
 int transfer_fetch_buf(const char* host, int port, const uint8_t* id,
                        uint8_t* dest, uint64_t total, uint64_t chunk,
                        int conns) {
-  return PullRanges(host, port, id, dest, total, chunk, conns);
+  char eps[96];
+  snprintf(eps, sizeof(eps), "%s:%d", host, port);
+  return transfer_fetch_multi(eps, id, dest, total, chunk, conns, 4, nullptr);
 }
 
 }  // extern "C"

@@ -703,9 +703,39 @@ def test_broadcast_relay_distribution(tmp_path):
     spread across copies as they appear instead of all hammering the owner
     (reference: push_manager.h relay/broadcast; BASELINE 1GiB->50 nodes).
     The owner bounds outstanding referrals per copy, so a simultaneous
-    fan-out cannot exceed 2x concurrent transfers from the source."""
+    fan-out cannot exceed 2x concurrent transfers from the source.
+
+    Forces the TCP transfer plane: same-host pullers would otherwise read
+    the source arena directly (no relay copies form on one host)."""
     from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
 
+    with _forced_tcp_plane():
+        _run_broadcast_relay_distribution(NodeAffinitySchedulingStrategy)
+
+
+def _forced_tcp_plane():
+    """Context manager: disable same-host arena reads for the enclosed
+    cluster (env + config reload), restoring both even when cluster
+    setup fails — a leaked override would silently change which data
+    plane every later test exercises."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        from ray_tpu.utils import config as config_mod
+
+        os.environ["RTPU_TRANSFER_SAME_HOST_ARENA"] = "0"
+        config_mod.set_config(config_mod.Config.load())
+        try:
+            yield
+        finally:
+            os.environ.pop("RTPU_TRANSFER_SAME_HOST_ARENA", None)
+            config_mod.set_config(config_mod.Config.load())
+
+    return _cm()
+
+
+def _run_broadcast_relay_distribution(NodeAffinitySchedulingStrategy):
     c = Cluster()
     src_node = c.add_node(num_cpus=1, node_id="bsrc")
     nodes = [c.add_node(num_cpus=2, node_id=f"bnode-{i}") for i in range(4)]
@@ -757,7 +787,15 @@ def test_promoted_relay_copy_is_pinned():
     """When the owner loses its primary copy and promotes a borrower's
     cached copy, it pins the copy at the holder first — otherwise the
     borrow-cache TTL sweep deletes the only surviving bytes and a put()
-    object (no lineage) is permanently lost (ADVICE r3)."""
+    object (no lineage) is permanently lost (ADVICE r3).
+
+    Forces the TCP transfer plane: a same-host borrower reads the owner's
+    arena directly and never caches the copy this test is about."""
+    with _forced_tcp_plane():
+        _run_promoted_relay_copy_is_pinned()
+
+
+def _run_promoted_relay_copy_is_pinned():
     c = Cluster()
     n1 = c.add_node(num_cpus=1, node_id="pin-owner")
     n2 = c.add_node(num_cpus=1, node_id="pin-holder")
@@ -811,6 +849,35 @@ def test_promoted_relay_copy_is_pinned():
     finally:
         rt_b.shutdown()
         rt_owner.shutdown()
+        c.shutdown()
+
+
+def test_same_host_arena_view_serves_without_transfer():
+    """Same-host zero-copy plane: a puller whose host matches the holder
+    node's boot id maps that node's arena and serves get() from a pinned
+    view — no wire transfer, no local copy, read-only plasma semantics."""
+    import numpy as np
+
+    c = Cluster()
+    n1 = c.add_node(num_cpus=1, node_id="shv-a")
+    n2 = c.add_node(num_cpus=1, node_id="shv-b")
+    rt_a = c.connect(n1)
+    rt_b = c.connect(n2)
+    try:
+        if rt_a.shm is None or rt_b.shm is None:
+            pytest.skip("native shm store unavailable")
+        payload = np.arange(1_000_000, dtype=np.float32)  # ~4MB
+        ref = rt_a.put(payload)
+        (out,) = rt_b.get([ref], timeout=60)
+        np.testing.assert_array_equal(out, payload)
+        assert out.flags.writeable is False  # read-only get() contract
+        # Served straight from the peer arena: mapped it, cached nothing.
+        assert rt_b._peer_arenas, "peer arena was never mapped"
+        assert not rt_b._local_contains(ref.id)
+        del out
+    finally:
+        rt_b.shutdown()
+        rt_a.shutdown()
         c.shutdown()
 
 

@@ -1,0 +1,249 @@
+"""Cut-through + multi-source transfer plane tests.
+
+Covers the sealed-range watermark API of the native store, cut-through
+range serving from objects still mid-transfer, the multi-source pipelined
+pull engine, and abort semantics under concurrent readers (reference
+models: object_manager chunked transfer + push_manager relays; plasma
+Create→write→Seal with readers)."""
+
+import ctypes
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.shm_store import SharedMemoryStore, ShmStoreError
+from ray_tpu.core import transfer
+
+
+@pytest.fixture
+def store():
+    s = SharedMemoryStore(f"rtpu_xfer_{os.getpid()}",
+                          capacity_bytes=64 << 20, create=True)
+    yield s
+    s.destroy()
+
+
+@pytest.fixture
+def dst_store():
+    s = SharedMemoryStore(f"rtpu_xferd_{os.getpid()}",
+                          capacity_bytes=64 << 20, create=True)
+    yield s
+    s.destroy()
+
+
+def test_progress_watermark_api(store):
+    oid = b"w" * 20
+    buf = store.create(oid, 1000)
+    # Unsealed: invisible to get(), visible to the partial API at mark 0.
+    with pytest.raises(KeyError):
+        store.get(oid)
+    assert store.progress(oid) == (1000, 0)
+    buf[:400] = b"a" * 400
+    store.set_progress(oid, 400)
+    assert store.progress(oid) == (1000, 400)
+    # Monotone: a lower watermark never rewinds.
+    store.set_progress(oid, 100)
+    assert store.progress(oid) == (1000, 400)
+    view, avail = store.get_partial(oid)
+    assert avail == 400 and bytes(view[:400]) == b"a" * 400
+    view.release()
+    store.release(oid)
+    buf[400:] = b"b" * 600
+    store.seal(oid)
+    assert store.progress(oid) == (1000, 1000)
+    assert store.get_bytes(oid) == b"a" * 400 + b"b" * 600
+    buf.release()
+
+
+def test_seal_ordering_cross_attach(store):
+    """A second attach (cross-process semantics) sees watermark advances
+    before the seal, and the sealed object after."""
+    other = SharedMemoryStore(store.name, create=False)
+    oid = b"x" * 20
+    buf = store.create(oid, 10)
+    buf[:5] = b"hello"
+    store.set_progress(oid, 5)
+    assert other.progress(oid) == (10, 5)
+    with pytest.raises(KeyError):
+        other.get(oid)  # not sealed yet
+    buf[5:] = b"world"
+    store.seal(oid)
+    assert other.get_bytes(oid) == b"helloworld"
+    buf.release()
+    other.close()
+
+
+def test_abort_with_pinned_reader(store):
+    oid = b"y" * 20
+    before = store.stats()["num_objects"]
+    buf = store.create(oid, 100)
+    buf[:50] = b"z" * 50
+    store.set_progress(oid, 50)
+    view, avail = store.get_partial(oid)  # concurrent cut-through reader
+    store.abort(oid)
+    # Aborted: new lookups miss immediately, even while the pin lives.
+    assert store.progress(oid) is None
+    with pytest.raises(KeyError):
+        store.get(oid)
+    # The last release reclaims the memory.
+    view.release()
+    store.release(oid)
+    assert store.stats()["num_objects"] == before
+    # The id is reusable after the abort drains.
+    store.put(oid, b"fresh")
+    assert store.get_bytes(oid) == b"fresh"
+    buf.release()
+
+
+def test_cut_through_range_serving(store):
+    """A puller drains an object WHILE the source is still writing it:
+    ranges are served against the advancing watermark and the pull
+    completes with the full payload — never waiting for the seal."""
+    size = 8 << 20
+    oid = b"c" * 20
+    payload = np.random.default_rng(0).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+    handle, port = transfer.start_server(store.name)
+    try:
+        buf = store.create(oid, size)
+        step = size // 8
+
+        def writer():
+            for i in range(8):
+                lo, hi = i * step, (i + 1) * step
+                buf[lo:hi] = payload[lo:hi]
+                store.set_progress(oid, hi)
+                time.sleep(0.02)
+            store.seal(oid)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            # Starts while the watermark is far from the end.
+            data = transfer.fetch_to_buffer(
+                oid, [("127.0.0.1", port)], chunk=1 << 20)
+        finally:
+            t.join()
+        assert data == payload
+        buf.release()
+    finally:
+        transfer.stop_server(handle)
+
+
+def test_multi_source_pull_splits_ranges(store, dst_store):
+    """Ranges of one pull are fetched from SEVERAL serving copies; every
+    live source moves bytes and the reassembly is exact."""
+    size = 16 << 20
+    oid = b"m" * 20
+    payload = os.urandom(size)
+    second = SharedMemoryStore(f"rtpu_xfer2_{os.getpid()}",
+                               capacity_bytes=64 << 20, create=True)
+    h1, p1 = transfer.start_server(store.name)
+    h2, p2 = transfer.start_server(second.name)
+    try:
+        store.put(oid, payload)
+        second.put(oid, payload)
+        per_src = (ctypes.c_uint64 * 8)()
+        rc = transfer.lib().transfer_pull_multi(
+            dst_store.name.encode(), oid,
+            f"127.0.0.1:{p1};127.0.0.1:{p2}".encode(),
+            1 << 20, 2, 4, per_src)
+        assert rc == size
+        assert dst_store.get_bytes(oid) == payload
+        assert per_src[0] > 0 and per_src[1] > 0, list(per_src[:2])
+        assert per_src[0] + per_src[1] == size
+    finally:
+        transfer.stop_server(h1)
+        transfer.stop_server(h2)
+        second.destroy()
+
+
+def test_pull_in_flight_raises(store, dst_store):
+    """A second same-arena pull of an in-flight object reports
+    ObjectInFlight instead of double-transferring."""
+    oid = b"f" * 20
+    handle, port = transfer.start_server(store.name)
+    try:
+        store.put(oid, b"q" * (2 << 20))
+        # Simulate an in-flight local pull: created, unsealed.
+        dst_store.create(oid, 2 << 20).release()
+        with pytest.raises(transfer.ObjectInFlight):
+            transfer.pull_to_store(dst_store.name, oid,
+                                   [("127.0.0.1", port)])
+    finally:
+        transfer.stop_server(handle)
+
+
+def test_pull_missing_everywhere(store, dst_store):
+    handle, port = transfer.start_server(store.name)
+    try:
+        assert transfer.pull_to_store(dst_store.name, b"n" * 20,
+                                      [("127.0.0.1", port)]) is None
+    finally:
+        transfer.stop_server(handle)
+
+
+def test_relay_chain_cut_through(store, dst_store):
+    """Source -> relay -> tail: the tail pulls from the RELAY while the
+    relay itself is still pulling from the source (watermark relaying,
+    reference push_manager relay trees) and everyone converges on the
+    same bytes."""
+    size = 8 << 20
+    oid = b"r" * 20
+    payload = os.urandom(size)
+    tail = SharedMemoryStore(f"rtpu_xfer3_{os.getpid()}",
+                             capacity_bytes=64 << 20, create=True)
+    h_src, p_src = transfer.start_server(store.name)
+    h_rel, p_rel = transfer.start_server(dst_store.name)
+    try:
+        store.put(oid, payload)
+        done = {}
+
+        def relay_pull():
+            done["relay"] = transfer.pull_to_store(
+                dst_store.name, oid, [("127.0.0.1", p_src)],
+                chunk=1 << 20)
+
+        t = threading.Thread(target=relay_pull)
+        t.start()
+        # The tail targets ONLY the relay, which is mid-pull.
+        tail_total = None
+        deadline = time.monotonic() + 30
+        while tail_total is None and time.monotonic() < deadline:
+            try:
+                tail_total = transfer.pull_to_store(
+                    tail.name, oid, [("127.0.0.1", p_rel)], chunk=1 << 20)
+            except transfer.ObjectInFlight:  # pragma: no cover - timing
+                break
+            if tail_total is None:
+                time.sleep(0.01)  # relay hasn't created the entry yet
+        t.join()
+        assert done["relay"] == size
+        assert tail_total == size
+        assert tail.get_bytes(oid) == payload
+    finally:
+        transfer.stop_server(h_src)
+        transfer.stop_server(h_rel)
+        tail.destroy()
+
+
+def test_store_backed_arrays_are_read_only(store):
+    """Plasma get() contract: ndarrays materialized from store-backed
+    views are read-only on every Python version (zero-copy arena views
+    on the pinned path; flag cleared on the copying fallback)."""
+    from ray_tpu.utils import serialization
+
+    arr = np.arange(4096, dtype=np.float32)
+    oid = b"a" * 20
+    store.put_parts(oid, serialization.serialize_parts(arr))
+    view = store.get_view(oid)
+    out = serialization.deserialize(view)
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out, arr)
+    assert out.flags.writeable is False
+    with pytest.raises((ValueError, RuntimeError)):
+        out[0] = 1.0
+    del out
