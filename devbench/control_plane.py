@@ -1,0 +1,188 @@
+"""Control-plane stage-latency breakdown + wire-byte accounting.
+
+Drives the submit → lease → push → reply path and emits PERF_CONTROL.json:
+- stage percentiles (p50/p90/p99) for sync task RTT, sync actor-call RTT,
+  lease grants (driver-side ``lease_grant`` spans), and worker-side task
+  execution (spans federated at the head — PR 1 telemetry),
+- per-task wire bytes from the ``ctrl_push_*`` counters, demonstrating the
+  function-registry contract: a repeat-submitted function's definition
+  crosses the wire once per WORKER (``ctrl_fn_count{op=fetch}``), not once
+  per task — per-task bytes stay O(spec header).
+
+Run: python devbench/control_plane.py [--tasks N]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RTPU_WORKER_IDLE_TTL_S", "300")
+
+import ray_tpu  # noqa: E402
+from ray_tpu import remote  # noqa: E402
+from ray_tpu.cluster_utils import Cluster  # noqa: E402
+from ray_tpu.core.worker import global_worker  # noqa: E402
+from ray_tpu.util import tracing  # noqa: E402
+from ray_tpu.utils.ids import JobID  # noqa: E402
+
+
+def pct(samples: list[float]) -> dict:
+    if not samples:
+        return {}
+    s = sorted(samples)
+
+    def at(q):
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    return {"n": len(s), "p50_ms": round(at(0.50) * 1e3, 3),
+            "p90_ms": round(at(0.90) * 1e3, 3),
+            "p99_ms": round(at(0.99) * 1e3, 3)}
+
+
+def counter_points(snapshot: dict, name: str) -> dict[tuple, float]:
+    for entry in snapshot["metrics"]:
+        if entry["name"] == name and "points" in entry:
+            return {tuple(k): v for k, v in entry["points"]}
+    return {}
+
+
+# A deliberately heavy definition (~128 KB closure): before the registry,
+# every TaskSpec shipped these bytes; now they move once per worker.
+_BALLAST = bytes(128 * 1024)
+
+
+@remote
+def probe(x):
+    return x if _BALLAST else None
+
+
+@remote
+class Pinger:
+    def ping(self):
+        return 0
+
+
+def main():
+    n_tasks = 400
+    if "--tasks" in sys.argv:
+        n_tasks = int(sys.argv[sys.argv.index("--tasks") + 1])
+    from ray_tpu.utils import config as config_mod
+
+    config_mod.set_config(config_mod.Config.load())
+    tracing.enable_tracing()
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    rt = c.connect()
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    try:
+        rt._daemon.call("prestart_workers", n=4, timeout=10)
+    except Exception:
+        pass
+    # Warm: definitions exported, workers forked+registered, leases cached.
+    ray_tpu.get([probe.remote(i) for i in range(100)], timeout=120)
+
+    from ray_tpu.util.metrics import registry
+
+    base = registry().snapshot()
+    base_push = counter_points(base, "ctrl_push_bytes").get(("task",), 0.0)
+    base_cnt = counter_points(base, "ctrl_push_count").get(("task",), 0.0)
+
+    # --- stage: async fan-out (lease grants appear as spans) ---
+    t0 = time.perf_counter()
+    ray_tpu.get([probe.remote(i) for i in range(n_tasks)], timeout=300)
+    async_wall = time.perf_counter() - t0
+
+    # --- stage: sync task RTT ---
+    sync_rtt = []
+    for i in range(min(n_tasks, 200)):
+        t0 = time.perf_counter()
+        ray_tpu.get(probe.remote(i))
+        sync_rtt.append(time.perf_counter() - t0)
+
+    # --- stage: sync actor-call RTT ---
+    a = Pinger.remote()
+    ray_tpu.get(a.ping.remote(), timeout=120)
+    actor_rtt = []
+    for _ in range(min(n_tasks, 200)):
+        t0 = time.perf_counter()
+        ray_tpu.get(a.ping.remote())
+        actor_rtt.append(time.perf_counter() - t0)
+
+    snap = registry().snapshot()
+    push_bytes = counter_points(snap, "ctrl_push_bytes").get(("task",), 0.0) \
+        - base_push
+    push_cnt = counter_points(snap, "ctrl_push_count").get(("task",), 0.0) \
+        - base_cnt
+
+    # Driver-side spans: lease grants. Head-federated spans: worker-side
+    # task execution (the PR 1 telemetry path).
+    grant = [s.end_ts - s.start_ts for s in tracing.spans()
+             if s.name == "lease_grant"]
+    time.sleep(1.2)  # one telemetry flush period: workers ship their spans
+    head_spans = rt.cluster_spans()
+    exec_spans = [s["end_ts"] - s["start_ts"] for s in head_spans
+                  if s.get("name") == "probe" and s.get("kind") == "worker"]
+
+    # Registry accounting, cluster-wide (driver exports + worker fetches).
+    tel = rt.get_telemetry()["sources"]
+    fn_ops: dict[str, float] = {}
+    fn_bytes: dict[str, float] = {}
+    me = f":{os.getpid()}"
+    for src, row in tel.items():
+        if src.endswith(me):
+            continue  # this process reports below from its live registry
+        for key, val in counter_points(row["snapshot"], "ctrl_fn_count").items():
+            fn_ops[key[0]] = fn_ops.get(key[0], 0.0) + val
+        for key, val in counter_points(row["snapshot"], "ctrl_fn_bytes").items():
+            fn_bytes[key[0]] = fn_bytes.get(key[0], 0.0) + val
+    for key, val in counter_points(snap, "ctrl_fn_count").items():
+        fn_ops[key[0]] = fn_ops.get(key[0], 0.0) + val
+    for key, val in counter_points(snap, "ctrl_fn_bytes").items():
+        fn_bytes[key[0]] = fn_bytes.get(key[0], 0.0) + val
+
+    fn_blob_bytes = len(probe._fn_blob or b"")
+    out = {
+        "note": ("per-task wire bytes for a repeat-submitted function: the "
+                 "spec names the definition by content id; the pickled "
+                 "definition moves once per worker (op=fetch), not per task"),
+        "hardware": {"nproc": os.cpu_count()},
+        "tasks_measured": int(push_cnt),
+        "fn_definition_bytes": fn_blob_bytes,
+        "per_task_push_bytes": round(push_bytes / max(push_cnt, 1), 1),
+        "fn_registry": {
+            "exports": int(fn_ops.get("export", 0)),
+            "fetches": int(fn_ops.get("fetch", 0)),
+            "cache_hits": int(fn_ops.get("hit", 0)),
+            "export_bytes": int(fn_bytes.get("export", 0)),
+            "fetch_bytes": int(fn_bytes.get("fetch", 0)),
+        },
+        "head_fn_stats": dict(c.head.fn_stats),
+        "stages": {
+            "sync_task_rtt": pct(sync_rtt),
+            "sync_actor_call_rtt": pct(actor_rtt),
+            "lease_grant": pct(grant),
+            "worker_exec_span": pct(exec_spans),
+        },
+        "async_tasks_per_s": round(n_tasks / async_wall, 1),
+    }
+    ray_tpu.kill(a)
+    rt.shutdown()
+    c.shutdown()
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PERF_CONTROL.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,7 +49,12 @@ class Cluster:
                 head._health_task.cancel()
             if head._persist_task:
                 head._persist_task.cancel()
-            head._wal_f = None  # records already flushed per mutation
+            # Default group commit coalesces per event-loop tick, and this
+            # coroutine is scheduled BEHIND any pending flush callback — so
+            # every ACKed mutation's record is already at the OS. (With
+            # wal_group_commit_ms > 0 a kill may drop the window's tail;
+            # that is the documented trade.)
+            head._wal_f = None
             await head.rpc.stop()
 
         self._io.run(hard_stop())

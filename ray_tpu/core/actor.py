@@ -133,6 +133,7 @@ class ActorClass:
         self._cls = cls
         self._options = {**_DEFAULT_ACTOR_OPTIONS, **options}
         self._cls_blob: bytes | None = None
+        self._cls_id: str | None = None  # content address of _cls_blob
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -141,8 +142,12 @@ class ActorClass:
         )
 
     def options(self, **overrides) -> "ActorClass":
+        # Share the serialized definition and its registry id with the copy:
+        # options() that only changes resources must not re-pickle or
+        # re-export an identical cls_blob (same hash → same registry entry).
         new = ActorClass(self._cls, {**self._options, **overrides})
         new._cls_blob = self._cls_blob
+        new._cls_id = self._cls_id
         return new
 
     def remote(self, *args, **kwargs) -> ActorHandle:
@@ -150,6 +155,17 @@ class ActorClass:
         worker.check_connected()
         if self._cls_blob is None:
             self._cls_blob = serialization.dumps_function(self._cls)
+        if self._cls_id is None:
+            from ray_tpu.core.fn_registry import fn_id
+
+            self._cls_id = fn_id(self._cls_blob)
+        cls_blob, cls_id = self._cls_blob, self._cls_id
+        export = getattr(worker.runtime, "export_function", None)
+        if export is not None:
+            export(cls_id, cls_blob)
+            cls_blob = b""
+        else:
+            cls_id = ""
         opts = self._options
         actor_id = ActorID.of(worker.job_id)
         arg_refs = extract_arg_refs(args, kwargs)
@@ -164,7 +180,8 @@ class ActorClass:
         spec = ActorCreationSpec(
             actor_id=actor_id,
             job_id=worker.job_id,
-            cls_blob=self._cls_blob,
+            cls_blob=cls_blob,
+            cls_id=cls_id,
             args_blob=serialization.serialize((args, kwargs)),
             arg_ref_ids=[r.id for r in arg_refs],
             resources=resources,

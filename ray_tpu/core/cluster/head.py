@@ -14,12 +14,15 @@ reference optionally persists to Redis); this build keeps tables in memory.
 from __future__ import annotations
 
 import asyncio
+import pickle
+import struct
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
 from ray_tpu.core.cluster.protocol import RpcServer, ServerConnection, spawn_task
+from ray_tpu.core.fn_registry import FN_NS
 from ray_tpu.utils.config import get_config
 
 
@@ -98,10 +101,20 @@ class HeadServer:
         self._persist_task: asyncio.Task | None = None
         self._write_fut = None  # in-flight executor write, if any
         self._wal_f = None  # append handle for the mutation log
+        # Group-commit buffer: packed records awaiting one coalesced
+        # write+flush (scheduled same-tick, or wal_group_commit_ms later).
+        self._wal_buf: list[bytes] = []
+        self._wal_flush_scheduled = False
         self.pgs: dict[str, dict] = {}
         if persist_path:
             self._load_snapshot()
             self._open_wal()
+            # Group-commit ordering guarantee (default mode): drain buffered
+            # WAL records before ANY response frame is written, so an ACKed
+            # mutation is always at the OS first. With a timer window
+            # (wal_group_commit_ms > 0) the bounded-durability trade is
+            # explicit and the hook stands down.
+            self.rpc.pre_reply = self._wal_pre_reply
         # Cluster-wide task events flushed from workers (reference:
         # GcsTaskManager bounded task-event store).
         from collections import deque
@@ -117,6 +130,10 @@ class HeadServer:
         # a federated export with a node_id label per series.
         self.telemetry: dict[str, dict] = {}  # source -> {node_id, ts, snapshot}
         self.spans: deque = deque(maxlen=50_000)
+        # Function-registry observability (puts/gets/misses/dup_puts) —
+        # the definitions themselves live in the KV under FN_NS.
+        self.fn_stats: dict[str, int] = {
+            "puts": 0, "dup_puts": 0, "gets": 0, "misses": 0}
         self._subs: dict[str, set[ServerConnection]] = {}  # channel -> conns
         self._node_conns: dict[str, ServerConnection] = {}
         self._register_handlers()
@@ -139,6 +156,8 @@ class HeadServer:
         r("get_actor_info", self._get_actor_info)
         r("get_named_actor", self._get_named_actor)
         r("kill_actor", self._kill_actor)
+        r("fn_put", self._fn_put)
+        r("fn_get", self._fn_get)
         r("kv_put", self._kv_put)
         r("kv_get", self._kv_get)
         r("kv_del", self._kv_del)
@@ -168,6 +187,7 @@ class HeadServer:
         return addr
 
     async def stop(self):
+        self._flush_wal()  # no buffered mutation outlives the server
         if self._health_task:
             self._health_task.cancel()
         if self._persist_task:
@@ -188,27 +208,63 @@ class HeadServer:
     # Durability model (reference: the GCS persists PER MUTATION through
     # redis_store_client.cc; a crash between writes loses nothing): every
     # mutation appends a record to a write-ahead log, and the periodic
-    # snapshot compacts it. Records are flushed to the OS per mutation (a
-    # head-process crash loses nothing; only a whole-machine power loss can
-    # drop the un-fsynced tail — redis appendfsync-everysec makes the same
-    # trade). Restart = load snapshot, then replay <path>.wal.old + .wal.
+    # snapshot compacts it. Records are GROUP-COMMITTED: a mutation buffers
+    # its record and one coalesced write+flush covers every record buffered
+    # since the last flush. In the default mode the rpc layer's pre_reply
+    # hook (_wal_pre_reply) drains the buffer BEFORE any response frame is
+    # written, so a client never observes an ACK whose record isn't at the
+    # OS — and a burst of mutations answered in one tick still pays one
+    # write. wal_group_commit_ms > 0 switches to a timer window for
+    # write-bound churn: ACKs may then precede their records by up to the
+    # window (redis appendfsync-everysec makes the same trade). Only a
+    # whole-machine power loss can drop the un-fsynced tail. Restart =
+    # load snapshot, then replay <path>.wal.old + .wal.
     def mark_dirty(self) -> None:
         self._dirty = True
 
     def _log_mutation(self, kind: str, *args) -> None:
-        """Append one durable mutation record and mark the snapshot stale."""
+        """Buffer one durable mutation record and mark the snapshot stale."""
         self._dirty = True
         if self._wal_f is None:
             return
-        import pickle
-        import struct
-
         try:
             rec = pickle.dumps((kind, args))
-            self._wal_f.write(struct.pack("<I", len(rec)) + rec)
+        except Exception:
+            return  # durability is best-effort; the snapshot still lands
+        self._wal_buf.append(struct.pack("<I", len(rec)) + rec)
+        if self._wal_flush_scheduled:
+            return
+        self._wal_flush_scheduled = True
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._flush_wal()  # off-loop caller (init replay): write now
+            return
+        ms = get_config().wal_group_commit_ms
+        if ms > 0:
+            loop.call_later(ms / 1000.0, self._flush_wal)
+        else:
+            loop.call_soon(self._flush_wal)
+
+    def _wal_pre_reply(self) -> None:
+        if self._wal_buf and get_config().wal_group_commit_ms <= 0:
+            self._flush_wal()
+
+    def _flush_wal(self) -> None:
+        """One coalesced append for every record buffered since the last
+        flush (the group commit)."""
+        self._wal_flush_scheduled = False
+        if not self._wal_buf:
+            return
+        data = b"".join(self._wal_buf)
+        self._wal_buf.clear()
+        if self._wal_f is None:
+            return
+        try:
+            self._wal_f.write(data)
             self._wal_f.flush()
         except Exception:
-            pass  # durability is best-effort; the snapshot still lands
+            pass  # best-effort; the snapshot still lands
 
     def _open_wal(self) -> None:
         import os
@@ -226,6 +282,7 @@ class HeadServer:
 
         if self._wal_f is None:
             return
+        self._flush_wal()  # buffered records belong to the closing segment
         try:
             self._wal_f.close()
             old = self._persist_path + ".wal.old"
@@ -769,8 +826,17 @@ class HeadServer:
                            "strategy": strategy, "assignment": None,
                            "name": name}
         self._log_mutation("pg", pg_id, dict(self.pgs[pg_id]))
-        spawn_task(self._schedule_pg(pg_id))
-        return {"ok": True}
+        # Inline the FIRST placement attempt, briefly: on an uncontended
+        # cluster the PG is CREATED before this reply, so the client's
+        # first ready() poll succeeds (PG churn previously paid poll
+        # backoff sleeps + extra state RPCs per group). A busy cluster
+        # falls back to background retries without delaying the reply.
+        task = spawn_task(self._schedule_pg(pg_id))
+        try:
+            await asyncio.wait_for(asyncio.shield(task), timeout=0.25)
+        except Exception:  # noqa: BLE001 - timeout: scheduling continues
+            pass
+        return {"ok": True, "state": self.pgs[pg_id]["state"]}
 
     async def _schedule_pg(self, pg_id: str, retries: int = 120):
         pg = self.pgs[pg_id]
@@ -779,30 +845,55 @@ class HeadServer:
                 return
             assignment = self._assign_bundles(pg["bundles"], pg["strategy"])
             if assignment is not None:
-                # Per-node concurrent prepares (reference 2PC semantics;
-                # sequential per-bundle RPCs made PG churn latency scale
-                # with bundle count).
+                # One grant RPC per NODE per phase, nodes in parallel
+                # (reference 2PC semantics — CommitAllBundles batches per
+                # raylet; per-bundle RPCs made PG churn latency scale with
+                # bundle count).
                 by_node: dict[str, list[int]] = {}
                 for idx, nid in enumerate(assignment):
                     by_node.setdefault(nid, []).append(idx)
 
+                if len(by_node) == 1:
+                    # Single participant: 2PC collapses to one RPC (the
+                    # daemon prepares+commits atomically on its own loop).
+                    nid, idxs = next(iter(by_node.items()))
+                    ok = False
+                    try:
+                        cli = await self._daemon_rpc(nid)
+                        res = await cli.call(
+                            "prepare_commit_bundles", pg_id=pg_id,
+                            bundle_indices=idxs,
+                            resources_list=[pg["bundles"][i] for i in idxs])
+                        ok = bool(res.get("ok"))
+                    except Exception:  # noqa: BLE001 - node/RPC failure
+                        ok = False
+                    if ok:
+                        if pg["state"] == "REMOVED":  # raced a remove()
+                            await self._rollback_bundles(
+                                pg_id, assignment, idxs)
+                            return
+                        pg["assignment"] = assignment
+                        pg["state"] = "CREATED"
+                        self._log_mutation("pg", pg_id, dict(pg))
+                        await self.publish("pg_events", pg_id=pg_id,
+                                           state="CREATED")
+                        return
+                    await asyncio.sleep(0.5)
+                    continue
+
                 async def _prepare_node(nid: str, idxs: list[int]):
                     # Never raises: a partial failure still reports the
                     # bundles that DID prepare so rollback can return them.
-                    got: list[int] = []
                     try:
                         cli = await self._daemon_rpc(nid)
-                        for idx in idxs:
-                            res = await cli.call(
-                                "prepare_bundle", pg_id=pg_id,
-                                bundle_index=idx,
-                                resources=pg["bundles"][idx])
-                            if not res.get("ok"):
-                                return got, False
-                            got.append(idx)
+                        res = await cli.call(
+                            "prepare_bundles", pg_id=pg_id,
+                            bundle_indices=idxs,
+                            resources_list=[pg["bundles"][i] for i in idxs])
+                        return list(res.get("prepared") or []), \
+                            bool(res.get("ok"))
                     except Exception:  # noqa: BLE001 - node/RPC failure
-                        return got, False
-                    return got, True
+                        return [], False
 
                 prepared: list[int] = []
                 ok = True
@@ -818,14 +909,11 @@ class HeadServer:
                     await self._rollback_bundles(pg_id, assignment, prepared)
                     return
                 if ok:
-                    committed: list[int] = []
                     try:
                         async def _commit_node(nid: str, idxs: list[int]):
                             cli = await self._daemon_rpc(nid)
-                            for idx in idxs:
-                                await cli.call("commit_bundle", pg_id=pg_id,
-                                               bundle_index=idx)
-                                committed.append(idx)
+                            await cli.call("commit_bundles", pg_id=pg_id,
+                                           bundle_indices=idxs)
 
                         # return_exceptions: every node's coroutine runs to
                         # completion BEFORE any rollback decision — a plain
@@ -846,7 +934,8 @@ class HeadServer:
                         await asyncio.sleep(0.5)
                         continue
                     if pg["state"] == "REMOVED":  # removed during commit
-                        await self._rollback_bundles(pg_id, assignment, committed)
+                        # Bundle return handles prepared AND committed.
+                        await self._rollback_bundles(pg_id, assignment, prepared)
                         return
                     pg["assignment"] = assignment
                     pg["state"] = "CREATED"
@@ -861,10 +950,14 @@ class HeadServer:
 
     async def _rollback_bundles(self, pg_id: str, assignment: list[str],
                                 indices: list[int]) -> None:
+        by_node: dict[str, list[int]] = {}
         for idx in indices:
+            by_node.setdefault(assignment[idx], []).append(idx)
+        for nid, idxs in by_node.items():
             try:
-                cli = await self._daemon_rpc(assignment[idx])
-                await cli.call("return_bundle", pg_id=pg_id, bundle_index=idx)
+                cli = await self._daemon_rpc(nid)
+                await cli.call("return_bundles", pg_id=pg_id,
+                               bundle_indices=idxs)
             except Exception:
                 pass
 
@@ -877,15 +970,46 @@ class HeadServer:
         # we return the already-committed assignment here.
         pg["state"] = "REMOVED"
         self._log_mutation("pg_del", pg_id)
-        if pg.get("assignment"):
-            await self._rollback_bundles(
-                pg_id, pg["assignment"], list(range(len(pg["assignment"]))))
-            pg["assignment"] = None
+        assignment = pg.get("assignment")
+        pg["assignment"] = None
+        if assignment:
+            # Bundle return rides in the background: the REMOVED state is
+            # already authoritative (no new bundle tasks schedule), and the
+            # client needn't wait out a daemon round trip per node.
+            spawn_task(self._rollback_bundles(
+                pg_id, assignment, list(range(len(assignment)))))
         return {"ok": True}
 
     async def _pg_state(self, conn: ServerConnection, pg_id: str):
         pg = self.pgs.get(pg_id)
         return {"state": pg["state"] if pg else "REMOVED"}
+
+    # ------------------------------------------------------------------ function registry
+    # Content-addressed definition table (reference: the GCS function table
+    # behind function_manager.py exports). Backed by a KV namespace so the
+    # WAL/snapshot persistence covers it like any other KV data; fn_stats
+    # makes the once-per-definition / once-per-worker contract observable.
+    # KNOWN BOUND: the table grows with DISTINCT definitions for the head's
+    # lifetime (the reference's per-job function tables have the same shape
+    # until job GC). Eviction is deliberately absent — submitters cache
+    # "already exported" per process, so dropping a blob would permanently
+    # fail their in-flight specs. Job-scoped GC is the right future fix.
+    async def _fn_put(self, conn: ServerConnection, fn_id: str, blob: bytes):
+        table = self.kv.setdefault(FN_NS, {})
+        if fn_id in table:
+            self.fn_stats["dup_puts"] += 1
+            return {"ok": True, "existed": True}
+        table[fn_id] = blob
+        self.fn_stats["puts"] += 1
+        self._log_mutation("kv_put", FN_NS, fn_id, blob)
+        return {"ok": True, "existed": False}
+
+    async def _fn_get(self, conn: ServerConnection, fn_id: str):
+        blob = self.kv.get(FN_NS, {}).get(fn_id)
+        self.fn_stats["gets"] += 1
+        if blob is None:
+            self.fn_stats["misses"] += 1
+        return {"blob": blob}
 
     # ------------------------------------------------------------------ KV
     # (reference: gcs_kv_manager.cc internal KV — function/code storage, serve

@@ -55,6 +55,16 @@ class _PendingLease:
     fut: asyncio.Future
     env_hash: str = ""
     owner: str = ""
+    # Multi-lease request (reference: the submitter's lease requests are
+    # per-task; here one RPC asks for up to lease_batch_max workers sized
+    # by its queue depth). ``granted`` accumulates grants until ``count``
+    # is reached or the wait loop settles for a partial batch.
+    count: int = 1
+    granted: list = field(default_factory=list)
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.count - len(self.granted))
 
 
 # Which daemon flushes this process's telemetry (see _telemetry_loop).
@@ -153,12 +163,17 @@ class NodeDaemon:
         r = self.rpc.register
         r("register_worker_proc", self._register_worker_proc)
         r("request_lease", self._request_lease)
+        r("lease_workers", self._lease_workers)
         r("return_lease", self._return_lease)
         r("node_info", self._node_info)
         r("ping", self._ping)
         r("prepare_bundle", self._prepare_bundle)
         r("commit_bundle", self._commit_bundle)
         r("return_bundle", self._return_bundle)
+        r("prepare_bundles", self._prepare_bundles)
+        r("commit_bundles", self._commit_bundles)
+        r("return_bundles", self._return_bundles)
+        r("prepare_commit_bundles", self._prepare_commit_bundles)
         r("list_logs", self._list_logs)
         r("tail_log", self._tail_log)
         r("prestart_workers", self._prestart_workers)
@@ -629,9 +644,11 @@ class NodeDaemon:
                     available=self.available, resources=self.resources,
                     # Pending lease demands feed the autoscaler (reference:
                     # raylet reports resource load to GcsResourceManager for
-                    # GcsAutoscalerStateManager).
+                    # GcsAutoscalerStateManager). Batched requests count one
+                    # demand per REMAINING grant.
                     pending_demands=[r.resources for r in self._pending
-                                     if not r.fut.done()],
+                                     if not r.fut.done()
+                                     for _ in range(max(1, r.remaining))],
                     peers_version=self._gossip_peers_version)
                 # Authoritative membership for the gossip ring (view data
                 # itself travels daemon-to-daemon, not through the head):
@@ -787,6 +804,32 @@ class NodeDaemon:
     async def _request_lease(self, conn: ServerConnection, resources: dict,
                              timeout: float | None = None, env_hash: str = "",
                              allow_spill: bool = True, owner: str = ""):
+        """Single-lease RPC (legacy shape): one grant dict, or spill/error."""
+        res = await self._lease_common(resources, 1, timeout, env_hash,
+                                       allow_spill, owner)
+        grants = res.get("grants")
+        if grants:
+            return grants[0]
+        return res
+
+    async def _lease_workers(self, conn: ServerConnection, resources: dict,
+                             count: int = 1, timeout: float | None = None,
+                             env_hash: str = "", allow_spill: bool = True,
+                             owner: str = ""):
+        """Batched lease RPC: grant up to ``count`` workers in ONE round
+        trip (reference: the raylet grants one worker per
+        RequestWorkerLease; the per-RPC pump serialized multi-client
+        fan-out on daemon round trips). Replies as soon as ANY grants are
+        in hand — the submitter re-requests the remainder while forked
+        workers boot — so batch latency tracks the FIRST available worker,
+        not the last."""
+        count = max(1, min(int(count), get_config().lease_batch_max))
+        return await self._lease_common(resources, count, timeout, env_hash,
+                                        allow_spill, owner)
+
+    async def _lease_common(self, resources: dict, count: int,
+                            timeout: float | None, env_hash: str,
+                            allow_spill: bool, owner: str):
         if not self._feasible(resources):
             # Spillback: find a feasible node from the gossiped peer view
             # (head fallback while the ring converges) — reference:
@@ -797,9 +840,19 @@ class NodeDaemon:
                     return {"spill": best}
             return {"error": f"infeasible resource demand {resources}"}
         fut = asyncio.get_running_loop().create_future()
-        req = _PendingLease(dict(resources), fut, env_hash, owner)
+        req = _PendingLease(dict(resources), fut, env_hash, owner,
+                            count=count)
         self._pending.append(req)
         self._try_grant()
+        if fut.done():
+            return fut.result()
+        if req.granted:
+            # Partial immediate grant: the idle pool covered some of the
+            # batch and _try_grant already forked toward the remainder.
+            # No await since the grant pass — removal is atomic.
+            self._pending = [p for p in self._pending if p is not req]
+            fut.cancel()
+            return {"grants": req.granted}
         cfg = get_config()
         deadline = time.monotonic() + (timeout or cfg.worker_lease_timeout_s)
         # Queue locally, but if the wait drags on and another node has free
@@ -810,6 +863,8 @@ class NodeDaemon:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 self._pending = [p for p in self._pending if p is not req]
+                if req.granted:
+                    return {"grants": req.granted}
                 return {"error": "lease timeout", "timeout": True}
             try:
                 return await asyncio.wait_for(
@@ -819,6 +874,12 @@ class NodeDaemon:
                 pass
             if fut.done():
                 return fut.result()
+            if req.granted:
+                # A wait beat passed with part of the batch in hand: ship
+                # it rather than hold granted workers hostage to forks.
+                self._pending = [p for p in self._pending if p is not req]
+                fut.cancel()
+                return {"grants": req.granted}
             # Spill only when this node's resources are genuinely busy. When
             # the demand fits (we are merely waiting for a forked worker to
             # register) the grant is imminent — spilling then ping-pongs the
@@ -829,6 +890,14 @@ class NodeDaemon:
             best = await self._find_spill(resources, key="available")
             if fut.done():  # granted while we were looking
                 return fut.result()
+            if req.granted:
+                # _try_grant partially filled the batch DURING the
+                # find-spill await: those workers are leased to this
+                # request — returning a spill instead would leak them
+                # (nobody would ever return their lease ids).
+                self._pending = [p for p in self._pending if p is not req]
+                fut.cancel()
+                return {"grants": req.granted}
             if best is not None:
                 # No await between the done-check and removal: the grant
                 # path runs on this loop, so this hand-off is atomic.
@@ -888,6 +957,41 @@ class NodeDaemon:
                 pristine = w
         return None if exact_only else pristine
 
+    def _fit_units(self, demand: dict[str, float]) -> int:
+        """How many MORE leases of ``demand`` the current availability can
+        hold (bounds fork sizing: forking past what the node could ever
+        grant burns ~1 s of CPU per useless worker boot)."""
+        units: int | None = None
+        for k, v in demand.items():
+            if v <= 0:
+                continue
+            u = int(self.available.get(k, 0.0) / v)
+            units = u if units is None else min(units, u)
+        return (1 << 30) if units is None else units  # zero-resource demand
+
+    def _grant_to(self, req: _PendingLease) -> bool:
+        """Assign one idle worker to ``req`` (appends to req.granted)."""
+        from ray_tpu.runtime_env.container import container_spec
+
+        container = container_spec(req.env_hash)
+        w = self._idle_worker(req.env_hash, exact_only=container is not None)
+        if w is None:
+            return False
+        lease_id = uuid.uuid4().hex
+        w.lease_id = lease_id
+        if req.env_hash:
+            w.env_hash = req.env_hash  # branded for this env from now on
+        w.owner = req.owner
+        w.lease_granted_at = time.monotonic()
+        w.resources = req.resources
+        self._take_resources(req.resources)
+        self._leases[lease_id] = w
+        req.granted.append({
+            "lease_id": lease_id, "worker_id": w.worker_id,
+            "addr": list(w.addr),
+        })
+        return True
+
     def _try_grant(self):
         from ray_tpu.runtime_env.container import container_spec
 
@@ -897,39 +1001,30 @@ class NodeDaemon:
         for req in self._pending:
             if req.fut.done():
                 continue
-            if not self._fits(req.resources):
-                still.append(req)
-                continue
             container = container_spec(req.env_hash)
             if container is not None and \
                     self._container_fails.get(req.env_hash, 0) >= \
                     self.CONTAINER_BOOT_RETRIES:
-                req.fut.set_result({"error": (
-                    f"container worker for image "
-                    f"{container['image_uri']!r} failed to start "
-                    f"{self.CONTAINER_BOOT_RETRIES} times — check the "
-                    "image reference and the container runner "
-                    "(RTPU_CONTAINER_RUNNER)")})
+                if req.granted:
+                    req.fut.set_result({"grants": req.granted})
+                else:
+                    req.fut.set_result({"error": (
+                        f"container worker for image "
+                        f"{container['image_uri']!r} failed to start "
+                        f"{self.CONTAINER_BOOT_RETRIES} times — check the "
+                        "image reference and the container runner "
+                        "(RTPU_CONTAINER_RUNNER)")})
                 continue
-            w = self._idle_worker(req.env_hash,
-                                  exact_only=container is not None)
-            if w is None:
-                unmet.append(req)
-                still.append(req)
+            # Fill the batch from the idle pool while resources hold out.
+            while req.remaining and self._fits(req.resources) and \
+                    self._grant_to(req):
+                pass
+            if not req.remaining:
+                req.fut.set_result({"grants": req.granted})
                 continue
-            lease_id = uuid.uuid4().hex
-            w.lease_id = lease_id
-            if req.env_hash:
-                w.env_hash = req.env_hash  # branded for this env from now on
-            w.owner = req.owner
-            w.lease_granted_at = time.monotonic()
-            w.resources = req.resources
-            self._take_resources(req.resources)
-            self._leases[lease_id] = w
-            req.fut.set_result({
-                "lease_id": lease_id, "worker_id": w.worker_id,
-                "addr": list(w.addr),
-            })
+            still.append(req)
+            if self._fits(req.resources):
+                unmet.append(req)  # workers, not resources, are the gap
         self._pending = still
         # Fork only the DEFICIT beyond workers already starting: one fork per
         # unmatched request per grant pass compounds into a fork storm (each
@@ -939,11 +1034,35 @@ class NodeDaemon:
         # num_initial_python_workers/startup caps, not per-request).
         # Container requests fork a worker FOR their env (brand at birth):
         # count one fork per distinct container env, dedup so ten queued
-        # tasks of one env don't fork ten containers in a pass.
+        # tasks of one env don't fork ten containers in a pass. Batched
+        # requests count their REMAINING grants, capped by how many leases
+        # the availability could actually hold (_fit_units).
         starting = len(self._unregistered)
+        plain_deficit = 0
+        container_unmet: list[_PendingLease] = []
+        for req in unmet:
+            if container_spec(req.env_hash) is not None:
+                container_unmet.append(req)
+            else:
+                plain_deficit += min(req.remaining,
+                                     self._fit_units(req.resources))
+        if plain_deficit > 0 and cfg.idle_worker_pool > 0:
+            # Warm pool (demand-gated): keep a few workers booting AHEAD of
+            # the deficit so the next fan-out burst lands on registered
+            # workers instead of serializing on fork+handshake. Gated on
+            # PLAIN deficit: container-only demand can't use plain-process
+            # workers (exact-env match), and zero deficit means resources,
+            # not workers, are the gap.
+            plain_deficit += cfg.idle_worker_pool
+        # Concurrent boots are additionally capped by the machine's cores:
+        # a Python worker boot costs ~1 s of CPU, and launching more boots
+        # than cores STARVES the running tasks the grants are for (the
+        # config cap alone let a burst on a 2-core host fork 8 at once).
+        boot_cap = min(cfg.worker_startup_concurrency,
+                       max(1, os.cpu_count() or 1))
         to_start = min(
-            len(unmet) - starting,
-            cfg.worker_startup_concurrency - starting,
+            plain_deficit + len(container_unmet) - starting,
+            boot_cap - starting,
             cfg.max_workers_per_node - len(self.workers) - starting,
         )
         if to_start <= 0:
@@ -951,18 +1070,19 @@ class NodeDaemon:
         started = 0
         seen_container_envs = {
             w.env_hash for w in self._unregistered if w.env_hash}
-        for req in unmet:
+        for req in container_unmet:
             if started >= to_start:
                 break
-            container = container_spec(req.env_hash)
-            if container is not None:
-                if req.env_hash in seen_container_envs:
-                    continue  # a matching container worker is already booting
-                seen_container_envs.add(req.env_hash)
-                self._fork_worker(container=container, brand=req.env_hash)
-            else:
-                self._fork_worker()
+            if req.env_hash in seen_container_envs:
+                continue  # a matching container worker is already booting
+            seen_container_envs.add(req.env_hash)
+            self._fork_worker(container=container_spec(req.env_hash),
+                              brand=req.env_hash)
             started += 1
+        while started < to_start and plain_deficit > 0:
+            self._fork_worker()
+            started += 1
+            plain_deficit -= 1
 
     async def _return_lease(self, conn: ServerConnection, lease_id: str):
         w = self._leases.pop(lease_id, None)
@@ -999,11 +1119,25 @@ class NodeDaemon:
         self._prepared_bundles[key] = dict(resources)
         return {"ok": True}
 
-    async def _commit_bundle(self, conn, pg_id: str, bundle_index: int):
+    async def _prepare_bundles(self, conn, pg_id: str, bundle_indices: list,
+                               resources_list: list):
+        """Batched 2PC prepare: all of this node's bundles in one RPC. A
+        partial failure still reports what DID prepare so the coordinator
+        can roll those back."""
+        got: list[int] = []
+        for idx, res in zip(bundle_indices, resources_list):
+            r = await self._prepare_bundle(conn, pg_id, int(idx), res)
+            if not r.get("ok"):
+                return {"ok": False, "prepared": got,
+                        "reason": r.get("reason", "")}
+            got.append(int(idx))
+        return {"ok": True, "prepared": got}
+
+    def _commit_bundle_local(self, pg_id: str, bundle_index: int) -> bool:
         key = (pg_id, bundle_index)
         base = self._prepared_bundles.pop(key, None)
         if base is None:
-            return {"ok": key in self._committed_bundles}
+            return key in self._committed_bundles
         derived = {f"{k}_pg_{pg_id[:16]}_{bundle_index}": v
                    for k, v in base.items()}
         # Bundle marker resource: pins even zero-resource tasks to the bundle's
@@ -1013,22 +1147,66 @@ class NodeDaemon:
             self.resources[k] = v
             self.available[k] = v
         self._committed_bundles[key] = (base, derived)
-        # Push the new totals immediately so spillback routing sees the
-        # derived bundle resources without waiting a heartbeat period.
-        try:
-            await self._head.call("heartbeat", node_id=self.node_id,
-                                  available=self.available,
-                                  resources=self.resources)
-        except Exception:
-            pass
+        return True
+
+    def _push_totals(self) -> None:
+        """Heartbeat the new resource totals NOW — spillback routing must
+        see derived bundle resources without waiting a heartbeat period —
+        but off the commit RPC's critical path (the reply doesn't block on
+        a head round trip)."""
+        from ray_tpu.core.cluster.protocol import spawn_task
+
+        async def push():
+            try:
+                await self._head.call("heartbeat", node_id=self.node_id,
+                                      available=self.available,
+                                      resources=self.resources)
+            except Exception:
+                pass
+
+        spawn_task(push())
+
+    async def _commit_bundle(self, conn, pg_id: str, bundle_index: int):
+        ok = self._commit_bundle_local(pg_id, bundle_index)
+        self._push_totals()
+        return {"ok": ok}
+
+    async def _prepare_commit_bundles(self, conn, pg_id: str,
+                                      bundle_indices: list,
+                                      resources_list: list):
+        """Single-participant fast path: when every bundle of a PG lands on
+        THIS node there is no cross-node atomicity to coordinate — prepare
+        and commit collapse into one RPC (classic one-phase optimization
+        for a 2PC with exactly one participant). All-or-nothing locally."""
+        got: list[int] = []
+        for idx, res in zip(bundle_indices, resources_list):
+            r = await self._prepare_bundle(conn, pg_id, int(idx), res)
+            if not r.get("ok"):
+                for i in got:
+                    self._return_bundle_local(pg_id, i)
+                return {"ok": False, "reason": r.get("reason", "")}
+            got.append(int(idx))
+        for i in got:
+            self._commit_bundle_local(pg_id, i)
+        self._push_totals()
         return {"ok": True}
 
-    async def _return_bundle(self, conn, pg_id: str, bundle_index: int):
+    async def _commit_bundles(self, conn, pg_id: str, bundle_indices: list):
+        """Batched 2PC commit: every bundle this node hosts in one RPC,
+        one totals push for the lot (reference:
+        GcsPlacementGroupScheduler::CommitAllBundles per-raylet batch)."""
+        ok = True
+        for idx in bundle_indices:
+            ok = self._commit_bundle_local(pg_id, int(idx)) and ok
+        self._push_totals()
+        return {"ok": ok}
+
+    def _return_bundle_local(self, pg_id: str, bundle_index: int) -> None:
         key = (pg_id, bundle_index)
         base = self._prepared_bundles.pop(key, None)
         if base is not None:  # rollback of a prepared-but-uncommitted bundle
             self._release_resources(base)
-            return {"ok": True}
+            return
         entry = self._committed_bundles.pop(key, None)
         if entry is not None:
             base, derived = entry
@@ -1036,6 +1214,16 @@ class NodeDaemon:
                 self.resources.pop(k, None)
                 self.available.pop(k, None)
             self._release_resources(base)
+
+    async def _return_bundle(self, conn, pg_id: str, bundle_index: int):
+        self._return_bundle_local(pg_id, bundle_index)
+        return {"ok": True}
+
+    async def _return_bundles(self, conn, pg_id: str, bundle_indices: list):
+        for idx in bundle_indices:
+            self._return_bundle_local(pg_id, int(idx))
+        self._try_grant()  # freed base resources may satisfy queued leases
+        self._push_totals()  # removal visible without a heartbeat wait
         return {"ok": True}
 
     # ------------------------------------------------------------------ actors

@@ -13,6 +13,7 @@ no copies beyond the socket buffers.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import itertools
 import socket
 import struct
@@ -42,6 +43,15 @@ def spawn_task(coro) -> asyncio.Task:
 def _pack(msg: dict) -> bytes:
     body = msgpack.packb(msg, use_bin_type=True)
     return _LEN.pack(len(body)) + body
+
+
+def pack_reply(rid, result=None, err: str | None = None) -> bytes:
+    """Pre-pack a response frame OFF the event loop (raw-handler fast path:
+    execution threads serialize their own replies; the loop only writes)."""
+    if err is not None:
+        return _pack({"r": rid, "e": err})
+    return _pack({"r": rid, "o": result})
+
 
 
 class _CoalescingWriter:
@@ -130,9 +140,21 @@ class RpcServer:
         self.host = host
         self.port = port
         self._handlers: dict[str, Callable[..., Awaitable[Any]]] = {}
+        # Raw handlers: fn(conn, msg) invoked INLINE in the read loop — no
+        # task spawn, no auto-reply. The handler owns correlation: it hands
+        # the frame to an execution thread which packs the reply itself and
+        # posts it back via conn.post (the actor/task dispatch fast path).
+        self._raw_handlers: dict[str, Callable[..., Any]] = {}
         self._server: asyncio.AbstractServer | None = None
         self._conns: set["ServerConnection"] = set()
         self.on_disconnect: Callable[["ServerConnection"], None] | None = None
+        # Invoked (on the loop) immediately before ANY response frame is
+        # written. The head points this at its WAL group-commit flush so a
+        # client can never observe an ACK whose mutation record hasn't
+        # reached the OS — callback scheduling order alone cannot guarantee
+        # that (a reply flush scheduled earlier in the tick would carry the
+        # ACK first).
+        self.pre_reply: Callable[[], None] | None = None
 
     def handler(self, name: str):
         def deco(fn):
@@ -143,6 +165,9 @@ class RpcServer:
 
     def register(self, name: str, fn: Callable[..., Awaitable[Any]]):
         self._handlers[name] = fn
+
+    def register_raw(self, name: str, fn: Callable[..., Any]):
+        self._raw_handlers[name] = fn
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(self._on_client, self.host, self.port)
@@ -194,10 +219,23 @@ class ServerConnection:
         self._cw = _CoalescingWriter(writer)
 
     async def serve(self):
+        raw = self.server._raw_handlers
         while True:
             msg = await _read_frame(self.reader)
             if msg is None:
                 return
+            fn = raw.get(msg.get("m")) if raw else None
+            if fn is not None:
+                # Inline fast dispatch: enqueue-to-executor is non-blocking,
+                # and skipping the per-frame task + reply future halves the
+                # loop work of a small-call round trip.
+                try:
+                    fn(self, msg)
+                except Exception as e:  # noqa: BLE001
+                    rid = msg.get("i")
+                    if rid is not None:
+                        await self._reply(rid, err=f"{type(e).__name__}: {e}")
+                continue
             spawn_task(self._dispatch(msg))
 
     async def _dispatch(self, msg: dict):
@@ -215,6 +253,9 @@ class ServerConnection:
                 await self._reply(rid, err=f"{type(e).__name__}: {e}")
 
     async def _reply(self, rid, ok=None, err=None):
+        hook = self.server.pre_reply
+        if hook is not None:
+            hook()
         frame = {"r": rid, "e": err} if err is not None else {"r": rid, "o": ok}
         try:
             self._cw.write(_pack(frame))
@@ -226,6 +267,20 @@ class ServerConnection:
         """Server-initiated push (used by pubsub long-poll replacement)."""
         self._cw.write(_pack({"m": method, "a": kwargs}))
         await self._cw.maybe_drain()
+
+    def post(self, frames) -> None:
+        """Write pre-packed frame bytes (one blob or a list). Loop-thread
+        only — execution threads schedule it via call_soon_threadsafe. The
+        coalescing writer merges every frame posted this tick into one
+        transport write."""
+        try:
+            if isinstance(frames, (bytes, bytearray)):
+                self._cw.write(frames)
+            else:
+                for f in frames:
+                    self._cw.write(f)
+        except (ConnectionResetError, BrokenPipeError):
+            pass  # peer gone; its client sees the loss from the read side
 
 
 class AsyncRpcClient:
@@ -289,7 +344,61 @@ class AsyncRpcClient:
         except (ConnectionResetError, BrokenPipeError, OSError) as e:
             self._pending.pop(rid, None)
             raise RpcConnectionLost(f"send failed: {e}", sent=False)
-        return await asyncio.wait_for(fut, timeout)
+        if timeout is None:
+            # No wait_for wrapper: it costs a timer handle + an extra task
+            # per call, and unbounded calls are the hot path (push_task,
+            # push_actor_call ride with timeout=None).
+            return await fut
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)  # timed out: don't leak the slot
+
+    def call_nowait(self, method: str, **kwargs) -> asyncio.Future:
+        """Send a request and return its pending future WITHOUT awaiting —
+        callers attach done-callbacks instead of spawning a task per call
+        (the per-actor-call fast path). Loop-thread only."""
+        fut = asyncio.get_running_loop().create_future()
+        if self._closed:
+            fut.set_exception(RpcConnectionLost("client closed", sent=False))
+            return fut
+        rid = next(self._seq)
+        self._pending[rid] = fut
+        try:
+            self._cw.write(_pack({"m": method, "i": rid, "a": kwargs}))
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            self._pending.pop(rid, None)
+            fut.set_exception(RpcConnectionLost(f"send failed: {e}",
+                                                sent=False))
+        return fut
+
+    def call_many(self, method: str, payloads: list) -> list[asyncio.Future]:
+        """N individually-correlated requests in ONE frame: the multi-call
+        frame ``{"m": method, "c": [[rid, payload], ...]}`` amortizes
+        pack/write across a burst while every payload keeps its own reply
+        future (replies arrive as normal per-rid frames, in any order).
+        Loop-thread only."""
+        loop = asyncio.get_running_loop()
+        futs = [loop.create_future() for _ in payloads]
+        if self._closed:
+            err = RpcConnectionLost("client closed", sent=False)
+            for f in futs:
+                f.set_exception(err)
+            return futs
+        calls = []
+        for fut, payload in zip(futs, payloads):
+            rid = next(self._seq)
+            self._pending[rid] = fut
+            calls.append((rid, payload))
+        try:
+            self._cw.write(_pack({"m": method, "c": calls}))
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            err = RpcConnectionLost(f"send failed: {e}", sent=False)
+            for (rid, _), fut in zip(calls, futs):
+                self._pending.pop(rid, None)
+                if not fut.done():
+                    fut.set_exception(err)
+        return futs
 
     async def notify(self, method: str, **kwargs):
         self._cw.write(_pack({"m": method, "a": kwargs}))
@@ -360,11 +469,55 @@ class RpcClient:
         if self.on_reconnect is not None:
             self.on_reconnect()
 
+    def _call_once(self, method: str, timeout: float | None,
+                   kwargs: dict) -> Any:
+        """One request/response round trip, minimal hops: the frame is
+        packed on the CALLER thread (serialization overlaps loop work), one
+        call_soon_threadsafe registers the pending future and writes, and
+        the caller blocks on a concurrent.futures.Future — no wrapper
+        coroutine, no run_coroutine_threadsafe double-future, no wait_for
+        timer per call. Profiled against the old path this roughly halves
+        the non-wire cost of a sync control RPC (the 1_1_actor_calls_sync /
+        single_client_tasks_sync flamegraphs were dominated by these
+        allocations and thread handoffs)."""
+        a = self._async
+        if a._closed:
+            raise RpcConnectionLost("client closed", sent=False)
+        rid = next(a._seq)
+        data = _pack({"m": method, "i": rid, "a": kwargs})
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def send():
+            # Re-check closed ON THE LOOP: _fail_all (read loop) may have
+            # drained _pending between the caller-thread check and this
+            # callback — registering after it, against a dead transport
+            # whose write raises nothing, would leave the future pending
+            # FOREVER (a timeout=None caller would hang, not reconnect).
+            if a._closed:
+                if not fut.done():
+                    fut.set_exception(
+                        RpcConnectionLost("connection lost", sent=False))
+                return
+            a._pending[rid] = fut
+            try:
+                a._cw.write(data)
+            except Exception as e:  # noqa: BLE001 - dying transport
+                a._pending.pop(rid, None)
+                if not fut.done():
+                    fut.set_exception(
+                        RpcConnectionLost(f"send failed: {e}", sent=False))
+
+        self._io.loop.call_soon_threadsafe(send)
+        try:
+            return fut.result(timeout)
+        except concurrent.futures.TimeoutError:
+            a._pending.pop(rid, None)
+            raise TimeoutError(
+                f"rpc {method} timed out after {timeout}s") from None
+
     def call(self, method: str, timeout: float | None = None, **kwargs) -> Any:
         try:
-            return self._io.run(
-                self._async.call(method, timeout=timeout, **kwargs),
-                timeout=timeout)
+            return self._call_once(method, timeout, kwargs)
         except RpcConnectionLost as e:
             if e.sent:
                 # The request may have executed (only the reply was lost):
@@ -372,12 +525,19 @@ class RpcClient:
                 # failure; the NEXT call reconnects via the sent=False path.
                 raise
             self._reconnect()
-            return self._io.run(
-                self._async.call(method, timeout=timeout, **kwargs),
-                timeout=timeout)
+            return self._call_once(method, timeout, kwargs)
 
     def notify(self, method: str, **kwargs) -> None:
-        self._io.run(self._async.notify(method, **kwargs))
+        data = _pack({"m": method, "a": kwargs})
+        a = self._async
+
+        def send():
+            try:
+                a._cw.write(data)
+            except Exception:
+                pass  # loss surfaces on the read side
+
+        self._io.loop.call_soon_threadsafe(send)
 
     def close(self):
         try:

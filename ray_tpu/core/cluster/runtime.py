@@ -51,7 +51,7 @@ from ray_tpu.core.exceptions import (
     TaskCancelledError,
     TaskError,
 )
-from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_ref import ObjectRef, refcounting_suppressed
 from ray_tpu.core.store import LocalObjectStore, ReferenceCounter
 from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
 from ray_tpu.utils import serialization
@@ -59,6 +59,51 @@ from ray_tpu.utils.config import get_config
 from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, WorkerID
 
 import cloudpickle
+
+
+# Control-plane byte accounting (lazy: the registry must not import-cost the
+# hot path). Tags: kind = task|actor for pushes, op = export|fetch|hit for
+# registry traffic. These flush to the head with every telemetry push, so
+# devbench/control_plane.py can show per-task wire bytes cluster-wide.
+_ctrl_metrics = None
+
+
+def ctrl_metrics():
+    global _ctrl_metrics
+    if _ctrl_metrics is None:
+        from ray_tpu.util.metrics import Counter
+
+        _ctrl_metrics = (
+            Counter("ctrl_push_bytes",
+                    "serialized task-spec bytes pushed to executors",
+                    tag_keys=("kind",)),
+            Counter("ctrl_push_count", "task specs pushed to executors",
+                    tag_keys=("kind",)),
+            Counter("ctrl_fn_bytes",
+                    "definition bytes through the function registry",
+                    tag_keys=("op",)),
+            Counter("ctrl_fn_count", "function registry operations",
+                    tag_keys=("op",)),
+        )
+    return _ctrl_metrics
+
+
+def observe_ctrl_push(kind: str, nbytes: int) -> None:
+    try:
+        push_b, push_c, _, _ = ctrl_metrics()
+        push_b.inc(float(nbytes), tags={"kind": kind})
+        push_c.inc(1.0, tags={"kind": kind})
+    except Exception:
+        pass  # metrics must never fail a submit
+
+
+def observe_ctrl_fn(op: str, nbytes: int) -> None:
+    try:
+        _, _, fn_b, fn_c = ctrl_metrics()
+        fn_b.inc(float(nbytes), tags={"op": op})
+        fn_c.inc(1.0, tags={"op": op})
+    except Exception:
+        pass
 
 
 class _LeasedWorker:
@@ -93,7 +138,7 @@ class _KeyState:
     SchedulingKey in normal_task_submitter.h:52). Loop-thread-only."""
 
     __slots__ = ("key", "resources", "env_hash", "queue", "workers",
-                 "pending_leases", "strategy", "spread_idx", "pump_scheduled")
+                 "pending_leases", "lease_rpcs", "strategy", "spread_idx")
 
     def __init__(self, key, resources, env_hash, strategy=None):
         self.key = key
@@ -101,10 +146,10 @@ class _KeyState:
         self.env_hash = env_hash
         self.queue: deque[_TaskItem] = deque()
         self.workers: list[_LeasedWorker] = []
-        self.pending_leases = 0
+        self.pending_leases = 0  # WORKERS requested in flight (not RPCs)
+        self.lease_rpcs = 0      # outstanding lease RPCs
         self.strategy = strategy   # SchedulingStrategy (None = DEFAULT)
         self.spread_idx = 0        # SPREAD round-robin cursor
-        self.pump_scheduled = False  # a deferred _pump is queued on the loop
 
 
 class _ActorState:
@@ -114,8 +159,7 @@ class _ActorState:
     Loop-thread-only."""
 
     __slots__ = ("actor_id", "client", "addr", "pending", "inflight",
-                 "resolving", "window", "retrying", "recovering",
-                 "pump_scheduled")
+                 "resolving", "window", "retrying", "recovering")
 
     def __init__(self, actor_id: str):
         self.actor_id = actor_id
@@ -127,7 +171,6 @@ class _ActorState:
         self.window = 256
         self.retrying: list[_TaskItem] = []
         self.recovering = False
-        self.pump_scheduled = False
 
 
 class ClusterRuntime:
@@ -231,6 +274,9 @@ class ClusterRuntime:
         self._nodes_cache: tuple[float, dict] | None = None  # (ts, nodes)
         self._xfer_cache = None  # (ts, {node_id: transfer_addr})
         self._actor_states: dict[str, str] = {}
+        # Definitions this process already exported to the head registry
+        # (idempotence cache — reference: function_manager's exported set).
+        self._exported_fns: set[str] = set()
         self._cancelled: set[str] = set()  # task_id hex
         # Lineage retention for reconstruction (reference:
         # task_manager.h:184 lineage kept while returns are referenced;
@@ -290,6 +336,9 @@ class ClusterRuntime:
         def _on_head_reconnect():
             # A restarted head rebuilt its tables from its snapshot; refresh
             # anything connection-scoped (worker directory row, pubsub subs).
+            # A non-persistent head came back EMPTY: drop the export cache
+            # so the next submit of each definition re-exports it.
+            self._exported_fns.clear()
             try:
                 self.head.call("register_worker",
                                worker_id=self.worker_id.hex(),
@@ -890,8 +939,9 @@ class ClusterRuntime:
         # entries and retracts their relay adverts (no owner broadcast
         # exists to do it for us).
         owns = rec is None or rec.owner_id == self.worker_id
+        store_had = False
         if owns:
-            self.store.delete(oid)
+            store_had = self.store.delete(oid)
         elif oid not in self._pinned_borrows:
             self._borrow_cache[oid] = time.monotonic()
         self._recovery_attempts.pop(oid, None)
@@ -914,9 +964,11 @@ class ClusterRuntime:
         # The shm arena is shared node-wide: only the object's owner may
         # delete from it — a borrower releasing its cache must not GC data
         # other processes still reference (reference: owner-driven GC,
-        # reference_counter.h).
+        # reference_counter.h). Objects the PROCESS store held were never
+        # in the arena (the two are exclusive destinations) — skip the
+        # native lookup, which was pure overhead for every inline result.
         if rec is not None and rec.owner_id == self.worker_id \
-                and self.shm is not None:
+                and not store_had and self.shm is not None:
             try:
                 self.shm.delete(oid.binary())
             except Exception:
@@ -989,8 +1041,9 @@ class ClusterRuntime:
         oid = ObjectID.for_put(self.worker_id)
         self._store_blob(oid, serialization.serialize_parts(value),
                          self.worker_id)
-        self.refs.add_owned(oid, self.worker_id)
-        return ObjectRef(oid, self.worker_id)
+        lr = 0 if refcounting_suppressed() else 1
+        self.refs.add_owned(oid, self.worker_id, local_refs=lr)
+        return (ObjectRef.counted if lr else ObjectRef)(oid, self.worker_id)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -1473,17 +1526,50 @@ class ClusterRuntime:
         return ready, pending
 
     # ------------------------------------------------------------------ tasks
+    def export_function(self, fn_id: str, fn_blob: bytes) -> None:
+        """Publish a definition to the head registry once per process
+        (reference: FunctionManager.export — definitions ride the GCS
+        function table, not every TaskSpec). Idempotent: the head keeps
+        the first copy of a content id; re-exports are cheap no-ops."""
+        if fn_id in self._exported_fns:
+            return
+        self.head.call("fn_put", fn_id=fn_id, blob=fn_blob)
+        self._exported_fns.add(fn_id)
+        observe_ctrl_fn("export", len(fn_blob))
+
+    def fetch_function(self, fn_id: str, retries: int = 40) -> bytes:
+        """Executor-side registry fetch with a negative-lookup retry: a
+        definition exported through a different head connection can trail
+        the first task naming it by a beat (head restart replay, racing
+        exports). Bounded: a definition that never appears is an error on
+        the task, not a hang."""
+        for attempt in range(retries):
+            res = self.head.call("fn_get", fn_id=fn_id, timeout=10)
+            blob = res.get("blob")
+            if blob is not None:
+                observe_ctrl_fn("fetch", len(blob))
+                return blob
+            time.sleep(0.05 * min(attempt + 1, 5))
+        raise KeyError(f"function definition {fn_id} not in the registry")
+
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
         from ray_tpu.core.events import global_event_buffer
 
         return_ids = spec.return_ids()
+        # Fused: ownership + the returned ref's local count in one
+        # refcounter lock round trip (the per-ref __init__ accounting was a
+        # top profile entry under multi-threaded submission). Suppressed
+        # inside refcount_disabled() (proxy layers).
+        lr = 0 if refcounting_suppressed() else 1
         for oid in return_ids:
-            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
+            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id,
+                                local_refs=lr)
         spec.owner_id = self.worker_id
         global_event_buffer().record(
             spec.task_id.hex(), spec.name, "SUBMITTED",
             worker_id=self.worker_id.hex(), job_id=spec.job_id.hex())
         item = _TaskItem(spec, serialization.dumps_spec(spec), return_ids)
+        observe_ctrl_push("task", len(item.blob))
         if spec.num_returns != "streaming":
             # Retain lineage while any return is referenced so a lost copy
             # can be recomputed by resubmission — bounded by a byte budget
@@ -1508,18 +1594,34 @@ class ClusterRuntime:
             self._submit_wake = True
         if wake:
             self._io.loop.call_soon_threadsafe(self._drain_submits)
-        return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+        make = ObjectRef.counted if lr else ObjectRef
+        return [make(oid, self.worker_id) for oid in return_ids]
 
     def _drain_submits(self) -> None:
+        # One wakeup drains every submission buffered since the last drain;
+        # pumping AFTER the full drain is what batches a burst into one
+        # push frame per worker (the old per-key deferred-pump tick bought
+        # the same batching at one extra loop iteration per submit — pure
+        # latency on the sync path).
         with self._submit_lock:
             items = list(self._submit_buf)
             self._submit_buf.clear()
             self._submit_wake = False
+        touched_ks: dict[int, _KeyState] = {}
+        touched_actors: dict[int, _ActorState] = {}
         for kind, item in items:
             if kind == "task":
-                self._submit_on_loop(item)
+                ks = self._enqueue_task(item)
+                if ks is not None:
+                    touched_ks[id(ks)] = ks
             else:
-                self._actor_submit_on_loop(item)
+                st = self._enqueue_actor_task(item)
+                if st is not None:
+                    touched_actors[id(st)] = st
+        for ks in touched_ks.values():
+            self._pump(ks)
+        for st in touched_actors.values():
+            self._actor_pump(st)
 
     def _recover_object(self, object_id: ObjectID) -> bool:
         """Lineage reconstruction: resubmit the task that created the object
@@ -1561,11 +1663,13 @@ class ClusterRuntime:
         return True
 
     # -- loop-side submission state machine --------------------------------
-    def _submit_on_loop(self, item: _TaskItem) -> None:
+    def _enqueue_task(self, item: _TaskItem) -> _KeyState | None:
+        """Queue one task on its key state WITHOUT pumping (the drain loop
+        pumps each touched key once per wakeup — burst batching)."""
         tid = item.spec.task_id.hex()
         if tid in self._cancelled:
             self._store_error_local(item.return_ids, TaskCancelledError())
-            return
+            return None
         key = item.spec.scheduling_key()
         ks = self._key_states.get(key)
         if ks is None:
@@ -1574,16 +1678,12 @@ class ClusterRuntime:
             self._key_states[key] = ks
         ks.queue.append(item)
         self._task_where[tid] = ("queued", ks)
-        # Defer the pump one loop tick so a burst of submissions lands in
-        # the queue before dispatch — that is what lets _pump push a BATCH
-        # per worker instead of one task per frame.
-        if not ks.pump_scheduled:
-            ks.pump_scheduled = True
-            self._io.loop.call_soon(self._deferred_pump, ks)
+        return ks
 
-    def _deferred_pump(self, ks: _KeyState) -> None:
-        ks.pump_scheduled = False
-        self._pump(ks)
+    def _submit_on_loop(self, item: _TaskItem) -> None:
+        ks = self._enqueue_task(item)
+        if ks is not None:
+            self._pump(ks)
 
     def _pump(self, ks: _KeyState) -> None:
         if self._shutdown:
@@ -1651,7 +1751,15 @@ class ClusterRuntime:
             if batch[0].spec.num_returns == "streaming":
                 spawn_task(self._push_and_collect(ks, w, batch[0]))
             else:
-                spawn_task(self._push_batch_and_collect(ks, w, batch))
+                # Callback-style push (no per-batch coroutine): the reply
+                # resolves a pending future whose done-callback lands the
+                # results — two fewer loop iterations per round trip than
+                # spawning an awaiting task.
+                fut = w.client.call_nowait(
+                    "push_task_batch", blobs=[i.blob for i in batch])
+                fut.add_done_callback(
+                    lambda f, w=w, batch=batch:
+                    self._task_batch_done(ks, w, batch, f))
         # Scale out: request more leases while a backlog remains.
         if self._daemon is None:
             if ks.queue and not ks.workers and ks.pending_leases == 0:
@@ -1665,11 +1773,26 @@ class ClusterRuntime:
             return
         capacity = sum(depth - w.inflight
                        for w in ks.workers if not w.dead)
-        deficit = len(ks.queue) - capacity
-        want = min(self.MAX_PENDING_LEASES - ks.pending_leases, deficit)
-        for _ in range(max(0, want)):
-            ks.pending_leases += 1
-            spawn_task(self._request_lease(ks))
+        deficit = len(ks.queue) - capacity - ks.pending_leases
+        if deficit <= 0 or ks.lease_rpcs >= self.MAX_PENDING_LEASES:
+            return
+        if spread:
+            # SPREAD leases stay one-per-RPC: each request round-robins to
+            # a DIFFERENT entry daemon (_lease_entry_daemon) — a batched
+            # grant would land the whole backlog on one node.
+            for _ in range(min(deficit,
+                               self.MAX_PENDING_LEASES - ks.lease_rpcs)):
+                ks.pending_leases += 1
+                ks.lease_rpcs += 1
+                spawn_task(self._request_lease(ks, 1))
+        else:
+            # One RPC sized by the queue deficit: the daemon grants up to
+            # lease_batch_max workers in a single round trip (the per-RPC
+            # pump was the multi-client fan-out bottleneck).
+            count = min(deficit, get_config().lease_batch_max)
+            ks.pending_leases += count
+            ks.lease_rpcs += 1
+            spawn_task(self._request_lease(ks, count))
 
     async def _push_and_collect(self, ks: _KeyState, w: _LeasedWorker,
                                 item: _TaskItem) -> None:
@@ -1711,45 +1834,47 @@ class ClusterRuntime:
                 self._task_where.pop(tid, None)
             self._pump(ks)
 
-    async def _push_batch_and_collect(self, ks: _KeyState, w: _LeasedWorker,
-                                      items: list[_TaskItem]) -> None:
-        """Batched variant of _push_and_collect: one RPC carries N task
-        specs, one reply carries N results (executed in order on the
-        worker). Failure handling mirrors the single path, applied to every
-        item of the batch."""
+    def _task_batch_done(self, ks: _KeyState, w: _LeasedWorker,
+                         items: list[_TaskItem], fut) -> None:
+        """Completion callback of one batched push (one RPC carried N task
+        specs, one reply carries N results, executed in order on the
+        worker). Failure handling mirrors _push_and_collect, applied to
+        every item of the batch; the slow terminal-error path (worker-fate
+        RPC) runs as its own task off this callback."""
         try:
-            reply = await w.client.call(
-                "push_task_batch", blobs=[i.blob for i in items],
-                timeout=None)
-            for item, r in zip(items, reply["replies"]):
-                self._handle_task_reply(item.spec, item.return_ids, r,
-                                        notify=False)
-            self._notify_waiters()
-        except (RpcError, OSError) as e:
-            w.dead = True
-            if w in ks.workers:
-                ks.workers.remove(w)
-                spawn_task(self._return_dead_lease(w))
-            sent = getattr(e, "sent", True)
-            retry = []
-            for item in items:
-                if sent:
-                    item.attempts += 1
-                if item.attempts > max(item.spec.max_retries, 0):
-                    err = await self._terminal_push_error(
-                        w, e, item.spec.name)
-                    self._store_error_local(item.return_ids, err)
-                else:
-                    retry.append(item)
-            if retry:
-                await asyncio.sleep(get_config().task_retry_delay_s)
-                for item in retry:
-                    ks.queue.append(item)
-                    self._task_where[item.spec.task_id.hex()] = ("queued", ks)
-        except Exception as e:  # noqa: BLE001
-            for item in items:
-                self._store_error_local(item.return_ids,
-                                        TaskError(e, task_desc=item.spec.name))
+            try:
+                if fut.cancelled():
+                    raise RpcConnectionLost("push cancelled")
+                exc = fut.exception()
+                if exc is not None:
+                    raise exc
+                reply = fut.result()
+                for item, r in zip(items, reply["replies"]):
+                    self._handle_task_reply(item.spec, item.return_ids, r,
+                                            notify=False)
+                self._notify_waiters()
+            except (RpcError, OSError) as e:
+                w.dead = True
+                if w in ks.workers:
+                    ks.workers.remove(w)
+                    spawn_task(self._return_dead_lease(w))
+                sent = getattr(e, "sent", True)
+                retry, terminal = [], []
+                for item in items:
+                    if sent:
+                        item.attempts += 1
+                    if item.attempts > max(item.spec.max_retries, 0):
+                        terminal.append(item)
+                    else:
+                        retry.append(item)
+                if terminal:
+                    spawn_task(self._fail_items_terminal(w, e, terminal))
+                if retry:
+                    spawn_task(self._requeue_after_delay(ks, retry))
+            except Exception as e:  # noqa: BLE001
+                for item in items:
+                    self._store_error_local(
+                        item.return_ids, TaskError(e, task_desc=item.spec.name))
         finally:
             w.inflight -= len(items)
             if w.inflight <= 0:
@@ -1760,6 +1885,20 @@ class ClusterRuntime:
                 if where is not None and where[0] == "running":
                     self._task_where.pop(tid, None)
             self._pump(ks)
+
+    async def _fail_items_terminal(self, w: _LeasedWorker, e: Exception,
+                                   items: list[_TaskItem]) -> None:
+        for item in items:
+            err = await self._terminal_push_error(w, e, item.spec.name)
+            self._store_error_local(item.return_ids, err)
+
+    async def _requeue_after_delay(self, ks: _KeyState,
+                                   items: list[_TaskItem]) -> None:
+        await asyncio.sleep(get_config().task_retry_delay_s)
+        for item in items:
+            ks.queue.append(item)
+            self._task_where[item.spec.task_id.hex()] = ("queued", ks)
+        self._pump(ks)
 
     async def _lease_entry_daemon(self, ks: _KeyState):
         """(daemon, pinned) the lease request starts at, per scheduling
@@ -1880,28 +2019,36 @@ class ClusterRuntime:
             return None
         return max(counts.items(), key=lambda kv: kv[1])[0]
 
-    async def _request_lease(self, ks: _KeyState) -> None:
-        """Lease a worker from the local daemon (or the strategy's entry
-        node), following spillback redirects (reference:
-        cluster_lease_manager spillback). A granted worker that refuses
-        connections (killed between grant and connect) is returned and the
-        lease re-requested."""
+    async def _request_lease(self, ks: _KeyState, count: int = 1) -> None:
+        """Lease up to ``count`` workers from the local daemon (or the
+        strategy's entry node) in one RPC, following spillback redirects
+        (reference: cluster_lease_manager spillback). Granted workers that
+        refuse connections (killed between grant and connect) are returned
+        and the lease re-requested."""
+        from ray_tpu.util import tracing
+
         try:
             for _ in range(4):
                 try:
                     daemon, pinned = await self._lease_entry_daemon(ks)
-                    res = await daemon.call("request_lease",
-                                            resources=ks.resources,
-                                            env_hash=ks.env_hash, timeout=None,
-                                            allow_spill=not pinned,
-                                            owner=self.worker_id.hex())
+                    # Stage span for the control-plane breakdown
+                    # (devbench/control_plane.py): grant latency = one
+                    # daemon round trip, possibly plus spill hops.
+                    with tracing.span("lease_grant",
+                                      attributes={"count": count}):
+                        res = await daemon.call(
+                            "lease_workers", resources=ks.resources,
+                            count=count, env_hash=ks.env_hash, timeout=None,
+                            allow_spill=not pinned,
+                            owner=self.worker_id.hex())
                     hops = 0
                     while res.get("spill") and hops < 4:
                         daemon = await self._apeer(tuple(res["spill"]))
                         # Final hop commits to its node: prevents spill
                         # ping-pong when every node is briefly busy.
-                        res = await daemon.call("request_lease",
+                        res = await daemon.call("lease_workers",
                                                 resources=ks.resources,
+                                                count=count,
                                                 env_hash=ks.env_hash,
                                                 timeout=None,
                                                 allow_spill=hops < 3,
@@ -1922,24 +2069,31 @@ class ClusterRuntime:
                     if res.get("timeout"):
                         raise LeaseTimeoutError(res["error"])
                     raise ValueError(res["error"])
-                client = AsyncRpcClient(*tuple(res["addr"]))
-                client.on_notify("stream_item", self._on_stream_item)
-                try:
-                    await client.connect()
-                except OSError:
-                    # Dead-on-arrival worker (chaos kill mid-grant): hand
-                    # the lease back so the daemon reaps it, then retry.
+
+                async def _adopt(g: dict):
+                    client = AsyncRpcClient(*tuple(g["addr"]))
+                    client.on_notify("stream_item", self._on_stream_item)
                     try:
-                        await daemon.call("return_lease",
-                                          lease_id=res["lease_id"])
-                    except Exception:
-                        pass
-                    await asyncio.sleep(0.1)
-                    continue
-                w = _LeasedWorker(res["lease_id"], res["worker_id"],
-                                  tuple(res["addr"]), client, daemon)
-                ks.workers.append(w)
-                return
+                        await client.connect()
+                    except OSError:
+                        # Dead-on-arrival worker (chaos kill mid-grant):
+                        # hand the lease back so the daemon reaps it.
+                        try:
+                            await daemon.call("return_lease",
+                                              lease_id=g["lease_id"])
+                        except Exception:
+                            pass
+                        return None
+                    return _LeasedWorker(g["lease_id"], g["worker_id"],
+                                         tuple(g["addr"]), client, daemon)
+
+                adopted = await asyncio.gather(
+                    *(_adopt(g) for g in res.get("grants") or []))
+                live = [w for w in adopted if w is not None]
+                if live:
+                    ks.workers.extend(live)
+                    return
+                await asyncio.sleep(0.1)  # every grant DOA: retry
             raise ValueError("granted workers repeatedly unreachable")
         except Exception as e:  # noqa: BLE001
             # A lease TIMEOUT is a stale-demand signal, not a task failure:
@@ -1958,7 +2112,8 @@ class ClusterRuntime:
                 self._store_error_local(item.return_ids,
                                         TaskError(e, task_desc=item.spec.name))
         finally:
-            ks.pending_leases -= 1
+            ks.pending_leases -= count
+            ks.lease_rpcs -= 1
             self._pump(ks)
 
     def _handle_task_reply(self, spec, return_ids, reply: dict,
@@ -2151,34 +2306,33 @@ class ClusterRuntime:
 
     def submit_actor_task(self, spec: TaskSpec) -> list[ObjectRef]:
         return_ids = spec.return_ids()
+        lr = 0 if refcounting_suppressed() else 1
         for oid in return_ids:
-            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
+            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id,
+                                local_refs=lr)
         spec.owner_id = self.worker_id
         item = _TaskItem(spec, serialization.dumps_spec(spec), return_ids)
+        observe_ctrl_push("actor", len(item.blob))
         with self._submit_lock:
             self._submit_buf.append(("actor", item))
             wake = not self._submit_wake
             self._submit_wake = True
         if wake:
             self._io.loop.call_soon_threadsafe(self._drain_submits)
-        return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+        make = ObjectRef.counted if lr else ObjectRef
+        return [make(oid, self.worker_id) for oid in return_ids]
 
     # -- loop-side actor state machine --------------------------------------
-    def _actor_submit_on_loop(self, item: _TaskItem) -> None:
+    def _enqueue_actor_task(self, item: _TaskItem) -> _ActorState:
+        """Queue one call on its actor state WITHOUT pumping (the drain
+        loop pumps each touched actor once per wakeup — burst batching)."""
         aid = item.spec.actor_id.hex()
         st = self._actor_sm.get(aid)
         if st is None:
             st = _ActorState(aid)
             self._actor_sm[aid] = st
         st.pending.append(item)
-        # Defer one tick so same-burst calls dispatch as a batch frame.
-        if not st.pump_scheduled:
-            st.pump_scheduled = True
-            self._io.loop.call_soon(self._actor_deferred_pump, st)
-
-    def _actor_deferred_pump(self, st: _ActorState) -> None:
-        st.pump_scheduled = False
-        self._actor_pump(st)
+        return st
 
     def _actor_pump(self, st: _ActorState) -> None:
         if self._shutdown:
@@ -2188,26 +2342,42 @@ class ClusterRuntime:
                 st.resolving = True
                 spawn_task(self._actor_resolve(st))
             return
-        # FIFO dispatch: tasks spawned here start in creation order and
-        # frames hit the wire in program order (reference: sequence-numbered
-        # sends). Bursst of calls ride one batched frame each (the worker
-        # executes them in order and replies once).
+        # FIFO dispatch: frames hit the wire in program order (reference:
+        # sequence-numbered sends) over one connection, so the actor's
+        # mailbox receives calls in order. Each call is its own correlated
+        # request (call_nowait + done-callback — no task or batch gather
+        # per call): replies resolve the right future in WHATEVER order
+        # the actor finishes them, so a slow async call never blocks the
+        # results of later calls (reference: direct actor call replies
+        # correlate per-call in core_worker.cc).
+        client = st.client
         while st.pending and st.inflight < st.window:
+            if st.pending[0].spec.num_returns == "streaming":
+                # Streaming rides the legacy push path (its items flow back
+                # as notify frames on the pushing connection). The frame is
+                # WRITTEN here, synchronously, so it keeps its place in
+                # program order relative to the fast-path frames below (a
+                # spawned-task send would let later calls overtake it).
+                item = st.pending.popleft()
+                st.inflight += 1
+                fut = client.call_nowait("push_actor_task",
+                                         spec_blob=item.blob)
+                spawn_task(self._actor_push(st, client, item, fut))
+                continue
+            # Burst coalescing: one multi-call frame carries every call
+            # queued this pump (up to 64), each with its own reply future.
             batch: list[_TaskItem] = []
             room = min(st.window - st.inflight, 64)
-            while st.pending and len(batch) < room:
-                if st.pending[0].spec.num_returns == "streaming" and batch:
-                    break  # streaming rides the single-push path
+            while st.pending and len(batch) < room and \
+                    st.pending[0].spec.num_returns != "streaming":
                 batch.append(st.pending.popleft())
-                if batch[-1].spec.num_returns == "streaming":
-                    break
             st.inflight += len(batch)
-            # Streaming only on the single path; batch otherwise (one
-            # failure-handling state machine for normal calls).
-            if batch[0].spec.num_returns == "streaming":
-                spawn_task(self._actor_push(st, batch[0]))
-            else:
-                spawn_task(self._actor_push_batch(st, batch))
+            futs = client.call_many("push_actor_calls",
+                                    [i.blob for i in batch])
+            for item, fut in zip(batch, futs):
+                fut.add_done_callback(
+                    lambda f, item=item, client=client:
+                    self._actor_call_done(st, client, item, f))
 
     async def _actor_resolve(self, st: _ActorState) -> None:
         """Wait for the actor to be ALIVE and open its connection. Transient
@@ -2267,11 +2437,12 @@ class ClusterRuntime:
             item = st.pending.popleft()
             self._store_error_local(item.return_ids, err)
 
-    async def _actor_push(self, st: _ActorState, item: _TaskItem) -> None:
-        client = st.client  # the connection THIS call rides
+    async def _actor_push(self, st: _ActorState, client: AsyncRpcClient,
+                          item: _TaskItem, fut) -> None:
+        """Await one already-sent legacy push (streaming calls; the frame
+        was written in _actor_pump to preserve program order)."""
         try:
-            reply = await client.call("push_actor_task",
-                                      spec_blob=item.blob, timeout=None)
+            reply = await fut
             if reply.get("dead"):
                 raise RpcError(reply.get("reason", "actor dead"))
             self._handle_task_reply(item.spec, item.return_ids, reply)
@@ -2307,51 +2478,53 @@ class ClusterRuntime:
             st.inflight -= 1
             self._actor_pump(st)
 
-    async def _actor_push_batch(self, st: _ActorState,
-                                items: list[_TaskItem]) -> None:
-        """Batched variant of _actor_push: one frame carries N method calls,
-        executed in order by the actor, one reply with N results. Failure
-        handling mirrors the single path applied per item (all land in
-        ``retrying`` in order, so the post-restart merge preserves FIFO)."""
-        client = st.client
+    def _actor_call_done(self, st: _ActorState, client: AsyncRpcClient,
+                         item: _TaskItem, fut) -> None:
+        """Completion callback of one fast-path actor call (loop thread).
+        Failure handling mirrors _actor_push: connection loss tears down
+        the client once, failed items gather in ``retrying`` and re-queue
+        in seq order after recovery."""
         try:
-            reply = await client.call("push_actor_task_batch",
-                                      blobs=[i.blob for i in items],
-                                      timeout=None)
-            if reply.get("dead"):
-                raise RpcError(reply.get("reason", "actor dead"))
-            for item, r in zip(items, reply["replies"]):
-                self._handle_task_reply(item.spec, item.return_ids, r,
-                                        notify=False)
-            self._notify_waiters()
-        except (RpcError, OSError):
-            if st.client is client:
-                try:
-                    await client.close()
-                except Exception:
-                    pass
-                st.client = None
-                self._actor_addr_cache.pop(st.actor_id, None)
-            for item in items:
+            try:
+                if fut.cancelled():
+                    raise RpcConnectionLost("call cancelled")
+                exc = fut.exception()
+                if exc is not None:
+                    raise exc
+                reply = fut.result()
+                if reply.get("dead"):
+                    raise RpcError(reply.get("reason", "actor dead"))
+                self._handle_task_reply(item.spec, item.return_ids, reply)
+                return
+            except (RpcError, OSError):
+                # Connection lost / incarnation died. Only tear down
+                # st.client if it is still the connection we used — a
+                # sibling failure may have already installed a fresh one
+                # that must survive.
+                if st.client is client:
+                    spawn_task(client.close())
+                    st.client = None
+                    self._actor_addr_cache.pop(st.actor_id, None)
                 item.attempts += 1
                 if item.attempts > 60:
                     self._store_error_local(
                         item.return_ids,
                         ActorDiedError(st.actor_id, "worker connection lost"))
+                elif st.client is not None:
+                    # A sibling already recovered the connection: merge this
+                    # straggler straight back in order.
+                    st.retrying.append(item)
+                    self._merge_retrying(st)
                 else:
                     st.retrying.append(item)
-            if st.retrying:
-                if st.client is not None:
-                    self._merge_retrying(st)
-                elif not st.recovering:
-                    st.recovering = True
-                    spawn_task(self._actor_recover(st, st.addr))
-        except Exception as e:  # noqa: BLE001
-            for item in items:
-                self._store_error_local(item.return_ids,
-                                        TaskError(e, task_desc=item.spec.name))
+                    if not st.recovering:
+                        st.recovering = True
+                        spawn_task(self._actor_recover(st, st.addr))
+            except Exception as e:  # noqa: BLE001
+                self._store_error_local(
+                    item.return_ids, TaskError(e, task_desc=item.spec.name))
         finally:
-            st.inflight -= len(items)
+            st.inflight -= 1
             self._actor_pump(st)
 
     async def _actor_recover(self, st: _ActorState, old_addr) -> None:
@@ -2405,9 +2578,12 @@ class ClusterRuntime:
 
     # ------------------------------------------------------------------ placement groups
     def create_placement_group(self, pg_id, bundles, strategy, name=None,
-                               labels=None) -> None:
-        self.head.call("create_placement_group", pg_id=pg_id.hex(),
-                       bundles=bundles, strategy=strategy, name=name)
+                               labels=None) -> str | None:
+        res = self.head.call("create_placement_group", pg_id=pg_id.hex(),
+                             bundles=bundles, strategy=strategy, name=name)
+        # The head inlines the first placement attempt: CREATED here lets
+        # ready() skip its first state poll entirely.
+        return (res or {}).get("state")
 
     def remove_placement_group(self, pg_id) -> None:
         self.head.call("remove_placement_group", pg_id=pg_id.hex())

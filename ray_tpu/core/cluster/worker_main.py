@@ -17,11 +17,12 @@ import inspect
 import os
 import queue
 import threading
+from collections import deque
 from typing import Any
 
 import cloudpickle
 
-from ray_tpu.core.cluster.protocol import EventLoopThread
+from ray_tpu.core.cluster.protocol import EventLoopThread, pack_reply
 from ray_tpu.core.cluster.runtime import ClusterRuntime
 from ray_tpu.core.exceptions import (
     ActorDiedError,
@@ -117,10 +118,18 @@ class WorkerProcess:
         self._io = EventLoopThread.get()
         srv = self.runtime.server
         srv.register("push_task", self._push_task)
-        srv.register("push_task_batch", self._push_task_batch)
-        srv.register("push_actor_task_batch", self._push_actor_task_batch)
         srv.register("init_actor", self._init_actor)
-        srv.register("push_actor_task", self._push_actor_task)
+        # Fast-path frames, dispatched INLINE in the read loop (no task
+        # spawn, no reply future): the execution thread deserializes the
+        # spec, runs it, packs the reply itself, and posts the pre-packed
+        # bytes back with one loop wake (reference: the direct-call path in
+        # core_worker.cc answers PushTask from the executing thread).
+        # push_actor_task (streaming) MUST ride the same inline dispatch:
+        # mixing an inline route with a task-spawned one would let later
+        # calls reach the mailbox before an earlier streaming call.
+        srv.register_raw("push_task_batch", self._push_task_batch_raw)
+        srv.register_raw("push_actor_task", self._push_actor_call_raw)
+        srv.register_raw("push_actor_calls", self._push_actor_calls_raw)
         srv.register("cancel_task", self._cancel_task)
         srv.register("exit_worker", self._exit_worker)
         # Cancellation state: ids cancelled before start, and the thread
@@ -130,8 +139,24 @@ class WorkerProcess:
         # Deserialized-function cache keyed by the exact code blob — repeat
         # submissions of the same @remote function skip the unpickle
         # (reference: function_manager.py caches imported remote functions).
+        # Only specs from registry-less submitters (client-mode proxies)
+        # still embed blobs; registry specs use _registry_cache below.
         self._fn_cache: dict[bytes, Any] = {}
+        # Registry-fetched definitions, LRU-bounded by serialized size
+        # (reference: FunctionManager fetch-and-cache from the GCS table).
+        from ray_tpu.core.fn_registry import FnCache
+
+        self._registry_cache = FnCache(get_config().fn_cache_max_bytes)
         self._task_executor = _SerialExecutor()
+        # Cross-thread reply buffer: execution threads enqueue pre-packed
+        # reply frames the moment each call finishes (nothing is ever held
+        # across a later execution), and ONE loop wake drains everything
+        # enqueued since the last drain — the same coalescing the submit
+        # buffer uses on the driver side. Under load one self-pipe write
+        # covers a burst of replies; when idle, the wake is immediate.
+        self._reply_buf: deque = deque()
+        self._reply_wake = False
+        self._reply_lock = threading.Lock()
         self._actor_instance: Any = None
         self._actor_id_hex: str | None = None
         self._actor_mailbox: "queue.Queue" = queue.Queue()
@@ -166,16 +191,45 @@ class WorkerProcess:
         return await loop.run_in_executor(self._task_executor,
                                           self._execute_task, spec, emit)
 
-    async def _push_task_batch(self, conn, blobs: list):
-        """Batched push: N specs in one frame, executed in order, N results
-        in one reply — one executor hop for the whole batch instead of a
-        queue+future+thread-wake round trip per task (the per-task hop
-        dominates small-task throughput on few-core hosts)."""
-        specs = [serialization.loads_spec(b) for b in blobs]
-        loop = asyncio.get_running_loop()
-        replies = await loop.run_in_executor(self._task_executor,
-                                             self._execute_batch, specs)
-        return {"replies": replies}
+    def _push_task_batch_raw(self, conn, msg: dict):
+        """Batched push, raw-dispatched: N specs in one frame, executed in
+        order, N results in one reply. Spec deserialization AND reply
+        packing happen on the execution thread; the io loop's only work per
+        batch is one enqueue and one write (the per-task dispatch
+        task/future/executor hop dominated small-task throughput on
+        few-core hosts)."""
+        self._task_executor.submit(
+            self._run_task_batch, msg["a"]["blobs"], msg.get("i"), conn,
+            asyncio.get_running_loop())
+
+    def _post_reply(self, loop, conn, frame: bytes) -> None:
+        """Ship one pre-packed reply from an execution thread: enqueued
+        immediately (never held behind a later execution), with coalesced
+        loop wakes — one self-pipe write covers every reply buffered until
+        the drain runs."""
+        with self._reply_lock:
+            self._reply_buf.append((conn, frame))
+            wake = not self._reply_wake
+            self._reply_wake = True
+        if wake:
+            loop.call_soon_threadsafe(self._drain_replies)
+
+    def _drain_replies(self) -> None:
+        with self._reply_lock:
+            items = list(self._reply_buf)
+            self._reply_buf.clear()
+            self._reply_wake = False
+        for conn, frame in items:
+            conn.post(frame)
+
+    def _run_task_batch(self, blobs: list, rid, conn, loop) -> None:
+        try:
+            specs = [serialization.loads_spec(b) for b in blobs]
+            replies = self._execute_batch(specs)
+            data = pack_reply(rid, {"replies": replies})
+        except BaseException as e:  # noqa: BLE001 - client must not hang
+            data = pack_reply(rid, err=f"{type(e).__name__}: {e}")
+        self._post_reply(loop, conn, data)
 
     def _execute_batch(self, specs) -> list:
         return _run_batch_contained(
@@ -264,12 +318,7 @@ class WorkerProcess:
                 from ray_tpu.runtime_env import get_manager
 
                 get_manager().ensure(spec.runtime_env, self.runtime)
-            fn = self._fn_cache.get(spec.fn_blob)
-            if fn is None:
-                fn = serialization.loads_function(spec.fn_blob)
-                if len(self._fn_cache) > 256:
-                    self._fn_cache.clear()
-                self._fn_cache[spec.fn_blob] = fn
+            fn = self._load_definition(spec.fn_id, spec.fn_blob)
             args, kwargs = serialization.deserialize(spec.args_blob)
             args = self._resolve(args)
             kwargs = self._resolve(kwargs)
@@ -305,6 +354,29 @@ class WorkerProcess:
         if stream_emit is not None:
             return self._run_stream(spec, result, stream_emit)
         return {"results": self._package_results(spec, return_ids, result)}
+
+    def _load_definition(self, fn_id: str, fn_blob: bytes):
+        """Resolve a task's callable: registry cache hit, registry fetch on
+        miss (exactly once per definition per worker), or the embedded-blob
+        legacy path for registry-less submitters."""
+        if fn_id:
+            from ray_tpu.core.cluster.runtime import observe_ctrl_fn
+
+            fn = self._registry_cache.get(fn_id)
+            if fn is not None:
+                observe_ctrl_fn("hit", 0)
+                return fn
+            blob = fn_blob or self.runtime.fetch_function(fn_id)
+            fn = serialization.loads_function(blob)
+            self._registry_cache.put(fn_id, fn, len(blob))
+            return fn
+        fn = self._fn_cache.get(fn_blob)
+        if fn is None:
+            fn = serialization.loads_function(fn_blob)
+            if len(self._fn_cache) > 256:
+                self._fn_cache.clear()
+            self._fn_cache[fn_blob] = fn
+        return fn
 
     def _resolve(self, obj):
         if isinstance(obj, ObjectRef):
@@ -359,7 +431,8 @@ class WorkerProcess:
                 from ray_tpu.runtime_env import get_manager
 
                 get_manager().ensure(spec.runtime_env, self.runtime)
-            cls = serialization.loads_function(spec.cls_blob)
+            cls = self._load_definition(getattr(spec, "cls_id", ""),
+                                        spec.cls_blob)
             args, kwargs = serialization.deserialize(spec.args_blob)
             self._actor_instance = cls(*self._resolve(args), **self._resolve(kwargs))
             self._actor_id_hex = actor_id
@@ -384,42 +457,63 @@ class WorkerProcess:
             item = self._actor_mailbox.get()
             if item is None:
                 return
-            if item[0] == "__batch__":
-                # Sync-actor batch: run all calls in order on this thread,
-                # one reply wakeup for the whole batch (per-call
-                # call_soon_threadsafe is a self-pipe syscall each).
-                _, specs, reply_fut, loop, conn = item
-                replies = _run_batch_contained(
-                    specs, lambda s: self._exec_actor_reply(s, loop, conn))
-                loop.call_soon_threadsafe(reply_fut.set_result,
-                                          {"replies": replies})
+            if item[0] == "__call__":
+                # Fast-path call (raw-dispatched push_actor_call(s) frame):
+                # decode the spec HERE (off the io loop), execute in
+                # mailbox order, serialize the reply on this thread, and
+                # post pre-packed bytes — the loop's only per-call work is
+                # one write, and each reply ships the moment its call
+                # finishes (a later slow method never holds an earlier
+                # result hostage; the coalescing writer still merges
+                # replies landing in the same loop tick into one syscall).
+                # Concurrent execution modes (async methods, concurrency
+                # pools, injected fns) run on their own threads and post
+                # their replies the same way when THEY finish, so replies
+                # correlate out-of-order by request id.
+                _, spec_blob, rid, conn, loop = item
+                try:
+                    spec: TaskSpec = serialization.loads_spec(spec_blob)
+                except BaseException as e:  # noqa: BLE001
+                    loop.call_soon_threadsafe(conn.post, pack_reply(
+                        rid, err=f"{type(e).__name__}: {e}"))
+                    continue
+                if not self._dispatch_concurrent(spec, rid, conn, loop):
+                    self._run_actor_call(spec, rid, conn, loop)
                 continue
-            spec, reply_fut, loop, conn = item
-            method = getattr(type(self._actor_instance), spec.method_name, None)
-            is_async = inspect.iscoroutinefunction(method)
-            # args= binds eagerly — a lambda would capture the loop variables
-            # by reference and race with the next mailbox item.
-            if spec.method_name == "__rtpu_call_fn__":
-                # Injected functions may be long-running compiled-graph loops;
-                # a dedicated thread keeps both the consumer and the
-                # concurrency pool free.
-                threading.Thread(target=self._run_actor_method,
-                                 args=(spec, reply_fut, loop, conn),
-                                 daemon=True).start()
-            elif is_async or self._actor_pool is not None:
-                if self._actor_pool is not None:
-                    self._actor_pool.submit(
-                        self._run_actor_method, spec, reply_fut, loop, conn)
-                else:
-                    threading.Thread(target=self._run_actor_method,
-                                     args=(spec, reply_fut, loop, conn),
-                                     daemon=True).start()
-            else:
-                self._run_actor_method(spec, reply_fut, loop, conn)
 
-    def _run_actor_method(self, spec: TaskSpec, reply_fut, loop, conn=None):
+    def _dispatch_concurrent(self, spec: TaskSpec, rid, conn, loop) -> bool:
+        """Route a fast-path call that must NOT run on the ordered consumer
+        thread (async methods, concurrency pools, injected long-running
+        fns) to its executor. Returns False for plain sync methods — the
+        consumer runs those inline, preserving mailbox order."""
+        if spec.method_name == "__rtpu_call_fn__":
+            threading.Thread(target=self._run_actor_call,
+                             args=(spec, rid, conn, loop),
+                             daemon=True).start()
+            return True
+        method = getattr(type(self._actor_instance), spec.method_name, None)
+        if inspect.iscoroutinefunction(method) or self._actor_pool is not None:
+            if self._actor_pool is not None:
+                self._actor_pool.submit(self._run_actor_call,
+                                        spec, rid, conn, loop)
+            else:
+                threading.Thread(target=self._run_actor_call,
+                                 args=(spec, rid, conn, loop),
+                                 daemon=True).start()
+            return True
+        return False
+
+    def _run_actor_call(self, spec: TaskSpec, rid, conn, loop) -> None:
+        """Execute one fast-path call and post its reply: serialization on
+        the execution thread, coalesced loop wakes (_post_reply), and the
+        coalescing writer merges frames shipped in one tick into one
+        syscall."""
         reply = self._exec_actor_reply(spec, loop, conn)
-        loop.call_soon_threadsafe(reply_fut.set_result, reply)
+        try:
+            data = pack_reply(rid, reply)
+        except BaseException as e:  # noqa: BLE001 - unpackable reply value
+            data = pack_reply(rid, err=f"{type(e).__name__}: {e}")
+        self._post_reply(loop, conn, data)
 
     def _exec_actor_reply(self, spec: TaskSpec, loop, conn=None) -> dict:
         from ray_tpu.core.events import task_execution
@@ -465,38 +559,38 @@ class WorkerProcess:
                                  for _ in return_ids]}
         return reply
 
-    async def _push_actor_task(self, conn, spec_blob: bytes):
+    def _push_actor_call_raw(self, conn, msg: dict):
+        """Direct actor call (raw-dispatched): the read loop's entire work
+        is one mailbox enqueue. Replies correlate by request id, so calls
+        finishing out of order (async actors, pools) answer out of order —
+        a sync 1:1 call is one RPC round trip with no reply future, no
+        dispatch task, and no loop hop between execution and reply
+        serialization. Streaming calls (legacy push_actor_task frames)
+        take the same route: _exec_actor_reply drives the generator and
+        the stream-end reply posts like any other."""
+        rid = msg.get("i")
         if self._actor_instance is None:
-            return {"dead": True, "reason": "no actor hosted in this worker"}
-        spec: TaskSpec = serialization.loads_spec(spec_blob)
-        loop = asyncio.get_running_loop()
-        fut = loop.create_future()
-        self._actor_mailbox.put((spec, fut, loop, conn))
-        return await fut
+            conn.post(pack_reply(rid, {
+                "dead": True, "reason": "no actor hosted in this worker"}))
+            return
+        self._actor_mailbox.put((
+            "__call__", msg["a"]["spec_blob"], rid, conn,
+            asyncio.get_running_loop()))
 
-    async def _push_actor_task_batch(self, conn, blobs: list):
-        """Batched actor calls: one frame in, one reply out (order
-        preserved). Sync actors run the whole batch on the mailbox consumer
-        thread; async/pooled actors keep their concurrent execution paths,
-        with the replies gathered before answering."""
+    def _push_actor_calls_raw(self, conn, msg: dict):
+        """Multi-call frame: N individually-correlated calls ride one frame
+        (one decode, N mailbox items); replies flow back per call, batched
+        per consumer sweep (see _actor_consumer's reply flushing)."""
+        calls = msg.get("c") or []
         if self._actor_instance is None:
-            return {"dead": True, "reason": "no actor hosted in this worker"}
-        specs = [serialization.loads_spec(b) for b in blobs]
+            conn.post([pack_reply(rid, {
+                "dead": True, "reason": "no actor hosted in this worker"})
+                for rid, _ in calls])
+            return
         loop = asyncio.get_running_loop()
-        simple = (self._actor_pool is None and self._actor_loop is None
-                  and all(s.num_returns != "streaming" and
-                          s.method_name != "__rtpu_call_fn__"
-                          for s in specs))
-        if simple:
-            fut = loop.create_future()
-            self._actor_mailbox.put(("__batch__", specs, fut, loop, conn))
-            return await fut
-        futs = []
-        for s in specs:
-            f = loop.create_future()
-            self._actor_mailbox.put((s, f, loop, conn))
-            futs.append(f)
-        return {"replies": await asyncio.gather(*futs)}
+        put = self._actor_mailbox.put
+        for rid, blob in calls:
+            put(("__call__", blob, rid, conn, loop))
 
     async def _exit_worker(self, conn):
         self._exit_event.set()

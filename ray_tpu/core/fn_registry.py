@@ -1,0 +1,93 @@
+"""Content-addressed function/actor-class registry.
+
+Capability parity with the reference's FunctionManager + GCS function table
+(reference: python/ray/_private/function_manager.py — `export()` publishes a
+pickled function under its content hash to the GCS KV once per definition;
+workers `fetch_and_execute` on first sight and cache the import): a task spec
+names its function by ``fn_id = sha256(fn_blob)`` instead of embedding the
+cloudpickled definition, so repeat submissions ship an O(spec-header) frame
+and every worker unpickles a given definition exactly once.
+
+Three pieces live here:
+- ``fn_id()``: the content address (submitters cache it next to the blob).
+- ``FnCache``: the worker-side deserialized-definition cache, LRU-bounded by
+  ``fn_cache_max_bytes`` (reference: function_manager's per-job function
+  tables are dropped with the job; here a byte budget bounds a long-lived
+  pooled worker serving many jobs).
+- ``FN_NS``: the head KV namespace definitions are exported into (the head
+  persists it like any KV namespace, so definitions survive head restarts).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any
+
+# Head-KV namespace for exported definitions (reference: RemoteFunction
+# exports land under a RemoteFunction:<job>:<hash> key in the GCS KV).
+FN_NS = "__fn__"
+
+
+def fn_id(fn_blob: bytes) -> str:
+    """Content address of a serialized definition."""
+    return hashlib.sha256(fn_blob).hexdigest()
+
+
+class FnCache:
+    """LRU cache of deserialized definitions, bounded by a byte budget.
+
+    Thread-safe: worker execution threads hit it concurrently. The byte
+    accounting charges each entry its serialized size (the deserialized
+    callable's footprint is unknowable; the blob size is the stable proxy).
+    """
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._entries: "OrderedDict[str, tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: str) -> Any | None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry[0]
+
+    def put(self, key: str, value: Any, nbytes: int) -> None:
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            # Evict LRU-first, but never the entry just inserted (a single
+            # definition larger than the whole budget must still be usable
+            # for the task that fetched it).
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, (_, n) = self._entries.popitem(last=False)
+                self._bytes -= n
+                self.evictions += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}

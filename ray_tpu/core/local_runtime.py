@@ -34,7 +34,7 @@ from ray_tpu.core.exceptions import (
     TaskCancelledError,
     TaskError,
 )
-from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_ref import ObjectRef, refcounting_suppressed
 from ray_tpu.core.store import LocalObjectStore, ReferenceCounter
 from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
 from ray_tpu.utils import serialization
@@ -153,6 +153,11 @@ class LocalRuntime:
         self._pg_reserved: dict = {}
         self._cancelled: set[ObjectID] = set()
         self._kv: dict[str, dict[str, bytes]] = {}
+        # Content-addressed definition registry (cluster parity: the head
+        # KV function table). blob by id, plus a deserialized cache so a
+        # definition is unpickled once per process, not once per task.
+        self._fn_defs: dict[str, bytes] = {}
+        self._fns: dict[str, Any] = {}
         self._lock = threading.RLock()
         self._shutdown = False
 
@@ -176,9 +181,10 @@ class LocalRuntime:
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.worker_id)
         self.store.put(oid, serialization.serialize(value), self.worker_id)
-        self.refs.add_owned(oid, self.worker_id)
+        lr = 0 if refcounting_suppressed() else 1
+        self.refs.add_owned(oid, self.worker_id, local_refs=lr)
         self._register_nested(oid, value)
-        return ObjectRef(oid, self.worker_id)
+        return (ObjectRef.counted if lr else ObjectRef)(oid, self.worker_id)
 
     @contextlib.contextmanager
     def _yield_task_resources(self):
@@ -268,8 +274,12 @@ class LocalRuntime:
     # ------------------------------------------------------------------ tasks
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
         return_ids = spec.return_ids()
+        # Fused ownership + returned-ref count (see ObjectRef.counted);
+        # suppressed inside refcount_disabled() (proxy layers).
+        lr = 0 if refcounting_suppressed() else 1
         for oid in return_ids:
-            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
+            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id,
+                                local_refs=lr)
         self.refs.on_task_submitted(spec.arg_ref_ids)
         global_event_buffer().record(
             spec.task_id.hex(), spec.name, "SUBMITTED",
@@ -290,7 +300,8 @@ class LocalRuntime:
                 daemon=True, name=f"task-ovf-{spec.name[:20]}").start()
         else:
             self._task_pool.submit(self._run_pooled, spec, return_ids)
-        return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+        make = ObjectRef.counted if lr else ObjectRef
+        return [make(oid, self.worker_id) for oid in return_ids]
 
     def _run_pooled(self, spec: TaskSpec, return_ids: list[ObjectID]) -> None:
         try:
@@ -317,7 +328,7 @@ class LocalRuntime:
                         from ray_tpu.runtime_env import get_manager
 
                         get_manager().ensure(spec.runtime_env, self)
-                    fn = serialization.loads_function(spec.fn_blob)
+                    fn = self._load_definition(spec.fn_id, spec.fn_blob)
                     args, kwargs = self._resolve_args(spec)
                     if not self.resources.acquire(spec.resources, timeout=None):
                         raise RuntimeError("resource acquisition failed")
@@ -350,6 +361,30 @@ class LocalRuntime:
         finally:
             # Exactly once per task, regardless of retries.
             self.refs.on_task_finished(spec.arg_ref_ids)
+
+    def export_function(self, fn_id: str, fn_blob: bytes) -> None:
+        """Registry export (idempotent): submitters publish a definition
+        once; specs then carry only the content id."""
+        if fn_id not in self._fn_defs:
+            self._fn_defs[fn_id] = fn_blob
+
+    def _load_definition(self, fn_id: str, fn_blob: bytes):
+        if not fn_id:
+            return serialization.loads_function(fn_blob)
+        fn = self._fns.get(fn_id)
+        if fn is None:
+            # Thin-client proxies export through the KV namespace (their
+            # runtime interface has no direct registry): honor both tables.
+            from ray_tpu.core.fn_registry import FN_NS
+
+            blob = fn_blob or self._fn_defs.get(fn_id) or \
+                self._kv.get(FN_NS, {}).get(fn_id)
+            if blob is None:
+                raise KeyError(
+                    f"function definition {fn_id} not in the registry")
+            fn = serialization.loads_function(blob)
+            self._fns[fn_id] = fn
+        return fn
 
     def _resolve_args(self, spec: TaskSpec) -> tuple[tuple, dict]:
         args, kwargs = serialization.deserialize(spec.args_blob)
@@ -481,7 +516,8 @@ class LocalRuntime:
             from ray_tpu.runtime_env import get_manager
 
             get_manager().ensure(state.spec.runtime_env, self)
-        cls = serialization.loads_function(state.spec.cls_blob)
+        cls = self._load_definition(getattr(state.spec, "cls_id", ""),
+                                    state.spec.cls_blob)
         args, kwargs = serialization.deserialize(state.spec.args_blob)
         args = self._replace_refs(args)
         kwargs = self._replace_refs(kwargs)
@@ -547,8 +583,11 @@ class LocalRuntime:
 
     def submit_actor_task(self, spec: TaskSpec) -> list[ObjectRef]:
         return_ids = spec.return_ids()
+        lr = 0 if refcounting_suppressed() else 1
+        make = ObjectRef.counted if lr else ObjectRef
         for oid in return_ids:
-            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id)
+            self.refs.add_owned(oid, self.worker_id, lineage_task=spec.task_id,
+                                local_refs=lr)
         global_event_buffer().record(
             spec.task_id.hex(), spec.name, "SUBMITTED",
             worker_id=self.worker_id.hex(),
@@ -560,9 +599,9 @@ class LocalRuntime:
             reason = state.death_reason if state else "unknown actor"
             err = ActorDiedError(spec.actor_id.hex() if spec.actor_id else "", reason)
             self._store_error(return_ids, err)
-            return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+            return [make(oid, self.worker_id) for oid in return_ids]
         state.mailbox.put(spec)
-        return [ObjectRef(oid, self.worker_id) for oid in return_ids]
+        return [make(oid, self.worker_id) for oid in return_ids]
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         with self._lock:

@@ -22,6 +22,28 @@ import threading
 
 _refcount_off = threading.local()
 
+# Lazily-bound process worker (import machinery is measurable at
+# refs-per-task rates; worker.py imports this module, so bind on first use).
+_worker_singleton = None
+
+
+def _current_runtime():
+    global _worker_singleton
+    if _worker_singleton is None:
+        from ray_tpu.core.worker import global_worker
+
+        _worker_singleton = global_worker
+    return _worker_singleton.runtime
+
+
+def refcounting_suppressed() -> bool:
+    """Whether this thread is inside refcount_disabled() — fused-count
+    submit paths must then neither pre-take local refs nor hand out
+    counted ObjectRefs (their __del__ would decrement a DIFFERENT
+    runtime's counter when a proxy hosts one runtime on behalf of
+    another)."""
+    return getattr(_refcount_off, "on", False)
+
 
 @contextlib.contextmanager
 def refcount_disabled():
@@ -50,22 +72,31 @@ class ObjectRef:
         # release in __del__ (reference: _raylet ObjectRef dealloc decrements
         # the local count in the reference counter).
         try:
-            from ray_tpu.core.worker import global_worker
-
-            rt = global_worker.runtime
+            rt = _current_runtime()
             if rt is not None:
                 rt.refs.add_local_ref(object_id)
                 self._counted = True
         except Exception:
             pass
 
+    @classmethod
+    def counted(cls, object_id: ObjectID,
+                owner_id: WorkerID | None) -> "ObjectRef":
+        """Construct a ref whose local count was ALREADY taken (fused into
+        the owner registration — one refcounter lock round trip per return
+        instead of two on the submit hot path). __del__ still releases."""
+        ref = cls.__new__(cls)
+        ref.id = object_id
+        ref.owner_id = owner_id
+        ref._worker = None
+        ref._counted = True
+        return ref
+
     def __del__(self):
         if not self._counted:
             return
         try:
-            from ray_tpu.core.worker import global_worker
-
-            rt = global_worker.runtime
+            rt = _current_runtime()
             if rt is not None:
                 rt.refs.remove_local_ref(self.id)
         except Exception:

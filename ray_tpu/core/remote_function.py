@@ -96,6 +96,7 @@ class RemoteFunction:
         self._fn = fn
         self._options = {**_DEFAULT_TASK_OPTIONS, **options}
         self._fn_blob: bytes | None = None
+        self._fn_id: str | None = None  # content address of _fn_blob
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -105,15 +106,38 @@ class RemoteFunction:
         )
 
     def options(self, **overrides) -> "RemoteFunction":
+        # Share the serialized definition AND its registry id: an options()
+        # copy that only changes resources must not re-pickle or re-export
+        # the identical fn_blob (same content hash → same registry entry).
         new = RemoteFunction(self._fn, {**self._options, **overrides})
         new._fn_blob = self._fn_blob
+        new._fn_id = self._fn_id
         return new
+
+    def _definition(self) -> tuple[bytes, str]:
+        """(fn_blob, fn_id), serialized and hashed once per handle chain."""
+        if self._fn_blob is None:
+            self._fn_blob = serialization.dumps_function(self._fn)
+        if self._fn_id is None:
+            from ray_tpu.core.fn_registry import fn_id
+
+            self._fn_id = fn_id(self._fn_blob)
+        return self._fn_blob, self._fn_id
 
     def remote(self, *args, **kwargs):
         worker = global_worker
         worker.check_connected()
-        if self._fn_blob is None:
-            self._fn_blob = serialization.dumps_function(self._fn)
+        fn_blob, fn_id = self._definition()
+        # Registry fast path: runtimes exposing export_function receive the
+        # definition once (idempotent, cached per runtime) and the spec
+        # carries only the content id; runtimes without a registry embed
+        # the blob as before.
+        export = getattr(worker.runtime, "export_function", None)
+        if export is not None:
+            export(fn_id, fn_blob)
+            fn_blob = b""
+        else:
+            fn_id = ""
         opts = self._options
         args_blob, arg_refs = serialization.serialize_args((args, kwargs))
         resources, strategy = resolve_strategy(
@@ -122,7 +146,8 @@ class RemoteFunction:
         spec = TaskSpec(
             task_id=TaskID.of(worker.job_id),
             job_id=worker.job_id,
-            fn_blob=self._fn_blob,
+            fn_blob=fn_blob,
+            fn_id=fn_id,
             args_blob=args_blob,
             arg_ref_ids=[r.id for r in arg_refs],
             arg_owner_ids=[r.owner_id for r in arg_refs],

@@ -97,11 +97,14 @@ class LocalObjectStore:
             entry = self._objects.get(object_id)
             return entry.size if entry is not None else None
 
-    def delete(self, object_id: ObjectID) -> None:
+    def delete(self, object_id: ObjectID) -> bool:
+        """Remove the entry; returns whether it was present (callers skip
+        shm-arena cleanup for objects this process store held — the two
+        stores are exclusive destinations)."""
         with self._lock:
             entry = self._objects.pop(object_id, None)
             if entry is None:
-                return
+                return False
             if entry.data is not None:
                 self._used -= entry.size
             if entry.spilled_path:
@@ -109,6 +112,7 @@ class LocalObjectStore:
                     os.unlink(entry.spilled_path)
                 except OSError:
                     pass
+            return True
 
     def used_bytes(self) -> int:
         with self._lock:
@@ -181,13 +185,19 @@ class ReferenceCounter:
         self._lock = threading.RLock()
         self._on_release = on_release
 
-    def add_owned(self, object_id: ObjectID, owner_id: WorkerID, lineage_task: TaskID | None = None):
-        """Register ownership + lineage. Does NOT take a local ref — live
-        ObjectRef instances each hold one (taken in ObjectRef.__init__)."""
+    def add_owned(self, object_id: ObjectID, owner_id: WorkerID,
+                  lineage_task: TaskID | None = None, local_refs: int = 0):
+        """Register ownership + lineage. ``local_refs`` pre-takes that many
+        local refs in the SAME lock round trip (the submit hot path fuses
+        the owner registration with the returned ObjectRef's count and
+        constructs the ref via ObjectRef.counted); with the default 0, live
+        ObjectRef instances each take their own (ObjectRef.__init__)."""
         with self._lock:
             rec = self._records.setdefault(object_id, _RefRecord())
             rec.owner_id = owner_id
             rec.lineage_task = lineage_task
+            if local_refs:
+                rec.local_refs += local_refs
 
     def add_borrowed(self, object_id: ObjectID, owner_id: WorkerID | None, borrower: WorkerID):
         with self._lock:

@@ -33,6 +33,11 @@ class TaskSpec:
     job_id: JobID
     fn_blob: bytes  # cloudpickled callable (or method name for actor tasks)
     args_blob: bytes  # serialized (args, kwargs) with refs replaced by markers
+    # Content address of the function definition in the head's registry
+    # (reference: FunctionDescriptor + GCS function table). When set,
+    # fn_blob is empty and executors fetch-and-cache the definition by id —
+    # repeat submissions ship O(spec-header) bytes, not the pickled code.
+    fn_id: str = ""
     arg_ref_ids: list[ObjectID] = field(default_factory=list)
     arg_owner_ids: list[WorkerID | None] = field(default_factory=list)
     num_returns: int | str = 1  # int, or "streaming" (generator task)
@@ -87,6 +92,9 @@ class ActorCreationSpec:
     job_id: JobID
     cls_blob: bytes  # cloudpickled class
     args_blob: bytes
+    # Registry content address of the class definition (see TaskSpec.fn_id):
+    # N actors of one class ship the pickled class once, not once per actor.
+    cls_id: str = ""
     arg_ref_ids: list[ObjectID] = field(default_factory=list)
     resources: dict[str, float] = field(default_factory=dict)
     max_restarts: int = 0

@@ -27,6 +27,7 @@ class ClientRuntime:
         # drops, tell the server to unpin it (reference: client refs release
         # server-side state on del).
         self.refs = ReferenceCounter(on_release=self._release_remote)
+        self._exported_fns: set[str] = set()  # registry idempotence cache
 
     def _owner(self, owner_hex: str) -> WorkerID:
         w = WorkerID.from_hex(owner_hex)
@@ -89,6 +90,16 @@ class ClientRuntime:
                 [by_hex[h] for h in res["pending"]])
 
     # ---- tasks ----
+    def export_function(self, fn_id: str, fn_blob: bytes) -> None:
+        """Registry export through the proxy's KV: the definition crosses
+        the client connection once; every subsequent spec names it by id."""
+        if fn_id in self._exported_fns:
+            return
+        from ray_tpu.core.fn_registry import FN_NS
+
+        self._rpc.call("c_kv", op="put", ns=FN_NS, key=fn_id, value=fn_blob)
+        self._exported_fns.add(fn_id)
+
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
         res = self._rpc.call("c_submit_task",
                              spec_blob=serialization.dumps_spec(spec))

@@ -32,8 +32,15 @@ class PlacementGroup:
     id: PlacementGroupID
     bundles: list[dict[str, float]]
     strategy: str = "PACK"
+    # Creation-reply hint: the head inlines the first placement attempt, so
+    # a PG born CREATED lets the first ready() answer without a state RPC
+    # (consumed once — later calls re-poll, observing removals).
+    created_hint: bool = False
 
     def ready(self, timeout: float | None = 60.0) -> bool:
+        if self.created_hint:
+            self.created_hint = False
+            return True
         deadline = None if timeout is None else time.monotonic() + timeout
         sleep = 0.001  # adaptive: sub-ms-fresh PGs resolve on early polls
         while True:
@@ -63,9 +70,10 @@ def placement_group(bundles: list[dict[str, float]], strategy: str = "PACK",
         raise ValueError("bundles must be a non-empty list of non-empty dicts")
     global_worker.check_connected()
     pg_id = PlacementGroupID.from_random()
-    global_worker.runtime.create_placement_group(
+    state = global_worker.runtime.create_placement_group(
         pg_id, [dict(b) for b in bundles], strategy, name, labels)
-    return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
+    return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy,
+                          created_hint=state == "CREATED")
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:

@@ -45,6 +45,14 @@ class Config:
     worker_startup_concurrency: int = 8
     lease_keepalive_s: float = 2.0  # idle driver-cached leases returned after this
     lease_spill_check_s: float = 0.3  # queued lease looks for a freer node after this
+    # Max worker leases granted by ONE lease_workers RPC (the submitter
+    # sizes requests by queue depth; the daemon grants up to this many idle
+    # workers per round trip instead of one per RPC).
+    lease_batch_max: int = 16
+    # Idle workers the daemon keeps prestarted AHEAD of demand once leases
+    # are being requested (0 disables): fan-out bursts land on a warm pool
+    # instead of serializing on fork+register (~1 s of CPU per worker).
+    idle_worker_pool: int = 1
 
     # --- object store (reference: plasma + spilling thresholds, ray_config_def.h:680-697) ---
     object_store_memory_bytes: int = 2 * 1024**3
@@ -88,6 +96,9 @@ class Config:
     max_lineage_bytes: int = 64 * 1024**2
     max_direct_call_object_size: int = 100 * 1024
     task_events_buffer_size: int = 10000
+    # Worker-side cache of deserialized function/class definitions fetched
+    # from the head registry (LRU by serialized size; see core/fn_registry).
+    fn_cache_max_bytes: int = 64 * 1024**2
 
     # --- memory monitor (reference: _private/memory_monitor.py:97 +
     # raylet/worker_killing_policy_group_by_owner.cc) ---
@@ -97,6 +108,12 @@ class Config:
     # the sum of worker RSS exceeds threshold*budget (node-level pressure
     # against the detected cgroup/MemTotal limit always applies).
     memory_limit_bytes: int = 0
+
+    # Head WAL group commit: mutation records buffered this long before one
+    # coalesced write+flush. 0 = same-event-loop-tick coalescing (burst
+    # mutations share one write, nothing outlives the tick that logged it);
+    # > 0 trades a bounded durability window for fewer writes under churn.
+    wal_group_commit_ms: float = 0.0
 
     # --- observability ---
     # Flight recorder: JSON debug bundles dumped on task failure / worker

@@ -1,0 +1,344 @@
+"""Control-plane fast path: function registry, batched lease grants,
+out-of-order actor replies, batched placement-group placement.
+
+Coverage modeled on the reference's function-manager and lease-path tests
+(reference: python/ray/tests/test_advanced.py task-spec wire behavior;
+worker_pool_test.cc lease grant accounting; gcs_placement_group tests for
+batch prepare/commit).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import remote
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.fn_registry import FN_NS, FnCache, fn_id
+from ray_tpu.core.worker import global_worker
+from ray_tpu.utils.ids import JobID
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    os.environ["RTPU_WORKER_IDLE_TTL_S"] = "120"
+    os.environ["RTPU_HEALTH_CHECK_PERIOD_S"] = "0.2"
+    from ray_tpu.utils import config as config_mod
+
+    config_mod.set_config(config_mod.Config.load())
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    rt = c.connect()
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    yield c
+    rt.shutdown()
+    c.shutdown()
+    global_worker.runtime = None
+    config_mod.set_config(config_mod.Config.load())
+
+
+# ---------------------------------------------------------------- registry
+def test_fn_cache_hit_miss_eviction():
+    cache = FnCache(max_bytes=100)
+    assert cache.get("a") is None  # miss
+    cache.put("a", "fa", 40)
+    cache.put("b", "fb", 40)
+    assert cache.get("a") == "fa"  # hit refreshes LRU position
+    cache.put("c", "fc", 40)  # over budget: evicts LRU ("b", not "a")
+    assert cache.get("b") is None
+    assert cache.get("a") == "fa"
+    assert cache.get("c") == "fc"
+    assert cache.evictions == 1
+    # A single definition larger than the whole budget is still usable.
+    cache.put("huge", "fh", 10_000)
+    assert cache.get("huge") == "fh"
+    assert len(cache) == 1
+
+
+def test_fn_id_is_content_addressed():
+    assert fn_id(b"same") == fn_id(b"same")
+    assert fn_id(b"same") != fn_id(b"different")
+
+
+def test_definition_exported_once_across_submits_and_options(cluster):
+    """N submissions of one @remote function — including .options() copies
+    that only change resources — export the definition to the head exactly
+    once (the per-task spec carries only the content id)."""
+    head = cluster.head
+    puts_before = head.fn_stats["puts"]
+
+    @remote
+    def reg_probe(x):
+        return x * 7
+
+    refs = [reg_probe.remote(i) for i in range(10)]
+    # .options() copies share the cached blob AND its registry id: no
+    # re-export of an identical definition under a new id.
+    refs += [reg_probe.options(num_cpus=2).remote(i) for i in range(5)]
+    assert ray_tpu.get(refs, timeout=120) == \
+        [i * 7 for i in range(10)] + [i * 7 for i in range(5)]
+    assert head.fn_stats["puts"] == puts_before + 1
+    # The definition landed in the persistent KV namespace.
+    blob_id = reg_probe._fn_id
+    assert head.kv[FN_NS][blob_id] == reg_probe._fn_blob
+
+
+def test_worker_fetches_definition_once_per_worker(cluster):
+    """Across N tasks of one function, each executing worker fetches the
+    definition at most once (cache hits afterwards) — per-task wire bytes
+    stay O(spec header)."""
+    head = cluster.head
+    gets_before = head.fn_stats["gets"]
+
+    @remote
+    def fetch_probe(_i):
+        return os.getpid()
+
+    pids = set(ray_tpu.get([fetch_probe.remote(i) for i in range(30)],
+                           timeout=120))
+    fetches = head.fn_stats["gets"] - gets_before
+    assert fetches <= len(pids), (fetches, pids)
+    assert fetches < 30  # definitively NOT once per task
+    # Per-task wire bytes are O(spec header): a repeat-submitted spec no
+    # longer embeds the definition, so it serializes far smaller than the
+    # pickled function it names.
+    from ray_tpu.core.task_spec import TaskSpec
+    from ray_tpu.utils import serialization
+    from ray_tpu.utils.ids import TaskID
+
+    spec = TaskSpec(
+        task_id=TaskID.of(global_worker.job_id),
+        job_id=global_worker.job_id, fn_blob=b"",
+        fn_id=fetch_probe._fn_id,
+        args_blob=serialization.serialize(((1,), {})))
+    assert len(serialization.dumps_spec(spec)) < len(fetch_probe._fn_blob)
+
+
+def test_local_mode_registry_roundtrip():
+    """LocalRuntime honors the same export/lookup contract (and unpickles
+    a definition once per process, not once per task). Uses a private
+    LocalRuntime so the module's cluster fixture stays untouched."""
+    from ray_tpu.core.local_runtime import LocalRuntime
+
+    rt = LocalRuntime(num_cpus=4)
+    old = (global_worker.runtime, global_worker.worker_id, global_worker.mode)
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.mode = "local"
+    try:
+        @remote
+        def local_probe(x):
+            return x + 100
+
+        assert ray_tpu.get([local_probe.remote(i) for i in range(5)],
+                           timeout=60) == [100 + i for i in range(5)]
+        assert local_probe._fn_id in rt._fn_defs
+        assert local_probe._fn_id in rt._fns
+    finally:
+        rt.shutdown()
+        (global_worker.runtime, global_worker.worker_id,
+         global_worker.mode) = old
+
+
+# ---------------------------------------------------------------- leases
+def test_batched_lease_grant_accounting(cluster):
+    """One lease_workers RPC grants K leases; returning them restores the
+    daemon's availability."""
+    from ray_tpu.core.cluster.protocol import RpcClient
+
+    daemon = cluster.nodes[0]
+    # Warm the pool so grants come from idle workers, not forks, and wait
+    # out any leases earlier tests' driver still caches (keepalive ~2 s) so
+    # the full CPU capacity is grantable.
+    cli = RpcClient(daemon.rpc.host, daemon.rpc.port)
+    cli.call("prestart_workers", n=3, timeout=30)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        idle = [w for w in daemon.workers.values()
+                if w.lease_id is None and w.actor_id is None
+                and w.addr is not None]
+        if len(idle) >= 3 and daemon.available.get("CPU", 0.0) >= 3:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.skip("worker pool did not warm in time")
+    avail_before = dict(daemon.available)
+    res = cli.call("lease_workers", resources={"CPU": 1.0}, count=3,
+                   env_hash="", owner="test", timeout=30)
+    grants = res.get("grants") or []
+    try:
+        assert len(grants) == 3, res
+        assert len({g["lease_id"] for g in grants}) == 3
+        assert daemon.available["CPU"] == avail_before["CPU"] - 3
+    finally:
+        for g in grants:
+            cli.call("return_lease", lease_id=g["lease_id"], timeout=10)
+    assert daemon.available["CPU"] == avail_before["CPU"]
+    cli.close()
+
+
+def test_lease_batch_partial_grant(cluster):
+    """A batch bigger than the idle pool returns the grants in hand rather
+    than blocking for forks (the submitter re-requests the remainder)."""
+    from ray_tpu.core.cluster.protocol import RpcClient
+
+    daemon = cluster.nodes[0]
+    cli = RpcClient(daemon.rpc.host, daemon.rpc.port)
+    res = cli.call("lease_workers", resources={"CPU": 0.25}, count=16,
+                   env_hash="", owner="test", timeout=30)
+    grants = res.get("grants") or []
+    assert 1 <= len(grants) <= 16
+    for g in grants:
+        cli.call("return_lease", lease_id=g["lease_id"], timeout=10)
+    cli.close()
+
+
+# ---------------------------------------------------------------- actors
+def test_out_of_order_actor_replies(cluster):
+    """A slow async method must not block the reply of a later fast one:
+    replies correlate per-call, not per connection order."""
+    @remote
+    class OOO:
+        async def slow(self):
+            import asyncio
+
+            await asyncio.sleep(1.0)
+            return "slow"
+
+        async def fast(self):
+            return "fast"
+
+    a = OOO.remote()
+    ray_tpu.get(a.fast.remote(), timeout=120)  # actor started
+    slow_ref = a.slow.remote()
+    t0 = time.monotonic()
+    fast_ref = a.fast.remote()
+    assert ray_tpu.get(fast_ref, timeout=30) == "fast"
+    assert time.monotonic() - t0 < 0.8  # did not wait behind slow
+    assert ray_tpu.get(slow_ref, timeout=30) == "slow"
+    ray_tpu.kill(a)
+
+
+def test_concurrent_submitters_resolve_right_futures(cluster):
+    """Interleaved submissions from several threads each get their own
+    results back (correlation ids route every reply to its future)."""
+    @remote
+    class Echo:
+        def echo(self, v):
+            return v
+
+    a = Echo.remote()
+    ray_tpu.get(a.echo.remote(0), timeout=120)
+    errors = []
+
+    def client(tid):
+        try:
+            vals = [(tid, i) for i in range(25)]
+            refs = [a.echo.remote(v) for v in vals]
+            got = ray_tpu.get(refs, timeout=60)
+            if got != vals:
+                errors.append((tid, got[:3]))
+        except Exception as e:  # noqa: BLE001
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    ray_tpu.kill(a)
+
+
+def test_sync_actor_call_roundtrip(cluster):
+    """The 1:1 sync path still returns correct results call after call."""
+    @remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def tick(self):
+            self.n += 1
+            return self.n
+
+    a = Counter.remote()
+    for i in range(1, 21):
+        assert ray_tpu.get(a.tick.remote(), timeout=120) == i
+    ray_tpu.kill(a)
+
+
+# ---------------------------------------------------------------- placement groups
+def test_pg_batch_create_remove(cluster):
+    """Multi-bundle PG: one prepare/commit RPC per node places every
+    bundle; removal returns them all and releases base resources."""
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    daemon = cluster.nodes[0]
+    avail_before = daemon.available.get("CPU", 0.0)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+                         strategy="PACK")
+    assert pg.wait(timeout=60)
+    committed = [k for k in daemon._committed_bundles if k[0] == pg.id.hex()]
+    assert len(committed) == 3
+    assert daemon.available["CPU"] == avail_before - 3
+    # Tasks scheduled into a bundle land on the bundle's derived resources.
+    from ray_tpu.util.placement_group import PlacementGroupSchedulingStrategy
+
+    @remote
+    def inside():
+        return "placed"
+
+    ref = inside.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=1)).remote()
+    assert ray_tpu.get(ref, timeout=120) == "placed"
+    remove_placement_group(pg)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if not [k for k in daemon._committed_bundles
+                if k[0] == pg.id.hex()] and \
+                daemon.available.get("CPU", 0.0) >= avail_before:
+            break
+        time.sleep(0.05)
+    assert not [k for k in daemon._committed_bundles if k[0] == pg.id.hex()]
+    assert daemon.available["CPU"] == avail_before
+
+
+def test_wal_group_commit_burst_survives_crash(tmp_path):
+    """A burst of mutations group-committed in one tick is fully durable
+    across a hard head crash (kill -9 semantics)."""
+    os.environ["RTPU_HEALTH_CHECK_PERIOD_S"] = "0.2"
+    from ray_tpu.utils import config as config_mod
+
+    config_mod.set_config(config_mod.Config.load())
+    c = Cluster(persist_path=str(tmp_path / "snap.pkl"))
+    c.add_node(num_cpus=2)
+    rt = c.connect()
+    old = (global_worker.runtime, global_worker.worker_id,
+           global_worker.node_id, global_worker.mode)
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    try:
+        for i in range(25):
+            rt.kv_put(f"burst-{i}", f"v{i}".encode())
+        c.crash_head()
+        time.sleep(0.5)
+        for i in range(25):
+            assert rt.kv_get(f"burst-{i}") == f"v{i}".encode()
+    finally:
+        rt.shutdown()
+        c.shutdown()
+        (global_worker.runtime, global_worker.worker_id,
+         global_worker.node_id, global_worker.mode) = old
+        config_mod.set_config(config_mod.Config.load())
