@@ -85,7 +85,15 @@ def init_collective_group(world_size: int = 1, rank: int = 0,
                           backend: str = "xla", group_name: str = "default",
                           **kwargs):
     """Create a named group in this process. XLA groups ignore world_size/rank
-    (membership is the device mesh); host groups use them for rendezvous."""
+    (membership is the device mesh); host groups use them for rendezvous.
+
+    XLA groups accept multi-slice options (forwarded to
+    :class:`~ray_tpu.collective.xla_backend.XlaCollectiveGroup`):
+    ``num_slices=N`` lays members out on a 2-level mesh and lowers allreduce
+    hierarchically (ICI reduce-scatter → DCN sum → ICI all-gather) — used
+    automatically whenever the group spans slices; ``hierarchy=("ici",
+    "dcn")`` names the two levels; ``dcn_quant="bf16"|"int8"`` quantizes the
+    cross-slice stage (default from config ``collective_dcn_quant``)."""
     return _manager.create(backend, world_size, rank, group_name, **kwargs)
 
 

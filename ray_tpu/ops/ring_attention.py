@@ -63,7 +63,10 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     """
     b, h, sq, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    n = lax.axis_size(axis_name)
+    if hasattr(lax, "axis_size"):
+        n = lax.axis_size(axis_name)
+    else:  # jax < 0.6 spelling: psum of a literal constant-folds to the size
+        n = int(lax.psum(1, axis_name))
     my = lax.axis_index(axis_name)
     chunk = sq
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -142,9 +145,12 @@ def shard_map_ring(mesh: Mesh, axis: str, causal: bool, sm_scale, spec: P,
     body = functools.partial(ring_attention_local, axis_name=axis,
                              causal=causal, sm_scale=sm_scale, impl=impl)
 
+    # compat shim: jax >= 0.6 jax.shard_map / older experimental check_rep
+    from ray_tpu.collective.xla_backend import shard_map
+
     @jax.jit
     def fn(q, k, v):
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)

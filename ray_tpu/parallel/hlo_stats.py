@@ -1,0 +1,198 @@
+"""Compiled-HLO collective analysis: which bytes cross the slice boundary.
+
+The scale-proof harness (devbench/multislice_perf.py) and tests need a
+*measured* answer to "how many bytes does one train step push over DCN?",
+even on CPU hosts where no real slice interconnect exists. XLA's partitioned
+module is the ground truth: every collective op carries its per-device
+payload shape and a ``replica_groups`` assignment, and a group whose members
+live on more than one slice must move its payload across the slice boundary.
+This module parses ``jit(...).lower(...).compile().as_text()`` and prices
+each cross-slice op with the standard ring-algorithm cost model (stated on
+the result so the number is reproducible):
+
+- all-reduce over m slices: each participant sends ``2*(m-1)/m * payload``
+  across the boundary (reduce-scatter + all-gather phases);
+- all-gather / reduce-scatter / all-to-all: ``(m-1)/m * payload``;
+- collective-permute: ``payload`` per cross-slice pair.
+
+Payload is the op's per-device buffer size as listed in the partitioned
+module (output shape), so quantized wire formats (int8 + scales) are priced
+at their real width.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+COST_MODEL = ("per participant: all-reduce 2*(m-1)/m*payload, "
+              "all-gather/all-to-all (m-1)/m*payload, reduce-scatter "
+              "(m-1)/m*input (= payload*group_size), collective-permute "
+              "payload; m = slices spanned by the replica group; payload = "
+              "per-device result buffer bytes in the partitioned HLO "
+              "(async -start ops: result = tuple minus operand aliases)")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_OP_RE = re.compile(
+    # result: nested tuple (multi-operand async starts return
+    # ((operands...), (results...))), flat tuple, or plain shape; two
+    # nesting levels so TPU tiled layouts ({1,0:T(8,128)}) inside a
+    # nested tuple still match
+    r"=\s+(\((?:[^()]|\((?:[^()]|\([^()]*\))*\))*\)|\S+)\s+"
+    r"(all-reduce-start|all-reduce|all-gather-start|all-gather|"
+    r"reduce-scatter-start|reduce-scatter|collective-permute-start|"
+    r"collective-permute|all-to-all-start|all-to-all)\(")
+_SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\}|\{\{[0-9,{} ]*\}\}|"
+    r"\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)")
+_IOTA_RE = re.compile(r"\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?$")
+
+
+@dataclass
+class CollectiveOp:
+    op: str
+    payload_bytes: int        # per-device buffer bytes
+    groups: list[list[int]]   # partition ids per replica group
+    crosses_slices: bool
+    dcn_bytes: int            # cross-slice bytes under COST_MODEL (all
+    #                           participants summed); 0 for intra-slice ops
+
+
+@dataclass
+class CollectiveStats:
+    ops: list[CollectiveOp] = field(default_factory=list)
+    cost_model: str = COST_MODEL
+    # collective lines whose replica groups could not be resolved (so the
+    # totals below UNDERCOUNT if this is non-zero — callers should surface
+    # it instead of trusting a silently partial sum)
+    skipped_ops: int = 0
+
+    @property
+    def dcn_bytes(self) -> int:
+        return sum(op.dcn_bytes for op in self.ops)
+
+    @property
+    def dcn_ops(self) -> int:
+        return sum(1 for op in self.ops if op.crosses_slices)
+
+
+def _parse_groups(spec: str) -> list[list[int]] | None:
+    if spec.startswith("{"):
+        return [[int(v) for v in grp.split(",") if v.strip()]
+                for grp in re.findall(r"\{([0-9, ]*)\}", spec) if grp.strip()]
+    m = _IOTA_RE.match(spec)
+    if not m:
+        return None
+    n_groups, group_size = int(m.group(1)), int(m.group(2))
+    dims = [int(d) for d in m.group(3).split(",")]
+    ids = np.arange(math.prod(dims)).reshape(dims)
+    if m.group(4):
+        ids = ids.transpose([int(p) for p in m.group(4).split(",")])
+    return ids.reshape(n_groups, group_size).tolist()
+
+
+def _call_args(line: str, start: int) -> str:
+    """The operand list from ``start`` (just past the call's open paren) to
+    its matching close paren. Depth-counted, not find(")"): TPU tiled
+    layouts (``f32[8,128]{1,0:T(8,128)}``) put parens inside operands."""
+    depth = 1
+    for i in range(start, len(line)):
+        if line[i] == "(":
+            depth += 1
+        elif line[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return line[start:i]
+    return line[start:]
+
+
+def _shape_bytes(result: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(result):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = math.prod(int(d) for d in dims.split(",") if d) if dims else 1
+        total += _DTYPE_BYTES[dtype] * n
+    return total
+
+
+def collective_stats(hlo_text: str, slice_of,
+                     n_partitions: int | None = None) -> CollectiveStats:
+    """Parse a partitioned HLO module; ``slice_of(partition_id) -> slice``
+    maps the module's partition ids onto slices (for a mesh built slice-major
+    over N devices with P per slice this is ``pid // P``). ``n_partitions``
+    resolves the ``replica_groups={}`` spelling ("one group of everyone");
+    without it, such ops are counted in ``skipped_ops`` rather than silently
+    dropped."""
+    stats = CollectiveStats()
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        groups_m = _GROUPS_RE.search(line)
+        if not groups_m:
+            # collective-permute carries source_target_pairs instead.
+            pairs_m = re.search(r"source_target_pairs=\{([0-9,{} ]*)\}", line)
+            if not pairs_m:
+                stats.skipped_ops += 1
+                continue
+            groups = [[int(v) for v in grp.split(",")]
+                      for grp in re.findall(r"\{([0-9, ]+)\}",
+                                            pairs_m.group(1))]
+        elif groups_m.group(1) == "{}":
+            # all participants in one group
+            groups = ([list(range(n_partitions))] if n_partitions else None)
+        else:
+            groups = _parse_groups(groups_m.group(1))
+        if not groups:
+            stats.skipped_ops += 1
+            continue
+        payload = _shape_bytes(m.group(1))
+        op = m.group(2)
+        if op.endswith("-start") and m.group(1).startswith("("):
+            # Async wrapper tuple: (operand aliases..., results..., ctx) —
+            # price only the results, or the raw payload is double-counted.
+            payload = max(payload - _shape_bytes(_call_args(line, m.end())),
+                          0)
+        op = op.removesuffix("-start")
+        dcn = 0
+        crosses = False
+        for grp in groups:
+            m_slices = len({slice_of(p) for p in grp})
+            if m_slices < 2:
+                continue
+            crosses = True
+            if op == "collective-permute":
+                dcn += payload  # one buffer moves src -> dst
+                continue
+            frac = (m_slices - 1) / m_slices
+            per_member = {
+                "all-reduce": 2 * frac * payload,
+                "all-gather": frac * payload,
+                # reduce-scatter's result is the 1/group_size shard; the
+                # ring moves (m-1)/m of the FULL input per member.
+                "reduce-scatter": frac * payload * len(grp),
+                "all-to-all": frac * payload,
+            }[op]
+            dcn += int(per_member * len(grp))
+        stats.ops.append(CollectiveOp(op=op, payload_bytes=payload,
+                                      groups=groups, crosses_slices=crosses,
+                                      dcn_bytes=dcn))
+    return stats
+
+
+def mesh_slice_map(n_devices: int, num_slices: int):
+    """slice_of for a slice-major mesh (hybrid_mesh's device layout):
+    partition ids enumerate the mesh flat with the DCN axis outermost, so
+    consecutive runs of ``n_devices // num_slices`` ids share a slice."""
+    per_slice = n_devices // num_slices
+    return lambda pid: pid // per_slice

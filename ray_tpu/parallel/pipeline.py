@@ -24,7 +24,9 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
-from jax import shard_map
+# shard_map via the collective backend's jax-version compat shim (jax >= 0.6
+# exports jax.shard_map; older releases spell it experimental + check_rep).
+from ray_tpu.collective.xla_backend import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models.llama import (

@@ -10,6 +10,7 @@ rule-table change, not a model change.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 
 import jax
@@ -85,3 +86,105 @@ def shard_params(params, mesh: Mesh, logical_tree, rules: ShardingRules | None =
     """Device_put a param pytree with shardings derived from logical axes."""
     shardings = tree_shardings(mesh, logical_tree, rules)
     return jax.tree.map(jax.device_put, params, shardings)
+
+
+# -- cross-replica weight-update sharding (ZeRO-1, arxiv 2004.13336) --------
+
+def batch_axes(rules: ShardingRules | None = None) -> tuple[str, ...]:
+    """The mesh axes the global batch shards over — the data-parallel domain
+    a ZeRO-1 update can shard optimizer state across."""
+    rules = rules or ShardingRules()
+    ax = rules.rules.get("batch")
+    if ax is None:
+        return ()
+    return tuple(ax) if isinstance(ax, tuple) else (ax,)
+
+
+# Logical dims a ZeRO-1 update must NOT shard: "layers" is the scan-stacked
+# dim (sharding it would slice the layer loop itself, forcing per-iteration
+# resharding inside the backward while-loop), and "vocab" is gather/scatter-
+# indexed on the embedding table (a data-dependent-sharded scatter makes the
+# partitioner fall back to full gathers of the one-hot activations).
+ZERO1_SKIP_LOGICAL = ("layers", "vocab")
+
+
+def zero1_spec(spec: P, shape: tuple[int, ...], mesh: Mesh,
+               axes: tuple[str, ...],
+               logical: tuple[str | None, ...] | None = None) -> P:
+    """Extend a param leaf's PartitionSpec so one dim is additionally
+    sharded over ``axes`` (the data-parallel mesh axes), when divisible.
+
+    This is the ZeRO-1 layout: optimizer moments (and the weight update)
+    keyed off this spec live 1/N-sized per data-parallel replica. The dim is
+    the largest one divisible by the extra factor whose logical name (when
+    ``logical`` is given) isn't in :data:`ZERO1_SKIP_LOGICAL` — matmul-style
+    dims lower to clean (reduce-)scatter collectives, scan/index dims don't.
+    Axes already used elsewhere in the spec are skipped; leaves with no
+    suitable dim keep their original spec (their update stays replicated —
+    correct, just not sharded)."""
+    spec = P(*spec) if spec is not None else P()
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    if not entries or not shape:
+        return spec
+    used = set()
+    for e in entries:
+        used.update(e if isinstance(e, tuple) else ((e,) if e else ()))
+    extra = tuple(a for a in axes if a not in used and mesh.shape[a] > 1)
+    if not extra:
+        return spec
+    extra_n = math.prod(mesh.shape[a] for a in extra)
+
+    def _entry_axes(e):
+        return e if isinstance(e, tuple) else ((e,) if e else ())
+
+    best = None
+    for dim, size in enumerate(shape):
+        if logical is not None and dim < len(logical) and \
+                logical[dim] in ZERO1_SKIP_LOGICAL:
+            continue
+        factor = extra_n * math.prod(
+            mesh.shape[a] for a in _entry_axes(entries[dim]))
+        if size % factor:
+            continue
+        if best is None or size > shape[best]:
+            best = dim
+    if best is None:
+        return spec
+    merged = tuple(_entry_axes(entries[best])) + extra  # existing axes major
+    entries[best] = merged if len(merged) > 1 else merged[0]
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def zero1_shardings(mesh: Mesh, shapes, shardings, axes: tuple[str, ...],
+                    logical_axes=None):
+    """Map param-leaf shardings to their ZeRO-1 counterparts: each leaf's
+    spec extended over the data-parallel ``axes`` via :func:`zero1_spec`.
+    ``shapes`` is any pytree of objects with ``.shape`` matching
+    ``shardings``' structure; ``logical_axes`` (the same pytree of
+    logical-dim-name tuples the rule table consumes) steers dim choice away
+    from scan/index dims."""
+    leaves, treedef = jax.tree.flatten(shapes)
+    sh_leaves = jax.tree.flatten(shardings)[0]
+    if logical_axes is None:
+        log_leaves = [None] * len(leaves)
+    else:
+        # is_leaf must also catch None entries ("no logical names for this
+        # leaf") — tree.flatten would otherwise DROP them, misaligning
+        # log_leaves against the param leaves.
+        log_leaves = jax.tree.flatten(
+            logical_axes,
+            is_leaf=lambda x: x is None or (
+                isinstance(x, tuple) and all(
+                    isinstance(a, (str, type(None))) for a in x)))[0]
+        if len(log_leaves) != len(leaves):
+            raise ValueError(
+                f"logical_axes tree has {len(log_leaves)} leaves, params "
+                f"have {len(leaves)}")
+    out = [
+        NamedSharding(mesh, zero1_spec(sh.spec, tuple(leaf.shape), mesh,
+                                       axes, logical=log))
+        for leaf, sh, log in zip(leaves, sh_leaves, log_leaves)
+    ]
+    return jax.tree.unflatten(treedef, out)

@@ -57,6 +57,51 @@ def _set_slice_env(env: dict) -> dict:
     return {k: os.environ.get(k) for k in env}
 
 
+# Latency-hiding-scheduler / async-collective flags for multi-slice training:
+# let the compiler overlap DCN collectives (the deferred gradient sync a
+# grad_accum step leaves at the microbatch boundary) with the next
+# microbatch's compute. They ride LIBTPU_INIT_ARGS, which only libtpu reads —
+# inert on CPU/GPU hosts, no unknown-flag errors.
+_XLA_PERF_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+)
+
+
+def _apply_xla_perf_flags() -> str:
+    """Runs ON each worker, BEFORE jax/libtpu init. Appends the latency-
+    hiding flags to LIBTPU_INIT_ARGS (idempotent; flags already present —
+    e.g. user-pinned values — are left alone). Env-overridable:
+    RTPU_TRAIN_XLA_PERF_FLAGS=0 disables, RTPU_TRAIN_XLA_PERF_FLAGS_EXTRA
+    appends space-separated extra flags. Returns the resulting value for
+    verification."""
+    import os
+
+    from ray_tpu.utils.config import get_config
+
+    if not get_config().train_xla_perf_flags:
+        return os.environ.get("LIBTPU_INIT_ARGS", "")
+    current = os.environ.get("LIBTPU_INIT_ARGS", "")
+    have = {f.split("=")[0] for f in current.split() if f}
+    extra = os.environ.get("RTPU_TRAIN_XLA_PERF_FLAGS_EXTRA", "").split()
+    # EXTRA wins over the defaults: a user re-specifying a built-in flag
+    # (e.g. ...latency_hiding_scheduler=false) replaces it, not joins it.
+    extra_names = {f.split("=")[0] for f in extra}
+    defaults = [f for f in _XLA_PERF_FLAGS
+                if f.split("=")[0] not in extra_names]
+    add = [f for f in (*defaults, *extra)
+           if f.split("=")[0] not in have]
+    if add:
+        os.environ["LIBTPU_INIT_ARGS"] = " ".join(
+            ([current] if current else []) + add)
+    return os.environ.get("LIBTPU_INIT_ARGS", "")
+
+
 @dataclass
 class JaxBackendConfig(BackendConfig):
     """Bring up a jax.distributed world across the worker group.
@@ -75,6 +120,9 @@ class JaxBackendConfig(BackendConfig):
     backend_name: str = "jax"
     distributed: bool = False
     num_slices: int = 1
+    # Apply the latency-hiding-scheduler LIBTPU flags on every worker before
+    # backend init (config train_xla_perf_flags gates it process-wide).
+    xla_perf_flags: bool = True
 
     def make_backend(self) -> "JaxBackend":
         return JaxBackend(self)
@@ -84,11 +132,19 @@ class JaxBackend(Backend):
     def __init__(self, cfg: JaxBackendConfig):
         self.cfg = cfg
         self.slice_env_applied: list[dict] = []  # per-rank, for asserts
+        self.libtpu_args_applied: list[str] = []  # per-rank, for asserts
 
     def on_start(self, worker_group, coordinator_addr: str | None) -> None:
         import ray_tpu
 
         n = len(worker_group.workers)
+        if self.cfg.xla_perf_flags:
+            # Must land before any jax/libtpu init on the worker (both the
+            # distributed bring-up below and the user's train_fn import jax).
+            self.libtpu_args_applied = ray_tpu.get([
+                w.exec_fn.remote(_apply_xla_perf_flags)
+                for w in worker_group.workers
+            ], timeout=300)
         if self.cfg.num_slices > 1:
             from ray_tpu.util.tpu import get_tpu_coordinator_env_vars
 

@@ -130,7 +130,9 @@ class TrainController:
                     if self.backend_config.distributed else None
                 latest = self.ckpt_manager.latest()
                 group.setup(coordinator, restart_count,
-                            latest.path if latest else None)
+                            latest.path if latest else None,
+                            num_slices=getattr(self.backend_config,
+                                               "num_slices", 1))
                 self.backend_config.make_backend().on_start(group, coordinator)
                 if self.datasets:
                     # Split per (re)start so elastic world-size changes get

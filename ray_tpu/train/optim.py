@@ -25,6 +25,7 @@ from typing import NamedTuple, Optional
 import chex
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 
@@ -80,6 +81,40 @@ def scale_by_adam_compact(
         return steps, ScaleByAdamCompactState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def optimizer_state_bytes(optimizer: optax.GradientTransformation, params,
+                          shardings=None) -> int:
+    """Per-device bytes of optimizer state — the number ZeRO-1 divides.
+
+    Computed from ``jax.eval_shape(optimizer.init, params)`` so no state is
+    materialized. With ``shardings`` (a pytree of NamedShardings matching the
+    state tree, e.g. from train/spmd's update sharding), each leaf's bytes
+    are divided by its shard count, giving the HBM actually resident per
+    device; without, the replicated (flat data-parallel) footprint."""
+    shapes = jax.eval_shape(optimizer.init, params)
+    leaves = jax.tree.leaves(shapes)
+    if shardings is None:
+        return sum(int(np.prod(l.shape)) * jnp.dtype(l.dtype).itemsize
+                   for l in leaves)
+    # is_leaf keeps None placeholders (unmatched leaves = replicated) so the
+    # two leaf lists stay aligned.
+    sh_leaves = jax.tree.leaves(shardings, is_leaf=lambda x: x is None)
+    if len(sh_leaves) != len(leaves):
+        raise ValueError(
+            f"shardings tree has {len(sh_leaves)} leaves, optimizer state "
+            f"has {len(leaves)} — a zip would silently misalign them")
+    total = 0
+    for leaf, sh in zip(leaves, sh_leaves):
+        nbytes = int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+        n_shards = 1
+        if sh is not None and hasattr(sh, "spec"):
+            for entry in sh.spec:
+                for ax in (entry if isinstance(entry, tuple)
+                           else ((entry,) if entry else ())):
+                    n_shards *= sh.mesh.shape[ax]
+        total += nbytes // max(n_shards, 1)
+    return total
 
 
 def adamw_lowmem(

@@ -25,6 +25,10 @@ class TrainContext:
     coordinator_addr: str | None = None
     restart_count: int = 0
     latest_checkpoint: str | None = None  # dir path, set on restore
+    # Multi-slice topology (from JaxBackendConfig.num_slices): lets a
+    # train_fn build its hybrid mesh / pick dcn_axes for the spmd step
+    # without re-deriving the slice count from MEGASCALE env.
+    num_slices: int = 1
 
     # filled by the worker harness
     dataset_shards: dict = field(default_factory=dict)  # name -> DataIterator
@@ -40,6 +44,9 @@ class TrainContext:
 
     def get_local_rank(self) -> int:
         return self.local_rank
+
+    def get_num_slices(self) -> int:
+        return self.num_slices
 
     def get_checkpoint(self) -> str | None:
         return self.latest_checkpoint

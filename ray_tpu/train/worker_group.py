@@ -37,10 +37,11 @@ class TrainWorker:
         self._error: str | None = None
 
     def setup_env(self, coordinator_addr: str | None, restart_count: int,
-                  latest_checkpoint: str | None):
+                  latest_checkpoint: str | None, num_slices: int = 1):
         self.ctx.coordinator_addr = coordinator_addr
         self.ctx.restart_count = restart_count
         self.ctx.latest_checkpoint = latest_checkpoint
+        self.ctx.num_slices = max(1, int(num_slices))
         return True
 
     def set_dataset_shards(self, shards: dict) -> bool:
@@ -121,9 +122,10 @@ class WorkerGroup:
         ]
 
     def setup(self, coordinator_addr: str | None, restart_count: int,
-              latest_checkpoint: str | None):
+              latest_checkpoint: str | None, num_slices: int = 1):
         ray_tpu.get([
-            w.setup_env.remote(coordinator_addr, restart_count, latest_checkpoint)
+            w.setup_env.remote(coordinator_addr, restart_count,
+                               latest_checkpoint, num_slices)
             for w in self.workers
         ], timeout=120)
 

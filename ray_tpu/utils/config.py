@@ -115,6 +115,33 @@ class Config:
     # > 0 trades a bounded durability window for fewer writes under churn.
     wal_group_commit_ms: float = 0.0
 
+    # --- collectives / multi-slice training ---
+    # Cross-slice (DCN) wire format for hierarchical allreduce in multi-slice
+    # collective groups ("none" | "bf16" | "int8"). "none" keeps the input
+    # dtype. "bf16" halves DCN bytes at ~1e-3 relative error. "int8" is the
+    # EQuARX-style per-bucket-scaled format: ~4x fewer DCN bytes at ~4e-3
+    # relative error on the summed gradient (see tests/test_collective.py
+    # parity tolerances). Per-group override: init_collective_group(
+    # dcn_quant=...).
+    collective_dcn_quant: str = "none"
+    # Elements sharing one f32 scale in the int8 DCN format. Smaller buckets
+    # track outliers better (lower error, more scale overhead); 256 keeps
+    # scale overhead at 1.6% of payload.
+    collective_dcn_quant_bucket: int = 256
+
+    # --- train ---
+    # Compute the grad-norm metric every N steps (1 = every step, the
+    # old behavior). The global-norm reduction costs ~1.6% of a Llama-1B
+    # step (PERF_STEP.json r05: 7.8 ms of 505); skipped steps report
+    # grad_norm = -1. Default for make_train_step(grad_norm_every=None).
+    train_grad_norm_every: int = 1
+    # Set latency-hiding-scheduler / async-collective LIBTPU flags on train
+    # workers before backend init, so DCN collectives overlap the next
+    # microbatch's compute (train/backend.py _XLA_PERF_FLAGS). Flags ride
+    # LIBTPU_INIT_ARGS, so they are inert on CPU hosts. Extra flags can be
+    # appended via RTPU_TRAIN_XLA_PERF_FLAGS_EXTRA (space-separated).
+    train_xla_perf_flags: bool = True
+
     # --- observability ---
     # Flight recorder: JSON debug bundles dumped on task failure / worker
     # death / actor death under <temp_dir>/flight_records.
