@@ -130,6 +130,10 @@ class HeadServer:
         # a federated export with a node_id label per series.
         self.telemetry: dict[str, dict] = {}  # source -> {node_id, ts, snapshot}
         self.spans: deque = deque(maxlen=50_000)
+        # Per-worker train step-time/sync-time summaries (straggler
+        # attribution): source -> {node_id, ts, stats: {rank: {...}}},
+        # streamed inside the same report_telemetry pushes.
+        self.train_stats: dict[str, dict] = {}
         # Function-registry observability (puts/gets/misses/dup_puts) —
         # the definitions themselves live in the KV under FN_NS.
         self.fn_stats: dict[str, int] = {
@@ -171,6 +175,10 @@ class HeadServer:
         r("report_telemetry", self._report_telemetry)
         r("get_telemetry", self._get_telemetry)
         r("get_spans", self._get_spans)
+        r("profile_cluster", self._profile_cluster)
+        r("stack_cluster", self._stack_cluster)
+        r("device_memory", self._device_memory)
+        r("get_train_stats", self._get_train_stats)
         r("cluster_load", self._cluster_load)
         r("create_placement_group", self._create_pg)
         r("remove_placement_group", self._remove_pg)
@@ -1048,7 +1056,8 @@ class HeadServer:
                                 snapshot: dict | None = None,
                                 spans: list | None = None,
                                 events: list | None = None,
-                                dropped: int = 0):
+                                dropped: int = 0,
+                                train_stats: dict | None = None):
         """One batched push from a process's telemetry flusher: its metrics
         snapshot (replaces the previous one for this source), finished
         spans, and drained task events (reference: per-worker
@@ -1081,6 +1090,14 @@ class HeadServer:
         if events:
             self.task_events.extend(events)
             self._task_events_total += len(events)
+        if train_stats:
+            self.train_stats[source] = {
+                "node_id": node_id, "ts": time.time(), "stats": train_stats,
+            }
+            if len(self.train_stats) > 4096:  # churny clusters stay bounded
+                src = min(self.train_stats,
+                          key=lambda s: self.train_stats[s]["ts"])
+                self.train_stats.pop(src, None)
         return {"ok": True}
 
     async def _get_telemetry(self, conn: ServerConnection,
@@ -1097,6 +1114,67 @@ class HeadServer:
     async def _get_spans(self, conn: ServerConnection, limit: int = 50_000):
         spans = list(self.spans)
         return {"spans": spans[-limit:]}
+
+    # ------------------------------------------------------------- profiling
+    # Cluster leg of the `profile` control RPC: fan the capture out to every
+    # alive node daemon (which fans out to its workers), then hand back the
+    # per-process captures TOGETHER with the span timeline so the caller
+    # merges one chrome-trace + one fleet flamegraph (profiling/merge.py).
+    # A node dying mid-capture contributes an error entry, never a hang.
+
+    async def _fan_to_daemons(self, method: str, timeout: float, **kwargs):
+        async def one(nid: str):
+            try:
+                cli = await self._daemon_rpc(nid)
+                return nid, await cli.call(method, timeout=timeout, **kwargs)
+            except Exception as e:  # noqa: BLE001 - partial results win
+                return nid, {"errors": {nid: f"{type(e).__name__}: {e}"}}
+
+        alive = [nid for nid, n in self.nodes.items() if n.alive]
+        return await asyncio.gather(*(one(nid) for nid in alive))
+
+    async def _profile_cluster(self, conn: ServerConnection,
+                               seconds: float = 5.0,
+                               sample_hz: float = 0.0,
+                               include_daemons: bool = True):
+        seconds = max(0.05, min(float(seconds),
+                                get_config().profiler_max_capture_s))
+        captures: list[dict] = []
+        errors: dict[str, str] = {}
+        # One capture_id for the whole request: co-hosted daemons (several
+        # NodeDaemons in one interpreter) dedupe their self-capture on it.
+        capture_id = uuid.uuid4().hex
+        for nid, res in await self._fan_to_daemons(
+                "profile_node", seconds + 60.0, seconds=seconds,
+                sample_hz=sample_hz, include_daemon=include_daemons,
+                capture_id=capture_id):
+            captures.extend(res.get("captures") or [])
+            errors.update(res.get("errors") or {})
+        return {"captures": captures, "errors": errors,
+                "spans": list(self.spans)[-20_000:]}
+
+    async def _stack_cluster(self, conn: ServerConnection):
+        nodes = {}
+        for nid, res in await self._fan_to_daemons("stack_node", 30.0):
+            nodes[nid] = res
+        return {"nodes": nodes}
+
+    async def _device_memory(self, conn: ServerConnection):
+        nodes = {}
+        for nid, res in await self._fan_to_daemons("memory_node", 30.0):
+            nodes[nid] = res
+        return {"nodes": nodes}
+
+    async def _get_train_stats(self, conn: ServerConnection,
+                               max_age_s: float = 300.0):
+        """The straggler table: every source's per-rank step summaries,
+        sources silent past ``max_age_s`` omitted (finished/dead trainers
+        must fall out of the report)."""
+        cutoff = time.time() - max_age_s
+        return {"sources": {
+            src: row for src, row in self.train_stats.items()
+            if row["ts"] >= cutoff
+        }}
 
     async def _state_snapshot(self, conn: ServerConnection):
         """Whole-cluster view for the state API (reference: the GCS tables

@@ -70,6 +70,14 @@ class _PendingLease:
 # Which daemon flushes this process's telemetry (see _telemetry_loop).
 _process_telemetry_owner: str | None = None
 
+# capture_id -> node_id that claimed THIS PROCESS's self-capture for that
+# profile request. In-process test clusters co-host several daemons in one
+# process; exactly one of them should sample it per request (the others
+# would only produce duplicates + busy refusals). Keyed per REQUEST — a
+# liveness-independent claim, unlike the telemetry owner, which a
+# leaked/stopped daemon can hold indefinitely. Bounded FIFO.
+_capture_claims: "OrderedDict[str, str]" = OrderedDict()
+
 
 class NodeDaemon:
     # Consecutive container-worker boot failures per env before pending
@@ -156,6 +164,10 @@ class NodeDaemon:
                 self.transfer_addr = (self.rpc.host, port)
             except Exception:
                 self.transfer_addr = None  # RPC chunk fallback only
+        # Concurrent profile_node captures in flight (guardrail: bounded by
+        # config profiler_max_concurrent_captures; excess requests are
+        # refused and counted, never queued behind a long capture).
+        self._active_captures = 0
         self._register_handlers()
         self._bg: list[asyncio.Task] = []
 
@@ -179,6 +191,10 @@ class NodeDaemon:
         r("prestart_workers", self._prestart_workers)
         r("gossip", self._handle_gossip)
         r("worker_fate", self._worker_fate)
+        # On-demand profiling plane (head -> here -> workers).
+        r("profile_node", self._profile_node)
+        r("stack_node", self._stack_node)
+        r("memory_node", self._memory_node)
 
     async def _prestart_workers(self, conn, n: int = 0):
         """Warm the worker pool ahead of demand (reference:
@@ -503,6 +519,146 @@ class NodeDaemon:
     async def _worker_fate(self, conn, worker_id: str = ""):
         return self._worker_fates.get(worker_id) or {}
 
+    # ------------------------------------------------------------- profiling
+    # The node leg of the `profile` control RPC (head -> daemon -> worker):
+    # fan the capture out to every registered worker process (each samples
+    # itself while still executing tasks) plus, once per process, this
+    # daemon. A worker dying mid-capture yields a partial result set + a
+    # flight record — never a hang (bounded per-worker timeouts).
+
+    def _live_worker_addrs(self) -> list[tuple[str, tuple[str, int]]]:
+        return [(wid, w.addr) for wid, w in self.workers.items()
+                if w.addr is not None
+                and (w.proc is None or w.proc.poll() is None)]
+
+    async def _profile_node(self, conn, seconds: float = 5.0,
+                            sample_hz: float = 0.0,
+                            include_daemon: bool = True,
+                            capture_id: str = ""):
+        from ray_tpu import profiling
+
+        cfg = get_config()
+        capture_id = capture_id or uuid.uuid4().hex
+        seconds = max(0.05, min(float(seconds), cfg.profiler_max_capture_s))
+        if self._active_captures >= cfg.profiler_max_concurrent_captures:
+            profiling.count_dropped("node_capture_limit")
+            return {"captures": [], "dropped": True, "errors": {
+                self.node_id: (
+                    f"node capture limit reached "
+                    f"({cfg.profiler_max_concurrent_captures} in flight)")}}
+        self._active_captures += 1
+        try:
+            captures: list[dict] = []
+            errors: dict[str, str] = {}
+
+            async def daemon_capture():
+                # One self-capture per PROCESS per request: co-hosted
+                # daemons (in-process test clusters) share one interpreter —
+                # whichever sees the request first claims it (runs on the
+                # shared loop, so the check-and-set is race-free).
+                if _capture_claims.setdefault(capture_id, self.node_id) != \
+                        self.node_id:
+                    return
+                while len(_capture_claims) > 64:
+                    _capture_claims.popitem(last=False)
+                import functools
+
+                from ray_tpu.profiling import capture_profile
+
+                loop = asyncio.get_running_loop()
+                res = await loop.run_in_executor(None, functools.partial(
+                    capture_profile, seconds, sample_hz=sample_hz or None,
+                    meta={"kind": "daemon", "node_id": self.node_id,
+                          "source": self.node_id}))
+                if res.get("error"):
+                    errors[self.node_id] = str(res.get("reason")
+                                               or res["error"])
+                else:
+                    captures.append(res)
+
+            fan = self._fan_workers("profile", timeout=seconds + 30.0,
+                                    seconds=seconds, sample_hz=sample_hz)
+            if include_daemon:
+                (results, lost), _ = await asyncio.gather(fan,
+                                                          daemon_capture())
+            else:
+                results, lost = await fan
+            for wid, res in results.items():
+                if res.get("error"):
+                    errors[wid] = str(res.get("reason") or res["error"])
+                else:
+                    captures.append(res)
+            for wid, err in lost.items():
+                errors[wid] = err
+                from ray_tpu.core import flight_recorder
+
+                flight_recorder.record(
+                    "profile_capture_failure",
+                    reason=f"worker lost mid-capture: {err}",
+                    node_id=self.node_id, extra={"worker_id": wid})
+            return {"captures": captures, "errors": errors,
+                    "node_id": self.node_id}
+        finally:
+            self._active_captures -= 1
+
+    async def _fan_workers(self, method: str, timeout: float = 10.0,
+                           **kwargs) -> tuple[dict, dict]:
+        """Concurrent RPC to every live worker on this node; returns
+        ``(results, errors)`` keyed by worker id. Wedged workers are
+        exactly when these verbs matter, so per-worker timeouts must
+        overlap, not stack — sequential polling of N dead connections
+        would blow past the head's own fan timeout and lose the responsive
+        workers' answers along with the daemon's."""
+        results: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+
+        async def one(wid: str, addr: tuple[str, int]):
+            cli = None
+            try:
+                cli = AsyncRpcClient(*addr)
+                await cli.connect()
+                results[wid] = await cli.call(method, timeout=timeout,
+                                              **kwargs)
+            except Exception as e:  # noqa: BLE001 - partial results win
+                errors[wid] = f"{type(e).__name__}: {e}"
+            finally:
+                if cli is not None:
+                    try:
+                        await cli.close()
+                    except Exception:
+                        pass
+
+        addrs = self._live_worker_addrs()
+        if addrs:
+            await asyncio.gather(*(one(wid, addr) for wid, addr in addrs))
+        return results, errors
+
+    async def _stack_node(self, conn):
+        """One-shot stack dump of every process on this node (the fleet
+        `stack` verb with no target)."""
+        from ray_tpu.profiling.sampler import dump_stacks
+
+        out = {"node_id": self.node_id,
+               "daemon": {"pid": os.getpid(), "stacks": dump_stacks()},
+               "workers": {}, "errors": {}}
+        out["workers"], out["errors"] = await self._fan_workers("dump_stack")
+        return out
+
+    async def _memory_node(self, conn):
+        """Device/host memory snapshot of every process on this node."""
+        from ray_tpu.profiling.memory import memory_snapshot
+
+        out = {"node_id": self.node_id, "daemon": memory_snapshot(),
+               "workers": {}, "errors": {}}
+        if self.shm_name and self._shm is not None:
+            try:
+                out["shm_arena"] = self._shm.stats()
+            except Exception:
+                pass
+        out["workers"], out["errors"] = await self._fan_workers(
+            "memory_snapshot")
+        return out
+
     @staticmethod
     def _node_used_bytes(source: str = "meminfo") -> int:
         """Node-level used memory, read from the SAME accounting domain
@@ -546,6 +702,22 @@ class NodeDaemon:
             return 0
         return max(0, total - avail)
 
+    async def _dump_worker_stacks(self, w: WorkerProc,
+                                  grace_s: float = 0.25) -> None:
+        """Last words before a SIGKILL: SIGUSR2 makes the worker dump all
+        thread stacks into a flight-recorder bundle (worker_main installs
+        the handler), then a short grace lets the write land. SIGKILL
+        leaves no other trace of WHY the process was wedged/oversized."""
+        import signal as _signal
+
+        if w.proc is None or w.proc.poll() is not None:
+            return
+        try:
+            os.kill(w.proc.pid, _signal.SIGUSR2)
+        except OSError:
+            return
+        await asyncio.sleep(grace_s)
+
     async def _memory_watch_loop(self):
         """Two triggers, one kill policy:
         - node pressure: host used memory above the threshold of the
@@ -583,6 +755,11 @@ class NodeDaemon:
                 "oom": True, "rss": rss, "usage": usage, "limit": limit,
                 "node_id": self.node_id,
             })
+            # Last-words stack dump, grace capped by the poll interval: a
+            # worker allocating at full tilt must not get long to grow
+            # further before the kill lands.
+            await self._dump_worker_stacks(
+                victim, grace_s=min(0.25, cfg.memory_monitor_interval_s))
             try:
                 victim.proc.kill()  # SIGKILL; the reap loop cleans up
             except OSError:

@@ -303,6 +303,11 @@ class ClusterRuntime:
         self.server.register("report_holder", self._handle_report_holder)
         self.server.register("pin_object", self._handle_pin_object)
         self.server.register("ping", self._handle_ping)
+        # Profiling one-shots answered by EVERY cluster process (driver and
+        # worker alike): the `stack <worker>` / `memory --device` verbs
+        # resolve any row of the head's worker directory.
+        self.server.register("dump_stack", self._handle_dump_stack)
+        self.server.register("memory_snapshot", self._handle_memory_snapshot)
         self.addr = self._io.run(self.server.start())
         # Workers learn their node from the forking daemon's env; a DRIVER
         # asks its attached daemon — without this, objects the driver holds
@@ -382,19 +387,30 @@ class ClusterRuntime:
                 spans, self._span_cursor = tracing.flush_new(
                     self._span_cursor)
                 snapshot = metrics.registry().snapshot()
+                # Straggler feed: per-rank step-time/sync-time deciles from
+                # any train context living in this process ride the same
+                # push (train/session.py collects; the head keys them by
+                # source so restarts overwrite, not duplicate).
+                train_stats = None
+                try:
+                    from ray_tpu.train import session as _session
+
+                    train_stats = _session.collect_train_stats() or None
+                except Exception:
+                    pass
                 # Idle-process economy: nothing new to report and the
                 # snapshot unchanged — skip the RPC, but keepalive well
                 # inside the head's 60s liveness window so the source
                 # doesn't age out of the federated export.
                 now = time.monotonic()
                 if not events and not spans and snapshot == last_snapshot \
-                        and now - last_sent < 20.0:
+                        and train_stats is None and now - last_sent < 20.0:
                     continue
                 self.head.call(
                     "report_telemetry", source=source,
                     node_id=self.my_node_id, timeout=10,
                     snapshot=snapshot, spans=spans, events=events,
-                    dropped=buf.dropped)
+                    dropped=buf.dropped, train_stats=train_stats)
                 last_snapshot, last_sent = snapshot, now
             except Exception:
                 pass  # head temporarily unreachable: drop (bounded loss)
@@ -407,9 +423,55 @@ class ClusterRuntime:
         """Finished spans flushed to the head from every node."""
         return self.head.call("get_spans").get("spans", [])
 
+    # ----------------------------------------------------------- profiling
+    def profile_cluster(self, seconds: float = 5.0,
+                        sample_hz: float = 0.0) -> dict:
+        """One cluster-wide profile capture: per-process stack samples +
+        guarded XLA traces + memory snapshots, plus the head's span
+        timeline (merge with ray_tpu.profiling.merge)."""
+        return self.head.call("profile_cluster", seconds=seconds,
+                              sample_hz=sample_hz,
+                              timeout=float(seconds) + 120.0)
+
+    def stack_cluster(self) -> dict:
+        """Immediate stack dump of every daemon/worker process."""
+        return self.head.call("stack_cluster", timeout=60)
+
+    def dump_worker_stack(self, worker_id: str) -> dict:
+        """One worker's thread stacks, resolved through the head's worker
+        directory (the `ray stack <worker>` verb)."""
+        res = self.head.call("resolve_worker", worker_id=worker_id)
+        addr = res.get("addr")
+        if not addr:
+            raise ValueError(f"unknown worker {worker_id!r}")
+        return self._peer(tuple(addr)).call("dump_stack", timeout=10)
+
+    def device_memory(self) -> dict:
+        """Per-node device/host memory snapshots."""
+        return self.head.call("device_memory", timeout=60)
+
+    def train_stats(self) -> dict:
+        """The head's straggler table (per-rank step-time summaries)."""
+        return self.head.call("get_train_stats")
+
     # ------------------------------------------------------------------ serving
     async def _handle_ping(self, conn, **kw):
         return {"ok": True, "worker_id": self.worker_id.hex()}
+
+    async def _handle_dump_stack(self, conn, **kw):
+        from ray_tpu.profiling.sampler import dump_stacks
+
+        return {"worker_id": self.worker_id.hex(),
+                "node_id": self.my_node_id, "pid": os.getpid(),
+                "stacks": dump_stacks()}
+
+    async def _handle_memory_snapshot(self, conn, **kw):
+        from ray_tpu.profiling.memory import memory_snapshot
+
+        snap = memory_snapshot()
+        snap["worker_id"] = self.worker_id.hex()
+        snap["node_id"] = self.my_node_id
+        return snap
 
     # Relay-distribution knobs (reference: push_manager bounds concurrent
     # chunk sends; here the owner bounds outstanding referrals per copy).

@@ -132,6 +132,11 @@ class WorkerProcess:
         srv.register_raw("push_actor_calls", self._push_actor_calls_raw)
         srv.register("cancel_task", self._cancel_task)
         srv.register("exit_worker", self._exit_worker)
+        # On-demand profiling plane (head -> node_daemon -> here): captures
+        # run on an executor thread so they sample task/actor execution
+        # instead of blocking behind it. (dump_stack / memory_snapshot
+        # one-shots are registered by ClusterRuntime for every process.)
+        srv.register("profile", self._profile)
         # Cancellation state: ids cancelled before start, and the thread
         # currently executing each task (for async interrupt).
         self._cancelled_tasks: set[str] = set()
@@ -592,6 +597,24 @@ class WorkerProcess:
         for rid, blob in calls:
             put(("__call__", blob, rid, conn, loop))
 
+    # ------------------------------------------------------------- profiling
+    async def _profile(self, conn, seconds: float = 1.0,
+                       sample_hz: float = 0.0):
+        """One capture of THIS worker: stack samples + (guarded) XLA trace +
+        memory snapshot. Runs on the default executor — the serial task
+        executor keeps executing, which is the whole point of sampling it."""
+        import functools
+
+        from ray_tpu.profiling import capture_profile
+
+        loop = asyncio.get_running_loop()
+        meta = {"kind": "worker", "worker_id": self.runtime.worker_id.hex(),
+                "node_id": self.node_id_hex,
+                "actor_id": self._actor_id_hex or ""}
+        return await loop.run_in_executor(None, functools.partial(
+            capture_profile, seconds, sample_hz=sample_hz or None,
+            meta=meta))
+
     async def _exit_worker(self, conn):
         self._exit_event.set()
         return {"ok": True}
@@ -619,6 +642,33 @@ def _parent_watchdog():
     threading.Thread(target=watch, daemon=True).start()
 
 
+def _install_sigusr2_dump():
+    """Hung-worker last resort: SIGUSR2 dumps every thread's stack into a
+    flight-recorder bundle (kind ``worker_stacks``) that survives the
+    process. The node daemon sends it before escalating to SIGKILL on
+    unresponsive workers, so a post-mortem always has the final stacks even
+    when the RPC plane is wedged."""
+    import signal
+
+    def _dump(signum, frame):  # noqa: ARG001 - signal handler signature
+        try:
+            from ray_tpu.core import flight_recorder
+            from ray_tpu.profiling.sampler import dump_stacks
+
+            # local_only: the dump must not block on a head RPC — the RPC
+            # plane being wedged (or the kill-grace window expiring) is
+            # exactly when this handler fires.
+            flight_recorder.record(
+                "worker_stacks", reason="SIGUSR2 stack dump",
+                node_id=os.environ.get("RTPU_NODE_ID", ""),
+                extra={"stacks": dump_stacks(), "pid": os.getpid()},
+                local_only=True)
+        except Exception:
+            pass  # a dump must never make a dying worker die harder
+
+    signal.signal(signal.SIGUSR2, _dump)
+
+
 def main():
     # SIGUSR1 dumps all thread stacks to the worker log — the first tool to
     # reach for when a worker wedges (reference: ray stack / py-spy dump).
@@ -626,6 +676,7 @@ def main():
     import signal
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    _install_sigusr2_dump()
     # Honor a platform pin for jax-using task/actor code. The env var
     # JAX_PLATFORMS alone is NOT enough in environments whose
     # sitecustomize pre-imports jax with a device-tunnel platform

@@ -37,10 +37,16 @@ def records_dir() -> str:
 
 def record(kind: str, reason: str = "", task_id: str = "",
            actor_id: str = "", node_id: str = "",
-           extra: dict | None = None) -> str | None:
+           extra: dict | None = None,
+           local_only: bool = False) -> str | None:
     """Dump a debug bundle; returns its path, or None when disabled,
     rate-limited, or anything at all goes wrong (the failure path being
-    instrumented must never fail harder because of the recorder)."""
+    instrumented must never fail harder because of the recorder).
+
+    ``local_only`` skips every cluster RPC while building the bundle —
+    required from signal handlers and kill-grace windows, where a blocking
+    head round-trip could hang past the SIGKILL (or forever, when the RPC
+    plane being wedged is exactly why the dump was requested)."""
     global _last_record_ts
     try:
         cfg = get_config()
@@ -52,7 +58,7 @@ def record(kind: str, reason: str = "", task_id: str = "",
                 return None
             _last_record_ts = now
         bundle = _build_bundle(kind, reason, task_id, actor_id, node_id,
-                               extra)
+                               extra, local_only)
         d = records_dir()
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"fr-{time.time_ns()}-{kind}.json")
@@ -66,7 +72,8 @@ def record(kind: str, reason: str = "", task_id: str = "",
         return None
 
 
-def _build_bundle(kind, reason, task_id, actor_id, node_id, extra) -> dict:
+def _build_bundle(kind, reason, task_id, actor_id, node_id, extra,
+                  local_only: bool = False) -> dict:
     from ray_tpu.core import events as _events
     from ray_tpu.util import metrics as _metrics
     from ray_tpu.util import tracing as _tracing
@@ -82,10 +89,11 @@ def _build_bundle(kind, reason, task_id, actor_id, node_id, extra) -> dict:
     # record() callers run on a node's control-plane event loop — asdict
     # over the full ring there would stall heartbeats/lease handling.
     try:
-        if on_io_loop:
-            # record() from an event-loop coroutine (actor-death paths):
-            # an RPC through the loop's own sync façade would deadlock, so
-            # settle for the local buffer + already-fetched cluster cache.
+        if on_io_loop or local_only:
+            # record() from an event-loop coroutine (actor-death paths) or
+            # a caller that cannot block (signal handlers): an RPC would
+            # deadlock / hang, so settle for the local buffer + the
+            # already-fetched cluster cache.
             raw = _events.global_event_buffer().events()
             raw.extend(_events._cluster_cache)
         else:
@@ -100,7 +108,7 @@ def _build_bundle(kind, reason, task_id, actor_id, node_id, extra) -> dict:
     from dataclasses import asdict as _asdict
 
     spans = [_asdict(s) for s in _tracing.spans()[-SPANS_TAIL:]]
-    if not on_io_loop:
+    if not on_io_loop and not local_only:
         # Cluster mode: local spans alone miss the submitter's client span
         # (it lives in the driver process and reaches the head via its
         # telemetry flusher) — merge the head's view so a worker-side

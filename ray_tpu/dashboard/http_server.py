@@ -170,6 +170,26 @@ class DashboardServer:
 
         self.add_route("GET", "/api/flight_records", flight_records)
 
+        # On-demand profiler (reference capability: `ray stack`/timeline +
+        # jax.profiler, driven over HTTP). /api/profile blocks for the
+        # capture window — the threaded server keeps the other routes live.
+        self.add_route(
+            "GET", "/api/profile",
+            lambda p, b: state_api.profile_cluster(
+                seconds=float(p.get("seconds", 2.0)),
+                sample_hz=float(p.get("hz", 0.0))))
+        self.add_route(
+            "GET", "/api/stack",
+            lambda p, b: (state_api.get_stack(p["worker"])
+                          if p.get("worker")
+                          else state_api.stack_cluster()))
+        self.add_route("GET", "/api/memory/device",
+                       lambda p, b: state_api.device_memory())
+        self.add_route(
+            "GET", "/api/stragglers",
+            lambda p, b: state_api.stragglers(
+                threshold=float(p.get("threshold", 1.15))))
+
         def cluster_status(p, b):
             from ray_tpu.core.worker import global_worker
 

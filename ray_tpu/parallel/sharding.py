@@ -70,6 +70,22 @@ class ShardingRules:
         return ShardingRules({**self.rules, **updates})
 
 
+def normalize_spec(spec: P | None) -> P:
+    """Canonical PartitionSpec form: 1-tuples collapse to their bare axis
+    and empty tuples to None, so specs compare by MEANING across jax
+    versions (jax >= 0.5 normalizes at construction; 0.4.x keeps
+    ``P(("fsdp",),)`` and ``P("fsdp")`` distinct-but-equivalent objects,
+    which breaks naive equality)."""
+    if spec is None:
+        return P()
+    out = []
+    for e in spec:
+        if isinstance(e, tuple):
+            e = e if len(e) > 1 else (e[0] if e else None)
+        out.append(e)
+    return P(*out)
+
+
 def tree_shardings(mesh: Mesh, logical_tree, rules: ShardingRules | None = None):
     """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
     rules = rules or ShardingRules()

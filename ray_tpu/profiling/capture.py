@@ -1,0 +1,126 @@
+"""One profiling capture session for THIS process.
+
+``capture_profile(seconds)`` runs, for a clamped duration:
+
+(a) the Python stack sampler (collapsed flamegraph lines + sample timeline),
+(b) a ``jax.profiler`` trace session — guarded so it degrades to a no-op
+    marker when jax was never initialized here or the backend is CPU-only
+    (tier-1), and
+(c) a before/after memory snapshot (device buffers, RSS, store occupancy).
+
+Exactly one capture runs per process at a time: a second request returns a
+``busy`` error (and counts into ``profiler_dropped_captures``) instead of
+double-sampling — the per-NODE concurrency cap lives in the node daemon.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from ray_tpu.utils.config import get_config
+
+_capture_lock = threading.Lock()
+
+
+def _xla_trace_begin(logdir: str | None) -> tuple[dict, bool]:
+    """Start a jax.profiler trace when it is meaningful; otherwise return
+    the degradation marker. Never initializes a jax backend in a process
+    that hasn't."""
+    from ray_tpu.profiling.memory import jax_backend_ready
+
+    cfg = get_config()
+    if not cfg.profiler_xla_trace:
+        return {"status": "skipped", "reason": "disabled by config "
+                "(profiler_xla_trace=False)"}, False
+    if not jax_backend_ready():
+        return {"status": "skipped",
+                "reason": "jax not initialized in this process"}, False
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        if backend == "cpu":
+            # CPU-only tier-1: a device trace has nothing to say and the
+            # TensorBoard plugin deps may be absent — no-op marker.
+            return {"status": "skipped",
+                    "reason": "cpu-only backend (no XLA device trace)"}, \
+                False
+        logdir = logdir or os.path.join(
+            cfg.temp_dir, "xla_traces", f"{os.getpid()}-{time.time_ns()}")
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+        return {"status": "capturing", "backend": backend,
+                "logdir": logdir}, True
+    except Exception as e:  # noqa: BLE001 - trace must not fail the capture
+        return {"status": "error", "reason": f"{type(e).__name__}: {e}"}, \
+            False
+
+
+def _xla_trace_end(state: dict) -> dict:
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+        state = dict(state)
+        state["status"] = "captured"
+    except Exception as e:  # noqa: BLE001
+        state = dict(state)
+        state["status"] = "error"
+        state["reason"] = f"{type(e).__name__}: {e}"
+    return state
+
+
+def capture_profile(seconds: float, *, sample_hz: float | None = None,
+                    xla_logdir: str | None = None,
+                    meta: dict | None = None) -> dict:
+    """Blocking capture (callers run it on an executor thread, never the
+    event loop). Returns the capture bundle, or ``{"error": "busy", ...}``
+    when this process is already capturing."""
+    from ray_tpu.profiling import count_dropped, profiler_metrics
+    from ray_tpu.profiling.memory import memory_snapshot
+    from ray_tpu.profiling.sampler import StackSampler
+
+    cfg = get_config()
+    seconds = max(0.05, min(float(seconds), cfg.profiler_max_capture_s))
+    hz = float(sample_hz or cfg.profiler_sample_hz)
+    if not _capture_lock.acquire(blocking=False):
+        count_dropped("busy")
+        return {"error": "busy", "reason": "a capture is already running in "
+                f"this process (pid {os.getpid()})", "meta": dict(meta or {})}
+    try:
+        mem_before = memory_snapshot()
+        xla, xla_live = _xla_trace_begin(xla_logdir)
+        sampler = StackSampler(hz=hz).start()
+        hz = sampler.hz  # report the CLAMPED rate (sampler enforces _MAX_HZ)
+        t0 = time.monotonic()
+        time.sleep(seconds)
+        sampler.stop()
+        if xla_live:
+            xla = _xla_trace_end(xla)
+        duration = time.monotonic() - t0
+        bundle = {
+            "meta": dict(meta or {}),
+            "pid": os.getpid(),
+            "duration_s": duration,
+            "sample_hz": hz,
+            "samples": sampler.samples,
+            "collapsed": sampler.collapsed(),
+            "sample_events": sampler.sample_events(),
+            "xla_trace": xla,
+            "memory": memory_snapshot(),
+            "memory_before": mem_before,
+            "started_at": sampler.started_at,
+            "ended_at": sampler.ended_at,
+        }
+        try:
+            kind = (meta or {}).get("kind", "process")
+            profiler_metrics()["capture_seconds"].inc(
+                duration, tags={"kind": kind})
+        except Exception:
+            pass
+        return bundle
+    finally:
+        _capture_lock.release()

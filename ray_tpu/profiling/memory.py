@@ -1,0 +1,122 @@
+"""Device/host memory snapshot: what is holding memory RIGHT NOW.
+
+Three accounting domains in one dict:
+
+- process: RSS from /proc (works everywhere, no dependencies);
+- device: per-device live ``jax`` buffer bytes via ``jax.live_arrays()`` —
+  guarded so a process that never initialized jax (most cluster workers)
+  reports a skip marker instead of paying the multi-second import;
+- stores: the in-process object store and the node shm arena occupancy from
+  their existing stats() surfaces.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def jax_backend_ready() -> bool:
+    """True only when a jax backend has already been INITIALIZED in this
+    process. ``"jax" in sys.modules`` alone is not enough: a worker whose
+    user code merely imported jax would pay full backend init on its first
+    ``live_arrays()``/``default_backend()`` call — multi-second, and on a
+    TPU host it contends for chips another process owns — exactly the cost
+    these guards exist to avoid. Unknown jax internals degrade to the
+    imported-implies-ready check rather than dropping real snapshots."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        backends = getattr(xla_bridge, "_backends", None)
+        if backends is not None:
+            return bool(backends)
+    except Exception:
+        pass
+    return True
+
+
+def _rss_bytes() -> int:
+    try:
+        with open(f"/proc/{os.getpid()}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def _device_memory() -> dict:
+    """Per-device live-buffer bytes. Never initializes a backend: profiling
+    a worker that never ran jax must not cost a backend init (and on a TPU
+    host would steal the chip)."""
+    if not jax_backend_ready():
+        return {"status": "skipped",
+                "reason": "jax not initialized in this process"}
+    try:
+        import jax
+
+        per_device: dict[str, dict] = {}
+        for arr in jax.live_arrays():
+            try:
+                devs = list(arr.devices())
+                nbytes = int(arr.nbytes) // max(1, len(devs))
+            except Exception:
+                continue
+            for d in devs:
+                row = per_device.setdefault(
+                    str(d), {"live_arrays": 0, "bytes": 0})
+                row["live_arrays"] += 1
+                row["bytes"] += nbytes
+        out = {"status": "captured", "backend": jax.default_backend(),
+               "devices": per_device}
+        # Platform allocator stats when the runtime exposes them (TPU/GPU
+        # backends; CPU has no pooled device memory).
+        try:
+            stats = {}
+            for d in jax.local_devices():
+                ms = d.memory_stats()
+                if ms:
+                    stats[str(d)] = {
+                        "bytes_in_use": int(ms.get("bytes_in_use", 0)),
+                        "bytes_limit": int(ms.get("bytes_limit", 0)),
+                    }
+            if stats:
+                out["allocator"] = stats
+        except Exception:
+            pass
+        return out
+    except Exception as e:  # noqa: BLE001 - snapshot must not fail captures
+        return {"status": "error", "reason": f"{type(e).__name__}: {e}"}
+
+
+def _store_stats() -> dict:
+    out: dict[str, dict] = {}
+    try:
+        from ray_tpu.core.worker import global_worker
+
+        rt = global_worker.runtime
+        if rt is None:
+            return out
+        store = getattr(rt, "store", None)
+        if store is not None and hasattr(store, "stats"):
+            out["object_store"] = store.stats()
+        shm = getattr(rt, "shm", None)
+        if shm is not None and hasattr(shm, "stats"):
+            out["shm_arena"] = shm.stats()
+    except Exception:
+        pass
+    return out
+
+
+def memory_snapshot() -> dict:
+    """One process's memory picture: RSS + live device buffers + object
+    store / shm arena occupancy."""
+    import time
+
+    return {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "rss_bytes": _rss_bytes(),
+        "device": _device_memory(),
+        "stores": _store_stats(),
+    }

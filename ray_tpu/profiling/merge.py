@@ -1,0 +1,141 @@
+"""Head/driver-side aggregation: per-process captures + span timeline →
+one chrome-trace JSON and one fleet flamegraph.
+
+The chrome trace interleaves three kinds of rows so the whole capture loads
+as one Perfetto/chrome://tracing document:
+
+- span slices (ph="X") from the PR-1 span timeline, one row per trace;
+- sampling tracks per captured process: one slice per stack sample, named by
+  the leaf frame (the "what was it doing" track);
+- memory counters (ph="C") per process from the capture's snapshots.
+
+The fleet flamegraph is plain collapsed-stack text: every process's stacks
+prefixed with a ``kind:id@node`` root frame, counts summed — one file feeds
+any flamegraph renderer (inferno, speedscope, flamegraph.pl).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def _capture_label(cap: dict) -> str:
+    meta = cap.get("meta") or {}
+    kind = meta.get("kind", "process")
+    ident = (meta.get("worker_id") or meta.get("source")
+             or str(cap.get("pid", "?")))[:8]
+    node = (meta.get("node_id") or "")[:8]
+    return f"{kind}:{ident}@{node}" if node else f"{kind}:{ident}"
+
+
+def merge_flamegraph(captures: list[dict]) -> str:
+    """Sum collapsed stacks across captures, each rooted at its process
+    label, so one flamegraph spans the fleet."""
+    agg: dict[str, int] = {}
+    for cap in captures:
+        if not cap or cap.get("error"):
+            continue
+        label = _capture_label(cap)
+        for line in (cap.get("collapsed") or "").splitlines():
+            stack, _, n = line.rpartition(" ")
+            if not stack or not n.isdigit():
+                continue
+            key = f"{label};{stack}"
+            agg[key] = agg.get(key, 0) + int(n)
+    return "\n".join(f"{k} {v}" for k, v in
+                     sorted(agg.items(), key=lambda kv: (-kv[1], kv[0])))
+
+
+def merge_chrome_trace(captures: list[dict],
+                       spans: list[dict] | None = None) -> dict:
+    """Chrome-trace object document merging sample tracks, memory counters,
+    and the span timeline (same span-row shape as the ``timeline`` CLI, so
+    the two artifacts never drift visually)."""
+    events: list[dict] = []
+    seen_spans = set()
+    for s in spans or []:
+        sid = s.get("span_id")
+        if sid in seen_spans:
+            continue
+        seen_spans.add(sid)
+        events.append({
+            "name": s.get("name", ""), "cat": f"span:{s.get('kind', '')}",
+            "ph": "X", "ts": s.get("start_ts", 0.0) * 1e6,
+            "dur": max(0.0, (s.get("end_ts", 0.0) -
+                             s.get("start_ts", 0.0)) * 1e6),
+            "pid": "spans", "tid": (s.get("trace_id") or "")[:8],
+            "args": {"trace_id": s.get("trace_id"), "span_id": sid,
+                     "status": s.get("status"),
+                     **(s.get("attributes") or {})},
+        })
+    if spans is not None:
+        events.append({"name": "process_name", "ph": "M", "pid": "spans",
+                       "args": {"name": "ray_tpu spans"}})
+
+    for cap in captures:
+        if not cap or cap.get("error"):
+            continue
+        label = _capture_label(cap)
+        hz = float(cap.get("sample_hz") or 100.0)
+        dur_us = 1e6 / hz
+        events.append({"name": "process_name", "ph": "M", "pid": label,
+                       "args": {"name": f"samples {label}"}})
+        for ev in cap.get("sample_events") or []:
+            events.append({
+                "name": ev.get("leaf") or "(idle)", "cat": "sample",
+                "ph": "X", "ts": ev.get("ts", 0.0) * 1e6, "dur": dur_us,
+                "pid": label, "tid": ev.get("thread", "thread"),
+            })
+        for which in ("memory_before", "memory"):
+            mem = cap.get(which) or {}
+            if not mem:
+                continue
+            events.append({
+                "name": "rss_bytes", "ph": "C",
+                "ts": mem.get("ts", 0.0) * 1e6, "pid": label,
+                "args": {"rss": mem.get("rss_bytes", 0)},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_artifacts(result: dict, out_dir: str,
+                    trace: dict | None = None,
+                    flame: str | None = None) -> dict:
+    """Write the merged artifacts of one cluster profile under ``out_dir``:
+    trace.json (chrome trace), flame.txt (collapsed stacks), memory.json
+    (per-process snapshots), captures.json (raw bundles, sample events
+    elided — they are already in the trace). Returns the path map. Pass
+    ``trace``/``flame`` when the caller already merged them (a fleet merge
+    over thousands of sample events is not free to redo)."""
+    os.makedirs(out_dir, exist_ok=True)
+    captures = result.get("captures") or []
+    if trace is None:
+        trace = merge_chrome_trace(captures, result.get("spans"))
+    if flame is None:
+        flame = merge_flamegraph(captures)
+    paths = {
+        "trace": os.path.join(out_dir, "trace.json"),
+        "flamegraph": os.path.join(out_dir, "flame.txt"),
+        "memory": os.path.join(out_dir, "memory.json"),
+        "captures": os.path.join(out_dir, "captures.json"),
+    }
+    with open(paths["trace"], "w") as f:
+        json.dump(trace, f)
+    with open(paths["flamegraph"], "w") as f:
+        f.write(flame + ("\n" if flame else ""))
+    with open(paths["memory"], "w") as f:
+        json.dump([{"label": _capture_label(c),
+                    "memory": c.get("memory"),
+                    "memory_before": c.get("memory_before")}
+                   for c in captures if c and not c.get("error")],
+                  f, indent=2, default=str)
+    slim = []
+    for c in captures:
+        c = dict(c or {})
+        c.pop("sample_events", None)
+        slim.append(c)
+    with open(paths["captures"], "w") as f:
+        json.dump({"captures": slim, "errors": result.get("errors") or {}},
+                  f, default=str)
+    return paths

@@ -166,12 +166,81 @@ def cmd_logs(args) -> int:
 
 
 def cmd_memory(args) -> int:
-    """Object store usage (reference: ray memory)."""
+    """Object store usage (reference: ray memory); with --device, the
+    per-node device/host memory snapshot (live jax buffer bytes per device,
+    RSS, shm-arena occupancy)."""
     api = _connect(args.address)
     from ray_tpu.core.worker import global_worker
 
+    if getattr(args, "device", False):
+        from ray_tpu.util.state import device_memory
+
+        print(json.dumps(device_memory(), indent=2, default=str))
+        return 0
     snap = global_worker.runtime.state_snapshot()
     print(json.dumps(snap.get("objects", {}), indent=2))
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """On-demand cluster profile: stack samples + guarded XLA traces +
+    memory snapshots from every process, merged with the span timeline into
+    a chrome-trace and a fleet flamegraph under --out."""
+    _connect(args.address)
+    from ray_tpu.util.state import profile_cluster
+
+    res = profile_cluster(seconds=args.seconds, sample_hz=args.hz,
+                          out_dir=args.out)
+    n = len(res["captures"])
+    total = sum(c.get("samples", 0) for c in res["captures"])
+    print(f"captured {n} process(es), {total} stack samples")
+    for target, err in sorted(res["errors"].items()):
+        print(f"  error {target[:16]}: {err}")
+    for name, path in sorted(res.get("paths", {}).items()):
+        print(f"  {name}: {path}")
+    return 0 if n else 1
+
+
+def cmd_stack(args) -> int:
+    """Thread stacks: one worker (id prefix), or — with no target — every
+    process in the cluster (daemons + workers; in-process runtimes degrade
+    to this process)."""
+    _connect(args.address)
+    if args.worker:
+        from ray_tpu.util.state import get_stack
+
+        res = get_stack(args.worker)
+        print(f"=== worker {res.get('worker_id', '')[:16]} "
+              f"pid {res.get('pid')} ===")
+        print(res.get("stacks", ""))
+        return 0
+    from ray_tpu.util.state import stack_cluster
+
+    res = stack_cluster()
+    for nid, node in sorted(res.get("nodes", {}).items()):
+        d = node.get("daemon") or {}
+        print(f"=== node {nid[:16]} daemon pid {d.get('pid')} ===")
+        print(d.get("stacks", ""))
+        for wid, w in sorted((node.get("workers") or {}).items()):
+            print(f"=== worker {wid[:16]} pid {w.get('pid')} ===")
+            print(w.get("stacks", ""))
+        for wid, err in sorted((node.get("errors") or {}).items()):
+            print(f"=== worker {wid[:16]} unreachable: {err} ===")
+    return 0
+
+
+def cmd_stragglers(args) -> int:
+    """Straggler report: workers ranked by step time vs the fleet, lagging
+    host named."""
+    _connect(args.address)
+    from ray_tpu.profiling.straggler import format_report
+    from ray_tpu.util.state import stragglers
+
+    report = stragglers(threshold=args.threshold)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(format_report(report))
     return 0
 
 
@@ -201,7 +270,24 @@ def main(argv: list[str] | None = None) -> int:
     gp.add_argument("glob", nargs="?", default=None)
     gp.add_argument("--list", action="store_true")
     gp.add_argument("--tail", type=int, default=20_000)
-    sub.add_parser("memory")
+    mp = sub.add_parser("memory")
+    mp.add_argument("--device", action="store_true",
+                    help="per-node device/host memory snapshot")
+    prof = sub.add_parser("profile")
+    prof.add_argument("--seconds", type=float, default=5.0)
+    prof.add_argument("--hz", type=float, default=0.0,
+                      help="sampling rate (default: config "
+                           "profiler_sample_hz)")
+    prof.add_argument("--out", default="prof",
+                      help="artifact directory (trace.json, flame.txt, "
+                           "memory.json, captures.json)")
+    stk = sub.add_parser("stack")
+    stk.add_argument("worker", nargs="?", default="",
+                     help="worker id (or unique prefix); omit for a "
+                          "fleet-wide dump of every daemon and worker")
+    strag = sub.add_parser("stragglers")
+    strag.add_argument("--threshold", type=float, default=1.15)
+    strag.add_argument("--json", action="store_true")
 
     from ray_tpu.scripts.start import add_parsers as _add_start_parsers
 
@@ -212,7 +298,8 @@ def main(argv: list[str] | None = None) -> int:
         return args._fn(args)
     cmds = {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
             "timeline": cmd_timeline, "logs": cmd_logs, "memory": cmd_memory,
-            "flight-records": cmd_flight_records}
+            "flight-records": cmd_flight_records, "profile": cmd_profile,
+            "stack": cmd_stack, "stragglers": cmd_stragglers}
     return cmds[args.command](args)
 
 

@@ -203,6 +203,22 @@ class ServeReplica:
 
     # -- control plane --
 
+    def profile(self, seconds: float = 2.0, sample_hz: float = 0.0) -> dict:
+        """Per-replica capture: sample THIS replica's process while it
+        serves (called through the actor handle, so it runs concurrently
+        with the data plane under max_concurrency). Answers "why is this
+        one replica's TTFT 3x the fleet" with a flamegraph of that replica
+        alone — the cluster-wide `profile` verb covers it too, but this
+        targets one deployment copy without touching the rest."""
+        from ray_tpu.profiling import capture_profile
+
+        return capture_profile(
+            seconds, sample_hz=sample_hz or None,
+            meta={"kind": "serve_replica",
+                  "deployment": self.deployment_name,
+                  "source": self.replica_id,
+                  "replica_id": self.replica_id})
+
     def get_metrics(self) -> dict:
         with self._lock:
             return {"replica_id": self.replica_id, "ongoing": self._ongoing,

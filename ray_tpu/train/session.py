@@ -9,8 +9,13 @@ manager, SURVEY.md §3.4 step 4).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
+
+# Per-worker step-time window feeding straggler attribution (the head ranks
+# workers from the decile summaries streamed with every telemetry push).
+_STEP_WINDOW = 256
 
 
 @dataclass
@@ -35,6 +40,11 @@ class TrainContext:
     _reports: list[dict] = field(default_factory=list)
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
     _last_report_ts: float = 0.0  # monotonic ts of the previous report()
+    # Rolling per-step timing window: (step_time, sync_s, compute_s) per
+    # report(); summarized into deciles for the head's straggler table.
+    _step_window: deque = field(
+        default_factory=lambda: deque(maxlen=_STEP_WINDOW))
+    _steps_total: int = 0
 
     def get_world_rank(self) -> int:
         return self.world_rank
@@ -64,9 +74,49 @@ class TrainContext:
 
 _local = threading.local()
 
+# rank -> its LIVE TrainContext (last-write-wins across restarts): the
+# telemetry flusher reads step-stat summaries from here without holding a
+# reference into any particular worker thread. Only live contexts are held
+# strongly — a finished run is summarized into a plain row at
+# set_context(None) time (below), never pinned (a TrainContext holds the
+# run's dataset shards).
+_stats_registry: dict[int, TrainContext] = {}
+# rank -> (monotonic finish time, final summary row). The final window
+# stays streamable for a bounded grace (a short run can end before the
+# flusher's next tick — dropping it instantly would lose the run's stats
+# entirely), then the rank is evicted so the telemetry idle-skip resumes
+# and the head row ages out of the straggler report instead of being
+# re-stamped forever.
+_stats_final: dict[int, tuple[float, dict]] = {}
+_FINISHED_GRACE_S = 60.0
+_stats_lock = threading.Lock()
+
+
+def _prune_final_locked(now_m: float) -> None:
+    for rank, (t0, _row) in list(_stats_final.items()):
+        if now_m - t0 > _FINISHED_GRACE_S:
+            _stats_final.pop(rank)
+
 
 def set_context(ctx: TrainContext | None) -> None:
+    import time as _time
+
+    prev = getattr(_local, "ctx", None)
     _local.ctx = ctx
+    now_m = _time.monotonic()
+    with _stats_lock:
+        _prune_final_locked(now_m)
+        if ctx is not None:
+            _stats_registry[ctx.world_rank] = ctx
+            _stats_final.pop(ctx.world_rank, None)
+        elif prev is not None and \
+                _stats_registry.get(prev.world_rank) is prev:
+            # Guarded so a restart that already took the rank
+            # (last-write-wins) isn't evicted by the old run's cleanup.
+            _stats_registry.pop(prev.world_rank)
+            row = _summarize_steps(prev)
+            if row is not None:
+                _stats_final[prev.world_rank] = (now_m, row)
 
 
 def get_context() -> TrainContext:
@@ -129,6 +179,18 @@ def _instrument_report(ctx: TrainContext, metrics: dict[str, Any]) -> None:
     step_time = (now - last) if last else 0.0
     if step_time > 0:
         m["step_time"].set(step_time, tags=rank)
+        sync = metrics.get("sync_time_s")
+        compute = metrics.get("compute_time_s")
+        # _report_lock: the telemetry flusher snapshots this window from
+        # another thread, and list(deque) raises if an append lands
+        # mid-iteration once the window is full.
+        with ctx._report_lock:
+            ctx._step_window.append((
+                step_time,
+                float(sync) if sync is not None else None,
+                float(compute) if compute is not None else None,
+            ))
+            ctx._steps_total += 1
     if "tokens_per_s" in metrics:
         m["tokens_per_s"].set(float(metrics["tokens_per_s"]), tags=rank)
     elif step_time > 0:
@@ -164,6 +226,66 @@ def drain_reports(ctx: TrainContext) -> list[dict]:
     with ctx._report_lock:
         out, ctx._reports = ctx._reports, []
     return out
+
+
+def collect_train_stats() -> dict:
+    """Per-rank step-time/sync-time summaries for the head's straggler
+    table, streamed with every telemetry push. Deciles are computed over
+    the rolling window (p0..p100 inclusive, 11 values); sync/compute shares
+    come from ``sync_time_s``/``compute_time_s`` keys passed to report()
+    when the train loop measures them (None when it doesn't)."""
+    import time as _time
+
+    out: dict[str, dict] = {}
+    now_m = _time.monotonic()
+    with _stats_lock:
+        _prune_final_locked(now_m)
+        contexts = dict(_stats_registry)
+        finals = {rank: row for rank, (_t0, row) in _stats_final.items()}
+    for rank, ctx in contexts.items():
+        row = _summarize_steps(ctx)
+        if row is not None:
+            out[str(rank)] = row
+    for rank, row in finals.items():
+        out.setdefault(str(rank), row)
+    return out
+
+
+def _summarize_steps(ctx: TrainContext) -> dict | None:
+    """One rank's summary row from its rolling step window (None when the
+    run never reported a timed step)."""
+    import time as _time
+
+    with ctx._report_lock:  # pairs with the append in _instrument_report
+        window = list(ctx._step_window)
+    if not window:
+        return None
+    ts = sorted(t for t, _, _ in window)
+    n = len(ts)
+    deciles = [ts[min(n - 1, round(q * (n - 1) / 10))]
+               for q in range(11)]
+    # Shares are ratios over only the steps that REPORTED the numerator
+    # — a loop that instruments sync_time_s every Nth step must not get
+    # its share diluted by the uninstrumented steps' time (which would
+    # misattribute a collective-wait victim as compute-bound).
+    syncs = [(t, s) for t, s, _ in window if s is not None]
+    computes = [(t, c) for t, _, c in window if c is not None]
+
+    def share(pairs):
+        denom = sum(t for t, _ in pairs)
+        return (sum(v for _, v in pairs) / denom) if denom else None
+
+    total = sum(ts)
+    return {
+        "world_size": ctx.world_size,
+        "steps": ctx._steps_total,
+        "mean_step_s": total / n,
+        "median_step_s": deciles[5],
+        "deciles": deciles,
+        "sync_share": share(syncs),
+        "compute_share": share(computes),
+        "ts": _time.time(),
+    }
 
 
 def get_dataset_shard(name: str = "train"):

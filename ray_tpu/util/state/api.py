@@ -142,6 +142,152 @@ def get_flight_record(name: str) -> dict:
     return flight_recorder.get_record(name)
 
 
+def _reject_thin_client(rt, what: str) -> None:
+    """A ``client://`` runtime is attached to a REAL cluster but proxies
+    only the task/object API — the in-process degrade path would silently
+    profile just the local CLI process while claiming success. Error
+    instead of mis-scoping."""
+    try:
+        from ray_tpu.util.client.client import ClientRuntime
+    except Exception:
+        return
+    if isinstance(rt, ClientRuntime):
+        raise ValueError(
+            f"{what} is not available over a client:// connection; "
+            "attach with address='<head-host:port>' instead")
+
+
+def profile_cluster(seconds: float = 5.0, sample_hz: float = 0.0,
+                    out_dir: str | None = None) -> dict:
+    """On-demand cluster profile: every daemon/worker captures stack
+    samples + a guarded XLA trace + a memory snapshot for ``seconds``; the
+    result merges with the span timeline into one chrome-trace and one
+    fleet flamegraph. In-process runtimes degrade to profiling this
+    process. With ``out_dir``, artifacts are written there and their paths
+    returned under ``"paths"``. The returned captures omit the raw
+    ``sample_events``/span lists — they are already encoded in
+    ``chrome_trace`` and would double a multi-MB payload (the ``out_dir``
+    trace file holds the complete merge)."""
+    from ray_tpu.profiling import (
+        capture_profile,
+        merge_chrome_trace,
+        merge_flamegraph,
+        write_artifacts,
+    )
+    from ray_tpu.util import tracing
+
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "profile_cluster")
+    if hasattr(rt, "profile_cluster"):
+        res = rt.profile_cluster(seconds, sample_hz=sample_hz)
+    else:
+        cap = capture_profile(seconds, sample_hz=sample_hz or None,
+                              meta={"kind": "driver", "source": "local"})
+        res = {"captures": [] if cap.get("error") else [cap],
+               "errors": ({"local": cap["reason"]} if cap.get("error")
+                          else {}),
+               "spans": tracing.export()}
+    captures = res.get("captures") or []
+    spans = res.get("spans") or []
+    out = {
+        "captures": [{k: v for k, v in c.items() if k != "sample_events"}
+                     for c in captures],
+        "errors": res.get("errors") or {},
+        "chrome_trace": merge_chrome_trace(captures, spans),
+        "flamegraph": merge_flamegraph(captures),
+    }
+    if out_dir:
+        out["paths"] = write_artifacts(res, out_dir,
+                                       trace=out["chrome_trace"],
+                                       flame=out["flamegraph"])
+    return out
+
+
+def get_stack(worker_id: str = "") -> dict:
+    """Thread stacks of one worker (id or unique id prefix), or of THIS
+    process when ``worker_id`` is empty — the `ray stack` capability."""
+    from ray_tpu.profiling.sampler import dump_stacks
+
+    if not worker_id:
+        import os
+
+        return {"worker_id": "local", "pid": os.getpid(),
+                "stacks": dump_stacks()}
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "per-worker stacks")
+    if not hasattr(rt, "dump_worker_stack"):
+        raise ValueError("per-worker stacks require cluster mode "
+                         "(pass no worker for a local dump)")
+    matches = [w["worker_id"] for w in list_workers()
+               if w["worker_id"].startswith(worker_id)]
+    if not matches:
+        raise ValueError(f"no worker matches {worker_id!r}")
+    if len(matches) > 1:
+        raise ValueError(f"ambiguous worker prefix {worker_id!r}: "
+                         f"{[m[:16] for m in matches]}")
+    return rt.dump_worker_stack(matches[0])
+
+
+def stack_cluster() -> dict:
+    """Thread stacks of EVERY process in the cluster (each node's daemon
+    plus its workers) — the fleet `stack` verb with no target. In-process
+    runtimes degrade to this process."""
+    import os
+
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "stack_cluster")
+    if hasattr(rt, "stack_cluster"):
+        return rt.stack_cluster()
+    from ray_tpu.profiling.sampler import dump_stacks
+
+    return {"nodes": {"local": {
+        "node_id": "local",
+        "daemon": {"pid": os.getpid(), "stacks": dump_stacks()},
+        "workers": {}, "errors": {}}}}
+
+
+def device_memory() -> dict:
+    """Per-node device/host memory snapshots (live jax buffer bytes per
+    device, RSS, shm-arena/object-store occupancy). In-process runtimes
+    degrade to this process's snapshot."""
+    from ray_tpu.profiling import memory_snapshot
+
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "device_memory")
+    if hasattr(rt, "device_memory"):
+        return rt.device_memory()
+    return {"nodes": {"local": {"node_id": "local",
+                                "daemon": memory_snapshot(),
+                                "workers": {}, "errors": {}}}}
+
+
+def stragglers(threshold: float = 1.15) -> dict:
+    """Straggler report: workers ranked by median step time vs the fleet,
+    attributed compute-bound vs collective-wait, lagging host named. Feeds
+    off the per-rank deciles the telemetry pushes stream to the head; the
+    in-process runtime reads this process's train contexts directly."""
+    import time as _time
+
+    from ray_tpu.profiling import build_report
+
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "stragglers")
+    if hasattr(rt, "train_stats"):
+        sources = rt.train_stats().get("sources", {})
+    else:
+        from ray_tpu.train.session import collect_train_stats
+
+        stats = collect_train_stats()
+        sources = {"local": {"node_id": "local", "ts": _time.time(),
+                             "stats": stats}} if stats else {}
+    return build_report(sources, threshold=threshold)
+
+
 def list_logs(node_id: str | None = None) -> list[dict]:
     """Per-node worker log files (reference: `ray logs` listing via the
     dashboard agent). Cluster mode only; in-process runtimes have no

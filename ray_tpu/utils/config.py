@@ -152,6 +152,28 @@ class Config:
     # the push entirely).
     telemetry_flush_interval_s: float = 0.5
 
+    # --- on-demand profiler (ray_tpu/profiling) ---
+    # Python stack-sampler rate for `profile` captures. 100 Hz keeps the
+    # measured overhead within the <=2% budget PERF_PROFILER.json tracks;
+    # raise for finer flamegraphs on beefy hosts. The sampler clamps any
+    # requested rate to 1 kHz — above that the per-sample GIL cost
+    # approaches the interval and a single profile request would busy-loop
+    # every process in the cluster.
+    profiler_sample_hz: float = 100.0
+    # Hard ceiling on one capture's duration: a fat-fingered
+    # `profile --seconds 86400` must not leave samplers running for a day.
+    # Requests are clamped, not rejected.
+    profiler_max_capture_s: float = 60.0
+    # Concurrent `profile_node` captures a node daemon will run at once;
+    # excess requests are refused (and counted in
+    # profiler_dropped_captures) so profiling can't pile onto a node that
+    # is already being profiled.
+    profiler_max_concurrent_captures: int = 2
+    # Allow `jax.profiler` device-trace capture inside profile sessions.
+    # Off, or on a process without an initialized non-CPU jax backend, the
+    # capture carries a no-op marker instead of a trace.
+    profiler_xla_trace: bool = True
+
     # --- tpu ---
     tpu_visible_chips_env: str = "TPU_VISIBLE_CHIPS"
     tpu_premapped_buffer_bytes: int = 0  # 0 = library default

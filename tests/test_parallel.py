@@ -6,7 +6,12 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.parallel.mesh import MeshSpec, build_mesh, hybrid_mesh
-from ray_tpu.parallel.sharding import ShardingRules, shard_params, tree_shardings
+from ray_tpu.parallel.sharding import (
+    ShardingRules,
+    normalize_spec,
+    shard_params,
+    tree_shardings,
+)
 
 
 def test_mesh_spec_sizes():
@@ -42,7 +47,10 @@ def test_hybrid_mesh_dcn_outermost(cpu_mesh_devices):
 def test_sharding_rules_spec():
     rules = ShardingRules()
     assert rules.spec("batch", "seq", "act_embed") == P(("dp", "fsdp"), "sp", None)
-    assert rules.spec("embed", "mlp") == P(("fsdp",), "tp")
+    # normalize both sides: jax 0.4.x keeps P(("fsdp",)) and P("fsdp")
+    # distinct objects; >=0.5 normalizes at construction
+    assert normalize_spec(rules.spec("embed", "mlp")) == \
+        normalize_spec(P(("fsdp",), "tp"))
     assert rules.spec(None, "heads") == P(None, "tp")
 
 
@@ -66,7 +74,8 @@ def test_shard_params_places_on_mesh(cpu_mesh_devices):
     }
     logical = {"wq": ("embed", "heads"), "wo": ("heads", "embed")}
     sharded = shard_params(params, mesh, logical)
-    assert sharded["wq"].sharding.spec == P(("fsdp",), "tp")
+    assert normalize_spec(sharded["wq"].sharding.spec) == \
+        normalize_spec(P(("fsdp",), "tp"))
     # value preserved
     np.testing.assert_allclose(np.asarray(sharded["wq"]), params["wq"])
 
@@ -114,7 +123,11 @@ def test_pp_matches_single_device(cpu_mesh_devices):
     ref_loss = float(loss_fn(cfg, params, jnp.asarray(tokens),
                              jnp.asarray(targets), attn_impl="blockwise",
                              remat=False, fused_ce=False))
-    np.testing.assert_allclose(pp_loss, ref_loss, rtol=1e-4, atol=1e-4)
+    # 5e-4: jax 0.4.x CPU accumulation order drifts the pipeline's f32 sum
+    # ~2e-4 relative from the single-device reference (measured 2.15e-4 on
+    # 0.4.37); real grad bugs show up orders of magnitude larger (the
+    # trajectory check below would also catch them).
+    np.testing.assert_allclose(pp_loss, ref_loss, rtol=5e-4, atol=5e-4)
 
     # And training makes progress over a few steps.
     for _ in range(3):

@@ -1,0 +1,489 @@
+"""On-demand profiler: stack sampler, capture sessions, the profile RPC
+head → daemon → worker round trip, straggler attribution, and the SIGUSR2
+hung-worker dump.
+
+Mirrors the reference's active-debugging surface (reference: `ray stack`
+via py-spy, `ray timeline`, jax.profiler trace capture) against the local
+multi-worker cluster fixture.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from ray_tpu.profiling import (
+    build_report,
+    capture_profile,
+    merge_chrome_trace,
+    merge_flamegraph,
+)
+from ray_tpu.profiling.sampler import StackSampler, dump_stacks
+from ray_tpu.utils.config import get_config
+
+
+@pytest.fixture(autouse=True)
+def _clean_train_stats():
+    """Other suites leave TrainContexts in the session stats registry; a
+    stale rank must not leak into straggler tables built here."""
+    from ray_tpu.train import session
+
+    with session._stats_lock:
+        session._stats_registry.clear()
+    yield
+    with session._stats_lock:
+        session._stats_registry.clear()
+
+
+def _spin(seconds: float) -> None:
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        sum(i * i for i in range(400))
+
+
+class _ClusterHarness:
+    """Cluster + driver-bound global_worker, restored on exit (the same
+    shape the observability suite uses)."""
+
+    def __init__(self, num_nodes=1, num_cpus=2, node_ids=None):
+        import ray_tpu
+        from ray_tpu.cluster_utils import Cluster
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.utils.ids import JobID
+
+        self.api = ray_tpu
+        self.c = Cluster()
+        for i in range(num_nodes):
+            nid = node_ids[i] if node_ids else None
+            self.c.add_node(num_cpus=num_cpus, node_id=nid)
+        self.rt = self.c.connect()
+        self._gw = global_worker
+        self._old = (global_worker.runtime, global_worker.worker_id,
+                     global_worker.node_id, global_worker.mode,
+                     global_worker.job_id)
+        global_worker.runtime = self.rt
+        global_worker.worker_id = self.rt.worker_id
+        global_worker.node_id = self.rt.node_id
+        global_worker.job_id = JobID.from_random()
+        global_worker.mode = "cluster"
+
+    def close(self):
+        self.rt.shutdown()
+        self.c.shutdown()
+        (self._gw.runtime, self._gw.worker_id, self._gw.node_id,
+         self._gw.mode, self._gw.job_id) = self._old
+
+
+class TestSampler:
+    def test_collapsed_stacks_and_sample_events(self):
+        t = threading.Thread(target=_spin, args=(0.4,), name="spin-thread")
+        t.start()
+        s = StackSampler(hz=200).start()
+        time.sleep(0.25)
+        s.stop()
+        t.join()
+        assert s.samples >= 10
+        collapsed = s.collapsed()
+        # the busy thread's stack aggregated under its thread-name root
+        spin_lines = [ln for ln in collapsed.splitlines()
+                      if ln.startswith("spin-thread;")]
+        assert spin_lines and "_spin" in spin_lines[0]
+        # every line is `stack count`
+        for ln in collapsed.splitlines():
+            stack, _, n = ln.rpartition(" ")
+            assert stack and n.isdigit()
+        events = s.sample_events()
+        assert events and all({"ts", "thread", "leaf"} <= set(e) for e in
+                              events)
+
+    def test_dump_stacks_lists_every_thread(self):
+        text = dump_stacks()
+        assert "MainThread" in text
+        assert "test_dump_stacks_lists_every_thread" in text
+
+
+class TestCapture:
+    def test_capture_degrades_xla_on_cpu_and_snapshots_memory(self):
+        """CPU-only tier-1 acceptance: the XLA leg is a no-op marker, the
+        memory leg still reports live jax buffers (conftest initialized the
+        cpu backend in this process)."""
+        cap = capture_profile(0.15, meta={"kind": "driver", "source": "t"})
+        assert not cap.get("error")
+        assert cap["samples"] >= 3
+        assert cap["xla_trace"]["status"] == "skipped"
+        assert "cpu-only" in cap["xla_trace"]["reason"]
+        assert cap["memory"]["rss_bytes"] > 0
+        assert cap["memory"]["device"]["status"] == "captured"
+        assert cap["memory"]["device"]["backend"] == "cpu"
+
+    def test_second_capture_refused_busy_and_counted(self):
+        from ray_tpu.util import metrics
+
+        results = {}
+
+        def long_capture():
+            results["first"] = capture_profile(0.5)
+
+        t = threading.Thread(target=long_capture)
+        t.start()
+        time.sleep(0.1)
+        second = capture_profile(0.1)
+        t.join()
+        assert not results["first"].get("error")
+        assert second.get("error") == "busy"
+        text = metrics.registry().export_prometheus()
+        assert 'profiler_dropped_captures{reason="busy"}' in text
+        assert "profiler_capture_seconds" in text
+
+    def test_duration_hard_ceiling(self, monkeypatch):
+        monkeypatch.setattr(get_config(), "profiler_max_capture_s", 0.2)
+        t0 = time.monotonic()
+        cap = capture_profile(30.0)
+        assert time.monotonic() - t0 < 2.0
+        assert cap["duration_s"] < 1.0
+
+
+class TestMergers:
+    def test_flamegraph_and_chrome_trace_merge(self):
+        caps = []
+        for i, node in enumerate(("nodea", "nodeb")):
+            caps.append({
+                "meta": {"kind": "worker", "worker_id": f"w{i}" * 4,
+                         "node_id": node},
+                "pid": 100 + i, "sample_hz": 100.0, "samples": 3,
+                "collapsed": "MainThread;f (m.py:1);g (m.py:9) 3",
+                "sample_events": [{"ts": 1.0, "thread": "MainThread",
+                                   "leaf": "g (m.py:9)"}],
+                "memory": {"ts": 1.1, "rss_bytes": 123},
+            })
+        caps.append({"error": "busy"})  # failed captures must be skipped
+        flame = merge_flamegraph(caps)
+        lines = flame.splitlines()
+        assert len(lines) == 2
+        assert any(ln.startswith("worker:w0w0w0w0@nodea;MainThread;")
+                   for ln in lines)
+        spans = [{"span_id": "s1", "trace_id": "t1", "name": "op",
+                  "kind": "client", "start_ts": 1.0, "end_ts": 1.5,
+                  "status": "OK", "attributes": {}}]
+        trace = merge_chrome_trace(caps, spans)
+        evs = trace["traceEvents"]
+        assert any(e.get("cat") == "span:client" for e in evs)
+        assert any(e.get("cat") == "sample" for e in evs)
+        assert any(e.get("ph") == "C" for e in evs)  # memory counter
+
+
+class TestClusterProfile:
+    def test_profile_rpc_round_trip(self, tmp_path, monkeypatch):
+        """Acceptance path: one profile_cluster() over a live multi-worker
+        cluster produces a merged chrome-trace (spans + samples) and a
+        fleet flamegraph; the guarded XLA leg reports its CPU degradation
+        marker; the per-node capture cap refuses and counts."""
+        from ray_tpu.util import state, tracing
+
+        h = _ClusterHarness(num_cpus=2)
+        try:
+            tracing.enable_tracing()
+
+            gate = str(tmp_path / "spin-gate")
+
+            @h.api.remote
+            class Spinner:
+                def spin(self, gate_path, max_s):
+                    """Spin until the gate file appears (deterministically
+                    busy for the whole capture window, however starved the
+                    box is), bounded by max_s."""
+                    import os as _os
+                    import time as _t
+
+                    deadline = _t.monotonic() + max_s
+                    while not _os.path.exists(gate_path) and \
+                            _t.monotonic() < deadline:
+                        sum(i * i for i in range(400))
+                    return True
+
+            a = Spinner.remote()
+            assert h.api.get(a.spin.remote(gate, 0.05), timeout=120)
+            fut = a.spin.remote(gate, 60.0)  # busy until gated below
+            res = state.profile_cluster(seconds=0.8,
+                                        out_dir=str(tmp_path / "prof"))
+            with open(gate, "w") as f:
+                f.write("done")
+            kinds = {c["meta"].get("kind") for c in res["captures"]}
+            assert "worker" in kinds, (kinds, res["errors"])
+            wcap = next(c for c in res["captures"]
+                        if c["meta"].get("kind") == "worker")
+            assert wcap["samples"] > 0
+            assert "spin" in wcap["collapsed"]
+            assert wcap["xla_trace"]["status"] == "skipped"  # CPU tier-1
+            # fleet flamegraph: per-process roots, summed counts
+            assert any(ln.startswith("worker:")
+                       for ln in res["flamegraph"].splitlines())
+            # merged chrome trace: sampling track present and file loads
+            evs = res["chrome_trace"]["traceEvents"]
+            assert any(e.get("cat") == "sample" for e in evs)
+            with open(res["paths"]["trace"]) as f:
+                assert json.load(f)["traceEvents"]
+            assert os.path.exists(res["paths"]["flamegraph"])
+            h.api.get(fut, timeout=60)
+
+            # per-worker stack + fleet stack + per-node device memory verbs
+            workers = state.list_workers()
+            st = state.get_stack(workers[0]["worker_id"])
+            assert "Thread" in st["stacks"]
+            sc = state.stack_cluster()
+            snode = next(iter(sc["nodes"].values()))
+            assert "stacks" in snode["daemon"]
+            assert snode["workers"] and all(
+                "stacks" in w for w in snode["workers"].values())
+            dm = state.device_memory()
+            node = next(iter(dm["nodes"].values()))
+            assert node["daemon"]["rss_bytes"] > 0
+
+            # guardrail: concurrency cap refuses + counts dropped captures
+            monkeypatch.setattr(get_config(),
+                                "profiler_max_concurrent_captures", 0)
+            refused = h.rt.profile_cluster(seconds=0.2)
+            assert not refused["captures"]
+            assert any("capture limit" in e
+                       for e in refused["errors"].values())
+            from ray_tpu.util import metrics
+
+            text = metrics.registry().export_prometheus()
+            assert 'profiler_dropped_captures{' \
+                   'reason="node_capture_limit"}' in text
+        finally:
+            tracing.disable_tracing()
+            h.close()
+
+    def test_worker_death_mid_capture_partial_results(self, tmp_path,
+                                                      monkeypatch):
+        """A worker dying mid-capture yields partial results + a flight
+        record — the RPC returns, never hangs."""
+        from ray_tpu.core import flight_recorder
+
+        monkeypatch.setattr(get_config(), "temp_dir", str(tmp_path))
+        # The rate limiter may otherwise suppress the capture-failure
+        # bundle when the kill triggers other records within 50 ms.
+        monkeypatch.setattr(flight_recorder, "MIN_INTERVAL_S", 0.0)
+        h = _ClusterHarness(num_cpus=1)
+        try:
+            @h.api.remote
+            class Holder:
+                def ping(self):
+                    return True
+
+            a = Holder.remote()
+            assert h.api.get(a.ping.remote(), timeout=120)
+
+            result = {}
+
+            def run_profile():
+                result["res"] = h.rt.profile_cluster(seconds=2.5)
+
+            t = threading.Thread(target=run_profile)
+            t.start()
+            time.sleep(0.8)  # capture is in flight on the worker
+            assert h.c.kill_workers() >= 1
+            t.join(timeout=30)
+            assert not t.is_alive(), "profile hung after worker death"
+            res = result["res"]
+            assert res["errors"], res
+            # the daemon's own capture still landed (partial results)
+            assert any(c["meta"].get("kind") == "daemon"
+                       for c in res["captures"])
+            recs = [r for r in flight_recorder.list_records()
+                    if r["kind"] == "profile_capture_failure"]
+            assert recs
+        finally:
+            h.close()
+
+
+class TestStraggler:
+    def test_report_ranks_and_attributes(self):
+        now = time.time()
+
+        def src(node, rank, step, sync_share):
+            return {"node_id": node, "ts": now, "stats": {str(rank): {
+                "deciles": [step] * 11, "median_step_s": step,
+                "mean_step_s": step, "steps": 50, "world_size": 3,
+                "sync_share": sync_share, "compute_share": 1 - sync_share,
+            }}}
+
+        sources = {
+            "a": src("hosta", 0, 0.010, 0.55),
+            "b": src("hostb", 1, 0.011, 0.50),
+            "c": src("hostc", 2, 0.031, 0.05),  # slow AND not waiting
+        }
+        rep = build_report(sources, threshold=1.15)
+        assert rep["fleet"]["workers"] == 3
+        assert rep["workers"][0]["rank"] == 2
+        assert rep["stragglers"][0]["cause"].startswith("compute-bound")
+        assert rep["lagging_host"] == "hostc"
+        assert rep["lagging_rank"] == 2
+        # stale sources fall out
+        sources["c"]["ts"] = now - 10_000
+        rep2 = build_report(sources, threshold=1.15)
+        assert rep2["lagging_host"] is None
+
+    def test_injected_slow_worker_flagged_by_host(self, wait_for):
+        """End to end: two train workers on two nodes report steps; the
+        injected slow one is flagged by rank AND host in the stragglers
+        report served off the head's streamed deciles."""
+        from ray_tpu.util import state
+
+        h = _ClusterHarness(num_nodes=2, num_cpus=1,
+                            node_ids=["hostfast", "hostslow"])
+        try:
+            @h.api.remote(num_cpus=1)
+            class TrainSim:
+                def run(self, rank, step_s, sync_frac):
+                    """Synchronous-DDP signature: the FAST worker spends
+                    most of its step waiting at the collective for the slow
+                    one; the slow worker barely waits at all."""
+                    import os as _os
+                    import time as _t
+
+                    from ray_tpu.train import session
+
+                    ctx = session.TrainContext(world_rank=rank,
+                                               world_size=2)
+                    session.set_context(ctx)
+                    try:
+                        for _ in range(8):
+                            _t.sleep(step_s)
+                            session.report({
+                                "loss": 1.0,
+                                "sync_time_s": step_s * sync_frac,
+                                "compute_time_s": step_s * (1 - sync_frac)})
+                    finally:
+                        session.set_context(None)
+                    return _os.environ.get("RTPU_NODE_ID", "")
+
+            # one 1-CPU actor per 1-CPU node: placement spreads them
+            fast, slow = TrainSim.remote(), TrainSim.remote()
+            hosts = h.api.get([fast.run.remote(0, 0.01, 0.6),
+                               slow.run.remote(1, 0.05, 0.05)],
+                              timeout=120)
+            assert sorted(hosts) == ["hostfast", "hostslow"]
+            slow_host = hosts[1]
+
+            def both_ranks():
+                ranks = set()
+                for row in h.rt.train_stats().get("sources", {}).values():
+                    ranks.update(int(r) for r in (row.get("stats") or {}))
+                return ranks if {0, 1} <= ranks else None
+
+            wait_for(both_ranks, timeout=30,
+                     desc="train stats from both ranks at the head")
+            rep = state.stragglers(threshold=1.5)
+            assert rep["lagging_rank"] == 1, rep
+            assert rep["lagging_host"] == slow_host, rep
+            assert rep["stragglers"][0]["cause"].startswith("compute-bound")
+        finally:
+            h.close()
+
+
+class TestSigusr2Dump:
+    def test_sigusr2_dumps_stacks_to_flight_record(self, tmp_path,
+                                                   monkeypatch, wait_for):
+        """Hung-worker last resort: SIGUSR2 makes a worker write its thread
+        stacks into a flight-recorder bundle that survives a later
+        SIGKILL."""
+        from ray_tpu.core import flight_recorder
+
+        # Workers inherit the env at fork; the test process's own config
+        # points the reader at the same tree.
+        monkeypatch.setenv("RTPU_TEMP_DIR", str(tmp_path))
+        monkeypatch.setattr(get_config(), "temp_dir", str(tmp_path))
+        h = _ClusterHarness(num_cpus=1)
+        try:
+            @h.api.remote
+            class P:
+                def pid(self):
+                    import os as _os
+
+                    return _os.getpid()
+
+            a = P.remote()
+            pid = h.api.get(a.pid.remote(), timeout=120)
+            os.kill(pid, signal.SIGUSR2)
+
+            def bundle():
+                for rec in flight_recorder.list_records():
+                    if rec["kind"] == "worker_stacks":
+                        b = flight_recorder.get_record(rec["name"])
+                        if b["extra"].get("pid") == pid:
+                            return b
+                return None
+
+            b = wait_for(bundle, timeout=15, desc="worker_stacks bundle")
+            assert "MainThread" in b["extra"]["stacks"]
+            # the worker survives the dump (it's a diagnostic, not a kill)
+            assert h.api.get(a.pid.remote(), timeout=60) == pid
+        finally:
+            h.close()
+
+
+class TestServeReplicaProfile:
+    def test_per_replica_capture(self):
+        from ray_tpu.serve.replica import ServeReplica
+        from ray_tpu.utils import serialization
+
+        rep = ServeReplica("profdep", "r1",
+                           serialization.serialize(lambda x: x * 2),
+                           serialization.serialize(((), {})))
+        cap = rep.profile(0.15)
+        assert not cap.get("error")
+        assert cap["meta"]["kind"] == "serve_replica"
+        assert cap["meta"]["deployment"] == "profdep"
+        assert cap["samples"] > 0
+
+
+class TestCli:
+    def test_profile_stack_stragglers_verbs(self, rt_start, tmp_path,
+                                            capsys):
+        from ray_tpu.scripts.cli import main
+
+        out = str(tmp_path / "prof")
+        assert main(["profile", "--seconds", "0.15", "--out", out]) == 0
+        printed = capsys.readouterr().out
+        assert "captured 1 process(es)" in printed
+        assert os.path.exists(os.path.join(out, "trace.json"))
+        assert os.path.exists(os.path.join(out, "flame.txt"))
+
+        assert main(["stack"]) == 0
+        assert "MainThread" in capsys.readouterr().out
+
+        assert main(["stragglers"]) == 0
+        assert "no train stats" in capsys.readouterr().out
+
+        assert main(["memory", "--device"]) == 0
+        assert "rss_bytes" in capsys.readouterr().out
+
+    def test_dashboard_profiler_endpoints(self, rt_start):
+        import urllib.request
+
+        from ray_tpu.dashboard.http_server import DashboardServer
+
+        srv = DashboardServer()
+        host, port = srv.start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://{host}:{port}{path}", timeout=30) as r:
+                    return json.loads(r.read())
+
+            res = get("/api/profile?seconds=0.15")
+            assert res["captures"] and res["flamegraph"]
+            st = get("/api/stack")  # no worker param -> fleet dump
+            node = next(iter(st["nodes"].values()))
+            assert "MainThread" in node["daemon"]["stacks"]
+            mem = get("/api/memory/device")
+            assert "nodes" in mem
+            rep = get("/api/stragglers")
+            assert rep["workers"] == []
+        finally:
+            srv.stop()
