@@ -1,0 +1,364 @@
+"""Recovery bench: kill workers/slices mid-step under the chaos layer and
+price every restart tier.
+
+Three scenarios on the 2-slice / 4-worker shape, one injected failure
+each (a worker process SIGKILL-dying mid-step, or a whole node daemon
+dropping dead), measured on a real multi-process cluster (subprocess
+workers, in-process head/daemon). The kill is armed from the driver once
+every rank passed the kill step — steady state, as production failures
+land — and delivered through the chaos control plane:
+
+- ``replica``   — replication on (session.replicate every step, sparse
+  backstop checkpoints) + a full warmed spare set: the fast-restart tier.
+  State comes back from the buddy slice's ReplicaStore through the object
+  plane; the group rebuilds by promoting the spares.
+- ``checkpoint`` — the reference behavior: no replication, no spares;
+  rank 0 write-behind-checkpoints every other step; the restart pays cold
+  worker forks + orbax restore.
+- ``elastic_shrink`` — a node daemon is chaos-killed, taking one slice's
+  capacity with it; the elastic policy resumes at half world size from
+  the latest checkpoint.
+
+Per scenario the bench reports (into PERF_RECOVERY.json):
+
+- ``detection_latency_s``  — chaos mark timestamp (written inside the dying
+  process the instant before os._exit) → the controller's restart decision.
+- ``ttfs_s``               — time-to-first-step-after-failure: mark → first
+  completed step reported by the restarted group.
+- ``steps_lost``           — steps re-executed: last step finished before
+  the failure minus the resume point.
+- the tier the controller actually chose (asserted per scenario), world
+  before/after, spares promoted.
+
+Acceptance: replica-tier ttfs at least 5x lower than checkpoint-tier ttfs
+on the same injected failure (``speedup_fast_vs_checkpoint``).
+
+Run: python devbench/recovery_bench.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_train_fn():
+    def train_fn(config):
+        import os as _os
+        import time as _time
+
+        import numpy as np
+
+        import orbax.checkpoint  # noqa: F401 - warm the import (seconds on
+        # this box) BEFORE the step loop, as a long-lived trainer would have
+
+        from ray_tpu.train import get_context, replicate, report
+        from ray_tpu.train.checkpoint import (
+            AsyncCheckpointWriter,
+            restore_pytree,
+        )
+
+        ctx = get_context()
+        rank = ctx.get_world_rank()
+        steps = config["steps"]
+        ckpt_every = config.get("ckpt_every", 0)
+        start, source = 0, "fresh"
+        w = np.zeros(config.get("state_elems", 4096), np.float32)
+        rs = ctx.get_replica_state()
+        if rs is not None:
+            start, w, source = rs.step + 1, rs.state["w"], "replica"
+        elif ctx.get_checkpoint():
+            tree = restore_pytree(ctx.get_checkpoint())
+            start = int(tree["step"]) + 1
+            w = np.asarray(tree["w"], np.float32)
+            source = "checkpoint"
+        writer = AsyncCheckpointWriter()  # write-behind: saves don't stall
+        for step in range(start, steps):
+            t0 = _time.time()
+            _time.sleep(config.get("step_s", 0.25))  # the "compute"
+            w = w + 1.0
+            replicate({"w": w, "step": step}, step)
+            ck = None
+            if rank == 0 and ckpt_every and step % ckpt_every == 0:
+                writer.save(
+                    {"w": w, "step": step},
+                    _os.path.join(ctx.storage_path,
+                                  f"ck_{step}_{ctx.restart_count}"),
+                    step=step)
+            if rank == 0:
+                done = writer.completed()
+                ck = done[-1] if done else None
+            report({"step": step, "rank": rank,
+                    "restart": ctx.restart_count, "source": source,
+                    "ts": _time.time(), "step_start_ts": t0}, checkpoint=ck)
+        if rank == 0:
+            writer.wait()
+            done = writer.completed()
+            if done:
+                report({"step": steps - 1, "rank": rank, "final_ck": True,
+                        "restart": ctx.restart_count, "source": source,
+                        "ts": _time.time()}, checkpoint=done[-1])
+        return float(w.sum())
+
+    return train_fn
+
+
+def _run_scenario(name: str, *, steps: int, kill_step: int,
+                  replicate_every: int, hot_spares: int, ckpt_every: int,
+                  daemon_kill: bool = False, step_s: float = 0.25,
+                  world: int = 4, num_slices: int = 2) -> dict:
+    """One failure drill on a fresh cluster; returns the measured row."""
+    import ray_tpu
+    from ray_tpu.chaos import injector
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.train import (
+        CheckpointConfig,
+        FailureConfig,
+        RunConfig,
+        ScalingConfig,
+    )
+    from ray_tpu.train.backend import JaxBackendConfig
+    from ray_tpu.train.controller import TrainController
+    from ray_tpu.utils import config as config_mod
+    from ray_tpu.utils.ids import JobID
+
+    marks = tempfile.mkdtemp(prefix=f"rtpu-chaos-{name}-")
+    injector.reset_for_tests()
+    os.environ["RTPU_HEALTH_CHECK_PERIOD_S"] = "0.5"
+    config_mod.set_config(config_mod.Config.load())
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    if daemon_kill:
+        # Worker placement pinned per node via a marker resource so the
+        # doomed node provably hosts one slice's workers.
+        cluster.add_node(num_cpus=8, resources={"trainslot": world / 2})
+        doomed = cluster.add_node(num_cpus=4, resources={"trainslot": world / 2},
+                                  node_id="benchdoomednode")
+    else:
+        cluster.add_node(num_cpus=8)
+    rt = cluster.connect()
+    old = (global_worker.runtime, global_worker.worker_id,
+           global_worker.node_id, global_worker.mode, global_worker.job_id)
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    killer = None
+    try:
+        try:
+            rt._daemon.call("prestart_workers", n=world + hot_spares +
+                            (num_slices if replicate_every else 0),
+                            timeout=10)
+        except Exception:
+            pass
+        storage = tempfile.mkdtemp(prefix=f"rtpu-recovery-{name}-")
+
+        def make_warmup():
+            def warmup():
+                # What a reserve slice pre-warms: the training stack (and,
+                # on real hardware, the compiled step program).
+                import numpy  # noqa: F401
+                import orbax.checkpoint  # noqa: F401
+
+                import ray_tpu.train  # noqa: F401
+                return True
+
+            return warmup
+
+        scaling = ScalingConfig(num_workers=world, hot_spares=hot_spares,
+                                hot_spare_warmup=make_warmup())
+        if daemon_kill:
+            scaling = ScalingConfig(
+                num_workers=world, min_workers=world // 2, max_workers=world,
+                hot_spares=hot_spares, hot_spare_warmup=make_warmup(),
+                resources_per_worker={"trainslot": 1.0, "CPU": 0.5})
+        ctl = TrainController(
+            _make_train_fn(),
+            {"steps": steps, "ckpt_every": ckpt_every, "step_s": step_s},
+            scaling,
+            RunConfig(name=f"recovery-{name}", storage_path=storage,
+                      failure_config=FailureConfig(max_failures=2),
+                      checkpoint_config=CheckpointConfig(
+                          replicate_every=replicate_every)),
+            JaxBackendConfig(num_slices=num_slices),
+        )
+        # Arm the kill from the DRIVER on observed progress: inject only
+        # once EVERY rank has reported kill_step (steady state — spares
+        # warmed, replication caught up), delivered through the chaos
+        # control plane (head → daemons → live workers, ~ms). This is what
+        # a production chaos drill does; worker-side at_step schedules stay
+        # covered by tests/test_chaos.py.
+        arm_info: dict = {"installs": 0}
+
+        def arm():
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                ranks_at = {m["rank"] for m in list(ctl.metrics_history)
+                            if m.get("step", -1) >= kill_step
+                            and m.get("restart") == 0}
+                if ranks_at >= set(range(world)):
+                    break
+                time.sleep(0.05)
+            arm_info["armed_ts"] = time.time()
+            if daemon_kill:
+                rule = {"point": "daemon.tick", "action": "kill",
+                        "match": {"node": "^benchdoomed"}, "mark": marks}
+            else:
+                # Kill ONE worker of slice 1 (rank world//2) mid-step.
+                rule = {"point": "train.step", "action": "kill",
+                        "match": {"rank": world // 2, "restart": 0},
+                        "mark": marks}
+            # Re-deliver until the mark proves the rule fired: on this
+            # contended 1-core box the install fan can lag behind a busy
+            # spare's GIL (the injector dedups repeated installs, so the
+            # firing budget stays single).
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not os.listdir(marks):
+                try:
+                    rt.chaos_cluster(rules=[rule])
+                    arm_info["installs"] += 1
+                except Exception as e:  # noqa: BLE001 - run already over
+                    arm_info["install_error"] = repr(e)
+                time.sleep(0.5)
+
+        killer = threading.Thread(target=arm)
+        killer.start()
+        t_run0 = time.time()
+        result = ctl.run()
+        wall = time.time() - t_run0
+        if killer is not None:
+            killer.join()
+        if not result.ok:
+            return {"scenario": name, "error": result.error[-2000:]}
+        if not result.restarts:
+            return {"scenario": name,
+                    "error": "no restart observed (injection missed?)",
+                    "arm_info": arm_info,
+                    "marks": sorted(os.listdir(marks))}
+        mark_files = sorted(os.listdir(marks))
+        inject_ts = min(json.load(open(os.path.join(marks, f)))["ts"]
+                        for f in mark_files) if mark_files else None
+        decision = result.restarts[0] if result.restarts else {}
+        before = [m for m in result.metrics_history if m["restart"] == 0]
+        after = [m for m in result.metrics_history if m["restart"] == 1]
+        first_after = min((m["ts"] for m in after), default=None)
+        resume_step = min((m["step"] for m in after), default=None)
+        last_before = max((m["step"] for m in before), default=None)
+        row = {
+            "scenario": name,
+            "tier": decision.get("tier"),
+            "trigger": decision.get("trigger"),
+            "world_before": decision.get("world_before"),
+            "world_after": decision.get("world_after"),
+            "restore_step": decision.get("restore_step"),
+            "spares_promoted": decision.get("spares_promoted"),
+            "detection_latency_s": (
+                round(decision["detected_ts"] - inject_ts, 3)
+                if inject_ts and decision else None),
+            "ttfs_s": (round(first_after - inject_ts, 3)
+                       if inject_ts and first_after else None),
+            "steps_lost": (last_before - resume_step + 1
+                           if None not in (last_before, resume_step)
+                           else None),
+            "resume_step": resume_step,
+            "resume_source": (after[0].get("source") if after else None),
+            "run_wall_s": round(wall, 2),
+        }
+        return row
+    finally:
+        try:
+            rt.shutdown()
+            cluster.shutdown()
+        except Exception:
+            pass
+        (global_worker.runtime, global_worker.worker_id,
+         global_worker.node_id, global_worker.mode,
+         global_worker.job_id) = old
+        os.environ.pop("RTPU_CHAOS", None)
+        os.environ.pop("RTPU_HEALTH_CHECK_PERIOD_S", None)
+        config_mod.set_config(config_mod.Config.load())
+        injector.reset_for_tests()
+        shutil.rmtree(marks, ignore_errors=True)
+
+
+def run_bench(quick: bool = False, out_path: str | None = None) -> dict:
+    # The kill lands several seconds into the run: failures in production
+    # hit steady state — spares long warmed, replication caught up — and on
+    # this 1-core box the spare warmup (orbax import) needs those seconds
+    # to stop competing with the train step for the core.
+    steps = 10 if quick else 14
+    kill_step = 5 if quick else 7
+    step_s = 0.4 if quick else 0.5
+    scenarios = {}
+    # The replica scenario keeps sparse backstop checkpoints (the
+    # production shape: checkpoint every minutes, replicate every step);
+    # the checkpoint scenario's denser cadence is its best case.
+    scenarios["replica"] = _run_scenario(
+        "replica", steps=steps, kill_step=kill_step, step_s=step_s,
+        replicate_every=1, hot_spares=4, ckpt_every=4)
+    scenarios["checkpoint"] = _run_scenario(
+        "checkpoint", steps=steps, kill_step=kill_step, step_s=step_s,
+        replicate_every=0, hot_spares=0, ckpt_every=2)
+    if not quick:
+        scenarios["elastic_shrink"] = _run_scenario(
+            "elastic_shrink", steps=steps, kill_step=kill_step,
+            step_s=step_s, replicate_every=0, hot_spares=0, ckpt_every=2,
+            daemon_kill=True)
+
+    fast = scenarios["replica"].get("ttfs_s")
+    slow = scenarios["checkpoint"].get("ttfs_s")
+    speedup = round(slow / fast, 2) if fast and slow else None
+    report = {
+        "bench": "recovery",
+        "quick": quick,
+        "scenarios": scenarios,
+        "speedup_fast_vs_checkpoint": speedup,
+        "meets_5x": bool(speedup and speedup >= 5.0),
+        "provenance": {
+            "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "cpus": os.cpu_count(),
+            "loadavg": list(os.getloadavg()),
+            "box_note": (
+                "single-host multi-process cluster on a 1-core CPU box: "
+                "checkpoint-tier ttfs is dominated by cold worker "
+                "fork+import (seconds each, serialized on one core) plus "
+                "orbax restore; replica-tier ttfs is spare promotion + an "
+                "object-plane shard fetch. On a TPU fleet the gap widens — "
+                "checkpoint restore adds storage I/O and re-compile, while "
+                "replica restore stays in-cluster and the hot spare holds "
+                "the compiled program."),
+        },
+    }
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PERF_RECOVERY.json")
+    # Same namespacing contract as the other PERF files: a quick dryrun
+    # refresh lands under "quick_refresh", never overwriting full-run
+    # provenance.
+    doc = report
+    if quick and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                existing = json.load(f)
+            if not existing.get("quick"):
+                existing["quick_refresh"] = report
+                doc = existing
+        except Exception:
+            pass
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return report
+
+
+if __name__ == "__main__":
+    rep = run_bench(quick="--quick" in sys.argv[1:])
+    print(json.dumps(rep, indent=2))

@@ -1,0 +1,19 @@
+"""Chaos engineering: declarative fault injection for tests, devbench, and
+live clusters (see :mod:`ray_tpu.chaos.injector` for the rule schema)."""
+
+from ray_tpu.chaos.injector import (
+    ChaosKilled,
+    ChaosRule,
+    clear,
+    decide,
+    fired,
+    install,
+    maybe_kill,
+    reset_for_tests,
+    status,
+)
+
+__all__ = [
+    "ChaosKilled", "ChaosRule", "clear", "decide", "fired", "install",
+    "maybe_kill", "reset_for_tests", "status",
+]

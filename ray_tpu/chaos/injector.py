@@ -1,0 +1,343 @@
+"""Fault injection: scheduled/predicated kills, RPC delays and drops.
+
+One injector per process, configured from the environment (``RTPU_CHAOS`` =
+JSON rule list, or ``RTPU_CHAOS_FILE`` = path to one), from config
+(``chaos_enabled`` gates everything), or programmatically/over RPC
+(``install``; the `chaos` CLI verb and ``ray_tpu.util.state.inject_chaos``
+fan rules to every daemon and worker in a live cluster). The same rule
+format drives unit tests, the recovery devbench, and live-cluster chaos
+drills (reference capability: the reference's chaos-testing utilities —
+RayletKiller / WorkerKillerActor in test_utils.py — generalized into a
+declarative, cluster-deliverable schedule).
+
+Rule schema (JSON object per rule; unknown keys are rejected)::
+
+    {"point": "train.step",          # where the probe sits (see below)
+     "action": "kill",               # kill | delay | drop | error
+     "match": {"rank": 1},           # predicate: all keys must match the
+                                     #  probe attrs; "method"/"node" values
+                                     #  are regexes, ints/strs are equality
+     "after_s": 2.0,                 # armed this long after install
+     "at_step": 3,                   # train.step only: fire when step == N
+     "prob": 1.0,                    # firing probability once matched
+     "count": 1,                     # max firings (-1 = unlimited)
+     "delay_s": 0.5,                 # delay action: added latency
+     "mode": "exit",                 # kill: "exit" (os._exit) | "raise"
+     "exit_code": 137,               # kill/exit: status to die with
+     "mark": "/tmp/chaos_marks"}     # dir: write a timestamped marker
+                                     #  just before applying (benches read
+                                     #  the injection instant from it)
+
+Probe points and their attrs:
+
+- ``train.step``  — every ``session.report()``; attrs ``rank``, ``slice``,
+  ``step``, ``restart``. Kill a worker (match rank) or a whole slice
+  (match slice) mid-step.
+- ``daemon.tick`` — the node daemon's heartbeat loop; attrs ``node``.
+  Kill takes the daemon down abruptly (no deregistration) together with
+  its worker processes — a node/slice death as the head sees one.
+- ``rpc.server`` — every inbound control/transfer-plane RPC dispatch;
+  attrs ``method``. ``delay`` sleeps before dispatch — inline on the
+  connection's read loop, so frames queued behind the matched one wait
+  too (TCP-stream delay semantics; scope the method regex with that in
+  mind — a broad delay can age out heartbeats sharing the connection).
+  ``drop`` swallows the request (the caller sees a timeout / hang,
+  exactly like a lost datagram to a wedged peer).
+
+Kills are real: ``mode="exit"`` calls ``os._exit`` so the process dies
+without cleanup (SIGKILL semantics). ``mode="raise"`` raises
+:class:`ChaosKilled` instead — for in-process runtimes where taking the
+whole interpreter down would kill the test too.
+
+This module must stay stdlib-only (plus utils.config, itself stdlib-only):
+it is imported from the RPC protocol layer of every process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+# Fast-path gate: protocol dispatch checks this module attribute before
+# paying for a decide() call. True only while at least one rule is
+# installed (and chaos is enabled).
+ACTIVE = False
+
+_ALLOWED_KEYS = {
+    "point", "action", "match", "after_s", "at_step", "prob", "count",
+    "delay_s", "mode", "exit_code", "mark",
+}
+_ACTIONS = ("kill", "delay", "drop", "error")
+_POINTS = ("train.step", "daemon.tick", "rpc.server")
+_REGEX_KEYS = ("method", "node")
+
+
+class ChaosKilled(BaseException):
+    """Raised by a kill rule with mode="raise" (BaseException so a broad
+    ``except Exception`` in the instrumented path can't swallow the
+    injected death)."""
+
+
+@dataclass
+class ChaosRule:
+    point: str
+    action: str = "kill"
+    match: dict[str, Any] = field(default_factory=dict)
+    after_s: float = 0.0
+    at_step: int | None = None
+    prob: float = 1.0
+    count: int = -1
+    delay_s: float = 0.1
+    mode: str = "exit"
+    exit_code: int = 137
+    mark: str | None = None
+    # runtime state
+    fired: int = 0
+    installed_ts: float = field(default_factory=time.monotonic)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosRule":
+        unknown = set(d) - _ALLOWED_KEYS
+        if unknown:
+            raise ValueError(f"unknown chaos rule keys: {sorted(unknown)}")
+        rule = cls(**{k: v for k, v in d.items()})
+        if rule.point not in _POINTS:
+            raise ValueError(
+                f"unknown chaos point {rule.point!r}; one of {_POINTS}")
+        if rule.action not in _ACTIONS:
+            raise ValueError(
+                f"unknown chaos action {rule.action!r}; one of {_ACTIONS}")
+        return rule
+
+    def to_dict(self) -> dict:
+        return {
+            "point": self.point, "action": self.action,
+            "match": dict(self.match), "after_s": self.after_s,
+            "at_step": self.at_step, "prob": self.prob, "count": self.count,
+            "delay_s": self.delay_s, "mode": self.mode,
+            "exit_code": self.exit_code, "mark": self.mark,
+            "fired": self.fired,
+        }
+
+    def matches(self, attrs: dict[str, Any]) -> bool:
+        if self.at_step is not None and attrs.get("step") != self.at_step:
+            return False
+        for key, want in (self.match or {}).items():
+            got = attrs.get(key)
+            if key in _REGEX_KEYS:
+                if got is None or not re.search(str(want), str(got)):
+                    return False
+            elif got != want:
+                return False
+        return True
+
+
+_lock = threading.Lock()
+_rules: list[ChaosRule] = []
+_fired: list[dict] = []
+_env_loaded = False
+_FIRED_TAIL = 200
+
+
+def _chaos_enabled() -> bool:
+    try:
+        from ray_tpu.utils.config import get_config
+
+        return bool(get_config().chaos_enabled)
+    except Exception:
+        return True
+
+
+def _refresh_active_locked() -> None:
+    global ACTIVE
+    ACTIVE = bool(_rules)
+
+
+def _ensure_env_loaded() -> None:
+    """Parse RTPU_CHAOS / RTPU_CHAOS_FILE once per process (workers inherit
+    the daemon's environment at fork, so an env schedule set before cluster
+    start reaches every process)."""
+    global _env_loaded
+    with _lock:
+        if _env_loaded:
+            return
+        _env_loaded = True
+        raw = os.environ.get("RTPU_CHAOS", "")
+        path = os.environ.get("RTPU_CHAOS_FILE", "")
+        if not raw and path:
+            try:
+                with open(path) as f:
+                    raw = f.read()
+            except OSError:
+                raw = ""
+        if not raw:
+            return
+        try:
+            parsed = json.loads(raw)
+        except json.JSONDecodeError:
+            return
+        for d in parsed if isinstance(parsed, list) else [parsed]:
+            try:
+                _rules.append(ChaosRule.from_dict(d))
+            except (ValueError, TypeError):
+                continue
+        _refresh_active_locked()
+
+
+def _rule_key(r: ChaosRule) -> tuple:
+    return (r.point, r.action, tuple(sorted((r.match or {}).items())),
+            r.after_s, r.at_step, r.prob, r.count, r.delay_s, r.mode,
+            r.exit_code, r.mark)
+
+
+def install(rules: list[dict | ChaosRule], replace: bool = False) -> int:
+    """Install rules into THIS process; returns the installed rule count.
+    ``replace=True`` drops existing rules first. Exact duplicates of an
+    installed rule that still has firing budget are skipped — the cluster
+    fan-out (head → daemon → worker) visits a co-hosted test cluster's
+    shared interpreter once per leg, and each leg must not multiply the
+    budget. An EXHAUSTED duplicate does not block: re-running the same
+    drill (`chaos kill-worker --rank 1` twice) arms a fresh firing, with
+    the spent rule dropped so status stays readable."""
+    _ensure_env_loaded()
+    parsed = [r if isinstance(r, ChaosRule) else ChaosRule.from_dict(r)
+              for r in rules or []]
+    with _lock:
+        if replace:
+            _rules.clear()
+        have = {_rule_key(r) for r in _rules
+                if r.count < 0 or r.fired < r.count}
+        for r in parsed:
+            if _rule_key(r) in have:
+                continue
+            have.add(_rule_key(r))
+            # Replace any exhausted twin instead of accumulating spent
+            # rules forever.
+            _rules[:] = [x for x in _rules if _rule_key(x) != _rule_key(r)]
+            _rules.append(r)
+        _refresh_active_locked()
+        return len(_rules)
+
+
+def clear() -> None:
+    global _env_loaded
+    with _lock:
+        _rules.clear()
+        _fired.clear()
+        # A clear also suppresses re-loading the env schedule: `chaos clear`
+        # must actually stop the chaos, even when RTPU_CHAOS is still set.
+        _env_loaded = True
+        _refresh_active_locked()
+
+
+def reset_for_tests() -> None:
+    """Full reset incl. the env-loaded latch (test isolation only)."""
+    global _env_loaded
+    with _lock:
+        _rules.clear()
+        _fired.clear()
+        _env_loaded = False
+        _refresh_active_locked()
+
+
+def status() -> dict:
+    _ensure_env_loaded()
+    with _lock:
+        return {
+            "pid": os.getpid(),
+            "active": ACTIVE,
+            "rules": [r.to_dict() for r in _rules],
+            "fired": list(_fired),
+        }
+
+
+def fired(point: str | None = None) -> list[dict]:
+    with _lock:
+        return [f for f in _fired if point is None or f["point"] == point]
+
+
+def decide(point: str, **attrs) -> ChaosRule | None:
+    """First armed, matching, non-exhausted rule for ``point`` — consuming
+    one firing from its budget — or None. Thread-safe."""
+    _ensure_env_loaded()
+    if not ACTIVE or not _chaos_enabled():
+        return None
+    now = time.monotonic()
+    with _lock:
+        for rule in _rules:
+            if rule.point != point:
+                continue
+            if rule.count >= 0 and rule.fired >= rule.count:
+                continue
+            if now - rule.installed_ts < rule.after_s:
+                continue
+            if not rule.matches(attrs):
+                continue
+            if rule.prob < 1.0 and random.random() >= rule.prob:
+                continue
+            rule.fired += 1
+            _fired.append({"point": point, "action": rule.action,
+                           "ts": time.time(), "attrs": dict(attrs)})
+            del _fired[:-_FIRED_TAIL]
+            return rule
+    return None
+
+
+def write_mark(rule: ChaosRule, point: str, attrs: dict) -> str | None:
+    """Timestamped marker file written at the injection instant (benches
+    measure detection latency from it). Never fails the injection."""
+    if not rule.mark:
+        return None
+    try:
+        os.makedirs(rule.mark, exist_ok=True)
+        path = os.path.join(
+            rule.mark, f"chaos-{point.replace('.', '_')}-{time.time_ns()}")
+        with open(path, "w") as f:
+            json.dump({"ts": time.time(), "point": point,
+                       "action": rule.action, "attrs": attrs}, f)
+        return path
+    except OSError:
+        return None
+
+
+def maybe_kill(point: str, **attrs) -> None:
+    """Apply a matching kill/error rule at a code-point inside the target
+    process: exit hard (``mode="exit"``), or raise :class:`ChaosKilled` /
+    RuntimeError for in-process targets."""
+    rule = decide(point, **attrs)
+    if rule is None:
+        return
+    write_mark(rule, point, attrs)
+    if rule.action == "error":
+        raise RuntimeError(f"chaos: injected error at {point} ({attrs})")
+    if rule.action != "kill":
+        return  # delay/drop make no sense at a kill probe; ignore
+    if rule.mode == "raise":
+        raise ChaosKilled(f"chaos: injected kill at {point} ({attrs})")
+    os._exit(rule.exit_code)
+
+
+def rpc_server_action(method: str) -> tuple[str, float] | None:
+    """rpc.server probe: returns ("drop", 0) / ("delay", seconds) or None.
+    The dispatch loop applies the action (it owns the event loop)."""
+    rule = decide("rpc.server", method=method)
+    if rule is None:
+        return None
+    write_mark(rule, "rpc.server", {"method": method})
+    if rule.action == "drop":
+        return ("drop", 0.0)
+    if rule.action == "delay":
+        return ("delay", max(0.0, float(rule.delay_s)))
+    return None
+
+
+# Load any env-provided schedule NOW: every probe site guards on the ACTIVE
+# module flag before calling in, so the flag must be correct from import —
+# a lazy-only load would leave an env schedule invisible forever.
+_ensure_env_loaded()

@@ -176,6 +176,7 @@ class HeadServer:
         r("get_telemetry", self._get_telemetry)
         r("get_spans", self._get_spans)
         r("profile_cluster", self._profile_cluster)
+        r("chaos", self._chaos_cluster)
         r("stack_cluster", self._stack_cluster)
         r("device_memory", self._device_memory)
         r("get_train_stats", self._get_train_stats)
@@ -462,6 +463,27 @@ class HeadServer:
             info = self.nodes.get(node_id)
             if info:
                 info.last_heartbeat = -1e18  # force failure at next check
+                # Failure-detection fast path: a dead daemon process closes
+                # its sockets immediately, so after a short grace (absorbing
+                # reconnect blips) declare the node dead NOW instead of
+                # waiting out heartbeat aging — cuts node-death detection
+                # from up to health_check_period_s * threshold to the grace.
+                grace = get_config().node_disconnect_grace_s
+                if grace >= 0:
+                    spawn_task(self._confirm_node_death(node_id, conn, grace))
+
+    async def _confirm_node_death(self, node_id: str,
+                                  conn: ServerConnection,
+                                  grace: float) -> None:
+        await asyncio.sleep(grace)
+        info = self.nodes.get(node_id)
+        if (
+            info is None or not info.alive
+            or self._node_conns.get(node_id) is not conn
+            or info.last_heartbeat > 0  # re-registered / heartbeat landed
+        ):
+            return
+        await self._declare_node_dead(node_id)
 
     # ------------------------------------------------------------------ nodes
     async def _register_node(
@@ -542,11 +564,20 @@ class HeadServer:
             threshold = cfg.health_check_period_s * cfg.health_check_failure_threshold
             for node in list(self.nodes.values()):
                 if node.alive and now - node.last_heartbeat > threshold:
-                    node.alive = False
-                    self._drop_daemon_client(node.node_id)
-                    self._membership_version += 1
-                    await self.publish("node_events", event="died", node_id=node.node_id)
-                    await self._fail_actors_on_node(node.node_id)
+                    await self._declare_node_dead(node.node_id)
+
+    async def _declare_node_dead(self, node_id: str) -> None:
+        """The ONE node-death sequence (heartbeat aging and the disconnect
+        fast path both land here): flip alive, drop the cached daemon
+        client, bump membership, publish, fail the node's actors."""
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        self._drop_daemon_client(node_id)
+        self._membership_version += 1
+        await self.publish("node_events", event="died", node_id=node_id)
+        await self._fail_actors_on_node(node_id)
 
     async def _fail_actors_on_node(self, node_id: str):
         for actor in list(self.actors.values()):
@@ -1152,6 +1183,26 @@ class HeadServer:
             errors.update(res.get("errors") or {})
         return {"captures": captures, "errors": errors,
                 "spans": list(self.spans)[-20_000:]}
+
+    async def _chaos_cluster(self, conn: ServerConnection, rules=None,
+                             clear: bool = False):
+        """Chaos plane: fan fault-injection rules (or a clear) to every
+        alive daemon, which installs locally and fans to its workers. The
+        head itself also installs — rpc.server rules can target head RPCs
+        (lease/heartbeat delay drills)."""
+        from ray_tpu.chaos import injector
+
+        if clear:
+            injector.clear()
+        if rules:
+            injector.install(rules, replace=False)
+        nodes = {}
+        errors: dict[str, str] = {}
+        for nid, res in await self._fan_to_daemons(
+                "chaos_node", 30.0, rules=rules, clear=clear):
+            nodes[nid] = res
+            errors.update((res or {}).get("errors") or {})
+        return {"head": injector.status(), "nodes": nodes, "errors": errors}
 
     async def _stack_cluster(self, conn: ServerConnection):
         nodes = {}

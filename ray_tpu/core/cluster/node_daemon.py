@@ -127,6 +127,10 @@ class NodeDaemon:
         # via the worker_fate RPC to turn a dropped connection into a
         # typed error, e.g. OutOfMemoryError). Bounded.
         self._worker_fates: "OrderedDict[str, dict]" = OrderedDict()
+        # actor_failed notifications that couldn't reach the head (it was
+        # down/reconnecting when the death was detected); redelivered by
+        # the heartbeat loop once the head answers again.
+        self._failed_actor_notify: list[tuple[str, str]] = []
         self._head: AsyncRpcClient | None = None
         self._leases: dict[str, WorkerProc] = {}
         self._actor_workers: dict[str, WorkerProc] = {}
@@ -195,6 +199,8 @@ class NodeDaemon:
         r("profile_node", self._profile_node)
         r("stack_node", self._stack_node)
         r("memory_node", self._memory_node)
+        # Chaos plane (head -> here -> workers): install/clear fault rules.
+        r("chaos_node", self._chaos_node)
 
     async def _prestart_workers(self, conn, n: int = 0):
         """Warm the worker pool ahead of demand (reference:
@@ -282,6 +288,8 @@ class NodeDaemon:
         loop = asyncio.get_running_loop()
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._reap_loop()))
+        if get_config().worker_death_poll_s > 0:
+            self._bg.append(loop.create_task(self._death_watch_loop()))
         self._bg.append(loop.create_task(self._gossip_loop()))
         self._bg.append(loop.create_task(self._telemetry_loop()))
         if get_config().memory_monitor_interval_s > 0:
@@ -410,39 +418,85 @@ class NodeDaemon:
                     w.proc.terminate()
                     del self.workers[wid]
                 if w.proc is not None and w.proc.poll() is not None:
-                    # Worker process died.
-                    self.workers.pop(wid, None)
-                    if w.lease_id or w.actor_id:
-                        from ray_tpu.core import flight_recorder
+                    await self._on_worker_exit(wid, w)
 
-                        fate = self._worker_fates.get(w.worker_id) or {}
-                        flight_recorder.record(
-                            "worker_death",
-                            reason=(f"oom-killed rss={fate.get('rss', 0)}"
-                                    if fate.get("oom") else
-                                    f"exit code {w.proc.returncode}"),
-                            actor_id=w.actor_id or "",
-                            node_id=self.node_id,
-                            extra={"worker_id": w.worker_id})
-                        self._release_resources(w.resources)
-                        # Drop the lease record too: a later return_lease for
-                        # it must not release the resources a second time.
-                        if w.lease_id:
-                            self._leases.pop(w.lease_id, None)
-                            w.lease_id = None
-                            w.resources = {}
-                    if w.actor_id and self._head:
-                        fate = self._worker_fates.get(w.worker_id) or {}
-                        reason = (
-                            f"worker OOM-killed by the node memory monitor "
-                            f"(rss {fate.get('rss', 0)} of node limit "
-                            f"{fate.get('limit', 0)} bytes)"
-                            if fate.get("oom") else
-                            f"worker process exited with {w.proc.returncode}")
-                        await self._head.call(
-                            "actor_failed", actor_id=w.actor_id,
-                            reason=reason,
-                        )
+    async def _on_worker_exit(self, wid: str, w: WorkerProc) -> None:
+        """A registered worker's process is gone: record its fate, release
+        its resources/lease, and tell the head its actor died. Shared by
+        the reap loop and the fast death watcher (idempotent-by-pop: only
+        the caller that removes the entry runs the handling)."""
+        if self.workers.pop(wid, None) is None:
+            return
+        if w.lease_id or w.actor_id:
+            from ray_tpu.core import flight_recorder
+
+            fate = self._worker_fates.get(w.worker_id) or {}
+            flight_recorder.record(
+                "worker_death",
+                reason=(f"oom-killed rss={fate.get('rss', 0)}"
+                        if fate.get("oom") else
+                        f"exit code {w.proc.returncode}"),
+                actor_id=w.actor_id or "",
+                node_id=self.node_id,
+                extra={"worker_id": w.worker_id})
+            self._release_resources(w.resources)
+            # Drop the lease record too: a later return_lease for
+            # it must not release the resources a second time.
+            if w.lease_id:
+                self._leases.pop(w.lease_id, None)
+                w.lease_id = None
+                w.resources = {}
+        if w.actor_id and self._head:
+            fate = self._worker_fates.get(w.worker_id) or {}
+            reason = (
+                f"worker OOM-killed by the node memory monitor "
+                f"(rss {fate.get('rss', 0)} of node limit "
+                f"{fate.get('limit', 0)} bytes)"
+                if fate.get("oom") else
+                f"worker process exited with {w.proc.returncode}")
+            await self._notify_actor_failed(w.actor_id, reason)
+
+    async def _notify_actor_failed(self, actor_id: str, reason: str) -> None:
+        """actor_failed to the head, with redelivery: the worker entry is
+        already popped when this runs, so a failed RPC (head mid-reconnect)
+        would otherwise lose the death forever — the owner's recovery would
+        then burn its full poll deadline instead of failing fast. Failed
+        notifications queue and the heartbeat loop re-sends them after its
+        reconnect."""
+        try:
+            await self._head.call("actor_failed", actor_id=actor_id,
+                                  reason=reason, timeout=10)
+        except Exception:  # noqa: BLE001 - head down/reconnecting
+            self._failed_actor_notify.append((actor_id, reason))
+            del self._failed_actor_notify[:-100]
+
+    async def _drain_actor_failures(self) -> None:
+        """Redeliver queued actor_failed notifications (heartbeat loop,
+        right after a successful heartbeat proved the head reachable)."""
+        pending, self._failed_actor_notify = self._failed_actor_notify, []
+        for actor_id, reason in pending:
+            await self._notify_actor_failed(actor_id, reason)
+
+    async def _death_watch_loop(self):
+        """Failure-detection fast path: a waitpid(WNOHANG) sweep over the
+        LEASED/actor-hosting workers every ``worker_death_poll_s``. The
+        reap loop's idle-TTL cadence (worker_idle_ttl_s/4) leaves a killed
+        train worker undetected for up to 15 s — this loop bounds
+        worker-death detection (and therefore the train controller's
+        restart trigger) at a quarter second for the cost of a few
+        syscalls per tick."""
+        poll_s = max(0.05, get_config().worker_death_poll_s)
+        while True:
+            await asyncio.sleep(poll_s)
+            for wid, w in list(self.workers.items()):
+                if (
+                    w.proc is not None and (w.lease_id or w.actor_id)
+                    and w.proc.poll() is not None
+                ):
+                    try:
+                        await self._on_worker_exit(wid, w)
+                    except Exception:  # noqa: BLE001 - head unreachable
+                        pass  # heartbeat loop reconnects; reap loop retries
 
     # ------------------------------------------------------------- memory
     # Node memory defense (reference: _private/memory_monitor.py:97 polls
@@ -812,9 +866,60 @@ class NodeDaemon:
             except Exception:
                 pass  # head unreachable: heartbeat loop handles reconnects
 
+    async def _chaos_node(self, conn, rules=None, clear=False):
+        """Chaos plane leg: install/clear fault rules in this daemon and
+        fan them to every live worker on the node (mirrors profile_node)."""
+        from ray_tpu.chaos import injector
+
+        if clear:
+            injector.clear()
+        if rules:
+            injector.install(rules, replace=False)
+        workers, errors = await self._fan_workers(
+            "chaos_install", rules=rules, clear=clear)
+        return {"node_id": self.node_id, "daemon": injector.status(),
+                "workers": sorted(workers), "errors": errors}
+
+    async def _chaos_die(self) -> None:
+        """Abrupt daemon death (chaos daemon.tick kill): SIGKILL every
+        worker process, then drop off the network without deregistering —
+        the head must discover the loss through its own detection path
+        (disconnect fast path / heartbeat aging), exactly as it would a
+        real node crash. Works for in-process daemons (tests/devbench,
+        where os._exit would take the whole interpreter down) and real
+        daemon processes alike."""
+        import signal as _signal
+
+        for w in list(self.workers.values()) + list(self._unregistered):
+            if w.proc is not None and w.proc.poll() is None:
+                try:
+                    w.proc.send_signal(_signal.SIGKILL)
+                except OSError:
+                    pass
+        for t in self._bg:
+            if t is not asyncio.current_task():
+                t.cancel()
+        try:
+            await self._head.close()
+        except Exception:
+            pass
+        try:
+            await self.rpc.stop()
+        except Exception:
+            pass
+
     async def _heartbeat_loop(self):
+        from ray_tpu.chaos import injector as _chaos
+
         cfg = get_config()
         while True:
+            if _chaos.ACTIVE:
+                rule = _chaos.decide("daemon.tick", node=self.node_id)
+                if rule is not None and rule.action == "kill":
+                    _chaos.write_mark(rule, "daemon.tick",
+                                      {"node": self.node_id})
+                    await self._chaos_die()
+                    return
             try:
                 res = await self._head.call(
                     "heartbeat", node_id=self.node_id,
@@ -840,6 +945,8 @@ class NodeDaemon:
                     for nid in list(self._gossip_view):
                         if nid not in self._gossip_peers:
                             self._gossip_view.pop(nid, None)
+                if self._failed_actor_notify:
+                    await self._drain_actor_failures()
             except Exception:
                 # Head down/restarted: reconnect and re-register so a
                 # restarted control plane rebuilds its node view (reference:

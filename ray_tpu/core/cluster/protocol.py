@@ -22,6 +22,8 @@ from typing import Any, Awaitable, Callable
 
 import msgpack
 
+from ray_tpu.chaos import injector as _chaos
+
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
 
@@ -224,6 +226,23 @@ class ServerConnection:
             msg = await _read_frame(self.reader)
             if msg is None:
                 return
+            if _chaos.ACTIVE:
+                # Fault-injection probe (rpc.server): a matching rule drops
+                # the request on the floor (caller sees a hang/timeout —
+                # lost-datagram semantics) or delays its dispatch. Delay is
+                # DELIBERATELY inline: frames queued behind the matched one
+                # on this connection wait too, which is what real network
+                # delay does to a TCP stream — and dispatching delayed
+                # frames out of band would reorder actor calls (mailbox
+                # FIFO = frame order). Scope delay rules' method regexes
+                # accordingly: heartbeats sharing the connection stall with
+                # it. The module-flag guard keeps the disarmed hot path at
+                # one attribute read per frame.
+                act = _chaos.rpc_server_action(msg.get("m"))
+                if act is not None:
+                    if act[0] == "drop":
+                        continue
+                    await asyncio.sleep(act[1])
             fn = raw.get(msg.get("m")) if raw else None
             if fn is not None:
                 # Inline fast dispatch: enqueue-to-executor is non-blocking,

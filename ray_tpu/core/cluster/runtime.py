@@ -152,11 +152,18 @@ class _KeyState:
         self.spread_idx = 0        # SPREAD round-robin cursor
 
 
+_SENT_CALL_LOST = (
+    "actor restarted; this call was in flight on the dead incarnation and "
+    "may have executed there (actor calls are at-most-once)")
+
+
 class _ActorState:
     """Per-actor submitter (reference: actor_task_submitter.cc). FIFO
-    dispatch over one pipelined connection; failed in-flight calls gather in
-    ``retrying`` and are re-queued in seq order after the actor restarts.
-    Loop-thread-only."""
+    dispatch over one pipelined connection. Failed in-flight calls gather
+    in ``retrying`` while recovery runs, then FAIL with ActorDiedError —
+    they were sent to the dead incarnation and may have executed there
+    (at-most-once; see _actor_recover). Only never-sent ``pending`` calls
+    flow to a restarted incarnation. Loop-thread-only."""
 
     __slots__ = ("actor_id", "client", "addr", "pending", "inflight",
                  "resolving", "window", "retrying", "recovering")
@@ -308,6 +315,7 @@ class ClusterRuntime:
         # resolve any row of the head's worker directory.
         self.server.register("dump_stack", self._handle_dump_stack)
         self.server.register("memory_snapshot", self._handle_memory_snapshot)
+        self.server.register("chaos_install", self._handle_chaos_install)
         self.addr = self._io.run(self.server.start())
         # Workers learn their node from the forking daemon's env; a DRIVER
         # asks its attached daemon — without this, objects the driver holds
@@ -454,6 +462,19 @@ class ClusterRuntime:
         """The head's straggler table (per-rank step-time summaries)."""
         return self.head.call("get_train_stats")
 
+    # ---------------------------------------------------------------- chaos
+    def chaos_cluster(self, rules=None, clear: bool = False) -> dict:
+        """Install/clear fault-injection rules fleet-wide (head -> every
+        daemon -> every worker); also installs in THIS process so driver-
+        side probes (e.g. its rpc.server) see the same schedule."""
+        from ray_tpu.chaos import injector
+
+        if clear:
+            injector.clear()
+        if rules:
+            injector.install(rules, replace=False)
+        return self.head.call("chaos", rules=rules, clear=clear, timeout=60)
+
     # ------------------------------------------------------------------ serving
     async def _handle_ping(self, conn, **kw):
         return {"ok": True, "worker_id": self.worker_id.hex()}
@@ -472,6 +493,18 @@ class ClusterRuntime:
         snap["worker_id"] = self.worker_id.hex()
         snap["node_id"] = self.my_node_id
         return snap
+
+    async def _handle_chaos_install(self, conn, rules=None,
+                                    clear: bool = False, **kw):
+        from ray_tpu.chaos import injector
+
+        if clear:
+            injector.clear()
+        if rules:
+            injector.install(rules, replace=False)
+        st = injector.status()
+        st["worker_id"] = self.worker_id.hex()
+        return st
 
     # Relay-distribution knobs (reference: push_manager bounds concurrent
     # chunk sends; here the owner bounds outstanding referrals per copy).
@@ -2524,13 +2557,16 @@ class ClusterRuntime:
                 self._store_error_local(
                     item.return_ids,
                     ActorDiedError(st.actor_id, "worker connection lost"))
+            elif st.client is not None:
+                # A sibling already recovered onto a NEW incarnation: this
+                # call was sent to the dead one and may have executed
+                # there — at-most-once, it must fail, not replay.
+                self._store_error_local(
+                    item.return_ids, ActorDiedError(
+                        st.actor_id, _SENT_CALL_LOST))
             else:
                 st.retrying.append(item)
-                if st.client is not None:
-                    # A sibling already recovered the connection: merge this
-                    # straggler straight back in order.
-                    self._merge_retrying(st)
-                elif not st.recovering:
+                if not st.recovering:
                     st.recovering = True
                     spawn_task(self._actor_recover(st, st.addr))
         except Exception as e:  # noqa: BLE001
@@ -2544,8 +2580,10 @@ class ClusterRuntime:
                          item: _TaskItem, fut) -> None:
         """Completion callback of one fast-path actor call (loop thread).
         Failure handling mirrors _actor_push: connection loss tears down
-        the client once, failed items gather in ``retrying`` and re-queue
-        in seq order after recovery."""
+        the client once; failed items gather in ``retrying`` while recovery
+        runs and FAIL with ActorDiedError once the incarnation is known to
+        have changed (at-most-once — the call may have executed on the dead
+        incarnation)."""
         try:
             try:
                 if fut.cancelled():
@@ -2573,10 +2611,12 @@ class ClusterRuntime:
                         item.return_ids,
                         ActorDiedError(st.actor_id, "worker connection lost"))
                 elif st.client is not None:
-                    # A sibling already recovered the connection: merge this
-                    # straggler straight back in order.
-                    st.retrying.append(item)
-                    self._merge_retrying(st)
+                    # A sibling already recovered onto a NEW incarnation:
+                    # this call was sent to the dead one and may have
+                    # executed there — at-most-once, it must fail here.
+                    self._store_error_local(
+                        item.return_ids, ActorDiedError(
+                            st.actor_id, _SENT_CALL_LOST))
                 else:
                     st.retrying.append(item)
                     if not st.recovering:
@@ -2590,9 +2630,15 @@ class ClusterRuntime:
             self._actor_pump(st)
 
     async def _actor_recover(self, st: _ActorState, old_addr) -> None:
-        """Wait for a new incarnation, then merge failed calls back into the
-        queue in sequence order (reference: actor_task_submitter resends the
-        out-of-order set ordered by sequence number after restart)."""
+        """Wait for a new incarnation. Calls that were already SENT to the
+        dead incarnation (``st.retrying``) fail with ActorDiedError — they
+        may have executed before the crash, and replaying a side-effectful
+        call into the restarted actor breaks at-most-once semantics
+        (observed as a crash-inducing call killing every incarnation in
+        turn once failure detection got fast). Queued-but-never-sent calls
+        (``st.pending``) flow to the new incarnation (reference:
+        actor_task_submitter resubmits only tasks the dead incarnation
+        never received; in-flight ones fail under max_task_retries=0)."""
         aid = st.actor_id
         try:
             deadline = time.monotonic() + 10.0
@@ -2612,20 +2658,16 @@ class ClusterRuntime:
                 await asyncio.sleep(0.1)
             else:
                 raise ActorDiedError(aid, "worker connection lost")
-            self._merge_retrying(st)
+            for item in st.retrying:
+                self._store_error_local(
+                    item.return_ids,
+                    ActorDiedError(aid, _SENT_CALL_LOST))
+            st.retrying = []
             st.recovering = False
             self._actor_pump(st)
         except ActorDiedError as e:
             st.recovering = False
             self._fail_actor_queue(st, e)
-
-    def _merge_retrying(self, st: _ActorState) -> None:
-        """Re-queue failed calls sorted by sequence number ahead of (and
-        merged with) anything already pending — program order survives any
-        interleaving of failure notifications."""
-        st.pending = deque(sorted(
-            st.retrying + list(st.pending), key=lambda it: it.spec.seq_no))
-        st.retrying = []
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self.head.call("kill_actor", actor_id=actor_id.hex(), no_restart=no_restart)

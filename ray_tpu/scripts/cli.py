@@ -229,6 +229,86 @@ def cmd_stack(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Fault injection (chaos drills): install kill/delay/drop rules
+    fleet-wide, clear them, or show the current schedule + firing log.
+    The same rule schema drives tests, devbench, and live clusters
+    (ray_tpu/chaos/injector.py documents it)."""
+    _connect(args.address)
+    from ray_tpu.util.state import inject_chaos
+
+    if args.verb == "status":
+        print(json.dumps(inject_chaos(), indent=2, default=str))
+        return 0
+    if args.verb == "clear":
+        res = inject_chaos(clear=True)
+        print(f"cleared chaos rules on "
+              f"{1 + len(res.get('nodes', {}))} target group(s)")
+        return 0
+
+    rules: list[dict] = []
+    if args.file:
+        with open(args.file) as f:
+            loaded = json.load(f)
+        rules.extend(loaded if isinstance(loaded, list) else [loaded])
+    if args.rules:
+        loaded = json.loads(args.rules)
+        rules.extend(loaded if isinstance(loaded, list) else [loaded])
+    common = {}
+    if args.after is not None:
+        common["after_s"] = args.after
+    if args.count is not None:
+        common["count"] = args.count
+    if args.prob is not None:
+        common["prob"] = args.prob
+    if args.at_step is not None:
+        common["at_step"] = args.at_step
+    # Each targeted verb REQUIRES its selector: a None selector would
+    # install a rule that can never match, printing success while the
+    # drill silently does nothing.
+    def _need(value, flag):
+        if value is None:
+            print(f"chaos {args.verb} requires {flag}", file=sys.stderr)
+            raise SystemExit(2)
+        return value
+
+    if args.verb == "kill-worker":
+        rules.append({"point": "train.step", "action": "kill",
+                      "match": {"rank": _need(args.rank, "--rank")},
+                      **common})
+    elif args.verb == "kill-slice":
+        rules.append({"point": "train.step", "action": "kill",
+                      "match": {"slice": _need(args.slice, "--slice")},
+                      **common})
+    elif args.verb == "kill-daemon":
+        rules.append({"point": "daemon.tick", "action": "kill",
+                      "match": {"node": _need(args.node, "--node")},
+                      **common})
+    elif args.verb == "rpc":
+        action = "drop" if args.drop else "delay"
+        rule = {"point": "rpc.server", "action": action,
+                "match": {"method": _need(args.method, "--method")},
+                **common}
+        if not args.drop:
+            rule["delay_s"] = args.delay_s
+        rules.append(rule)
+    elif args.verb != "install":
+        print(f"unknown chaos verb {args.verb!r}", file=sys.stderr)
+        return 2
+    if not rules:
+        print("no rules to install (use --file/--rules or a kill-*/rpc "
+              "verb)", file=sys.stderr)
+        return 2
+    res = inject_chaos(rules=rules)
+    nodes = res.get("nodes", {})
+    workers = sum(len((n or {}).get("workers", ())) for n in nodes.values())
+    errors = res.get("errors", {})
+    print(f"installed {len(rules)} rule(s) on {len(nodes)} node(s), "
+          f"{workers} worker(s)"
+          + (f"; {len(errors)} error(s): {errors}" if errors else ""))
+    return 0
+
+
 def cmd_stragglers(args) -> int:
     """Straggler report: workers ranked by step time vs the fleet, lagging
     host named."""
@@ -288,6 +368,31 @@ def main(argv: list[str] | None = None) -> int:
     strag = sub.add_parser("stragglers")
     strag.add_argument("--threshold", type=float, default=1.15)
     strag.add_argument("--json", action="store_true")
+    ch = sub.add_parser(
+        "chaos", help="fault injection: kill workers/slices/daemons, "
+                      "delay/drop RPCs (see ray_tpu/chaos/injector.py)")
+    ch.add_argument("verb", choices=["status", "clear", "install",
+                                     "kill-worker", "kill-slice",
+                                     "kill-daemon", "rpc"])
+    ch.add_argument("--file", default=None, help="JSON rule file")
+    ch.add_argument("--rules", default=None, help="inline JSON rule list")
+    ch.add_argument("--rank", type=int, default=None,
+                    help="kill-worker: world rank to kill")
+    ch.add_argument("--slice", type=int, default=None,
+                    help="kill-slice: slice id to kill")
+    ch.add_argument("--node", default=None,
+                    help="kill-daemon: node id regex")
+    ch.add_argument("--method", default=None,
+                    help="rpc: RPC method regex to delay/drop")
+    ch.add_argument("--delay-s", type=float, default=0.1, dest="delay_s")
+    ch.add_argument("--drop", action="store_true",
+                    help="rpc: drop matching requests instead of delaying")
+    ch.add_argument("--at-step", type=int, default=None, dest="at_step")
+    ch.add_argument("--after", type=float, default=None,
+                    help="arm the rule this many seconds after install")
+    ch.add_argument("--count", type=int, default=1,
+                    help="max firings (-1 = unlimited; default 1)")
+    ch.add_argument("--prob", type=float, default=None)
 
     from ray_tpu.scripts.start import add_parsers as _add_start_parsers
 
@@ -299,7 +404,8 @@ def main(argv: list[str] | None = None) -> int:
     cmds = {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
             "timeline": cmd_timeline, "logs": cmd_logs, "memory": cmd_memory,
             "flight-records": cmd_flight_records, "profile": cmd_profile,
-            "stack": cmd_stack, "stragglers": cmd_stragglers}
+            "stack": cmd_stack, "stragglers": cmd_stragglers,
+            "chaos": cmd_chaos}
     return cmds[args.command](args)
 
 

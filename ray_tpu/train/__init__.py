@@ -4,6 +4,7 @@ ray.train v2 — controller actor + worker group + JAX backend + checkpoints).
 
 from ray_tpu.train.backend import JaxBackendConfig
 from ray_tpu.train.checkpoint import (
+    AsyncCheckpointWriter,
     Checkpoint,
     CheckpointManager,
     restore_pytree,
@@ -16,7 +17,13 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.controller import Result, TrainController
-from ray_tpu.train.session import get_context, get_dataset_shard, report
+from ray_tpu.train.replica import ReplicaState, ReplicaStore
+from ray_tpu.train.session import (
+    get_context,
+    get_dataset_shard,
+    replicate,
+    report,
+)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 
 __all__ = [
@@ -24,6 +31,7 @@ __all__ = [
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "JaxBackendConfig", "get_context", "get_dataset_shard", "report",
     "Checkpoint", "CheckpointManager", "save_pytree", "restore_pytree",
+    "AsyncCheckpointWriter", "replicate", "ReplicaState", "ReplicaStore",
 ]
 
 # usage telemetry (local-only, opt-out — reference: usage_lib auto-records

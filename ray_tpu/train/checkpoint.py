@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any
@@ -65,6 +66,75 @@ def restore_pytree(directory: str, template: Any = None) -> Any:
         )
         return ckptr.restore(target, abstract)
     return ckptr.restore(target)
+
+
+class AsyncCheckpointWriter:
+    """Write-behind checkpointing: ``save()`` snapshots the pytree to host
+    memory inline (donation-safe — the train step may reuse those buffers
+    immediately) and runs the actual orbax write on a background thread, so
+    periodic checkpoints stop stalling the step. The NEXT ``save()`` (or
+    ``wait()``) barriers on the previous write, which keeps writes ordered
+    and bounds dirty state to one checkpoint.
+
+    Completion contract: a checkpoint directory is durable only once its
+    write finished (``save_pytree`` writes ``rtpu_meta.json`` last, so a
+    meta-less directory is detectably partial). Report directories returned
+    by :meth:`completed` — not the one just queued — to the controller, so
+    CheckpointManager registration/retention stays ordered behind the
+    writes themselves::
+
+        writer = AsyncCheckpointWriter()
+        writer.save(state, ckpt_dir, step=step)      # returns immediately
+        for d in writer.completed():                 # previous finished
+            report({"step": step}, checkpoint=d)
+        ...
+        writer.wait()                                # end of run: drain
+
+    Multi-host runs with partially addressable arrays must keep the
+    synchronous ``save_pytree`` (orbax coordinates the shard writes across
+    processes; a host-local snapshot can't)."""
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._exc: BaseException | None = None
+        self._done: list[str] = []
+        self._lock = threading.Lock()
+
+    def save(self, tree: Any, directory: str, step: int | None = None) -> str:
+        self.wait()  # barrier on (and surface errors from) the previous write
+        host_tree = jax.tree.map(
+            lambda x: jax.device_get(x) if hasattr(x, "shape") else x, tree)
+
+        def work():
+            try:
+                save_pytree(host_tree, directory, step=step)
+                with self._lock:
+                    self._done.append(directory)
+            except BaseException as e:  # noqa: BLE001 - re-raised at barrier
+                self._exc = e
+
+        self._thread = threading.Thread(target=work, daemon=True,
+                                        name="ckpt-write-behind")
+        self._thread.start()
+        return directory
+
+    def wait(self, timeout: float | None = None) -> None:
+        """Barrier on the in-flight write; re-raises its error, if any."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if not t.is_alive():
+                self._thread = None
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def completed(self) -> list[str]:
+        """Directories whose writes finished since the last call (in write
+        order) — the ones safe to report/register."""
+        with self._lock:
+            out, self._done = self._done, []
+        return out
 
 
 class CheckpointManager:

@@ -9,6 +9,7 @@ shapes from ray.air/ray.train).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Any
 
 
 @dataclass
@@ -24,6 +25,20 @@ class ScalingConfig:
     # feasible world size in [min_workers, max_workers].
     min_workers: int | None = None
     max_workers: int | None = None
+    # Hot spares: reserve TrainWorker actors the controller keeps pre-warmed
+    # (process booted, framework/jax imported) OUTSIDE the group. On a
+    # worker/slice failure the next group promotes them instead of paying
+    # cold fork+import — the dominant cost of a restart when state comes
+    # from in-cluster replicas rather than a checkpoint. On TPU fleets this
+    # is the reserve-slice pattern: spares sized to one slice make a
+    # whole-slice loss recoverable at full world size.
+    hot_spares: int = 0
+    # Optional callable run once inside every hot spare right after it
+    # boots (via exec_fn): import the training stack, build the mesh,
+    # compile the step — whatever makes promotion instant. Without it a
+    # promoted spare still skips the fork+framework-import cost but pays
+    # the train_fn's own first-use imports/compiles on its first step.
+    hot_spare_warmup: Any = None
 
     def worker_resources(self) -> dict[str, float]:
         res = dict(self.resources_per_worker)
@@ -43,6 +58,13 @@ class FailureConfig:
 class CheckpointConfig:
     num_to_keep: int | None = None
     checkpoint_frequency: int = 0
+    # In-cluster replication cadence: every N steps session.replicate()
+    # actually pushes the worker's state shards to its buddy slice's
+    # ReplicaStore (train/replica.py). 0 disables replication — restarts
+    # then always restore from the latest checkpoint. With it on, the
+    # controller prefers the replica fast-restart tier whenever surviving
+    # stores cover every rank at a step >= the newest checkpoint.
+    replicate_every: int = 0
 
 
 @dataclass

@@ -5,6 +5,16 @@ python/ray/train/v2/_internal/execution/controller/controller.py:105 — async
 control loop `run` :634, one iteration :612: poll worker group → scaling
 decision → failure decision; FailurePolicy restart-from-latest-checkpoint;
 runs as an actor so driver death doesn't kill training).
+
+Recovery tiers (beyond the reference): on a worker/slice failure the
+controller first tries a **fast restart** — rebuild the group from
+pre-warmed hot spares (SparePool) and restore state from in-cluster
+replica shards (train/replica.py) pushed by session.replicate() — and only
+falls back to the orbax checkpoint when replicas don't cover the new world.
+Every restart decision (tier, trigger, detection latency, world change) is
+recorded as a flight-recorder bundle (kind ``train_restart``) and counted
+in ``train_restarts_total{run,tier}`` so post-mortems read one artifact,
+not log archaeology.
 """
 
 from __future__ import annotations
@@ -17,7 +27,8 @@ from typing import Any, Callable
 from ray_tpu.train.backend import JaxBackendConfig, free_port
 from ray_tpu.train.checkpoint import CheckpointManager
 from ray_tpu.train.config import RunConfig, ScalingConfig
-from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.train.replica import ReplicaManager
+from ray_tpu.train.worker_group import SparePool, WorkerGroup
 
 
 import threading as _threading
@@ -39,7 +50,9 @@ def _controller_metrics():
         _metrics = {
             "restarts": Counter(
                 "train_restarts_total",
-                "worker-group restarts after failures", tag_keys=("run",)),
+                "worker-group restarts after failures, by recovery tier "
+                "(replica | checkpoint | elastic_shrink)",
+                tag_keys=("run", "tier")),
             "failures": Counter(
                 "train_worker_failures_total",
                 "train workers that reported an error", tag_keys=("run",)),
@@ -56,10 +69,33 @@ class Result:
     checkpoint: Any = None
     error: str | None = None
     metrics_history: list[dict] = field(default_factory=list)
+    # One entry per worker-group restart: the recorded restart decision
+    # (tier, trigger, detection latency, world change — same dict as the
+    # train_restart flight-recorder bundle).
+    restarts: list[dict] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
         return self.error is None
+
+
+class _GroupFailure(RuntimeError):
+    """A poll observed the group failing; carries attribution for the
+    restart decision record."""
+
+    def __init__(self, trigger: str, message: str,
+                 dead: dict[int, str] | None = None,
+                 errors: dict[int, str] | None = None,
+                 since_last_ok_s: float | None = None):
+        super().__init__(message)
+        self.trigger = trigger
+        self.dead = dict(dead or {})
+        self.errors = dict(errors or {})
+        self.since_last_ok_s = since_last_ok_s
+        # Stamped at OBSERVATION: the tier decision (replica settle window,
+        # manifest RPCs) happens after this, and detection latency must not
+        # include it.
+        self.detected_ts = time.time()
 
 
 class TrainController:
@@ -82,6 +118,7 @@ class TrainController:
             num_to_keep=run_config.checkpoint_config.num_to_keep,
         )
         self.metrics_history: list[dict] = []
+        self.restart_log: list[dict] = []
         self._status = "PENDING"
         self._callbacks = list(run_config.callbacks)
         self._run_name = name
@@ -105,10 +142,100 @@ class TrainController:
     def status(self) -> str:
         return self._status
 
+    def get_restart_log(self) -> list[dict]:
+        return list(self.restart_log)
+
+    # ------------------------------------------------------------- tiers
+    def _choose_tier(self, world: int,
+                     prev_world: int | None) -> tuple[str, int | None]:
+        """Restore tier for the NEXT group after a failure:
+
+        - ``replica``: surviving ReplicaStores cover every rank of the new
+          world at a step at least as new as the latest checkpoint — restore
+          in-cluster, skip storage entirely.
+        - ``elastic_shrink``: capacity loss forced a smaller world; replica
+          shards are world-shaped, so the resharded resume goes through the
+          checkpoint (orbax reshards on load).
+        - ``checkpoint``: replicas are gone (buddy slice lost too) or
+          replication is off — the reference behavior.
+        """
+        best = None
+        if self._replicas.enabled:
+            # The writers push asynchronously: a failure can race the final
+            # shard of an otherwise complete step set by milliseconds. Give
+            # the plane a short settle window before falling back to the
+            # (much slower) checkpoint tier.
+            deadline = time.monotonic() + 2.0
+            while True:
+                try:
+                    best = self._replicas.best_restore(world)
+                except Exception:  # noqa: BLE001 - replica plane down
+                    best = None
+                    break
+                if best is not None or time.monotonic() >= deadline:
+                    break
+                time.sleep(0.2)
+        latest = self.ckpt_manager.latest()
+        ck_step = None
+        if latest is not None:
+            ck_step = latest.metadata().get("step")
+        if best is not None and (ck_step is None or best["step"] >= ck_step):
+            return "replica", best["step"]
+        if prev_world is not None and world < prev_world:
+            return "elastic_shrink", None
+        return "checkpoint", None
+
+    def _record_restart(self, failure: _GroupFailure | None, tier: str,
+                        restart_index: int, world_before: int | None,
+                        world_after: int, restore_step: int | None,
+                        spares_taken: int) -> None:
+        from ray_tpu.core import flight_recorder
+
+        latest = self.ckpt_manager.latest()
+        decision = {
+            "run": self._run_name,
+            "restart_index": restart_index,
+            "tier": tier,
+            "trigger": getattr(failure, "trigger", "controller_error"),
+            "detected_ts": getattr(failure, "detected_ts", time.time()),
+            "detection_latency_s": getattr(failure, "since_last_ok_s", None),
+            "dead_ranks": sorted(getattr(failure, "dead", {})),
+            "error_ranks": sorted(getattr(failure, "errors", {})),
+            "world_before": world_before,
+            "world_after": world_after,
+            "restore_step": restore_step,
+            "checkpoint": latest.path if latest else None,
+            "spares_promoted": spares_taken,
+        }
+        if self._replicas.enabled:
+            try:
+                decision["replica_coverage"] = self._replicas.manifests()
+            except Exception:  # noqa: BLE001
+                pass
+        # Straggler breadcrumb (PR-5 signals): the fleet's per-rank step
+        # stats at decision time — a slice that was flagged lagging before
+        # it died turns a mystery restart into a diagnosis.
+        try:
+            from ray_tpu.core.worker import global_worker
+
+            rt = global_worker.runtime
+            if rt is not None and hasattr(rt, "train_stats"):
+                decision["straggler_stats"] = rt.train_stats()
+        except Exception:  # noqa: BLE001
+            pass
+        self.restart_log.append(decision)
+        self._m_restarts.inc(tags={"run": self._run_name, "tier": tier})
+        flight_recorder.record(
+            "train_restart", reason=decision["trigger"],
+            extra=decision)
+
+    # --------------------------------------------------------------- run
     def run(self) -> Result:
         """The control loop (reference: controller.py:634). Each (re)start
         consults the scaling policy — elastic configs resume at a smaller
-        world size after capacity loss (reference: elastic.py:29)."""
+        world size after capacity loss (reference: elastic.py:29) — then
+        picks a restore tier (_choose_tier) and builds the group from hot
+        spares where available."""
         from ray_tpu.train.scaling_policy import make_scaling_policy
 
         self._status = "RUNNING"
@@ -116,56 +243,126 @@ class TrainController:
         max_failures = self.run_config.failure_config.max_failures
         policy = make_scaling_policy(self.scaling,
                                      getattr(self, "_resources_fn", None))
+        num_slices = max(1, getattr(self.backend_config, "num_slices", 1))
+        rep_every = int(getattr(self.run_config.checkpoint_config,
+                                "replicate_every", 0) or 0)
+        self._replicas = ReplicaManager(self._run_name, num_slices,
+                                        enabled=rep_every > 0)
+        try:
+            self._replicas.create()
+        except Exception:  # noqa: BLE001 - no replica plane: checkpoint tier
+            self._replicas.enabled = False
+            rep_every = 0
+        self._spares = SparePool(self.scaling, self._run_name,
+                                 self.ckpt_manager.storage_path,
+                                 getattr(self.scaling, "hot_spares", 0),
+                                 warmup=getattr(self.scaling,
+                                                "hot_spare_warmup", None))
         restart_count = 0
-        while True:
-            group = None
-            try:
-                world = policy.decide_world_size(restart_count)
-                self._m_world.set(world, tags={"run": self._run_name})
-                group = WorkerGroup(
-                    self.scaling, self.run_config.name or "train",
-                    self.ckpt_manager.storage_path, num_workers=world,
-                )
-                coordinator = f"127.0.0.1:{free_port()}" \
-                    if self.backend_config.distributed else None
-                latest = self.ckpt_manager.latest()
-                group.setup(coordinator, restart_count,
-                            latest.path if latest else None,
-                            num_slices=getattr(self.backend_config,
-                                               "num_slices", 1))
-                self.backend_config.make_backend().on_start(group, coordinator)
-                if self.datasets:
-                    # Split per (re)start so elastic world-size changes get
-                    # fresh equal splits (reference: datasets= are
-                    # streaming_split across the current worker group).
-                    splits = {name: ds.streaming_split(world, equal=True)
-                              for name, ds in self.datasets.items()}
-                    group.assign_dataset_shards([
-                        {name: its[rank] for name, its in splits.items()}
-                        for rank in range(world)])
-                group.run(self.train_fn, self.train_loop_config)
-                result = self._poll_until_done(group)
-                self._status = "FINISHED" if result.ok else "ERRORED"
-                self._cb("on_run_end", result)
-                return result
-            except Exception:  # noqa: BLE001 - worker/actor failures
-                restart_count += 1
-                self._m_restarts.inc(tags={"run": self._run_name})
-                if max_failures >= 0 and restart_count > max_failures:
-                    self._status = "ERRORED"
-                    result = Result(error=traceback.format_exc(),
-                                    checkpoint=self.ckpt_manager.latest(),
-                                    metrics_history=self.metrics_history)
+        prev_world: int | None = None
+        pending_failure: _GroupFailure | None = None
+        try:
+            while True:
+                group = None
+                try:
+                    world = policy.decide_world_size(restart_count)
+                    tier, restore_step = (None, None)
+                    recycled: list = []
+                    if restart_count > 0:
+                        tier, restore_step = self._choose_tier(world,
+                                                               prev_world)
+                        recycled = self._spares.take(world)
+                        self._record_restart(
+                            pending_failure, tier, restart_count,
+                            prev_world, world, restore_step, len(recycled))
+                        pending_failure = None
+                    self._m_world.set(world, tags={"run": self._run_name})
+                    group = WorkerGroup(
+                        self.scaling, self.run_config.name or "train",
+                        self.ckpt_manager.storage_path, num_workers=world,
+                        recycled=recycled,
+                    )
+                    prev_world = world
+                    coordinator = f"127.0.0.1:{free_port()}" \
+                        if self.backend_config.distributed else None
+                    latest = self.ckpt_manager.latest()
+                    replica_info = None
+                    if rep_every > 0 or restore_step is not None:
+                        replica_info = {
+                            "run": self._run_name, "every": rep_every,
+                            "num_slices": num_slices,
+                            "restore_step": restore_step,
+                        }
+                    group.setup(coordinator, restart_count,
+                                latest.path if latest else None,
+                                num_slices=getattr(self.backend_config,
+                                                   "num_slices", 1),
+                                replica=replica_info)
+                    self.backend_config.make_backend().on_start(group,
+                                                                coordinator)
+                    if self.datasets:
+                        # Split per (re)start so elastic world-size changes
+                        # get fresh equal splits (reference: datasets= are
+                        # streaming_split across the current worker group).
+                        splits = {name: ds.streaming_split(world, equal=True)
+                                  for name, ds in self.datasets.items()}
+                        group.assign_dataset_shards([
+                            {name: its[rank] for name, its in splits.items()}
+                            for rank in range(world)])
+                    group.run(self.train_fn, self.train_loop_config)
+                    # Replenish the spare pool only once the group is up:
+                    # the run's own workers always get capacity first.
+                    self._spares.fill()
+                    failures_left = (float("inf") if max_failures < 0
+                                     else max_failures - restart_count)
+                    result = self._poll_until_done(group, failures_left)
+                    self._status = "FINISHED" if result.ok else "ERRORED"
+                    result.restarts = list(self.restart_log)
                     self._cb("on_run_end", result)
                     return result
-                # else: loop → new worker group restored from latest checkpoint
-            finally:
-                if group is not None:
-                    group.shutdown()
+                except Exception as e:  # noqa: BLE001 - worker/actor failures
+                    restart_count += 1
+                    if isinstance(e, _GroupFailure):
+                        pending_failure = e
+                    else:
+                        pending_failure = _GroupFailure(
+                            "controller_error", str(e))
+                    # The single failure budget: restart_count consumes it on
+                    # EVERY path (poll-observed failures raise _GroupFailure
+                    # with budget > 0 left; setup/backend errors land here
+                    # directly) — max_failures means the same thing
+                    # everywhere.
+                    if max_failures >= 0 and restart_count > max_failures:
+                        self._record_restart(
+                            pending_failure, "abort", restart_count,
+                            prev_world, 0, None, 0)
+                        self._status = "ERRORED"
+                        result = Result(
+                            error=traceback.format_exc(),
+                            checkpoint=self.ckpt_manager.latest(),
+                            metrics_history=self.metrics_history,
+                            restarts=list(self.restart_log))
+                        self._cb("on_run_end", result)
+                        return result
+                    # else: loop → new worker group, tier chosen at the top
+                finally:
+                    if group is not None:
+                        group.shutdown()
+        finally:
+            self._spares.shutdown()
+            try:
+                self._replicas.drop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._replicas.shutdown()
 
-    def _poll_until_done(self, group: WorkerGroup) -> Result:
-        max_failures = self.run_config.failure_config.max_failures
-        failures_left = float("inf") if max_failures < 0 else max_failures
+    def _poll_until_done(self, group: WorkerGroup,
+                         failures_left: float) -> Result:
+        """Poll loop; ``failures_left`` is the REMAINING restart budget
+        (max_failures minus restarts already consumed), so whether a
+        failure triggers a restart or ends the run is decided by the same
+        counter run() enforces."""
+        last_ok = time.monotonic()
         while True:
             status = group.poll_status(timeout=60)
             for rep in status.reports:
@@ -176,18 +373,28 @@ class TrainController:
                 if rep.get("checkpoint") and rep.get("rank", 0) == 0:
                     self.ckpt_manager.register(rep["checkpoint"], rep["metrics"])
                     self._cb("on_checkpoint", rep["checkpoint"], rep["metrics"])
-            if status.errors:
-                self._m_failures.inc(len(status.errors),
-                                     tags={"run": self._run_name})
-                err = "\n".join(f"rank {r}: {e}"
-                                for r, e in status.errors.items())
+            if status.errors or status.dead:
+                n = len(status.errors) + len(status.dead)
+                self._m_failures.inc(n, tags={"run": self._run_name})
+                parts = [f"rank {r}: {e}"
+                         for r, e in sorted(status.errors.items())]
+                parts += [f"rank {r} died: {e}"
+                          for r, e in sorted(status.dead.items())]
+                err = "\n".join(parts)
+                trigger = "worker_dead" if status.dead else "worker_error"
                 if failures_left > 0:
-                    raise RuntimeError(f"worker failure (will restart): {err}")
+                    raise _GroupFailure(
+                        trigger, f"worker failure (will restart): {err}",
+                        dead=status.dead, errors=status.errors,
+                        since_last_ok_s=time.monotonic() - last_ok)
                 return Result(error=err, checkpoint=self.ckpt_manager.latest(),
-                              metrics_history=self.metrics_history)
+                              metrics_history=self.metrics_history,
+                              restarts=list(self.restart_log))
+            last_ok = time.monotonic()
             if status.finished:
                 last = self.metrics_history[-1] if self.metrics_history else {}
                 return Result(metrics=last,
                               checkpoint=self.ckpt_manager.latest(),
-                              metrics_history=self.metrics_history)
+                              metrics_history=self.metrics_history,
+                              restarts=list(self.restart_log))
             time.sleep(0.05)

@@ -1,0 +1,417 @@
+"""In-cluster state replication: checkpoint-light recovery for training.
+
+The ZeRO-1 sharded update (train/spmd.py) already leaves each data-parallel
+worker holding a 1/N shard of the optimizer state, and every step's DCN
+all-gather moves exactly those shards across slices anyway — so keeping a
+*replica* of each slice's shards on a buddy slice costs one more hop of the
+same payload through the zero-copy object plane, not a storage round trip.
+This module is that replica plane:
+
+- :class:`ReplicaStore` — a small actor (one per slice, named
+  ``_rtpu_replica:<run>:<idx>``) holding the latest K step-stamped shard
+  sets pushed to it. Workers of slice ``s`` push to store ``(s+1) % S`` so
+  the death of any single slice (workers *and* the store it hosts) leaves
+  every shard recoverable from the surviving stores.
+- :class:`ReplicaWriter` — the worker-side pusher ``session.replicate()``
+  uses: snapshots the state to host memory inline (donation-safe) and
+  ships it from a background thread so the train step never stalls on the
+  push; disables itself after repeated failures (replication must never
+  become the thing that kills a healthy run).
+- :class:`ReplicaManager` — the controller-side view: creates the stores,
+  asks them for their manifests, and answers "what is the newest step
+  fully covered by surviving replicas for this world size?" — the
+  fast-restart tier's eligibility check.
+
+On a real multi-slice fleet the stores would be pinned to their slice's
+hosts via node-affinity scheduling; in the single-host test/devbench
+clusters placement is wherever the scheduler puts them — the failure
+semantics (store is a separate process from the workers it protects) are
+identical.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any
+
+STORE_PREFIX = "_rtpu_replica"
+
+
+def store_name(run: str, idx: int) -> str:
+    return f"{STORE_PREFIX}:{run}:{idx}"
+
+
+def slice_of(rank: int, world_size: int, num_slices: int) -> int:
+    per_slice = max(1, world_size // max(1, num_slices))
+    return min(max(0, rank // per_slice), max(1, num_slices) - 1)
+
+
+def buddy_store_idx(rank: int, world_size: int, num_slices: int) -> int:
+    """The store a rank pushes its shards to: the NEXT slice's store, so a
+    whole-slice loss (workers + co-located store) never takes a shard and
+    its only replica down together. Single-slice runs use store 0 — it
+    still survives worker death, just not whole-node loss."""
+    s = max(1, num_slices)
+    return (slice_of(rank, world_size, s) + 1) % s
+
+
+def host_snapshot(tree: Any) -> Any:
+    """Host-memory (numpy) snapshot of a (possibly jax) pytree, taken
+    inline so a donated buffer can't be reused mid-serialization. Fully
+    addressable leaves become plain ndarrays; partially addressable ones
+    (true multi-host shardings) become a list of ``(index, ndarray)`` pairs
+    covering this process's shards — exactly the 1/N this worker owns under
+    ZeRO-1, which is what the buddy store needs from it."""
+    import jax
+    import numpy as np
+
+    def one(x):
+        if hasattr(x, "addressable_shards") and \
+                not getattr(x, "is_fully_addressable", True):
+            return [(s.index, np.asarray(s.data))
+                    for s in x.addressable_shards]
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return np.asarray(x)
+        return x
+
+    return jax.tree.map(one, tree)
+
+
+@dataclass
+class ReplicaState:
+    """What get_replica_state() hands the train_fn on a fast restart."""
+    step: int
+    state: Any
+
+
+class ReplicaStore:
+    """Holds step-stamped state shards for one buddy position of one run.
+    Plain dict-of-bytes actor: the object plane already moved the payload
+    zero-copy as the actor-call argument; keeping bytes (not live arrays)
+    makes the store's memory bounded and its restarts trivial."""
+
+    def __init__(self, run: str = "", keep: int | None = None):
+        from ray_tpu.utils.config import get_config
+
+        self.run = run
+        self.keep = int(keep if keep is not None
+                        else get_config().train_replica_keep)
+        self._shards: dict[int, dict[int, bytes]] = {}  # step -> rank -> blob
+        self._meta: dict[int, dict] = {}  # step -> world_size/num_slices/ts
+        self._pinned: int | None = None
+
+    def put_shard(self, step: int, rank: int, blob: bytes,
+                  world_size: int, num_slices: int) -> bool:
+        step = int(step)
+        self._shards.setdefault(step, {})[int(rank)] = bytes(blob)
+        self._meta[step] = {"world_size": int(world_size),
+                            "num_slices": int(num_slices),
+                            "ts": time.time()}
+        steps = sorted(self._shards)
+        for old in steps[:-self.keep]:
+            if old == self._pinned:
+                continue
+            self._shards.pop(old, None)
+            self._meta.pop(old, None)
+        return True
+
+    def pin(self, step: int | None) -> bool:
+        """Exempt ``step`` from retention pruning: the controller pins its
+        chosen restore step before launching the new group, so a straggler
+        push from the dying group (which advances the newest-steps window)
+        can't evict the state the restart is about to read. A new pin (or
+        None) releases the previous one."""
+        self._pinned = int(step) if step is not None else None
+        return True
+
+    def manifest(self) -> dict:
+        """step -> {"ranks": [...], "world_size": w, "num_slices": s} for
+        every retained step (the controller unions manifests across stores
+        to find the newest fully covered step)."""
+        return {
+            step: {"ranks": sorted(ranks), **self._meta.get(step, {})}
+            for step, ranks in self._shards.items()
+        }
+
+    def get_shard(self, step: int, rank: int) -> bytes | None:
+        return self._shards.get(int(step), {}).get(int(rank))
+
+    def drop_run(self) -> bool:
+        self._shards.clear()
+        self._meta.clear()
+        return True
+
+    def stats(self) -> dict:
+        return {
+            "run": self.run,
+            "steps": sorted(self._shards),
+            "bytes": sum(len(b) for ranks in self._shards.values()
+                         for b in ranks.values()),
+        }
+
+
+class ReplicaWriter:
+    """Worker-side background shard pusher (one per TrainContext, created
+    lazily by session.replicate()). Keeps at most one queued snapshot —
+    newest wins; replication is a recovery optimization, not a log."""
+
+    MAX_CONSECUTIVE_FAILURES = 3
+    # Store-actor bring-up can lag the first train steps (it is being
+    # scheduled while the workers already run): resolution/push failures
+    # inside this window retry with backoff instead of counting toward
+    # the disable budget.
+    STARTUP_GRACE_S = 60.0
+    RETRY_BACKOFF_S = 0.5
+
+    def __init__(self, run: str, rank: int, world_size: int,
+                 num_slices: int):
+        self.run = run
+        self.rank = rank
+        self.world_size = world_size
+        self.num_slices = max(1, num_slices)
+        self.store = store_name(run, buddy_store_idx(rank, world_size,
+                                                     num_slices))
+        self._handle = None
+        self._cond = threading.Condition()
+        self._queued: tuple[int, bytes] | None = None
+        self._inflight = False
+        self._failures = 0
+        self._disabled = False
+        self._started = time.monotonic()
+        self._pushed_steps: list[int] = []
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"replica-push-{rank}")
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop the push thread (idempotent). Called when the hosting
+        context is torn down — a recycled hot spare must not strand one
+        parked writer thread per restart."""
+        with self._cond:
+            self._disabled = True
+            self._queued = ("__closed__", b"")
+            self._cond.notify_all()
+
+    def put(self, state: Any, step: int) -> bool:
+        """Snapshot ``state`` to host memory NOW (safe against donation)
+        and queue it for push; returns False when the writer has disabled
+        itself after repeated push failures (or was closed)."""
+        if self._disabled:
+            return False
+        blob = pickle.dumps(
+            {"step": int(step), "state": host_snapshot(state)},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        with self._cond:
+            self._queued = (int(step), blob)
+            self._cond.notify()
+        return True
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Wait until nothing is queued or in flight (tests/benches)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while (self._queued is not None or self._inflight) and \
+                    not self._disabled:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return not self._disabled
+
+    def pushed_steps(self) -> list[int]:
+        with self._cond:
+            return list(self._pushed_steps)
+
+    def _resolve(self):
+        if self._handle is None:
+            import ray_tpu
+
+            self._handle = ray_tpu.get_actor(self.store)
+        return self._handle
+
+    def _run(self) -> None:
+        import ray_tpu
+        from ray_tpu.utils.config import get_config
+
+        timeout = get_config().train_replica_push_timeout_s
+        while True:
+            with self._cond:
+                while self._queued is None and not self._disabled:
+                    self._cond.wait()
+                if self._disabled:
+                    return
+                step, blob = self._queued
+                self._queued = None
+                self._inflight = True
+            try:
+                h = self._resolve()
+                ray_tpu.get([h.put_shard.remote(
+                    step, self.rank, blob, self.world_size,
+                    self.num_slices)], timeout=timeout)
+                self._failures = 0
+                with self._cond:
+                    self._pushed_steps.append(step)
+                    del self._pushed_steps[:-64]
+            except Exception:  # noqa: BLE001 - push failure must not kill
+                self._handle = None  # store may have moved/died: re-resolve
+                in_grace = (time.monotonic() - self._started
+                            < self.STARTUP_GRACE_S)
+                if not in_grace:
+                    self._failures += 1
+                    if self._failures >= self.MAX_CONSECUTIVE_FAILURES:
+                        self._disabled = True
+                with self._cond:
+                    # Re-queue this snapshot unless a newer one landed: an
+                    # early step's shard must not vanish just because the
+                    # store registered a beat later.
+                    if self._queued is None and not self._disabled:
+                        self._queued = (step, blob)
+                time.sleep(self.RETRY_BACKOFF_S)
+            finally:
+                with self._cond:
+                    self._inflight = False
+                    self._cond.notify_all()
+                if self._disabled:
+                    return
+
+
+def fetch_replica_state(replica: dict, rank: int,
+                        world_size: int) -> ReplicaState | None:
+    """Worker-side restore: pull this rank's shard for the controller-chosen
+    restore step from the store that holds it. Returns None when the shard
+    is gone (the controller re-validates coverage before choosing the
+    replica tier, so this is a late-loss race, not the common path)."""
+    import ray_tpu
+
+    step = replica.get("restore_step")
+    if step is None:
+        return None
+    run = replica["run"]
+    num_slices = int(replica.get("num_slices", 1))
+    name = store_name(run, buddy_store_idx(rank, world_size, num_slices))
+    try:
+        h = ray_tpu.get_actor(name)
+        blob = ray_tpu.get([h.get_shard.remote(int(step), rank)],
+                           timeout=60)[0]
+    except Exception:  # noqa: BLE001 - store died since the tier decision
+        return None
+    if blob is None:
+        return None
+    payload = pickle.loads(blob)
+    return ReplicaState(step=payload["step"], state=payload["state"])
+
+
+class ReplicaManager:
+    """Controller-side replica plane: create the per-slice stores, query
+    coverage, and pick the fast-restart restore step."""
+
+    def __init__(self, run: str, num_slices: int, enabled: bool):
+        self.run = run
+        self.num_slices = max(1, int(num_slices))
+        self.enabled = bool(enabled)
+        self._handles: list = []
+
+    def create(self) -> None:
+        if not self.enabled or self._handles:
+            return
+        import ray_tpu
+
+        Store = ray_tpu.remote(ReplicaStore)
+        for idx in range(self.num_slices):
+            self._handles.append(
+                Store.options(name=store_name(self.run, idx), num_cpus=0,
+                              max_concurrency=2).remote(self.run))
+
+    def manifests(self) -> list[dict]:
+        """Per-store manifests; dead/unreachable stores contribute {} (their
+        shards are simply not coverage)."""
+        import ray_tpu
+
+        out = []
+        for h in self._handles:
+            try:
+                out.append(ray_tpu.get([h.manifest.remote()], timeout=15)[0])
+            except Exception:  # noqa: BLE001 - store lost with its slice
+                out.append({})
+        return out
+
+    def _scan(self, world_size: int) -> dict | None:
+        coverage: dict[int, set[int]] = {}
+        meta: dict[int, dict] = {}
+        for man in self.manifests():
+            for step, info in (man or {}).items():
+                step = int(step)
+                if int(info.get("world_size", -1)) != int(world_size):
+                    continue
+                coverage.setdefault(step, set()).update(info.get("ranks", ()))
+                meta[step] = info
+        need = set(range(world_size))
+        for step in sorted(coverage, reverse=True):
+            if need <= coverage[step]:
+                return {"step": step,
+                        "num_slices": meta[step].get("num_slices", 1)}
+        return None
+
+    def _covered(self, step: int, world_size: int) -> bool:
+        have: set[int] = set()
+        for man in self.manifests():
+            info = (man or {}).get(step)
+            if info and int(info.get("world_size", -1)) == int(world_size):
+                have.update(info.get("ranks", ()))
+        return set(range(world_size)) <= have
+
+    def best_restore(self, world_size: int) -> dict | None:
+        """Newest step whose union shard coverage across surviving stores
+        is every rank of ``world_size`` (and whose world matches — replica
+        shards cannot restore into a different world size; that path falls
+        back to the checkpoint tier). The chosen step is pinned against
+        pruning and REVALIDATED after the pin: a straggler push from the
+        dying group can advance the retention window and evict the step
+        between the scan and the pin landing — when that happens, rescan
+        (the straggler left newer, complete coverage behind)."""
+        if not self.enabled:
+            return None
+        for _ in range(4):
+            best = self._scan(world_size)
+            if best is None:
+                return None
+            self.pin(best["step"])
+            if self._covered(best["step"], world_size):
+                return best
+        return None
+
+    def pin(self, step: int | None) -> None:
+        """Pin ``step`` against pruning in every surviving store (see
+        ReplicaStore.pin)."""
+        import ray_tpu
+
+        for h in self._handles:
+            try:
+                ray_tpu.get([h.pin.remote(step)], timeout=10)
+            except Exception:  # noqa: BLE001 - dead store: nothing to pin
+                pass
+
+    def drop(self) -> None:
+        """Forget replicated state (a finished run must not leave sharded
+        payloads pinned in store actors)."""
+        import ray_tpu
+
+        for h in self._handles:
+            try:
+                ray_tpu.get([h.drop_run.remote()], timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        for h in self._handles:
+            try:
+                ray_tpu.kill(h)
+            except Exception:  # noqa: BLE001
+                pass
+        self._handles.clear()

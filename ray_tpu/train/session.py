@@ -34,9 +34,16 @@ class TrainContext:
     # train_fn build its hybrid mesh / pick dcn_axes for the spmd step
     # without re-deriving the slice count from MEGASCALE env.
     num_slices: int = 1
+    # Replica plane wiring from the controller (None = replication off):
+    # {"run": store name prefix, "every": push every N steps,
+    #  "num_slices": buddy-mapping slice count,
+    #  "restore_step": step to restore from on a fast restart (None unless
+    #  the controller chose the replica tier)}.
+    replica: dict | None = None
 
     # filled by the worker harness
     dataset_shards: dict = field(default_factory=dict)  # name -> DataIterator
+    _replica_writer: Any = None  # lazy ReplicaWriter (train/replica.py)
     _reports: list[dict] = field(default_factory=list)
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
     _last_report_ts: float = 0.0  # monotonic ts of the previous report()
@@ -60,6 +67,19 @@ class TrainContext:
 
     def get_checkpoint(self) -> str | None:
         return self.latest_checkpoint
+
+    def get_replica_state(self):
+        """On a replica-tier fast restart: this rank's in-cluster state
+        shard as a :class:`ray_tpu.train.replica.ReplicaState` (``.step``,
+        ``.state``); None otherwise. Check it BEFORE get_checkpoint() —
+        replicas are newer than (or equal to) the latest checkpoint
+        whenever the controller picked this tier."""
+        rep = self.replica
+        if not rep or rep.get("restore_step") is None:
+            return None
+        from ray_tpu.train.replica import fetch_replica_state
+
+        return fetch_replica_state(rep, self.world_rank, self.world_size)
 
     def get_dataset_shard(self, name: str = "train"):
         """This worker's streaming split of a Trainer dataset (reference:
@@ -214,12 +234,59 @@ def report(metrics: dict[str, Any], checkpoint: str | None = None) -> None:
     (train_step_time_s / train_tokens_per_s / train_mfu) so throughput is
     readable off /metrics, not just the report stream."""
     ctx = get_context()
+    _maybe_chaos(ctx, metrics)
     try:
         _instrument_report(ctx, metrics)
     except Exception:
         pass  # metrics must never fail a training step
     with ctx._report_lock:
         ctx._reports.append({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+def _maybe_chaos(ctx: TrainContext, metrics: dict[str, Any]) -> None:
+    """train.step fault-injection probe: every report() is a step boundary,
+    so a scheduled worker/slice kill lands here, mid-run, inside the target
+    process. Attrs exposed to rule predicates: rank, slice, step, restart."""
+    from ray_tpu.chaos import injector as _chaos
+
+    if not _chaos.ACTIVE:
+        return
+    from ray_tpu.train.replica import slice_of
+
+    _chaos.maybe_kill(
+        "train.step",
+        rank=ctx.world_rank,
+        slice=slice_of(ctx.world_rank, ctx.world_size, ctx.num_slices),
+        step=metrics.get("step", ctx._steps_total),
+        restart=ctx.restart_count,
+    )
+
+
+def replicate(state: Any, step: int) -> bool:
+    """Replicate this rank's training state to its buddy slice's
+    :class:`~ray_tpu.train.replica.ReplicaStore` through the object plane.
+    Cheap by construction: the state is snapshotted to host memory inline
+    (donation-safe) and pushed from a background thread — the train step
+    never waits on the wire. Honors the controller's ``replicate_every``
+    cadence (CheckpointConfig.replicate_every; steps off-cadence are
+    skipped). Under ZeRO-1 pass the optimizer/param shards this worker
+    owns (e.g. ``spmd.replica_payload(state)``) — they are already 1/N of
+    the run's state, so replication costs one buddy hop of the same bytes
+    the DCN all-gather moves every step. Returns True when a push was
+    queued."""
+    ctx = get_context()
+    rep = ctx.replica
+    if not rep or int(rep.get("every", 0) or 0) <= 0:
+        return False
+    if int(step) % int(rep["every"]) != 0:
+        return False
+    if ctx._replica_writer is None:
+        from ray_tpu.train.replica import ReplicaWriter
+
+        ctx._replica_writer = ReplicaWriter(
+            rep["run"], ctx.world_rank, ctx.world_size,
+            int(rep.get("num_slices", ctx.num_slices)))
+    return ctx._replica_writer.put(state, step)
 
 
 def drain_reports(ctx: TrainContext) -> list[dict]:

@@ -473,6 +473,29 @@ def make_train_step(
     return step_fn, init_state, data_sharder
 
 
+def replica_payload(state: TrainState) -> dict:
+    """What :func:`ray_tpu.train.replicate` should push for a ZeRO-1 /
+    sharded-update train state: a host snapshot of the shards THIS process
+    holds. Under ``zero1=True`` the optimizer moments are already 1/world_dp
+    per device and the params re-gather every step anyway, so the replica
+    of a slice's state is exactly the shard-sized payload the DCN
+    all-gather already moves — replication costs one extra buddy-slice hop
+    of the same bytes, not a second full-state transfer. Single-process
+    (test) meshes degrade to a plain host copy of the full state.
+
+    The payload restores via ``ctx.get_replica_state()``: step counter,
+    params, and opt_state as numpy trees (or ``(index, shard)`` lists for
+    partially addressable leaves), ready for ``jax.device_put`` against the
+    run's shardings."""
+    from ray_tpu.train.replica import host_snapshot
+
+    return {
+        "step": int(jax.device_get(state.step)),
+        "params": host_snapshot(state.params),
+        "opt_state": host_snapshot(state.opt_state),
+    }
+
+
 def make_llama_train_step(
     cfg: LlamaConfig,
     mesh: Mesh,

@@ -204,6 +204,30 @@ def profile_cluster(seconds: float = 5.0, sample_hz: float = 0.0,
     return out
 
 
+def inject_chaos(rules: list | None = None, clear: bool = False) -> dict:
+    """Install (or, with ``clear=True``, remove) fault-injection rules —
+    fleet-wide on a cluster runtime (head fans to every daemon and worker),
+    or into this process for in-process runtimes. Rule schema:
+    :mod:`ray_tpu.chaos.injector`. Returns per-target injector status."""
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "inject_chaos")
+    if hasattr(rt, "chaos_cluster"):
+        return rt.chaos_cluster(rules=rules, clear=clear)
+    from ray_tpu.chaos import injector
+
+    if clear:
+        injector.clear()
+    if rules:
+        injector.install(rules, replace=False)
+    return {"local": injector.status()}
+
+
+def chaos_status() -> dict:
+    """Current chaos rules + firing log (fleet-wide on a cluster)."""
+    return inject_chaos(rules=None, clear=False)
+
+
 def get_stack(worker_id: str = "") -> dict:
     """Thread stacks of one worker (id or unique id prefix), or of THIS
     process when ``worker_id`` is empty — the `ray stack` capability."""

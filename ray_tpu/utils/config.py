@@ -82,6 +82,21 @@ class Config:
 
     # --- control plane ---
     health_check_period_s: float = 1.0
+    # Failure-detection fast path (sub-minute recovery): how often the node
+    # daemon polls its worker processes for death. The reap loop's idle-TTL
+    # cadence (worker_idle_ttl_s/4 = 15 s) is far too slow to notice a
+    # SIGKILLed train worker; this dedicated waitpid(WNOHANG) sweep costs
+    # microseconds and bounds worker-death detection at ~this interval.
+    # <= 0 falls back to reap-loop-only detection.
+    worker_death_poll_s: float = 0.25
+    # When a node daemon's persistent head connection drops, the head waits
+    # this long for a re-register/heartbeat and then declares the node dead
+    # immediately — instead of waiting for heartbeat aging (up to
+    # health_check_period_s * health_check_failure_threshold = 5 s). A dead
+    # daemon process closes its sockets at once, so this catches real node
+    # death fast while the grace absorbs reconnect blips. < 0 disables the
+    # fast path (heartbeat aging only).
+    node_disconnect_grace_s: float = 0.5
     # Superseded by telemetry_flush_interval_s (the batched telemetry push
     # carries the task events); kept so existing RTPU_TASK_EVENT_* env
     # settings don't error, but no longer read.
@@ -141,6 +156,24 @@ class Config:
     # LIBTPU_INIT_ARGS, so they are inert on CPU hosts. Extra flags can be
     # appended via RTPU_TRAIN_XLA_PERF_FLAGS_EXTRA (space-separated).
     train_xla_perf_flags: bool = True
+
+    # --- chaos (ray_tpu/chaos) ---
+    # Master gate for the fault-injection layer. Rules come from the
+    # RTPU_CHAOS env var (JSON list), RTPU_CHAOS_FILE, the `chaos` CLI verb,
+    # or util.state.inject_chaos(); with this False every installed rule is
+    # inert (a production cluster can carry a chaos schedule disarmed).
+    chaos_enabled: bool = True
+
+    # --- train recovery ---
+    # In-cluster replica shards a ReplicaStore keeps per run (newest
+    # complete sets win; older steps are pruned). 2 lets a restore proceed
+    # even when a worker died mid-way through pushing step N.
+    train_replica_keep: int = 2
+    # Seconds session.replicate()'s background pusher waits for one shard
+    # push before counting it failed; replication disables itself after 3
+    # consecutive failures (it must never become the thing that stalls or
+    # kills a healthy run).
+    train_replica_push_timeout_s: float = 30.0
 
     # --- observability ---
     # Flight recorder: JSON debug bundles dumped on task failure / worker

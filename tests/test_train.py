@@ -153,6 +153,85 @@ def test_failure_restart_from_checkpoint(rt_start, tmp_path):
     assert min(resumed_steps) == 2
 
 
+def test_failure_budget_unified(rt_start, tmp_path):
+    """max_failures is ONE budget: a run allowed 1 restart restarts exactly
+    once, and the second failure ends the run with the structured per-rank
+    error (regression: _poll_until_done used to track an undecremented
+    failures_left while run() counted restart_count separately, so the
+    budget-exhausted path lost the rank attribution)."""
+    attempts = str(tmp_path / "attempts")
+    os.makedirs(attempts, exist_ok=True)
+
+    def train_fn(config):
+        import os as _os
+
+        from ray_tpu.train import get_context
+
+        ctx = get_context()
+        open(_os.path.join(config["attempts"],
+                           f"a{ctx.restart_count}"), "w").close()
+        raise RuntimeError(f"always fails (restart {ctx.restart_count})")
+
+    trainer = JaxTrainer(
+        train_fn, train_loop_config={"attempts": attempts},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="budget", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+    )
+    result = trainer.fit()
+    assert not result.ok
+    # exactly 2 attempts: the original + the single budgeted restart
+    assert sorted(os.listdir(attempts)) == ["a0", "a1"]
+    # the terminal error is the structured per-rank map, not a controller
+    # traceback wrapper
+    assert "rank 0" in result.error and "always fails" in result.error
+    # the restart decision was recorded with its tier
+    assert len(result.restarts) == 1
+    assert result.restarts[0]["tier"] in ("checkpoint", "replica")
+    assert result.restarts[0]["trigger"] == "worker_error"
+
+
+def test_async_checkpoint_writer(tmp_path):
+    """Write-behind checkpointing: save() returns before the write lands,
+    the next save() barriers on the previous one, completed() releases
+    directories only after their writes finished, and restore sees the
+    LAST snapshot's values even though the tree mutated right after
+    save() returned (donation-safety: the snapshot is taken inline)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.train import AsyncCheckpointWriter
+
+    writer = AsyncCheckpointWriter()
+    tree = {"w": jnp.zeros(4), "step": jnp.int32(0)}
+    d1 = writer.save(tree, str(tmp_path / "ck1"), step=1)
+    # mutate immediately — the async write must hold the old snapshot
+    tree = {"w": jnp.full(4, 9.0), "step": jnp.int32(2)}
+    d2 = writer.save(tree, str(tmp_path / "ck2"), step=2)  # barriers on d1
+    assert d1 in writer.completed()  # d1 finished before d2 started
+    writer.wait()
+    assert writer.completed() == [d2]
+    r1 = restore_pytree(d1)
+    np.testing.assert_allclose(np.asarray(r1["w"]), 0.0)
+    r2 = restore_pytree(d2)
+    np.testing.assert_allclose(np.asarray(r2["w"]), 9.0)
+    # a completed directory carries the meta file (write-finished sentinel)
+    from ray_tpu.train import Checkpoint
+
+    assert Checkpoint(d2).metadata()["step"] == 2
+
+
+def test_async_checkpoint_writer_surfaces_errors(tmp_path):
+    from ray_tpu.train import AsyncCheckpointWriter
+
+    writer = AsyncCheckpointWriter()
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where the checkpoint dir should go")
+    writer.save({"w": np.ones(2)}, str(blocked / "ck"), step=0)
+    with pytest.raises(Exception):
+        writer.wait()
+    assert writer.completed() == []
+
+
 def test_jax_train_on_virtual_mesh(rt_start, tmp_path):
     """Tiny llama step inside a train worker on the 8-device CPU mesh —
     the single-process SPMD shape of the TPU fine-tune workload."""
