@@ -1,0 +1,41 @@
+"""Memory-model-guided train-step autotuner.
+
+Replaces hand-enumerated bench config sweeps: generate candidates across
+the full configuration space the training stack supports (batch size x
+remat policy — including per-layer save-lists — x ZeRO-1 sharded update x
+gradient accumulation x flash/CE kernel block and chunk sizes), predict
+each candidate's peak HBM with an analytic memory model (no compilation,
+no execution), prune the ones that cannot fit, rank the survivors, and
+measure only the top few on hardware. Predicted-vs-actual HBM is recorded
+per measured candidate (actual from AOT ``memory_analysis()`` or the
+``hlo_stats`` liveness estimator), and measurements persist in a JSON
+cache keyed by device kind + model geometry so later rounds start from
+the recorded frontier instead of re-measuring the whole space.
+
+Grounding: "Automatic Cross-Replica Sharding of Weight Update" (ZeRO-1)
+for the update-sharding dimension; EQuARX's price-from-compiled-HLO
+methodology, extended from comms bytes to peak HBM (parallel/hlo_stats).
+"""
+
+from ray_tpu.autotune.model import (
+    HbmPrediction,
+    device_hbm_budget_bytes,
+    predict_hbm,
+)
+from ray_tpu.autotune.search import (
+    AutotuneCache,
+    SearchResult,
+    autotune_train_configs,
+)
+from ray_tpu.autotune.space import Candidate, candidate_space
+
+__all__ = [
+    "AutotuneCache",
+    "Candidate",
+    "HbmPrediction",
+    "SearchResult",
+    "autotune_train_configs",
+    "candidate_space",
+    "device_hbm_budget_bytes",
+    "predict_hbm",
+]

@@ -183,6 +183,48 @@ def _layer(cfg: LlamaConfig, x, layer_params, inv_freq, positions,
     return x
 
 
+def normalize_remat(remat, num_layers: int):
+    """Canonicalize a remat spec: a scalar policy stays scalar; a per-layer
+    sequence (one policy string per layer — the autotuner's save-lists
+    keyed by layer index) is length-checked and collapsed back to a scalar
+    when uniform, so the single-scan fast path still applies. Strings with
+    commas ("attn:8,dots:8" or "attn,attn,dots,...") expand to per-layer
+    form; "policy:N" runs N consecutive layers under that policy."""
+    if isinstance(remat, str) and ("," in remat or ":" in remat):
+        out = []
+        for part in remat.split(","):
+            part = part.strip()
+            if ":" in part:
+                pol, n = part.rsplit(":", 1)
+                out.extend([pol] * int(n))
+            elif part:
+                out.append(part)
+        remat = tuple(out)
+    if isinstance(remat, (list, tuple)):
+        if len(remat) != num_layers:
+            raise ValueError(
+                f"per-layer remat has {len(remat)} entries for "
+                f"{num_layers} layers")
+        if len(set(remat)) == 1:
+            return remat[0]
+        return tuple(remat)
+    return remat
+
+
+def _remat_runs(remat: tuple) -> list[tuple]:
+    """Consecutive equal-policy runs of a per-layer remat spec:
+    ('attn','attn','dots') -> [('attn', 0, 2), ('dots', 2, 3)]. Each run
+    scans with ONE compiled layer body (same compile-size economics as the
+    uniform case; the number of distinct bodies = number of runs)."""
+    runs = []
+    start = 0
+    for i in range(1, len(remat) + 1):
+        if i == len(remat) or remat[i] != remat[start]:
+            runs.append((remat[start], start, i))
+            start = i
+    return runs
+
+
 def _remat_wrap(layer_fn, remat):
     """remat policy: True/'full' = recompute everything (min memory),
     'attn' = save ONLY the attention residuals (rope'd q/k, v, flash
@@ -229,17 +271,38 @@ def _remat_wrap(layer_fn, remat):
 def forward_hidden(cfg: LlamaConfig, params: dict, tokens: jax.Array,
                    positions: jax.Array | None = None,
                    attn_impl: str = "flash", sp_axis: str | None = None,
-                   remat: bool | str = True) -> jax.Array:
-    """tokens [B, S] → final-norm hidden states [B, S, H]."""
+                   remat: bool | str | tuple = True) -> jax.Array:
+    """tokens [B, S] → final-norm hidden states [B, S, H].
+
+    ``remat`` is a single policy (see :func:`_remat_wrap`) or a per-layer
+    spec (tuple of policies / "pol:N,pol:N" string — see
+    :func:`normalize_remat`): e.g. the autotuner's mixed save-lists spend
+    HBM on cheap-to-save early layers while the deep layers stay lean."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.arange(s)
     x = params["embed_tokens"][tokens]
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
-    layer_fn = _remat_wrap(
-        partial(_layer, cfg, inv_freq=inv_freq, positions=positions,
-                attn_impl=attn_impl, sp_axis=sp_axis), remat)
+    base_fn = partial(_layer, cfg, inv_freq=inv_freq, positions=positions,
+                      attn_impl=attn_impl, sp_axis=sp_axis)
+    remat = normalize_remat(remat, cfg.num_layers)
+
+    if isinstance(remat, tuple):
+        # Per-layer policies: scan each equal-policy run over its slice of
+        # the stacked layer params (still one compiled body per run).
+        for policy, start, end in _remat_runs(remat):
+            layer_fn = _remat_wrap(base_fn, policy)
+
+            def scan_body(x, lp, _fn=layer_fn):
+                return _fn(x, lp), None
+
+            run_params = jax.tree.map(lambda a: a[start:end],
+                                      params["layers"])
+            x, _ = lax.scan(scan_body, x, run_params)
+        return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+    layer_fn = _remat_wrap(base_fn, remat)
 
     def scan_body(x, lp):
         return layer_fn(x, lp), None
@@ -271,11 +334,12 @@ def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array,
             fused_ce: bool = True, **fwd_kwargs) -> jax.Array:
     """Mean next-token cross-entropy over unmasked positions."""
     if fused_ce:
-        from ray_tpu.ops.loss import fused_cross_entropy
+        from ray_tpu.ops.loss import default_ce_chunk, fused_cross_entropy
 
         x = forward_hidden(cfg, params, tokens, **fwd_kwargs)
         head = unembed_weights(cfg, params)
-        return fused_cross_entropy(x, head, targets, mask)
+        return fused_cross_entropy(x, head, targets, mask,
+                                   default_ce_chunk())
     logits = forward(cfg, params, tokens, **fwd_kwargs)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -42,6 +42,29 @@ import os as _os
 
 FUSED_BWD = _os.environ.get("RTPU_FLASH_FUSED_BWD", "1") != "0"
 
+
+def _env_int(name: str, default: int) -> int:
+    v = _os.environ.get(name)
+    if not v:
+        return default
+    try:
+        n = int(v)
+    except ValueError:
+        return default
+    return n if n > 0 else default
+
+
+def flash_blocks(block_q: int | None = None,
+                 block_k: int | None = None) -> tuple[int, int]:
+    """Resolve flash-attention kernel block sizes: explicit argument wins,
+    then the RTPU_FLASH_BLOCK_Q / RTPU_FLASH_BLOCK_K env overrides (the
+    autotuner sets these per candidate before tracing — block size is a
+    compile-time grid parameter, so each value is a separate compile), then
+    the 512 default chip-measured best at the bench geometry. Values must
+    divide the sequence length; the pallas wrappers assert that loudly."""
+    return (block_q or _env_int("RTPU_FLASH_BLOCK_Q", 512),
+            block_k or _env_int("RTPU_FLASH_BLOCK_K", 512))
+
 # Scoped-VMEM ceiling for the flash kernels, by TPU generation: v5e/v5p/v6
 # expose 128 MB of VMEM per core, where the compiler's default 16 MB scoped
 # limit is too tight for packed blocks but a flat 96 MB would OVERSUBSCRIBE
@@ -300,12 +323,13 @@ def _packed_qspecs(pack, block_q, d, kv_div, skv):
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
-                      block_q: int = 512, block_k: int = 512):
+                      block_q: int | None = None, block_k: int | None = None):
     """GQA-native: k/v stay [B, Hkv, S, D]; the BlockSpec index maps send
     each packed q-head group to its kv head — no materialized repeat."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    block_q, block_k = flash_blocks(block_q, block_k)
     b, h, sq, d = q.shape
     hkv, skv = k.shape[1], k.shape[2]
     rep = h // hkv
@@ -549,7 +573,8 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_fused_pallas(q, k, v, out, lse, g, causal: bool,
                             sm_scale: float,
-                            block_q: int = 512, block_k: int = 512):
+                            block_q: int | None = None,
+                            block_k: int | None = None):
     """Single-kernel backward (see _flash_bwd_fused_kernel). dk/dv come
     back folded to kv heads [B, Hkv, S, D] — the pack-group fold happens
     inside the kernel's accumulation; any remaining rep/pack groups are
@@ -558,6 +583,7 @@ def _flash_bwd_fused_pallas(q, k, v, out, lse, g, causal: bool,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    block_q, block_k = flash_blocks(block_q, block_k)
     b, h, sq, d = q.shape
     hkv, skv = k.shape[1], k.shape[2]
     rep = h // hkv
@@ -615,12 +641,13 @@ def _flash_bwd_fused_pallas(q, k, v, out, lse, g, causal: bool,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, causal: bool, sm_scale: float,
-                      block_q: int = 512, block_k: int = 512):
+                      block_q: int | None = None, block_k: int | None = None):
     """GQA-native like the forward: k/v stay [B, Hkv, S, D]; dk/dv come back
     per *query* head [B, H, S, D] (caller folds the group dimension)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    block_q, block_k = flash_blocks(block_q, block_k)
     b, h, sq, d = q.shape
     hkv, skv = k.shape[1], k.shape[2]
     rep = h // hkv
@@ -844,10 +871,12 @@ def _chunk_blocks(sq: int, skv: int, block_q: int, block_k: int):
 
 
 def _flash_chunk_fwd_pallas(q, k, v, qpos, kpos, causal, sm_scale,
-                            block_q: int = 512, block_k: int = 512):
+                            block_q: int | None = None,
+                            block_k: int | None = None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    block_q, block_k = flash_blocks(block_q, block_k)
     b, h, sq, d = q.shape
     hkv, skv = k.shape[1], k.shape[2]
     block_q, block_k = _chunk_blocks(sq, skv, block_q, block_k)
@@ -890,10 +919,12 @@ def _flash_chunk_fwd_pallas(q, k, v, qpos, kpos, causal, sm_scale,
 
 def _flash_chunk_bwd_pallas(q, k, v, qpos, kpos, out, lse, g_out, g_lse,
                             causal, sm_scale,
-                            block_q: int = 512, block_k: int = 512):
+                            block_q: int | None = None,
+                            block_k: int | None = None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    block_q, block_k = flash_blocks(block_q, block_k)
     b, h, sq, d = q.shape
     hkv, skv = k.shape[1], k.shape[2]
     block_q, block_k = _chunk_blocks(sq, skv, block_q, block_k)

@@ -27,6 +27,17 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def default_ce_chunk(default: int = 512) -> int:
+    """Sequence-chunk size for :func:`fused_cross_entropy`, overridable via
+    RTPU_CE_CHUNK (the train-step autotuner sets it per candidate: chunk is
+    a static argument, so each value compiles a distinct scan — larger
+    chunks = fewer scan steps but a bigger [B, chunk, V] logits workspace,
+    the dominant transient of the loss)."""
+    from ray_tpu.ops.attention import _env_int
+
+    return _env_int("RTPU_CE_CHUNK", default)
+
+
 def _chunked(x, chunk):
     """[B, S, ...] -> [S/chunk, B, chunk, ...]."""
     b, s = x.shape[0], x.shape[1]

@@ -1,4 +1,4 @@
-"""Compiled-HLO collective analysis: which bytes cross the slice boundary.
+"""Compiled-HLO analysis: cross-slice collective bytes AND peak HBM.
 
 The scale-proof harness (devbench/multislice_perf.py) and tests need a
 *measured* answer to "how many bytes does one train step push over DCN?",
@@ -18,6 +18,15 @@ the result so the number is reproducible):
 Payload is the op's per-device buffer size as listed in the partitioned
 module (output shape), so quantized wire formats (int8 + scales) are priced
 at their real width.
+
+The second half of this module (:func:`hbm_stats`) extends the same
+HLO-text grounding from comms bytes to memory: a liveness sweep over the
+SCHEDULED module (``is_scheduled=true`` — instructions appear in execution
+order, so def-to-last-use intervals are real lifetimes) estimates peak
+live HBM without executing anything. The train-step autotuner
+(ray_tpu/autotune) uses it to record predicted-vs-actual HBM per
+candidate, and the recorded-fixture tests hold it within 15% of XLA's own
+``compiled.memory_analysis()`` numbers.
 """
 
 from __future__ import annotations
@@ -196,3 +205,246 @@ def mesh_slice_map(n_devices: int, num_slices: int):
     consecutive runs of ``n_devices // num_slices`` ids share a slice."""
     per_slice = n_devices // num_slices
     return lambda pid: pid // per_slice
+
+
+# ---------------------------------------------------------------------------
+# Peak-HBM estimation over scheduled HLO (buffer liveness sweep)
+# ---------------------------------------------------------------------------
+#
+# Method: parse every computation of the module; for the entry computation
+# walk instructions in schedule order keeping a running sum of live buffer
+# bytes. A buffer becomes live at its defining instruction and dies after
+# its last use. Aliasing ops (tuple / get-tuple-element / bitcast / while /
+# optimization-barrier) allocate nothing but EXTEND the lifetimes of the
+# buffers they forward — without this, every scan carry packed into a tuple
+# would "die" at the tuple op and the sweep undercounts by 2-3x (measured).
+# Control flow recurses: a while op's peak is the live set at the op plus
+# the body/condition computation's own temp peak (the carry aliases the
+# operand, so it is already in the live set); fusions materialize only
+# their result (fused internals stay in registers/VMEM scratch).
+#
+# Accuracy: the sweep does NOT model XLA's in-place buffer sharing (an
+# elementwise op reusing a dying operand's allocation), so it lands a
+# consistent 8-15% ABOVE ``compiled.memory_analysis()`` on train-step
+# modules (devbench/autotune_bench.py tracks this on recorded fixtures).
+# Overestimating is the safe direction for the autotuner's OOM pruning.
+
+# dtype[dims]{layout} — layout may carry TPU tiling with parens: {1,0:T(8,128)}
+_HBM_SHAPE_RE = re.compile(
+    r"([a-z][a-z0-9]*)\[([0-9,]*)\](?:\{([^{}]*?(?:\([0-9,]*\)[^{}]*?)*)\})?")
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s+\((.*)\)\s+->\s+(.+?)\s*\{\s*$")
+_INSTR_RE = re.compile(r"^\s*(ROOT\s+)?%([\w.\-]+)\s*=\s*(.+)$")
+_OPCODE_RE = re.compile(r"^\s*((?:\(.*?\)|\S+?))\s+([\w\-]+)\(")
+_CALLED_RE = re.compile(r"(?:calls|body|condition|to_apply)=%?([\w.\-]+)")
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+# Ops whose result aliases (a subset of) their operands: no new allocation,
+# but the forwarded buffers stay live as long as the alias does.
+_ALIAS_OPS = frozenset({
+    "bitcast", "bitcast-convert", "get-tuple-element", "tuple", "parameter",
+    "while", "optimization-barrier",
+})
+
+_HBM_DTYPE_BYTES = dict(_DTYPE_BYTES)
+_HBM_DTYPE_BYTES.update({"s4": 1, "u4": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+                         "f8e4m3b11fnuz": 1, "f8e5m2fnuz": 1, "f8e4m3fnuz": 1,
+                         "token": 0, "opaque": 0})
+
+
+def _padded_shape_bytes(text: str) -> int:
+    """Total buffer bytes of every shape in ``text`` (tuples sum), honoring
+    TPU tiled layouts: ``f32[130,260]{1,0:T(8,128)}`` pads the physical
+    (minor-to-major-permuted) dims up to tile multiples — the padding is
+    real HBM the buffer occupies."""
+    total = 0
+    for dtype, dims, layout in _HBM_SHAPE_RE.findall(text):
+        if dtype not in _HBM_DTYPE_BYTES:
+            continue
+        dl = [int(d) for d in dims.split(",") if d]
+        n = math.prod(dl) if dl else 1
+        if layout and ":T(" in layout:
+            tile_m = re.search(r"T\(([0-9,]+)\)", layout)
+            perm = [int(p) for p in layout.split(":", 1)[0].split(",")
+                    if p.strip().lstrip("-").isdigit()]
+            if tile_m and dl and len(perm) == len(dl):
+                tile = [int(t) for t in tile_m.group(1).split(",")]
+                # physical order: minor-to-major list reversed = major-first
+                phys = [dl[p] for p in reversed(perm)]
+                for i in range(1, len(tile) + 1):
+                    if i <= len(phys):
+                        t = tile[-i]
+                        phys[-i] = -(-phys[-i] // t) * t
+                n = math.prod(phys) if phys else 1
+        total += _HBM_DTYPE_BYTES[dtype] * n
+    return total
+
+
+@dataclass
+class HbmStats:
+    """Peak-HBM estimate for one scheduled HLO module (see module docs)."""
+    parameter_bytes: int      # entry arguments (params + opt state + batch)
+    peak_temp_bytes: int      # liveness-sweep peak over entry temporaries
+    n_computations: int
+    n_instructions: int
+    # donated inputs (input_output_alias header entries): outputs reuse
+    # argument buffers, so the total need not add outputs again
+    aliased_outputs: int = 0
+
+    @property
+    def peak_bytes(self) -> int:
+        """Estimated peak HBM: arguments stay resident for the whole run,
+        temporaries peak on top; non-donated outputs are produced by entry
+        instructions and therefore already ride in the temp sweep."""
+        return self.parameter_bytes + self.peak_temp_bytes
+
+
+def _parse_module(hlo_text: str):
+    """computations: name -> {param_bytes, instrs:[{lhs, op, nbytes,
+    operands, called, root}]}; returns (computations, entry_name,
+    n_output_aliases)."""
+    comps: dict[str, dict] = {}
+    cur = None
+    entry = None
+    aliases = 0
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        if cur is None:
+            if s.startswith("HloModule"):
+                aliases = s.count("may-alias") + s.count("must-alias")
+                continue
+            if s.endswith("{"):
+                m = _COMP_RE.match(s)
+                if m:
+                    cur = m.group(2)
+                    comps[cur] = {
+                        "param_bytes": _padded_shape_bytes(m.group(3)),
+                        "instrs": [],
+                    }
+                    if m.group(1):
+                        entry = cur
+            continue
+        if s == "}":
+            cur = None
+            continue
+        m = _INSTR_RE.match(s)
+        if not m:
+            continue
+        root, lhs, rest = bool(m.group(1)), m.group(2), m.group(3)
+        om = _OPCODE_RE.match(rest)
+        if not om:
+            continue
+        # operands live inside the call's parens (depth-counted: TPU tiled
+        # layouts put parens inside shapes); computation refs in the attrs.
+        start = om.end()
+        depth, i = 1, start
+        while i < len(rest) and depth:
+            if rest[i] == "(":
+                depth += 1
+            elif rest[i] == ")":
+                depth -= 1
+            i += 1
+        args, attrs = rest[start:i - 1], rest[i:]
+        called = _CALLED_RE.findall(attrs)
+        br = _BRANCHES_RE.search(attrs)
+        if br:
+            called += _OPERAND_RE.findall(br.group(1))
+        comps[cur]["instrs"].append({
+            "lhs": lhs,
+            "op": om.group(2),
+            "nbytes": _padded_shape_bytes(om.group(1)),
+            "operands": _OPERAND_RE.findall(args),
+            "called": called,
+            "root": root,
+        })
+    return comps, entry, aliases
+
+
+def _temp_peak(comps: dict, name: str, memo: dict) -> int:
+    """Liveness-sweep peak of one computation's temporaries (parameters
+    excluded — the caller's live set already holds them)."""
+    if name in memo:
+        return memo[name]
+    memo[name] = 0  # cycle guard (malformed input); real value set below
+    c = comps[name]
+    instrs = c["instrs"]
+
+    # Alias resolution: lhs -> underlying allocated buffer names. Defs
+    # precede uses in valid HLO, so one forward pass suffices.
+    alias: dict[str, frozenset] = {}
+
+    def bufs(n: str) -> frozenset:
+        return alias.get(n, frozenset((n,)))
+
+    for ins in instrs:
+        if ins["op"] in _ALIAS_OPS:
+            s = frozenset()
+            for o in ins["operands"]:
+                s |= bufs(o)
+            alias[ins["lhs"]] = s
+
+    last_use: dict[str, int] = {}
+    n = len(instrs)
+    for idx, ins in enumerate(instrs):
+        for o in ins["operands"]:
+            for b in bufs(o):
+                last_use[b] = idx
+        if ins["root"]:  # computation output: live past the end
+            for b in bufs(ins["lhs"]):
+                last_use[b] = n
+
+    live: dict[str, int] = {}
+    peak = cur = 0
+    for idx, ins in enumerate(instrs):
+        alloc = 0 if ins["op"] in _ALIAS_OPS else ins["nbytes"]
+        nested = 0
+        if ins["op"] != "fusion":  # fused internals never hit HBM
+            for cn in ins["called"]:
+                if cn in comps:
+                    nested = max(nested, _temp_peak(comps, cn, memo))
+        cur += alloc
+        live[ins["lhs"]] = alloc
+        peak = max(peak, cur + nested)
+        # free buffers whose last use was this instruction (alias-extended)
+        for o in set(ins["operands"]) | {ins["lhs"]}:
+            for b in bufs(o):
+                if last_use.get(b, idx) <= idx and b in live:
+                    cur -= live.pop(b)
+    memo[name] = peak
+    return peak
+
+
+def hbm_stats(hlo_text: str) -> HbmStats:
+    """Estimate peak HBM of a compiled (scheduled) HLO module from its text
+    alone — nothing is executed or allocated. See the section comment above
+    for method and accuracy; prefer :func:`compiled_hbm_bytes` when you
+    hold the jax ``Compiled`` object (exact where the backend reports it)."""
+    comps, entry, aliases = _parse_module(hlo_text)
+    if entry is None:
+        raise ValueError("no ENTRY computation found — is this HLO text "
+                         "from jit(...).lower(...).compile().as_text()?")
+    memo: dict[str, int] = {}
+    return HbmStats(
+        parameter_bytes=comps[entry]["param_bytes"],
+        peak_temp_bytes=_temp_peak(comps, entry, memo),
+        n_computations=len(comps),
+        n_instructions=sum(len(c["instrs"]) for c in comps.values()),
+        aliased_outputs=aliases,
+    )
+
+
+def compiled_hbm_bytes(compiled) -> tuple[int, str]:
+    """Peak-HBM bytes for a jax ``Compiled`` object: XLA's own
+    ``memory_analysis()`` when the backend provides it (arguments + temp +
+    non-aliased outputs — outputs aliased to donated inputs reuse argument
+    buffers), else the :func:`hbm_stats` text estimate. Returns
+    ``(bytes, source)`` with source "memory_analysis" | "hlo_liveness"."""
+    try:
+        ma = compiled.memory_analysis()
+        total = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                 + max(ma.output_size_in_bytes - ma.alias_size_in_bytes, 0))
+        if total > 0:
+            return int(total), "memory_analysis"
+    except Exception:
+        pass
+    return hbm_stats(compiled.as_text()).peak_bytes, "hlo_liveness"

@@ -502,13 +502,15 @@ def make_llama_train_step(
     rules: ShardingRules | None = None,
     optimizer: optax.GradientTransformation | None = None,
     attn_impl: str = "flash",
-    remat: bool = True,
+    remat: bool | str | tuple = True,
     seed: int = 0,
     **step_options,
 ) -> tuple[Callable, Callable, Callable]:
     """Llama-family specialization of :func:`make_train_step`.
     ``step_options`` forwards the multi-slice/ZeRO-1 knobs (``zero1``,
-    ``grad_accum``, ``grad_norm_every``, ``dcn_axes``, ``dcn_quant``)."""
+    ``grad_accum``, ``grad_norm_every``, ``dcn_axes``, ``dcn_quant``).
+    ``remat`` accepts a single policy or a per-layer save-list spec
+    (tuple / "pol:N,pol:N" string — models/llama.normalize_remat)."""
     return make_train_step(
         mesh,
         loss=lambda p, tokens, targets: loss_fn(

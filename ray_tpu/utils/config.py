@@ -144,6 +144,30 @@ class Config:
     # scale overhead at 1.6% of payload.
     collective_dcn_quant_bucket: int = 256
 
+    # --- kernels / train-step autotuning (env-only knobs) ---
+    # The Pallas/loss kernel tuning knobs are read DIRECTLY from the
+    # environment at trace time rather than through this Config: the ops
+    # modules must stay importable without runtime initialization, and the
+    # autotuner (ray_tpu/autotune) flips them per candidate between
+    # compiles (Candidate.applied_env). Documented here because this file
+    # is the flag registry of record:
+    #   RTPU_FLASH_BLOCK_Q / RTPU_FLASH_BLOCK_K (512): flash-attention
+    #     kernel block sizes — fwd, fused + split backward, ring chunk
+    #     kernels; must divide the sequence length.
+    #   RTPU_CE_CHUNK (512): fused cross-entropy sequence-chunk size —
+    #     fewer scan steps vs a bigger [B, chunk, V] logits workspace.
+    #   RTPU_FLASH_FUSED_BWD (1): fused dq+dkv backward kernel; 0 = the
+    #     split dq / dkv kernel pair. Read ONCE at ops/attention import
+    #     (module-level FUSED_BWD) — set it before the process starts;
+    #     not flippable per candidate, unlike the trace-time knobs above.
+    #   RTPU_FLASH_VMEM_LIMIT_MB (by TPU generation): scoped-VMEM ceiling
+    #     for the flash kernels; 0 forces the compiler default.
+    #   RTPU_HBM_BUDGET_GB (detected from the backend): HBM budget the
+    #     autotuner's pruning tier compares predictions against.
+    #   RTPU_AUTOTUNE_CACHE (<repo>/AUTOTUNE_CACHE.json): measured-
+    #     throughput cache path (keyed device kind + geometry + config).
+    #   RTPU_BENCH_MAX_MEASURE (6): candidates measured per bench round.
+
     # --- train ---
     # Compute the grad-norm metric every N steps (1 = every step, the
     # old behavior). The global-norm reduction costs ~1.6% of a Llama-1B

@@ -66,11 +66,23 @@ def test_flash_attention_cpu_fallback_and_grad():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 
-def test_flash_pallas_interpret_matches_reference():
+def _force_interpret_mode():
+    """pltpu.force_tpu_interpret_mode appeared after jax 0.4.37 — skip
+    with the reason instead of erroring (same compat policy as the
+    shard_map shim in parallel/): the kernel code paths are still covered
+    by the attn_mod.INTERPRET tests below on old releases."""
+    import jax
     from jax.experimental.pallas import tpu as pltpu
 
+    if not hasattr(pltpu, "force_tpu_interpret_mode"):
+        pytest.skip("pltpu.force_tpu_interpret_mode unavailable on jax "
+                    f"{jax.__version__} (added in later releases)")
+    return pltpu.force_tpu_interpret_mode()
+
+
+def test_flash_pallas_interpret_matches_reference():
     q, k, v = _qkv(b=1, h=2, s=256, d=64)
-    with pltpu.force_tpu_interpret_mode():
+    with _force_interpret_mode():
         from ray_tpu.ops.attention import _flash_fwd_pallas
 
         out, lse = _flash_fwd_pallas(q, k, v, causal=True, sm_scale=1.0 / 8.0,
@@ -164,13 +176,11 @@ def test_rms_norm_reference_properties():
 
 
 def test_rms_norm_pallas_interpret():
-    from jax.experimental.pallas import tpu as pltpu
-
     from ray_tpu.ops.norms import rms_norm_pallas
 
     x = jax.random.normal(jax.random.PRNGKey(1), (256, 128))
     w = jax.random.normal(jax.random.PRNGKey(2), (128,))
-    with pltpu.force_tpu_interpret_mode():
+    with _force_interpret_mode():
         out = rms_norm_pallas(x, w)
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(rms_norm_reference(x, w)), atol=1e-5)
@@ -254,6 +264,56 @@ def test_fused_cross_entropy_grads_match():
         argnums=(0, 1))(x, head)
     rx, rh = jax.grad(
         lambda x_, h_: _ce_reference(x_, h_, targets, mask),
+        argnums=(0, 1))(x, head)
+    np.testing.assert_allclose(gx, rx, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gh, rh, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("s,chunk", [(13, 4), (7, 512), (24, 7), (17, 17)])
+def test_fused_cross_entropy_odd_seq_nondivisible_chunk(s, chunk):
+    """s % chunk != 0 falls back to a single chunk (ops/loss.py): the
+    forward AND the custom-vjp backward must both take the fallback and
+    agree with the reference — the backward recomputes chunk geometry
+    independently, so a fwd/bwd disagreement would silently corrupt
+    gradients rather than error."""
+    from ray_tpu.ops.loss import fused_cross_entropy
+
+    b, h, v = 2, 8, 24
+    x = jax.random.normal(jax.random.PRNGKey(7), (b, s, h), jnp.float32)
+    head = jax.random.normal(jax.random.PRNGKey(8), (h, v), jnp.float32) * 0.2
+    targets = jax.random.randint(jax.random.PRNGKey(9), (b, s), 0, v)
+    mask = (jax.random.uniform(jax.random.PRNGKey(10), (b, s)) > 0.25)
+
+    got = fused_cross_entropy(x, head, targets, mask, chunk)
+    want = _ce_reference(x, head, targets, mask)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    gx, gh = jax.grad(
+        lambda x_, h_: fused_cross_entropy(x_, h_, targets, mask, chunk),
+        argnums=(0, 1))(x, head)
+    rx, rh = jax.grad(
+        lambda x_, h_: _ce_reference(x_, h_, targets, mask),
+        argnums=(0, 1))(x, head)
+    np.testing.assert_allclose(gx, rx, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gh, rh, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_cross_entropy_divisible_multichunk_grads():
+    """Companion boundary case: s % chunk == 0 with several chunks (the
+    scan path, not the fallback) at an odd chunk count."""
+    from ray_tpu.ops.loss import fused_cross_entropy
+
+    b, s, h, v, chunk = 2, 15, 8, 24, 5
+    x = jax.random.normal(jax.random.PRNGKey(11), (b, s, h), jnp.float32)
+    head = jax.random.normal(jax.random.PRNGKey(12), (h, v),
+                             jnp.float32) * 0.2
+    targets = jax.random.randint(jax.random.PRNGKey(13), (b, s), 0, v)
+
+    gx, gh = jax.grad(
+        lambda x_, h_: fused_cross_entropy(x_, h_, targets, None, chunk),
+        argnums=(0, 1))(x, head)
+    rx, rh = jax.grad(
+        lambda x_, h_: _ce_reference(x_, h_, targets, None),
         argnums=(0, 1))(x, head)
     np.testing.assert_allclose(gx, rx, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(gh, rh, rtol=1e-4, atol=1e-5)
