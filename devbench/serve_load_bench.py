@@ -1,0 +1,465 @@
+"""Serve load bench: closed-loop load generation against a multi-replica
+deployment, with chaos kills mid-burst, proving the request-resilience
+layer (deadlines, shedding, retries, circuit breaking) composes under
+production-shaped traffic.
+
+A fake-LLM streaming deployment (prefill sleep + per-token decode sleeps)
+runs 3 replicas behind the full serve stack — controller, long-poll,
+shared pow-2 router, streaming replicas. A thread-pool load generator
+drives it through phases:
+
+- ``baseline``        — closed loop at capacity (replicas x
+  max_ongoing concurrent clients), prefix-skewed prompts routed with
+  affinity hints. Establishes p50/p99 TTFT/TPOT and throughput.
+- ``overload``        — 2x capacity. The bounded router queue must SHED
+  (Overloaded) instead of stretching latency, and goodput
+  (SLO-satisfying completions/s) must hold within 10% of the
+  pre-overload throughput.
+- ``latency_outlier`` — a chaos ``serve.replica`` delay rule makes one
+  replica pathologically slow; the router's latency-outlier breaker
+  blacklists it and tail latency recovers without operator action.
+- ``chaos_kill``      — a replica is killed mid-burst with
+  retries+breaker ON: zero failed non-shed requests (never-sent
+  re-resolve + policy retries absorb the death), bounded p99 TTFT, and
+  time-to-recover (kill → deployment HEALTHY again) is measured.
+- ``chaos_kill_raw``  — the same kill with the resilience layer OFF
+  (max_retries=0, never-sent retry off, breaker disabled): the raw
+  errors users would have seen, recorded for comparison.
+
+Per phase the bench reports request counts by outcome
+(ok/shed/expired/failed), latency percentiles, throughput/goodput at the
+fixed SLOs, and the resilience counters (retries, hedges, breaker
+transitions) read from the serve metrics. PERF_SERVE_LOAD.json carries an
+``acceptance`` block asserting the headline claims.
+
+Run: python devbench/serve_load_bench.py [--quick]
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# SLOs for goodput accounting (box-scaled: the fake LLM's service time is
+# ~0.1-0.3 s on an idle box; the SLO is a generous multiple so goodput
+# measures systemic latency collapse, not scheduler jitter).
+SLO_TTFT_S = 1.5
+SLO_E2E_S = 6.0
+
+NUM_REPLICAS = 3
+MAX_ONGOING = 4
+CAPACITY = NUM_REPLICAS * MAX_ONGOING  # concurrent closed-loop clients
+
+# Prefix-skewed prompts: most traffic shares a few hot system prompts (the
+# production LLM shape the route-hint affinity exists for).
+_HOT_PREFIXES = [f"[system prompt {i}] " + "x" * 140 for i in range(3)]
+
+
+def _make_prompt(rng: random.Random) -> str:
+    if rng.random() < 0.7:
+        head = rng.choice(_HOT_PREFIXES)
+    else:
+        head = f"[unique {rng.random():.12f}] " + "y" * 120
+    return head + f" user question {rng.random():.6f}"
+
+
+def _route_hint(prompt: str) -> str:
+    # Same fixed-head-block hashing as the HTTP proxy's prefix hint.
+    return hashlib.sha1(prompt[:128].encode()).hexdigest()[:16]
+
+
+def _pctl(values, q: float):
+    if not values:
+        return None
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+    return round(vals[idx], 4)
+
+
+def _metric_total(name: str) -> float:
+    """Sum of every series of one counter in the process-wide registry."""
+    from ray_tpu.util.metrics import registry
+
+    for m in registry().metrics():
+        if m.name == name:
+            return float(sum(m._points().values()))
+    return 0.0
+
+
+_RESILIENCE_COUNTERS = (
+    "serve_retries_total", "serve_hedges_total",
+    "serve_breaker_transitions_total", "serve_shed_total",
+    "serve_expired_total",
+)
+
+
+def _counters_snapshot() -> dict:
+    return {n: _metric_total(n) for n in _RESILIENCE_COUNTERS}
+
+
+def _counters_delta(before: dict) -> dict:
+    return {n.replace("serve_", "").replace("_total", ""):
+            round(_metric_total(n) - before[n], 1)
+            for n in _RESILIENCE_COUNTERS}
+
+
+def _deploy(resilient: bool, tokens: int):
+    """Fresh serve app with the fake-LLM deployment; returns the handle."""
+    from ray_tpu import serve
+
+    @serve.deployment(
+        name="FakeLLM" if resilient else "FakeLLMRaw",
+        num_replicas=NUM_REPLICAS, max_ongoing_requests=MAX_ONGOING,
+        health_check_period_s=0.25,
+        request_timeout_s=20.0,
+        max_queued_requests=8 if resilient else -1,
+        retry_policy=serve.RetryPolicy(max_retries=2)
+        if resilient else serve.RetryPolicy(max_retries=0,
+                                            retry_never_sent=False),
+        circuit_breaker=serve.CircuitBreakerConfig(
+            failure_threshold=3, open_s=1.0, latency_factor=5.0,
+            latency_min_samples=8)
+        if resilient else serve.CircuitBreakerConfig(enabled=False))
+    class FakeLLM:
+        """Streaming fake LLM: prefill cost grows with prompt length,
+        then fixed-cadence token chunks — enough to make TTFT/TPOT and
+        replica saturation real without a model."""
+
+        def __call__(self, prompt: str, tokens: int = 16):
+            time.sleep(0.002 * (len(prompt) // 64 + 1))  # "prefill"
+            for i in range(tokens):
+                time.sleep(0.004)  # "decode"
+                yield f"tok{i} "
+
+    return serve.run(FakeLLM.bind(), name="llm" if resilient else "llm-raw",
+                     route_prefix=None), tokens
+
+
+def _replica_actors(deployment: str):
+    import ray_tpu
+
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+    infos = ray_tpu.get(controller.get_replicas.remote(deployment))
+    out = []
+    for info in infos:
+        try:
+            out.append((info.replica_id,
+                        ray_tpu.get_actor(info.actor_name,
+                                          namespace="serve")))
+        except Exception:  # noqa: BLE001 - replica racing away
+            pass
+    return out
+
+
+class _LoadGen:
+    """Closed-loop client pool: each client runs request after request
+    until the phase deadline, recording one row per request."""
+
+    def __init__(self, handle, tokens: int):
+        self._handle = handle
+        self._tokens = tokens
+        self.rows: list[dict] = []
+        self._lock = threading.Lock()
+
+    def _one_request(self, rng: random.Random, phase: str) -> None:
+        from ray_tpu.serve import resilience
+
+        prompt = _make_prompt(rng)
+        row = {"phase": phase, "start": time.time()}
+        t0 = time.perf_counter()
+        try:
+            gen = self._handle.options(
+                stream=True, route_hint=_route_hint(prompt)).remote(
+                    prompt, self._tokens)
+            ttft, last, gaps = None, None, []
+            for _chunk in gen:
+                now = time.perf_counter()
+                if ttft is None:
+                    ttft = now - t0
+                else:
+                    gaps.append(now - last)
+                last = now
+            row.update(outcome="ok", ttft=ttft, gaps=gaps,
+                       total=time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 - outcome classification
+            kind = resilience.classify(e)
+            if kind in ("overloaded_router", "overloaded_replica"):
+                row.update(outcome="shed", where=kind)
+            elif kind == "expired":
+                row.update(outcome="expired")
+            else:
+                row.update(outcome="failed", error=repr(e)[:200])
+            row["total"] = time.perf_counter() - t0
+        row["end"] = time.time()
+        with self._lock:
+            self.rows.append(row)
+        if row["outcome"] == "shed":
+            time.sleep(0.05)  # client-side backoff on 503, as a client would
+
+    def run_phase(self, phase: str, clients: int, duration_s: float,
+                  burst: int = 0) -> list[dict]:
+        """Run ``clients`` closed-loop workers for ``duration_s``;
+        ``burst`` fires that many extra one-shot requests at phase start
+        (open-loop spike on top of the closed-loop floor)."""
+        stop = time.monotonic() + duration_s
+        threads = []
+
+        def worker(seed):
+            rng = random.Random(seed)
+            while time.monotonic() < stop:
+                self._one_request(rng, phase)
+
+        def burst_worker(seed):
+            self._one_request(random.Random(seed), phase)
+
+        for i in range(clients):
+            t = threading.Thread(target=worker, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+        for i in range(burst):
+            t = threading.Thread(target=burst_worker, args=(1000 + i,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=duration_s + 60)
+        with self._lock:
+            return [r for r in self.rows if r["phase"] == phase]
+
+
+def _summarize(rows: list[dict], duration_s: float,
+               counters: dict) -> dict:
+    ok = [r for r in rows if r["outcome"] == "ok"]
+    ttfts = [r["ttft"] for r in ok if r.get("ttft") is not None]
+    gaps = [g for r in ok for g in r.get("gaps", ())]
+    good = [r for r in ok
+            if (r.get("ttft") or 0) <= SLO_TTFT_S
+            and r["total"] <= SLO_E2E_S]
+    return {
+        "requests": len(rows),
+        "ok": len(ok),
+        "shed": sum(1 for r in rows if r["outcome"] == "shed"),
+        "expired": sum(1 for r in rows if r["outcome"] == "expired"),
+        "failed": sum(1 for r in rows if r["outcome"] == "failed"),
+        "failed_errors": sorted({r.get("error", "")
+                                 for r in rows
+                                 if r["outcome"] == "failed"})[:4],
+        "p50_ttft_s": _pctl(ttfts, 0.50),
+        "p99_ttft_s": _pctl(ttfts, 0.99),
+        "p50_tpot_s": _pctl(gaps, 0.50),
+        "p99_tpot_s": _pctl(gaps, 0.99),
+        "p99_e2e_s": _pctl([r["total"] for r in ok], 0.99),
+        "throughput_rps": round(len(ok) / duration_s, 2),
+        "goodput_rps": round(len(good) / duration_s, 2),
+        "resilience_counters": counters,
+    }
+
+
+def _phase(gen: _LoadGen, name: str, clients: int, duration_s: float,
+           burst: int = 0, during=None) -> dict:
+    before = _counters_snapshot()
+    extra: dict = {}
+    runner: list = []
+    if during is not None:
+        def _side():
+            extra.update(during() or {})
+
+        side = threading.Thread(target=_side, daemon=True)
+        side.start()
+        runner.append(side)
+    rows = gen.run_phase(name, clients, duration_s, burst=burst)
+    for t in runner:
+        t.join(timeout=60)
+    out = _summarize(rows, duration_s, _counters_delta(before))
+    out.update(extra)
+    return out
+
+
+def _kill_one_replica(deployment: str, after_s: float):
+    """Phase side-task: kill a replica actor mid-burst; measure recovery
+    (kill -> deployment HEALTHY at full replica count again) and the
+    error-free point (last non-shed failure seen by clients is in the
+    phase rows; recovery here is the control-plane view)."""
+    import ray_tpu
+
+    def run():
+        time.sleep(after_s)
+        actors = _replica_actors(deployment)
+        if not actors:
+            return {"kill": "no-replica-found"}
+        rid, actor = actors[0]
+        controller = ray_tpu.get_actor("SERVE_CONTROLLER",
+                                       namespace="serve")
+        t_kill = time.time()
+        ray_tpu.kill(actor)
+        recovered = None
+        deadline = time.monotonic() + 30
+        # Recovered = the controller noticed the death (the killed id is
+        # gone from the published replica set) AND a replacement restored
+        # the full count. Plain status() would read HEALTHY for the first
+        # few hundred ms after the kill — the corpse still counts as
+        # RUNNING until a health probe fails.
+        while time.monotonic() < deadline:
+            try:
+                infos = ray_tpu.get(
+                    controller.get_replicas.remote(deployment))
+                ids = [i.replica_id for i in infos if not i.draining]
+                if rid not in ids and len(ids) >= NUM_REPLICAS:
+                    recovered = time.time()
+                    break
+            except Exception:  # noqa: BLE001 - controller busy
+                pass
+            time.sleep(0.05)
+        return {"killed_replica": rid, "kill_ts": t_kill,
+                "time_to_recover_s":
+                    round(recovered - t_kill, 3) if recovered else None}
+
+    return run
+
+
+def run_bench(quick: bool = False, out_path: str | None = None) -> dict:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.chaos import injector
+
+    dur = 4.0 if quick else 8.0
+    tokens = 12 if quick else 16
+    injector.reset_for_tests()
+    ray_tpu.shutdown()
+    ray_tpu.init()
+    phases: dict = {}
+    try:
+        handle, tokens = _deploy(resilient=True, tokens=tokens)
+        gen = _LoadGen(handle, tokens)
+
+        # -- baseline: closed loop at capacity
+        phases["baseline"] = _phase(gen, "baseline", CAPACITY, dur)
+
+        # -- overload: 2x capacity + an arrival burst on top
+        phases["overload"] = _phase(gen, "overload", 2 * CAPACITY, dur,
+                                    burst=CAPACITY)
+
+        # -- latency outlier: chaos-delay one replica; the breaker
+        #    blacklists it
+        victims = _replica_actors("FakeLLM")
+        if victims:
+            slow_rid = victims[-1][0]
+            injector.install([{
+                "point": "serve.replica", "action": "delay",
+                "match": {"replica": slow_rid}, "delay_s": 0.8,
+                "count": -1}])
+            try:
+                phases["latency_outlier"] = _phase(
+                    gen, "latency_outlier", CAPACITY, dur)
+                phases["latency_outlier"]["slowed_replica"] = slow_rid
+            finally:
+                injector.clear()
+
+        # -- chaos kill mid-burst, resilience ON
+        phases["chaos_kill"] = _phase(
+            gen, "chaos_kill", CAPACITY, max(dur, 6.0), burst=CAPACITY // 2,
+            during=_kill_one_replica("FakeLLM", after_s=1.0))
+
+        serve.shutdown()
+
+        # -- the same kill with the resilience layer OFF: raw errors
+        handle_raw, _ = _deploy(resilient=False, tokens=tokens)
+        gen_raw = _LoadGen(handle_raw, tokens)
+        phases["chaos_kill_raw"] = _phase(
+            gen_raw, "chaos_kill_raw", CAPACITY, max(dur, 6.0),
+            burst=CAPACITY // 2,
+            during=_kill_one_replica("FakeLLMRaw", after_s=1.0))
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        injector.reset_for_tests()
+        ray_tpu.shutdown()
+
+    base = phases["baseline"]
+    over = phases["overload"]
+    kill = phases["chaos_kill"]
+    raw = phases.get("chaos_kill_raw", {})
+    ttft_bound = max(3.0 * (base["p99_ttft_s"] or 0.1), 2.0)
+    acceptance = {
+        # A chaos replica kill mid-burst with retries+breaker on: no
+        # client saw a raw failure (shed/expired are explicit backpressure,
+        # not failures), p99 TTFT stayed bounded.
+        "kill_zero_failed_non_shed": kill["failed"] == 0,
+        "kill_p99_ttft_bounded":
+            (kill["p99_ttft_s"] or 1e9) <= ttft_bound,
+        "kill_p99_ttft_bound_s": round(ttft_bound, 3),
+        # The same kill without the layer produced the raw errors this PR
+        # exists to remove.
+        "raw_kill_shows_errors": raw.get("failed", 0) > 0,
+        # 2x-capacity overload sheds explicitly...
+        "overload_sheds": over["shed"] > 0,
+        # ...while goodput holds within 10% of pre-overload throughput.
+        "overload_goodput_within_10pct":
+            over["goodput_rps"] >= 0.9 * base["throughput_rps"],
+        "recovered_after_kill":
+            kill.get("time_to_recover_s") is not None,
+        "breaker_tripped_on_latency_outlier":
+            phases.get("latency_outlier", {}).get(
+                "resilience_counters", {}).get(
+                    "breaker_transitions", 0) >= 1,
+    }
+    report = {
+        "bench": "serve_load",
+        "quick": quick,
+        "config": {
+            "num_replicas": NUM_REPLICAS,
+            "max_ongoing_requests": MAX_ONGOING,
+            "closed_loop_clients_at_capacity": CAPACITY,
+            "tokens_per_request": tokens,
+            "phase_duration_s": dur,
+            "slo": {"ttft_s": SLO_TTFT_S, "e2e_s": SLO_E2E_S},
+        },
+        "phases": phases,
+        "acceptance": acceptance,
+        "all_accepted": all(v for k, v in acceptance.items()
+                            if isinstance(v, bool)),
+        "provenance": {
+            "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "cpus": os.cpu_count(),
+            "loadavg": list(os.getloadavg()),
+            "box_note": (
+                "in-process runtime on a small CPU box: replicas are "
+                "thread actors, a kill is ray_tpu.kill (named actor "
+                "deregistered, queued calls fail never-sent, in-flight "
+                "threads finish) — the serve layer sees the same error "
+                "surface as a process death minus mid-call connection "
+                "resets. The fake LLM's sleeps emulate prefill/decode; "
+                "absolute latencies are box artifacts, the on/off and "
+                "pre/post-overload comparisons are the signal."),
+        },
+    }
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PERF_SERVE_LOAD.json")
+    doc = report
+    if quick and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                existing = json.load(f)
+            if not existing.get("quick"):
+                existing["quick_refresh"] = report
+                doc = existing
+        except Exception:  # noqa: BLE001
+            pass
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return report
+
+
+if __name__ == "__main__":
+    rep = run_bench(quick="--quick" in sys.argv[1:])
+    print(json.dumps(rep, indent=2))

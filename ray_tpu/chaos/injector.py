@@ -43,6 +43,14 @@ Probe points and their attrs:
   mind — a broad delay can age out heartbeats sharing the connection).
   ``drop`` swallows the request (the caller sees a timeout / hang,
   exactly like a lost datagram to a wedged peer).
+- ``serve.replica`` — every serve data-plane request as it enters a
+  replica (before the user callable); attrs ``deployment``, ``replica``,
+  ``method`` (``method`` is a regex key). ``delay`` makes the replica a
+  latency outlier (circuit-breaker food), ``error`` feeds
+  consecutive-failure tracking, ``kill`` is a replica death mid-request
+  (use ``mode="raise"`` on in-process runtimes — ``"exit"`` takes the
+  whole interpreter). ``drop`` is not meaningful at a sync call site and
+  is ignored.
 
 Kills are real: ``mode="exit"`` calls ``os._exit`` so the process dies
 without cleanup (SIGKILL semantics). ``mode="raise"`` raises
@@ -74,7 +82,7 @@ _ALLOWED_KEYS = {
     "delay_s", "mode", "exit_code", "mark",
 }
 _ACTIONS = ("kill", "delay", "drop", "error")
-_POINTS = ("train.step", "daemon.tick", "rpc.server")
+_POINTS = ("train.step", "daemon.tick", "rpc.server", "serve.replica")
 _REGEX_KEYS = ("method", "node")
 
 

@@ -1001,19 +1001,37 @@ class ClusterRuntime:
     def _node_transfer_info(self, node_id: str) -> tuple | None:
         """Cached node_id -> (transfer_addr, object_plane) for alive nodes
         with a native data plane (5s TTL). object_plane carries the node's
-        arena name + host boot id for same-host zero-copy reads."""
+        arena name + host boot id for same-host zero-copy reads.
+
+        An UNKNOWN-id miss also refreshes (rate-limited to one head round
+        trip per 0.5s): a node that joined after the last snapshot would
+        otherwise be invisible to the native plane for a full TTL,
+        silently detouring its pulls onto the RPC chunk path. Alive nodes
+        WITHOUT a native plane are cached as explicit None entries so
+        their pulls don't re-trigger the miss refresh at 2 Hz forever."""
         now = time.monotonic()
         cached = self._xfer_cache
-        if cached is None or now - cached[0] > 5.0:
+        stale = cached is None or now - cached[0] > 5.0
+        if not stale and node_id not in cached[1] and now - cached[0] > 0.5:
+            stale = True
+        if stale:
             try:
                 nodes = self.head.call("list_nodes")
             except Exception:
                 return None
-            cached = self._xfer_cache = (now, {
-                nid: (tuple(info["transfer_addr"]),
-                      info.get("object_plane"))
+            snapshot = {
+                nid: ((tuple(info["transfer_addr"]),
+                       info.get("object_plane"))
+                      if info.get("transfer_addr") else None)
                 for nid, info in nodes.items()
-                if info.get("alive") and info.get("transfer_addr")})
+                if info.get("alive")}
+            if node_id not in snapshot:
+                # Queried id is GONE (dead/departed node behind stale
+                # object locations): negative-cache it too, or every
+                # retried pull re-triggers this refresh at 2 Hz until
+                # the locations age out.
+                snapshot[node_id] = None
+            cached = self._xfer_cache = (now, snapshot)
         return cached[1].get(node_id)
 
     def _node_transfer_addr(self, node_id: str) -> tuple | None:
@@ -2528,9 +2546,13 @@ class ClusterRuntime:
         for item in st.retrying:
             self._store_error_local(item.return_ids, err)
         st.retrying = []
+        # Pending calls never hit the wire: flagged never_sent so callers
+        # (serve's router) may re-route them without double-execution risk.
+        unsent = ActorDiedError(err.actor_id_hex, err.reason,
+                                never_sent=True)
         while st.pending:
             item = st.pending.popleft()
-            self._store_error_local(item.return_ids, err)
+            self._store_error_local(item.return_ids, unsent)
 
     async def _actor_push(self, st: _ActorState, client: AsyncRpcClient,
                           item: _TaskItem, fut) -> None:

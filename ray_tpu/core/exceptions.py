@@ -48,13 +48,22 @@ class ActorError(RayTpuError):
 
 
 class ActorDiedError(ActorError):
-    def __init__(self, actor_id_hex: str = "", reason: str = ""):
+    """``never_sent=True`` marks calls that provably never reached the dead
+    actor (queued caller-side / drained from an unstarted mailbox): they
+    cannot have executed, so retrying them is safe even for
+    non-idempotent methods. Calls that were in flight on the dead
+    incarnation keep the default False (at-most-once: they may have run)."""
+
+    def __init__(self, actor_id_hex: str = "", reason: str = "",
+                 never_sent: bool = False):
         self.actor_id_hex = actor_id_hex
         self.reason = reason
+        self.never_sent = never_sent
         super().__init__(f"actor {actor_id_hex[:12]} died: {reason}")
 
     def __reduce__(self):
-        return (ActorDiedError, (self.actor_id_hex, self.reason))
+        return (ActorDiedError, (self.actor_id_hex, self.reason,
+                                 self.never_sent))
 
 
 class ActorUnavailableError(ActorError):

@@ -597,7 +597,10 @@ class LocalRuntime:
             state = self._actors.get(spec.actor_id)
         if state is None or state.dead:
             reason = state.death_reason if state else "unknown actor"
-            err = ActorDiedError(spec.actor_id.hex() if spec.actor_id else "", reason)
+            # The call never entered the mailbox: flagged never_sent so
+            # serve's router may safely re-route it to a live replica.
+            err = ActorDiedError(spec.actor_id.hex() if spec.actor_id else "",
+                                 reason, never_sent=True)
             self._store_error(return_ids, err)
             return [make(oid, self.worker_id) for oid in return_ids]
         state.mailbox.put(spec)
@@ -622,13 +625,16 @@ class LocalRuntime:
         with self._lock:
             if state.spec.name:
                 self._named_actors.pop((state.spec.namespace, state.spec.name), None)
-        # Fail everything still queued.
+        # Fail everything still queued. Queued-but-unstarted calls are
+        # never_sent: they provably did not execute on the dead actor.
         try:
             while True:
                 item = state.mailbox.get_nowait()
                 if item is not None:
                     self._store_error(
-                        item.return_ids(), ActorDiedError(state.spec.actor_id.hex(), reason)
+                        item.return_ids(),
+                        ActorDiedError(state.spec.actor_id.hex(), reason,
+                                       never_sent=True)
                     )
         except queue.Empty:
             pass

@@ -26,6 +26,13 @@ from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.grpc_proxy import GrpcRequest
 from ray_tpu.serve.http_proxy import Request, Response
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.resilience import (
+    CircuitBreakerConfig,
+    DeadlineExceeded,
+    Overloaded,
+    RetryPolicy,
+    current_deadline as request_deadline,
+)
 
 __all__ = [
     "deployment", "Deployment", "Application",
@@ -36,6 +43,8 @@ __all__ = [
     "AutoscalingConfig", "DeploymentConfig",
     "batch", "Request", "Response",
     "multiplexed", "get_multiplexed_model_id",
+    "Overloaded", "DeadlineExceeded", "RetryPolicy",
+    "CircuitBreakerConfig", "request_deadline",
 ]
 
 # usage telemetry (local-only, opt-out — reference: usage_lib auto-records

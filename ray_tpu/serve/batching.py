@@ -28,13 +28,45 @@ class _BatchQueue:
         self._lock = threading.Lock()
 
     def submit(self, instance: Any, item: Any) -> Future:
+        # The request's deadline rides along (thread-local, stamped by the
+        # replica before the user method ran): the batch loop sheds items
+        # that expire while queued instead of spending a batch slot on
+        # them.
+        from ray_tpu.serve.resilience import current_deadline, current_deployment
+
         fut: Future = Future()
-        self.q.put((instance, item, fut))
+        self.q.put((instance, item, fut, current_deadline(),
+                    current_deployment()))
         with self._lock:
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(target=self._loop, daemon=True)
                 self._thread.start()
         return fut
+
+    @staticmethod
+    def _drop_expired(batch: list) -> list:
+        """Fail expired entries (DeadlineExceeded) and return the live
+        rest — run just before the batch executes, where queue wait has
+        already been paid and compute is about to be."""
+        from ray_tpu.serve.resilience import (
+            DeadlineExceeded,
+            expired,
+            shed_metrics,
+        )
+
+        live = []
+        for entry in batch:
+            if expired(entry[3]):
+                entry[2].set_exception(DeadlineExceeded(
+                    "request expired while queued for a batch"))
+                try:
+                    shed_metrics()["expired"].inc(
+                        tags={"deployment": entry[4], "where": "batcher"})
+                except Exception:
+                    pass
+            else:
+                live.append(entry)
+        return live
 
     def _loop(self) -> None:
         while True:
@@ -49,6 +81,9 @@ class _BatchQueue:
                     batch.append(self.q.get(timeout=deadline))
                 except queue.Empty:
                     break
+            batch = self._drop_expired(batch)
+            if not batch:
+                continue
             instance = batch[0][0]
             items = [b[1] for b in batch]
             futs = [b[2] for b in batch]

@@ -10,6 +10,12 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any
 
+from ray_tpu.serve.resilience import (
+    CircuitBreakerConfig,
+    ResilienceSettings,
+    RetryPolicy,
+)
+
 
 @dataclass
 class AutoscalingConfig:
@@ -33,6 +39,41 @@ class DeploymentConfig:
     graceful_shutdown_timeout_s: float = 5.0
     version: str | None = None
 
+    # --- request resilience (see ray_tpu/serve/resilience.py) ---
+    # Default per-request budget: requests carry an absolute deadline of
+    # now + request_timeout_s from the handle (overridable per call via
+    # handle.options(timeout_s=...)); the router bounds queue waits by it
+    # and the replica drops requests that expire before execution starts.
+    request_timeout_s: float = 30.0
+    # Router-side admission control: callers parked waiting for replica
+    # capacity beyond this count are shed with Overloaded (HTTP 503 /
+    # gRPC RESOURCE_EXHAUSTED) instead of queuing unboundedly. -1 removes
+    # the bound (pre-resilience behavior).
+    max_queued_requests: int = 256
+    # Replica-side admission: a replica rejects with Overloaded once its
+    # in-progress requests exceed max_ongoing_requests + this slack. The
+    # router already caps per-router in-flight at max_ongoing_requests;
+    # the slack absorbs the overshoot of several routers (driver handles +
+    # proxies) honestly filling their own caps at once.
+    replica_queue_slack: int = 8
+    # Assignment-level retry/hedge policy (replica deaths, replica-side
+    # sheds, optional tail hedging). RetryPolicy(max_retries=0) disables
+    # policy retries; never-sent failures are still retried once.
+    retry_policy: RetryPolicy = field(default_factory=RetryPolicy)
+    # Per-replica circuit breaker (consecutive failures / latency outlier
+    # → blacklist with half-open recovery probes).
+    circuit_breaker: CircuitBreakerConfig = field(
+        default_factory=CircuitBreakerConfig)
+
+    def resilience_settings(self) -> ResilienceSettings:
+        """The router-facing view of these knobs (published with every
+        replica snapshot)."""
+        return ResilienceSettings(
+            request_timeout_s=self.request_timeout_s,
+            max_queued_requests=self.max_queued_requests,
+            retry=self.retry_policy,
+            breaker=self.circuit_breaker)
+
     # resources per replica
     ray_actor_options: dict = field(default_factory=dict)
     # Gang resources per replica (reference: serve deployment
@@ -46,12 +87,20 @@ class DeploymentConfig:
 @dataclass
 class ReplicaInfo:
     """What routers need to know about one live replica (published via
-    long-poll, reference: _private/common.py RunningReplicaInfo)."""
+    long-poll, reference: _private/common.py RunningReplicaInfo).
+
+    ``draining`` replicas are still finishing in-flight work but must not
+    receive new assignments (graceful shutdown / rolling update). The
+    ``settings`` dict is the deployment's ResilienceSettings
+    (deployment-level, duplicated per replica so the snapshot stays a flat
+    list routers already understand)."""
 
     replica_id: str
     deployment_name: str
     actor_name: str
     max_ongoing_requests: int
+    draining: bool = False
+    settings: dict | None = None
 
 
 @dataclass

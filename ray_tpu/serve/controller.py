@@ -53,6 +53,7 @@ class _DeploymentState:
     version: str
     replicas: list[_Replica] = field(default_factory=list)
     deleting: bool = False
+    published: list | None = None  # last replica snapshot sent to routers
     # autoscaling bookkeeping
     last_metric_pull: float = 0.0
     total_ongoing: float = 0.0
@@ -140,6 +141,41 @@ class ServeController:
         with self._lock:
             return dict(self._routes)
 
+    def report_replica_unhealthy(self, deployment_name: str,
+                                 replica_id: str, reason: str = "") -> None:
+        """Router circuit-breaker feedback: a breaker opened on this
+        replica. Counts as one failed health check AND schedules an
+        immediate out-of-band probe — a genuinely sick replica fails it
+        and gets replaced for every router, while a healthy-but-slow one
+        passes and stays up (blacklisted only where the breaker saw the
+        latency). Repeated breaker trips therefore converge on replacement
+        without letting one router's opinion kill a replica outright."""
+        with self._lock:
+            ds = self._deployments.get(deployment_name)
+            if ds is None:
+                return
+            for r in ds.replicas:
+                if r.replica_id == replica_id and r.state == RUNNING:
+                    # Reports alone must never reach the replacement
+                    # threshold — several routers (driver + each proxy)
+                    # tripping at once would stop a slow-but-healthy
+                    # replica before its probe returns. Cap one below:
+                    # only an actually failed/timed-out probe pushes over.
+                    r.consecutive_failures = min(
+                        r.consecutive_failures + 1,
+                        ds.config.max_consecutive_health_failures - 1)
+                    if r.health_ref is None:
+                        # Probe on the next reconcile. Only when no probe
+                        # is already outstanding: zeroing health_sent_at
+                        # under an in-flight probe would trip the
+                        # stale-probe timeout branch — a spurious SECOND
+                        # strike that also discards the (likely passing)
+                        # probe result.
+                        r.health_sent_at = 0.0
+                    ds.message = (f"router breaker opened on "
+                                  f"{replica_id}: {reason}")
+                    break
+
     def get_app_ingresses(self) -> dict[str, str]:
         """app name -> ingress deployment, including HTTP-less (gRPC-only,
         route_prefix=None) applications."""
@@ -190,7 +226,6 @@ class ServeController:
             items = list(self._deployments.items())
         for name, ds in items:
             with self._lock:
-                before = self._running_infos(ds)
                 self._check_starting(ds)
                 self._check_health(ds)
                 self._autoscale(ds)
@@ -198,7 +233,16 @@ class ServeController:
                 self._scale_and_roll(ds, target)
                 self._reap_stopped(ds)
                 after = self._running_infos(ds)
-                if [r.replica_id for r in before] != [r.replica_id for r in after]:
+                # Compare against the LAST PUBLISHED snapshot, not a
+                # same-pass before (a settings-only redeploy swaps
+                # ds.config between passes — an intra-pass before/after
+                # would already both carry the new settings and compare
+                # equal). Dataclass equality covers the settings dict, so
+                # draining transitions AND settings-only redeploys (e.g.
+                # tightening max_queued_requests during an incident, which
+                # rolls no replicas) both reach routers.
+                if after != ds.published:
+                    ds.published = after
                     self._long_poll.notify_changed(f"replicas:{name}", after)
                 if ds.deleting and not ds.replicas:
                     del self._deployments[name]
@@ -212,11 +256,25 @@ class ServeController:
         return ds.autoscale_target
 
     def _running_infos(self, ds: _DeploymentState) -> list[ReplicaInfo]:
-        return [ReplicaInfo(replica_id=r.replica_id,
-                            deployment_name=ds.name,
-                            actor_name=r.actor_name,
-                            max_ongoing_requests=ds.config.max_ongoing_requests)
-                for r in ds.replicas if r.state == RUNNING]
+        """Router-facing snapshot: RUNNING replicas plus gracefully-draining
+        ones flagged ``draining=True`` (published, never assigned — a
+        router that saw the pre-drain snapshot must learn the replica is
+        retiring rather than racing new work onto it). Each info carries
+        the deployment-level resilience settings dict."""
+        settings = ds.config.resilience_settings().to_dict()
+        infos = []
+        for r in ds.replicas:
+            draining = r.state == STOPPING and r.drain_ref is not None
+            if r.state != RUNNING and not draining:
+                continue
+            infos.append(ReplicaInfo(
+                replica_id=r.replica_id,
+                deployment_name=ds.name,
+                actor_name=r.actor_name,
+                max_ongoing_requests=ds.config.max_ongoing_requests,
+                draining=draining,
+                settings=settings))
+        return infos
 
     # -- replica lifecycle --
 
@@ -261,16 +319,23 @@ class ServeController:
             sched_kw["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
                 placement_group=rep.pg, placement_group_bundle_index=0)
         Remote = ray_tpu.remote(ServeReplica)
+        # Thread budget must exceed the replica's admission cap
+        # (max_ongoing + queue slack) so over-cap calls actually reach the
+        # admission check and get an Overloaded answer promptly instead of
+        # queuing silently in the actor mailbox.
+        slack = getattr(ds.config, "replica_queue_slack", 8)
         try:
             rep.actor = Remote.options(
                 name=rep.actor_name, namespace="serve",
                 num_cpus=opts.get("num_cpus", 0),
                 num_tpus=opts.get("num_tpus", 0),
                 resources=opts.get("resources"),
-                max_concurrency=ds.config.max_ongoing_requests + 4,
+                max_concurrency=ds.config.max_ongoing_requests + slack + 4,
                 **sched_kw,
             ).remote(ds.name, rep.replica_id, ds.cls_blob, ds.init_args_blob,
-                     ds.config.user_config)
+                     ds.config.user_config,
+                     max_ongoing_requests=ds.config.max_ongoing_requests,
+                     replica_queue_slack=slack)
         except Exception as e:  # noqa: BLE001 - infeasible/registration fail
             ds.message = f"replica actor creation failed: {e!r}"
             self._release_pg(rep)
@@ -339,8 +404,18 @@ class ServeController:
                 try:
                     ray_tpu.get(r.health_ref)
                     r.consecutive_failures = 0
-                except Exception:
-                    r.consecutive_failures += 1
+                except Exception as e:
+                    from ray_tpu.core.exceptions import ActorDiedError
+                    from ray_tpu.serve.resilience import unwrap
+
+                    # A DEAD actor is not a flaky health check: skip the
+                    # 3-strikes grace and replace it now — every second of
+                    # grace is a second of routers retrying into a corpse.
+                    if isinstance(unwrap(e), ActorDiedError):
+                        r.consecutive_failures = \
+                            ds.config.max_consecutive_health_failures
+                    else:
+                        r.consecutive_failures += 1
                 r.health_ref = None
             elif now - r.health_sent_at > ds.config.health_check_timeout_s:
                 r.consecutive_failures += 1

@@ -12,6 +12,7 @@ from dataclasses import replace
 from typing import Any, Callable
 
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.resilience import CircuitBreakerConfig, RetryPolicy
 
 
 def _validate_pg_options(bundles: list | None, strategy: str) -> None:
@@ -63,8 +64,28 @@ class Deployment:
                 graceful_shutdown_timeout_s: float | None = None,
                 ray_actor_options: dict | None = None,
                 placement_group_bundles: list | None = None,
-                placement_group_strategy: str | None = None) -> "Deployment":
+                placement_group_strategy: str | None = None,
+                request_timeout_s: float | None = None,
+                max_queued_requests: int | None = None,
+                replica_queue_slack: int | None = None,
+                retry_policy: RetryPolicy | dict | None = None,
+                circuit_breaker: CircuitBreakerConfig | dict | None = None
+                ) -> "Deployment":
         cfg = replace(self.config)
+        if request_timeout_s is not None:
+            cfg.request_timeout_s = request_timeout_s
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
+        if replica_queue_slack is not None:
+            cfg.replica_queue_slack = replica_queue_slack
+        if retry_policy is not None:
+            cfg.retry_policy = (RetryPolicy(**retry_policy)
+                                if isinstance(retry_policy, dict)
+                                else retry_policy)
+        if circuit_breaker is not None:
+            cfg.circuit_breaker = (CircuitBreakerConfig(**circuit_breaker)
+                                   if isinstance(circuit_breaker, dict)
+                                   else circuit_breaker)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
@@ -103,8 +124,20 @@ def deployment(_func_or_class: Callable | None = None, *,
                graceful_shutdown_timeout_s: float = 5.0,
                ray_actor_options: dict | None = None,
                placement_group_bundles: list | None = None,
-               placement_group_strategy: str = "PACK"):
-    """``@serve.deployment`` (reference: serve/api.py deployment decorator)."""
+               placement_group_strategy: str = "PACK",
+               request_timeout_s: float = 30.0,
+               max_queued_requests: int = 256,
+               replica_queue_slack: int = 8,
+               retry_policy: RetryPolicy | dict | None = None,
+               circuit_breaker: CircuitBreakerConfig | dict | None = None):
+    """``@serve.deployment`` (reference: serve/api.py deployment decorator).
+
+    Resilience knobs (full semantics on DeploymentConfig /
+    ray_tpu/serve/resilience.py): ``request_timeout_s`` is the default
+    per-request budget, ``max_queued_requests`` bounds the router queue
+    (shed with Overloaded beyond it), ``replica_queue_slack`` bounds
+    replica-side admission, ``retry_policy`` configures assignment retries
+    and tail hedging, ``circuit_breaker`` the per-replica blacklist."""
 
     def deco(func_or_class: Callable) -> Deployment:
         if placement_group_bundles is not None or \
@@ -115,6 +148,11 @@ def deployment(_func_or_class: Callable | None = None, *,
             asc = AutoscalingConfig(**autoscaling_config)
         else:
             asc = autoscaling_config
+        rp = (RetryPolicy(**retry_policy) if isinstance(retry_policy, dict)
+              else retry_policy) or RetryPolicy()
+        cb = (CircuitBreakerConfig(**circuit_breaker)
+              if isinstance(circuit_breaker, dict)
+              else circuit_breaker) or CircuitBreakerConfig()
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
@@ -126,6 +164,11 @@ def deployment(_func_or_class: Callable | None = None, *,
             ray_actor_options=ray_actor_options or {},
             placement_group_bundles=placement_group_bundles,
             placement_group_strategy=placement_group_strategy,
+            request_timeout_s=request_timeout_s,
+            max_queued_requests=max_queued_requests,
+            replica_queue_slack=replica_queue_slack,
+            retry_policy=rp,
+            circuit_breaker=cb,
         )
         return Deployment(func_or_class,
                           name or func_or_class.__name__, cfg)

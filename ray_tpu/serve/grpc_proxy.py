@@ -97,29 +97,45 @@ class GrpcProxyActor:
         return None
 
     def _call(self, method: str, request: bytes, context, stream: bool):
+        import grpc
+
         md = {k: str(v) for k, v in (context.invocation_metadata() or [])}
         dep = self._pick(md)
         if dep is None:
-            import grpc
-
             context.abort(grpc.StatusCode.NOT_FOUND,
                           "no serve application for this call")
         req = GrpcRequest(method=method, data=bytes(request or b""),
                           metadata=md)
-        gen = self._get_handle(dep).options(stream=True).remote(req)
-        gen.timeout = 60.0
+        # Deadline: the client's native gRPC deadline (time_remaining)
+        # becomes the serve request budget, propagated through router,
+        # replica admission, and batcher.
+        timeout_s = None
+        try:
+            rem = context.time_remaining()
+            if rem is not None and rem > 0:
+                timeout_s = rem
+        except Exception:
+            pass
+        try:
+            gen = self._get_handle(dep).options(
+                stream=True, timeout_s=timeout_s).remote(req)
+        except Exception as e:  # noqa: BLE001 - mapped below
+            self._abort_resilience(context, e)
+            raise
+        gen.timeout = timeout_s or 60.0
         if stream:
-            return (_encode(c) for c in gen)
+            return self._stream_chunks(gen, context)
         # Unary: take exactly the first chunk. A bare next() would leak
         # StopIteration through the grpc handler as an opaque UNKNOWN error,
         # and silently drop any extra chunks the deployment yields.
         try:
             first = next(gen)
         except StopIteration:
-            import grpc
-
             context.abort(grpc.StatusCode.OUT_OF_RANGE,
                           "deployment yielded no response for unary call")
+        except Exception as e:  # noqa: BLE001 - mapped below
+            self._abort_resilience(context, e)
+            raise
         finally:
             close = getattr(gen, "close", None)
             if close is not None:
@@ -128,6 +144,38 @@ class GrpcProxyActor:
                 except Exception:
                     pass
         return _encode(first)
+
+    def _stream_chunks(self, gen, context):
+        try:
+            for c in gen:
+                yield _encode(c)
+        except Exception as e:  # noqa: BLE001 - mapped below
+            self._abort_resilience(context, e)
+            raise
+
+    @staticmethod
+    def _abort_resilience(context, err: BaseException) -> None:
+        """Map resilience failures to canonical gRPC codes (reference:
+        serve's gRPC proxy surfaces backpressure as RESOURCE_EXHAUSTED so
+        clients with retry policies back off):
+
+        - Overloaded       → RESOURCE_EXHAUSTED (retry-after in details)
+        - DeadlineExceeded → DEADLINE_EXCEEDED
+
+        Anything else falls through to the default UNKNOWN mapping."""
+        import grpc
+
+        from ray_tpu.serve import resilience
+
+        cause = resilience.unwrap(err)
+        if isinstance(cause, resilience.Overloaded):
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"overloaded ({cause.where}); "
+                f"retry after {cause.retry_after_s:.1f}s")
+        if isinstance(cause, (resilience.DeadlineExceeded, TimeoutError)):
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                          "request deadline exceeded")
 
     def _get_handle(self, deployment_name: str):
         from ray_tpu.serve.handle import DeploymentHandle

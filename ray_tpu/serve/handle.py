@@ -4,32 +4,230 @@ Capability parity with the reference's handle (reference:
 python/ray/serve/handle.py — DeploymentHandle.remote() → DeploymentResponse;
 handles are picklable and rebuild their router lazily in the receiving
 process, so deployments compose by passing handles through init args).
+
+The handle is also where the resilience layer's retry/hedge loop lives
+(ray_tpu/serve/resilience.py): a DeploymentResponse owns the request's
+deadline and, on replica death or replica-side rejection, re-routes through
+the shared router excluding replicas already tried. Requests that provably
+never reached a replica (``ActorDiedError.never_sent``) get one transparent
+re-resolve + retry even with the policy disabled — the dead replica may
+still be in the long-poll snapshot, but the router's exclusion set skips it
+and a healthy sibling answers instead of the caller seeing the raw error.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 import ray_tpu
+from ray_tpu.serve import resilience
 from ray_tpu.serve.long_poll import LongPollClient
 from ray_tpu.serve.router import Router
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
 
+_UNSET = object()
+
 
 class DeploymentResponse:
-    """Future-like result of a handle call."""
+    """Future-like result of a handle call, with the retry/hedge loop.
 
-    def __init__(self, ref):
-        self._ref = ref
+    ``result()`` drives the attempts: it waits on every outstanding attempt
+    at once, takes the first completion, and on a retryable failure
+    (classified by resilience.classify against the deployment's
+    RetryPolicy) submits a fresh attempt through the router with all tried
+    replicas excluded. Tail hedging launches one duplicate attempt after
+    ``hedge_after_s`` of silence; the first response wins (the loser runs
+    to completion on its replica — hedging trades work for tail latency,
+    opt in only for idempotent deployments)."""
+
+    def __init__(self, router: Router | None, method_name: str = "",
+                 args: tuple = (), kwargs: dict | None = None,
+                 deadline: float | None = None,
+                 route_hint: str | None = None, ref=None):
+        self._router = router
+        self._method = method_name
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._deadline = deadline
+        self._hint = route_hint
+        self._lock = threading.RLock()
+        self._attempts: list[tuple[Any, str]] = []  # (ref, replica_id)
+        self._tried: set[str] = set()
+        self._retries_used = 0
+        self._never_sent_used = False
+        self._hedged = False
+        self._born = time.time()
+        self._outcome = _UNSET
+        self._outcome_err: BaseException | None = None
+        if ref is not None:  # pre-resolved (composition/back-compat)
+            self._attempts.append((ref, ""))
+        else:
+            # Sheds (Overloaded) surface here synchronously; a replica that
+            # vanished before submit is retried through _maybe_retry (the
+            # router wraps it as a never-sent ActorDiedError).
+            try:
+                self._submit_attempt()
+            except BaseException as err:
+                if not self._maybe_retry(err, self._policy(),
+                                         self._deadline):
+                    raise
+
+    # ------------------------------------------------------------- attempts
+
+    def _submit_attempt(self):
+        ref, rid = self._router.assign_request(
+            self._method, self._args, self._kwargs,
+            deadline=self._deadline, route_hint=self._hint,
+            exclude=frozenset(self._tried))
+        if rid:
+            self._tried.add(rid)
+        self._attempts.append((ref, rid))
+        self._last_submit = time.time()  # hedge timer anchor
+        return ref
+
+    def _policy(self) -> resilience.RetryPolicy:
+        return self._router.settings.retry if self._router is not None \
+            else resilience.RetryPolicy(max_retries=0)
 
     def result(self, timeout: float | None = 60.0) -> Any:
-        return ray_tpu.get(self._ref, timeout=timeout)
+        with self._lock:
+            if self._outcome is not _UNSET:
+                if self._outcome_err is not None:
+                    raise self._outcome_err
+                return self._outcome
+            try:
+                value = self._drive(timeout)
+            except BaseException as e:
+                # Cache only TERMINAL outcomes. A DeadlineExceeded caused
+                # by the CALLER's wait cap — the request's own budget
+                # intact, an attempt still in flight — is transient:
+                # result(timeout=longer) must be able to re-poll (the
+                # pre-resilience ray_tpu.get(ref, timeout=) semantics).
+                transient = (isinstance(e, (resilience.DeadlineExceeded,
+                                            TimeoutError))
+                             and bool(self._attempts)
+                             and not resilience.expired(self._deadline))
+                if not transient:
+                    self._outcome, self._outcome_err = None, e
+                raise
+            self._outcome = value
+            return value
+
+    def _drive(self, timeout: float | None) -> Any:
+        deadline = self._deadline
+        if timeout is not None:
+            cap = time.time() + timeout
+            deadline = cap if deadline is None else min(deadline, cap)
+        policy = self._policy()
+        while True:
+            remaining = None if deadline is None else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                raise resilience.DeadlineExceeded(
+                    f"deployment call {self._method!r} exceeded its budget")
+            seg = remaining if remaining is not None else 3600.0
+            hedge_at = None
+            if policy.hedge_after_s is not None and not self._hedged \
+                    and len(self._attempts) == 1:
+                # Anchored at the LAST submit, not response creation: after
+                # a retry, the fresh attempt earns a full hedge window of
+                # observed silence — hedging a just-submitted retry would
+                # double load exactly while replicas are failing.
+                hedge_at = getattr(self, "_last_submit", self._born) \
+                    + policy.hedge_after_s
+                seg = min(seg, max(hedge_at - time.time(), 0.0))
+            refs = [ref for ref, _ in self._attempts]
+            done, _ = ray_tpu.wait(refs, num_returns=1,
+                                   timeout=max(seg, 0.005))
+            if not done:
+                if hedge_at is not None and time.time() >= hedge_at:
+                    self._launch_hedge()
+                continue
+            ref = done[0]
+            rid = next(r for f, r in self._attempts if f is ref)
+            try:
+                return ray_tpu.get(ref, timeout=0)
+            except BaseException as err:  # noqa: BLE001 - classified below
+                self._attempts = [(f, r) for f, r in self._attempts
+                                  if f is not ref]
+                if self._attempts:
+                    continue  # a hedge sibling is still in flight
+                if not self._maybe_retry(err, policy, deadline):
+                    raise
+
+    def _launch_hedge(self) -> None:
+        """Duplicate the request on a replica not yet tried; best-effort
+        and NON-BLOCKING (no_park): if every untried replica is saturated
+        there is no hedge — parking would consume an admission slot and
+        inject a guaranteed-wasted duplicate the moment the original's
+        completion frees capacity."""
+        self._hedged = True
+        try:
+            ref, rid = self._router.assign_request(
+                self._method, self._args, self._kwargs,
+                deadline=self._deadline, route_hint=None,
+                exclude=frozenset(self._tried), no_park=True)
+        except Exception:
+            return
+        if rid:
+            self._tried.add(rid)
+        self._attempts.append((ref, rid))
+        self._router.count_hedge()
+
+    def _maybe_retry(self, err: BaseException,
+                     policy: resilience.RetryPolicy,
+                     deadline: float | None) -> bool:
+        """Submit a replacement attempt if the failure warrants one."""
+        if self._router is None:
+            return False
+        kind = resilience.classify(err)
+        # Exclude the failed replica even when the failure predates a
+        # recorded attempt (submit-time death carries the replica id).
+        failed_rid = getattr(resilience.unwrap(err), "actor_id_hex", "")
+        if failed_rid:
+            self._tried.add(failed_rid)
+        if kind == "never_sent" and not self._never_sent_used and \
+                policy.retry_never_sent:
+            # The call never reached the dead replica: one transparent
+            # re-resolve + retry, independent of the policy budget (cannot
+            # have executed, so safe even for non-idempotent methods).
+            self._never_sent_used = True
+        elif resilience.is_retryable(kind, policy) and \
+                self._retries_used < policy.max_retries:
+            self._retries_used += 1
+            if policy.backoff_s > 0:
+                import random as _random
+
+                pause = policy.backoff_s * (2 ** (self._retries_used - 1))
+                pause *= _random.random()  # full jitter
+                if deadline is not None:
+                    pause = min(pause, max(deadline - time.time(), 0.0))
+                time.sleep(pause)
+        else:
+            return False
+        try:
+            self._submit_attempt()
+        except Exception:
+            return False  # shed/expired on resubmit: surface the original
+        self._router.count_retry()
+        return True
 
     def _to_object_ref(self):
-        return self._ref
+        # Composition: downstream calls consume the CURRENT attempt's ref.
+        # A later retry can't rebind an already-passed ref; the downstream
+        # call then sees the original failure — same semantics as before
+        # the resilience layer.
+        if not self._attempts:
+            # Every attempt failed and was drained by result(): re-raise
+            # the recorded failure instead of an opaque IndexError.
+            if self._outcome_err is not None:
+                raise self._outcome_err
+            raise resilience.DeadlineExceeded(
+                f"deployment call {self._method!r} has no live attempt")
+        return self._attempts[0][0]
 
 
 class DeploymentResponseGenerator:
@@ -37,18 +235,45 @@ class DeploymentResponseGenerator:
     DeploymentResponseGenerator, handle.options(stream=True)). The first
     item from the replica is a meta dict ({"streaming": bool}); it is
     consumed here and exposed as ``.streaming``. ``timeout`` bounds the wait
-    for each chunk."""
+    for each chunk.
 
-    def __init__(self, ref_gen, on_done=None, timeout: float = 60.0):
+    Resilience: failures BEFORE the first user chunk re-route like unary
+    retries (never-sent always, replica deaths within the policy budget) —
+    no output was observed, so a fresh attempt on a sibling replica is
+    transparent. Once chunks have flowed the stream cannot be resumed
+    mid-output; errors surface to the consumer. First-chunk success and
+    mid-stream failures feed the router's circuit breaker."""
+
+    def __init__(self, ref_gen, on_done=None, timeout: float = 60.0,
+                 router: Router | None = None, replica_id: str = "",
+                 resubmit=None):
         self._gen = ref_gen
         self._meta = None
         self._on_done = on_done
         self.timeout = timeout
+        self._router = router
+        self._rid = replica_id
+        self._resubmit = resubmit  # (exclude) -> ((gen, on_done), rid)
+        self._tried = {replica_id} if replica_id else set()
+        self._retries_used = 0
+        self._never_sent_used = False
+        self._born = time.perf_counter()
+        self._first_chunk_seen = False
 
     @property
     def meta(self) -> dict:
         if self._meta is None:
-            self._meta = ray_tpu.get(self._gen._next(self.timeout))
+            try:
+                self._meta = self._next_chunk(for_meta=True)
+            except BaseException:
+                # Meta-frame failure is how every replica-side shed/
+                # expiry/app-error of a streaming request surfaces (the
+                # proxies read .streaming first): release the router's
+                # in-flight slot NOW — leaving it to __del__ lets callers
+                # that keep failed generators alive read as permanent
+                # saturation.
+                self._done()
+                raise
         return self._meta
 
     @property
@@ -61,12 +286,89 @@ class DeploymentResponseGenerator:
     def __next__(self) -> Any:
         self.meta  # ensure consumed
         try:
-            return ray_tpu.get(self._gen._next(self.timeout))
+            chunk = self._next_chunk()
+        except StopIteration:
+            self._done()
+            raise
         except BaseException:
             self._done()
             raise
+        if not self._first_chunk_seen:
+            self._first_chunk_seen = True
+            if self._router is not None and self._rid:
+                self._router.record_stream_outcome(
+                    self._rid, True, time.perf_counter() - self._born)
+        return chunk
+
+    def _next_chunk(self, for_meta: bool = False) -> Any:
+        while True:
+            try:
+                if not for_meta and self._meta is None:
+                    # A retry swapped in a fresh attempt mid-iteration: its
+                    # first frame is the META dict, which must be consumed
+                    # here — returning it as a data chunk would hand the
+                    # consumer a {"streaming": ...} payload AND swallow the
+                    # real first chunk as meta on the next call.
+                    self._meta = ray_tpu.get(self._gen._next(self.timeout))
+                return ray_tpu.get(self._gen._next(self.timeout))
+            except StopIteration:
+                raise
+            except BaseException as err:  # noqa: BLE001 - classified
+                if self._recover(err):
+                    continue
+                raise
+
+    def _recover(self, err: BaseException) -> bool:
+        """Re-route a failed stream that produced no user output yet."""
+        if self._router is not None and self._rid:
+            kind = resilience.classify(err)
+            # Same breaker contract as the unary completion watcher:
+            # every failure except explicit backpressure (shed/expired)
+            # counts — a replica answering only errors is routed around,
+            # whether the error came from infrastructure or the model.
+            # One carve-out WITHIN "expired": a per-chunk stall (plain
+            # TimeoutError with the request's own budget still intact) is
+            # the replica producing NOTHING for the whole chunk window —
+            # the hung-but-health-checks-pass mode — and does count.
+            cause = resilience.unwrap(err)
+            stalled = (isinstance(cause, TimeoutError)
+                       and not isinstance(cause,
+                                          resilience.DeadlineExceeded))
+            if kind not in ("overloaded_replica", "overloaded_router",
+                            "expired") or (kind == "expired" and stalled):
+                self._router.record_stream_outcome(self._rid, False)
+        if self._first_chunk_seen or self._resubmit is None:
+            return False
+        kind = resilience.classify(err)
+        policy = (self._router.settings.retry if self._router is not None
+                  else resilience.RetryPolicy(max_retries=0))
+        if kind == "never_sent" and not self._never_sent_used and \
+                policy.retry_never_sent:
+            self._never_sent_used = True
+        elif resilience.is_retryable(kind, policy) and \
+                self._retries_used < policy.max_retries:
+            self._retries_used += 1
+        else:
+            return False
+        try:
+            (gen, on_done), rid = self._resubmit(frozenset(self._tried))
+        except Exception:
+            return False
+        # Swap in the fresh attempt; release the failed one's router slot.
+        self._done()
+        self._gen, self._on_done = gen, on_done
+        self._meta = None  # re-consume the new attempt's meta frame
+        if rid:
+            self._tried.add(rid)
+        self._rid = rid
+        if self._router is not None:
+            self._router.count_retry()
+        return True
 
     def _done(self):
+        # Probe-slot settlement for abandoned streams lives in the
+        # router's on_done closure (it knows whether THIS request's
+        # admission consumed a half-open probe slot).
         if self._on_done is not None:
             cb, self._on_done = self._on_done, None
             try:
@@ -116,6 +418,7 @@ class DeploymentHandle:
         self._stream = False
         self._mux_id: str | None = None
         self._route_hint: str | None = None
+        self._timeout_s: float | None = None  # None = deployment default
         self._lock = threading.Lock()
         self._router: Router | None = None
         self._poll: LongPollClient | None = None
@@ -125,7 +428,8 @@ class DeploymentHandle:
     def options(self, method_name: str | None = None,
                 stream: bool | None = None,
                 multiplexed_model_id: str | None = None,
-                route_hint: str | None = None) -> "DeploymentHandle":
+                route_hint: str | None = None,
+                timeout_s: float | None = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self.app_name,
                              method_name or self._method_name)
         h._stream = self._stream if stream is None else stream
@@ -133,10 +437,14 @@ class DeploymentHandle:
         # is readable replica-side via serve.get_multiplexed_model_id()
         # (reference: handle.options(multiplexed_model_id=...)). route_hint
         # is the bare affinity key (reference: prefix-aware routing).
+        # timeout_s overrides the deployment's request_timeout_s as this
+        # call's total budget (deadline = now + timeout_s at .remote()).
         h._mux_id = multiplexed_model_id \
             if multiplexed_model_id is not None else self._mux_id
         h._route_hint = route_hint if route_hint is not None \
             else self._route_hint
+        h._timeout_s = timeout_s if timeout_s is not None \
+            else self._timeout_s
         return h
 
     def __getattr__(self, name: str):
@@ -156,14 +464,38 @@ class DeploymentHandle:
         hint = self._route_hint or self._mux_id
         if self._mux_id:
             kwargs["__rtpu_mux_id"] = self._mux_id  # replica context
+        timeout_s = self._timeout_s if self._timeout_s is not None \
+            else router.settings.request_timeout_s
+        deadline = resilience.make_deadline(timeout_s)
         if self._stream:
-            gen, on_done = router.assign_request(self._method_name, args,
-                                                 kwargs, stream=True,
-                                                 route_hint=hint)
-            return DeploymentResponseGenerator(gen, on_done=on_done)
-        ref = router.assign_request(self._method_name, args, kwargs,
-                                    route_hint=hint)
-        return DeploymentResponse(ref)
+            method = self._method_name
+
+            def resubmit(exclude):
+                return router.assign_request(method, args, kwargs,
+                                             stream=True, route_hint=hint,
+                                             deadline=deadline,
+                                             exclude=exclude)
+
+            try:
+                (gen, on_done), rid = router.assign_request(
+                    method, args, kwargs, stream=True, route_hint=hint,
+                    deadline=deadline)
+            except BaseException as err:
+                # Never-sent submit failure: one transparent re-resolve
+                # excluding the vanished replica (mirrors the unary path).
+                if resilience.classify(err) != "never_sent" or \
+                        not router.settings.retry.retry_never_sent:
+                    raise
+                dead = getattr(resilience.unwrap(err), "actor_id_hex", "")
+                (gen, on_done), rid = resubmit(
+                    frozenset({dead} if dead else ()))
+                router.count_retry()
+            return DeploymentResponseGenerator(
+                gen, on_done=on_done, router=router, replica_id=rid,
+                resubmit=resubmit,
+                timeout=timeout_s if timeout_s is not None else 60.0)
+        return DeploymentResponse(router, self._method_name, args, kwargs,
+                                  deadline=deadline, route_hint=hint)
 
     def _ensure_router(self) -> Router:
         from ray_tpu.core.worker import global_worker
@@ -201,17 +533,29 @@ class DeploymentHandle:
                 controller = ray_tpu.get_actor(CONTROLLER_NAME,
                                                namespace=SERVE_NAMESPACE)
                 key = f"replicas:{self.deployment_name}"
+                dep_name = self.deployment_name
 
                 def listen(kv: dict, timeout: float) -> dict:
                     return ray_tpu.get(controller.listen.remote(kv, timeout),
                                        timeout=timeout + 30)
 
-                def on_update(_key, _snap):
+                def on_update(_key, snap):
                     # Wake router assign loops parked on saturation — a new
-                    # replica set may have capacity.
+                    # replica set may have capacity — and let the router
+                    # adopt settings / GC breaker state from the snapshot.
                     r = self._router
                     if r is not None:
-                        r.notify_replicas_changed()
+                        r.notify_replicas_changed(snap or [])
+
+                def report_unhealthy(replica_id: str, reason: str) -> None:
+                    # Breaker-open → controller health check nudge. Fire
+                    # and forget: the returned ref is dropped, and a dead
+                    # controller must never take the data plane with it.
+                    try:
+                        controller.report_replica_unhealthy.remote(
+                            dep_name, replica_id, reason)
+                    except Exception:
+                        pass
 
                 self._poll = LongPollClient(listen, [key], callback=on_update)
                 # Seed synchronously so the first request doesn't race the
@@ -223,7 +567,10 @@ class DeploymentHandle:
                 def get_replicas():
                     return self._poll.get(key) or []
 
-                self._router = Router(self.deployment_name, get_replicas)
+                self._router = Router(self.deployment_name, get_replicas,
+                                      report_unhealthy=report_unhealthy)
+                if seed:
+                    self._router.notify_replicas_changed(seed)
             return self._router
 
     def __reduce__(self):

@@ -74,9 +74,21 @@ class ProxyActor:
                 try:
                     hint = (self.headers.get("x-route-hint")
                             or _prefix_route_hint(body))
+                    # Per-request budget: the x-request-timeout-s header
+                    # overrides the deployment's request_timeout_s; the
+                    # deadline rides the call end to end (router queue,
+                    # replica admission, batcher).
+                    timeout_s = None
+                    raw_t = self.headers.get("x-request-timeout-s")
+                    if raw_t:
+                        try:
+                            timeout_s = max(float(raw_t), 0.001)
+                        except ValueError:
+                            timeout_s = None
                     gen = proxy._get_handle(dep).options(
-                        stream=True, route_hint=hint).remote(req)
-                    gen.timeout = 60.0  # bound a wedged replica per chunk
+                        stream=True, route_hint=hint,
+                        timeout_s=timeout_s).remote(req)
+                    gen.timeout = timeout_s or 60.0  # bound per chunk
                     if gen.streaming:
                         # SSE/chunk streaming: write each produced chunk as
                         # it arrives; length-delimited by connection close
@@ -104,7 +116,31 @@ class ProxyActor:
                             pass
                         return
                     result = next(gen)
-                except Exception as e:  # noqa: BLE001 - surface as 500
+                except Exception as e:  # noqa: BLE001 - mapped below
+                    # Resilience-aware status mapping (reference: serve
+                    # returns 503 on backpressure so clients/load balancers
+                    # back off instead of piling on):
+                    #   Overloaded        → 503 + Retry-After
+                    #   DeadlineExceeded  → 504 (budget spent in-cluster)
+                    #   anything else     → 500
+                    from ray_tpu.serve import resilience
+
+                    cause = resilience.unwrap(e)
+                    if isinstance(cause, resilience.Overloaded):
+                        self.send_response(503)
+                        self.send_header(
+                            "Retry-After",
+                            str(max(1, int(cause.retry_after_s))))
+                        self.end_headers()
+                        self.wfile.write(
+                            f"overloaded ({cause.where})".encode())
+                        return
+                    if isinstance(cause, (resilience.DeadlineExceeded,
+                                          TimeoutError)):
+                        self.send_response(504)
+                        self.end_headers()
+                        self.wfile.write(b"request deadline exceeded")
+                        return
                     self.send_response(500)
                     self.end_headers()
                     self.wfile.write(repr(e).encode())

@@ -74,18 +74,35 @@ class LongPollClient:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    # Reconnect backoff bounds: first retry after ~BACKOFF_BASE_S, doubling
+    # to BACKOFF_MAX_S, each with full jitter. A controller restart with
+    # hundreds of routers/proxies polling must see staggered reconnects,
+    # not a synchronized thundering herd every fixed 0.2 s.
+    BACKOFF_BASE_S = 0.1
+    BACKOFF_MAX_S = 5.0
+
     def _loop(self) -> None:
+        import random
+
         from ray_tpu.core.worker import global_worker
 
+        failures = 0
         while not self._stopped.is_set():
             if global_worker.runtime is not self._born_runtime:
                 return  # our runtime is gone; stop polling
             try:
                 updates = self._listen(dict(self._versions), self._poll_timeout)
+                failures = 0
             except Exception:
                 if self._stopped.is_set():
                     return
-                time.sleep(0.2)
+                # Jittered exponential backoff on controller connection
+                # loss (sleep in [0, cap) — full jitter decorrelates the
+                # fleet's retries while keeping the mean at cap/2).
+                failures += 1
+                cap = min(self.BACKOFF_MAX_S,
+                          self.BACKOFF_BASE_S * (2 ** min(failures, 16)))
+                self._stopped.wait(random.random() * cap)
                 continue
             if not isinstance(updates, dict):
                 # Defensive: a malformed/stale reply (e.g. from an actor

@@ -12,6 +12,12 @@ import threading
 import time
 from typing import Any
 
+from ray_tpu.serve.resilience import (
+    DEADLINE_KEY,
+    DeadlineExceeded,
+    Overloaded,
+    _set_current_deadline,
+)
 from ray_tpu.utils import serialization
 
 _replica_metrics = None
@@ -63,7 +69,10 @@ class ServeReplica:
 
     def __init__(self, deployment_name: str, replica_id: str,
                  cls_blob: bytes, init_args_blob: bytes,
-                 user_config: Any = None):
+                 user_config: Any = None, max_ongoing_requests: int = 0,
+                 replica_queue_slack: int = 8):
+        from ray_tpu.serve.resilience import shed_metrics
+
         self.deployment_name = deployment_name
         self.replica_id = replica_id
         cls = serialization.deserialize(cls_blob)
@@ -74,20 +83,57 @@ class ServeReplica:
             self._callable = cls  # plain function deployment
         self._ongoing = 0
         self._total = 0
+        self._shed = 0
+        self._expired = 0
+        # Replica-side admission cap: every router caps its OWN in-flight
+        # at max_ongoing_requests, but N independent routers can each fill
+        # that cap against one replica; beyond the slack the replica says
+        # Overloaded instead of queuing unboundedly. 0 = no self-defense
+        # (router caps only).
+        self._admit_cap = (max_ongoing_requests + replica_queue_slack
+                           if max_ongoing_requests > 0 else 0)
         self._lock = threading.Lock()
         self._started_at = time.time()
         self._m = _get_replica_metrics()
+        self._sm = shed_metrics()
         self._dep_tag = {"deployment": deployment_name}
         self._rep_tag = {"deployment": deployment_name,
                          "replica": replica_id}
         if user_config is not None:
             self.reconfigure(user_config)
 
-    def _begin_request(self) -> None:
+    def _begin_request(self, deadline: float | None = None) -> None:
+        """Admission: shed when over the replica-side cap; drop requests
+        whose deadline already passed — BEFORE any user/TPU work runs (a
+        request that waited out its budget in queues must not spend
+        compute producing an answer nobody is waiting for)."""
+        from ray_tpu.serve.resilience import expired as _expired
+
         # Gauge set under the same lock as the counter: interleaved sets
         # outside it could publish a stale ongoing value that sticks until
         # the next request.
         with self._lock:
+            if self._admit_cap and self._ongoing >= self._admit_cap:
+                self._shed += 1
+                try:
+                    self._sm["shed"].inc(tags={**self._dep_tag,
+                                               "where": "replica"})
+                except Exception:
+                    pass
+                raise Overloaded(
+                    f"replica {self.replica_id} at admission cap "
+                    f"({self._admit_cap} ongoing)",
+                    retry_after_s=0.5, where="replica")
+            if _expired(deadline):
+                self._expired += 1
+                try:
+                    self._sm["expired"].inc(tags={**self._dep_tag,
+                                                  "where": "replica"})
+                except Exception:
+                    pass
+                raise DeadlineExceeded(
+                    f"request expired before execution on replica "
+                    f"{self.replica_id}")
             self._ongoing += 1
             self._total += 1
             try:
@@ -106,14 +152,51 @@ class ServeReplica:
 
     # -- data plane --
 
+    def _chaos_probe(self, method_name: str) -> None:
+        """serve.replica chaos point: kill (mode="raise" for in-process
+        runtimes), error, and delay rules exercise the resilience layer
+        end to end — a delay makes this replica a latency outlier (breaker
+        food), an error feeds consecutive-failure tracking, a kill is a
+        replica death mid-request."""
+        from ray_tpu.chaos import injector
+
+        if not injector.ACTIVE:
+            return
+        rule = injector.decide("serve.replica",
+                               deployment=self.deployment_name,
+                               replica=self.replica_id, method=method_name)
+        if rule is None:
+            return
+        injector.write_mark(rule, "serve.replica",
+                            {"deployment": self.deployment_name,
+                             "replica": self.replica_id,
+                             "method": method_name})
+        if rule.action == "delay":
+            time.sleep(max(0.0, float(rule.delay_s)))
+        elif rule.action == "error":
+            raise RuntimeError(
+                f"chaos: injected error at serve.replica "
+                f"({self.deployment_name}/{self.replica_id})")
+        elif rule.action == "kill":
+            if rule.mode == "raise":
+                raise injector.ChaosKilled(
+                    f"chaos: injected kill at serve.replica "
+                    f"({self.replica_id})")
+            import os as _os
+
+            _os._exit(rule.exit_code)
+
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
         from ray_tpu.serve.multiplex import _set_multiplexed_model_id
 
         mux_id = kwargs.pop("__rtpu_mux_id", "")
+        deadline = kwargs.pop(DEADLINE_KEY, None)
         _set_multiplexed_model_id(mux_id)
-        self._begin_request()
+        self._begin_request(deadline)
+        _set_current_deadline(deadline, self.deployment_name)
         t0 = time.perf_counter()
         try:
+            self._chaos_probe(method_name)
             if method_name == "__call__":
                 target = self._callable
                 if not callable(target):
@@ -132,6 +215,7 @@ class ServeReplica:
                 pass
             return result
         finally:
+            _set_current_deadline(None)
             self._end_request()
 
     def handle_request_streaming(self, method_name: str, args: tuple,
@@ -146,9 +230,12 @@ class ServeReplica:
         from ray_tpu.serve.multiplex import _set_multiplexed_model_id
 
         _set_multiplexed_model_id(kwargs.pop("__rtpu_mux_id", ""))
-        self._begin_request()
+        deadline = kwargs.pop(DEADLINE_KEY, None)
+        self._begin_request(deadline)
+        _set_current_deadline(deadline, self.deployment_name)
         t0 = time.perf_counter()
         try:
+            self._chaos_probe(method_name)
             if method_name == "__call__":
                 target = self._callable
             else:
@@ -174,6 +261,7 @@ class ServeReplica:
                 pass
             yield result
         finally:
+            _set_current_deadline(None)
             self._end_request()
 
     def _instrumented_stream(self, gen, t0: float):
@@ -222,7 +310,8 @@ class ServeReplica:
     def get_metrics(self) -> dict:
         with self._lock:
             return {"replica_id": self.replica_id, "ongoing": self._ongoing,
-                    "total": self._total}
+                    "total": self._total, "shed": self._shed,
+                    "expired": self._expired}
 
     def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)

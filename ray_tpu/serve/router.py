@@ -4,17 +4,36 @@ Capability parity with the reference's router (reference:
 python/ray/serve/_private/router.py:510 Router.assign_request :1028 →
 request_router/pow_2_router.py:27 PowerOfTwoChoicesRequestRouter
 .choose_replicas :52 — sample two replicas, pick the one with the smaller
-queue; requests queue router-side when all replicas are saturated).
+queue; requests queue router-side when all replicas are saturated), plus
+the request-resilience layer (ray_tpu/serve/resilience.py):
+
+- queue waits are bounded by the request's absolute deadline;
+- admission control sheds with :class:`Overloaded` once
+  ``max_queued_requests`` callers are parked (bounded queues, not
+  unbounded latency);
+- the choose loop never picks a draining replica, a replica the caller
+  already tried (retry exclusion), or one whose circuit breaker is open;
+- per-replica breakers track consecutive failures and latency outliers
+  from the completion watcher, blacklist sick replicas with half-open
+  recovery probes, and nudge the controller's health check on open.
 """
 
 from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Callable
 
 import ray_tpu
 from ray_tpu.serve.config import ReplicaInfo
+from ray_tpu.serve.resilience import (
+    DEADLINE_KEY,
+    CircuitBreaker,
+    DeadlineExceeded,
+    Overloaded,
+    ResilienceSettings,
+)
 from ray_tpu.util import tracing
 
 _router_metrics = None
@@ -23,7 +42,8 @@ _router_metrics_lock = threading.Lock()
 
 def _get_router_metrics():
     """Process-wide router metrics: admission wait, parked-caller depth,
-    and request count per deployment (reference: serve's
+    request count, and the resilience counters (shed/expired/retry/hedge/
+    breaker) per deployment (reference: serve's
     ray_serve_num_router_requests / queued gauges). Lock-guarded creation:
     two racing first-requests must not register two metric objects and
     strand increments on the one the exporter can't see."""
@@ -45,13 +65,30 @@ def _get_router_metrics():
             "requests": Counter(
                 "serve_router_requests_total",
                 "requests assigned to replicas", tag_keys=("deployment",)),
+            "retries": Counter(
+                "serve_retries_total",
+                "assignment retries after replica failure/rejection",
+                tag_keys=("deployment",)),
+            "hedges": Counter(
+                "serve_hedges_total",
+                "tail-hedge duplicate attempts launched",
+                tag_keys=("deployment",)),
+            "breaker_transitions": Counter(
+                "serve_breaker_transitions_total",
+                "circuit breaker open transitions",
+                tag_keys=("deployment", "replica")),
+            "breaker_open": Gauge(
+                "serve_breaker_open_replicas",
+                "replicas currently blacklisted by the circuit breaker",
+                tag_keys=("deployment",)),
         }
     return _router_metrics
 
 
 class Router:
     def __init__(self, deployment_name: str,
-                 get_replicas: Callable[[], list[ReplicaInfo]]):
+                 get_replicas: Callable[[], list[ReplicaInfo]],
+                 report_unhealthy: Callable[[str, str], None] | None = None):
         self._deployment = deployment_name
         self._get_replicas = get_replicas
         self._inflight: dict[str, int] = {}  # replica_id -> local in-flight
@@ -59,50 +96,148 @@ class Router:
         self._not_saturated = threading.Condition(self._lock)
         self._rng = random.Random()
         self._waiting = 0  # callers parked for capacity (queue-depth gauge)
+        # Set by _choose_locked (under _lock) when the chosen replica's
+        # admission consumed a half-open breaker probe slot; read by
+        # assign_request immediately after, per request.
+        self._choice_was_probe = False
+        self._report_unhealthy = report_unhealthy
+        self.settings = ResilienceSettings()
+        self._settings_adopted = False
+        self.breaker = CircuitBreaker(self.settings.breaker,
+                                      on_open=self._on_breaker_open)
+
+    # ------------------------------------------------------------ settings
+
+    def _adopt_settings(self, replicas: list[ReplicaInfo]) -> None:
+        """Adopt the deployment-level resilience settings riding the newest
+        replica snapshot (cheap: dict identity check short-circuits)."""
+        for r in replicas:
+            s = getattr(r, "settings", None)
+            if s is not None:
+                if s is not getattr(self, "_last_settings_dict", None):
+                    self._last_settings_dict = s
+                    self.settings = ResilienceSettings.from_dict(s)
+                    self.breaker.config = self.settings.breaker
+                self._settings_adopted = True
+                return
+
+    def _on_breaker_open(self, replica_id: str, reason: str) -> None:
+        mtr = _get_router_metrics()
+        try:
+            mtr["breaker_transitions"].inc(
+                tags={"deployment": self._deployment, "replica": replica_id})
+            mtr["breaker_open"].set(
+                self.breaker.open_count(),
+                tags={"deployment": self._deployment})
+        except Exception:
+            pass
+        # Feed the controller's health check: a breaker trip means THIS
+        # router has stopped routing there, but only the controller can
+        # probe-and-replace a genuinely sick replica for everyone.
+        if self._report_unhealthy is not None:
+            try:
+                self._report_unhealthy(replica_id, reason)
+            except Exception:
+                pass
+
+    # ---------------------------------------------------------- data plane
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       timeout: float = 30.0, stream: bool = False,
-                       route_hint: str | None = None):
+                       timeout: float | None = None, stream: bool = False,
+                       route_hint: str | None = None,
+                       deadline: float | None = None,
+                       exclude: set[str] | frozenset[str] | None = None,
+                       no_park: bool = False):
         """Pick a replica (pow-2 on local in-flight counts), submit, and
-        return the result ObjectRef. Blocks while every replica is at
-        max_ongoing_requests (router-side queuing, reference behavior).
+        return ``(result, replica_id)`` where result is the ObjectRef (or
+        ``(gen, on_done)`` when streaming). One attempt — retry/hedge loops
+        live in the handle, which excludes already-tried replicas here.
+
+        The wait for a replica slot is bounded by ``deadline`` (absolute
+        wall clock; defaults to now + the deployment's request_timeout_s,
+        or the legacy ``timeout`` argument when given). While every
+        eligible replica is saturated the caller parks on a Condition that
+        is notified on request completion and on replica-set changes — no
+        sleep-poll — but only ``settings.max_queued_requests`` callers may
+        park: beyond that, :class:`Overloaded` sheds the request
+        immediately (admission control, reference: serve's
+        max_queued_requests handle option).
 
         ``route_hint`` biases placement for cache locality: the same hint
         routes to the same replica while that replica's load stays within a
         bounded delta of the least-loaded one (reference: multiplexed-model
-        routing, request_router/multiplex + the prefix-aware policy in llm
-        routing_policies/prefix_aware — affinity-by-key with a balance
+        routing + the prefix-aware policy — affinity-by-key with a balance
         threshold, so a shared system prompt can't pin a whole deployment
-        to one replica).
-
-        Admission is event-driven: when every replica is saturated the
-        caller parks on a Condition that is notified on request completion
-        and on replica-set changes — no sleep-poll (reference:
-        serve/_private/router.py:510 wakes assign loops on config/ongoing-
-        request events)."""
-        import time as _time
+        to one replica)."""
+        from ray_tpu.serve.resilience import shed_metrics
 
         mtr = _get_router_metrics()
+        smtr = shed_metrics()
         dep_tag = {"deployment": self._deployment}
-        t_enter = _time.monotonic()
-        deadline = t_enter + timeout
+        t_enter = time.time()
+        if deadline is None:
+            budget = timeout if timeout is not None \
+                else self.settings.request_timeout_s
+            deadline = t_enter + budget
         with self._lock:
             parked = False
             try:
                 while True:
                     replicas = self._get_replicas()
-                    chosen = (self._choose_locked(replicas, route_hint)
+                    if replicas and not self._settings_adopted:
+                        self._adopt_settings(replicas)
+                    if replicas and exclude and all(
+                            r.replica_id in exclude or
+                            getattr(r, "draining", False)
+                            for r in replicas):
+                        # Retry exclusion covers every published replica:
+                        # nothing a wake can change for THIS call — fail
+                        # fast so the handle surfaces the original error
+                        # instead of a full-budget park that also occupies
+                        # an admission slot (a 0.5s retry-after shed must
+                        # not become a 30s stall on a 1-replica app).
+                        raise Overloaded(
+                            f"{self._deployment!r}: every replica already "
+                            f"tried by this request", retry_after_s=0.5,
+                            where="router")
+                    chosen = (self._choose_locked(replicas, route_hint,
+                                                  exclude)
                               if replicas else None)
                     if chosen is not None:
+                        is_probe = self._choice_was_probe
                         self._inflight[chosen.replica_id] = \
                             self._inflight.get(chosen.replica_id, 0) + 1
                         break
-                    remaining = deadline - _time.monotonic()
+                    remaining = deadline - time.time()
                     if remaining <= 0:
-                        raise TimeoutError(
+                        smtr["expired"].inc(tags={**dep_tag,
+                                                  "where": "router"})
+                        raise DeadlineExceeded(
                             f"no available replica for {self._deployment!r} "
-                            f"within {timeout}s")
+                            f"within the request budget "
+                            f"({deadline - t_enter:.1f}s)")
                     if not parked:
+                        if no_park:
+                            # Internal opportunistic assignment (hedging):
+                            # take a free slot now or give up — a hedge
+                            # that parks would add load exactly at
+                            # saturation and block the caller's drive
+                            # loop. Not counted as a shed: never
+                            # user-visible.
+                            raise Overloaded(
+                                f"{self._deployment!r} has no free replica "
+                                f"for an opportunistic assignment",
+                                retry_after_s=0.0, where="router")
+                        cap = self.settings.max_queued_requests
+                        if cap >= 0 and self._waiting >= cap:
+                            # Bounded router queue: shed instead of joining
+                            # an unbounded wait (the client owns backoff).
+                            smtr["shed"].inc(tags={**dep_tag,
+                                                   "where": "router"})
+                            raise Overloaded(
+                                f"{self._deployment!r} router queue full "
+                                f"({cap} waiting)",
+                                retry_after_s=1.0, where="router")
                         parked = True
                         self._waiting += 1
                         mtr["queue_depth"].set(self._waiting, tags=dep_tag)
@@ -114,17 +249,34 @@ class Router:
                 if parked:
                     self._waiting -= 1
                     mtr["queue_depth"].set(self._waiting, tags=dep_tag)
-        mtr["queue_wait"].observe(_time.monotonic() - t_enter, tags=dep_tag)
+        mtr["queue_wait"].observe(time.time() - t_enter, tags=dep_tag)
         mtr["requests"].inc(tags=dep_tag)
 
+        # Propagate the budget: the replica drops the request if it expires
+        # before execution starts (and exposes it to user code / batcher).
+        kwargs = dict(kwargs)
+        kwargs[DEADLINE_KEY] = deadline
+
+        rid = chosen.replica_id
         try:
             handle = ray_tpu.get_actor(chosen.actor_name, namespace="serve")
-        except Exception:
+        except Exception as e:
             # Replica vanished between the long-poll snapshot and submission:
             # give the slot back (a leaked increment would read as permanent
-            # saturation) and surface the error to the caller.
-            self._release(chosen.replica_id)
-            raise
+            # saturation), return any half-open probe slot, and count the
+            # miss against the breaker. Surfaced as a NEVER-SENT actor death
+            # (the request provably didn't reach any replica) carrying the
+            # replica id, so the handle's retry loop can exclude it and
+            # re-resolve onto a live sibling.
+            from ray_tpu.core.exceptions import ActorDiedError
+
+            self._release(rid)
+            if is_probe:
+                self.breaker.cancel_probe(rid)
+            self.breaker.record_failure(rid)
+            raise ActorDiedError(
+                rid, f"replica {rid} vanished before submit: {e!r}",
+                never_sent=True) from e
         if stream:
             try:
                 # Client span around submission: inject() rides the
@@ -133,13 +285,16 @@ class Router:
                 with tracing.span(f"serve.request.{self._deployment}",
                                   kind="client",
                                   attributes={"method": method_name,
-                                              "replica": chosen.replica_id,
+                                              "replica": rid,
                                               "stream": "true"}):
                     gen = handle.handle_request_streaming.options(
                         num_returns="streaming").remote(
                             method_name, args, kwargs)
             except Exception:
-                self._release(chosen.replica_id)
+                self._release(rid)
+                if is_probe:
+                    self.breaker.cancel_probe(rid)
+                self.breaker.record_failure(rid)
                 raise
 
             done = threading.Event()
@@ -149,36 +304,139 @@ class Router:
                 # (keeps max_ongoing_requests honest for long-lived SSE).
                 if not done.is_set():
                     done.set()
-                    self._release(chosen.replica_id)
+                    self._release(rid)
+                    if is_probe:
+                        # Settle this request's half-open probe slot if no
+                        # outcome was recorded (abandoned stream): no-op
+                        # once record_success/failure already moved the
+                        # breaker out of half-open.
+                        self.breaker.cancel_probe(rid)
 
-            return gen, on_stream_done
+            return (gen, on_stream_done), rid
         try:
             with tracing.span(f"serve.request.{self._deployment}",
                               kind="client",
                               attributes={"method": method_name,
-                                          "replica": chosen.replica_id}):
+                                          "replica": rid}):
                 ref = handle.handle_request.remote(method_name, args, kwargs)
         except Exception:
-            self._release(chosen.replica_id)
+            self._release(rid)
+            if is_probe:
+                self.breaker.cancel_probe(rid)
+            self.breaker.record_failure(rid)
             raise
+
+        t_submit = time.perf_counter()
 
         def _done():
             try:
                 ray_tpu.wait([ref], num_returns=1, timeout=None,
                              fetch_local=False)
             finally:
-                self._release(chosen.replica_id)
+                # Release the capacity the moment the replica is done:
+                # _observe_outcome may still block on a local result
+                # fetch (cluster mode, large payloads), and parked
+                # callers must not wait out that fetch for a slot the
+                # replica already freed.
+                self._release(rid)
+            latency = time.perf_counter() - t_submit
+            outcome = None
+            try:
+                outcome = self._observe_outcome(ref)
+            finally:
+                if outcome is True:
+                    self.breaker.record_success(rid, latency)
+                elif outcome is False:
+                    self.breaker.record_failure(rid)
+                elif is_probe:
+                    # Neutral (shed/expired/unknown): no health signal
+                    # either way — but THIS request's half-open probe
+                    # slot must be returned so the breaker doesn't wedge
+                    # half-open (and a shed must NOT close the breaker
+                    # on a still-sick replica). Only the probe request
+                    # settles the slot: a non-probe neutral completion
+                    # canceling it would over-admit probes.
+                    self.breaker.cancel_probe(rid)
+                self._refresh_breaker_gauge()
         threading.Thread(target=_done, daemon=True).start()
-        return ref
+        return ref, rid
+
+    def _observe_outcome(self, ref) -> bool | None:
+        """Ternary outcome of the completed call: True = healthy answer,
+        False = failure (infra or application), None = neutral — sheds and
+        deadline expiries say nothing about replica health in EITHER
+        direction (counting a fast shed as success would close a half-open
+        breaker on a still-overloaded replica and seed its cleared latency
+        window with bogus samples). The result is already local (actor
+        replies land in the caller's store), so this get is cheap."""
+        from ray_tpu.serve import resilience
+
+        try:
+            # Bounded get: in cluster mode the reply may still be a local
+            # fetch away after wait(fetch_local=False); a timeout here is
+            # "unknown" (neutral).
+            ray_tpu.get(ref, timeout=5.0)
+            return True
+        except (resilience.Overloaded, resilience.DeadlineExceeded):
+            return None
+        except Exception as e:  # noqa: BLE001 - classify
+            kind = resilience.classify(e)
+            if kind in ("overloaded_replica", "overloaded_router",
+                        "expired"):
+                return None
+            return False
+
+    def _refresh_breaker_gauge(self) -> None:
+        try:
+            _get_router_metrics()["breaker_open"].set(
+                self.breaker.open_count(),
+                tags={"deployment": self._deployment})
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------- feedback
+
+    def record_stream_outcome(self, replica_id: str, ok: bool,
+                              latency_s: float | None = None) -> None:
+        """Breaker feedback for streaming calls: the generator wrapper
+        reports first-chunk success (with TTFT as the latency sample) or a
+        mid-stream failure (the completion watcher can't see stream
+        errors — they surface in the consumer)."""
+        if ok:
+            self.breaker.record_success(replica_id, latency_s or 0.0)
+        else:
+            self.breaker.record_failure(replica_id)
+        self._refresh_breaker_gauge()
+
+    def count_retry(self) -> None:
+        try:
+            _get_router_metrics()["retries"].inc(
+                tags={"deployment": self._deployment})
+        except Exception:
+            pass
+
+    def count_hedge(self) -> None:
+        try:
+            _get_router_metrics()["hedges"].inc(
+                tags={"deployment": self._deployment})
+        except Exception:
+            pass
 
     def _release(self, replica_id: str) -> None:
         with self._lock:
             self._inflight[replica_id] -= 1
             self._not_saturated.notify_all()
 
-    def notify_replicas_changed(self) -> None:
+    def notify_replicas_changed(self,
+                                replicas: list[ReplicaInfo] | None = None
+                                ) -> None:
         """Wake parked assign loops after a replica-set update (called from
-        the long-poll callback in DeploymentHandle)."""
+        the long-poll callback in DeploymentHandle). With the new snapshot
+        in hand, also adopt its settings and garbage-collect breaker state
+        for replicas the controller no longer publishes."""
+        if replicas is not None:
+            self._adopt_settings(replicas)
+            self.breaker.forget([r.replica_id for r in replicas])
         with self._lock:
             self._not_saturated.notify_all()
 
@@ -186,8 +444,25 @@ class Router:
     # be before load balancing overrides cache locality.
     HINT_BALANCE_DELTA = 2
 
+    def _eligible_locked(self, r: ReplicaInfo,
+                         exclude) -> bool:
+        if getattr(r, "draining", False):
+            return False
+        if exclude and r.replica_id in exclude:
+            return False
+        return not self.breaker.is_open(r.replica_id)
+
     def _choose_locked(self, replicas: list[ReplicaInfo],
-                       route_hint: str | None = None) -> ReplicaInfo | None:
+                       route_hint: str | None = None,
+                       exclude: set[str] | frozenset[str] | None = None
+                       ) -> ReplicaInfo | None:
+        """Pow-2 choice over the ELIGIBLE set: never a draining replica,
+        never one the caller already tried, never one whose breaker is
+        open (half-open admission happens below, via breaker.allow)."""
+        self._choice_was_probe = False
+        replicas = [r for r in replicas if self._eligible_locked(r, exclude)]
+        if not replicas:
+            return None
         if route_hint is not None:
             # Rendezvous hashing: every router maps the same hint to the
             # same replica without coordination — but only while the hinted
@@ -209,7 +484,11 @@ class Router:
                 if load >= r.max_ongoing_requests:
                     continue
                 if load - min_load <= self.HINT_BALANCE_DELTA:
-                    return r
+                    ok, probe = self.breaker.allow_ex(r.replica_id)
+                    if ok:
+                        self._choice_was_probe = probe
+                        return r
+                    continue  # half-open and out of probe slots
                 break  # hinted replica overloaded — balance instead
         candidates = (self._rng.sample(replicas, 2)
                       if len(replicas) >= 2 else list(replicas))
@@ -220,6 +499,24 @@ class Router:
                 continue
             if best_load is None or load < best_load:
                 best, best_load = r, load
+        if best is None:
+            return None
+        ok, probe = self.breaker.allow_ex(best.replica_id)
+        if not ok:
+            # Half-open with its probe budget spent: try the other pow-2
+            # candidate; otherwise report saturation (the caller parks and
+            # the breaker re-admits on the next wake).
+            for r in candidates:
+                if r.replica_id == best.replica_id:
+                    continue
+                load = self._inflight.get(r.replica_id, 0)
+                if load < r.max_ongoing_requests:
+                    ok2, probe2 = self.breaker.allow_ex(r.replica_id)
+                    if ok2:
+                        self._choice_was_probe = probe2
+                        return r
+            return None
+        self._choice_was_probe = probe
         return best
 
     def metrics(self) -> dict[str, int]:

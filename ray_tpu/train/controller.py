@@ -164,8 +164,23 @@ class TrainController:
             # The writers push asynchronously: a failure can race the final
             # shard of an otherwise complete step set by milliseconds. Give
             # the plane a short settle window before falling back to the
-            # (much slower) checkpoint tier.
-            deadline = time.monotonic() + 2.0
+            # (much slower) checkpoint tier. Load-scaled: on a contended
+            # host the surviving workers' in-flight pushes take
+            # proportionally longer to land (same policy as the CLI kill
+            # deadlines in tests/test_start_cli.py).
+            settle = 2.0
+            try:
+                import os as _os
+
+                per_core = _os.getloadavg()[0] / max(_os.cpu_count() or 1, 1)
+                # Capped at 4x (8 s): the window only spins while the
+                # ReplicaStores are alive but coverage is incomplete, so
+                # the cost of a miss is bounded checkpoint-fallback delay,
+                # not correctness.
+                settle *= max(1.0, min(4.0, per_core))
+            except OSError:
+                pass
+            deadline = time.monotonic() + settle
             while True:
                 try:
                     best = self._replicas.best_restore(world)

@@ -181,6 +181,33 @@ class Config:
     # appended via RTPU_TRAIN_XLA_PERF_FLAGS_EXTRA (space-separated).
     train_xla_perf_flags: bool = True
 
+    # --- serve request resilience (per-deployment, not env flags) ---
+    # The serve data-plane resilience knobs are deployment-scoped and live
+    # on DeploymentConfig (ray_tpu/serve/config.py), set per deployment via
+    # @serve.deployment(...) — different models need different budgets, so
+    # a process-wide flag would be wrong. Documented here because this file
+    # is the flag registry of record:
+    #   request_timeout_s (30): default per-request budget; the absolute
+    #     deadline rides handle → router → replica → batcher, bounding
+    #     queue waits and dropping expired requests before they spend TPU
+    #     time. Per call: handle.options(timeout_s=...); per HTTP request:
+    #     x-request-timeout-s header; gRPC uses the client's deadline.
+    #   max_queued_requests (256): router admission control — callers
+    #     parked beyond this are shed with Overloaded (HTTP 503 +
+    #     Retry-After / gRPC RESOURCE_EXHAUSTED). -1 = unbounded.
+    #   replica_queue_slack (8): replica-side admission — reject once
+    #     ongoing > max_ongoing_requests + slack (N routers can each fill
+    #     their own per-router cap against one replica).
+    #   retry_policy (RetryPolicy): max_retries (1) assignment retries on
+    #     replica death / replica-side sheds, excluding replicas already
+    #     tried; retry_never_sent (True) single safe retry of calls that
+    #     provably never reached a replica; hedge_after_s (None) tail
+    #     hedging for idempotent calls; backoff_s (0) jittered backoff.
+    #   circuit_breaker (CircuitBreakerConfig): failure_threshold (3)
+    #     consecutive failures → open; open_s (2.0) cooldown;
+    #     half_open_probes (1) trial requests; latency_factor (5.0) /
+    #     latency_min_samples (16) latency-outlier trip vs fleet median.
+
     # --- chaos (ray_tpu/chaos) ---
     # Master gate for the fault-injection layer. Rules come from the
     # RTPU_CHAOS env var (JSON list), RTPU_CHAOS_FILE, the `chaos` CLI verb,

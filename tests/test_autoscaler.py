@@ -22,6 +22,20 @@ from ray_tpu.autoscaler import (
 )
 
 
+def _load_factor() -> float:
+    """Deadline multiplier gated on actual scheduler pressure (same policy
+    as tests/test_start_cli.py): the subprocess-bootstrap drill forks a
+    real node process whose boot (framework import, register) serializes
+    behind unrelated full-suite work on a small box, stretching every
+    scale-up/readiness/terminate deadline. Capped so a pathological
+    loadavg can't turn a real hang into an hour-long wait."""
+    try:
+        per_core = os.getloadavg()[0] / max(os.cpu_count() or 1, 1)
+    except OSError:
+        return 1.0
+    return min(max(per_core, 1.0), 4.0)
+
+
 class TestInstanceFsm:
     def test_happy_path(self):
         mgr = InstanceManager()
@@ -124,7 +138,15 @@ class TestEndToEnd:
             scaler = Autoscaler(config, provider, rt.head)
 
             # Demand beyond the 1-CPU head node: 2 concurrent 1-CPU tasks.
-            @ray_tpu.remote(num_cpus=1)
+            # SPREAD keeps one task in flight per leased worker, so the
+            # excess stays a pending lease request at the daemon — the
+            # demand signal the autoscaler reads. (Default scheduling
+            # pipelines up to 16 queued tasks onto one worker: whenever
+            # the first lease grant beats the burst — warm pools, warm
+            # page cache mid-suite — the whole backlog hides inside the
+            # pipeline and no demand ever surfaces, which made this test
+            # flake by suite order.)
+            @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
             def hold(sec):
                 time.sleep(sec)
                 return 1
@@ -187,14 +209,21 @@ class TestSubprocessBootstrap:
                 f"{rt._head_host}:{rt._head_port}", str(tmp_path))
             scaler = Autoscaler(config, provider, rt.head)
 
-            @ray_tpu.remote(num_cpus=1)
+            # SPREAD so the backlog surfaces as pending lease demand
+            # instead of hiding in one worker's pipeline (see
+            # TestEndToEnd.test_scale_up_then_down).
+            @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
             def hold(sec):
                 time.sleep(sec)
                 return os.environ.get("RTPU_NODE_ID", "")
 
+            # Load-scaled deadlines: the booted node's fork+import+register
+            # and the 3 serialized hold() leases stretch together under
+            # full-suite pressure.
+            lf = _load_factor()
             # Saturate the 1-CPU head so later probes cannot land there.
             refs = [hold.remote(18) for _ in range(3)]
-            deadline = time.monotonic() + 20
+            deadline = time.monotonic() + 20 * lf
             launched = {}
             while time.monotonic() < deadline and not launched:
                 launched = scaler.update()["launched"]
@@ -206,7 +235,7 @@ class TestSubprocessBootstrap:
             assert pid is not None
 
             # RAY_RUNNING once the daemon registered under its node id.
-            deadline = time.monotonic() + 15
+            deadline = time.monotonic() + 15 * lf
             while time.monotonic() < deadline:
                 scaler.update()
                 if scaler.instances.instances((InstanceStatus.RAY_RUNNING,)):
@@ -218,26 +247,41 @@ class TestSubprocessBootstrap:
             # must schedule on the freshly booted process node.
             probes = [hold.options(num_cpus=1, resources={"boot": 0.1})
                       .remote(0) for _ in range(2)]
-            homes = ray_tpu.get(probes, timeout=60)
+            homes = ray_tpu.get(probes, timeout=60 * lf)
             assert all(h.startswith("sub-") for h in homes), homes
-            assert ray_tpu.get(refs, timeout=60)
+            assert ray_tpu.get(refs, timeout=60 * lf)
 
-            # Idle scale-down stops the OS process.
-            deadline = time.monotonic() + 20
+            # Idle scale-down stops the OS process(es). The SPREAD demand
+            # may have launched MORE than one cpu2 node; keep running
+            # update() until the provider has none left — nodes idle (and
+            # terminate) at different times, so stopping at the first
+            # termination would leave the other's process running and its
+            # pid alive.
+            pids = [provider._pid(rec)
+                    for rec in list(provider._nodes.values())]
+            deadline = time.monotonic() + 30 * lf
             terminated = []
-            while time.monotonic() < deadline and not terminated:
-                terminated = scaler.update()["terminated"]
+            while time.monotonic() < deadline and provider._nodes:
+                terminated += scaler.update()["terminated"]
                 time.sleep(0.5)
             assert terminated, "idle node was not terminated"
-            deadline = time.monotonic() + 10
-            while time.monotonic() < deadline:
-                try:
-                    os.kill(pid, 0)
-                    time.sleep(0.2)
-                except ProcessLookupError:
-                    break
-            else:
-                raise AssertionError("node process still alive after stop")
+            assert not provider._nodes, \
+                f"nodes never terminated: {provider._nodes}"
+            # The provider's `ray_tpu stop` subprocess pays interpreter
+            # start + framework import (~seconds on a loaded 1-core box)
+            # before SIGTERM, then up to a 5 s grace before SIGKILL — and
+            # the SPREAD holds leave worker children to reap too.
+            deadline = time.monotonic() + 30 * lf
+            live = [p for p in pids if p is not None]
+            while time.monotonic() < deadline and live:
+                for p in list(live):
+                    try:
+                        os.kill(p, 0)
+                    except ProcessLookupError:
+                        live.remove(p)
+                time.sleep(0.2)
+            assert not live, \
+                f"node process(es) still alive after stop: {live}"
         finally:
             ray_tpu.shutdown()
 

@@ -214,7 +214,14 @@ def _make_recovery_train_fn():
             w = w + 1.0
             replicate({"w": w, "step": step}, step)
             ck = None
-            if rank == 0:
+            # Sparse backstop checkpoints (every 4th step), the production
+            # cadence the recovery bench uses: replicate every step,
+            # checkpoint every minutes. Checkpointing EVERY step made the
+            # replica-tier drill a race — the dying worker's final push
+            # (killed inside the same step's report) had to beat os._exit
+            # to keep replica coverage >= the checkpoint step, so the test
+            # flaked under load.
+            if rank == 0 and step % 4 == 0:
                 d = os.path.join(ctx.storage_path,
                                  f"ck_{step}_{ctx.restart_count}")
                 os.makedirs(d, exist_ok=True)
