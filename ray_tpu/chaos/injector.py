@@ -32,7 +32,8 @@ Probe points and their attrs:
 
 - ``train.step``  — every ``session.report()``; attrs ``rank``, ``slice``,
   ``step``, ``restart``. Kill a worker (match rank) or a whole slice
-  (match slice) mid-step.
+  (match slice) mid-step; ``delay`` sleeps ``delay_s`` inside the matched
+  worker's step = an injected STRAGGLER (watchdog/attribution drills).
 - ``daemon.tick`` — the node daemon's heartbeat loop; attrs ``node``.
   Kill takes the daemon down abruptly (no deregistration) together with
   its worker processes — a node/slice death as the head sees one.
@@ -315,17 +316,24 @@ def write_mark(rule: ChaosRule, point: str, attrs: dict) -> str | None:
 
 
 def maybe_kill(point: str, **attrs) -> None:
-    """Apply a matching kill/error rule at a code-point inside the target
-    process: exit hard (``mode="exit"``), or raise :class:`ChaosKilled` /
-    RuntimeError for in-process targets."""
+    """Apply a matching kill/error/delay rule at a code-point inside the
+    target process: exit hard (``mode="exit"``), raise :class:`ChaosKilled`
+    / RuntimeError for in-process targets, or — for ``delay`` — sleep
+    ``delay_s`` inline. At ``train.step`` a delay rule IS a straggler
+    injection: the matched rank's step time stretches while its peers wait
+    at the allreduce, exactly the fault the watchdog's step-drift detector
+    and straggler attribution exist to catch."""
     rule = decide(point, **attrs)
     if rule is None:
         return
     write_mark(rule, point, attrs)
     if rule.action == "error":
         raise RuntimeError(f"chaos: injected error at {point} ({attrs})")
+    if rule.action == "delay":
+        time.sleep(max(0.0, float(rule.delay_s)))
+        return
     if rule.action != "kill":
-        return  # delay/drop make no sense at a kill probe; ignore
+        return  # drop makes no sense at a kill probe; ignore
     if rule.mode == "raise":
         raise ChaosKilled(f"chaos: injected kill at {point} ({attrs})")
     os._exit(rule.exit_code)

@@ -143,6 +143,18 @@ class HeadServer:
         self._register_handlers()
         self._health_task: asyncio.Task | None = None
         self.placement_groups = None  # attached by placement_group module
+        # Always-on health watchdog (ray_tpu/observability): rolling
+        # time-series store fed by the delta samples piggybacked on
+        # report_telemetry, streaming detectors, incident assembly with
+        # targeted profile captures. None when the gate is off.
+        self.watchdog = None
+        if get_config().watchdog_enabled:
+            from ray_tpu.observability.watchdog import Watchdog
+
+            self.watchdog = Watchdog(
+                train_stats_fn=lambda: self.train_stats,
+                nodes_fn=lambda: self.nodes,
+                profile_fn=self._watchdog_profile)
 
     # ------------------------------------------------------------------ wiring
     def _register_handlers(self):
@@ -175,6 +187,9 @@ class HeadServer:
         r("report_telemetry", self._report_telemetry)
         r("get_telemetry", self._get_telemetry)
         r("get_spans", self._get_spans)
+        r("get_timeseries", self._get_timeseries)
+        r("get_incidents", self._get_incidents)
+        r("watchdog_status", self._watchdog_status)
         r("profile_cluster", self._profile_cluster)
         r("chaos", self._chaos_cluster)
         r("stack_cluster", self._stack_cluster)
@@ -193,10 +208,14 @@ class HeadServer:
         self._health_task = loop.create_task(self._health_loop())
         if self._persist_path:
             self._persist_task = loop.create_task(self._persist_loop())
+        if self.watchdog is not None:
+            self.watchdog.start()
         return addr
 
     async def stop(self):
         self._flush_wal()  # no buffered mutation outlives the server
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self._health_task:
             self._health_task.cancel()
         if self._persist_task:
@@ -1088,13 +1107,21 @@ class HeadServer:
                                 spans: list | None = None,
                                 events: list | None = None,
                                 dropped: int = 0,
-                                train_stats: dict | None = None):
+                                train_stats: dict | None = None,
+                                series: dict | None = None):
         """One batched push from a process's telemetry flusher: its metrics
         snapshot (replaces the previous one for this source), finished
-        spans, and drained task events (reference: per-worker
-        TaskEventBuffer + metrics agent, federated at the GCS/dashboard).
-        ``dropped`` is the reporter's cumulative dropped-event count,
-        surfaced per source in the get_telemetry table."""
+        spans, drained task events, and the delta-encoded watchdog series
+        samples (reference: per-worker TaskEventBuffer + metrics agent,
+        federated at the GCS/dashboard). ``dropped`` is the reporter's
+        cumulative dropped-event count, surfaced per source in the
+        get_telemetry table. The reply carries ``series_resync`` when the
+        watchdog store doesn't know a referenced series id (head restart /
+        source eviction) — the reporter re-declares on its next flush."""
+        out = {"ok": True}
+        if series and self.watchdog is not None:
+            if self.watchdog.ingest(source, node_id, series):
+                out["series_resync"] = True
         if snapshot is not None:
             self.telemetry[source] = {
                 "node_id": node_id, "ts": time.time(),
@@ -1111,11 +1138,11 @@ class HeadServer:
                     if len(self.telemetry) <= 512:
                         break
                     if row["ts"] < cutoff:
-                        self.telemetry.pop(src, None)
+                        self._evict_telemetry_source(src)
                 while len(self.telemetry) > 1024:  # hard cap: shed live rows
                     src = min(self.telemetry,
                               key=lambda s: self.telemetry[s]["ts"])
-                    self.telemetry.pop(src, None)
+                    self._evict_telemetry_source(src)
         if spans:
             self.spans.extend(spans)
         if events:
@@ -1129,7 +1156,15 @@ class HeadServer:
                 src = min(self.train_stats,
                           key=lambda s: self.train_stats[s]["ts"])
                 self.train_stats.pop(src, None)
-        return {"ok": True}
+        return out
+
+    def _evict_telemetry_source(self, source: str) -> None:
+        """Shed one reporter from the snapshot table AND its watchdog
+        series + detector state (a dead worker's rings and baselines must
+        not pin store slots forever)."""
+        self.telemetry.pop(source, None)
+        if self.watchdog is not None:
+            self.watchdog.drop_source(source)
 
     async def _get_telemetry(self, conn: ServerConnection,
                              max_age_s: float = 60.0):
@@ -1145,6 +1180,48 @@ class HeadServer:
     async def _get_spans(self, conn: ServerConnection, limit: int = 50_000):
         spans = list(self.spans)
         return {"spans": spans[-limit:]}
+
+    # ------------------------------------------------------------- watchdog
+    async def _get_timeseries(self, conn: ServerConnection,
+                              name: str | None = None,
+                              source: str | None = None,
+                              node_id: str | None = None,
+                              tags: dict | None = None,
+                              since: float = 0.0, max_points: int = 0,
+                              max_age_s: float = 0.0):
+        if self.watchdog is None:
+            return {"series": [], "enabled": False}
+        return {"series": self.watchdog.store.query(
+            name=name, source=source, node_id=node_id, tags=tags,
+            since=since, max_points=max_points, max_age_s=max_age_s),
+            "enabled": True}
+
+    async def _get_incidents(self, conn: ServerConnection,
+                             since: float = 0.0, limit: int = 100,
+                             incident_id: str | None = None):
+        if self.watchdog is None:
+            return {"incidents": [], "enabled": False}
+        return {"incidents": self.watchdog.list_incidents(
+            since=since, limit=limit, incident_id=incident_id),
+            "enabled": True}
+
+    async def _watchdog_status(self, conn: ServerConnection):
+        if self.watchdog is None:
+            return {"enabled": False}
+        return self.watchdog.status()
+
+    async def _watchdog_profile(self, node_id: str, seconds: float) -> dict:
+        """Targeted capture for incident evidence: ONE node's daemon fans
+        to its workers (the PR-5 profile_node leg, same guardrails on the
+        daemon side). Raises on a dead/unknown node — the watchdog records
+        the error as partial evidence."""
+        if node_id not in self.nodes or not self.nodes[node_id].alive:
+            raise ValueError(f"implicated node {node_id[:16]!r} not alive")
+        cli = await self._daemon_rpc(node_id)
+        return await cli.call(
+            "profile_node", timeout=seconds + 30.0, seconds=seconds,
+            sample_hz=0.0, include_daemon=True,
+            capture_id=f"watchdog-{uuid.uuid4().hex}")
 
     # ------------------------------------------------------------- profiling
     # Cluster leg of the `profile` control RPC: fan the capture out to every

@@ -843,6 +843,7 @@ class NodeDaemon:
         source = f"{self.node_id}:{os.getpid()}"
         last_snapshot: dict | None = None
         last_sent = 0.0
+        sampler = None  # lazy watchdog SeriesSampler
         while True:
             period = get_config().telemetry_flush_interval_s
             await asyncio.sleep(period if period > 0 else 0.5)
@@ -852,19 +853,33 @@ class NodeDaemon:
                 spans, span_cursor = tracing.flush_new(span_cursor)
                 events = buf.drain_dicts()
                 snapshot = metrics.registry().snapshot()
+                # Watchdog series piggyback (shared glue with the runtime
+                # flusher: gate + lazy init + resync in sampler.py).
+                from ray_tpu.observability import sampler as _wd_sampler
+
+                sampler, series = _wd_sampler.collect_for_flush(
+                    sampler, snapshot)
                 # Idle economy + keepalive (see the runtime flusher): skip
                 # unchanged pushes but stay inside the head's 60s window.
                 now = time.monotonic()
                 if not events and not spans and snapshot == last_snapshot \
-                        and now - last_sent < 20.0:
+                        and series is None and now - last_sent < 20.0:
                     continue
-                await self._head.call(
+                reply = await self._head.call(
                     "report_telemetry", source=source, node_id=self.node_id,
                     snapshot=snapshot, spans=spans, events=events,
-                    dropped=buf.dropped, timeout=10)
+                    dropped=buf.dropped, series=series, timeout=10)
+                _wd_sampler.handle_flush_reply(sampler, reply)
                 last_snapshot, last_sent = snapshot, now
             except Exception:
-                pass  # head unreachable: heartbeat loop handles reconnects
+                # Head unreachable: heartbeat loop handles reconnects;
+                # gauges re-send next tick (see handle_flush_failure).
+                try:
+                    from ray_tpu.observability import sampler as _wd_sampler
+
+                    _wd_sampler.handle_flush_failure(sampler)
+                except Exception:
+                    pass
 
     async def _chaos_node(self, conn, rules=None, clear=False):
         """Chaos plane leg: install/clear fault rules in this daemon and

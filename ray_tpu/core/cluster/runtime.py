@@ -340,6 +340,7 @@ class ClusterRuntime:
         # push — never on the hot path, bounded batches, drop-oldest).
         self._stop_flush = threading.Event()
         self._span_cursor = 0
+        self._series_sampler = None  # lazy watchdog SeriesSampler
         threading.Thread(target=self._telemetry_flusher, daemon=True,
                          name="telemetry-flush").start()
         # Actor state invalidation via pubsub.
@@ -406,22 +407,41 @@ class ClusterRuntime:
                     train_stats = _session.collect_train_stats() or None
                 except Exception:
                     pass
+                # Watchdog series: delta-encoded hot-path samples derived
+                # from the snapshot, piggybacked on the same push (the
+                # sampler returns None when nothing changed).
+                from ray_tpu.observability import sampler as _wd_sampler
+
+                self._series_sampler, series = _wd_sampler.collect_for_flush(
+                    self._series_sampler, snapshot)
                 # Idle-process economy: nothing new to report and the
                 # snapshot unchanged — skip the RPC, but keepalive well
                 # inside the head's 60s liveness window so the source
                 # doesn't age out of the federated export.
                 now = time.monotonic()
                 if not events and not spans and snapshot == last_snapshot \
-                        and train_stats is None and now - last_sent < 20.0:
+                        and train_stats is None and series is None \
+                        and now - last_sent < 20.0:
                     continue
-                self.head.call(
+                reply = self.head.call(
                     "report_telemetry", source=source,
                     node_id=self.my_node_id, timeout=10,
                     snapshot=snapshot, spans=spans, events=events,
-                    dropped=buf.dropped, train_stats=train_stats)
+                    dropped=buf.dropped, train_stats=train_stats,
+                    series=series)
+                _wd_sampler.handle_flush_reply(self._series_sampler, reply)
                 last_snapshot, last_sent = snapshot, now
             except Exception:
-                pass  # head temporarily unreachable: drop (bounded loss)
+                # Head temporarily unreachable: events/spans drop (bounded
+                # loss), but gauge samples must RE-send once it returns —
+                # a transition lost here would otherwise read stale on the
+                # head until the value next changes.
+                try:
+                    from ray_tpu.observability import sampler as _wd_sampler
+
+                    _wd_sampler.handle_flush_failure(self._series_sampler)
+                except Exception:
+                    pass
 
     def get_telemetry(self) -> dict:
         """The head's per-node telemetry table (source -> node/snapshot)."""
@@ -461,6 +481,28 @@ class ClusterRuntime:
     def train_stats(self) -> dict:
         """The head's straggler table (per-rank step-time summaries)."""
         return self.head.call("get_train_stats")
+
+    # ------------------------------------------------------------ watchdog
+    def incidents(self, since: float = 0.0, limit: int = 100,
+                  incident_id: str | None = None) -> dict:
+        """Health-watchdog incidents the head has assembled (bounded)."""
+        return self.head.call("get_incidents", since=since, limit=limit,
+                              incident_id=incident_id)
+
+    def get_timeseries(self, name: str | None = None,
+                       source: str | None = None,
+                       node_id: str | None = None,
+                       tags: dict | None = None,
+                       since: float = 0.0, max_points: int = 0,
+                       max_age_s: float = 0.0) -> dict:
+        """The head's rolling hot-path series store (watchdog feed).
+        ``max_age_s`` filters HEAD-side (skew-safe liveness window)."""
+        return self.head.call("get_timeseries", name=name, source=source,
+                              node_id=node_id, tags=tags, since=since,
+                              max_points=max_points, max_age_s=max_age_s)
+
+    def watchdog_status(self) -> dict:
+        return self.head.call("watchdog_status")
 
     # ---------------------------------------------------------------- chaos
     def chaos_cluster(self, rules=None, clear: bool = False) -> dict:

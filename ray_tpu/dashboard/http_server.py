@@ -190,6 +190,26 @@ class DashboardServer:
             lambda p, b: state_api.stragglers(
                 threshold=float(p.get("threshold", 1.15))))
 
+        # Health watchdog: incident deque + rolling hot-path series
+        # (?name=serve_ttft_s:p99 or a prefix like ?name=train_*).
+        self.add_route(
+            "GET", "/api/incidents",
+            lambda p, b: state_api.incidents(
+                since=float(p.get("since", 0.0)),
+                limit=int(p.get("limit", 100)),
+                incident_id=p.get("id")))
+        self.add_route(
+            "GET", "/api/timeseries",
+            lambda p, b: state_api.timeseries(
+                name=p.get("name"), source=p.get("source"),
+                node_id=p.get("node_id"),
+                tags=(json.loads(p["tags"]) if p.get("tags") else None),
+                since=float(p.get("since", 0.0)),
+                max_points=int(p.get("max_points", 0)),
+                max_age_s=float(p.get("max_age_s", 0.0))))
+        self.add_route("GET", "/api/watchdog",
+                       lambda p, b: state_api.watchdog_status())
+
         def cluster_status(p, b):
             from ray_tpu.core.worker import global_worker
 

@@ -1,0 +1,31 @@
+"""Always-on health watchdog: head time-series store, streaming anomaly
+detectors, anomaly-triggered evidence capture.
+
+- :mod:`~ray_tpu.observability.timeseries` — bounded ring-buffer store on
+  the head, fed by delta-encoded samples piggybacked on ``report_telemetry``;
+- :mod:`~ray_tpu.observability.sampler` — reporter-side derivation of the
+  hot-path series (train step/tokens/MFU, collective latency+bytes, serve
+  TTFT/TPOT/queue/shed, transfer bytes, per-process RSS/HBM);
+- :mod:`~ray_tpu.observability.detectors` — streaming O(1) rules with
+  warmup/debounce/cooldown;
+- :mod:`~ray_tpu.observability.watchdog` — the head loop that turns a trip
+  into an incident (attribution + series window + flight record + targeted
+  profile under guardrails).
+"""
+
+from ray_tpu.observability.detectors import (  # noqa: F401
+    DerivativeRule,
+    Rule,
+    SlopeRule,
+    SpikeRule,
+    ThresholdRule,
+    Trip,
+    build_rules,
+)
+from ray_tpu.observability.sampler import SeriesSampler  # noqa: F401
+from ray_tpu.observability.timeseries import (  # noqa: F401
+    Series,
+    SeriesKey,
+    SeriesStore,
+)
+from ray_tpu.observability.watchdog import Watchdog  # noqa: F401

@@ -1,0 +1,255 @@
+"""Streaming anomaly detectors over watchdog series: O(1) state per series.
+
+Every rule consumes samples AS THEY ARRIVE (no batch re-scan): state per
+series is a handful of floats (EWMA mean, EWMA absolute deviation, breach
+streak, last-trip instant), so a head ingesting 1000 nodes' samples pays a
+few arithmetic ops per sample — the fleet-size regime ROADMAP item 5
+targets. The shared firing discipline lives in :class:`Rule`:
+
+- **warmup**: no verdicts until ``warmup`` samples built a baseline (a
+  fresh series' first steps must not be "anomalous vs nothing");
+- **debounce**: ``debounce`` CONSECUTIVE breaching samples before a trip
+  (one garbage-collection hiccup is not an incident);
+- **cooldown**: after a trip the series is muted for ``cooldown_s`` (the
+  watchdog captures evidence once, not once per sample while the incident
+  is live).
+
+Detector families (rule -> series, built in :func:`build_rules`):
+
+- :class:`SpikeRule` — robust z-score (EWMA mean + EWMA |dev|, the
+  streaming stand-in for median/MAD) AND a ratio guard ``value >
+  ratio * mean`` so microscopic-scale series can't trip on noise. Covers
+  train step-time drift, per-(op,group) collective-latency outliers, serve
+  p99 TTFT/TPOT spikes, and node heartbeat-gap jitter.
+- :class:`ThresholdRule` — absolute level. Covers shed/expiry rate (the
+  healthy baseline is exactly zero, so "above X/s" is the right shape).
+- :class:`DerivativeRule` — EWMA of d(value)/dt above a floor. Covers
+  router queue growth (a queue LEVEL is fine; sustained growth is the
+  death spiral).
+- :class:`SlopeRule` — least-squares slope over the series' rolling tail.
+  Covers per-process RSS leak detection (monotone drift that never looks
+  like a spike).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Trip:
+    rule: str
+    kind: str  # train | collective | serve | node | memory
+    series: object  # timeseries.Series
+    ts: float
+    value: float
+    baseline: float
+    reason: str
+
+
+@dataclass
+class _SeriesState:
+    n: int = 0
+    mean: float = 0.0
+    dev: float = 0.0
+    streak: int = 0
+    last_trip: float = -1e18
+    prev: tuple | None = None  # (ts, value) for derivative rules
+    tail: deque = field(default_factory=lambda: deque(maxlen=64))
+
+
+class Rule:
+    """Shared warmup/debounce/cooldown machinery; subclasses implement
+    ``_breach(state, ts, value) -> (breaching, baseline, detail)`` and must
+    keep their own state update O(1)."""
+
+    kind = "generic"
+
+    def __init__(self, name: str, series: tuple[str, ...],
+                 warmup: int = 10, debounce: int = 2,
+                 cooldown_s: float = 30.0):
+        self.name = name
+        self.series_names = tuple(series)
+        self.warmup = int(warmup)
+        self.debounce = max(1, int(debounce))
+        self.cooldown_s = float(cooldown_s)
+        self._state: dict = {}
+
+    def matches(self, series_name: str) -> bool:
+        return series_name in self.series_names
+
+    def drop_source(self, source: str) -> None:
+        """Forget a dead reporter's per-series state (paired with
+        SeriesStore.drop_source: the rings are bounded, detector state
+        must be too — and a recycled key must not inherit a dead
+        process's baseline)."""
+        for key in [k for k in self._state if k.source == source]:
+            self._state.pop(key, None)
+
+    def drop_key(self, key) -> None:
+        self._state.pop(key, None)
+
+    def update(self, series, ts: float, value: float) -> Trip | None:
+        st = self._state.get(series.key)
+        if st is None:
+            st = self._state[series.key] = _SeriesState()
+        breaching, baseline, detail = self._breach(st, ts, value)
+        st.n += 1
+        if st.n <= self.warmup:
+            st.streak = 0
+            return None
+        if ts - st.last_trip < self.cooldown_s:
+            return None
+        if not breaching:
+            st.streak = 0
+            return None
+        st.streak += 1
+        if st.streak < self.debounce:
+            return None
+        st.streak = 0
+        st.last_trip = ts
+        return Trip(rule=self.name, kind=self.kind, series=series, ts=ts,
+                    value=value, baseline=baseline,
+                    reason=f"{series.key.name} {detail}")
+
+    # subclass hook
+    def _breach(self, st: _SeriesState, ts: float,
+                value: float) -> tuple[bool, float, str]:
+        raise NotImplementedError
+
+
+class SpikeRule(Rule):
+    """Robust-z high-side spike vs the series' own streaming baseline."""
+
+    def __init__(self, name: str, series: tuple[str, ...], kind: str,
+                 z: float = 6.0, ratio: float = 2.0, abs_floor: float = 0.0,
+                 alpha: float = 0.08, **kw):
+        super().__init__(name, series, **kw)
+        self.kind = kind
+        self.z = float(z)
+        self.ratio = float(ratio)
+        self.abs_floor = float(abs_floor)
+        self.alpha = float(alpha)
+
+    def _breach(self, st, ts, value):
+        mean, dev = st.mean, st.dev
+        if st.n == 0:
+            st.mean, st.dev = value, 0.0
+            return False, value, ""
+        # Scale floor: 5 % of the baseline — a perfectly steady series'
+        # dev collapses toward 0 and any wobble would be "infinite sigma".
+        scale = max(dev * 1.4826, 0.05 * abs(mean), 1e-12)
+        z = (value - mean) / scale
+        breaching = (z > self.z and value > self.ratio * mean
+                     and value > self.abs_floor)
+        # WINSORIZED baseline update: adapt with the sample clamped to
+        # mean ± 3·scale. A raw EWMA of |dev| would swallow the anomaly it
+        # is judging — two spike samples inflate the deviation enough to
+        # drop z below threshold before a debounce of 3 is ever reached
+        # (the robust-z stops being robust exactly when it matters). With
+        # the clamp, an outlier nudges the baseline instead of absorbing
+        # into it, so a sustained regression keeps reading anomalous and
+        # re-trips after every cooldown until it is actually fixed.
+        lo, hi = mean - 3.0 * scale, mean + 3.0 * scale
+        clamped = min(max(value, lo), hi)
+        st.mean = mean + self.alpha * (clamped - mean)
+        st.dev = dev + self.alpha * (abs(clamped - mean) - dev)
+        return breaching, mean, (
+            f"spiked to {value:.4g} (baseline {mean:.4g}, z={z:.1f})")
+
+
+class ThresholdRule(Rule):
+    """Absolute level breach — for series whose healthy value is ~0."""
+
+    def __init__(self, name: str, series: tuple[str, ...], kind: str,
+                 threshold: float, **kw):
+        super().__init__(name, series, **kw)
+        self.kind = kind
+        self.threshold = float(threshold)
+
+    def _breach(self, st, ts, value):
+        return (value > self.threshold, self.threshold,
+                f"at {value:.4g}/s (threshold {self.threshold:.4g}/s)")
+
+
+class DerivativeRule(Rule):
+    """Sustained positive growth: EWMA of d(value)/dt above a floor."""
+
+    def __init__(self, name: str, series: tuple[str, ...], kind: str,
+                 growth_per_s: float, alpha: float = 0.3, **kw):
+        super().__init__(name, series, **kw)
+        self.kind = kind
+        self.growth = float(growth_per_s)
+        self.alpha = float(alpha)
+
+    def _breach(self, st, ts, value):
+        prev, st.prev = st.prev, (ts, value)
+        if prev is None or ts <= prev[0]:
+            return False, 0.0, ""
+        d = (value - prev[1]) / (ts - prev[0])
+        st.mean = st.mean + self.alpha * (d - st.mean)  # mean reused: d/dt
+        return (st.mean > self.growth, self.growth,
+                f"growing {st.mean:.3g}/s (floor {self.growth:.3g}/s, "
+                f"level {value:.4g})")
+
+
+class SlopeRule(Rule):
+    """Least-squares slope over the rolling tail — monotone-leak shape.
+    ``min_span_s`` of history required before a verdict (a slope fit over
+    half a second of samples is noise)."""
+
+    def __init__(self, name: str, series: tuple[str, ...], kind: str,
+                 slope_per_s: float, min_span_s: float = 10.0, **kw):
+        super().__init__(name, series, **kw)
+        self.kind = kind
+        self.slope = float(slope_per_s)
+        self.min_span_s = float(min_span_s)
+
+    def _breach(self, st, ts, value):
+        st.tail.append((ts, value))
+        if len(st.tail) < 4 or ts - st.tail[0][0] < self.min_span_s:
+            return False, 0.0, ""
+        t0 = st.tail[0][0]
+        xs = [t - t0 for t, _ in st.tail]
+        ys = [v for _, v in st.tail]
+        n = len(xs)
+        mx, my = sum(xs) / n, sum(ys) / n
+        denom = sum((x - mx) ** 2 for x in xs)
+        if denom <= 0:
+            return False, 0.0, ""
+        slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+        return (slope > self.slope, self.slope,
+                f"rising {slope / 1e6:.2f} MB/s over {ts - t0:.0f}s "
+                f"(floor {self.slope / 1e6:.2f} MB/s)")
+
+
+def build_rules(cfg) -> list[Rule]:
+    """The production rule set, thresholds from config (documented in
+    utils/config.py's watchdog block)."""
+    common = dict(warmup=cfg.watchdog_warmup_samples,
+                  debounce=cfg.watchdog_debounce,
+                  cooldown_s=cfg.watchdog_cooldown_s)
+    z, ratio = cfg.watchdog_z_threshold, cfg.watchdog_spike_ratio
+    return [
+        SpikeRule("train_step_drift", ("train_step_time_s",), "train",
+                  z=z, ratio=ratio, **common),
+        SpikeRule("collective_latency", ("collective_op_latency_s:mean",
+                                         "collective_op_latency_s:p99"),
+                  "collective", z=z, ratio=ratio, **common),
+        SpikeRule("serve_latency", ("serve_ttft_s:p99", "serve_tpot_s:p99"),
+                  "serve", z=z, ratio=ratio, **common),
+        ThresholdRule("shed_rate", ("serve_shed_total:rate",
+                                    "serve_expired_total:rate"),
+                      "serve", threshold=cfg.watchdog_shed_rate_per_s,
+                      **{**common, "warmup": 0}),
+        DerivativeRule("queue_growth", ("serve_router_queue_depth",),
+                       "serve",
+                       growth_per_s=cfg.watchdog_queue_growth_per_s,
+                       **common),
+        SlopeRule("memory_leak", ("proc_rss_bytes", "proc_hbm_bytes"),
+                  "memory",
+                  slope_per_s=cfg.watchdog_mem_slope_mb_s * 1e6, **common),
+        SpikeRule("heartbeat_jitter", ("node_heartbeat_gap_s",), "node",
+                  z=z, ratio=ratio, abs_floor=0.25, **common),
+    ]

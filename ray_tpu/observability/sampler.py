@@ -1,0 +1,347 @@
+"""Reporter-side series sampler: hot-path metrics -> delta-encoded samples.
+
+Runs inside the existing per-process telemetry flushers (runtime driver
+flusher + node-daemon loop): each flush it derives scalar samples from the
+process's metrics snapshot for a WHITELIST of hot-path series and packs them
+in the compact wire format :mod:`~ray_tpu.observability.timeseries`
+documents. Everything is computed from successive registry snapshots the
+flusher already builds — no new locks on the hot paths being observed.
+
+Derivations per metric type:
+
+- gauge: the value itself, sent only when it changed (an idle process adds
+  zero bytes to its telemetry push);
+- counter: ``<name>:rate`` = delta / interval, sent while non-zero plus one
+  trailing zero so a burst visibly ends;
+- histogram: over the interval's *bucket deltas* — ``:rate`` (observations/s),
+  ``:mean`` (delta sum / delta count), ``:p99`` (linear interpolation within
+  the delta's cumulative buckets), ``:volume`` (delta sum / s — bytes-style
+  histograms where the sum IS the payload).
+
+The sampler also contributes two process-health gauges the registry doesn't
+carry: ``proc_rss_bytes`` (always) and ``proc_hbm_bytes`` (only when a jax
+backend is ALREADY initialized in this process — the same guard the profiler
+memory snapshot uses; sampling must never trigger a backend init). RSS is
+noise-gated (1 % / 1 MiB) so an idle process stays silent.
+
+Cost: one dict pass over the whitelisted snapshot entries per flush, self-
+measured into the ``watchdog_sample_seconds`` counter so the <1 % duty-cycle
+acceptance gate is readable off /metrics rather than asserted on faith.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+# metric name -> derived kinds. Keep this list the authoritative statement
+# of what the watchdog can see; detectors reference these derived names.
+HIST_SERIES: dict[str, tuple[str, ...]] = {
+    "collective_op_latency_s": ("mean", "p99"),
+    "collective_op_bytes": ("volume",),
+    "serve_ttft_s": ("p99", "mean", "rate"),
+    "serve_tpot_s": ("p99",),
+    "serve_request_latency_s": ("p99",),
+    "transfer_bytes": ("volume",),
+}
+COUNTER_SERIES = (
+    "serve_shed_total",
+    "serve_expired_total",
+    "serve_retries_total",
+    "serve_breaker_transitions_total",
+    "train_restarts_total",
+)
+GAUGE_SERIES = (
+    "train_step_time_s",
+    "train_tokens_per_s",
+    "train_mfu",
+    "serve_router_queue_depth",
+    "serve_ongoing_requests",
+    "serve_breaker_open_replicas",
+)
+
+_RSS_MIN_DELTA = 1 << 20  # 1 MiB noise gate
+
+
+_sample_metrics = None
+
+
+def _get_sample_metrics():
+    """Self-metrics, lazy (the sampler must stay importable without pulling
+    the registry in at module import)."""
+    global _sample_metrics
+    if _sample_metrics is None:
+        from ray_tpu.util.metrics import Counter
+
+        _sample_metrics = {
+            "seconds": Counter(
+                "watchdog_sample_seconds",
+                "cumulative wall time this process spent deriving "
+                "watchdog series samples (duty-cycle numerator)"),
+        }
+    return _sample_metrics
+
+
+def _rss_bytes() -> int:
+    try:
+        with open(f"/proc/{os.getpid()}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def _hbm_bytes() -> int | None:
+    """Summed device bytes_in_use — only from an already-initialized jax
+    backend (never pay/trigger backend init from a telemetry tick)."""
+    from ray_tpu.profiling.memory import jax_backend_ready
+
+    if not jax_backend_ready():
+        return None
+    try:
+        import jax
+
+        total = 0
+        seen = False
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if ms:
+                seen = True
+                total += int(ms.get("bytes_in_use", 0))
+        return total if seen else None
+    except Exception:
+        return None
+
+
+def estimate_p99(boundaries: list[float], deltas: list[int]) -> float | None:
+    """p99 of one interval's observations from cumulative-bucket deltas
+    (linear interpolation inside the target bucket; the +Inf bucket clamps
+    to the last finite boundary — good enough for spike detection)."""
+    total = sum(deltas)
+    if total <= 0:
+        return None
+    target = 0.99 * total
+    cum = 0
+    lo = 0.0
+    for bound, n in zip(boundaries, deltas):
+        if cum + n >= target and n > 0:
+            return lo + (bound - lo) * (target - cum) / n
+        cum += n
+        lo = bound
+    return boundaries[-1] if boundaries else None
+
+
+class SeriesSampler:
+    """Stateful per-process sampler. One instance per telemetry flusher."""
+
+    def __init__(self):
+        self._sid: dict[tuple[str, tuple], int] = {}
+        self._next_sid = 0
+        self._declared: set[int] = set()
+        # last-sent values / counter+histogram cumulative states
+        self._gauge_last: dict[int, float] = {}
+        self._counter_last: dict[tuple[str, tuple], float] = {}
+        self._counter_live: set[int] = set()  # rate sids with nonzero last
+        self._hist_last: dict[tuple[str, tuple], tuple] = {}
+        self._last_ts: float | None = None
+        self._rss_last = 0.0
+        self._hbm_last: float | None = None
+        self.spent_s = 0.0
+        self._unmetered_s = 0.0  # spent time not yet in the registry
+
+    def force_resync(self) -> None:
+        """Head forgot us (restart/eviction): re-declare every series with
+        the next payload. Gauge last-sent values reset too — a steady
+        gauge would otherwise never resend, leaving the new head's store
+        permanently blind to it (a series only exists once a sample
+        lands). Counter/histogram cumulative state stays: their derived
+        samples are per-interval deltas and flow again on the next
+        activity regardless."""
+        self._declared.clear()
+        self._gauge_last.clear()
+
+    def flush_failed(self) -> None:
+        """The push carrying the last payload never reached the head:
+        forget gauge last-sent values so a transition that happened to
+        land in the lost payload is retransmitted on the next tick. A
+        gauge that then plateaus would otherwise read stale on the head
+        FOREVER (value == last suppresses resend, and the head knows the
+        sid so no resync ever fires). Counter/histogram state stays —
+        their per-interval deltas self-heal (one interval's rate is lost,
+        bounded; cumulative tracking resumes from the live registry)."""
+        self._gauge_last.clear()
+
+    # ------------------------------------------------------------ helpers
+    def _sid_for(self, name: str, tags: tuple, defs: list) -> int:
+        key = (name, tags)
+        sid = self._sid.get(key)
+        if sid is None:
+            sid = self._next_sid
+            self._next_sid += 1
+            self._sid[key] = sid
+        if sid not in self._declared:
+            self._declared.add(sid)
+            defs.append([sid, name, dict(tags)])
+        return sid
+
+    def _emit_gauge(self, name: str, tags: tuple, value: float,
+                    defs: list, samples: list, always: bool = False,
+                    min_delta: float = 0.0) -> None:
+        sid = self._sid_for(name, tags, defs)
+        last = self._gauge_last.get(sid)
+        if not always and last is not None:
+            if value == last:
+                return
+            if min_delta and abs(value - last) < max(
+                    min_delta, 0.01 * abs(last)):
+                return
+        self._gauge_last[sid] = value
+        samples.append([sid, value])
+
+    # ------------------------------------------------------------ collect
+    def collect(self, snapshot: dict, now: float | None = None) -> dict | None:
+        """Derive this interval's samples from a registry snapshot. Returns
+        the wire payload, or None when there is nothing to send."""
+        t0 = time.perf_counter()
+        payload = None
+        try:
+            payload = self._collect(snapshot, now)
+            return payload
+        finally:
+            dt = time.perf_counter() - t0
+            self.spent_s += dt
+            # The self-metric only moves when a payload is produced: an
+            # inc on EVERY tick would change the registry snapshot each
+            # flush and permanently defeat the flushers' snapshot-
+            # unchanged idle skip — a fully idle process would push its
+            # whole snapshot at flush cadence instead of the 20s
+            # keepalive. Idle ticks accumulate locally and ride the next
+            # real payload's increment.
+            self._unmetered_s += dt
+            if payload is not None:
+                try:
+                    _get_sample_metrics()["seconds"].inc(self._unmetered_s)
+                    self._unmetered_s = 0.0
+                except Exception:
+                    pass
+
+    def _collect(self, snapshot: dict, now: float | None) -> dict | None:
+        now = time.time() if now is None else float(now)
+        prev_ts, self._last_ts = self._last_ts, now
+        interval = (now - prev_ts) if prev_ts else 0.0
+        defs: list = []
+        samples: list = []
+        for entry in (snapshot or {}).get("metrics", ()):
+            name = entry.get("name", "")
+            typ = entry.get("type")
+            keys = tuple(entry.get("tag_keys") or ())
+            if typ == "gauge" and name in GAUGE_SERIES:
+                for tagvals, v in entry.get("points") or ():
+                    tags = tuple(zip(keys, (str(x) for x in tagvals)))
+                    self._emit_gauge(name, _trim(tags), float(v),
+                                     defs, samples)
+            elif typ == "counter" and name in COUNTER_SERIES:
+                for tagvals, v in entry.get("points") or ():
+                    tags = _trim(tuple(zip(keys, (str(x) for x in tagvals))))
+                    ckey = (name, tags)
+                    last = self._counter_last.get(ckey)
+                    self._counter_last[ckey] = float(v)
+                    if last is None or interval <= 0:
+                        continue
+                    rate = max(0.0, (float(v) - last)) / interval
+                    sid = self._sid_for(name + ":rate", tags, defs)
+                    if rate > 0:
+                        samples.append([sid, rate])
+                        self._counter_live.add(sid)
+                    elif sid in self._counter_live:
+                        # one trailing zero: a burst must visibly end
+                        samples.append([sid, 0.0])
+                        self._counter_live.discard(sid)
+            elif typ == "histogram" and name in HIST_SERIES:
+                self._hist(entry, name, keys, interval, defs, samples)
+        # process-health gauges (not in the registry)
+        rss = float(_rss_bytes())
+        if rss:
+            self._emit_gauge("proc_rss_bytes", (), rss, defs, samples,
+                             min_delta=_RSS_MIN_DELTA)
+        hbm = _hbm_bytes()
+        if hbm is not None:
+            self._emit_gauge("proc_hbm_bytes", (), float(hbm),
+                             defs, samples, min_delta=_RSS_MIN_DELTA)
+        if not samples and not defs:
+            return None
+        return {"t": now, "defs": defs, "s": samples}
+
+    def _hist(self, entry: dict, name: str, keys: tuple, interval: float,
+              defs: list, samples: list) -> None:
+        kinds = HIST_SERIES[name]
+        bounds = [float(b) for b in entry.get("boundaries") or ()]
+        sums = {tuple(k): float(v) for k, v in entry.get("sums") or ()}
+        counts = {tuple(k): float(v) for k, v in entry.get("counts") or ()}
+        for tagvals, bk in entry.get("buckets") or ():
+            tagvals = tuple(tagvals)
+            tags = _trim(tuple(zip(keys, (str(x) for x in tagvals))))
+            hkey = (name, tags)
+            cur = (list(bk), sums.get(tagvals, 0.0),
+                   counts.get(tagvals, 0.0))
+            last = self._hist_last.get(hkey)
+            self._hist_last[hkey] = cur
+            if last is None or interval <= 0:
+                continue
+            d_bk = [max(0, int(a) - int(b)) for a, b in zip(cur[0], last[0])]
+            d_sum = max(0.0, cur[1] - last[1])
+            d_count = max(0.0, cur[2] - last[2])
+            if d_count <= 0:
+                continue
+            for kind in kinds:
+                if kind == "rate":
+                    value = d_count / interval
+                elif kind == "mean":
+                    value = d_sum / d_count
+                elif kind == "volume":
+                    value = d_sum / interval
+                else:  # p99
+                    p = estimate_p99(bounds, d_bk)
+                    if p is None:
+                        continue
+                    value = p
+                sid = self._sid_for(f"{name}:{kind}", tags, defs)
+                samples.append([sid, float(value)])
+
+
+def _trim(tags: tuple) -> tuple:
+    """Drop empty tag values (unset tag keys) and keep a stable order."""
+    return tuple(sorted((k, v) for k, v in tags if v != ""))
+
+
+# ------------------------------------------------------------ flusher glue
+# Both telemetry flushers (the runtime driver thread and the node daemon's
+# asyncio loop) piggyback series the same way; keep the one authoritative
+# copy of the gate/lazy-init/resync protocol here.
+
+def collect_for_flush(sampler: "SeriesSampler | None",
+                      snapshot: dict) -> tuple["SeriesSampler | None",
+                                               dict | None]:
+    """One flush tick's series leg: honors the watchdog_enabled gate,
+    lazily creates the sampler, returns ``(sampler, payload-or-None)``."""
+    from ray_tpu.utils.config import get_config
+
+    if not get_config().watchdog_enabled:
+        return sampler, None
+    if sampler is None:
+        sampler = SeriesSampler()
+    return sampler, sampler.collect(snapshot)
+
+
+def handle_flush_reply(sampler: "SeriesSampler | None", reply) -> None:
+    """Resync protocol: the head answered ``series_resync`` when it didn't
+    know a referenced sid (restart/eviction) — re-declare everything on
+    the next flush."""
+    if sampler is not None and (reply or {}).get("series_resync"):
+        sampler.force_resync()
+
+
+def handle_flush_failure(sampler: "SeriesSampler | None") -> None:
+    """The flusher's push raised after collect() committed its delta
+    state: arrange gauge retransmission (see flush_failed)."""
+    if sampler is not None:
+        sampler.flush_failed()

@@ -1,0 +1,216 @@
+"""Head-side time-series store: bounded rolling history for hot-path series.
+
+The always-on health watchdog needs *history* — "is this step time drifting"
+is unanswerable from the head's latest-snapshot telemetry table. This store
+keeps a bounded ring of ``(ts, value)`` points per series, fed by the
+delta-encoded sample payloads the per-process telemetry flushers piggyback
+on their existing ``report_telemetry`` pushes (reference capability: the
+reference dashboard's Prometheus+Grafana pairing collapsed into the head —
+no external TSDB, just enough rolling window for streaming detectors and
+the `timeseries`/`watch` surfaces).
+
+Series identity is ``(source, name, tags)``: the *source* (one per reporting
+process, ``<node>:<pid>``) disambiguates same-named series from different
+processes (two serve replicas both export ``serve_ttft_s:p99`` with the same
+deployment tag), and the reporter's node_id rides along for attribution.
+
+Wire format (one payload per telemetry push, built by
+:class:`~ray_tpu.observability.sampler.SeriesSampler`)::
+
+    {"t": 1699....2,                  # sample instant (reporter wall clock)
+     "defs": [[sid, name, {tags}]],   # NEW series declared this push
+     "s": [[sid, value], ...]}        # samples; sid -> defs sent earlier
+
+``sid`` is a small per-reporter integer: a series' name+tags cross the wire
+ONCE, every later sample is two numbers — this is the down-payment on
+ROADMAP item 5's delta-based telemetry sync (1000 nodes re-shipping full
+label sets every 500 ms is exactly the head-egress shape that item calls
+out). A head that has forgotten a reporter's ids (restart, eviction)
+answers with ``series_resync`` and the reporter re-declares on its next
+flush.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class SeriesKey:
+    source: str
+    name: str
+    tags: tuple  # sorted (k, v) pairs
+
+    def tag_dict(self) -> dict:
+        return dict(self.tags)
+
+
+@dataclass
+class Series:
+    key: SeriesKey
+    node_id: str = ""
+    points: deque = field(default_factory=deque)  # (ts, value)
+
+    def latest(self) -> tuple[float, float] | None:
+        return self.points[-1] if self.points else None
+
+
+class SeriesStore:
+    """Bounded per-series rings + per-source sid maps. Not thread-safe by
+    itself — the head mutates it only from its asyncio loop."""
+
+    def __init__(self, max_points: int = 360, max_series: int = 4096):
+        self.max_points = int(max_points)
+        self.max_series = int(max_series)
+        self._series: dict[SeriesKey, Series] = {}
+        # source -> {sid: (name, tags_tuple)}
+        self._sids: dict[str, dict[int, tuple[str, tuple]]] = {}
+        self.ingested = 0   # samples accepted
+        self.dropped = 0    # samples dropped (unknown sid / series cap)
+
+    # ------------------------------------------------------------- ingest
+    def ingest(self, source: str, node_id: str, payload: dict,
+               updated: list | None = None) -> bool:
+        """Apply one wire payload. Returns True when the reporter must
+        resync (it referenced a sid this store doesn't know — head restart
+        or source eviction). ``updated``, when given, collects the
+        (Series, ts, value) triples appended — the watchdog feeds them
+        straight into its streaming detectors."""
+        if not payload:
+            return False
+        sids = self._sids.setdefault(source, {})
+        for row in payload.get("defs") or ():
+            try:
+                sid, name, tags = int(row[0]), str(row[1]), dict(row[2])
+            except (TypeError, ValueError, IndexError):
+                continue
+            sids[sid] = (name, tuple(sorted(tags.items())))
+        ts = float(payload.get("t") or time.time())
+        # A reporter clock far in the future must not poison detector
+        # ordering; trust it only within a minute of arrival.
+        now = time.time()
+        if not (now - 60.0 <= ts <= now + 60.0):
+            ts = now
+        resync = False
+        for row in payload.get("s") or ():
+            try:
+                sid, value = int(row[0]), float(row[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            ref = sids.get(sid)
+            if ref is None:
+                self.dropped += 1
+                resync = True
+                continue
+            key = SeriesKey(source=source, name=ref[0], tags=ref[1])
+            series = self._series.get(key)
+            if series is None:
+                if len(self._series) >= self.max_series:
+                    self.dropped += 1
+                    continue
+                series = Series(key=key, node_id=node_id,
+                                points=deque(maxlen=self.max_points))
+                self._series[key] = series
+            series.node_id = node_id or series.node_id
+            series.points.append((ts, value))
+            self.ingested += 1
+            if updated is not None:
+                updated.append((series, ts, value))
+        return resync
+
+    def append(self, source: str, name: str, tags: dict, value: float,
+               node_id: str = "", ts: float | None = None,
+               updated: list | None = None) -> None:
+        """Direct head-side append (heartbeat-gap series, tests)."""
+        key = SeriesKey(source=source, name=name,
+                        tags=tuple(sorted((tags or {}).items())))
+        series = self._series.get(key)
+        if series is None:
+            if len(self._series) >= self.max_series:
+                self.dropped += 1
+                return
+            series = Series(key=key, node_id=node_id,
+                            points=deque(maxlen=self.max_points))
+            self._series[key] = series
+        ts = time.time() if ts is None else float(ts)
+        series.points.append((ts, float(value)))
+        self.ingested += 1
+        if updated is not None:
+            updated.append((series, ts, float(value)))
+
+    def drop_source(self, source: str) -> None:
+        """Forget a reporter: its sid map and series (dead workers must not
+        pin ring memory forever)."""
+        self._sids.pop(source, None)
+        for key in [k for k in self._series if k.source == source]:
+            self._series.pop(key, None)
+
+    def drop_key(self, key: SeriesKey) -> None:
+        """Forget ONE series (e.g. a removed node's heartbeat-gap ring)."""
+        self._series.pop(key, None)
+
+    # -------------------------------------------------------------- query
+    def series(self) -> list[Series]:
+        return list(self._series.values())
+
+    def get(self, key: SeriesKey) -> Series | None:
+        return self._series.get(key)
+
+    def window(self, key: SeriesKey, seconds: float = 120.0,
+               max_points: int | None = None) -> list[list[float]]:
+        series = self._series.get(key)
+        if series is None:
+            return []
+        cutoff = time.time() - seconds
+        pts = [[ts, v] for ts, v in series.points if ts >= cutoff]
+        if max_points and len(pts) > max_points:
+            pts = pts[-max_points:]
+        return pts
+
+    def query(self, name: str | None = None, source: str | None = None,
+              node_id: str | None = None, tags: dict | None = None,
+              since: float = 0.0, max_points: int = 0,
+              max_age_s: float = 0.0) -> list[dict]:
+        """Filtered listing for the state API / dashboard / `watch` CLI.
+        ``name`` matches exactly or as a prefix ending in ``*``.
+        ``max_age_s`` > 0 keeps only points younger than that, judged
+        against THIS store's clock — remote callers wanting a liveness
+        window must use it rather than computing ``since`` from their own
+        wall clock (client/head skew would blank or falsify the view)."""
+        if max_age_s and max_age_s > 0:
+            since = max(since, time.time() - max_age_s)
+        out: list[dict] = []
+        for series in self._series.values():
+            key = series.key
+            if name:
+                if name.endswith("*"):
+                    if not key.name.startswith(name[:-1]):
+                        continue
+                elif key.name != name:
+                    continue
+            if source and key.source != source:
+                continue
+            if node_id and series.node_id != node_id:
+                continue
+            if tags:
+                have = key.tag_dict()
+                if any(have.get(k) != str(v) for k, v in tags.items()):
+                    continue
+            pts = [[ts, v] for ts, v in series.points if ts >= since]
+            if max_points and len(pts) > max_points:
+                pts = pts[-max_points:]
+            out.append({
+                "name": key.name, "tags": key.tag_dict(),
+                "source": key.source, "node_id": series.node_id,
+                "points": pts,
+            })
+        out.sort(key=lambda r: (r["name"], r["source"]))
+        return out
+
+    def stats(self) -> dict:
+        return {"series": len(self._series), "sources": len(self._sids),
+                "ingested": self.ingested, "dropped": self.dropped,
+                "max_points": self.max_points,
+                "max_series": self.max_series}

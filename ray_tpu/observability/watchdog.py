@@ -1,0 +1,420 @@
+"""Head-side health watchdog: ingest -> detect -> capture evidence, always on.
+
+The loop closes what the pull-based surfaces (PR 1 /metrics + flight
+recorder, PR 5 on-demand profiler) leave open: nobody is watching 1000
+nodes by hand, so the cluster must notice its own regressions and grab the
+perishable evidence (stacks, series windows, queue states) WHILE the
+incident is live. Three stages:
+
+1. **ingest** — every ``report_telemetry`` push hands its delta-encoded
+   series payload here; samples land in the bounded
+   :class:`~ray_tpu.observability.timeseries.SeriesStore` and flow straight
+   through the streaming detectors (O(1) per sample). The head's own
+   heartbeat table is sampled into ``node_heartbeat_gap_s`` series by the
+   loop, so heartbeat jitter is watched without any reporter cooperation.
+2. **detect** — :mod:`~ray_tpu.observability.detectors` rules with warmup/
+   debounce/per-rule-cooldown fire :class:`Trip`s into a small queue.
+3. **evidence** — the loop assembles each trip into an *incident*: the
+   implicated entity (train trips reuse PR-5 straggler attribution; others
+   implicate the offending series' reporter), the offending series window,
+   a flight-recorder bundle, and a *targeted* profiler capture scoped to
+   the implicated node over the PR-5 ``profile_node`` RPC — under hard
+   guardrails (concurrent-capture cap, per-node cooldown, lifetime budget)
+   so the watchdog can never become the thing that melts a sick cluster.
+
+Incidents are a bounded deque surfaced through the state API
+(``incidents``/``timeseries``), the CLI (``incidents``, ``watch``) and the
+dashboard (``/api/incidents``, ``/api/timeseries``). Self-metrics:
+``watchdog_incidents_total{rule}``, ``watchdog_eval_seconds``,
+``watchdog_dropped_samples``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+import uuid
+from collections import deque
+
+from ray_tpu.observability.detectors import Rule, Trip, build_rules
+from ray_tpu.observability.timeseries import SeriesKey, SeriesStore
+from ray_tpu.utils.config import get_config
+
+_PENDING_MAX = 16  # trips queued for assembly; floods drop (counted)
+# Hang bound on one targeted capture beyond the capture window itself
+# (daemon fan-out + worker RTT); a dead daemon usually fails fast with a
+# connect error — this is the backstop for a WEDGED one.
+CAPTURE_RPC_SLACK_S = 20.0
+
+_wd_metrics = None
+
+
+def _get_wd_metrics():
+    global _wd_metrics
+    if _wd_metrics is None:
+        from ray_tpu.util.metrics import Counter
+
+        _wd_metrics = {
+            "incidents": Counter(
+                "watchdog_incidents_total",
+                "incidents the health watchdog opened, by rule",
+                tag_keys=("rule",)),
+            "eval_seconds": Counter(
+                "watchdog_eval_seconds",
+                "cumulative wall time spent in watchdog ingest+eval "
+                "(duty-cycle numerator on the head)"),
+            "dropped": Counter(
+                "watchdog_dropped_samples",
+                "samples dropped at ingest (unknown sid / series cap / "
+                "trip-queue overflow)"),
+        }
+    return _wd_metrics
+
+
+class Watchdog:
+    """``train_stats_fn``/``nodes_fn`` are synchronous reads of the head's
+    tables; ``profile_fn(node_id, seconds)`` is an awaitable returning the
+    PR-5 ``profile_node`` result for ONE node. Injectable so incident
+    assembly is unit-testable without a cluster."""
+
+    def __init__(self, train_stats_fn=None, nodes_fn=None, profile_fn=None,
+                 cfg=None, rules: list[Rule] | None = None,
+                 store: SeriesStore | None = None):
+        cfg = cfg or get_config()
+        self.cfg = cfg
+        self.store = store or SeriesStore(
+            max_points=cfg.watchdog_series_samples,
+            max_series=cfg.watchdog_series_max)
+        self.rules = rules if rules is not None else build_rules(cfg)
+        self._train_stats_fn = train_stats_fn or (lambda: {})
+        self._nodes_fn = nodes_fn or (lambda: {})
+        self._profile_fn = profile_fn
+        self.incidents: deque = deque(maxlen=cfg.watchdog_max_incidents)
+        self._pending: deque = deque()
+        self._hb_last: dict[str, float] = {}
+        self._node_capture_ts: dict[str, float] = {}
+        self._captures_inflight = 0
+        self.captures_done = 0
+        self.eval_s = 0.0
+        self._dropped_trips = 0
+        self._store_dropped_seen = 0
+        self._task: asyncio.Task | None = None
+        self._updated_buf: list = []  # reused per ingest (no per-push alloc)
+
+    # ------------------------------------------------------------- ingest
+    def ingest(self, source: str, node_id: str, payload: dict) -> bool:
+        """Called from the head's ``_report_telemetry`` handler. Returns
+        True when the reporter must resync its series declarations."""
+        t0 = time.perf_counter()
+        try:
+            updated = self._updated_buf
+            updated.clear()
+            resync = self.store.ingest(source, node_id, payload,
+                                       updated=updated)
+            if self.store.dropped != self._store_dropped_seen:
+                delta = self.store.dropped - self._store_dropped_seen
+                self._store_dropped_seen = self.store.dropped
+                try:
+                    _get_wd_metrics()["dropped"].inc(delta)
+                except Exception:
+                    pass
+            for series, ts, value in updated:
+                self._detect(series, ts, value)
+            updated.clear()
+            return resync
+        finally:
+            self._spend(time.perf_counter() - t0)
+
+    def _detect(self, series, ts: float, value: float) -> None:
+        for rule in self.rules:
+            if not rule.matches(series.key.name):
+                continue
+            trip = rule.update(series, ts, value)
+            if trip is not None:
+                if len(self._pending) >= _PENDING_MAX:
+                    self._dropped_trips += 1
+                    try:
+                        _get_wd_metrics()["dropped"].inc()
+                    except Exception:
+                        pass
+                    continue
+                self._pending.append(trip)
+
+    def _spend(self, dt: float) -> None:
+        self.eval_s += dt
+        try:
+            _get_wd_metrics()["eval_seconds"].inc(dt)
+        except Exception:
+            pass
+
+    def drop_source(self, source: str) -> None:
+        """Evict one reporter everywhere: store rings AND every rule's
+        per-series detector state (worker churn on an always-on head must
+        not grow either without bound)."""
+        self.store.drop_source(source)
+        for rule in self.rules:
+            rule.drop_source(source)
+
+    # ------------------------------------------------------ heartbeat feed
+    def observe_heartbeats(self) -> None:
+        """Sample per-node heartbeat gaps into the store (head-local: the
+        gap between consecutive heartbeats as the head saw them). Fed by
+        the loop each tick; the jitter rule does the judging.
+
+        A FULLY stalled heartbeat must not be invisible: while a node is
+        silent past one health period, each tick also samples the
+        gap-SO-FAR (now - last heartbeat, a rising value) — so the jitter
+        rule trips while the daemon is still wedged, inside the gray zone
+        before heartbeat aging declares the node dead. Waiting for the
+        next heartbeat to measure the gap would capture the evidence only
+        after the incident ended."""
+        t0 = time.perf_counter()
+        try:
+            nodes = self._nodes_fn() or {}
+            for gone in [nid for nid in self._hb_last if nid not in nodes]:
+                self._hb_last.pop(gone, None)
+                key = SeriesKey(source="head", name="node_heartbeat_gap_s",
+                                tags=(("node", gone),))
+                self.store.drop_key(key)
+                for rule in self.rules:
+                    rule.drop_key(key)
+            try:
+                stall_floor = 2.0 * get_config().health_check_period_s
+            except Exception:
+                stall_floor = 2.0
+            now_mono = time.monotonic()
+            for node_id, info in nodes.items():
+                hb = getattr(info, "last_heartbeat", None)
+                alive = getattr(info, "alive", True)
+                if hb is None or hb <= 0 or not alive:
+                    continue
+                prev = self._hb_last.get(node_id)
+                self._hb_last[node_id] = hb
+                if prev is None:
+                    continue
+                if hb > prev:
+                    gap = hb - prev
+                elif now_mono - hb > stall_floor:
+                    gap = now_mono - hb  # silent node: gap-so-far, rising
+                    self._hb_last[node_id] = prev  # keep the real base
+                else:
+                    continue
+                updated: list = []
+                self.store.append("head", "node_heartbeat_gap_s",
+                                  {"node": node_id}, gap,
+                                  node_id=node_id, updated=updated)
+                for series, ts, value in updated:
+                    self._detect(series, ts, value)
+        finally:
+            self._spend(time.perf_counter() - t0)
+
+    # --------------------------------------------------------------- loop
+    def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        if self._task is None or self._task.done():
+            self._task = loop.create_task(self.run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def run(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.watchdog_eval_interval_s)
+            try:
+                self.observe_heartbeats()
+                while self._pending:
+                    trip = self._pending.popleft()
+                    await self._assemble(trip)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # the watchdog must never take the head down
+
+    # ----------------------------------------------------------- evidence
+    async def _assemble(self, trip: Trip) -> dict:
+        """One incident: attribution + series window + flight record +
+        targeted profile. Every leg is best-effort and bounded — a dead
+        implicated worker yields partial evidence, never a hang."""
+        t0 = time.perf_counter()
+        key = trip.series.key
+        incident = {
+            "id": uuid.uuid4().hex[:12],
+            "ts": trip.ts,
+            "wall_ts": time.time(),
+            "rule": trip.rule,
+            "kind": trip.kind,
+            "reason": trip.reason,
+            "value": trip.value,
+            "baseline": trip.baseline,
+            "series": {"name": key.name, "tags": key.tag_dict(),
+                       "source": key.source,
+                       "node_id": trip.series.node_id},
+        }
+        implicated = self._implicate(trip)
+        incident["implicated"] = implicated
+        incident["window"] = self.store.window(key, seconds=120.0,
+                                               max_points=240)
+        incident["related"] = self._related(trip)
+        self._spend(time.perf_counter() - t0)
+
+        # Flight record: head-side bundle carrying the incident context
+        # (record() detects the running loop and stays local — no RPC).
+        try:
+            from ray_tpu.core import flight_recorder
+
+            incident["flight_record"] = flight_recorder.record(
+                "watchdog_incident", reason=trip.reason,
+                node_id=implicated.get("node_id") or "",
+                extra={"incident_id": incident["id"], "rule": trip.rule,
+                       "series": incident["series"],
+                       "implicated": implicated,
+                       "window_tail": incident["window"][-32:]})
+        except Exception:
+            incident["flight_record"] = None
+
+        incident["profile"] = await self._auto_capture(
+            incident["id"], implicated.get("node_id") or "")
+        incident["assembly_s"] = round(time.perf_counter() - t0, 4)
+        self.incidents.append(incident)
+        try:
+            _get_wd_metrics()["incidents"].inc(tags={"rule": trip.rule})
+        except Exception:
+            pass
+        return incident
+
+    def _implicate(self, trip: Trip) -> dict:
+        """The entity an operator would restart. Train trips reuse the
+        PR-5 straggler attribution (the slow RANK's host, not the victim
+        ranks waiting at the allreduce); everything else implicates the
+        offending series' reporter."""
+        key = trip.series.key
+        out = {"node_id": trip.series.node_id, "source": key.source,
+               "detail": ""}
+        if trip.kind == "train":
+            # The offending series already names the rank (its tag); the
+            # straggler report can only sharpen that — its rolling-window
+            # MEDIAN lags a fresh regression by half the window, so it
+            # often hasn't flagged anyone yet at trip time.
+            rank_tag = key.tag_dict().get("rank")
+            if rank_tag is not None:
+                try:
+                    out["rank"] = int(rank_tag)
+                except ValueError:
+                    pass
+            try:
+                from ray_tpu.profiling.straggler import build_report
+
+                report = build_report(self._train_stats_fn() or {},
+                                      threshold=1.15)
+                if report.get("lagging_host"):
+                    out["node_id"] = report["lagging_host"]
+                    out["rank"] = report.get("lagging_rank")
+                    st = next((w for w in report.get("stragglers", [])
+                               if w.get("rank") == out.get("rank")), None)
+                    if st:
+                        out["source"] = st.get("source", out["source"])
+                        out["detail"] = st.get("cause", "")
+            except Exception:
+                pass
+        elif trip.kind == "node":
+            out["node_id"] = key.tag_dict().get(
+                "node", trip.series.node_id)
+        return out
+
+    def _related(self, trip: Trip, max_series: int = 6) -> list[dict]:
+        """A few sibling series from the same reporter — the queue depth
+        next to the p99 spike, the RSS next to the step drift."""
+        key = trip.series.key
+        out = []
+        for series in self.store.series():
+            if series.key.source != key.source or series.key == key:
+                continue
+            pts = self.store.window(series.key, seconds=120.0,
+                                    max_points=60)
+            if not pts:
+                continue
+            out.append({"name": series.key.name,
+                        "tags": series.key.tag_dict(), "points": pts})
+            if len(out) >= max_series:
+                break
+        return out
+
+    async def _auto_capture(self, incident_id: str, node_id: str) -> dict:
+        """Targeted profiler capture scoped to the implicated node, under
+        hard guardrails. Returns a summary dict; the full capture payload
+        is written under <temp_dir>/watchdog/ (an incident row must stay
+        cheap to list)."""
+        cfg = self.cfg
+        if not cfg.watchdog_auto_capture or self._profile_fn is None:
+            return {"status": "skipped: auto-capture disabled"}
+        if not node_id:
+            return {"status": "skipped: no implicated node"}
+        if self._captures_inflight >= cfg.watchdog_max_auto_captures:
+            return {"status": "skipped: concurrent capture cap"}
+        if self.captures_done >= cfg.watchdog_capture_budget:
+            return {"status": "skipped: capture budget exhausted"}
+        now = time.monotonic()
+        last = self._node_capture_ts.get(node_id)
+        if last is not None and \
+                now - last < cfg.watchdog_capture_cooldown_s:
+            return {"status": f"skipped: node cooldown "
+                              f"({cfg.watchdog_capture_cooldown_s}s)"}
+        nodes = self._nodes_fn() or {}
+        info = nodes.get(node_id)
+        if info is not None and not getattr(info, "alive", True):
+            return {"status": "skipped: implicated node is dead"}
+        self._node_capture_ts[node_id] = now
+        self._captures_inflight += 1
+        try:
+            res = await asyncio.wait_for(
+                self._profile_fn(node_id, cfg.watchdog_capture_seconds),
+                timeout=cfg.watchdog_capture_seconds + CAPTURE_RPC_SLACK_S)
+        except Exception as e:  # noqa: BLE001 - partial evidence wins
+            return {"status": f"error: {type(e).__name__}: {e}"}
+        finally:
+            self._captures_inflight -= 1
+        self.captures_done += 1
+        captures = (res or {}).get("captures") or []
+        summary = {
+            "status": "captured",
+            "node_id": node_id,
+            "captures": len(captures),
+            "samples": sum(int(c.get("samples", 0)) for c in captures),
+            "errors": (res or {}).get("errors") or {},
+        }
+        try:
+            d = os.path.join(get_config().temp_dir, "watchdog")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"incident-{incident_id}-profile.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(res, f, default=str)
+            os.replace(tmp, path)
+            summary["path"] = path
+        except Exception:
+            pass
+        return summary
+
+    # -------------------------------------------------------------- reads
+    def list_incidents(self, since: float = 0.0, limit: int = 100,
+                       incident_id: str | None = None) -> list[dict]:
+        rows = [i for i in self.incidents
+                if i["wall_ts"] >= since
+                and (incident_id is None or i["id"] == incident_id)]
+        return rows[-max(1, int(limit)):]
+
+    def status(self) -> dict:
+        return {
+            "enabled": True,
+            "rules": [r.name for r in self.rules],
+            "incidents": len(self.incidents),
+            "pending_trips": len(self._pending),
+            "captures_done": self.captures_done,
+            "eval_seconds": round(self.eval_s, 4),
+            "dropped_trips": self._dropped_trips,
+            "store": self.store.stats(),
+        }

@@ -309,6 +309,111 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def cmd_incidents(args) -> int:
+    """Health-watchdog incidents: what the cluster noticed about itself
+    (rule, implicated entity, evidence bundle). --get <id> dumps one
+    incident in full (series window, flight-record path, profile
+    summary)."""
+    _connect(args.address)
+    from ray_tpu.util.state import incidents
+
+    if args.get:
+        rows = incidents(incident_id=args.get)
+        if not rows:
+            print(f"no incident {args.get!r}", file=sys.stderr)
+            return 1
+        print(json.dumps(rows[-1], indent=2, default=str))
+        return 0
+    rows = incidents(since=args.since, limit=args.limit)
+    if args.json:
+        print(json.dumps(rows, default=str))
+        return 0
+    import time as _time
+
+    table = []
+    for inc in reversed(rows):  # newest first
+        prof = (inc.get("profile") or {}).get("status", "")
+        table.append({
+            "id": inc["id"],
+            "rule": inc["rule"],
+            # wall_ts is the HEAD's clock; clamp so client skew can't
+            # print a negative age.
+            "age_s": f"{max(0.0, _time.time() - inc['wall_ts']):.0f}",
+            "node": (inc.get("implicated") or {}).get("node_id", "")[:12],
+            "profile": prof.split(":")[0],
+            "reason": inc.get("reason", "")[:60],
+        })
+    if not table:
+        print("no incidents")
+        return 0
+    print(_fmt_table(table, ["id", "rule", "age_s", "node", "profile",
+                             "reason"]))
+    return 0
+
+
+def cmd_watch(args) -> int:
+    """Live health line: poll the watchdog store + incident deque and
+    print one compact status line per interval (new incidents are printed
+    in full as they appear). --once prints a single snapshot (scripts,
+    tests); bounded by --seconds."""
+    _connect(args.address)
+    import time as _time
+
+    from ray_tpu.util.state import incidents, timeseries, watchdog_status
+
+    def _latest(name: str, max_age_s: float = 60.0):
+        # Staleness gate: the store keeps a finished job's rings around —
+        # a run that ended an hour ago must not display as live health.
+        # Filtered HEAD-side (max_age_s, judged on the head's clock) so
+        # CLI/head clock skew can't blank or falsify the line.
+        # max_points=1: the head ships one point per series instead of
+        # serializing whole rings on every poll.
+        rows = timeseries(name=name, max_age_s=max_age_s, max_points=1)
+        vals = [r["points"][-1][1] for r in rows if r.get("points")]
+        return (max(vals), len(vals)) if vals else (None, 0)
+
+    seen: set = set()
+    deadline = _time.monotonic() + args.seconds
+    first = True
+    while True:
+        status = watchdog_status()
+        if not status.get("enabled", False):
+            print("watchdog disabled on this runtime")
+            return 1
+        parts = [f"series={status.get('store', {}).get('series', 0)}"]
+        step, n_ranks = _latest("train_step_time_s")
+        if step is not None:
+            parts.append(f"step={step * 1e3:.0f}ms/{n_ranks}r")
+        p99, _ = _latest("serve_ttft_s:p99")
+        if p99 is not None:
+            parts.append(f"ttft_p99={p99 * 1e3:.0f}ms")
+        depth, _ = _latest("serve_router_queue_depth")
+        if depth is not None:
+            parts.append(f"queue={depth:g}")
+        shed, _ = _latest("serve_shed_total:rate")
+        if shed:
+            parts.append(f"shed={shed:.1f}/s")
+        rows = incidents(limit=status.get("incidents", 0) or 100)
+        parts.append(f"incidents={len(rows)}")
+        print(f"[watch {_time.strftime('%H:%M:%S')}] " + " ".join(parts),
+              flush=True)
+        for inc in rows:
+            if inc["id"] in seen:
+                continue
+            seen.add(inc["id"])
+            if first and not args.once:
+                continue  # backlog: count it, don't spam the scrollback
+            print(f"  incident {inc['id']} [{inc['rule']}] "
+                  f"{inc.get('reason', '')} -> "
+                  f"node {(inc.get('implicated') or {}).get('node_id', '')}"
+                  f" profile={(inc.get('profile') or {}).get('status', '')}",
+                  flush=True)
+        first = False
+        if args.once or _time.monotonic() >= deadline:
+            return 0
+        _time.sleep(args.interval)
+
+
 def cmd_stragglers(args) -> int:
     """Straggler report: workers ranked by step time vs the fleet, lagging
     host named."""
@@ -368,6 +473,23 @@ def main(argv: list[str] | None = None) -> int:
     strag = sub.add_parser("stragglers")
     strag.add_argument("--threshold", type=float, default=1.15)
     strag.add_argument("--json", action="store_true")
+    inc = sub.add_parser(
+        "incidents", help="health-watchdog incidents: auto-detected "
+                          "anomalies with captured evidence bundles")
+    inc.add_argument("--get", default=None, help="dump one incident by id")
+    inc.add_argument("--since", type=float, default=0.0,
+                     help="only incidents after this unix timestamp")
+    inc.add_argument("--limit", type=int, default=100)
+    inc.add_argument("--json", action="store_true")
+    wt = sub.add_parser(
+        "watch", help="live cluster-health line off the watchdog series "
+                      "store (step time, serve p99, queue, sheds, "
+                      "incidents)")
+    wt.add_argument("--interval", type=float, default=2.0)
+    wt.add_argument("--seconds", type=float, default=300.0,
+                    help="stop after this long")
+    wt.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
     ch = sub.add_parser(
         "chaos", help="fault injection: kill workers/slices/daemons, "
                       "delay/drop RPCs (see ray_tpu/chaos/injector.py)")
@@ -405,7 +527,8 @@ def main(argv: list[str] | None = None) -> int:
             "timeline": cmd_timeline, "logs": cmd_logs, "memory": cmd_memory,
             "flight-records": cmd_flight_records, "profile": cmd_profile,
             "stack": cmd_stack, "stragglers": cmd_stragglers,
-            "chaos": cmd_chaos}
+            "chaos": cmd_chaos, "incidents": cmd_incidents,
+            "watch": cmd_watch}
     return cmds[args.command](args)
 
 

@@ -245,8 +245,9 @@ def report(metrics: dict[str, Any], checkpoint: str | None = None) -> None:
 
 def _maybe_chaos(ctx: TrainContext, metrics: dict[str, Any]) -> None:
     """train.step fault-injection probe: every report() is a step boundary,
-    so a scheduled worker/slice kill lands here, mid-run, inside the target
-    process. Attrs exposed to rule predicates: rank, slice, step, restart."""
+    so a scheduled worker/slice kill — or a delay rule, i.e. an injected
+    straggler — lands here, mid-run, inside the target process. Attrs
+    exposed to rule predicates: rank, slice, step, restart."""
     from ray_tpu.chaos import injector as _chaos
 
     if not _chaos.ACTIVE:

@@ -312,6 +312,52 @@ def stragglers(threshold: float = 1.15) -> dict:
     return build_report(sources, threshold=threshold)
 
 
+def incidents(since: float = 0.0, limit: int = 100,
+              incident_id: str | None = None) -> list[dict]:
+    """Health-watchdog incidents: anomalies the head detected on its
+    rolling hot-path series, each with the implicated entity, the
+    offending series window, a flight-record path, and the targeted
+    profile summary. Cluster mode reads the head's bounded incident deque;
+    in-process runtimes have no watchdog and return []."""
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "incidents")
+    if not hasattr(rt, "incidents"):
+        return []
+    return rt.incidents(since=since, limit=limit,
+                        incident_id=incident_id).get("incidents", [])
+
+
+def timeseries(name: str | None = None, source: str | None = None,
+               node_id: str | None = None, tags: dict | None = None,
+               since: float = 0.0, max_points: int = 0,
+               max_age_s: float = 0.0) -> list[dict]:
+    """Rolling hot-path series from the head's watchdog store (train step
+    time / tokens/s / MFU, collective latency+bytes, serve TTFT/TPOT/queue/
+    shed, transfer bytes, per-process RSS/HBM, node heartbeat gaps).
+    ``name`` matches exactly, or as a prefix with a trailing ``*``.
+    In-process runtimes return []."""
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "timeseries")
+    if not hasattr(rt, "get_timeseries"):
+        return []
+    return rt.get_timeseries(name=name, source=source, node_id=node_id,
+                             tags=tags, since=since, max_points=max_points,
+                             max_age_s=max_age_s).get("series", [])
+
+
+def watchdog_status() -> dict:
+    """Watchdog health: rule list, store occupancy, incidents, cumulative
+    eval seconds (duty-cycle numerator)."""
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "watchdog_status")
+    if not hasattr(rt, "watchdog_status"):
+        return {"enabled": False, "note": "in-process runtime"}
+    return rt.watchdog_status()
+
+
 def list_logs(node_id: str | None = None) -> list[dict]:
     """Per-node worker log files (reference: `ray logs` listing via the
     dashboard agent). Cluster mode only; in-process runtimes have no

@@ -236,6 +236,51 @@ class Config:
     # the push entirely).
     telemetry_flush_interval_s: float = 0.5
 
+    # --- health watchdog (ray_tpu/observability) ---
+    # Master gate: with this on, every process's telemetry flusher derives
+    # delta-encoded samples for the hot-path series (train step/tokens/MFU,
+    # collective latency+bytes, serve TTFT/TPOT/queue/shed, transfer bytes,
+    # per-process RSS/HBM) and the head runs streaming anomaly detectors
+    # over them, auto-capturing evidence on a trip. Off = no sampling, no
+    # detection, no auto-captures (the pull-based surfaces still work).
+    watchdog_enabled: bool = True
+    # Head loop cadence: heartbeat-gap sampling + incident assembly tick.
+    # Detection itself is streaming (evaluated at sample arrival), so this
+    # bounds evidence-capture latency, not detection latency.
+    watchdog_eval_interval_s: float = 0.5
+    # Rolling points kept per series (ring buffer) and distinct series the
+    # store accepts before dropping (watchdog_dropped_samples counts).
+    watchdog_series_samples: int = 360
+    watchdog_series_max: int = 4096
+    # Detector firing discipline (see observability/detectors.py): no
+    # verdicts before `warmup` samples; `debounce` CONSECUTIVE breaching
+    # samples to trip; a tripped series is muted for `cooldown_s`.
+    watchdog_warmup_samples: int = 10
+    watchdog_debounce: int = 3
+    watchdog_cooldown_s: float = 30.0
+    # Spike rules (step-time drift, collective latency, serve p99,
+    # heartbeat jitter): robust z-score above this AND value above
+    # ratio * baseline (both, so steady-but-noisy series can't trip).
+    watchdog_z_threshold: float = 6.0
+    watchdog_spike_ratio: float = 2.0
+    # Absolute floors for the baseline-free rules: shed/expiry rate
+    # (healthy = 0/s), router queue growth (levels are fine, sustained
+    # growth is the death spiral), per-process RSS/HBM leak slope.
+    watchdog_shed_rate_per_s: float = 0.5
+    watchdog_queue_growth_per_s: float = 2.0
+    watchdog_mem_slope_mb_s: float = 256.0
+    # Incident retention (bounded deque on the head).
+    watchdog_max_incidents: int = 64
+    # Anomaly-triggered targeted profiler captures (PR-5 profile_node RPC,
+    # scoped to the implicated node) — hard guardrails: concurrent-capture
+    # cap, per-node cooldown, and a lifetime budget per head, so the
+    # watchdog can never pile profiling onto an already-sick cluster.
+    watchdog_auto_capture: bool = True
+    watchdog_capture_seconds: float = 1.5
+    watchdog_max_auto_captures: int = 1
+    watchdog_capture_cooldown_s: float = 60.0
+    watchdog_capture_budget: int = 20
+
     # --- on-demand profiler (ray_tpu/profiling) ---
     # Python stack-sampler rate for `profile` captures. 100 Hz keeps the
     # measured overhead within the <=2% budget PERF_PROFILER.json tracks;

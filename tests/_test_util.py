@@ -1,0 +1,18 @@
+"""Shared test helpers (importable: pytest inserts tests/ on sys.path in
+this rootdir layout, so test modules use ``from _test_util import ...``)."""
+
+import os
+
+
+def load_factor() -> float:
+    """Deadline multiplier gated on actual scheduler pressure, not wall
+    clock: under a loaded full-suite run on a small box (1-min loadavg well
+    above the core count) daemon forks, worker boots, and background GC
+    chains serialize behind unrelated work, so every readiness/poll
+    deadline stretches. Capped so a pathological loadavg can't turn a real
+    hang into an hour-long wait."""
+    try:
+        per_core = os.getloadavg()[0] / max(os.cpu_count() or 1, 1)
+    except OSError:
+        return 1.0
+    return min(max(per_core, 1.0), 4.0)

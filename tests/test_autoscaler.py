@@ -22,18 +22,7 @@ from ray_tpu.autoscaler import (
 )
 
 
-def _load_factor() -> float:
-    """Deadline multiplier gated on actual scheduler pressure (same policy
-    as tests/test_start_cli.py): the subprocess-bootstrap drill forks a
-    real node process whose boot (framework import, register) serializes
-    behind unrelated full-suite work on a small box, stretching every
-    scale-up/readiness/terminate deadline. Capped so a pathological
-    loadavg can't turn a real hang into an hour-long wait."""
-    try:
-        per_core = os.getloadavg()[0] / max(os.cpu_count() or 1, 1)
-    except OSError:
-        return 1.0
-    return min(max(per_core, 1.0), 4.0)
+from _test_util import load_factor as _load_factor  # noqa: E402
 
 
 class TestInstanceFsm:

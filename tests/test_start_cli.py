@@ -28,17 +28,7 @@ def _env():
     return env
 
 
-def _load_factor() -> float:
-    """Deadline multiplier gated on actual scheduler pressure, not wall
-    clock: under a loaded full-suite run on a small box (1-min loadavg well
-    above the core count) daemon forks and worker boots serialize behind
-    unrelated work, so every readiness/poll deadline stretches. Capped so a
-    pathological loadavg can't turn a real hang into an hour-long wait."""
-    try:
-        per_core = os.getloadavg()[0] / max(os.cpu_count() or 1, 1)
-    except OSError:
-        return 1.0
-    return min(max(per_core, 1.0), 4.0)
+from _test_util import load_factor as _load_factor  # noqa: E402
 
 
 def _cli(*argv, timeout=60):
