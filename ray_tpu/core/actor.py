@@ -69,11 +69,16 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_names: list[str] | None = None):
+        import itertools
         import os as _os
 
         self._actor_id = actor_id
         self._method_names = method_names or []
-        self._seq_no = 0
+        # Atomic under the GIL: handles are shared across threads on hot
+        # paths (the serve router caches one handle per replica), and a
+        # racy `+= 1` would mint duplicate seq_nos — i.e. duplicate task
+        # ids and colliding return object ids.
+        self._seq = itertools.count(1)
         # Distinguishes task ids from different handles to the same actor
         # (each handle has its own ordered call sequence).
         self._handle_nonce = _os.urandom(4)
@@ -90,10 +95,10 @@ class ActorHandle:
     def _submit_method(self, method_name: str, args: tuple, kwargs: dict, num_returns: int = 1):
         worker = global_worker
         worker.check_connected()
-        self._seq_no += 1
+        seq_no = next(self._seq)
         args_blob, arg_refs = serialization.serialize_args((args, kwargs))
         spec = TaskSpec(
-            task_id=TaskID.for_actor_task(self._actor_id, self._seq_no, self._handle_nonce),
+            task_id=TaskID.for_actor_task(self._actor_id, seq_no, self._handle_nonce),
             job_id=worker.job_id,
             fn_blob=b"",
             args_blob=args_blob,
@@ -102,7 +107,7 @@ class ActorHandle:
             num_returns=num_returns,
             actor_id=self._actor_id,
             method_name=method_name,
-            seq_no=self._seq_no,
+            seq_no=seq_no,
             name=f"{method_name}",
             owner_id=worker.worker_id,
             trace_ctx=tracing.inject(),

@@ -90,6 +90,14 @@ class LLMConfig:
     # Total blocks in the pool; 0 = auto (max_num_seqs × max_seq_len / 2
     # worth of tokens, i.e. the 2×-slots-at-equal-HBM point).
     kv_num_blocks: int = 0
+    # Prefix-cache publication granularity: prompt token ids are hashed in
+    # chained blocks of this many tokens (serve/prefix.py); the engine
+    # publishes the chain hashes of every cached prompt prefix so the serve
+    # router can score replicas by matched prefix length (KV-block-aware
+    # routing). 0 disables publication. Callers computing request-side
+    # hashes (handle.options(prefix_hashes=...)) must use the same block
+    # size over the same token ids.
+    prefix_block_tokens: int = 32
 
     def model_config(self) -> LlamaConfig:
         return _resolve_model(self.model, self.dtype)

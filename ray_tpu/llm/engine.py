@@ -721,9 +721,21 @@ class LLMEngine:
         self._prefix_cached: dict[int, tuple[tuple[int, ...], float]] = {}
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
+        # KV-block-aware routing: chain hashes of the cached prefixes are
+        # published to the serve router (serve/prefix.py) so shared-prefix
+        # bursts land on the replica already holding the blocks. The hash
+        # cache is keyed by the prompt tuple and pruned to the live donor
+        # set on every publish.
+        self.prefix_block = int(getattr(config, "prefix_block_tokens", 32)
+                                or 0)
+        self._prefix_hash_cache: dict[tuple, tuple[int, ...]] = {}
         self._cache_gen = 0  # bumped when a device failure rebuilds the cache
         self._prefill_rr = -1  # last slot that ran a prefill chunk
         self._waiting: queue.Queue[GenerationRequest] = queue.Queue()
+        # Held slots returned by release_slot (user threads); the
+        # scheduler thread frees + retires them at tick start — slot and
+        # prefix-cache registries have a single mutating thread.
+        self._released: queue.Queue[GenerationRequest] = queue.Queue()
         # Preempted (blocked-KV) requests re-admit ahead of the queue.
         self._preempted: deque[GenerationRequest] = deque()
         self._arrival_seq = 0
@@ -820,13 +832,42 @@ class LLMEngine:
                 "finish_reason": req.finish_reason}
 
     def release_slot(self, req: GenerationRequest) -> None:
-        for slot, r in self._slots.items():
-            if r is req:
-                self._slots[slot] = None
-                self._prefix_live.pop(slot, None)
-                if self.blocked:
-                    self._free_slot_blocks(slot)
+        """Return a ``hold_slot`` reservation (prefill_only's export is
+        done). Handed to the scheduler thread: it frees the slot and — the
+        hand-off's KV line being a fully-prefilled prompt — RETIRES it as
+        a cached prefix instead of discarding it, so a dedicated prefill
+        engine accumulates the prefix cache its replica publishes for
+        KV-block-aware routing (a shared-prefix burst then prefills only
+        the tail). Freeing from this (user) thread raced the scheduler's
+        admit: retire-then-clear could in-place-adopt a slot mid-release,
+        clear-then-retire could mark a freshly re-admitted slot cached."""
+        self._released.put(req)
         self._work.set()
+
+    def _process_releases(self) -> None:
+        """Scheduler-thread half of release_slot."""
+        while True:
+            try:
+                req = self._released.get_nowait()
+            except queue.Empty:
+                return
+            if req.finish_reason is None and not req.error:
+                # Export timed out while the prefill still runs: its
+                # _finish (hold_slot was dropped) frees the slot — freeing
+                # here would hand a mid-prefill slot to the next admit.
+                continue
+            for slot, r in self._slots.items():
+                if r is req:
+                    self._slots[slot] = None
+                    self._prefix_live.pop(slot, None)
+                    if self.blocked:
+                        self._free_slot_blocks(slot)
+                    elif (req.finish_reason not in (None, "error")
+                          and not req.error):
+                        # Clean completed prefill: the slot's KV holds
+                        # exactly req.prompt_ids' prefix — retire it.
+                        self._prefix_cached[slot] = (
+                            tuple(req.prompt_ids), time.monotonic())
 
     def submit_prefilled(self, payload: dict,
                          sampling: SamplingParams | None = None,
@@ -866,13 +907,49 @@ class LLMEngine:
         self._work.set()
         self._thread.join(timeout=5)
 
+    def prefix_block_hashes(self) -> tuple[int, ...]:
+        """Chain hashes (serve/prefix.py) of every prompt prefix whose KV
+        this engine currently holds — live donors plus retired cached
+        slots. This is what the replica publishes to the serve router for
+        KV-block-aware routing. Safe from any thread: the registries are
+        snapshotted (the scheduler thread mutates them concurrently) and
+        the per-prompt hash cache swap is idempotent."""
+        if self.prefix_block <= 0:
+            return ()
+        from ray_tpu.serve.prefix import block_hashes
+
+        prefixes = list(self._prefix_live.values())
+        prefixes += [toks for toks, _ in list(self._prefix_cached.values())]
+        cache = self._prefix_hash_cache
+        fresh: dict[tuple, tuple[int, ...]] = {}
+        out: set[int] = set()
+        for toks in prefixes:
+            h = cache.get(toks)
+            if h is None:
+                h = block_hashes(toks, self.prefix_block)
+            fresh[toks] = h
+            out.update(h)
+        self._prefix_hash_cache = fresh  # prune evicted prefixes
+        return tuple(sorted(out))
+
+    def router_prefix_blocks(self) -> dict | None:
+        """The publication payload serve replicas answer router_meta()
+        with (one definition of the contract for every deployment type:
+        LLMServer and PrefillServer both delegate here). None when
+        publication is disabled — the controller then stops polling."""
+        if self.prefix_block <= 0:
+            return None
+        return {"blocks": list(self.prefix_block_hashes()),
+                "block": self.prefix_block}
+
     def stats(self) -> dict:
         active = sum(1 for r in self._slots.values() if r is not None)
         out = {"active": active, "waiting": self._waiting.qsize(),
                "slots": self.max_slots,
                "prefix_hits": self.prefix_hits,
                "prefix_tokens_saved": self.prefix_tokens_saved,
-               "prefix_cached_slots": len(self._prefix_cached)}
+               "prefix_cached_slots": len(self._prefix_cached),
+               "prefix_block": self.prefix_block}
         if self.blocked:
             out["kv_blocks_total"] = self.num_blocks
             out["kv_blocks_free"] = len(self._free_blocks)
@@ -927,6 +1004,7 @@ class LLMEngine:
         # burst's dispatch, so that burst's write mask provably excludes
         # it — only slots freed BY the pending resolve (mid-burst
         # finishes) must wait for it, and those are still occupied here.
+        self._process_releases()
         worked = self._admit()
         deferred: list = []
         try:
@@ -1076,9 +1154,20 @@ class LLMEngine:
                 self._slots[slot] = req
                 admitted = True
                 continue
-            if retired and donor is not None:
+            if donor is not None and adopt < self.PREFIX_COPY_MIN:
+                # Trivial LCP (e.g. a shared few-token template label):
+                # not worth a copy, and NEVER worth destroying a donor.
+                donor = None
+            if retired and donor is not None and \
+                    adopt * 2 >= len(self._prefix_cached[donor][0]):
                 # Zero-copy: admit straight into the retired slot whose KV
-                # already holds the prefix.
+                # already holds the prefix — only when the new prompt
+                # consumes most of it. An in-place adopt OVERWRITES the
+                # donor: taking a 1000-token cached line for a 20-token
+                # LCP (hot prompts sharing a template label) was measured
+                # pinning the whole cache at ONE entry under prefix-skewed
+                # load — every admit stole the same slot while fresh
+                # slots idled.
                 slot = donor
                 self._prefix_cached.pop(slot, None)
                 req.prefilled_len = adopt
@@ -1086,7 +1175,17 @@ class LLMEngine:
                 self.prefix_tokens_saved += adopt
             else:
                 slot = self._take_slot()
-                if donor is not None and adopt >= self.PREFIX_COPY_MIN:
+                if donor is not None and slot == donor:
+                    # LRU eviction handed us the donor itself (no fresh
+                    # slot): its KV line is already in place — in-place
+                    # adoption after all, minus the copy.
+                    req.prefilled_len = adopt
+                    self.prefix_hits += 1
+                    self.prefix_tokens_saved += adopt
+                elif donor is not None:
+                    # Content copy from the donor line (live OR retired —
+                    # both hold intact KV) into the fresh slot, preserving
+                    # the donor for future siblings.
                     try:
                         self.cache = copy_prefix_kv(
                             self.model_cfg, self.cache, jnp.int32(donor),
@@ -1094,6 +1193,13 @@ class LLMEngine:
                         req.prefilled_len = adopt
                         self.prefix_hits += 1
                         self.prefix_tokens_saved += adopt
+                        if donor in self._prefix_cached:
+                            # Donor USED: now is when it earns its LRU
+                            # refresh (stamping at _best_prefix time let
+                            # guard-rejected donors dodge eviction).
+                            self._prefix_cached[donor] = (
+                                self._prefix_cached[donor][0],
+                                time.monotonic())
                     except Exception as e:  # noqa: BLE001
                         # copy_prefix_kv DONATES the cache: a failed
                         # dispatch consumed its buffers, so this is a
@@ -1217,9 +1323,14 @@ class LLMEngine:
         best_slot, best_p, best_retired = None, 0, False
         if cap <= 0:
             return best_slot, best_p, best_retired
-        # Snapshot both registries: release_slot (user threads) pops
-        # _prefix_live concurrently; iterating the live dict would raise
-        # "dictionary changed size during iteration" mid-admit.
+        # Both registries are mutated only on this (scheduler) thread —
+        # release_slot hands frees over via the _released queue — but
+        # user threads READ them (prefix_block_hashes), so keep the
+        # snapshot-iterate discipline for the shared-read invariant.
+        # LRU re-stamping of a retired donor happens in _admit, and ONLY
+        # when the donor is actually used: stamping here shielded lines
+        # the admission guards then rejected (e.g. a trivial template-
+        # label LCP) from eviction, starving genuinely hot entries.
         for slot, toks in list(self._prefix_live.items()):
             p = _lcp(prompt_ids, toks, cap)
             if p > best_p:
@@ -1228,9 +1339,6 @@ class LLMEngine:
             p = _lcp(prompt_ids, toks, cap)
             if p > best_p or (p == best_p and p > 0 and not best_retired):
                 best_slot, best_p, best_retired = slot, p, True
-        if best_slot is not None and best_retired:
-            self._prefix_cached[best_slot] = (
-                self._prefix_cached[best_slot][0], time.monotonic())
         return best_slot, best_p, best_retired
 
     def _admit_prefilled(self, req: GenerationRequest, slot: int) -> None:

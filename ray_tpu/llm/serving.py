@@ -109,6 +109,14 @@ class LLMServer:
     def stats(self) -> dict:
         return self.engine.stats()
 
+    def router_prefix_blocks(self) -> dict | None:
+        """KV-block-aware routing publication (serve/prefix.py): the serve
+        controller polls this through ServeReplica.router_meta and
+        piggybacks the hashes on the replica snapshot, so routers score
+        candidates by matched prefix length. Token domain — handle callers
+        pass token-id chain hashes via options(prefix_hashes=...)."""
+        return self.engine.router_prefix_blocks()
+
     def check_health(self) -> None:
         if not self.engine._thread.is_alive():
             raise RuntimeError("engine scheduler thread died")

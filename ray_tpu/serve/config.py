@@ -93,7 +93,15 @@ class ReplicaInfo:
     receive new assignments (graceful shutdown / rolling update). The
     ``settings`` dict is the deployment's ResilienceSettings
     (deployment-level, duplicated per replica so the snapshot stays a flat
-    list routers already understand)."""
+    list routers already understand).
+
+    ``prefix_blocks`` is the replica's published prefix-cache state for
+    KV-block-aware routing (serve/prefix.py chain hashes, collected by the
+    controller through ServeReplica.router_meta on a cadence and
+    piggybacked here): None = the replica doesn't publish (non-LLM
+    deployments); a tuple = the chain hashes of every cached prompt prefix
+    it holds, with ``prefix_block`` the block size they were computed
+    with."""
 
     replica_id: str
     deployment_name: str
@@ -101,6 +109,8 @@ class ReplicaInfo:
     max_ongoing_requests: int
     draining: bool = False
     settings: dict | None = None
+    prefix_blocks: tuple | None = None
+    prefix_block: int = 0
 
 
 @dataclass

@@ -41,6 +41,14 @@ class _Replica:
     drain_ref: Any = None
     stop_deadline: float = 0.0
     pg: Any = None  # per-replica gang placement group, if configured
+    # Prefix-cache publication (KV-block-aware routing): last collected
+    # router_meta state. prefix_capable None = not yet probed; False =
+    # replica answered None once, never polled again (non-LLM deployment).
+    prefix_blocks: tuple | None = None
+    prefix_block: int = 0
+    prefix_capable: bool | None = None
+    prefix_ref: Any = None
+    prefix_sent_at: float = 0.0
 
 
 @dataclass
@@ -228,6 +236,7 @@ class ServeController:
             with self._lock:
                 self._check_starting(ds)
                 self._check_health(ds)
+                self._collect_prefix_state(ds)
                 self._autoscale(ds)
                 target = 0 if ds.deleting else self._target_count(ds)
                 self._scale_and_roll(ds, target)
@@ -273,7 +282,12 @@ class ServeController:
                 actor_name=r.actor_name,
                 max_ongoing_requests=ds.config.max_ongoing_requests,
                 draining=draining,
-                settings=settings))
+                settings=settings,
+                # Prefix-cache publication rides the snapshot; dataclass
+                # equality against ds.published means a changed hash set
+                # republishes (throttled by the collection cadence).
+                prefix_blocks=r.prefix_blocks,
+                prefix_block=r.prefix_block))
         return infos
 
     # -- replica lifecycle --
@@ -423,6 +437,55 @@ class ServeController:
             if r.consecutive_failures >= ds.config.max_consecutive_health_failures:
                 ds.message = f"replica {r.replica_id} failed health checks"
                 self._stop_replica(ds, r, force=True)
+
+    def _collect_prefix_state(self, ds: _DeploymentState) -> None:
+        """Poll each RUNNING replica's router_meta() on a cadence and stash
+        its prefix-cache chain hashes on the replica record; _running_infos
+        piggybacks them on the long-poll snapshot (KV-block-aware routing,
+        serve/prefix.py). Non-blocking like the health checks: one
+        outstanding probe per replica, collected on a later pass. A replica
+        that answers None once (no router_prefix_blocks on the callable) is
+        marked incapable and never polled again."""
+        from ray_tpu.utils.config import get_config
+
+        period = float(getattr(get_config(),
+                               "serve_prefix_publish_period_s", 0.5))
+        if period <= 0 or ds.deleting:
+            return
+        now = time.monotonic()
+        for r in ds.replicas:
+            if r.state != RUNNING or r.prefix_capable is False:
+                continue
+            if r.prefix_ref is None:
+                if now - r.prefix_sent_at >= period:
+                    try:
+                        r.prefix_ref = r.actor.router_meta.remote()
+                        r.prefix_sent_at = now
+                    except Exception:  # noqa: BLE001 - replica racing away
+                        pass
+                continue
+            ready, _ = ray_tpu.wait([r.prefix_ref], num_returns=1, timeout=0)
+            if ready:
+                meta, answered = None, True
+                try:
+                    meta = ray_tpu.get(r.prefix_ref)
+                except Exception:  # noqa: BLE001 - health checks own
+                    answered = False  # replica-death handling; retry later
+                r.prefix_ref = None
+                if not answered:
+                    # Transient RPC failure is NOT a "doesn't publish"
+                    # answer — marking incapable here would blind every
+                    # router to this replica's cache for its lifetime.
+                    continue
+                if meta is None:
+                    if r.prefix_capable is None:
+                        r.prefix_capable = False
+                    continue
+                r.prefix_capable = True
+                r.prefix_blocks = tuple(meta.get("blocks") or ())
+                r.prefix_block = int(meta.get("block") or 0)
+            elif now - r.prefix_sent_at > 10.0:
+                r.prefix_ref = None  # wedged probe: retry next period
 
     def _autoscale(self, ds: _DeploymentState) -> None:
         asc = ds.config.autoscaling_config

@@ -47,13 +47,15 @@ class DeploymentResponse:
     def __init__(self, router: Router | None, method_name: str = "",
                  args: tuple = (), kwargs: dict | None = None,
                  deadline: float | None = None,
-                 route_hint: str | None = None, ref=None):
+                 route_hint: str | None = None, ref=None,
+                 prefix_hashes: tuple | None = None):
         self._router = router
         self._method = method_name
         self._args = args
         self._kwargs = kwargs or {}
         self._deadline = deadline
         self._hint = route_hint
+        self._prefix_hashes = prefix_hashes
         self._lock = threading.RLock()
         self._attempts: list[tuple[Any, str]] = []  # (ref, replica_id)
         self._tried: set[str] = set()
@@ -82,6 +84,7 @@ class DeploymentResponse:
         ref, rid = self._router.assign_request(
             self._method, self._args, self._kwargs,
             deadline=self._deadline, route_hint=self._hint,
+            prefix_hashes=self._prefix_hashes,
             exclude=frozenset(self._tried))
         if rid:
             self._tried.add(rid)
@@ -406,6 +409,10 @@ def _reset_routers() -> None:
             for router, poll in per_runtime.values():
                 if poll is not None:
                     poll.stop()
+                try:
+                    router.close()  # stop the completion reaper thread
+                except Exception:
+                    pass
         _ROUTERS.clear()
 
 
@@ -418,6 +425,7 @@ class DeploymentHandle:
         self._stream = False
         self._mux_id: str | None = None
         self._route_hint: str | None = None
+        self._prefix_hashes: tuple | None = None
         self._timeout_s: float | None = None  # None = deployment default
         self._lock = threading.Lock()
         self._router: Router | None = None
@@ -429,6 +437,7 @@ class DeploymentHandle:
                 stream: bool | None = None,
                 multiplexed_model_id: str | None = None,
                 route_hint: str | None = None,
+                prefix_hashes: tuple | None = None,
                 timeout_s: float | None = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self.app_name,
                              method_name or self._method_name)
@@ -437,12 +446,18 @@ class DeploymentHandle:
         # is readable replica-side via serve.get_multiplexed_model_id()
         # (reference: handle.options(multiplexed_model_id=...)). route_hint
         # is the bare affinity key (reference: prefix-aware routing).
+        # prefix_hashes is the precise variant: the request prompt's
+        # chained block hashes (serve/prefix.py), scored against the
+        # prefix-cache state replicas publish — the router lands the call
+        # on the replica holding the longest matching cached prefix.
         # timeout_s overrides the deployment's request_timeout_s as this
         # call's total budget (deadline = now + timeout_s at .remote()).
         h._mux_id = multiplexed_model_id \
             if multiplexed_model_id is not None else self._mux_id
         h._route_hint = route_hint if route_hint is not None \
             else self._route_hint
+        h._prefix_hashes = tuple(prefix_hashes) \
+            if prefix_hashes is not None else self._prefix_hashes
         h._timeout_s = timeout_s if timeout_s is not None \
             else self._timeout_s
         return h
@@ -462,6 +477,7 @@ class DeploymentHandle:
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
                       else v) for k, v in kwargs.items()}
         hint = self._route_hint or self._mux_id
+        hashes = self._prefix_hashes
         if self._mux_id:
             kwargs["__rtpu_mux_id"] = self._mux_id  # replica context
         timeout_s = self._timeout_s if self._timeout_s is not None \
@@ -473,13 +489,14 @@ class DeploymentHandle:
             def resubmit(exclude):
                 return router.assign_request(method, args, kwargs,
                                              stream=True, route_hint=hint,
+                                             prefix_hashes=hashes,
                                              deadline=deadline,
                                              exclude=exclude)
 
             try:
                 (gen, on_done), rid = router.assign_request(
                     method, args, kwargs, stream=True, route_hint=hint,
-                    deadline=deadline)
+                    prefix_hashes=hashes, deadline=deadline)
             except BaseException as err:
                 # Never-sent submit failure: one transparent re-resolve
                 # excluding the vanished replica (mirrors the unary path).
@@ -495,7 +512,8 @@ class DeploymentHandle:
                 resubmit=resubmit,
                 timeout=timeout_s if timeout_s is not None else 60.0)
         return DeploymentResponse(router, self._method_name, args, kwargs,
-                                  deadline=deadline, route_hint=hint)
+                                  deadline=deadline, route_hint=hint,
+                                  prefix_hashes=hashes)
 
     def _ensure_router(self) -> Router:
         from ray_tpu.core.worker import global_worker
@@ -557,7 +575,17 @@ class DeploymentHandle:
                     except Exception:
                         pass
 
-                self._poll = LongPollClient(listen, [key], callback=on_update)
+                def on_alive():
+                    # Completed listen round = controller alive: keep the
+                    # router's prefix-map TTL from expiring a healthy but
+                    # UNCHANGED publication (snapshots only flow on change).
+                    r = self._router
+                    if r is not None:
+                        r.touch_prefix_map()
+
+                self._poll = LongPollClient(listen, [key],
+                                            callback=on_update,
+                                            on_alive=on_alive)
                 # Seed synchronously so the first request doesn't race the
                 # poll thread.
                 seed = ray_tpu.get(

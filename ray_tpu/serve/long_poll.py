@@ -57,13 +57,19 @@ class LongPollClient:
     def __init__(self, host_listen: Callable[[dict, float], dict],
                  keys: list[str],
                  callback: Callable[[str, Any], None] | None = None,
-                 poll_timeout: float = 5.0):
+                 poll_timeout: float = 5.0,
+                 on_alive: Callable[[], None] | None = None):
         from ray_tpu.core.worker import global_worker
 
         self._listen = host_listen
         self._versions = {k: 0 for k in keys}
         self._cache: dict[str, Any] = {}
         self._callback = callback
+        # Called after EVERY successful listen round, updates or not: a
+        # completed round proves the host is alive, which consumers use to
+        # age liveness-gated state (the router's prefix-map TTL must not
+        # expire a healthy-but-unchanged publication).
+        self._on_alive = on_alive
         self._poll_timeout = poll_timeout
         self._stopped = threading.Event()
         self._have_first = threading.Event()
@@ -117,6 +123,11 @@ class LongPollClient:
                     self._callback(key, snap)
             if updates:
                 self._have_first.set()
+            if self._on_alive is not None:
+                try:
+                    self._on_alive()
+                except Exception:  # noqa: BLE001 - liveness ping only
+                    pass
 
     def get(self, key: str, default: Any = None) -> Any:
         return self._cache.get(key, default)

@@ -1,0 +1,96 @@
+"""Chained block hashes for KV-block-aware prefix routing.
+
+Capability parity with the reference's prefix-aware request router
+(reference: serve request_router routing_policies/prefix_aware + vLLM's
+block-hash prefix caching): a prompt is hashed in fixed-size blocks where
+block ``i``'s hash chains over block ``i-1``'s — so hash ``h_i`` identifies
+the ENTIRE prefix through block ``i``, not just its own tokens. A replica
+publishes the chain hashes of every prefix its engine holds; a router
+scores candidates by how many leading request hashes the replica's set
+contains. Membership of ``h_i`` implies the whole prefix is cached, so the
+match length is exactly the reusable KV span in blocks.
+
+Two domains share one implementation:
+
+- token domain (``block_hashes``): sequences of token ids — what the
+  engine's KV cache is actually keyed by. Callers that tokenize
+  (the P/D orchestrator, engine-direct handle users, benches) compute
+  request hashes here and MUST use the replica's published block size.
+- text domain (``text_block_hashes``): UTF-8 bytes in fixed char blocks —
+  for deployments that key their cache on raw text (the serve HTTP proxy
+  cannot tokenize, so text-keyed deployments let proxy-side hints stay
+  precise). The two domains never mix: a deployment publishes in one
+  domain and its clients hash in the same one.
+
+Hashes are crc32-chained over the little-endian uint32 encoding of the
+ids: stable across processes and Python versions (no PYTHONHASHSEED), and
+cheap enough to run per request on the router hot path. 32-bit collisions
+only cost a misrouted request (the engine re-checks real token LCP before
+reusing KV), never correctness.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Iterable, Sequence
+
+import numpy as np
+
+# Hashing more than this many blocks per prefix buys nothing: routing only
+# needs enough resolution to separate hot system prompts, and the publish
+# payload must stay small enough to piggyback on every snapshot.
+MAX_BLOCKS = 64
+
+
+def block_hashes(ids: Sequence[int], block: int,
+                 max_blocks: int = MAX_BLOCKS) -> tuple[int, ...]:
+    """Chain hashes of ``ids`` in blocks of ``block`` tokens.
+
+    Only FULL blocks are hashed — a partial tail block can't be reused as
+    cached KV by a different prompt anyway (the engine always recomputes
+    at least the final prompt token). Returns () for prompts shorter than
+    one block."""
+    if block <= 0:
+        return ()
+    n = (min(len(ids), block * max_blocks) // block) * block
+    if n <= 0:
+        return ()
+    buf = np.asarray(list(ids[:n]), dtype=np.int64).astype(
+        np.uint32).tobytes()
+    out = []
+    h = 0
+    step = block * 4
+    for i in range(0, n * 4, step):
+        h = zlib.crc32(buf[i:i + step], h)
+        out.append(h)
+    return tuple(out)
+
+
+def text_block_hashes(text: str, block_chars: int = 128,
+                      max_blocks: int = MAX_BLOCKS) -> tuple[int, ...]:
+    """Text-domain chain hashes: UTF-8 bytes in ``block_chars``-byte
+    blocks (for deployments whose cache is keyed on raw text)."""
+    return block_hashes(text.encode("utf-8", "ignore"), block_chars,
+                        max_blocks)
+
+
+def match_len(hashes: Sequence[int], held: "set[int] | frozenset[int]"
+              ) -> int:
+    """Leading blocks of ``hashes`` present in ``held``. Chaining makes a
+    gap impossible in an honest publication, so stop at the first miss."""
+    n = 0
+    for h in hashes:
+        if h not in held:
+            break
+        n += 1
+    return n
+
+
+def union_hashes(prefixes: Iterable[Sequence[int]], block: int,
+                 max_blocks: int = MAX_BLOCKS) -> tuple[int, ...]:
+    """Union of chain hashes over several cached prefixes (what a replica
+    publishes), sorted for a deterministic snapshot."""
+    out: set[int] = set()
+    for p in prefixes:
+        out.update(block_hashes(p, block, max_blocks))
+    return tuple(sorted(out))

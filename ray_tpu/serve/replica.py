@@ -313,6 +313,23 @@ class ServeReplica:
                     "total": self._total, "shed": self._shed,
                     "expired": self._expired}
 
+    def router_meta(self) -> dict | None:
+        """Routing metadata the controller piggybacks on the replica
+        snapshot (KV-block-aware prefix routing): user callables that
+        define ``router_prefix_blocks() -> {"blocks": [...], "block": n}``
+        publish their prefix-cache chain hashes (serve/prefix.py); the
+        controller polls this on a cadence and routers score candidates by
+        matched prefix length. None = this deployment doesn't publish (the
+        controller then stops polling this replica). A RAISING
+        router_prefix_blocks propagates: the controller treats a failed
+        RPC as transient and retries next period — swallowing it to None
+        here would permanently mark a capable replica incapable over one
+        bad poll (e.g. mid device-failure recovery)."""
+        fn = getattr(self._callable, "router_prefix_blocks", None)
+        if not callable(fn):
+            return None
+        return fn() or None
+
     def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)
         if callable(user_check):

@@ -63,15 +63,52 @@ class Metric:
             return dict(self._series)
 
 
+class _BoundSeries:
+    """One pre-resolved series of a metric: the tag dict was merged and
+    validated ONCE at bind time, so hot-path updates skip the per-call
+    merge/validate/tuple-build of ``_series_key`` (measured as the
+    dominant cost of a Counter.inc at router request rates). Exported
+    state is identical — a bound update writes the same series the tagged
+    call would."""
+
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, metric: "Metric", key: tuple):
+        self._m = metric
+        self._key = key
+
+
+class _BoundCounter(_BoundSeries):
+    def inc(self, value: float = 1.0):
+        self._m._inc_key(self._key, value)
+
+
+class _BoundGauge(_BoundSeries):
+    def set(self, value: float):
+        self._m._set_key(self._key, value)
+
+
+class _BoundHistogram(_BoundSeries):
+    def observe(self, value: float):
+        self._m._observe_key(self._key, value)
+
+
 class Counter(Metric):
     """Monotonically increasing count."""
 
     def inc(self, value: float = 1.0, tags: dict[str, str] | None = None):
+        self._inc_key(self._series_key(tags), value)
+
+    def _inc_key(self, key: tuple, value: float):
+        # Validated here so the bound fast path keeps the monotonicity
+        # guarantee too — bound and tagged updates must behave alike.
         if value < 0:
             raise ValueError("Counter.inc() value must be >= 0")
-        key = self._series_key(tags)
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + value
+
+    def bound(self, tags: dict[str, str] | None = None) -> _BoundCounter:
+        return _BoundCounter(self, self._series_key(tags))
 
     prom_type = "counter"
 
@@ -80,9 +117,14 @@ class Gauge(Metric):
     """Last-set value."""
 
     def set(self, value: float, tags: dict[str, str] | None = None):
-        key = self._series_key(tags)
+        self._set_key(self._series_key(tags), value)
+
+    def _set_key(self, key: tuple, value: float):
         with self._lock:
             self._series[key] = float(value)
+
+    def bound(self, tags: dict[str, str] | None = None) -> _BoundGauge:
+        return _BoundGauge(self, self._series_key(tags))
 
     prom_type = "gauge"
 
@@ -104,7 +146,9 @@ class Histogram(Metric):
         self._sums: dict[tuple, float] = {}
 
     def observe(self, value: float, tags: dict[str, str] | None = None):
-        key = self._series_key(tags)
+        self._observe_key(self._series_key(tags), value)
+
+    def _observe_key(self, key: tuple, value: float):
         with self._lock:
             buckets = self._buckets.setdefault(key, [0] * (len(self.boundaries) + 1))
             idx = len(self.boundaries)
@@ -115,6 +159,9 @@ class Histogram(Metric):
             buckets[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._series[key] = self._series.get(key, 0.0) + 1  # observation count
+
+    def bound(self, tags: dict[str, str] | None = None) -> _BoundHistogram:
+        return _BoundHistogram(self, self._series_key(tags))
 
     def _hist_points(self):
         with self._lock:

@@ -208,6 +208,29 @@ class Config:
     #     half_open_probes (1) trial requests; latency_factor (5.0) /
     #     latency_min_samples (16) latency-outlier trip vs fleet median.
 
+    # --- serve inference fast path (KV-block-aware prefix routing +
+    #     disaggregated P/D KV hand-off; serve/prefix.py, serve/router.py,
+    #     llm/pd.py) ---
+    # How often the controller polls each replica's router_meta() for its
+    # prefix-cache block hashes and piggybacks them on the long-poll
+    # replica snapshot. Replicas that answer None (non-LLM deployments)
+    # are probed once and never polled again. <= 0 disables publication.
+    serve_prefix_publish_period_s: float = 0.5
+    # Router-side prefix-map entry TTL: an entry not refreshed by a
+    # snapshot within this window is ignored (ages out state from a dead
+    # controller / wedged long-poll; dead and draining replicas are
+    # dropped from the map immediately on every snapshot). Aged-out
+    # entries degrade to pow-2 routing — locality lost, correctness kept.
+    serve_prefix_map_ttl_s: float = 30.0
+    # Deployment/engine-scoped knobs documented here for the registry of
+    # record (set on LLMConfig, not env flags):
+    #   prefix_block_tokens (32): token-block granularity of the chain
+    #     hashes replicas publish and request hints are computed with.
+    #   pd_transfer_mode ("store"): disaggregated prefill→decode KV
+    #     hand-off transport — "store" ships ObjectRefs to store-backed
+    #     ndarrays over the zero-copy object plane (no serialize on the
+    #     TTFT path); "inline" pickles the KV through the handle call.
+
     # --- chaos (ray_tpu/chaos) ---
     # Master gate for the fault-injection layer. Rules come from the
     # RTPU_CHAOS env var (JSON list), RTPU_CHAOS_FILE, the `chaos` CLI verb,

@@ -48,7 +48,11 @@ class _FakeHandle:
 
 def _patch_submission(monkeypatch):
     monkeypatch.setattr(ray_tpu, "get_actor", lambda *a, **k: _FakeHandle())
-    monkeypatch.setattr(ray_tpu, "wait", lambda *a, **k: ([], []))
+    # The completion reaper waits on the submitted refs: report them all
+    # ready immediately so release/settlement runs (the pre-reaper stub
+    # returned ([], []), which the per-request watcher treated as done).
+    monkeypatch.setattr(ray_tpu, "wait",
+                        lambda refs, **k: (list(refs), []))
 
 
 # ------------------------------------------------------------ breaker unit
