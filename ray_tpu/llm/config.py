@@ -98,6 +98,17 @@ class LLMConfig:
     # hashes (handle.options(prefix_hashes=...)) must use the same block
     # size over the same token ids.
     prefix_block_tokens: int = 32
+    # Disaggregated prefill/decode KV hand-off transport:
+    #   "store"  — the prefill server exports the prompt KV as TWO
+    #              store-backed ndarrays (ray_tpu.put) and ships ObjectRefs
+    #              in the payload; the decode server imports straight from
+    #              the object plane (same-host: pinned read-only arena
+    #              views; cross-host: cut-through transfer pulls). Zero
+    #              pickle/serialize of the KV tensors on the TTFT path.
+    #   "inline" — legacy: the KV ndarrays ride the handle call pickled
+    #              inside the payload dict (one serialize + one deserialize
+    #              copy per hop). Kept for A/B benching and as a fallback.
+    pd_transfer_mode: str = "store"
 
     def model_config(self) -> LlamaConfig:
         return _resolve_model(self.model, self.dtype)

@@ -3,9 +3,16 @@
 Capability parity with the reference's P/D pattern (reference:
 python/ray/llm/_internal/serve/serving_patterns/prefill_decode/pd_server.py
 — a prefill deployment computes the prompt KV, a KV connector ships it, and
-a decode deployment continues generation): here the KV slice travels as a
-plain object through the handle call (the object store moves it; intra-node
-it rides the shm arena), and the decode engine imports it into a slot.
+a decode deployment continues generation).
+
+KV hand-off (``LLMConfig.pd_transfer_mode``): in the default ``"store"``
+mode the prompt KV never touches a pickler — the prefill server exports the
+two device slices as store-backed ndarrays (``ray_tpu.put`` scatter-writes
+the raw buffer into the object plane) and the payload carries only
+ObjectRefs; the decode server materializes them straight from the plane
+(same-host: pinned read-only arena views; cross-host: cut-through transfer
+pulls) and imports into a slot. ``"inline"`` keeps the legacy
+pickle-through-the-handle-call path for A/B comparison.
 
 Prefill replicas never decode (their slots turn over at prompt rate) and
 decode replicas never prefill (steady small-batch decode steps) — the
@@ -15,6 +22,7 @@ latency isolation that motivates the pattern.
 from __future__ import annotations
 
 import json
+import threading
 import time
 import uuid
 from typing import Any
@@ -24,16 +32,101 @@ from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.engine import LLMEngine
 from ray_tpu.llm.serving import _sampling_from
 
+_kv_metrics = None
+_kv_metrics_lock = threading.Lock()
+
+
+def kv_metrics():
+    """KV hand-off accounting, the bench/test proof surface for the
+    zero-copy path: ``llm_kv_handoff_bytes{path}`` counts payload tensor
+    bytes by transport ("store" = object-plane ndarrays, "inline" =
+    pickled through the handle call) and ``llm_kv_serialized_bytes`` counts
+    ONLY bytes that took a serialize/deserialize copy — zero on the store
+    path by construction."""
+    global _kv_metrics
+    with _kv_metrics_lock:
+        if _kv_metrics is None:
+            from ray_tpu.util.metrics import Counter
+
+            _kv_metrics = {
+                "bytes": Counter(
+                    "llm_kv_handoff_bytes",
+                    "prompt-KV bytes handed from prefill to decode engines",
+                    tag_keys=("path",)),
+                "serialized": Counter(
+                    "llm_kv_serialized_bytes",
+                    "prompt-KV bytes that crossed a serialize/deserialize "
+                    "copy during hand-off (zero on the store path)"),
+                "handoffs": Counter(
+                    "llm_kv_handoffs_total",
+                    "disaggregated prefill->decode hand-offs",
+                    tag_keys=("path",)),
+            }
+    return _kv_metrics
+
+
+def export_kv_payload(payload: dict, mode: str) -> dict:
+    """Swap the raw KV ndarrays for store-backed ObjectRefs (store mode).
+
+    The put() path tags the arrays as raw-buffer objects (_TAG_NDARRAY):
+    the store scatter-writes the memoryview — no pickle framing, and the
+    consumer's get() is an arena view (same host) or a transfer-plane pull
+    (cross host), never an unpickle."""
+    import ray_tpu
+
+    if mode not in ("store", "inline"):
+        # A typo'd mode must not silently pickle multi-MB KV per request
+        # (the zero-copy path would be off with no error anywhere).
+        raise ValueError(
+            f"unknown pd_transfer_mode {mode!r}: expected 'store' or "
+            f"'inline'")
+    mtr = kv_metrics()
+    nbytes = payload["kv_k"].nbytes + payload["kv_v"].nbytes
+    if mode == "store":
+        out = dict(payload)
+        kv_k, kv_v = out.pop("kv_k"), out.pop("kv_v")
+        out["kv_ref_k"] = ray_tpu.put(kv_k)
+        out["kv_ref_v"] = ray_tpu.put(kv_v)
+        mtr["bytes"].inc(nbytes, tags={"path": "store"})
+        mtr["handoffs"].inc(tags={"path": "store"})
+        return out
+    mtr["bytes"].inc(nbytes, tags={"path": "inline"})
+    mtr["serialized"].inc(nbytes)  # will ride the handle call pickled
+    mtr["handoffs"].inc(tags={"path": "inline"})
+    return payload
+
+
+def resolve_kv_payload(payload: dict) -> dict:
+    """Materialize a store-mode payload's KV refs into (read-only,
+    store-backed) ndarrays; inline payloads pass through unchanged."""
+    if "kv_ref_k" not in payload:
+        return payload
+    import ray_tpu
+
+    out = dict(payload)
+    # One batched get: cross-host, the two transfer-plane pulls overlap
+    # instead of serializing two multi-MB fetches on the TTFT path.
+    out["kv_k"], out["kv_v"] = ray_tpu.get(
+        [out.pop("kv_ref_k"), out.pop("kv_ref_v")])
+    return out
+
 
 class PrefillServer:
     """Computes prompt KV + the first token; no decode loop runs here."""
 
     def __init__(self, llm_config: LLMConfig):
         self.engine = LLMEngine(llm_config)
+        self._mode = getattr(llm_config, "pd_transfer_mode", "store")
 
     def prefill(self, prompt_ids: list[int], sampling_kw: dict) -> dict:
-        return self.engine.prefill_only(prompt_ids,
-                                        _sampling_from(sampling_kw))
+        payload = self.engine.prefill_only(prompt_ids,
+                                           _sampling_from(sampling_kw))
+        return export_kv_payload(payload, self._mode)
+
+    def router_prefix_blocks(self) -> dict | None:
+        """Publish the engine's cached-prefix block hashes so the serve
+        router can land shared-prefix bursts here (serve/prefix.py)."""
+        return self.engine.router_prefix_blocks()
 
     def check_health(self) -> None:
         if not self.engine._thread.is_alive():
@@ -48,7 +141,7 @@ class DecodeServer:
 
     def decode(self, payload: dict, sampling_kw: dict) -> dict:
         req = self.engine.submit_prefilled(
-            payload, _sampling_from(sampling_kw))
+            resolve_kv_payload(payload), _sampling_from(sampling_kw))
         if not req.done.wait(300):
             raise TimeoutError("decode timed out")
         if req.error:
@@ -59,7 +152,8 @@ class DecodeServer:
 
     def decode_stream(self, payload: dict, sampling_kw: dict):
         req = self.engine.submit_prefilled(
-            payload, _sampling_from(sampling_kw), stream=True)
+            resolve_kv_payload(payload), _sampling_from(sampling_kw),
+            stream=True)
         while True:
             item = req.stream_queue.get()
             if item is None:
@@ -76,9 +170,9 @@ class PDServer:
     """OpenAI-style ingress orchestrating prefill → KV hand-off → decode."""
 
     def __init__(self, prefill_handle, decode_handle, llm_config: LLMConfig):
-        # Bind method handles ONCE: options() creates a fresh handle whose
-        # first call builds a router + long-poll client — per-request
-        # options() would leak a polling thread per chat call.
+        # Bind method handles ONCE: routers/long-poll clients are shared
+        # per (runtime, deployment) behind the handle, but binding here
+        # keeps the per-request path to a cheap options() copy.
         self.prefill = prefill_handle.options(method_name="prefill")
         self.decode = decode_handle.options(method_name="decode")
         self.decode_stream_h = decode_handle.options(
@@ -88,11 +182,26 @@ class PDServer:
         self.tokenizer = get_tokenizer(llm_config.tokenizer)
         self._model_id = (llm_config.model
                          if isinstance(llm_config.model, str) else "llama")
+        self._block = int(getattr(llm_config, "prefix_block_tokens", 32)
+                          or 0)
+
+    def _prefill_handle(self, prompt: list[int]):
+        """Prefill handle with this prompt's token-block chain hashes: the
+        router lands a shared-prefix burst on the prefill replica whose
+        engine already caches those blocks (serve/prefix.py)."""
+        if not self._block:
+            return self.prefill
+        from ray_tpu.serve.prefix import block_hashes
+
+        hashes = block_hashes(prompt, self._block)
+        return self.prefill.options(prefix_hashes=hashes) if hashes \
+            else self.prefill
 
     def chat(self, messages: list[dict], **kw) -> dict:
         prompt = self.tokenizer.encode(
             self.tokenizer.apply_chat_template(messages))
-        payload = self.prefill.remote(prompt, kw).result(timeout=300)
+        payload = self._prefill_handle(prompt).remote(
+            prompt, kw).result(timeout=300)
         out = self.decode.remote(payload, kw).result(timeout=300)
         # token_ids already starts with first_token (the decode engine
         # emits the imported token as its first output) and the engine
@@ -114,7 +223,8 @@ class PDServer:
     def chat_stream(self, messages: list[dict], **kw):
         prompt = self.tokenizer.encode(
             self.tokenizer.apply_chat_template(messages))
-        payload = self.prefill.remote(prompt, kw).result(timeout=300)
+        payload = self._prefill_handle(prompt).remote(
+            prompt, kw).result(timeout=300)
         first = self.tokenizer.decode([payload["first_token"]])
         rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
         # Frames carry per-request id/model like the single-server OpenAI
@@ -160,17 +270,23 @@ class PDServer:
 
 def build_pd_openai_app(llm_config: LLMConfig, *,
                         num_prefill_replicas: int = 1,
-                        num_decode_replicas: int = 1):
-    """serve.run(build_pd_openai_app(cfg), route_prefix="/", http=True)."""
+                        num_decode_replicas: int = 1,
+                        name_prefix: str = ""):
+    """serve.run(build_pd_openai_app(cfg), route_prefix="/", http=True).
+
+    ``name_prefix`` namespaces the three deployment names so several PD
+    apps can coexist in one serve instance (deployment names are global
+    — e.g. an A/B bench running both transfer modes side by side)."""
     prefill_dep = serve.deployment(
-        name="PrefillServer", num_replicas=num_prefill_replicas,
+        name=f"{name_prefix}PrefillServer",
+        num_replicas=num_prefill_replicas,
         max_ongoing_requests=llm_config.max_num_seqs,
         health_check_period_s=2.0)(PrefillServer)
     decode_dep = serve.deployment(
-        name="DecodeServer", num_replicas=num_decode_replicas,
+        name=f"{name_prefix}DecodeServer", num_replicas=num_decode_replicas,
         max_ongoing_requests=llm_config.max_num_seqs,
         health_check_period_s=2.0)(DecodeServer)
-    pd_dep = serve.deployment(name="PDServer", num_replicas=1,
+    pd_dep = serve.deployment(name=f"{name_prefix}PDServer", num_replicas=1,
                               max_ongoing_requests=64)(PDServer)
     return pd_dep.bind(prefill_dep.bind(llm_config),
                        decode_dep.bind(llm_config), llm_config)

@@ -1,0 +1,198 @@
+"""Zero-copy KV hand-off for disaggregated prefill/decode (llm/pd.py):
+store-mode export/import moves ZERO serialized KV bytes (the bytes-moved
+assertion), continuations match the single-engine ground truth whichever
+transport carried the KV, and chunked-prefill export → import round-trips
+survive odd lengths and slot reuse after eviction."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+# ------------------------------------------------- zero-copy KV hand-off
+def _metric_total(name: str) -> float:
+    from ray_tpu.util.metrics import registry
+
+    for m in registry().metrics():
+        if m.name == name:
+            return float(sum(m._points().values()))
+    return 0.0
+
+
+class TestKvHandoff:
+    def test_store_mode_moves_zero_serialized_bytes(self):
+        """The bytes-moved assertion: a store-mode hand-off ships ONLY
+        ObjectRefs through the handle payload; every KV byte crosses as a
+        raw store buffer and the serialized-bytes counter stays flat,
+        while the inline path counts every byte."""
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.llm import LLMConfig, LLMEngine
+        from ray_tpu.llm.pd import export_kv_payload, resolve_kv_payload
+
+        ray_tpu.shutdown()
+        ray_tpu.init()
+        try:
+            eng = LLMEngine(LLMConfig(model="tiny", max_num_seqs=2,
+                                      max_seq_len=96))
+            try:
+                raw = eng.prefill_only(list(range(1, 20)))
+                kv_bytes = raw["kv_k"].nbytes + raw["kv_v"].nbytes
+                assert kv_bytes > 0
+
+                ser0 = _metric_total("llm_kv_serialized_bytes")
+
+                payload = export_kv_payload(dict(raw), "store")
+                # no ndarray rides the handle call — refs only
+                assert isinstance(payload["kv_ref_k"], ObjectRef)
+                assert isinstance(payload["kv_ref_v"], ObjectRef)
+                assert "kv_k" not in payload and "kv_v" not in payload
+                assert _metric_total("llm_kv_serialized_bytes") == ser0, \
+                    "store-mode hand-off serialized KV bytes"
+
+                back = resolve_kv_payload(payload)
+                np.testing.assert_array_equal(back["kv_k"], raw["kv_k"])
+                np.testing.assert_array_equal(back["kv_v"], raw["kv_v"])
+
+                # inline mode: every KV byte is counted as serialized
+                export_kv_payload(dict(raw), "inline")
+                assert _metric_total("llm_kv_serialized_bytes") \
+                    == ser0 + kv_bytes
+            finally:
+                eng.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+    def test_store_mode_decode_continuation_matches_inline(self):
+        """Same tokens whichever transport carried the KV."""
+        from ray_tpu.llm import LLMConfig, LLMEngine, SamplingParams
+        from ray_tpu.llm.pd import export_kv_payload, resolve_kv_payload
+
+        ray_tpu.shutdown()
+        ray_tpu.init()
+        cfg = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=96,
+                        seed=3)
+        try:
+            prompt = list(np.random.default_rng(2).integers(1, 200, 15))
+            single = LLMEngine(cfg)
+            want = single.generate(
+                prompt, SamplingParams(max_tokens=6, temperature=0.0),
+                timeout=120).token_ids
+            single.shutdown()
+
+            pre, dec = LLMEngine(cfg), LLMEngine(cfg)
+            try:
+                payload = export_kv_payload(
+                    pre.prefill_only(prompt), "store")
+                req = dec.submit_prefilled(
+                    resolve_kv_payload(payload),
+                    SamplingParams(max_tokens=5, temperature=0.0))
+                assert req.done.wait(120) and not req.error
+                assert req.out_tokens == want[:len(req.out_tokens)]
+            finally:
+                pre.shutdown()
+                dec.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
+def test_prefill_only_retires_prefix_for_publication():
+    """A dedicated prefill engine must ACCUMULATE prefix-cache state from
+    prefill_only traffic: the exported slot's KV retires as a cached
+    prefix line (not discarded with the hold_slot release), so the
+    replica publishes real block hashes for KV-block-aware routing and a
+    shared-prefix follow-up prefills only the tail."""
+    import time
+
+    from ray_tpu.llm import LLMConfig, LLMEngine
+    from ray_tpu.serve.prefix import block_hashes, match_len
+
+    cfg = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=96,
+                    prefix_block_tokens=8)
+    eng = LLMEngine(cfg)
+    try:
+        prompt = list(range(1, 34))  # 33 tokens -> 4 full blocks of 8
+        eng.prefill_only(prompt)
+        # the release (and retire) happens on the next scheduler tick
+        want = block_hashes(prompt, 8)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if match_len(want, set(eng.prefix_block_hashes())) == len(want):
+                break
+            time.sleep(0.02)
+        assert match_len(want, set(eng.prefix_block_hashes())) \
+            == len(want), "prefill_only slot was not retired for publication"
+        # a shared-prefix follow-up adopts the cached prefix
+        saved = eng.prefix_tokens_saved
+        out = eng.prefill_only(prompt + [77, 78, 79])
+        assert out["kv_k"].shape[2] == len(prompt) + 3
+        assert eng.prefix_hits >= 1 and eng.prefix_tokens_saved > saved, \
+            "shared-prefix prefill_only recomputed the cached prefix"
+    finally:
+        eng.shutdown()
+
+
+# -------------------------------------- prefill_chunk KV round-trip drill
+@pytest.mark.parametrize("prompt_len", [13, 33, 47])
+def test_prefill_chunk_kv_roundtrip_odd_lengths(prompt_len):
+    """Chunked-prefill KV export → import continuation at lengths that
+    leave partial last chunks/buckets (13 < bucket_min, 33 crosses one
+    16-bucket, 47 leaves a 15-token tail), against the single-engine
+    greedy ground truth."""
+    from ray_tpu.llm import LLMConfig, LLMEngine, SamplingParams
+
+    cfg = LLMConfig(model="tiny", max_num_seqs=2, max_seq_len=128, seed=7,
+                    prefill_bucket_min=16, prefill_chunk=16)
+    prompt = list(np.random.default_rng(prompt_len).integers(1, 200,
+                                                             prompt_len))
+    single = LLMEngine(cfg)
+    want = single.generate(prompt, SamplingParams(max_tokens=6,
+                                                  temperature=0.0),
+                           timeout=120).token_ids
+    single.shutdown()
+
+    pre, dec = LLMEngine(cfg), LLMEngine(cfg)
+    try:
+        payload = pre.prefill_only(prompt)
+        assert payload["kv_k"].shape[2] == prompt_len
+        assert payload["first_token"] == want[0]
+        req = dec.submit_prefilled(payload, SamplingParams(
+            max_tokens=5, temperature=0.0))
+        assert req.done.wait(120) and not req.error
+        assert req.out_tokens == want[:len(req.out_tokens)]
+    finally:
+        pre.shutdown()
+        dec.shutdown()
+
+
+def test_kv_import_into_reused_slot_after_eviction():
+    """A KV import must not read the previous tenant's stale tail: a
+    1-slot decode engine first runs a LONG sequence, then imports a
+    SHORTER prefill into the same slot — positions beyond the imported
+    length hold the old sequence's KV and must be masked."""
+    from ray_tpu.llm import LLMConfig, LLMEngine, SamplingParams
+
+    cfg = LLMConfig(model="tiny", max_num_seqs=1, max_seq_len=96, seed=11)
+    long_prompt = list(np.random.default_rng(3).integers(1, 200, 40))
+    short_prompt = list(np.random.default_rng(4).integers(1, 200, 9))
+
+    single = LLMEngine(cfg)
+    want = single.generate(short_prompt, SamplingParams(
+        max_tokens=6, temperature=0.0), timeout=120).token_ids
+    single.shutdown()
+
+    pre, dec = LLMEngine(cfg), LLMEngine(cfg)
+    try:
+        # occupy and retire the only slot with the long sequence
+        dec.generate(long_prompt, SamplingParams(max_tokens=8,
+                                                 temperature=0.0),
+                     timeout=120)
+        payload = pre.prefill_only(short_prompt)
+        req = dec.submit_prefilled(payload, SamplingParams(
+            max_tokens=5, temperature=0.0))
+        assert req.done.wait(120) and not req.error
+        assert req.out_tokens == want[:len(req.out_tokens)], \
+            "stale KV from the evicted tenant leaked into the import"
+    finally:
+        pre.shutdown()
+        dec.shutdown()
