@@ -14,7 +14,7 @@ from ray_tpu.core.actor import ActorHandle
 from ray_tpu.core.exceptions import RayTpuError
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.worker import global_worker
-from ray_tpu.utils.ids import JobID, NodeID, WorkerID
+from ray_tpu.utils.ids import JobID, NodeID
 
 
 def init(

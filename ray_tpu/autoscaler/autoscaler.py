@@ -14,7 +14,6 @@ import time
 from dataclasses import dataclass, field
 
 from ray_tpu.autoscaler.instance_manager import (
-    Instance,
     InstanceManager,
     InstanceStatus,
 )

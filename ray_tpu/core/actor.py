@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Any
 
 from ray_tpu.core.remote_function import _build_resources, extract_arg_refs
-from ray_tpu.core.task_spec import ActorCreationSpec, SchedulingStrategy, TaskSpec
+from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
 from ray_tpu.core.worker import global_worker
 from ray_tpu.util import tracing
 from ray_tpu.utils import serialization

@@ -21,6 +21,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
+from ray_tpu.devtools.annotations import loop_confined
 from ray_tpu.core.cluster.protocol import RpcServer, ServerConnection, spawn_task
 from ray_tpu.core.fn_registry import FN_NS
 from ray_tpu.utils.config import get_config
@@ -79,6 +80,7 @@ class ActorInfo:
     env_json: str = ""
 
 
+@loop_confined
 class HeadServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  persist_path: str | None = None):

@@ -22,6 +22,7 @@ from typing import Any, Awaitable, Callable
 
 import msgpack
 
+from ray_tpu.devtools.annotations import loop_confined
 from ray_tpu.chaos import injector as _chaos
 
 _LEN = struct.Struct("<I")
@@ -56,6 +57,7 @@ def pack_reply(rid, result=None, err: str | None = None) -> bytes:
 
 
 
+@loop_confined
 class _CoalescingWriter:
     """Batches frames written within one event-loop tick into a single
     transport write. asyncio's StreamWriter attempts a socket send per
@@ -302,6 +304,7 @@ class ServerConnection:
             pass  # peer gone; its client sees the loss from the read side
 
 
+@loop_confined
 class AsyncRpcClient:
     """Async client half: call(method, **kwargs) with correlation ids."""
 

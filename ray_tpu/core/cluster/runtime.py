@@ -26,7 +26,6 @@ records the location, and readers fetch from the holder.
 from __future__ import annotations
 
 import asyncio
-import json
 import os
 import threading
 import time

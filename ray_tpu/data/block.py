@@ -9,7 +9,7 @@ input pipelines — with conversion shims for rows, pandas, and pyarrow.
 
 from __future__ import annotations
 
-from typing import Any, Iterable, Iterator
+from typing import Any, Iterator
 
 import numpy as np
 

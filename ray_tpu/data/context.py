@@ -4,7 +4,7 @@ python/ray/data/context.py DataContext)."""
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 @dataclass

@@ -6,7 +6,7 @@ by the streaming executor)."""
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Iterable, Iterator
+from typing import Any, Callable, Iterator
 
 import numpy as np
 
@@ -19,7 +19,6 @@ from ray_tpu.data.plan import (
     LimitOp,
     LogicalOp,
     MapBlocks,
-    Read,
     make_filter_fn,
     make_flat_map_fn,
     make_map_batches_fn,

@@ -90,7 +90,8 @@ class SplitCoordinator:
                     time.sleep(0.01)
                 i += 1
         except Exception as e:  # surfaced to all consumers
-            self._error = f"{type(e).__name__}: {e}"
+            with self._lock:
+                self._error = f"{type(e).__name__}: {e}"
         finally:
             self._done = True
 

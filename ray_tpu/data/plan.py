@@ -18,7 +18,7 @@ from ray_tpu.data.block import (
     block_from_rows,
     concat_blocks,
 )
-from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.datasource import Datasource
 
 
 class LogicalOp:

@@ -8,7 +8,7 @@ pieces of one partition. All data movement stays in the object store.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable
 
 import numpy as np
 

@@ -1,0 +1,51 @@
+"""rtlint: framework-aware static analysis for ray_tpu (reference:
+absl thread-annotations GUARDED_BY + clang-tidy, rebuilt for the bug
+classes this codebase has actually shipped and hand-caught in review —
+see CHANGES.md PR 5/8/12).
+
+Rules (each reproduced as a fixture under tests/fixtures/rtlint/):
+
+- R0 style: unused module-scope imports (pyflakes F401 subset; __init__.py
+  re-export modules are exempt).
+- R1 shared-state race: attributes mutated from more than one inferred
+  thread entry point (threading.Thread targets, async RPC handlers /
+  event-loop callbacks, executor submissions) without a lock held, plus
+  the non-atomic read-modify-write detector (``self.x += 1`` on a shared
+  attribute — the PR-12 ActorHandle.seq_no bug). Driven by the
+  :func:`guarded_by` annotation convention.
+- R2 lock-order: cycles in the with-statement lock-acquisition graph, and
+  ``await`` while holding a *threading* lock inside ``async def``.
+- R3 event-loop blocking: ``time.sleep`` / sync ``RpcClient.call`` /
+  ``ray_tpu.get`` / file I/O / ``Future.result`` inside ``async def``
+  bodies (the PR-5 jax-backend-init-in-the-wrong-process class rides
+  here too: ``jax.devices()``/backend init calls in loop context).
+- R4 metrics hygiene: duplicate metric-name registration across call
+  sites (the PR-8 stranded-increments bug), ``node_id`` tag keys
+  (reserved for head federation, PR 9), and unbound per-call tag merges
+  on declared hot paths where ``Metric.bound()`` exists (PR 12).
+- R5 knob registry: every ``RTPU_*`` env read must resolve to a Config
+  field or a registry entry in utils/config.py, and attribute reads off
+  ``get_config()`` must name real Config fields.
+
+Usage: ``python -m ray_tpu lint [paths...]`` (exit 1 on unallowlisted
+findings), or :func:`run_lint` from code. True-but-accepted findings live
+in ``ray_tpu/devtools/rtlint_allow.txt`` with per-entry justifications.
+"""
+
+from ray_tpu.devtools.annotations import guarded_by, loop_confined
+
+__all__ = ["guarded_by", "loop_confined", "run_lint", "LintResult",
+           "Finding", "format_findings"]
+
+_ENGINE_EXPORTS = ("run_lint", "LintResult", "Finding", "format_findings")
+
+
+def __getattr__(name):
+    # The annotations must stay zero-cost: every hot-path module imports
+    # them, so the analyzer itself (engine/model/rules) loads lazily,
+    # only when someone actually lints.
+    if name in _ENGINE_EXPORTS:
+        from ray_tpu.devtools import engine
+
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

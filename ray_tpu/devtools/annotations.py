@@ -1,0 +1,82 @@
+"""Thread-safety annotations (reference: absl/base/thread_annotations.h
+GUARDED_BY / EXCLUSIVE_LOCKS_REQUIRED, as zero-cost Python decorators).
+
+The annotations are inert at runtime — they attach metadata the rtlint
+R1 race checker reads statically — so hot paths pay nothing for being
+documented.
+
+Class form — declare which lock guards which attributes::
+
+    @guarded_by("_lock", "_replicas", "_pending")
+    class Router:
+        ...
+
+rtlint then flags ANY mutation of ``self._replicas`` / ``self._pending``
+outside ``with self._lock:`` (``__init__`` excepted — construction
+happens before the object is shared).
+
+Method form — declare the caller must already hold the lock (absl's
+EXCLUSIVE_LOCKS_REQUIRED)::
+
+    @guarded_by("_lock")
+    def _evict_locked(self):
+        ...
+
+rtlint treats the body as running with ``self._lock`` held, so guarded
+attributes may be touched directly; keeping the convention honest is on
+the callers (name such helpers ``*_locked`` by convention).
+
+There is a sibling confinement annotation for classes whose state is
+owned by ONE event loop thread (the head server, the watchdog)::
+
+    @loop_confined
+    class Watchdog:
+        ...
+
+It declares that every method — including public sync methods called
+from async RPC handlers elsewhere — executes on that loop, so rtlint
+stops presuming an external caller thread for them. Real thread entry
+points inside the class (``threading.Thread`` targets) keep their own
+context: a loop-confined class that spawns a flusher thread still gets
+its races detected.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, TypeVar
+
+_T = TypeVar("_T")
+
+# Metadata attribute rtlint's runtime-adoption tests can introspect; the
+# static checker reads the decorator call from the AST instead.
+ATTR = "__rtlint_guarded_by__"
+CONFINED_ATTR = "__rtlint_loop_confined__"
+
+
+def loop_confined(cls: _T) -> _T:
+    """Declare every method of ``cls`` as running on one event loop."""
+    setattr(cls, CONFINED_ATTR, True)
+    return cls
+
+
+def guarded_by(lock: str, *attrs: str) -> Callable[[_T], _T]:
+    """Declare ``attrs`` (class form) or the decorated method's body
+    (method form, no attrs) as guarded by ``self.<lock>``."""
+    if not isinstance(lock, str) or not lock:
+        raise TypeError("guarded_by: lock must be a non-empty attribute "
+                        f"name string, got {lock!r}")
+    for a in attrs:
+        if not isinstance(a, str) or not a:
+            raise TypeError(f"guarded_by: attr names must be strings, got {a!r}")
+
+    def deco(obj: Any) -> Any:
+        existing = dict(getattr(obj, ATTR, {}) or {})
+        if attrs:
+            for a in attrs:
+                existing[a] = lock
+        else:
+            existing["<body>"] = lock
+        setattr(obj, ATTR, existing)
+        return obj
+
+    return deco

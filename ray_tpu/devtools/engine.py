@@ -1,0 +1,273 @@
+"""rtlint engine: file discovery, the shared parse, rule dispatch, and
+the allowlist filter.
+
+Findings print as ``file:line RULE message``. True-but-accepted findings
+live in an allowlist file (default ``ray_tpu/devtools/rtlint_allow.txt``)
+whose every entry carries a justification string — an entry without one
+is a hard error, and entries that no longer match anything are reported
+as stale so the file can't rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+from dataclasses import dataclass, field
+
+from ray_tpu.devtools.findings import Finding
+from ray_tpu.devtools.model import ModuleInfo, parse_module
+from ray_tpu.devtools.rules import ALL_RULES, RuleContext
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__),
+                                 "rtlint_allow.txt")
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules"}
+
+
+class AllowlistError(ValueError):
+    """Malformed allowlist entry (missing justification, bad shape)."""
+
+
+class LintUsageError(ValueError):
+    """Bad invocation (unknown rule id, etc.)."""
+
+
+@dataclass
+class AllowEntry:
+    rule: str
+    relpath: str
+    symbol: str
+    justification: str
+    lineno: int
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.relpath, self.symbol)
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]           # active (unallowlisted) findings
+    allowlisted: list[Finding]        # matched an allowlist entry
+    stale_entries: list[AllowEntry]   # allowlist rows that matched nothing
+    files: int
+    rule_seconds: dict[str, float]
+    wall_seconds: float
+    counts: dict[str, int] = field(default_factory=dict)  # per-rule active
+    allowlist_path: str | None = None  # the file stale line numbers refer to
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def _symbol_match(pattern: str, symbol: str) -> bool:
+    """Exact match, or a trailing ``*`` wildcard so one justified entry
+    can baseline a class (``HeadServer.*``) instead of forty rows."""
+    if pattern.endswith("*"):
+        return symbol.startswith(pattern[:-1])
+    return pattern == symbol
+
+
+def load_allowlist(path: str) -> list[AllowEntry]:
+    entries: list[AllowEntry] = []
+    if not os.path.exists(path):
+        return entries
+    with open(path) as f:
+        for lineno, raw in enumerate(f, start=1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if " -- " not in line:
+                raise AllowlistError(
+                    f"{path}:{lineno}: allowlist entry has no "
+                    f"' -- justification' suffix: {line!r}")
+            head, justification = line.split(" -- ", 1)
+            justification = justification.strip()
+            if not justification:
+                raise AllowlistError(
+                    f"{path}:{lineno}: empty justification")
+            parts = head.split()
+            if len(parts) != 3:
+                raise AllowlistError(
+                    f"{path}:{lineno}: expected 'RULE path symbol -- "
+                    f"justification', got {line!r}")
+            entries.append(AllowEntry(parts[0], parts[1], parts[2],
+                                      justification, lineno))
+    return entries
+
+
+def discover_files(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    seen: set[str] = set()  # realpath-dedup: overlapping args (a file AND
+    # its parent dir) must not parse a module twice — R4 would see every
+    # metric constructor at "two" call sites
+
+    def _add(p: str) -> None:
+        real = os.path.realpath(p)
+        if real not in seen:
+            seen.add(real)
+            files.append(p)
+
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            _add(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = [d for d in sorted(dirs) if d not in _SKIP_DIRS]
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    _add(os.path.join(root, n))
+    return files
+
+
+def _repo_base(paths: list[str]) -> str:
+    """Directory findings are reported relative to: the nearest ancestor
+    of the first target holding pyproject.toml, else the target's
+    parent."""
+    start = os.path.abspath(paths[0])
+    cur = start if os.path.isdir(start) else os.path.dirname(start)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return os.path.dirname(start) if os.path.isdir(start) \
+                else os.path.dirname(os.path.dirname(start))
+        cur = nxt
+
+
+def _load_config_registry(modules: list[ModuleInfo],
+                          ctx: RuleContext) -> None:
+    """Locate the knob registry of record among the scanned modules (R5).
+    When the scan doesn't include one (fixture corpus runs), every RTPU_*
+    read is undocumented by definition — which is what fixture tests
+    want."""
+    for mod in modules:
+        if mod.relpath.replace("\\", "/").endswith("utils/config.py"):
+            ctx.config_source = mod.source
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) and node.name == "Config":
+                    for item in node.body:
+                        if isinstance(item, ast.AnnAssign) and isinstance(
+                                item.target, ast.Name):
+                            ctx.config_fields.add(item.target.id)
+            return
+
+
+def run_lint(paths: list[str] | None = None,
+             allowlist: str | None = DEFAULT_ALLOWLIST,
+             rules: list[str] | None = None,
+             base_dir: str | None = None) -> LintResult:
+    """Run the rule suite over ``paths`` (default: the installed ray_tpu
+    package) and filter through the allowlist. ``allowlist=None``
+    disables filtering (fixture tests)."""
+    t0 = time.perf_counter()
+    if not paths:
+        import ray_tpu
+
+        paths = [os.path.dirname(os.path.abspath(ray_tpu.__file__))]
+    base = base_dir or _repo_base(paths)
+    files = discover_files(paths)
+    modules: list[ModuleInfo] = []
+    parse_failures: list[Finding] = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(os.path.abspath(path), base).replace(
+            "\\", "/")
+        mod = parse_module(path, rel, source)
+        if mod is not None:
+            modules.append(mod)
+        else:
+            # A file the analyzer cannot parse must be a finding, not a
+            # silent skip — otherwise a syntax error exempts a module
+            # from every rule.
+            parse_failures.append(Finding(
+                "R0", rel, 1, "syntax-error",
+                "file does not parse — no rule can check it"))
+
+    ctx = RuleContext()
+    _load_config_registry(modules, ctx)
+
+    selected = [r.strip().upper() for r in rules if r.strip()] \
+        if rules else sorted(ALL_RULES)
+    unknown = [r for r in selected if r not in ALL_RULES]
+    if unknown:
+        raise LintUsageError(
+            f"unknown rule id(s) {', '.join(unknown)}; "
+            f"valid: {', '.join(sorted(ALL_RULES))}")
+    all_findings: list[Finding] = list(parse_failures)
+    rule_seconds: dict[str, float] = {}
+    for rid in selected:
+        rt0 = time.perf_counter()
+        all_findings.extend(ALL_RULES[rid](modules, ctx))
+        rule_seconds[rid] = round(time.perf_counter() - rt0, 4)
+
+    # Dedup exact repeats (two opens on one line, etc.).
+    seen_f: set[tuple] = set()
+    deduped: list[Finding] = []
+    for f in all_findings:
+        k = (f.rule, f.relpath, f.line, f.symbol)
+        if k not in seen_f:
+            seen_f.add(k)
+            deduped.append(f)
+    all_findings = deduped
+
+    entries = load_allowlist(allowlist) if allowlist else []
+    matched: set[int] = set()
+    active: list[Finding] = []
+    allowed: list[Finding] = []
+    for f in all_findings:
+        hit = None
+        for idx, e in enumerate(entries):
+            if e.rule == f.rule and e.relpath == f.relpath and \
+                    _symbol_match(e.symbol, f.symbol):
+                hit = idx
+                break
+        if hit is not None:
+            matched.add(hit)
+            allowed.append(f)
+        else:
+            active.append(f)
+    # An entry is stale only when its FILE was in scope AND its rule ran
+    # and nothing matched — a partial run (`ray_tpu lint one/file.py`,
+    # `--rules R1`) must not report the rest of the baseline as rot.
+    scanned = {m.relpath for m in modules}
+    stale = [e for i, e in enumerate(entries)
+             if i not in matched and e.relpath in scanned
+             and e.rule in selected]
+    active.sort(key=lambda f: (f.relpath, f.line, f.rule))
+    counts: dict[str, int] = {}
+    for f in active:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return LintResult(
+        findings=active, allowlisted=allowed, stale_entries=stale,
+        files=len(modules), rule_seconds=rule_seconds,
+        wall_seconds=round(time.perf_counter() - t0, 4), counts=counts,
+        allowlist_path=allowlist)
+
+
+def format_findings(result: LintResult, verbose: bool = False) -> str:
+    lines = [f.render() for f in result.findings]
+    if result.stale_entries:
+        allow = result.allowlist_path or DEFAULT_ALLOWLIST
+        for e in result.stale_entries:
+            lines.append(
+                f"{allow}:{e.lineno} STALE allowlist entry "
+                f"matches nothing: {e.rule} {e.relpath} {e.symbol}")
+    summary = (
+        f"rtlint: {len(result.findings)} finding(s), "
+        f"{len(result.allowlisted)} allowlisted, "
+        f"{len(result.stale_entries)} stale allowlist entr(ies) over "
+        f"{result.files} files in {result.wall_seconds}s")
+    if verbose:
+        per = ", ".join(f"{k}={v}s" for k, v in
+                        sorted(result.rule_seconds.items()))
+        summary += f" ({per})"
+    lines.append(summary)
+    return "\n".join(lines)

@@ -1,0 +1,22 @@
+"""Finding record shared by the rtlint rules, engine, and allowlist."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str      # R0..R5
+    relpath: str   # repo-relative posix path
+    line: int
+    symbol: str    # stable key: Class.attr / metric name / env var / ...
+    message: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        """Allowlist match key — line numbers drift, symbols don't."""
+        return (self.rule, self.relpath, self.symbol)
+
+    def render(self) -> str:
+        return f"{self.relpath}:{self.line} {self.rule} {self.message}"

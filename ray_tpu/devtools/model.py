@@ -1,0 +1,516 @@
+"""Shared AST symbol model for the rtlint rules.
+
+One parse per module; a per-class walk collects everything the race (R1)
+and lock-order (R2) checkers need — attribute mutations/reads with the
+set of locks held at each site, the intra-class call graph, inferred
+thread entry points (threading.Thread targets, executor submissions,
+RPC-handler registrations, ``call_soon_threadsafe`` callbacks), and the
+with-statement lock-acquisition edges. R3–R5 do their own lighter passes
+over the same parsed trees.
+
+Execution-context model: every (method, nested-scope) site is assigned a
+set of *contexts* — ``init`` (``__init__``), ``loop`` (async bodies, RPC
+handlers, loop callbacks: one event-loop thread), ``thread:<name>`` (a
+dedicated ``threading.Thread`` target), ``pool`` (executor submissions),
+or ``caller`` (everything else: whatever thread calls the public API).
+Contexts propagate through ``self.method()`` calls to a fixpoint. An
+attribute touched from two distinct non-``init`` contexts is *shared*;
+an unlocked mutation of a shared attribute is the R1 race signal.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+# Method names that mutate their receiver container in place.
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "sort", "reverse", "put", "put_nowait",
+})
+
+# Names that construct a threading-level lock (module "threading" or
+# bare, via `from threading import Lock`).
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"})
+_LOCKISH_FRAGMENTS = ("lock", "mutex", "_cv", "cond")
+
+# Constructors whose instances are internally synchronized: mutating
+# calls on attributes bound to these are not race material.
+_THREADSAFE_CTORS = frozenset({
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "Event",
+})
+
+
+def _name_is_lockish(name: str) -> bool:
+    low = name.lower()
+    return any(f in low for f in _LOCKISH_FRAGMENTS)
+
+
+@dataclass
+class Site:
+    """One attribute access: where, what, and the locks held there."""
+
+    attr: str
+    line: int
+    kind: str  # assign | augassign | mutcall | subscript | delete | read
+    locks: frozenset[str]
+    scope: str | None = None  # nested-function name, None = method body
+    flag_literal: bool = False  # assignment of a bare constant literal
+
+
+@dataclass
+class MethodInfo:
+    name: str
+    node: ast.AST
+    is_async: bool
+    lineno: int
+    self_calls: set[str] = field(default_factory=set)
+    mutations: list[Site] = field(default_factory=list)
+    reads: list[Site] = field(default_factory=list)
+    # (outer_lock, inner_lock, line) acquisition-order edges.
+    lock_edges: list[tuple[str, str, int]] = field(default_factory=list)
+    # (line, held-threading-locks) at each `await` expression.
+    awaits: list[tuple[int, frozenset[str]]] = field(default_factory=list)
+    guard_lock: str | None = None  # @guarded_by("<lock>") method form
+    contexts: set[str] = field(default_factory=set)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    module: "ModuleInfo"
+    methods: dict[str, MethodInfo] = field(default_factory=dict)
+    locks: set[str] = field(default_factory=set)  # self-attr lock names
+    guarded: dict[str, str] = field(default_factory=dict)  # attr -> lock
+    safe: set[str] = field(default_factory=set)  # thread-safe containers
+    loop_confined: bool = False  # @loop_confined: one event-loop thread
+    # (method, nested-scope-name) -> context label for inferred entries.
+    entries: dict[tuple[str, str | None], str] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    path: str
+    relpath: str
+    tree: ast.Module
+    source: str
+    classes: list[ClassInfo] = field(default_factory=list)
+    functions: list[MethodInfo] = field(default_factory=list)  # top-level
+    module_locks: set[str] = field(default_factory=set)
+
+
+def parse_module(path: str, relpath: str, source: str) -> ModuleInfo | None:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    mod = ModuleInfo(path=path, relpath=relpath, tree=tree, source=source)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    mod.module_locks.add(t.id)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            mod.classes.append(_build_class(node, mod))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.functions.append(_build_method(node, None, mod))
+    for cls in mod.classes:
+        _assign_contexts(cls)
+    return mod
+
+
+def _is_threadsafe_ctor(expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    fn = expr.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name in _THREADSAFE_CTORS
+
+
+def _is_lock_ctor(expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    fn = expr.func
+    if isinstance(fn, ast.Name):
+        return fn.id in _LOCK_CTORS
+    if isinstance(fn, ast.Attribute):
+        return (fn.attr in _LOCK_CTORS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "threading")
+    return False
+
+
+def _guarded_by_args(deco: ast.AST) -> tuple[str, list[str]] | None:
+    """Parse a ``@guarded_by("lock", *attrs)`` decorator call."""
+    if not isinstance(deco, ast.Call):
+        return None
+    fn = deco.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if name != "guarded_by" or not deco.args:
+        return None
+    vals = []
+    for a in deco.args:
+        if not (isinstance(a, ast.Constant) and isinstance(a.value, str)):
+            return None
+        vals.append(a.value)
+    return vals[0], vals[1:]
+
+
+def _build_class(node: ast.ClassDef, mod: ModuleInfo) -> ClassInfo:
+    cls = ClassInfo(name=node.name, node=node, module=mod)
+    method_guards: dict[str, str] = {}
+    for deco in node.decorator_list:
+        dname = deco.id if isinstance(deco, ast.Name) else (
+            deco.attr if isinstance(deco, ast.Attribute) else None)
+        if dname == "loop_confined":
+            cls.loop_confined = True
+        parsed = _guarded_by_args(deco)
+        if parsed:
+            lock, attrs = parsed
+            for a in attrs:
+                cls.guarded[a] = lock
+    # First pass: find declared locks (self.X = threading.Lock() anywhere)
+    # and thread-safe containers (queue.Queue / threading.Event — their
+    # mutating calls are internally synchronized).
+    for item in ast.walk(node):
+        if isinstance(item, ast.Assign):
+            targets, value = item.targets, item.value
+        elif isinstance(item, ast.AnnAssign) and item.value is not None:
+            targets, value = [item.target], item.value
+        else:
+            continue
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                if _is_lock_ctor(value):
+                    cls.locks.add(t.attr)
+                elif _is_threadsafe_ctor(value):
+                    cls.safe.add(t.attr)
+    cls.locks.update(cls.guarded.values())
+    # Second pass: per-method walk.
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in item.decorator_list:
+            parsed = _guarded_by_args(deco)
+            if parsed and not parsed[1]:
+                method_guards[item.name] = parsed[0]
+        info = _build_method(item, cls, mod)
+        info.guard_lock = method_guards.get(item.name)
+        if info.guard_lock:
+            # Body runs with the declared lock held: rebase every site.
+            held = frozenset({f"self.{info.guard_lock}"})
+            for site in info.mutations + info.reads:
+                site.locks = site.locks | held
+            info.awaits = [(ln, lk | held) for ln, lk in info.awaits]
+        cls.methods[item.name] = info
+    _find_entries(cls)
+    return cls
+
+
+class _FnWalker(ast.NodeVisitor):
+    """Walks one function body tracking held locks, attribute sites,
+    self-calls, lock-order edges, and awaits. Nested function bodies are
+    walked too (fresh lock stack — they run later, possibly elsewhere)
+    with their sites tagged by the nested scope name so entry inference
+    can place e.g. a ``threading.Thread(target=pump)`` closure in its own
+    context."""
+
+    def __init__(self, info: MethodInfo, cls: ClassInfo | None,
+                 mod: ModuleInfo):
+        self.info = info
+        self.cls = cls
+        self.mod = mod
+        self.locks: list[str] = []  # sync (threading) locks, inner last
+        self.async_locks: list[str] = []
+        self.scope: str | None = None
+
+    # -- lock identity ---------------------------------------------------
+    def _lock_name(self, expr: ast.AST) -> str | None:
+        """Canonical identity of a with-item if it acquires a lock."""
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                known = self.cls is not None and expr.attr in self.cls.locks
+                if known or _name_is_lockish(expr.attr):
+                    return f"self.{expr.attr}"
+                return None
+            if _name_is_lockish(expr.attr):
+                try:
+                    return ast.unparse(expr)
+                except Exception:
+                    return expr.attr
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.mod.module_locks or _name_is_lockish(expr.id):
+                return f"{_mod_base(self.mod)}:{expr.id}"
+            return None
+        return None
+
+    def _held(self) -> frozenset[str]:
+        return frozenset(self.locks) | frozenset(self.async_locks)
+
+    # -- with ------------------------------------------------------------
+    def visit_With(self, node: ast.With):
+        self._with(node, is_async=False)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self._with(node, is_async=True)
+
+    def _with(self, node, is_async: bool):
+        acquired: list[tuple[str, bool]] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            name = self._lock_name(item.context_expr)
+            if name is None:
+                continue
+            for outer in self.locks + self.async_locks:
+                if outer != name:
+                    self.info.lock_edges.append((outer, name, node.lineno))
+            (self.async_locks if is_async else self.locks).append(name)
+            acquired.append((name, is_async))
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for name, was_async in reversed(acquired):
+            (self.async_locks if was_async else self.locks).remove(name)
+
+    # -- attribute sites -------------------------------------------------
+    def _self_attr(self, expr: ast.AST) -> str | None:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return expr.attr
+        return None
+
+    def _mutate(self, attr: str, line: int, kind: str,
+                flag_literal: bool = False):
+        self.info.mutations.append(Site(
+            attr=attr, line=line, kind=kind, locks=self._held(),
+            scope=self.scope, flag_literal=flag_literal))
+
+    def visit_Assign(self, node: ast.Assign):
+        reads_self = {self._self_attr(n) for n in ast.walk(node.value)
+                      if self._self_attr(n)}
+        for t in node.targets:
+            attr = self._self_attr(t)
+            if attr is not None:
+                is_rmw = attr in reads_self
+                is_flag = (isinstance(node.value, ast.Constant)
+                           and not is_rmw)
+                self._mutate(attr, node.lineno,
+                             "augassign" if is_rmw else "assign",
+                             flag_literal=is_flag)
+                continue
+            if isinstance(t, ast.Subscript):
+                attr = self._self_attr(t.value)
+                if attr is not None:
+                    self._mutate(attr, node.lineno, "subscript")
+                    self.visit(t.slice)
+                    continue
+            self.visit(t)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        attr = self._self_attr(node.target)
+        if attr is not None:
+            self._mutate(attr, node.lineno, "augassign")
+        elif isinstance(node.target, ast.Subscript):
+            sub = self._self_attr(node.target.value)
+            if sub is not None:
+                self._mutate(sub, node.lineno, "subscript")
+            self.visit(node.target.slice)
+        else:
+            self.visit(node.target)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            attr = self._self_attr(t)
+            if attr is None and isinstance(t, ast.Subscript):
+                attr = self._self_attr(t.value)
+            if attr is not None:
+                self._mutate(attr, node.lineno, "delete")
+            else:
+                self.visit(t)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = self._self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self.info.reads.append(Site(
+                attr=attr, line=node.lineno, kind="read",
+                locks=self._held(), scope=self.scope))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv_attr = self._self_attr(fn.value)
+            if recv_attr is not None and fn.attr in MUTATOR_METHODS:
+                self._mutate(recv_attr, node.lineno, "mutcall")
+            if (isinstance(fn.value, ast.Name) and fn.value.id == "self"):
+                self.info.self_calls.add(fn.attr)
+        self.generic_visit(node)
+
+    def visit_Await(self, node: ast.Await):
+        self.info.awaits.append((node.lineno, frozenset(self.locks)))
+        self.generic_visit(node)
+
+    # -- nested scopes ---------------------------------------------------
+    def _nested(self, node, name: str):
+        outer_scope, outer_locks, outer_async = (
+            self.scope, self.locks, self.async_locks)
+        self.scope = name if outer_scope is None else f"{outer_scope}.{name}"
+        self.locks, self.async_locks = [], []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope, self.locks, self.async_locks = (
+            outer_scope, outer_locks, outer_async)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._nested(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._nested(node, node.name)
+
+    def visit_Lambda(self, node: ast.Lambda):
+        prev, self.scope = self.scope, (self.scope or "<lambda>")
+        self.visit(node.body)
+        self.scope = prev
+
+
+def _build_method(node, cls: ClassInfo | None, mod: ModuleInfo) -> MethodInfo:
+    info = MethodInfo(name=node.name, node=node,
+                      is_async=isinstance(node, ast.AsyncFunctionDef),
+                      lineno=node.lineno)
+    walker = _FnWalker(info, cls, mod)
+    for stmt in node.body:
+        walker.visit(stmt)
+    return info
+
+
+def _callback_target(arg: ast.AST) -> tuple[str | None, str | None]:
+    """(self-method-name, local-function-name) a callable argument names."""
+    if (isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name)
+            and arg.value.id == "self"):
+        return arg.attr, None
+    if isinstance(arg, ast.Name):
+        return None, arg.id
+    if isinstance(arg, ast.Lambda):
+        for sub in ast.walk(arg.body):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"):
+                return sub.attr, None
+    return None, None
+
+
+def _find_entries(cls: ClassInfo) -> None:
+    """Infer thread entry points from spawn/registration calls anywhere in
+    the class body (reference: the review checklist this rule mechanizes —
+    reaper/flusher/watchdog loops are threading.Thread targets, RPC
+    handlers run on the event loop, call_soon_threadsafe callbacks too)."""
+    for mname, meth in cls.methods.items():
+        if meth.is_async:
+            cls.entries.setdefault((mname, None), "loop")
+        for node in ast.walk(meth.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if fname == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        self_m, local_f = _callback_target(kw.value)
+                        if self_m:
+                            cls.entries[(self_m, None)] = f"thread:{self_m}"
+                        elif local_f:
+                            cls.entries[(mname, local_f)] = \
+                                f"thread:{local_f}"
+            elif fname == "call_soon_threadsafe" and node.args:
+                self_m, local_f = _callback_target(node.args[0])
+                if self_m:
+                    cls.entries.setdefault((self_m, None), "loop")
+                elif local_f:
+                    cls.entries.setdefault((mname, local_f), "loop")
+            elif fname == "submit" and node.args:
+                self_m, local_f = _callback_target(node.args[0])
+                if self_m:
+                    cls.entries.setdefault((self_m, None), "pool")
+                elif local_f:
+                    cls.entries.setdefault((mname, local_f), "pool")
+            elif fname in ("register", "register_raw", "handler"):
+                # rpc.register("name", self._handler): handler runs on the
+                # event-loop thread (async handlers are caught by is_async
+                # already; register_raw handlers are sync loop-side).
+                for arg in node.args[1:]:
+                    self_m, _ = _callback_target(arg)
+                    if self_m:
+                        cls.entries.setdefault((self_m, None), "loop")
+
+
+def _assign_contexts(cls: ClassInfo) -> None:
+    """Base context per method, then propagate through self-calls to a
+    fixpoint so a helper called from a reaper thread inherits the reaper's
+    context."""
+    called_in_class: set[str] = set()
+    for meth in cls.methods.values():
+        called_in_class |= meth.self_calls
+    for mname, meth in cls.methods.items():
+        if mname == "__init__":
+            meth.contexts = {"init"}
+        elif (mname, None) in cls.entries:
+            meth.contexts = {cls.entries[(mname, None)]}
+        elif meth.is_async:
+            meth.contexts = {"loop"}
+        elif cls.loop_confined:
+            # @loop_confined: public sync methods are loop-side too (their
+            # callers are async handlers elsewhere); only explicit thread
+            # entries above escape the loop context.
+            meth.contexts = {"loop"}
+        elif mname.startswith("_") and not mname.startswith("__") \
+                and mname in called_in_class:
+            # Private helper with in-class callers: it runs wherever its
+            # callers run — let propagation fill the contexts in instead
+            # of presuming an external caller thread (the Head/daemon
+            # classes live entirely on the event loop; stamping "caller"
+            # on every _helper would fabricate cross-thread sharing).
+            meth.contexts = set()
+        else:
+            meth.contexts = {"caller"}
+    changed = True
+    while changed:
+        changed = False
+        for meth in cls.methods.values():
+            for callee in meth.self_calls:
+                target = cls.methods.get(callee)
+                if target is None or callee == "__init__":
+                    continue
+                add = meth.contexts - target.contexts
+                if add:
+                    target.contexts |= add
+                    changed = True
+
+
+def site_contexts(cls: ClassInfo, meth: MethodInfo, site: Site) -> set[str]:
+    """Contexts a given site executes under (nested-scope aware)."""
+    if site.scope is not None:
+        scope_head = site.scope.split(".", 1)[0]
+        label = cls.entries.get((meth.name, scope_head))
+        if label is not None:
+            return {label}
+    return set(meth.contexts)
+
+
+def _mod_base(mod: ModuleInfo) -> str:
+    rel = mod.relpath.replace("\\", "/")
+    return rel[:-3] if rel.endswith(".py") else rel

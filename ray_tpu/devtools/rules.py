@@ -1,0 +1,589 @@
+"""The rtlint rule implementations (R0–R5).
+
+Each rule is a function ``(modules: list[ModuleInfo], ctx: RuleContext)
+-> list[Finding]`` over the shared symbol model. Rules derive from bug
+classes this repo has shipped and hand-caught in review (CHANGES.md):
+R1 ← PR-12 racy ``seq_no += 1`` and PR-5's deque-mutated-during-iteration
+race; R2 ← the lock-discipline the serve router/breaker review enforced;
+R3 ← PR-5's jax-backend-init-in-the-wrong-process hazard and the sync-
+call-on-the-loop class; R4 ← PR-8's same-name metric double-registration
+stranding increments and PR-9's reserved ``node_id`` label; R5 ← the
+PR-7 satellite that found two undocumented env knobs.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from ray_tpu.devtools.findings import Finding
+from ray_tpu.devtools.model import ModuleInfo, site_contexts
+
+
+@dataclass
+class RuleContext:
+    """Cross-rule inputs resolved by the engine."""
+
+    # Source text of the knob registry of record (utils/config.py); None
+    # disables the registry-membership half of R5.
+    config_source: str | None = None
+    # Config dataclass field names parsed out of config_source.
+    config_fields: set[str] = field(default_factory=set)
+    # Repo-relative module suffixes whose metric updates must be
+    # pre-bound (Metric.bound()) instead of merging tags per call.
+    hot_modules: tuple[str, ...] = (
+        "serve/router.py", "serve/replica.py", "serve/handle.py",
+        "llm/pd.py", "core/transfer.py",
+    )
+
+
+# --------------------------------------------------------------------------
+# R0: unused module-scope imports (pyflakes F401 subset)
+# --------------------------------------------------------------------------
+
+def rule_style(modules: list[ModuleInfo], ctx: RuleContext) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in modules:
+        if mod.relpath.endswith("__init__.py"):
+            continue  # re-export surface: unused-looking imports are the API
+        lines = mod.source.splitlines()
+        # Lines occupied by ANY module-scope import: excluded from the
+        # usage scan so two unused imports binding the same name cannot
+        # vouch for each other.
+        import_lines: set[int] = set()
+        for node in mod.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                import_lines.update(range(
+                    node.lineno, getattr(node, "end_lineno",
+                                         node.lineno) + 1))
+        for node in mod.tree.body:
+            bindings: list[tuple[str, str]] = []  # (bound name, shown name)
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    bindings.append((bound, alias.name))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    bindings.append((bound, alias.name))
+            else:
+                continue
+            lineno = node.lineno
+            line_txt = lines[lineno - 1] if lineno <= len(lines) else ""
+            if "noqa" in line_txt:
+                continue
+            for bound, shown in bindings:
+                if bound == "_":
+                    continue
+                # Word-boundary search outside the import statement itself:
+                # catches string annotations and docstring doctests that a
+                # pure Name-node scan would miss (fewer false positives
+                # beats pyflakes-exactness for a tree-hygiene gate).
+                pat = re.compile(rf"\b{re.escape(bound)}\b")
+                used = False
+                for i, txt in enumerate(lines, start=1):
+                    if i in import_lines:
+                        continue
+                    if pat.search(txt):
+                        used = True
+                        break
+                if not used:
+                    out.append(Finding(
+                        "R0", mod.relpath, lineno, f"import:{bound}",
+                        f"unused import '{shown}'"
+                        + (f" as '{bound}'" if bound != shown else "")))
+    return out
+
+
+# --------------------------------------------------------------------------
+# R1: shared-state races + non-atomic read-modify-write
+# --------------------------------------------------------------------------
+
+# Context labels that imply a second runner (prefix-matched for
+# "thread:<name>") — the single source _is_concurrent checks against.
+_CONCURRENT = ("thread:", "loop", "pool")
+
+
+def _is_concurrent(ctxs: set[str]) -> bool:
+    return any(c.startswith(p) if p.endswith(":") else c == p
+               for c in ctxs for p in _CONCURRENT)
+
+
+def rule_races(modules: list[ModuleInfo], ctx: RuleContext) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in modules:
+        for cls in mod.classes:
+            out.extend(_class_races(mod, cls))
+    return out
+
+
+def _class_races(mod: ModuleInfo, cls) -> list[Finding]:
+    out: list[Finding] = []
+    # Gather per-attribute access sites with resolved contexts.
+    accesses: dict[str, list[tuple]] = {}  # attr -> [(site, ctxs, is_mut)]
+    for meth in cls.methods.values():
+        for site in meth.mutations:
+            ctxs = site_contexts(cls, meth, site)
+            accesses.setdefault(site.attr, []).append((site, ctxs, True))
+        for site in meth.reads:
+            ctxs = site_contexts(cls, meth, site)
+            accesses.setdefault(site.attr, []).append((site, ctxs, False))
+
+    # (a) Annotation-driven: guarded attrs must hold their declared lock
+    # at EVERY mutation outside construction — precise, no inference.
+    for attr, lock in sorted(cls.guarded.items()):
+        want = f"self.{lock}"
+        for site, ctxs, is_mut in accesses.get(attr, ()):
+            if not is_mut or ctxs == {"init"}:
+                continue
+            if want not in site.locks:
+                out.append(Finding(
+                    "R1", mod.relpath, site.line, f"{cls.name}.{attr}",
+                    f"guarded attribute '{attr}' "
+                    f"(@guarded_by('{lock}')) mutated without "
+                    f"self.{lock} held"))
+
+    # (b) Inferred races on undeclared attrs.
+    for attr, sites in sorted(accesses.items()):
+        if attr in cls.guarded or attr in cls.locks or attr in cls.safe:
+            continue
+        all_ctx: set[str] = set()
+        for _, ctxs, _ in sites:
+            all_ctx |= ctxs
+        all_ctx.discard("init")
+        if len(all_ctx) < 2 or not _is_concurrent(all_ctx):
+            continue  # never shared across inferred execution contexts
+        muts = [(s, c) for s, c, is_mut in sites
+                if is_mut and c != {"init"}]
+        if not muts:
+            continue
+        unlocked = [(s, c) for s, c in muts if not s.locks]
+        if not unlocked:
+            continue
+        if all(s.flag_literal for s, _ in muts):
+            continue  # stop-flag pattern: only bare-constant assigns
+        # Non-atomic RMW gets its own message (the PR-12 seq_no class);
+        # report one finding per attribute at the first unlocked site.
+        rmw = [(s, c) for s, c in unlocked if s.kind == "augassign"]
+        site, ctxs = (rmw or unlocked)[0]
+        kind_msg = (
+            "non-atomic read-modify-write on shared attribute" if rmw
+            else "unlocked mutation of shared attribute")
+        out.append(Finding(
+            "R1", mod.relpath, site.line, f"{cls.name}.{attr}",
+            f"{kind_msg} '{attr}' (contexts: "
+            f"{', '.join(sorted(all_ctx))}; no lock held at this site); "
+            f"guard it or declare @guarded_by on {cls.name}"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# R2: lock-order cycles + await while holding a threading lock
+# --------------------------------------------------------------------------
+
+def rule_lock_order(modules: list[ModuleInfo],
+                    ctx: RuleContext) -> list[Finding]:
+    out: list[Finding] = []
+    # Global acquisition graph. Lock identity is class-qualified for self
+    # attrs so Router._lock and Replica._lock never alias.
+    edges: dict[str, set[str]] = {}
+    sites: dict[tuple[str, str], tuple[str, int]] = {}
+    for mod in modules:
+        for cls in mod.classes:
+            for meth in cls.methods.values():
+                for outer, inner, line in meth.lock_edges:
+                    o = _qual_lock(cls.name, outer)
+                    i = _qual_lock(cls.name, inner)
+                    edges.setdefault(o, set()).add(i)
+                    sites.setdefault((o, i), (mod.relpath, line))
+                for line, held in meth.awaits:
+                    if held:
+                        locks = ", ".join(sorted(held))
+                        out.append(Finding(
+                            "R2", mod.relpath, line,
+                            f"{cls.name}.{meth.name}:await",
+                            f"await while holding threading lock(s) "
+                            f"{locks} — blocks every other acquirer for "
+                            f"the full suspension"))
+        for fn in mod.functions:
+            for outer, inner, line in fn.lock_edges:
+                o = _qual_lock(None, outer)
+                i = _qual_lock(None, inner)
+                edges.setdefault(o, set()).add(i)
+                sites.setdefault((o, i), (mod.relpath, line))
+            for line, held in fn.awaits:
+                if held:
+                    out.append(Finding(
+                        "R2", mod.relpath, line, f"{fn.name}:await",
+                        f"await while holding threading lock(s) "
+                        f"{', '.join(sorted(held))}"))
+
+    # Cycle detection (DFS with colors); each cycle reported once at a
+    # canonical rotation.
+    seen_cycles: set[tuple[str, ...]] = set()
+    color: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(node: str):
+        color[node] = 1
+        stack.append(node)
+        for nxt in sorted(edges.get(node, ())):
+            if color.get(nxt, 0) == 1:
+                cyc = tuple(stack[stack.index(nxt):])
+                lo = cyc.index(min(cyc))
+                canon = cyc[lo:] + cyc[:lo]
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    rel, line = sites.get((node, nxt), ("", 0))
+                    out.append(Finding(
+                        "R2", rel, line, "lockcycle:" + ">".join(canon),
+                        "lock-order cycle: "
+                        + " -> ".join(canon + (canon[0],))
+                        + " (deadlock when acquired concurrently)"))
+            elif color.get(nxt, 0) == 0:
+                dfs(nxt)
+        stack.pop()
+        color[node] = 2
+
+    for node in sorted(edges):
+        if color.get(node, 0) == 0:
+            dfs(node)
+    return out
+
+
+def _qual_lock(cls_name: str | None, lock: str) -> str:
+    if lock.startswith("self.") and cls_name:
+        return f"{cls_name}.{lock[5:]}"
+    return lock
+
+
+# --------------------------------------------------------------------------
+# R3: blocking calls on the event loop
+# --------------------------------------------------------------------------
+
+_SUBPROC = frozenset({"run", "call", "check_output", "check_call"})
+_JAX_BACKEND = frozenset({"devices", "local_devices", "device_count",
+                          "local_device_count"})
+
+
+class _BlockingVisitor(ast.NodeVisitor):
+    """Flags blocking calls lexically inside one async (or loop-context
+    sync) function body; does NOT descend into nested sync defs/lambdas —
+    those run later, usually on an executor."""
+
+    def __init__(self, mod: ModuleInfo, qual: str, out: list[Finding]):
+        self.mod = mod
+        self.qual = qual
+        self.out = out
+        self._awaited: set[ast.Call] = set()
+
+    def visit_Await(self, node: ast.Await):
+        # `await client.call(...)` — and the wrapped idiom
+        # `await asyncio.wait_for(client.call(...), timeout)` — are the
+        # ASYNC rpc path: every call in the awaited expression's subtree
+        # feeds the await, so none of them is a sync block. (Slight
+        # under-report beats hard-failing the gate on correct code.)
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Call):
+                self._awaited.add(sub)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # nested sync def: skip body
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):  # nested async: own pass
+        pass
+
+    def _flag(self, line: int, callee: str, why: str):
+        self.out.append(Finding(
+            "R3", self.mod.relpath, line, f"{self.qual}:{callee}",
+            f"{why} inside event-loop context ({self.qual})"))
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        dotted = _dotted(fn)
+        if dotted == "time.sleep":
+            self._flag(node.lineno, "time.sleep", "blocking time.sleep")
+        elif isinstance(fn, ast.Name) and fn.id == "open":
+            self._flag(node.lineno, "open", "blocking file I/O (open)")
+        elif isinstance(fn, ast.Attribute):
+            base = _dotted(fn.value) or ""
+            if base == "subprocess" and fn.attr in _SUBPROC:
+                self._flag(node.lineno, f"subprocess.{fn.attr}",
+                           "blocking subprocess call")
+            elif base == "os" and fn.attr == "system":
+                self._flag(node.lineno, "os.system", "blocking os.system")
+            elif base == "ray_tpu" and fn.attr in ("get", "wait"):
+                self._flag(node.lineno, f"ray_tpu.{fn.attr}",
+                           f"sync ray_tpu.{fn.attr}")
+            elif base == "jax" and fn.attr in _JAX_BACKEND:
+                self._flag(node.lineno, f"jax.{fn.attr}",
+                           f"jax.{fn.attr} may initialize the jax "
+                           "backend (seconds of work, wrong process)")
+            elif fn.attr == "call" and _looks_rpc(base) \
+                    and node not in self._awaited:
+                self._flag(node.lineno, f"{base}.call",
+                           "sync RpcClient.call")
+            # NOTE: no `.result()` check — every hit in this tree was the
+            # known-done asyncio idiom (`if fut.done(): fut.result()` /
+            # post-asyncio.wait collection), statically indistinguishable
+            # from a blocking concurrent.futures result.
+        self.generic_visit(node)
+
+
+def _dotted(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = _dotted(expr.value)
+        return f"{base}.{expr.attr}" if base else None
+    return None
+
+
+def _looks_rpc(dotted: str) -> bool:
+    low = dotted.lower()
+    return any(k in low for k in ("client", "daemon", "head", "rpc",
+                                  "_conn"))
+
+
+def rule_event_loop(modules: list[ModuleInfo],
+                    ctx: RuleContext) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in modules:
+        # All async defs, top-level or nested anywhere.
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                v = _BlockingVisitor(mod, node.name, out)
+                for stmt in node.body:
+                    v.visit(stmt)
+        # Sync methods that run exclusively in loop context (register_raw
+        # handlers, call_soon_threadsafe targets, helpers only async code
+        # calls).
+        for cls in mod.classes:
+            for meth in cls.methods.values():
+                if meth.is_async:
+                    continue
+                if meth.contexts and meth.contexts <= {"loop", "init"} \
+                        and "loop" in meth.contexts:
+                    v = _BlockingVisitor(
+                        mod, f"{cls.name}.{meth.name}", out)
+                    for stmt in meth.node.body:
+                        v.visit(stmt)
+    return out
+
+
+# --------------------------------------------------------------------------
+# R4: metrics hygiene
+# --------------------------------------------------------------------------
+
+_METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram"})
+_UPDATE_METHODS = frozenset({"inc", "observe", "set"})
+
+
+def rule_metrics(modules: list[ModuleInfo],
+                 ctx: RuleContext) -> list[Finding]:
+    out: list[Finding] = []
+    by_name: dict[str, list[tuple[str, int]]] = {}
+    for mod in modules:
+        if mod.relpath.endswith("util/metrics.py"):
+            continue  # the API definition itself
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            cname = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if cname in _METRIC_CTORS:
+                name = _metric_name_arg(node)
+                if name is not None:
+                    by_name.setdefault(name, []).append(
+                        (mod.relpath, node.lineno))
+                tags = _metric_tag_keys(node)
+                if tags and "node_id" in tags:
+                    out.append(Finding(
+                        "R4", mod.relpath, node.lineno, name or "<metric>",
+                        f"metric {name or '?'} declares reserved tag key "
+                        "'node_id' (stamped by head federation — a local "
+                        "node_id label would collide/shadow it)"))
+            elif (isinstance(fn, ast.Attribute)
+                  and fn.attr in _UPDATE_METHODS
+                  and any(kw.arg == "tags" and not _is_none(kw.value)
+                          for kw in node.keywords)
+                  and any(mod.relpath.endswith(h)
+                          for h in ctx.hot_modules)):
+                recv = _dotted(fn.value)
+                if recv is None and isinstance(fn.value, ast.Subscript) \
+                        and isinstance(fn.value.slice, ast.Constant):
+                    base = _dotted(fn.value.value) or "?"
+                    recv = f"{base}[{fn.value.slice.value}]"
+                recv = recv or "<metric>"
+                out.append(Finding(
+                    "R4", mod.relpath, node.lineno,
+                    f"unbound:{recv}.{fn.attr}",
+                    f"per-call tags= merge on hot path "
+                    f"({recv}.{fn.attr}) — pre-bind the series with "
+                    "Metric.bound() (PR-12 measured the merge as the "
+                    "dominant per-call cost)"))
+    for name, found in sorted(by_name.items()):
+        if len(found) > 1:
+            for rel, line in found[1:]:
+                first = f"{found[0][0]}:{found[0][1]}"
+                out.append(Finding(
+                    "R4", rel, line, f"dup:{name}",
+                    f"metric name '{name}' registered at more than one "
+                    f"call site (first: {first}) — the registry keeps one "
+                    "object per name; increments on the losing object are "
+                    "stranded (PR-8 bug class)"))
+    return out
+
+
+def _metric_name_arg(node: ast.Call) -> str | None:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    for kw in node.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _metric_tag_keys(node: ast.Call) -> list[str]:
+    for kw in node.keywords:
+        if kw.arg == "tag_keys" and isinstance(
+                kw.value, (ast.Tuple, ast.List)):
+            return [e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return []
+
+
+def _is_none(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Constant) and expr.value is None
+
+
+# --------------------------------------------------------------------------
+# R5: knob registry
+# --------------------------------------------------------------------------
+
+def rule_knobs(modules: list[ModuleInfo], ctx: RuleContext) -> list[Finding]:
+    out: list[Finding] = []
+    cfg_src = ctx.config_source
+    fields = ctx.config_fields
+    for mod in modules:
+        if mod.relpath.endswith(("utils/config.py", "devtools/rules.py")):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                env = _env_read_name(node)
+                if env and env.startswith("RTPU_"):
+                    if not _knob_registered(env, fields, cfg_src):
+                        out.append(Finding(
+                            "R5", mod.relpath, node.lineno, env,
+                            f"env knob {env} read here but has no "
+                            "registry entry in utils/config.py (Config "
+                            "field or documented env-only knob)"))
+            elif isinstance(node, ast.Subscript):
+                env = _env_subscript_name(node)
+                if env and env.startswith("RTPU_"):
+                    if not _knob_registered(env, fields, cfg_src):
+                        out.append(Finding(
+                            "R5", mod.relpath, node.lineno, env,
+                            f"env knob {env} read here but has no "
+                            "registry entry in utils/config.py"))
+        if fields:
+            out.extend(_cfg_attr_typos(mod, fields))
+    # Dedup same env var per module (one finding per (module, var)).
+    seen: set[tuple[str, str, str]] = set()
+    uniq: list[Finding] = []
+    for f in out:
+        if f.key in seen:
+            continue
+        seen.add(f.key)
+        uniq.append(f)
+    return uniq
+
+
+def _knob_registered(env: str, fields: set, cfg_src: str | None) -> bool:
+    """Config field, or WHOLE-WORD mention in the registry source —
+    substring containment would let RTPU_SHM ride on RTPU_SHM_NAME
+    (underscore is a word char, so \b cannot match inside it)."""
+    if env[5:].lower() in fields:
+        return True
+    if cfg_src is None:
+        return False
+    return re.search(rf"\b{re.escape(env)}\b", cfg_src) is not None
+
+
+_CFG_METHODS = frozenset({"load", "from_json", "to_dict"})
+
+
+def _cfg_attr_typos(mod: ModuleInfo, fields: set[str]) -> list[Finding]:
+    """`cfg = get_config(); cfg.unknwon_flag` — a typo'd flag read returns
+    AttributeError only when that code path runs; catch it statically."""
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cfg_vars: set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and isinstance(
+                    sub.value, ast.Call):
+                callee = _dotted(sub.value.func) or ""
+                if callee.endswith("get_config"):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            cfg_vars.add(t.id)
+        if not cfg_vars:
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id in cfg_vars
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.attr not in fields
+                    and sub.attr not in _CFG_METHODS
+                    and not sub.attr.startswith("__")):
+                out.append(Finding(
+                    "R5", mod.relpath, sub.lineno, f"cfg.{sub.attr}",
+                    f"config attribute '{sub.attr}' is not a Config "
+                    "field (typo, or an undeclared knob)"))
+    return out
+
+
+def _env_read_name(node: ast.Call) -> str | None:
+    fn = node.func
+    dotted = _dotted(fn) or ""
+    if dotted in ("os.environ.get", "os.environ.pop", "os.getenv",
+                  "environ.get", "environ.pop", "getenv"):
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return node.args[0].value
+    return None
+
+
+def _env_subscript_name(node: ast.Subscript) -> str | None:
+    base = _dotted(node.value) or ""
+    if base in ("os.environ", "environ"):
+        if isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            return node.slice.value
+    return None
+
+
+ALL_RULES = {
+    "R0": rule_style,
+    "R1": rule_races,
+    "R2": rule_lock_order,
+    "R3": rule_event_loop,
+    "R4": rule_metrics,
+    "R5": rule_knobs,
+}

@@ -36,13 +36,14 @@ import uuid
 from collections import deque
 from dataclasses import dataclass, field, replace
 from functools import partial
-from typing import Any, Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ray_tpu.devtools.annotations import guarded_by
 from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.tokenizer import get_tokenizer
 from ray_tpu.models.llama import LlamaConfig, init_params
@@ -614,6 +615,7 @@ class GenerationResult:
     finish_reason: str
 
 
+@guarded_by("_submit_lock", "_requests")
 class LLMEngine:
     """The continuous-batching engine. Thread-safe: ``generate``/``submit``
     may be called concurrently (e.g. from serve replica threads); one
@@ -740,6 +742,11 @@ class LLMEngine:
         self._preempted: deque[GenerationRequest] = deque()
         self._arrival_seq = 0
         self._requests: dict[str, GenerationRequest] = {}
+        # Serve replicas submit from max_concurrency pool threads: the
+        # arrival counter and request-table insert must not interleave
+        # (rtlint R1 — the same non-atomic += class as the PR-12 seq_no
+        # bug). The scheduler thread takes it only for its table pop.
+        self._submit_lock = threading.Lock()
         self._rng_key = jax.random.PRNGKey(config.seed + 1)
         # Pipelined decode: (active snapshot, burst, device tokens) of a
         # chained burst awaiting resolution at the next tick's start.
@@ -762,9 +769,10 @@ class LLMEngine:
             request_id=uuid.uuid4().hex[:12], prompt_ids=ids,
             sampling=sampling,
             stream_queue=queue.Queue() if stream else None)
-        self._arrival_seq += 1
-        req.arrival_seq = self._arrival_seq
-        self._requests[req.request_id] = req
+        with self._submit_lock:
+            self._arrival_seq += 1
+            req.arrival_seq = self._arrival_seq
+            self._requests[req.request_id] = req
         self._waiting.put(req)
         self._work.set()
         return req
@@ -799,7 +807,8 @@ class LLMEngine:
         req = GenerationRequest(
             request_id=uuid.uuid4().hex[:12], prompt_ids=ids,
             sampling=replace(sampling, max_tokens=1), hold_slot=True)
-        self._requests[req.request_id] = req
+        with self._submit_lock:
+            self._requests[req.request_id] = req
         self._waiting.put(req)
         self._work.set()
         try:
@@ -885,7 +894,8 @@ class LLMEngine:
         req.preloaded = (np.asarray(payload["kv_k"]),
                          np.asarray(payload["kv_v"]),
                          int(payload["first_token"]))
-        self._requests[req.request_id] = req
+        with self._submit_lock:
+            self._requests[req.request_id] = req
         self._waiting.put(req)
         self._work.set()
         return req
@@ -1873,7 +1883,8 @@ class LLMEngine:
                         self._prefix_cached[slot] = (toks, time.monotonic())
         if req.stream_queue is not None:
             req.stream_queue.put(None)
-        self._requests.pop(req.request_id, None)
+        with self._submit_lock:
+            self._requests.pop(req.request_id, None)
         req.done.set()
 
     def _result(self, req: GenerationRequest) -> GenerationResult:

@@ -38,6 +38,7 @@ import time
 import uuid
 from collections import deque
 
+from ray_tpu.devtools.annotations import loop_confined
 from ray_tpu.observability.detectors import Rule, Trip, build_rules
 from ray_tpu.observability.timeseries import SeriesKey, SeriesStore
 from ray_tpu.utils.config import get_config
@@ -73,6 +74,7 @@ def _get_wd_metrics():
     return _wd_metrics
 
 
+@loop_confined
 class Watchdog:
     """``train_stats_fn``/``nodes_fn`` are synchronous reads of the head's
     tables; ``profile_fn(node_id, seconds)`` is an awaitable returning the

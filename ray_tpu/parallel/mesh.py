@@ -21,7 +21,7 @@ Canonical axis names (used by sharding rules, collectives, and models):
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import numpy as np

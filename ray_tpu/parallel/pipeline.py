@@ -35,7 +35,6 @@ from ray_tpu.models.llama import (
     init_params,
     rms_norm,
     rope_frequencies,
-    unembed_weights,
 )
 from ray_tpu.train.spmd import TrainState, _opt_shardings
 

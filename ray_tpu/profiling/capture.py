@@ -16,7 +16,6 @@ double-sampling — the per-NODE concurrency cap lives in the node daemon.
 from __future__ import annotations
 
 import os
-import sys
 import threading
 import time
 

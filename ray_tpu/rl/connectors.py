@@ -14,7 +14,6 @@ the compact substitution for the reference's cross-runner state merge.
 
 from __future__ import annotations
 
-from typing import Any
 
 import numpy as np
 

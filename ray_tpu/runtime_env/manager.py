@@ -18,7 +18,6 @@ an isolation boundary).
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import threading

@@ -429,6 +429,65 @@ def cmd_stragglers(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """rtlint: framework-aware static analysis (ray_tpu/devtools). Runs
+    entirely locally — no cluster connection. Exit 0 iff every finding is
+    fixed or allowlisted with a justification."""
+    from ray_tpu.devtools.engine import (
+        DEFAULT_ALLOWLIST,
+        AllowlistError,
+        LintUsageError,
+        format_findings,
+        run_lint,
+    )
+
+    allowlist = None if args.no_allowlist else (
+        args.allowlist or DEFAULT_ALLOWLIST)
+    if args.allowlist and not os.path.exists(args.allowlist):
+        # An explicitly-given allowlist that doesn't exist must be loud:
+        # silently linting with an empty baseline would resurface every
+        # accepted finding as if it were new.
+        print(f"rtlint: no such allowlist file: {args.allowlist}",
+              file=sys.stderr)
+        return 2
+    paths = args.paths or None
+    if paths:
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            print(f"rtlint: no such path(s): {', '.join(missing)}",
+                  file=sys.stderr)
+            return 2
+    try:
+        res = run_lint(paths, allowlist=allowlist,
+                       rules=args.rules.split(",") if args.rules else None)
+    except (AllowlistError, LintUsageError) as e:
+        print(f"rtlint: {e}", file=sys.stderr)
+        return 2
+    if paths and res.files == 0 and not res.findings:
+        # Explicit targets that contained no parseable Python: a typo'd
+        # path must not produce a green "checked nothing" run.
+        print(f"rtlint: no Python files found under: {', '.join(paths)}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({
+            "findings": [{"rule": f.rule, "file": f.relpath,
+                          "line": f.line, "symbol": f.symbol,
+                          "message": f.message} for f in res.findings],
+            "allowlisted": len(res.allowlisted),
+            "stale_allowlist_entries": len(res.stale_entries),
+            "files": res.files,
+            "counts": res.counts,
+            "rule_seconds": res.rule_seconds,
+            "wall_seconds": res.wall_seconds,
+        }, indent=2))
+    else:
+        print(format_findings(res, verbose=args.verbose))
+    # Stale allowlist rows fail the run too — the cannot-rot invariant
+    # must hold from the CLI, not only from the dryrun gate.
+    return 0 if res.ok and not res.stale_entries else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     p.add_argument("--address", default=None,
@@ -490,6 +549,23 @@ def main(argv: list[str] | None = None) -> int:
                     help="stop after this long")
     wt.add_argument("--once", action="store_true",
                     help="print one snapshot and exit")
+    lint = sub.add_parser(
+        "lint", help="rtlint static analysis: race/lock-order/event-loop/"
+                     "metrics/knob-registry checks over ray_tpu (or given "
+                     "paths); exit 1 on unallowlisted findings")
+    lint.add_argument("paths", nargs="*", default=None,
+                      help="files/dirs to lint (default: the installed "
+                           "ray_tpu package)")
+    lint.add_argument("--rules", default=None,
+                      help="comma-separated rule subset, e.g. R1,R4")
+    lint.add_argument("--allowlist", default=None,
+                      help="allowlist file (default: "
+                           "ray_tpu/devtools/rtlint_allow.txt)")
+    lint.add_argument("--no-allowlist", action="store_true",
+                      help="report every finding, allowlisted or not")
+    lint.add_argument("--json", action="store_true")
+    lint.add_argument("--verbose", action="store_true",
+                      help="include per-rule timings in the summary")
     ch = sub.add_parser(
         "chaos", help="fault injection: kill workers/slices/daemons, "
                       "delay/drop RPCs (see ray_tpu/chaos/injector.py)")
@@ -528,7 +604,7 @@ def main(argv: list[str] | None = None) -> int:
             "flight-records": cmd_flight_records, "profile": cmd_profile,
             "stack": cmd_stack, "stragglers": cmd_stragglers,
             "chaos": cmd_chaos, "incidents": cmd_incidents,
-            "watch": cmd_watch}
+            "watch": cmd_watch, "lint": cmd_lint}
     return cmds[args.command](args)
 
 

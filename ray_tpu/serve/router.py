@@ -45,6 +45,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
 import ray_tpu
+from ray_tpu.devtools.annotations import guarded_by
 from ray_tpu.serve.config import ReplicaInfo
 from ray_tpu.serve.prefix import match_len
 from ray_tpu.serve.resilience import (
@@ -110,6 +111,7 @@ def _get_router_metrics():
     return _router_metrics
 
 
+@guarded_by("_cv", "_pending", "_obs_backlog")
 class _CompletionReaper:
     """One thread watching EVERY in-flight unary ref of a router: releases
     the replica slot the moment a reply lands and hands outcome

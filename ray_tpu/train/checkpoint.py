@@ -111,7 +111,8 @@ class AsyncCheckpointWriter:
                 with self._lock:
                     self._done.append(directory)
             except BaseException as e:  # noqa: BLE001 - re-raised at barrier
-                self._exc = e
+                with self._lock:
+                    self._exc = e
 
         self._thread = threading.Thread(target=work, daemon=True,
                                         name="ckpt-write-behind")

@@ -24,7 +24,6 @@ from ray_tpu.models.llama import (
     loss_fn,
     param_logical_axes,
 )
-from ray_tpu.parallel.mesh import MeshSpec, build_mesh
 from ray_tpu.parallel.sharding import (
     ShardingRules,
     batch_axes,

@@ -21,10 +21,12 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 import ray_tpu
+from ray_tpu.devtools.annotations import guarded_by
 from ray_tpu.core.exceptions import GetTimeoutError
 from ray_tpu.train.session import TrainContext, drain_reports, set_context
 
 
+@guarded_by("_res_lock", "_result", "_error")
 class TrainWorker:
     """Actor hosting one training worker; the user's train_fn runs on a
     dedicated thread so poll() stays responsive (max_concurrency=4)."""
@@ -41,6 +43,9 @@ class TrainWorker:
         )
         self._thread: threading.Thread | None = None
         self._status = "IDLE"  # IDLE | RUNNING | FINISHED | ERRORED
+        # Result/context handoff train-fn thread -> actor-call thread
+        # (rtlint R1): poll() must never see a half-published result.
+        self._res_lock = threading.Lock()
         self._result: Any = None
         self._error: str | None = None
 
@@ -57,14 +62,16 @@ class TrainWorker:
                 old_writer.close()  # don't strand a push thread per restart
             except Exception:
                 pass
-        self.ctx = TrainContext(
-            world_rank=rank, world_size=world_size, experiment_name=experiment,
-            storage_path=storage_path, local_rank=0,
-        )
-        self._thread = None
-        self._status = "IDLE"
-        self._result = None
-        self._error = None
+        with self._res_lock:
+            self.ctx = TrainContext(
+                world_rank=rank, world_size=world_size,
+                experiment_name=experiment,
+                storage_path=storage_path, local_rank=0,
+            )
+            self._thread = None
+            self._status = "IDLE"
+            self._result = None
+            self._error = None
         return True
 
     def setup_env(self, coordinator_addr: str | None, restart_count: int,
@@ -92,13 +99,16 @@ class TrainWorker:
             set_context(self.ctx)
             try:
                 if len(inspect.signature(train_fn).parameters) >= 1:
-                    self._result = train_fn(config if config is not None else {})
+                    result = train_fn(config if config is not None else {})
                 else:
-                    self._result = train_fn()
-                self._status = "FINISHED"
+                    result = train_fn()
+                with self._res_lock:
+                    self._result = result
+                    self._status = "FINISHED"
             except BaseException:  # noqa: BLE001
-                self._error = traceback.format_exc()
-                self._status = "ERRORED"
+                with self._res_lock:
+                    self._error = traceback.format_exc()
+                    self._status = "ERRORED"
             finally:
                 set_context(None)
 

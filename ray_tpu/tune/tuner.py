@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any
 
 import ray_tpu
 from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler

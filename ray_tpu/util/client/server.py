@@ -6,14 +6,15 @@ per-client object ownership, releasing it on disconnect)."""
 from __future__ import annotations
 
 import asyncio
-from typing import Any
 
 from ray_tpu.core.cluster.protocol import RpcServer, ServerConnection
+from ray_tpu.devtools.annotations import loop_confined
 from ray_tpu.core.object_ref import ObjectRef, refcount_disabled
 from ray_tpu.utils import serialization
 from ray_tpu.utils.ids import ActorID, ObjectID
 
 
+@loop_confined
 class ClientServer:
     """One RpcServer fronting one ClusterRuntime. Each client connection
     gets a pin-set of ObjectRefs the server holds alive on its behalf."""

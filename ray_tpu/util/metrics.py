@@ -21,11 +21,14 @@ from __future__ import annotations
 import threading
 from typing import Sequence
 
+from ray_tpu.devtools.annotations import guarded_by
+
 _DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
 )
 
 
+@guarded_by("_lock", "_series")
 class Metric:
     """Base: a named measurement with fixed tag keys and per-tagset series."""
 
@@ -129,6 +132,7 @@ class Gauge(Metric):
     prom_type = "gauge"
 
 
+@guarded_by("_lock", "_buckets", "_sums", "_series")
 class Histogram(Metric):
     """Bucketed distribution (cumulative buckets, Prometheus-style)."""
 

@@ -326,6 +326,34 @@ class Config:
     # capture carries a no-op marker instead of a trace.
     profiler_xla_trace: bool = True
 
+    # --- env-only knobs and internal plumbing (registry of record) ---
+    # These are read straight from the environment (no Config field): the
+    # first group is user-settable, the second is wiring the node daemon
+    # stamps into forked worker processes (set them yourself only in
+    # tests). rtlint rule R5 enforces that every RTPU_* read in the tree
+    # has an entry here or a Config field.
+    #   RTPU_USAGE_STATS_ENABLED (1): usage-stats collection master
+    #     switch (usage/__init__.py); "0" disables.
+    #   RTPU_PEAK_FLOPS (backend-detected): per-device peak FLOP/s used
+    #     for the MFU metric when the backend can't be probed
+    #     (train/session.py).
+    #   RTPU_CONTAINER_RUNNER ("podman"): container runtime binary for
+    #     runtime_env containers; tests point it at a stub
+    #     (runtime_env/container.py).
+    #   RTPU_JAX_PLATFORMS (unset): forces jax.config platforms in worker
+    #     processes BEFORE backend init (worker_main.py) — the dryrun
+    #     uses it to pin forked workers to cpu.
+    #   RTPU_HEAD / RTPU_NODE_DAEMON (internal): head / daemon host:port
+    #     a forked worker connects back to.
+    #   RTPU_NODE_ID (internal): hex node id of the owning daemon,
+    #     stamped into worker registration.
+    #   RTPU_WORKER_NONCE (internal): fork nonce tying a worker
+    #     registration to the lease that requested it.
+    #   RTPU_PARENT_PID (internal): daemon pid a worker watches so
+    #     orphaned workers exit when the daemon dies.
+    #   RTPU_SHM_NAME (internal): shared-memory arena name workers map
+    #     for the same-host zero-copy object plane.
+
     # --- tpu ---
     tpu_visible_chips_env: str = "TPU_VISIBLE_CHIPS"
     tpu_premapped_buffer_bytes: int = 0  # 0 = library default

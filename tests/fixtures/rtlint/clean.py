@@ -1,0 +1,38 @@
+"""Negative fixture: the same shapes as the bad fixtures, done right —
+rtlint must report ZERO findings here (false-positive canary)."""
+
+import asyncio
+import json
+import threading
+from collections import deque
+
+from ray_tpu.devtools.annotations import guarded_by
+
+
+@guarded_by("_lock", "_window", "_seq_no")
+class CleanWindow:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._window = deque(maxlen=128)
+        self._seq_no = 0
+        self._thread = threading.Thread(target=self._flush_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def report(self, step_time: float) -> int:
+        with self._lock:
+            self._window.append(step_time)
+            self._seq_no += 1
+            return self._seq_no
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._lock:
+                snapshot = list(self._window)
+            _ = json.dumps(snapshot)
+
+    async def publish(self):
+        with self._lock:
+            snapshot = list(self._window)
+        await asyncio.sleep(0)  # no lock held across the suspension
+        return snapshot

@@ -1,0 +1,29 @@
+"""R1 fixture: the PR-5 deque-mutated-during-iteration race, minimized.
+
+The train-context step window was appended by ``session.report()`` on
+the caller thread while the telemetry flusher thread iterated it for the
+straggler snapshot — ``RuntimeError: deque mutated during iteration``
+under load. The in-tree fix put both sides under ``ctx._report_lock``;
+the rule must flag the original unlocked shape.
+"""
+
+import threading
+from collections import deque
+
+
+class StepWindow:
+    def __init__(self):
+        self._window = deque(maxlen=128)
+        self._thread = threading.Thread(target=self._flush_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def report(self, step_time: float) -> None:
+        # BUG (PR-5): unlocked append while the flusher iterates.
+        self._window.append(step_time)
+
+    def _flush_loop(self) -> None:
+        while True:
+            total = 0.0
+            for v in self._window:  # iteration races the append
+                total += v

@@ -1,0 +1,27 @@
+"""R1 fixture: @guarded_by declaration violated.
+
+The annotation is the precise half of the race checker: once an attr is
+DECLARED guarded, any mutation outside the declared lock is flagged with
+no sharedness inference needed."""
+
+import threading
+
+from ray_tpu.devtools.annotations import guarded_by
+
+
+@guarded_by("_lock", "_table")
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+
+    def put_locked(self, k, v):
+        with self._lock:
+            self._table[k] = v  # OK: declared lock held
+
+    def put_racy(self, k, v):
+        self._table[k] = v  # BUG: guarded attr mutated without _lock
+
+    @guarded_by("_lock")
+    def _evict_locked(self, k):
+        self._table.pop(k, None)  # OK: caller holds _lock by contract

@@ -1,0 +1,18 @@
+"""R5 fixture: the PR-7 undocumented-env-knob class.
+
+The PR-7 satellite hand-found two RTPU_* env reads with no registry
+entry in utils/config.py (RTPU_FLASH_FUSED_BWD, RTPU_FLASH_VMEM_LIMIT_MB)
+— knobs nobody could discover without grepping the tree. Every RTPU_*
+read must resolve to a Config field or a documented env-only entry."""
+
+import os
+
+
+def flash_block_q() -> int:
+    # BUG (PR-7): env knob with no registry entry anywhere.
+    return int(os.environ.get("RTPU_FIXTURE_SECRET_KNOB", "512"))
+
+
+def vmem_limit() -> int:
+    # BUG: subscript read of an unregistered knob.
+    return int(os.environ["RTPU_FIXTURE_OTHER_KNOB"])

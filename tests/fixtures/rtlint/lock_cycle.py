@@ -1,0 +1,31 @@
+"""R2 fixture: lock-order cycle + await-while-holding-lock.
+
+Two threads taking ``_alock``→``_block`` and ``_block``→``_alock``
+deadlock the moment their critical sections overlap; and an ``async def``
+that awaits while holding a *threading* lock parks every other acquirer
+for the full suspension (the serve-router review has rejected both
+shapes by hand — this mechanizes the check)."""
+
+import asyncio
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def debit_then_credit(self):
+        with self._alock:
+            with self._block:  # order: A -> B
+                pass
+
+    def credit_then_debit(self):
+        with self._block:
+            with self._alock:  # BUG: order B -> A closes the cycle
+                pass
+
+    async def publish(self):
+        with self._alock:
+            # BUG: suspends the coroutine with a threading lock held.
+            await asyncio.sleep(0.1)

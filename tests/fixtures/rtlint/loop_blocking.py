@@ -1,0 +1,32 @@
+"""R3 fixture: blocking calls on the event loop, incl. the PR-5
+jax-backend-init hazard.
+
+PR-5's profiler originally called ``jax.devices()`` from a process that
+had merely imported jax — initializing a TPU backend (seconds of work,
+and the WRONG process to own the devices) from a loop-side snapshot
+handler. Plus the classic trio: ``time.sleep``, sync ``RpcClient.call``,
+and file I/O inside ``async def``."""
+
+import time
+
+import jax
+
+import ray_tpu
+
+
+class SnapshotHandler:
+    def __init__(self, rpc_client):
+        self._client = rpc_client
+
+    async def handle_snapshot(self, conn):
+        # BUG (PR-5): may initialize the jax backend on the loop.
+        devices = jax.devices()
+        # BUG: parks the whole event loop.
+        time.sleep(0.5)
+        # BUG: sync RPC round-trip on the loop (use the async client).
+        info = self._client.call("get_info")
+        # BUG: sync object fetch on the loop.
+        payload = ray_tpu.get(info["ref"])
+        # BUG: blocking file I/O on the loop.
+        with open("/tmp/snapshot.json", "w") as f:
+            f.write(str((devices, payload)))

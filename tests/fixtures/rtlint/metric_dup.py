@@ -1,0 +1,27 @@
+"""R4 fixture: the PR-8 same-name metric double-registration bug.
+
+Router and replica each constructed ``Counter("serve_shed_total")``; the
+registry keeps ONE object per name, so whichever side lost the race
+incremented a counter the exporter could no longer see — sheds silently
+vanished from /metrics. Also reproduces the PR-9 reserved ``node_id``
+label misuse (federation stamps node_id head-side; a local label would
+collide)."""
+
+from ray_tpu.util.metrics import Counter
+
+
+def router_metrics():
+    return Counter("fixture_shed_total", "sheds at the router",
+                   tag_keys=("deployment",))
+
+
+def replica_metrics():
+    # BUG (PR-8): same metric name registered at a second call site.
+    return Counter("fixture_shed_total", "sheds at the replica",
+                   tag_keys=("deployment",))
+
+
+def federated_wrong():
+    # BUG (PR-9): node_id is reserved for head federation.
+    return Counter("fixture_node_counter", "per-node things",
+                   tag_keys=("node_id",))

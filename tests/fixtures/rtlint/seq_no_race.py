@@ -1,0 +1,31 @@
+"""R1 fixture: the PR-12 ActorHandle.seq_no bug, minimized.
+
+A handle shared across threads minted task sequence numbers with a bare
+``self._seq_no += 1`` — two racing calls could read the same value and
+mint duplicate task ids. The fix in-tree was itertools.count; the rule
+must flag the original shape as a non-atomic read-modify-write.
+"""
+
+import threading
+
+
+class Handle:
+    def __init__(self):
+        self._seq_no = 0
+        self._sent = []
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         daemon=True)
+        self._flusher.start()
+
+    def call(self, payload):
+        # BUG (PR-12): non-atomic += on an attribute the flusher thread
+        # also reads/mutates — duplicate seq_nos under concurrent callers.
+        self._seq_no += 1
+        self._sent.append((self._seq_no, payload))
+        return self._seq_no
+
+    def _flush_loop(self):
+        while True:
+            if self._sent:
+                self._sent.pop()
+                self._seq_no += 0  # touches the counter from the thread

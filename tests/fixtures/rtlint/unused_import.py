@@ -1,0 +1,7 @@
+"""R0 fixture: unused module-scope import (pyflakes F401 subset)."""
+
+import json
+import os  # noqa — the noqa marker must suppress THIS one
+import textwrap  # BUG: never referenced again
+
+used = json.dumps({"ok": True})

@@ -1,0 +1,397 @@
+"""rtlint (ray_tpu/devtools): fixture-based positive/negative cases per
+rule, allowlist round-trip, annotation metadata, and the whole-package
+zero-unallowlisted-findings gate at HEAD."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from ray_tpu.devtools.annotations import ATTR, CONFINED_ATTR, guarded_by, loop_confined
+from ray_tpu.devtools.engine import (
+    AllowlistError,
+    load_allowlist,
+    run_lint,
+)
+from ray_tpu.devtools.model import parse_module
+from ray_tpu.devtools.rules import RuleContext, rule_metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "rtlint")
+
+
+def lint_fixture(name: str, rules=None):
+    return run_lint([os.path.join(FIXTURES, name)], allowlist=None,
+                    rules=rules)
+
+
+def symbols(res, rule=None):
+    return {f.symbol for f in res.findings
+            if rule is None or f.rule == rule}
+
+
+# ------------------------------------------------------------------ fixtures
+
+def test_r0_unused_import_detected_and_noqa_respected():
+    res = lint_fixture("unused_import.py", rules=["R0"])
+    assert symbols(res) == {"import:textwrap"}  # os has noqa, json is used
+
+
+def test_r1_seq_no_race_fixture():
+    """The PR-12 bug class: racy += minting duplicate task ids."""
+    res = lint_fixture("seq_no_race.py", rules=["R1"])
+    by_symbol = {f.symbol: f for f in res.findings}
+    assert "Handle._seq_no" in by_symbol
+    assert "non-atomic read-modify-write" in by_symbol["Handle._seq_no"].message
+
+
+def test_r1_deque_iteration_race_fixture():
+    """The PR-5 bug class: step window appended while the flusher
+    iterates."""
+    res = lint_fixture("deque_iter_race.py", rules=["R1"])
+    assert "StepWindow._window" in symbols(res)
+    (f,) = [f for f in res.findings if f.symbol == "StepWindow._window"]
+    assert "thread:_flush_loop" in f.message
+
+
+def test_r1_guarded_by_violation_fixture():
+    res = lint_fixture("guarded_violation.py", rules=["R1"])
+    assert len(res.findings) == 1
+    f = res.findings[0]
+    assert f.symbol == "Registry._table"
+    assert "guarded attribute" in f.message
+    # The locked site and the @guarded_by("_lock") method are NOT flagged.
+    assert f.line == 23
+
+
+def test_r2_lock_cycle_and_await_under_lock_fixture():
+    res = lint_fixture("lock_cycle.py", rules=["R2"])
+    cycles = [f for f in res.findings if f.symbol.startswith("lockcycle:")]
+    awaits = [f for f in res.findings if f.symbol.endswith(":await")]
+    assert len(cycles) == 1
+    assert "Transfer._alock" in cycles[0].message
+    assert "Transfer._block" in cycles[0].message
+    assert len(awaits) == 1
+    assert "self._alock" in awaits[0].message
+
+
+def test_r3_loop_blocking_fixture():
+    """time.sleep / sync call / ray_tpu.get / open / jax backend init in
+    an async body — incl. the PR-5 jax-backend-in-the-wrong-process
+    class."""
+    res = lint_fixture("loop_blocking.py", rules=["R3"])
+    got = symbols(res)
+    assert {"handle_snapshot:time.sleep", "handle_snapshot:open",
+            "handle_snapshot:ray_tpu.get",
+            "handle_snapshot:jax.devices"} <= got
+    assert any(s.endswith(".call") for s in got)
+
+
+def test_r4_metric_double_registration_fixture():
+    """The PR-8 bug class: second Counter(same_name) call site strands
+    increments; node_id tag key is reserved for federation (PR-9)."""
+    res = lint_fixture("metric_dup.py", rules=["R4"])
+    got = symbols(res)
+    assert "dup:fixture_shed_total" in got
+    assert "fixture_node_counter" in got
+    dup = [f for f in res.findings
+           if f.symbol == "dup:fixture_shed_total"]
+    assert len(dup) == 1  # one finding per extra site, not per site
+
+
+def test_r5_unregistered_knob_fixture():
+    """The PR-7 bug class: RTPU_* env reads with no registry entry."""
+    res = lint_fixture("knob_unregistered.py", rules=["R5"])
+    assert symbols(res) == {"RTPU_FIXTURE_SECRET_KNOB",
+                            "RTPU_FIXTURE_OTHER_KNOB"}
+
+
+def test_clean_fixture_has_zero_findings():
+    """False-positive canary: the same shapes done right."""
+    res = lint_fixture("clean.py")
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_every_rule_detects_its_bug_class():
+    """Acceptance: >= 5 rules each detect their reproduced historical
+    bug class in the corpus."""
+    res = run_lint([FIXTURES], allowlist=None)
+    fired = {f.rule for f in res.findings}
+    assert {"R0", "R1", "R2", "R3", "R4", "R5"} <= fired
+
+
+# ---------------------------------------------------------------- allowlist
+
+def test_allowlist_round_trip(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "# comment\n"
+        "R1 tests/fixtures/rtlint/seq_no_race.py Handle._seq_no"
+        " -- reproduction fixture, accepted\n")
+    res = run_lint([os.path.join(FIXTURES, "seq_no_race.py")],
+                   allowlist=str(allow), rules=["R1"])
+    assert "Handle._seq_no" not in symbols(res)
+    assert any(f.symbol == "Handle._seq_no" for f in res.allowlisted)
+    assert res.stale_entries == []
+
+
+def test_allowlist_wildcard_and_stale(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "R1 tests/fixtures/rtlint/seq_no_race.py Handle.* -- fixture\n"
+        "R1 tests/fixtures/rtlint/seq_no_race.py Gone.attr -- stale row\n")
+    res = run_lint([os.path.join(FIXTURES, "seq_no_race.py")],
+                   allowlist=str(allow), rules=["R1"])
+    assert res.findings == []          # wildcard swallowed the class
+    assert len(res.stale_entries) == 1  # and the dead row is reported
+    assert res.stale_entries[0].symbol == "Gone.attr"
+
+
+def test_allowlist_requires_justification(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text("R1 some/file.py Klass.attr\n")
+    with pytest.raises(AllowlistError):
+        load_allowlist(str(allow))
+    allow.write_text("R1 some/file.py Klass.attr -- \n")
+    with pytest.raises(AllowlistError):
+        load_allowlist(str(allow))
+
+
+# -------------------------------------------------------------- annotations
+
+def test_guarded_by_runtime_metadata():
+    @guarded_by("_lock", "_a", "_b")
+    class K:
+        pass
+
+    assert getattr(K, ATTR) == {"_a": "_lock", "_b": "_lock"}
+
+    class M:
+        @guarded_by("_lock")
+        def helper(self):
+            pass
+
+    assert getattr(M.helper, ATTR) == {"<body>": "_lock"}
+    with pytest.raises(TypeError):
+        guarded_by("")
+    with pytest.raises(TypeError):
+        guarded_by("_lock", 42)
+
+
+def test_loop_confined_runtime_metadata():
+    @loop_confined
+    class K:
+        pass
+
+    assert getattr(K, CONFINED_ATTR) is True
+
+
+def test_loop_confined_suppresses_caller_context():
+    src = (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._m = {}\n"
+        "    async def handler(self):\n"
+        "        self._m['k'] = 1\n"
+        "    def public_sync(self):\n"
+        "        self._m.pop('k', None)\n"
+    )
+    mod = parse_module("<mem>", "mem.py", src)
+    from ray_tpu.devtools.rules import rule_races
+    assert rule_races([mod], RuleContext())  # caller+loop: flagged
+    mod2 = parse_module("<mem>", "mem.py", "@loop_confined\n" + src)
+    assert rule_races([mod2], RuleContext()) == []  # confined: clean
+
+
+def test_thread_inside_loop_confined_class_still_flagged():
+    src = (
+        "@loop_confined\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        import threading\n"
+        "        self._m = {}\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _run(self):\n"
+        "        self._m['x'] = 1\n"
+        "    async def handler(self):\n"
+        "        self._m.pop('x', None)\n"
+    )
+    mod = parse_module("<mem>", "mem.py", src)
+    from ray_tpu.devtools.rules import rule_races
+    found = rule_races([mod], RuleContext())
+    assert any(f.symbol == "C._m" for f in found)
+
+
+# ------------------------------------------------------------ R4 hot paths
+
+def test_r4_unbound_tags_on_declared_hot_path():
+    src = (
+        "from ray_tpu.util.metrics import Counter\n"
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._c = Counter('fixture_hot_total',\n"
+        "                          tag_keys=('deployment',))\n"
+        "    def assign(self, dep):\n"
+        "        self._c.inc(tags={'deployment': dep})\n"
+    )
+    mod = parse_module("<mem>", "serve/hot.py", src)
+    ctx = RuleContext(hot_modules=("serve/hot.py",))
+    found = rule_metrics([mod], ctx)
+    assert any("bound()" in f.message for f in found)
+    # Same module NOT declared hot: no unbound finding.
+    cold = rule_metrics([mod], RuleContext(hot_modules=()))
+    assert not any("bound()" in f.message for f in cold)
+
+
+# -------------------------------------------------------------- whole tree
+
+def test_whole_package_zero_unallowlisted_findings():
+    """Acceptance: `ray_tpu lint` exits 0 at HEAD — every finding fixed
+    or allowlisted with a justification, and no stale allowlist rows."""
+    res = run_lint([os.path.join(REPO, "ray_tpu")])
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    assert res.stale_entries == [], [
+        f"{e.rule} {e.relpath} {e.symbol}" for e in res.stale_entries]
+    assert res.allowlisted, "allowlist unexpectedly empty — baseline gone?"
+
+
+def test_whole_package_within_wall_budget():
+    res = run_lint([os.path.join(REPO, "ray_tpu")])
+    assert res.wall_seconds < 30.0, res.wall_seconds
+
+
+# ---------------------------------------------------------------------- CLI
+
+def test_cli_lint_exit_codes(capsys):
+    from ray_tpu.scripts.cli import main
+
+    rc = main(["lint", os.path.join(FIXTURES, "seq_no_race.py"),
+               "--no-allowlist"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "R1" in out and "seq_no_race.py" in out
+
+    rc = main(["lint", os.path.join(FIXTURES, "clean.py")])
+    assert rc == 0
+
+    rc = main(["lint", "/definitely/not/a/path"])
+    assert rc == 2
+
+
+def test_cli_lint_json(capsys):
+    import json as _json
+
+    from ray_tpu.scripts.cli import main
+
+    rc = main(["lint", os.path.join(FIXTURES, "metric_dup.py"),
+               "--no-allowlist", "--json"])
+    assert rc == 1
+    payload = _json.loads(capsys.readouterr().out)
+    assert payload["counts"].get("R4", 0) >= 2
+    assert payload["files"] == 1
+    assert all({"rule", "file", "line", "symbol", "message"}
+               <= set(f) for f in payload["findings"])
+
+
+def test_r3_wrapped_await_not_flagged():
+    """`await asyncio.wait_for(client.call(...), t)` is the async path —
+    every call feeding an await is loop-side, not a sync block."""
+    from ray_tpu.devtools.rules import rule_event_loop
+
+    m = parse_module("<m>", "m.py", (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def ping(self):\n"
+        "        return await asyncio.wait_for("
+        "self._client.call('p'), 5)\n"))
+    assert not [f for f in rule_event_loop([m], RuleContext())
+                if ".call" in f.symbol]
+
+
+def test_r5_documented_check_is_whole_word():
+    """RTPU_SHM must not ride on a documented RTPU_SHM_NAME entry."""
+    from ray_tpu.devtools.rules import rule_knobs
+
+    ctx = RuleContext(config_source="#   RTPU_SHM_NAME (internal): x")
+    bad = parse_module("<m>", "m.py",
+                       "import os\nv = os.environ.get('RTPU_SHM')\n")
+    assert [f for f in rule_knobs([bad], ctx) if f.symbol == "RTPU_SHM"]
+    ok = parse_module("<m>", "m.py",
+                      "import os\nv = os.environ.get('RTPU_SHM_NAME')\n")
+    assert not rule_knobs([ok], ctx)
+
+
+def test_r0_same_name_imports_cannot_vouch_for_each_other():
+    from ray_tpu.devtools.rules import rule_style
+
+    m = parse_module("<m>", "m.py",
+                     "import json\nfrom simplejson import json as json2\n")
+    assert {f.symbol for f in rule_style([m], RuleContext())} == \
+        {"import:json", "import:json2"}
+
+
+def test_cli_no_python_files_is_usage_error(tmp_path, capsys):
+    from ray_tpu.scripts.cli import main
+
+    target = tmp_path / "notes.md"
+    target.write_text("not python\n")
+    assert main(["lint", str(target)]) == 2
+    assert "no Python files" in capsys.readouterr().err
+
+
+def test_unknown_rule_id_is_usage_error():
+    from ray_tpu.devtools.engine import LintUsageError
+    from ray_tpu.scripts.cli import main
+
+    with pytest.raises(LintUsageError):
+        run_lint([os.path.join(FIXTURES, "clean.py")], allowlist=None,
+                 rules=["R9"])
+    # lowercase + spaces normalize instead of crashing
+    res = run_lint([os.path.join(FIXTURES, "metric_dup.py")],
+                   allowlist=None, rules=["r4", " R4 "])
+    assert symbols(res, "R4")
+    assert main(["lint", os.path.join(FIXTURES, "clean.py"),
+                 "--rules", "R9"]) == 2
+
+
+def test_overlapping_paths_do_not_double_parse():
+    one = os.path.join(FIXTURES, "metric_dup.py")
+    res_single = run_lint([one], allowlist=None, rules=["R4"])
+    res_overlap = run_lint([one, FIXTURES], allowlist=None, rules=["R4"])
+    dup = lambda r: [f for f in r.findings  # noqa: E731
+                     if f.symbol == "dup:fixture_shed_total"]
+    assert len(dup(res_single)) == len(dup(res_overlap)) == 1
+
+
+def test_cli_stale_allowlist_entry_fails(tmp_path, capsys):
+    from ray_tpu.scripts.cli import main
+
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "R1 tests/fixtures/rtlint/clean.py Gone.attr -- dead row\n")
+    rc = main(["lint", os.path.join(FIXTURES, "clean.py"),
+               "--allowlist", str(allow)])
+    assert rc == 1  # stale rows fail the CLI, not just the dryrun gate
+    out = capsys.readouterr().out
+    assert "STALE" in out
+    assert str(allow) in out  # points at the file actually used
+
+
+def test_syntax_error_file_is_a_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n  pass\n")
+    res = run_lint([str(bad)], allowlist=None)
+    assert any(f.symbol == "syntax-error" for f in res.findings)
+
+
+def test_lint_bench_quick_record():
+    import sys
+
+    sys.path.insert(0, REPO)
+    from devbench.lint_bench import run_bench
+
+    rec = run_bench(quick=True, write=False)
+    assert rec["findings"] == 0
+    assert rec["within_budget"]
+    assert set(rec["rule_seconds"]) == {"R0", "R1", "R2", "R3", "R4", "R5"}
