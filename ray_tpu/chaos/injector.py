@@ -52,6 +52,23 @@ Probe points and their attrs:
   (use ``mode="raise"`` on in-process runtimes — ``"exit"`` takes the
   whole interpreter). ``drop`` is not meaningful at a sync call site and
   is ignored.
+- ``head.tick``   — the head server's health loop; attrs ``boot`` (the
+  head's boot id — scope a drill to ONE head when several share an
+  interpreter, as in-process test clusters do). ``kill`` takes the
+  control plane down abruptly — background tasks cancelled, NO final
+  WAL/snapshot flush beyond what group commit already wrote (crash
+  semantics) — so restart must come back from the persisted WAL. Works
+  for in-process heads (tests/devbench) and real head processes alike.
+- ``partition``   — directional head⇄node network partition, probed in
+  the RPC clients that carry head traffic (the daemon's head link and
+  the head's per-daemon clients); attrs ``node`` (regex key),
+  ``direction``. Rules carry their own ``direction`` field:
+  ``"to_head"`` affects node→head frames (heartbeats, registrations,
+  actor_failed), ``"from_head"`` affects head→node frames (place_actor,
+  PG 2PC, profile fan-out), ``"both"`` (default) affects both. ``drop``
+  silently discards matched frames (callers see hangs/timeouts — lost
+  datagrams, NOT connection resets, so reconnect logic is exercised the
+  hard way); ``delay`` stalls them ``delay_s``. Heal with `chaos clear`.
 
 Kills are real: ``mode="exit"`` calls ``os._exit`` so the process dies
 without cleanup (SIGKILL semantics). ``mode="raise"`` raises
@@ -80,10 +97,12 @@ ACTIVE = False
 
 _ALLOWED_KEYS = {
     "point", "action", "match", "after_s", "at_step", "prob", "count",
-    "delay_s", "mode", "exit_code", "mark",
+    "delay_s", "mode", "exit_code", "mark", "direction",
 }
 _ACTIONS = ("kill", "delay", "drop", "error")
-_POINTS = ("train.step", "daemon.tick", "rpc.server", "serve.replica")
+_POINTS = ("train.step", "daemon.tick", "rpc.server", "serve.replica",
+           "head.tick", "partition")
+_DIRECTIONS = ("both", "to_head", "from_head")
 _REGEX_KEYS = ("method", "node")
 
 
@@ -106,6 +125,8 @@ class ChaosRule:
     mode: str = "exit"
     exit_code: int = 137
     mark: str | None = None
+    # partition rules only: which head⇄node direction the rule severs.
+    direction: str = "both"
     # runtime state
     fired: int = 0
     installed_ts: float = field(default_factory=time.monotonic)
@@ -122,6 +143,10 @@ class ChaosRule:
         if rule.action not in _ACTIONS:
             raise ValueError(
                 f"unknown chaos action {rule.action!r}; one of {_ACTIONS}")
+        if rule.direction not in _DIRECTIONS:
+            raise ValueError(
+                f"unknown partition direction {rule.direction!r}; one of "
+                f"{_DIRECTIONS}")
         return rule
 
     def to_dict(self) -> dict:
@@ -131,6 +156,7 @@ class ChaosRule:
             "at_step": self.at_step, "prob": self.prob, "count": self.count,
             "delay_s": self.delay_s, "mode": self.mode,
             "exit_code": self.exit_code, "mark": self.mark,
+            "direction": self.direction,
             "fired": self.fired,
         }
 
@@ -202,7 +228,7 @@ def _ensure_env_loaded() -> None:
 def _rule_key(r: ChaosRule) -> tuple:
     return (r.point, r.action, tuple(sorted((r.match or {}).items())),
             r.after_s, r.at_step, r.prob, r.count, r.delay_s, r.mode,
-            r.exit_code, r.mark)
+            r.exit_code, r.mark, r.direction)
 
 
 def install(rules: list[dict | ChaosRule], replace: bool = False) -> int:
@@ -243,6 +269,17 @@ def clear() -> None:
         # must actually stop the chaos, even when RTPU_CHAOS is still set.
         _env_loaded = True
         _refresh_active_locked()
+
+
+def remove_point(point: str) -> int:
+    """Remove only the rules installed at one probe point (heal a
+    partition without disarming the rest of a composed drill). Returns
+    the number removed."""
+    with _lock:
+        before = len(_rules)
+        _rules[:] = [r for r in _rules if r.point != point]
+        _refresh_active_locked()
+        return before - len(_rules)
 
 
 def reset_for_tests() -> None:
@@ -337,6 +374,50 @@ def maybe_kill(point: str, **attrs) -> None:
     if rule.mode == "raise":
         raise ChaosKilled(f"chaos: injected kill at {point} ({attrs})")
     os._exit(rule.exit_code)
+
+
+def partition_action(node: str, direction: str) -> tuple[str, float] | None:
+    """``partition`` probe for one frame of head⇄node traffic: returns
+    ("drop", 0) / ("delay", seconds) or None. ``direction`` is the frame's
+    travel direction ("to_head" | "from_head"); a rule severs it when its
+    own direction is "both" or matches. Unlike :func:`decide` this does
+    NOT log one firing per frame — a severed heartbeat stream would flood
+    the firing log — it records only each rule's FIRST firing (the
+    injection instant benches measure from) while still counting every
+    frame against a finite budget."""
+    _ensure_env_loaded()
+    if not ACTIVE or not _chaos_enabled():
+        return None
+    now = time.monotonic()
+    with _lock:
+        for rule in _rules:
+            if rule.point != "partition":
+                continue
+            if rule.direction != "both" and rule.direction != direction:
+                continue
+            if rule.count >= 0 and rule.fired >= rule.count:
+                continue
+            if now - rule.installed_ts < rule.after_s:
+                continue
+            if not rule.matches({"node": node}):
+                continue
+            if rule.prob < 1.0 and random.random() >= rule.prob:
+                continue
+            rule.fired += 1
+            if rule.fired == 1:
+                _fired.append({"point": "partition", "action": rule.action,
+                               "ts": time.time(),
+                               "attrs": {"node": node,
+                                         "direction": direction}})
+                del _fired[:-_FIRED_TAIL]
+                write_mark(rule, "partition",
+                           {"node": node, "direction": direction})
+            if rule.action == "drop":
+                return ("drop", 0.0)
+            if rule.action == "delay":
+                return ("delay", max(0.0, float(rule.delay_s)))
+            return None
+    return None
 
 
 def rpc_server_action(method: str) -> tuple[str, float] | None:

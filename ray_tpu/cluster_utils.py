@@ -35,31 +35,61 @@ class Cluster:
         self.head = start_head(host=host, port=port,
                                persist_path=self._persist_path)
 
+    def kill_head(self) -> None:
+        """Chaos: hard-kill the control plane — NO final snapshot/WAL
+        flush beyond what group commit already ACKed (kill -9 semantics) —
+        and leave it DOWN. The cluster runs headless until
+        :meth:`revive_head`; daemons/drivers ride it out on their
+        reconnect/retry paths. (Same death the chaos plane's ``head.tick``
+        kill rule delivers.)"""
+        self._down_addr = (self.head.rpc.host, self.head.rpc.port)
+        self._io.run(self.head._chaos_die())
+
+    def revive_head(self) -> tuple[float, "object"]:
+        """Bring a killed head back on the SAME address. Returns
+        ``(restart_seconds, head)`` — the wall time of snapshot load + WAL
+        replay + socket bind, the number the headft bench gates at 3 s."""
+        import time as _time
+
+        host, port = getattr(self, "_down_addr",
+                             (self.head.rpc.host, self.head.rpc.port))
+        t0 = _time.monotonic()
+        self.head = start_head(host=host, port=port,
+                               persist_path=self._persist_path)
+        return _time.monotonic() - t0, self.head
+
     def crash_head(self) -> None:
         """Chaos: hard-kill the control plane — NO final snapshot flush
         (kill -9 semantics) — and bring it back on the same address. State
         must come back from the per-mutation WAL (reference: GCS persists
         each mutation to Redis, so a crash between snapshots loses
         nothing)."""
-        host, port = self.head.rpc.host, self.head.rpc.port
-        head = self.head
+        self.kill_head()
+        self.revive_head()
 
-        async def hard_stop():
-            if head._health_task:
-                head._health_task.cancel()
-            if head._persist_task:
-                head._persist_task.cancel()
-            # Default group commit coalesces per event-loop tick, and this
-            # coroutine is scheduled BEHIND any pending flush callback — so
-            # every ACKed mutation's record is already at the OS. (With
-            # wal_group_commit_ms > 0 a kill may drop the window's tail;
-            # that is the documented trade.)
-            head._wal_f = None
-            await head.rpc.stop()
+    def partition_from_head(self, node_regex: str,
+                            direction: str = "both",
+                            action: str = "drop",
+                            delay_s: float = 0.5) -> None:
+        """Chaos: sever head⇄node traffic for daemons matching
+        ``node_regex`` by installing a ``partition`` rule in this
+        process's injector (in-process clusters share one interpreter, so
+        one install covers both ends). Directional: "to_head",
+        "from_head", or "both". Heal with :meth:`heal_partition`."""
+        from ray_tpu.chaos import injector
 
-        self._io.run(hard_stop())
-        self.head = start_head(host=host, port=port,
-                               persist_path=self._persist_path)
+        injector.install([{
+            "point": "partition", "action": action,
+            "match": {"node": node_regex}, "direction": direction,
+            "delay_s": delay_s, "count": -1,
+        }])
+
+    def heal_partition(self) -> None:
+        """Remove only the partition rules — a composed drill's other
+        chaos rules (kills, rpc delays) stay armed."""
+        from ray_tpu.chaos import injector
+
+        injector.remove_point("partition")
 
     @property
     def address(self) -> str:

@@ -60,9 +60,11 @@ def connect_cluster(address: str, num_cpus: float | None = None,
         _wrap_shutdown(rt)
         return rt
     host, port = address.rsplit(":", 1)
-    # Adopt the first alive node as the local lease target.
+    # Adopt the first alive node as the local lease target. Retrying: a
+    # driver attaching while the head is mid-restart (or briefly
+    # partitioned) should ride the blip out, not fail `init()`.
     probe = RpcClient(host, int(port))
-    nodes = probe.call("list_nodes")
+    nodes = probe.call_retrying("list_nodes", idempotent=True)
     probe.close()
     daemon_addr = None
     for info in nodes.values():

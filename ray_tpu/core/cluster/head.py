@@ -18,13 +18,29 @@ import pickle
 import struct
 import time
 import uuid
+import zlib
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any
 
+from ray_tpu.chaos import injector as _chaos
 from ray_tpu.devtools.annotations import loop_confined
 from ray_tpu.core.cluster.protocol import RpcServer, ServerConnection, spawn_task
 from ray_tpu.core.fn_registry import FN_NS
 from ray_tpu.utils.config import get_config
+
+# WAL record header: payload length + CRC32 of the payload. The CRC is what
+# makes a torn tail DETECTABLE: a power loss can land any byte prefix of the
+# final write on disk, and a bare length prefix would happily frame a
+# half-written or bit-rotted record for pickle to choke on (or worse,
+# quietly accept). Replay stops cleanly at the first record whose checksum
+# or framing fails — everything before it is intact by construction.
+# Files open with a magic version header; a file WITHOUT it is a
+# pre-CRC-format log (bare 4-byte length prefixes) and replays through the
+# legacy parser instead of being silently mis-framed and discarded.
+_WAL_HDR = struct.Struct("<II")
+_WAL_MAGIC = b"RTPUWAL2"
+_WAL_HDR_V1 = struct.Struct("<I")
 
 
 @dataclass
@@ -38,6 +54,11 @@ class NodeInfo:
     alive: bool = True
     pending_demands: list = field(default_factory=list)  # autoscaler feed
     transfer_addr: tuple | None = None  # native object-transfer server
+    # Daemon incarnation fence: the registration epoch (daemon boot wall
+    # clock) of the incarnation currently owning this node id. A register
+    # carrying an OLDER epoch is a stale daemon resurrecting (partition
+    # heal, paused process) and is fenced instead of double-allocated.
+    epoch: float = 0.0
     # Same-host zero-copy descriptor: {"shm_name": ..., "boot_id": ...}.
     # A puller whose boot_id matches maps the node's arena directly and
     # reads objects with no wire transfer at all (plasma-style same-host
@@ -107,7 +128,24 @@ class HeadServer:
         # write+flush (scheduled same-tick, or wal_group_commit_ms later).
         self._wal_buf: list[bytes] = []
         self._wal_flush_scheduled = False
+        self._wal_tail_dropped = 0  # torn/corrupt tail records skipped
         self.pgs: dict[str, dict] = {}
+        # Crash-consistent session identity: ``incarnation`` counts head
+        # boots over this persist path (bumped + WAL-logged each boot);
+        # ``boot_id`` identifies THIS process even without persistence, so
+        # daemons can fence traffic from a superseded (stale) head and
+        # detect an amnesiac restart. Reference: the GCS restart path the
+        # raylets handle via HandleNotifyGCSRestart (node_manager.cc:1050).
+        self.boot_id = uuid.uuid4().hex
+        self.incarnation = 0
+        self.started_ts = time.time()
+        # Exactly-once head mutations: completed request ids -> recorded
+        # reply, bounded (head_dedup_max), WAL-logged and snapshotted with
+        # the tables they guard — a client retry after crash-before-ACK is
+        # answered from the record instead of re-applied.
+        self._dedup: "OrderedDict[str, Any]" = OrderedDict()
+        self._fenced_registrations = 0
+        self._reconcile_totals: dict[str, int] = {}
         if persist_path:
             self._load_snapshot()
             self._open_wal()
@@ -117,10 +155,12 @@ class HeadServer:
             # (wal_group_commit_ms > 0) the bounded-durability trade is
             # explicit and the hook stands down.
             self.rpc.pre_reply = self._wal_pre_reply
+        self.incarnation += 1
+        self.restart_count = max(0, self.incarnation - 1)
+        if self._wal_f is not None:
+            self._log_mutation("meta", {"incarnation": self.incarnation})
         # Cluster-wide task events flushed from workers (reference:
         # GcsTaskManager bounded task-event store).
-        from collections import deque
-
         self.task_events: deque = deque(maxlen=100_000)
         self._task_events_total = 0  # monotone append count (cursor base)
         self._events_epoch = uuid.uuid4().hex  # head incarnation id
@@ -201,6 +241,8 @@ class HeadServer:
         r("create_placement_group", self._create_pg)
         r("remove_placement_group", self._remove_pg)
         r("placement_group_state", self._pg_state)
+        r("head_status", self._head_status)
+        r("placement_fenced", self._placement_fenced)
         self.rpc.on_disconnect = self._on_disconnect
         self._daemon_clients: dict[str, Any] = {}
 
@@ -212,6 +254,18 @@ class HeadServer:
             self._persist_task = loop.create_task(self._persist_loop())
         if self.watchdog is not None:
             self.watchdog.start()
+            if self.restart_count > 0:
+                # A control-plane restart is an incident an operator wants
+                # in the same timeline as the anomalies it may explain —
+                # lightweight (no profile capture), never a detector trip.
+                self.watchdog.record_event(
+                    "head_restart",
+                    f"head restarted (incarnation {self.incarnation}, "
+                    f"{self._wal_tail_dropped} torn WAL tail record(s) "
+                    "dropped)",
+                    detail={"incarnation": self.incarnation,
+                            "boot_id": self.boot_id,
+                            "restart_count": self.restart_count})
         return addr
 
     async def stop(self):
@@ -261,7 +315,7 @@ class HeadServer:
             rec = pickle.dumps((kind, args))
         except Exception:
             return  # durability is best-effort; the snapshot still lands
-        self._wal_buf.append(struct.pack("<I", len(rec)) + rec)
+        self._wal_buf.append(_WAL_HDR.pack(len(rec), zlib.crc32(rec)) + rec)
         if self._wal_flush_scheduled:
             return
         self._wal_flush_scheduled = True
@@ -301,7 +355,31 @@ class HeadServer:
 
         os.makedirs(os.path.dirname(os.path.abspath(self._persist_path)),
                     exist_ok=True)
-        self._wal_f = open(self._persist_path + ".wal", "ab")
+        cur = self._persist_path + ".wal"
+        # Upgrade-in-place: never APPEND current-format records to a
+        # legacy (pre-magic) log — a mixed file would mis-frame on
+        # replay. Retire the legacy segment into .wal.old (already
+        # replayed by _load_snapshot; the next snapshot compacts it away)
+        # and start a fresh current-format log.
+        try:
+            with open(cur, "rb") as f:
+                head8 = f.read(len(_WAL_MAGIC))
+            if head8 and head8 != _WAL_MAGIC:
+                old = self._persist_path + ".wal.old"
+                if os.path.exists(old):
+                    with open(old, "ab") as dst, open(cur, "rb") as src:
+                        dst.write(src.read())
+                    os.remove(cur)
+                else:
+                    os.replace(cur, old)
+        except FileNotFoundError:
+            pass
+        except Exception:
+            pass  # unreadable: the append below starts a fresh segment
+        self._wal_f = open(cur, "ab")
+        if self._wal_f.tell() == 0:
+            self._wal_f.write(_WAL_MAGIC)
+            self._wal_f.flush()
 
     def _rotate_wal(self) -> None:
         """Called at snapshot-copy time ON THE LOOP THREAD: the snapshot
@@ -331,9 +409,14 @@ class HeadServer:
         self._open_wal()
 
     def _replay_wal(self) -> None:
+        """Roll the mutation log forward over the loaded snapshot. Torn-
+        tail tolerant: a power loss can leave any byte prefix of the final
+        group-commit write (truncated header, truncated payload, or a
+        bit-rotted record) — replay verifies each record's CRC and stops
+        CLEANLY at the first bad one instead of raising mid-load, keeping
+        the intact prefix. Skipped tail records are counted in
+        ``_wal_tail_dropped`` (surfaced via head_status)."""
         import os
-        import pickle
-        import struct
 
         for suffix in (".wal.old", ".wal"):
             path = self._persist_path + suffix
@@ -344,17 +427,60 @@ class HeadServer:
                     data = f.read()
             except Exception:
                 continue
-            off = 0
-            while off + 4 <= len(data):
-                (n,) = struct.unpack_from("<I", data, off)
-                if off + 4 + n > len(data):
-                    break  # truncated tail record (crash mid-append)
-                try:
-                    kind, args = pickle.loads(data[off + 4:off + 4 + n])
-                    self._apply_mutation(kind, args)
-                except Exception:
-                    break  # corrupt tail: stop replay, keep what we have
-                off += 4 + n
+            if data.startswith(_WAL_MAGIC):
+                self._replay_records(data, len(_WAL_MAGIC))
+            else:
+                # Pre-magic log written before the CRC format landed:
+                # replay it with the legacy framing rather than silently
+                # discarding every post-snapshot mutation as a "torn
+                # tail". _open_wal retires the file so nothing current-
+                # format is ever appended to it.
+                self._replay_records_v1(data)
+
+    def _replay_records(self, data: bytes, off: int) -> None:
+        hdr = _WAL_HDR.size
+        while off + hdr <= len(data):
+            n, crc = _WAL_HDR.unpack_from(data, off)
+            start = off + hdr
+            if start + n > len(data):
+                self._wal_tail_dropped += 1
+                break  # truncated tail record (crash mid-append)
+            payload = data[start:start + n]
+            if zlib.crc32(payload) != crc:
+                # Bit-flipped / torn record: nothing after it can be
+                # trusted to frame correctly either — stop here.
+                self._wal_tail_dropped += 1
+                break
+            try:
+                kind, args = pickle.loads(payload)
+                self._apply_mutation(kind, args)
+            except Exception:
+                self._wal_tail_dropped += 1
+                break  # corrupt tail: stop replay, keep what we have
+            off = start + n
+
+    def _replay_records_v1(self, data: bytes) -> None:
+        """Legacy (pre-CRC) framing: ``<I len><pickle>``. Same clean-stop
+        discipline, minus the checksum the old format never had. A
+        failed-snapshot rotation can append a current-format segment onto
+        a legacy ``.wal.old`` — the embedded magic switches parsers."""
+        off = 0
+        hdr = _WAL_HDR_V1.size
+        while off + hdr <= len(data):
+            if data[off:off + len(_WAL_MAGIC)] == _WAL_MAGIC:
+                return self._replay_records(data, off + len(_WAL_MAGIC))
+            (n,) = _WAL_HDR_V1.unpack_from(data, off)
+            start = off + hdr
+            if start + n > len(data):
+                self._wal_tail_dropped += 1
+                break
+            try:
+                kind, args = pickle.loads(data[start:start + n])
+                self._apply_mutation(kind, args)
+            except Exception:
+                self._wal_tail_dropped += 1
+                break
+            off = start + n
 
     def _apply_mutation(self, kind: str, args: tuple) -> None:
         if kind == "actor":
@@ -380,6 +506,15 @@ class HeadServer:
             self.pgs[pg_id] = pg
         elif kind == "pg_del":
             self.pgs.pop(args[0], None)
+        elif kind == "worker_del":
+            self.workers.pop(args[0], None)
+        elif kind == "dedup":
+            req_id, reply = args
+            self._dedup[req_id] = reply
+            self._bound_dedup()
+        elif kind == "meta":
+            self.incarnation = int(args[0].get(
+                "incarnation", self.incarnation))
 
     def _snapshot_state(self) -> dict:
         """Copy on the loop thread — the executor pickles the copy while the
@@ -394,6 +529,11 @@ class HeadServer:
             "kv": copy.deepcopy(self.kv),
             "workers": dict(self.workers),
             "pgs": copy.deepcopy(self.pgs),
+            # Session + dedup state compact with the tables they guard: a
+            # post-snapshot retry of a pre-snapshot mutation must still
+            # find its record.
+            "incarnation": self.incarnation,
+            "dedup": list(self._dedup.items()),
         }
 
     def _write_snapshot(self, state: dict) -> None:
@@ -435,6 +575,8 @@ class HeadServer:
         self.kv = snap.get("kv", {})
         self.workers = snap.get("workers", {})
         self.pgs = snap.get("pgs", {})
+        self.incarnation = int(snap.get("incarnation", 0))
+        self._dedup = OrderedDict(snap.get("dedup") or ())
         # Restored actors keep their last known addresses; nodes re-register
         # and the health loop culls anything whose node never returns.
         # Then roll forward mutations logged after the snapshot was cut.
@@ -457,6 +599,86 @@ class HeadServer:
                     self._dirty = True  # next tick retries
                 finally:
                     self._write_fut = None
+
+    # ------------------------------------------------------- mutation dedup
+    # Exactly-once retries (reference: the GCS answers retried idempotent
+    # mutations from its persisted tables): clients stamp state-changing
+    # RPCs with a request id; the completed reply is recorded in a bounded
+    # OrderedDict that is WAL-logged + snapshotted ALONGSIDE the mutation
+    # it guards, so a retry after crash-before-ACK — against the restarted
+    # head — is answered from the record instead of re-applied. The record
+    # rides the same group-commit flush as the mutation, and the pre-reply
+    # hook guarantees both are at the OS before the client can see an ACK.
+    def _bound_dedup(self) -> None:
+        bound = max(16, get_config().head_dedup_max)
+        while len(self._dedup) > bound:
+            self._dedup.popitem(last=False)
+
+    def _dedup_get(self, req_id: str):
+        """Recorded reply for a completed mutation request id, or None."""
+        if not req_id:
+            return None
+        return self._dedup.get(req_id)
+
+    def _dedup_put(self, req_id: str, reply):
+        """Record (and WAL-log) the final reply for ``req_id``; returns
+        the reply so handlers can ``return self._dedup_put(rid, out)``."""
+        if req_id:
+            self._dedup[req_id] = reply
+            self._bound_dedup()
+            self._log_mutation("dedup", req_id, reply)
+        return reply
+
+    # --------------------------------------------------------------- chaos
+    async def _chaos_die(self) -> None:
+        """Abrupt control-plane death (chaos ``head.tick`` kill): cancel
+        the background loops and drop off the network with NO final WAL
+        or snapshot flush — un-ACKed buffered records die here, exactly
+        like kill -9. Everything a client ever saw ACKed is already at
+        the OS (group commit pre-reply ordering), so a restart over the
+        same persist path must come back complete from snapshot + WAL
+        replay. Works for in-process heads (tests/devbench, where
+        os._exit would take the whole interpreter) and real head
+        processes alike."""
+        if self.watchdog is not None:
+            try:
+                self.watchdog.stop()
+            except Exception:
+                pass
+        for t in (self._health_task, self._persist_task):
+            if t is not None and t is not asyncio.current_task():
+                t.cancel()
+        self._wal_buf.clear()  # un-ACKed records: lost, as in a crash
+        self._wal_flush_scheduled = True  # disarm any queued flush callback
+        self._wal_f = None
+        for node_id in list(self._daemon_clients):
+            self._drop_daemon_client(node_id)
+        try:
+            await self.rpc.stop()
+        except Exception:
+            pass
+
+    async def _head_status(self, conn: ServerConnection):
+        """Control-plane session facts for `ray_tpu status` / the state
+        API: who this head is (incarnation/boot), how long it has been up,
+        how many times it has come back, and the fault-tolerance odometers
+        (dedup table, torn-tail drops, fenced registrations, reconcile
+        repairs)."""
+        return {
+            "incarnation": self.incarnation,
+            "boot_id": self.boot_id,
+            "started_ts": self.started_ts,
+            "uptime_s": round(time.time() - self.started_ts, 3),
+            "restart_count": self.restart_count,
+            "persistent": self._persist_path is not None,
+            "dedup_entries": len(self._dedup),
+            "wal_tail_dropped": self._wal_tail_dropped,
+            "fenced_registrations": self._fenced_registrations,
+            "reconcile": dict(self._reconcile_totals),
+            "nodes_alive": sum(1 for n in self.nodes.values() if n.alive),
+            "nodes_total": len(self.nodes),
+            "actors": len(self.actors),
+        }
 
     # ------------------------------------------------------------------ pubsub
     # (reference: src/ray/pubsub long-poll channels; here: server-push over the
@@ -512,26 +734,184 @@ class HeadServer:
         resources: dict, labels: dict | None = None,
         transfer_addr: list | None = None,
         object_plane: dict | None = None,
+        epoch: float = 0.0, state: dict | None = None,
     ):
+        """Node (re-)registration with fencing + reconciliation. ``epoch``
+        is the daemon incarnation's boot stamp; ``state`` is its live
+        inventory (workers/actors/leases/bundles/available) so a head that
+        replayed its WAL — or lost everything (amnesiac, no persistence) —
+        cross-checks its tables against daemon truth and repairs the
+        divergence instead of scheduling into a fiction."""
+        prev = self.nodes.get(node_id)
+        if prev is not None and prev.alive and epoch and prev.epoch \
+                and epoch < prev.epoch:
+            # A daemon incarnation OLDER than the one that already owns
+            # this node id is resurrecting (partition heal, un-paused
+            # process) while the owner is still ALIVE. Accepting it would
+            # hand the node's resources to two daemons at once — fence
+            # it; the stale daemon stands down
+            # (node_daemon._register_with_head). The alive guard keeps
+            # the fence off a legitimate replacement whose host clock
+            # stepped backwards across a restart (epochs are wall-clock):
+            # once the owner is gone, any incarnation may take the id.
+            self._fenced_registrations += 1
+            return {"ok": False, "fenced": True,
+                    "incarnation": self.incarnation, "boot_id": self.boot_id}
         self._drop_daemon_client(node_id)  # re-registration: stale address
-        self.nodes[node_id] = NodeInfo(
+        info = NodeInfo(
             node_id=node_id, addr=(host, port), resources=dict(resources),
             available=dict(resources), labels=labels or {},
             transfer_addr=tuple(transfer_addr) if transfer_addr else None,
             object_plane=dict(object_plane) if object_plane else None,
+            epoch=epoch or (prev.epoch if prev else 0.0),
         )
+        if state and state.get("available") is not None:
+            # Daemon truth beats the fresh-node assumption: leases granted
+            # or returned during a head outage are already reflected here
+            # (the next heartbeat would fix it too; seeding avoids a
+            # window of phantom availability the scheduler could act on).
+            info.available = dict(state["available"])
+        self.nodes[node_id] = info
         conn.meta["node_id"] = node_id
         self._node_conns[node_id] = conn
         self._membership_version += 1
+        reconcile = None
+        if state is not None:
+            reconcile = await self._reconcile_node(conn, node_id, state)
         await self.publish("node_events", event="added", node_id=node_id)
-        return {"ok": True}
+        out = {"ok": True, "incarnation": self.incarnation,
+               "boot_id": self.boot_id}
+        if reconcile is not None:
+            out["reconcile"] = reconcile
+        return out
+
+    async def _reconcile_node(self, conn: ServerConnection, node_id: str,
+                              state: dict) -> dict:
+        """Repair head-vs-daemon divergence accumulated during an outage.
+        Four repairs (reference: the GCS rebuilding actor/node state from
+        raylet reports after restart):
+
+        - **reap**: the head believes an actor lives here, the daemon
+          doesn't — the worker died while the head was down. Run the
+          normal death path NOW (restart budget / DEAD) instead of letting
+          a caller discover it by timeout.
+        - **re-pin / adopt**: the daemon hosts a live actor the head has
+          as PENDING/RESTARTING (the ``actor_ready`` ACK died with the old
+          head — the placed-but-unACKed crash window) or doesn't know at
+          all (amnesiac head): mark it ALIVE at the reported address.
+          Adopted actors stay addressable and resource-accounted; their
+          name/spec died with the old head's tables.
+        - **orphan kill**: the head decided death (kill_actor, restart
+          budget) while the daemon was unreachable — reap the orphan.
+        - **prune + re-pend**: drop worker-directory rows the daemon
+          positively reports dead, and re-schedule CREATED placement
+          groups whose bundles this daemon no longer holds (a restarted
+          daemon's bundles evaporated with it)."""
+        summary = {"reaped": 0, "repinned": 0, "adopted": 0,
+                   "orphans_killed": 0, "workers_pruned": 0,
+                   "pgs_repending": 0}
+        reported = dict(state.get("actors") or {})
+        # Placements still IN FLIGHT on the daemon (worker forking, actor
+        # not yet in its table) are neither dead nor alive — leave them to
+        # resolve through actor_ready/actor_failed on the fresh session
+        # instead of reaping a booting actor.
+        placing = set(state.get("placing") or ())
+        for actor in list(self.actors.values()):
+            if actor.node_id != node_id:
+                continue
+            if actor.state in ("ALIVE", "PENDING", "RESTARTING") and \
+                    actor.actor_id not in reported and \
+                    actor.actor_id not in placing:
+                summary["reaped"] += 1
+                # DEFERRED: the death path may restart the actor, and its
+                # place_actor notify must hit the wire AFTER this
+                # register's reply — the daemon adopts the new head's
+                # boot id from that reply, and a placement arriving first
+                # would be fenced as stale-head traffic.
+                spawn_task(self._handle_actor_death(
+                    actor, "worker died during head outage"))
+        for aid, row in reported.items():
+            info = self.actors.get(aid)
+            addr = tuple(row.get("addr")) if row.get("addr") else None
+            if info is None:
+                info = ActorInfo(actor_id=aid, state="ALIVE",
+                                 node_id=node_id, worker_addr=addr)
+                self.actors[aid] = info
+                self._log_mutation("actor", aid, info)
+                summary["adopted"] += 1
+                continue
+            if info.state == "DEAD":
+                try:
+                    await conn.notify("kill_actor", actor_id=aid)
+                except Exception:
+                    pass
+                summary["orphans_killed"] += 1
+                continue
+            if info.state != "ALIVE" or (addr and info.worker_addr != addr):
+                info.node_id = node_id
+                if addr:
+                    info.worker_addr = addr
+                info.state = "ALIVE"
+                self._log_mutation("actor", aid, info)
+                await self.publish(
+                    "actor_events", actor_id=aid, state="ALIVE",
+                    addr=list(info.worker_addr) if info.worker_addr else None)
+                summary["repinned"] += 1
+        # Worker-directory rows are WAL-durable; rows for workers the
+        # daemon POSITIVELY knows died (its fate table) would otherwise
+        # serve stale pull referrals forever. Only positive knowledge
+        # prunes — the daemon can't enumerate driver processes on its
+        # node, so absence from its worker table proves nothing.
+        for wid in state.get("dead_workers") or ():
+            row = self.workers.get(wid)
+            if row is not None and (len(row) <= 2 or row[2] == node_id):
+                self.workers.pop(wid, None)
+                self._log_mutation("worker_del", wid)
+                summary["workers_pruned"] += 1
+        reported_bundles = {(b[0], int(b[1]))
+                            for b in (state.get("bundles") or ())}
+        for pg_id, pg in list(self.pgs.items()):
+            if pg.get("state") != "CREATED" or not pg.get("assignment"):
+                continue
+            assignment = pg["assignment"]
+            missing = [i for i, nid in enumerate(assignment)
+                       if nid == node_id
+                       and (pg_id, i) not in reported_bundles]
+            if not missing:
+                continue
+            pg["state"] = "PENDING"
+            pg["assignment"] = None
+            self._log_mutation("pg", pg_id, dict(pg))
+            summary["pgs_repending"] += 1
+            survivors = [i for i, nid in enumerate(assignment)
+                         if nid != node_id]
+            if survivors:
+                spawn_task(self._rollback_bundles(pg_id, assignment,
+                                                  survivors))
+            spawn_task(self._schedule_pg(pg_id))
+        for k, v in summary.items():
+            if v:
+                self._reconcile_totals[k] = \
+                    self._reconcile_totals.get(k, 0) + v
+        return summary
 
     async def _heartbeat(self, conn: ServerConnection, node_id: str, available: dict,
                          resources: dict | None = None,
                          pending_demands: list | None = None,
                          peers_version: int = -1):
         info = self.nodes.get(node_id)
-        if info is None:
+        if info is None or not info.alive or \
+                self._node_conns.get(node_id) is not conn:
+            # Unknown node (head restarted and lost membership), a node
+            # this head declared dead that turns out to be heartbeating
+            # again (partition healed before the daemon noticed anything),
+            # OR a heartbeat from a connection that is not the registered
+            # one — i.e. a daemon incarnation that never passed the
+            # register-time epoch fence (a superseded daemon un-pausing
+            # must not keep writing the node's resource view through the
+            # heartbeat side door). Either way: a plain heartbeat must NOT
+            # update state — the full registration path carries the epoch
+            # fence and the reconcile payload, so route the daemon there.
             return {"ok": False, "reregister": True}
         info.last_heartbeat = time.monotonic()
         info.available = available
@@ -581,6 +961,16 @@ class HeadServer:
         cfg = get_config()
         while True:
             await asyncio.sleep(cfg.health_check_period_s)
+            if _chaos.ACTIVE:
+                # ``boot`` scopes the drill to ONE head when several share
+                # an interpreter (in-process test clusters); an unscoped
+                # kill-head rule matches whichever head ticks first.
+                rule = _chaos.decide("head.tick", boot=self.boot_id)
+                if rule is not None and rule.action == "kill":
+                    _chaos.write_mark(rule, "head.tick",
+                                      {"boot": self.boot_id})
+                    await self._chaos_die()
+                    return
             now = time.monotonic()
             threshold = cfg.health_check_period_s * cfg.health_check_failure_threshold
             for node in list(self.nodes.values()):
@@ -644,11 +1034,23 @@ class HeadServer:
         lifetime: str = "non_detached",
         node_affinity: str | None = None, labels: dict | None = None,
         affinity_soft: bool = False, env_json: str = "",
+        req_id: str = "",
     ):
+        hit = self._dedup_get(req_id)
+        if hit is not None:
+            return hit
+        if actor_id in self.actors:
+            # Belt under the dedup braces: actor ids are client-unique, so
+            # a re-registration whose first attempt was WAL-logged but
+            # whose ACK died with the old head (and whose req_id aged out)
+            # must read as success, not as its own name squatting.
+            return self._dedup_put(req_id, {"ok": True, "existed": True})
         if name:
             key = (namespace, name)
             if key in self.named_actors:
-                return {"ok": False, "error": f"name {name!r} taken in {namespace!r}"}
+                return self._dedup_put(req_id, {
+                    "ok": False,
+                    "error": f"name {name!r} taken in {namespace!r}"})
         info = ActorInfo(
             actor_id=actor_id, spec_blob=spec_blob, resources=dict(resources),
             name=name, namespace=namespace, max_restarts=max_restarts,
@@ -669,8 +1071,9 @@ class HeadServer:
             # after a crash would resurrect an actor that can never run
             # (and leave its name squatting in named_actors).
             self._log_mutation("actor", actor_id, info)
-            return {"ok": False, "error": "no feasible node for actor resources"}
-        return {"ok": True}
+            return self._dedup_put(req_id, {
+                "ok": False, "error": "no feasible node for actor resources"})
+        return self._dedup_put(req_id, {"ok": True})
 
     def _pick_node(self, resources: dict[str, float], node_affinity: str | None = None,
                    labels: dict | None = None) -> NodeInfo | None:
@@ -730,9 +1133,13 @@ class HeadServer:
             node.optimistic[k] = node.optimistic.get(k, 0.0) + v
         # Ask the node daemon to place the actor in a fresh/pooled worker
         # (reference: GcsActorScheduler leases a worker from the raylet).
+        # head_boot rides along so a daemon that has since registered with
+        # a NEWER head can fence a stale head's placement instead of
+        # double-allocating the worker.
         await conn.notify(
             "place_actor", actor_id=info.actor_id, spec_blob=info.spec_blob,
             resources=info.resources, env_json=info.env_json,
+            head_boot=self.boot_id,
         )
         return True
 
@@ -741,12 +1148,44 @@ class HeadServer:
         info = self.actors.get(actor_id)
         if info is None:
             return {"ok": False}
+        if info.state == "DEAD":
+            # A placement that lost its race: the actor was killed or
+            # reaped (reconcile, kill_actor) while its worker was still
+            # booting. Resurrecting here would run a DEAD actor — whose
+            # name may already be released — on a zombie worker. Reap it.
+            node_id = conn.meta.get("node_id") or info.node_id
+            nconn = self._node_conns.get(node_id) if node_id else None
+            if nconn is not None:
+                try:
+                    await nconn.notify("kill_actor", actor_id=actor_id)
+                except Exception:
+                    pass
+            return {"ok": False, "dead": True}
         info.worker_addr = (host, port)
         info.state = "ALIVE"
         self._log_mutation("actor", actor_id, info)
         await self.publish("actor_events", actor_id=actor_id, state="ALIVE",
                            addr=[host, port])
         return {"ok": True}
+
+    async def _placement_fenced(self, conn: ServerConnection,
+                                actor_id: str):
+        """A daemon refused a place_actor as stale-head traffic. If the
+        placement was actually OURS — a reconcile-restart's notify racing
+        the daemon's boot-id adoption on its register reply — the actor
+        is still PENDING/RESTARTING here: re-issue it now that the daemon
+        knows our boot id. A placement from a genuinely dead head finds
+        no matching pending actor and is a no-op."""
+        info = self.actors.get(actor_id)
+        node_id = conn.meta.get("node_id")
+        if info is None or info.state not in ("PENDING", "RESTARTING") or \
+                (node_id and info.node_id and info.node_id != node_id):
+            return {"ok": False}
+        ok = await self._schedule_actor(info)
+        if not ok:
+            await self._handle_actor_death(
+                info, "placement fenced and no feasible node remained")
+        return {"ok": ok}
 
     async def _actor_failed(self, conn: ServerConnection, actor_id: str, reason: str):
         info = self.actors.get(actor_id)
@@ -821,6 +1260,9 @@ class HeadServer:
                 pass
             self._daemon_clients.pop(node_id, None)
         cli = AsyncRpcClient(*info.addr)
+        # Chaos partition probe: this client carries head→node traffic.
+        cli.partition_node = node_id
+        cli.partition_send = "from_head"
         await cli.connect()
         self._daemon_clients[node_id] = (info.addr, cli)
         return cli
@@ -881,7 +1323,16 @@ class HeadServer:
         return assignment
 
     async def _create_pg(self, conn: ServerConnection, pg_id: str,
-                         bundles: list, strategy: str, name: str | None = None):
+                         bundles: list, strategy: str, name: str | None = None,
+                         req_id: str = ""):
+        hit = self._dedup_get(req_id)
+        if hit is not None:
+            return hit
+        if pg_id in self.pgs:
+            # Retried creation (pg ids are client-unique): report current
+            # state instead of resetting a PG that may already be CREATED.
+            return self._dedup_put(
+                req_id, {"ok": True, "state": self.pgs[pg_id]["state"]})
         self.pgs[pg_id] = {"state": "PENDING", "bundles": bundles,
                            "strategy": strategy, "assignment": None,
                            "name": name}
@@ -896,7 +1347,8 @@ class HeadServer:
             await asyncio.wait_for(asyncio.shield(task), timeout=0.25)
         except Exception:  # noqa: BLE001 - timeout: scheduling continues
             pass
-        return {"ok": True, "state": self.pgs[pg_id]["state"]}
+        return self._dedup_put(
+            req_id, {"ok": True, "state": self.pgs[pg_id]["state"]})
 
     async def _schedule_pg(self, pg_id: str, retries: int = 120):
         pg = self.pgs[pg_id]
@@ -921,7 +1373,8 @@ class HeadServer:
                     try:
                         cli = await self._daemon_rpc(nid)
                         res = await cli.call(
-                            "prepare_commit_bundles", pg_id=pg_id,
+                            "prepare_commit_bundles", timeout=30,
+                            pg_id=pg_id,
                             bundle_indices=idxs,
                             resources_list=[pg["bundles"][i] for i in idxs])
                         ok = bool(res.get("ok"))
@@ -947,7 +1400,7 @@ class HeadServer:
                     try:
                         cli = await self._daemon_rpc(nid)
                         res = await cli.call(
-                            "prepare_bundles", pg_id=pg_id,
+                            "prepare_bundles", timeout=30, pg_id=pg_id,
                             bundle_indices=idxs,
                             resources_list=[pg["bundles"][i] for i in idxs])
                         return list(res.get("prepared") or []), \
@@ -972,7 +1425,8 @@ class HeadServer:
                     try:
                         async def _commit_node(nid: str, idxs: list[int]):
                             cli = await self._daemon_rpc(nid)
-                            await cli.call("commit_bundles", pg_id=pg_id,
+                            await cli.call("commit_bundles", timeout=30,
+                                           pg_id=pg_id,
                                            bundle_indices=idxs)
 
                         # return_exceptions: every node's coroutine runs to
@@ -1016,12 +1470,16 @@ class HeadServer:
         for nid, idxs in by_node.items():
             try:
                 cli = await self._daemon_rpc(nid)
-                await cli.call("return_bundles", pg_id=pg_id,
+                await cli.call("return_bundles", timeout=30, pg_id=pg_id,
                                bundle_indices=idxs)
             except Exception:
                 pass
 
-    async def _remove_pg(self, conn: ServerConnection, pg_id: str):
+    async def _remove_pg(self, conn: ServerConnection, pg_id: str,
+                         req_id: str = ""):
+        # No dedup-table read needed: removal is naturally idempotent
+        # (a second remove of a gone PG is a no-op success) — but the
+        # req_id still rides in so the retry wrapper may stamp it.
         pg = self.pgs.get(pg_id)
         if pg is None:
             return {"ok": True}
@@ -1054,15 +1512,19 @@ class HeadServer:
     # until job GC). Eviction is deliberately absent — submitters cache
     # "already exported" per process, so dropping a blob would permanently
     # fail their in-flight specs. Job-scoped GC is the right future fix.
-    async def _fn_put(self, conn: ServerConnection, fn_id: str, blob: bytes):
+    async def _fn_put(self, conn: ServerConnection, fn_id: str, blob: bytes,
+                      req_id: str = ""):
+        hit = self._dedup_get(req_id)
+        if hit is not None:
+            return hit
         table = self.kv.setdefault(FN_NS, {})
         if fn_id in table:
             self.fn_stats["dup_puts"] += 1
-            return {"ok": True, "existed": True}
+            return self._dedup_put(req_id, {"ok": True, "existed": True})
         table[fn_id] = blob
         self.fn_stats["puts"] += 1
         self._log_mutation("kv_put", FN_NS, fn_id, blob)
-        return {"ok": True, "existed": False}
+        return self._dedup_put(req_id, {"ok": True, "existed": False})
 
     async def _fn_get(self, conn: ServerConnection, fn_id: str):
         blob = self.kv.get(FN_NS, {}).get(fn_id)
@@ -1075,22 +1537,29 @@ class HeadServer:
     # (reference: gcs_kv_manager.cc internal KV — function/code storage, serve
     # config, usage flags all live here)
     async def _kv_put(self, conn: ServerConnection, ns: str, key: str, value: bytes,
-                      overwrite: bool = True):
+                      overwrite: bool = True, req_id: str = ""):
+        hit = self._dedup_get(req_id)
+        if hit is not None:
+            return hit
         table = self.kv.setdefault(ns, {})
         if not overwrite and key in table:
-            return {"ok": False}
+            return self._dedup_put(req_id, {"ok": False})
         table[key] = value
         self._log_mutation("kv_put", ns, key, value)
-        return {"ok": True}
+        return self._dedup_put(req_id, {"ok": True})
 
     async def _kv_get(self, conn: ServerConnection, ns: str, key: str):
         return {"value": self.kv.get(ns, {}).get(key)}
 
-    async def _kv_del(self, conn: ServerConnection, ns: str, key: str):
+    async def _kv_del(self, conn: ServerConnection, ns: str, key: str,
+                      req_id: str = ""):
+        hit = self._dedup_get(req_id)
+        if hit is not None:
+            return hit
         existed = self.kv.get(ns, {}).pop(key, None) is not None
         if existed:
             self._log_mutation("kv_del", ns, key)
-        return {"ok": existed}
+        return self._dedup_put(req_id, {"ok": existed})
 
     async def _kv_keys(self, conn: ServerConnection, ns: str, prefix: str = ""):
         return {"keys": [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]}

@@ -12,6 +12,7 @@ src/ray/ray_syncer/, here: heartbeats carry the availability view).
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import subprocess
 import sys
@@ -20,8 +21,43 @@ import uuid
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
-from ray_tpu.core.cluster.protocol import AsyncRpcClient, RpcServer, ServerConnection
+from ray_tpu.core.cluster.protocol import (
+    AsyncRpcClient,
+    RpcError,
+    RpcServer,
+    ServerConnection,
+)
 from ray_tpu.utils.config import get_config
+
+logger = logging.getLogger("ray_tpu.node_daemon")
+
+# Head-connectivity observability (shared across co-hosted daemons in one
+# interpreter — the registry is process-wide, so register once):
+# head_reconnects_total counts completed re-register cycles, head_connected
+# is the live link state per node (the dashboard/watchdog read on flapping).
+_hd_metrics = None
+
+
+def _head_metrics():
+    global _hd_metrics
+    if _hd_metrics is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        _hd_metrics = {
+            "reconnects": Counter(
+                "head_reconnects_total",
+                "completed daemon re-registrations after losing the head "
+                "connection", tag_keys=("node",)),
+            "connected": Gauge(
+                "head_connected",
+                "1 while this daemon's head link is registered and "
+                "heartbeating, 0 while reconnecting", tag_keys=("node",)),
+            "fenced": Counter(
+                "head_fenced_total",
+                "stale-head placements or stale-epoch registrations this "
+                "daemon fenced off", tag_keys=("node",)),
+        }
+    return _hd_metrics
 
 
 @dataclass
@@ -79,7 +115,16 @@ _process_telemetry_owner: str | None = None
 _capture_claims: "OrderedDict[str, str]" = OrderedDict()
 
 
+from ray_tpu.devtools.annotations import loop_confined
+
+
+@loop_confined
 class NodeDaemon:
+    # Every method runs on the daemon's event loop (RPC handlers, the
+    # background loops, and the head-client notify callbacks alike), so
+    # the lease-dedup / dead-worker / head-session tables need no locks —
+    # declared for rtlint, which otherwise presumes external callers.
+
     # Consecutive container-worker boot failures per env before pending
     # leases for that env are failed with a diagnostic (instead of
     # crash-forking the runner forever while the client blocks).
@@ -132,8 +177,37 @@ class NodeDaemon:
         # the heartbeat loop once the head answers again.
         self._failed_actor_notify: list[tuple[str, str]] = []
         self._head: AsyncRpcClient | None = None
+        # Daemon incarnation epoch: rides every register_node so the head
+        # can fence a STALE resurrection of this node id (an older daemon
+        # un-wedging after its replacement registered) instead of handing
+        # the node's resources to two incarnations at once.
+        self._epoch = time.time()
+        # Head session as this daemon last registered it: boot_id fences
+        # stale-head placements (a superseded head's place_actor must not
+        # double-allocate a worker); incarnation feeds `ray_tpu status`.
+        self._head_boot_id: str | None = None
+        self._head_incarnation = 0
+        # Link-state for the once-per-transition reconnect logging and the
+        # head_connected gauge (a wedged head flaps this, and the watchdog
+        # must see the flapping, not a log line per retry).
+        self._head_connected = True
+        self._head_reconnects = 0
+        self._fenced = False  # this incarnation was superseded: stand down
         self._leases: dict[str, WorkerProc] = {}
         self._actor_workers: dict[str, WorkerProc] = {}
+        # Batched-lease exactly-once: req_id -> grant reply. A submitter
+        # whose lease RPC's reply died with the connection retries with
+        # the same id; replaying the recorded grants instead of granting
+        # fresh workers keeps the retry from leaking leases. Bounded.
+        self._lease_dedup: "OrderedDict[str, dict]" = OrderedDict()
+        # Workers this daemon positively knows died (exit observed by the
+        # reap/death-watch loops). Reported at (re-)registration so the
+        # head prunes their WAL-durable directory rows. Bounded FIFO.
+        self._dead_workers: "OrderedDict[str, float]" = OrderedDict()
+        # Actor placements currently inside _place_actor (worker forking,
+        # actor not yet in _actor_workers). Reported at registration so a
+        # reconcile doesn't reap a merely-booting actor.
+        self._placing: set[str] = set()
         # 2PC bundle bookkeeping: (pg_id, bundle_index) -> resources
         self._prepared_bundles: dict[tuple[str, int], dict] = {}
         self._committed_bundles: dict[tuple[str, int], tuple[dict, dict]] = {}
@@ -274,17 +348,9 @@ class NodeDaemon:
 
     async def start(self) -> tuple[str, int]:
         addr = await self.rpc.start()
-        self._head = AsyncRpcClient(*self.head_addr)
+        self._head = self._make_head_client()
         await self._head.connect()
-        self._head.on_notify("place_actor", self._place_actor)
-        self._head.on_notify("kill_actor", self._kill_actor)
-        await self._head.call(
-            "register_node", node_id=self.node_id, host=addr[0], port=addr[1],
-            resources=self.resources, labels=self.labels,
-            transfer_addr=(list(self.transfer_addr)
-                           if self.transfer_addr else None),
-            object_plane=self._object_plane_info(),
-        )
+        await self._register_with_head(self._head)
         loop = asyncio.get_running_loop()
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._reap_loop()))
@@ -427,6 +493,7 @@ class NodeDaemon:
         the caller that removes the entry runs the handling)."""
         if self.workers.pop(wid, None) is None:
             return
+        self._note_dead_worker(wid)
         if w.lease_id or w.actor_id:
             from ray_tpu.core import flight_recorder
 
@@ -927,7 +994,16 @@ class NodeDaemon:
         from ray_tpu.chaos import injector as _chaos
 
         cfg = get_config()
+        # Heartbeat RPC timeout: a partition-DROPPED frame produces no
+        # connection error — without a bound the await would wedge this
+        # loop forever and the daemon would never enter its reconnect
+        # path even after the partition healed.
+        hb_timeout = cfg.daemon_heartbeat_timeout_s
         while True:
+            if self._fenced:
+                # Superseded incarnation: a newer daemon owns this node id.
+                # Heartbeating on would fight it for the registration.
+                return
             if _chaos.ACTIVE:
                 rule = _chaos.decide("daemon.tick", node=self.node_id)
                 if rule is not None and rule.action == "kill":
@@ -938,6 +1014,7 @@ class NodeDaemon:
             try:
                 res = await self._head.call(
                     "heartbeat", node_id=self.node_id,
+                    timeout=hb_timeout if hb_timeout > 0 else None,
                     available=self.available, resources=self.resources,
                     # Pending lease demands feed the autoscaler (reference:
                     # raylet reports resource load to GcsResourceManager for
@@ -947,6 +1024,16 @@ class NodeDaemon:
                                      if not r.fut.done()
                                      for _ in range(max(1, r.remaining))],
                     peers_version=self._gossip_peers_version)
+                if res.get("reregister"):
+                    # The head answered but doesn't know us: it restarted
+                    # (nodes aren't snapshotted — membership is rebuilt
+                    # from live daemons). Re-register on THIS connection
+                    # with the full reconcile payload.
+                    await self._register_with_head(self._head)
+                    if self._fenced:
+                        return
+                else:
+                    self._mark_head_connected(True)
                 # Authoritative membership for the gossip ring (view data
                 # itself travels daemon-to-daemon, not through the head):
                 # wholesale replacement prunes dead/drained nodes from the
@@ -962,10 +1049,13 @@ class NodeDaemon:
                             self._gossip_view.pop(nid, None)
                 if self._failed_actor_notify:
                     await self._drain_actor_failures()
-            except Exception:
-                # Head down/restarted: reconnect and re-register so a
-                # restarted control plane rebuilds its node view (reference:
-                # raylet HandleNotifyGCSRestart, node_manager.cc:1050).
+            except (OSError, RpcError, asyncio.TimeoutError, TimeoutError):
+                # Head down/restarted/partitioned: reconnect and
+                # re-register so a restarted control plane rebuilds its
+                # node view (reference: raylet HandleNotifyGCSRestart,
+                # node_manager.cc:1050). Narrow on connection-shaped
+                # failures — a programming error in the try block must
+                # surface, not be eaten as "head down".
                 await self._reconnect_head()
             await asyncio.sleep(cfg.health_check_period_s / 2)
 
@@ -1062,26 +1152,139 @@ class NodeDaemon:
             }
         return out
 
-    async def _reconnect_head(self) -> None:
+    def _make_head_client(self) -> AsyncRpcClient:
+        client = AsyncRpcClient(*self.head_addr)
+        # Chaos partition probe: this client carries node→head traffic
+        # (and receives head→node pushes on its read side).
+        client.partition_node = self.node_id
+        client.partition_send = "to_head"
+        client.on_notify("place_actor", self._place_actor)
+        client.on_notify("kill_actor", self._kill_actor)
+        return client
+
+    def _register_state(self) -> dict:
+        """This daemon's live inventory, shipped with register_node so the
+        head reconciles its WAL-replayed tables against ground truth:
+        actual availability (leases granted/returned during an outage),
+        live + in-flight actors, positively-dead workers, and committed
+        PG bundles. Only keys the head's _reconcile_node consumes ride
+        the wire — the re-register stampede after a head restart is
+        exactly when the head is most loaded."""
+        def alive(w: WorkerProc) -> bool:
+            return w.proc is None or w.proc.poll() is None
+
+        return {
+            "available": dict(self.available),
+            "dead_workers": list(self._dead_workers),
+            "actors": {
+                aid: {"worker_id": w.worker_id, "addr": list(w.addr)}
+                for aid, w in self._actor_workers.items()
+                if w.addr is not None and alive(w)
+            },
+            "placing": list(self._placing),
+            "bundles": [[pg_id, idx]
+                        for (pg_id, idx) in self._committed_bundles],
+        }
+
+    def _note_dead_worker(self, worker_id: str) -> None:
+        if not worker_id:
+            return
+        self._dead_workers[worker_id] = time.monotonic()
+        while len(self._dead_workers) > 256:
+            self._dead_workers.popitem(last=False)
+
+    def _mark_head_connected(self, up: bool) -> None:
+        """Flip the link-state gauge and log ONCE per transition — a
+        flapping head must show as metric movement, not a log line per
+        retry attempt."""
+        if up == self._head_connected:
+            return
+        self._head_connected = up
+        short = self.node_id[:8]
+        if up:
+            logger.info("node %s: head connection restored "
+                        "(reconnects=%d, head incarnation=%d)",
+                        short, self._head_reconnects,
+                        self._head_incarnation)
+        else:
+            logger.warning("node %s: lost head connection at %s:%s; "
+                           "re-registering with backoff", short,
+                           self.head_addr[0], self.head_addr[1])
         try:
-            client = AsyncRpcClient(*self.head_addr)
-            await client.connect()
-            client.on_notify("place_actor", self._place_actor)
-            client.on_notify("kill_actor", self._kill_actor)
-            await client.call(
-                "register_node", node_id=self.node_id, host=self.rpc.host,
-                port=self.rpc.port, resources=self.resources,
-                labels=self.labels,
-                transfer_addr=(list(self.transfer_addr)
-                               if self.transfer_addr else None),
-                object_plane=self._object_plane_info())
-            old, self._head = self._head, client
+            _head_metrics()["connected"].set(
+                1.0 if up else 0.0, tags={"node": short})
+        except Exception:  # noqa: BLE001 - metrics must not kill the loop
+            pass
+
+    async def _register_with_head(self, client: AsyncRpcClient) -> bool:
+        """One register_node round trip carrying epoch + live state;
+        adopts the head's session identity from the reply. Returns False
+        (and stands the daemon down) when the head fenced this daemon as
+        a stale incarnation."""
+        res = await client.call(
+            "register_node", node_id=self.node_id, host=self.rpc.host,
+            port=self.rpc.port, resources=self.resources,
+            labels=self.labels,
+            transfer_addr=(list(self.transfer_addr)
+                           if self.transfer_addr else None),
+            object_plane=self._object_plane_info(),
+            epoch=self._epoch, state=self._register_state(),
+            timeout=get_config().daemon_heartbeat_timeout_s or None)
+        if isinstance(res, dict) and res.get("fenced"):
+            # A newer incarnation of this node id owns the registration:
+            # this daemon is the stale survivor of a partition/pause.
+            # Stand down rather than fight over the node's resources.
+            self._fenced = True
+            logger.warning(
+                "node %s: registration fenced by the head (a newer daemon "
+                "incarnation owns this node id); standing down",
+                self.node_id[:8])
             try:
-                await old.close()
+                _head_metrics()["fenced"].inc(
+                    tags={"node": self.node_id[:8]})
             except Exception:
                 pass
-        except Exception:
-            pass  # still down; next heartbeat retries
+            return False
+        if isinstance(res, dict):
+            self._head_boot_id = res.get("boot_id") or self._head_boot_id
+            self._head_incarnation = int(
+                res.get("incarnation") or self._head_incarnation)
+        self._mark_head_connected(True)
+        return True
+
+    async def _reconnect_head(self) -> None:
+        """Re-register after losing the head: connect a fresh client and
+        run the full registration (epoch + live-state reconcile payload).
+        Connection-shaped failures are EXPECTED while the head is down —
+        they keep the retry loop alive silently (the transition was logged
+        once by _mark_head_connected); anything else is a real bug and
+        propagates to the heartbeat loop's logger."""
+        self._mark_head_connected(False)
+        client = self._make_head_client()
+        try:
+            await client.connect()
+            if not await self._register_with_head(client):
+                await client.close()
+                return
+        except (OSError, RpcError, asyncio.TimeoutError, TimeoutError):
+            # Head still down / partition still dropping frames: next
+            # heartbeat tick retries. Close the half-open client.
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001 - already dead
+                pass
+            return
+        self._head_reconnects += 1
+        try:
+            _head_metrics()["reconnects"].inc(
+                tags={"node": self.node_id[:8]})
+        except Exception:  # noqa: BLE001 - metrics must not kill the loop
+            pass
+        old, self._head = self._head, client
+        try:
+            await old.close()
+        except Exception:  # noqa: BLE001 - already dead
+            pass
 
     # ------------------------------------------------------------------ leases
     # reference protocol: HandleRequestWorkerLease → grant | spillback;
@@ -1100,12 +1303,44 @@ class NodeDaemon:
         for k, v in demand.items():
             self.available[k] = self.available.get(k, 0.0) + v
 
+    def _lease_dedup_get(self, req_id: str) -> dict | None:
+        if not req_id:
+            return None
+        hit = self._lease_dedup.get(req_id)
+        if hit is None:
+            return None
+        # Replay only while every recorded lease is still LIVE: if the
+        # submitter already returned them (dead-on-arrival adoption) or a
+        # reaper freed them, the record is stale and a fresh grant is the
+        # right answer — replaying would hand out leases nobody holds.
+        for g in hit.get("grants") or ():
+            if g["lease_id"] not in self._leases:
+                self._lease_dedup.pop(req_id, None)
+                return None
+        return hit
+
+    def _lease_dedup_put(self, req_id: str, res: dict) -> dict:
+        """Record a lease reply that GRANTED something: a submitter whose
+        reply died with the connection retries with the same request id,
+        and replaying the recorded grants (instead of granting fresh
+        workers) keeps the retry from leaking the first batch's leases."""
+        if req_id and res.get("grants"):
+            self._lease_dedup[req_id] = res
+            while len(self._lease_dedup) > 512:
+                self._lease_dedup.popitem(last=False)
+        return res
+
     async def _request_lease(self, conn: ServerConnection, resources: dict,
                              timeout: float | None = None, env_hash: str = "",
-                             allow_spill: bool = True, owner: str = ""):
+                             allow_spill: bool = True, owner: str = "",
+                             req_id: str = ""):
         """Single-lease RPC (legacy shape): one grant dict, or spill/error."""
+        hit = self._lease_dedup_get(req_id)
+        if hit is not None:
+            return hit["grants"][0]
         res = await self._lease_common(resources, 1, timeout, env_hash,
                                        allow_spill, owner)
+        self._lease_dedup_put(req_id, res)
         grants = res.get("grants")
         if grants:
             return grants[0]
@@ -1114,7 +1349,7 @@ class NodeDaemon:
     async def _lease_workers(self, conn: ServerConnection, resources: dict,
                              count: int = 1, timeout: float | None = None,
                              env_hash: str = "", allow_spill: bool = True,
-                             owner: str = ""):
+                             owner: str = "", req_id: str = ""):
         """Batched lease RPC: grant up to ``count`` workers in ONE round
         trip (reference: the raylet grants one worker per
         RequestWorkerLease; the per-RPC pump serialized multi-client
@@ -1122,9 +1357,13 @@ class NodeDaemon:
         in hand — the submitter re-requests the remainder while forked
         workers boot — so batch latency tracks the FIRST available worker,
         not the last."""
+        hit = self._lease_dedup_get(req_id)
+        if hit is not None:
+            return hit
         count = max(1, min(int(count), get_config().lease_batch_max))
-        return await self._lease_common(resources, count, timeout, env_hash,
-                                        allow_spill, owner)
+        res = await self._lease_common(resources, count, timeout, env_hash,
+                                       allow_spill, owner)
+        return self._lease_dedup_put(req_id, res)
 
     async def _lease_common(self, resources: dict, count: int,
                             timeout: float | None, env_hash: str,
@@ -1215,7 +1454,7 @@ class NodeDaemon:
             if best is not None:
                 return best
         try:
-            nodes = await self._head.call("list_nodes")
+            nodes = await self._head.call("list_nodes", timeout=10)
         except Exception:
             return None
         return self._spill_target(nodes, resources, key=key)
@@ -1459,7 +1698,7 @@ class NodeDaemon:
             try:
                 await self._head.call("heartbeat", node_id=self.node_id,
                                       available=self.available,
-                                      resources=self.resources)
+                                      resources=self.resources, timeout=10)
             except Exception:
                 pass
 
@@ -1527,17 +1766,47 @@ class NodeDaemon:
 
     # ------------------------------------------------------------------ actors
     async def _place_actor(self, actor_id: str, spec_blob: bytes,
-                           resources: dict, env_json: str = ""):
+                           resources: dict, env_json: str = "",
+                           head_boot: str = ""):
+        # Stale-head fence: a placement from a head boot we have since
+        # been superseded on (partition heal races, a dying head's last
+        # notify landing after the daemon re-registered with its
+        # replacement) must not double-allocate a worker — the current
+        # head re-issues placements from its own reconciled tables.
+        if head_boot and self._head_boot_id and \
+                head_boot != self._head_boot_id:
+            logger.warning(
+                "node %s: fenced place_actor(%s) from stale head boot %s "
+                "(current %s)", self.node_id[:8], actor_id[:8],
+                head_boot[:8], self._head_boot_id[:8])
+            try:
+                _head_metrics()["fenced"].inc(
+                    tags={"node": self.node_id[:8]})
+            except Exception:
+                pass
+            # Tell the CURRENT head: if the fenced placement was actually
+            # its own (a reconcile-restart's notify racing this daemon's
+            # boot-id adoption on the register reply), it re-issues and
+            # the actor converges instead of sticking in RESTARTING; a
+            # genuinely-stale dead head's placement is a no-op there.
+            try:
+                await self._head.call("placement_fenced",
+                                      actor_id=actor_id, timeout=10)
+            except Exception:  # noqa: BLE001 - best-effort convergence
+                pass
+            return
         # Dedicated worker per actor (reference: actor creation leases a worker
         # which then becomes the actor's home for its lifetime).
         from ray_tpu.runtime_env.container import container_spec
 
         container = container_spec(env_json)
+        self._placing.add(actor_id)
         try:
             if not self._fits(resources):
                 if not self._feasible(resources):
                     await self._head.call("actor_failed", actor_id=actor_id,
-                                          reason="infeasible on assigned node")
+                                          reason="infeasible on assigned node",
+                                          timeout=10)
                     return
                 # wait for resources to free up
                 for _ in range(200):
@@ -1546,7 +1815,8 @@ class NodeDaemon:
                         break
                 else:
                     await self._head.call("actor_failed", actor_id=actor_id,
-                                          reason="timed out waiting for resources")
+                                          reason="timed out waiting for resources",
+                                          timeout=10)
                     return
             # Actors get a pristine worker: the creation spec's runtime_env
             # is applied by init_actor, and the worker is dedicated until
@@ -1570,7 +1840,8 @@ class NodeDaemon:
                         break
                 else:
                     await self._head.call("actor_failed", actor_id=actor_id,
-                                          reason="worker start timeout")
+                                          reason="worker start timeout",
+                                          timeout=10)
                     return
             w.actor_id = actor_id
             w.resources = dict(resources)
@@ -1582,20 +1853,33 @@ class NodeDaemon:
                                        spec_blob=spec_blob)
             await client.close()
             if result.get("ok"):
-                await self._head.call("actor_ready", actor_id=actor_id,
-                                      worker_id=w.worker_id,
-                                      host=w.addr[0], port=w.addr[1])
+                try:
+                    await self._head.call("actor_ready", actor_id=actor_id,
+                                          worker_id=w.worker_id,
+                                          host=w.addr[0], port=w.addr[1],
+                                          timeout=10)
+                except Exception:  # noqa: BLE001 - the un-ACKed-grant window
+                    # Head died/partitioned between placement and ACK: the
+                    # actor IS running. Keep it placed — the reconcile
+                    # payload of the next registration re-pins it ALIVE
+                    # (reporting actor_failed here would kill a healthy
+                    # actor for a control-plane blip).
+                    pass
             else:
                 self._release_resources(resources)
                 w.actor_id = None
                 await self._head.call("actor_failed", actor_id=actor_id,
-                                      reason=result.get("error", "init failed"))
+                                      reason=result.get("error", "init failed"),
+                                      timeout=10)
         except Exception as e:  # noqa: BLE001
             try:
                 await self._head.call("actor_failed", actor_id=actor_id,
-                                      reason=f"placement error: {e}")
+                                      reason=f"placement error: {e}",
+                                      timeout=10)
             except Exception:
                 pass
+        finally:
+            self._placing.discard(actor_id)
 
     async def _kill_actor(self, actor_id: str):
         w = self._actor_workers.pop(actor_id, None)
@@ -1605,6 +1889,7 @@ class NodeDaemon:
         if w.proc is not None:
             w.proc.terminate()
         self.workers.pop(w.worker_id, None)
+        self._note_dead_worker(w.worker_id)
 
 
 async def run_node_daemon(head_host, head_port, node_id, resources, labels=None,

@@ -18,6 +18,7 @@ import itertools
 import socket
 import struct
 import threading
+import time
 from typing import Any, Awaitable, Callable
 
 import msgpack
@@ -317,6 +318,24 @@ class AsyncRpcClient:
         self._cw: _CoalescingWriter | None = None
         self._notify_handlers: dict[str, Callable[..., Awaitable[None]]] = {}
         self._closed = False
+        # Chaos partition probe (ray_tpu/chaos partition point): when this
+        # client carries head⇄node traffic, ``partition_node`` names the
+        # node end and ``partition_send`` the direction its outbound frames
+        # travel ("to_head" for a daemon's head link, "from_head" for the
+        # head's per-daemon clients). Inbound frames probe the opposite
+        # direction. None (the default) = no probe, zero hot-path cost
+        # beyond the module ACTIVE flag read.
+        self.partition_node: str | None = None
+        self.partition_send: str | None = None
+
+    def _partition_act(self, direction: str) -> tuple[str, float] | None:
+        if not _chaos.ACTIVE or self.partition_node is None:
+            return None
+        return _chaos.partition_action(self.partition_node, direction)
+
+    @property
+    def _partition_recv_dir(self) -> str:
+        return "from_head" if self.partition_send == "to_head" else "to_head"
 
     def on_notify(self, method: str, fn: Callable[..., Awaitable[None]]):
         self._notify_handlers[method] = fn
@@ -335,6 +354,18 @@ class AsyncRpcClient:
             if msg is None:
                 self._fail_all(RpcConnectionLost(f"connection to {self.host}:{self.port} lost"))
                 return
+            if _chaos.ACTIVE and self.partition_node is not None:
+                # Inbound leg of a directional head⇄node partition: a
+                # matched frame is silently discarded (the peer believes it
+                # answered; the caller sees a hang — lost-datagram
+                # semantics, the connection itself stays up) or stalled
+                # inline (frames queued behind it wait too, like a
+                # congested link).
+                act = self._partition_act(self._partition_recv_dir)
+                if act is not None:
+                    if act[0] == "drop":
+                        continue
+                    await asyncio.sleep(act[1])
             if "r" in msg:
                 fut = self._pending.pop(msg["r"], None)
                 if fut is not None and not fut.done():
@@ -357,12 +388,29 @@ class AsyncRpcClient:
     async def call(self, method: str, timeout: float | None = None, **kwargs) -> Any:
         if self._closed:
             raise RpcConnectionLost("client closed", sent=False)
+        dropped = False
+        if _chaos.ACTIVE and self.partition_node is not None:
+            act = self._partition_act(self.partition_send or "to_head")
+            if act is not None:
+                if act[0] == "drop":
+                    dropped = True  # register the future, never send: the
+                    # caller waits out its timeout, as for a lost datagram.
+                    # A caller WITHOUT a timeout gets a bounded one forced
+                    # on it — an un-timed dropped frame would otherwise
+                    # wedge its await forever, surviving even a heal (no
+                    # retransmit exists at this layer), e.g. the head's
+                    # PG 2PC task stuck past `chaos clear`.
+                    if timeout is None:
+                        timeout = 30.0
+                else:
+                    await asyncio.sleep(act[1])
         rid = next(self._seq)
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         try:
-            self._cw.write(_pack({"m": method, "i": rid, "a": kwargs}))
-            await self._cw.maybe_drain()
+            if not dropped:
+                self._cw.write(_pack({"m": method, "i": rid, "a": kwargs}))
+                await self._cw.maybe_drain()
         except (ConnectionResetError, BrokenPipeError, OSError) as e:
             self._pending.pop(rid, None)
             raise RpcConnectionLost(f"send failed: {e}", sent=False)
@@ -423,6 +471,12 @@ class AsyncRpcClient:
         return futs
 
     async def notify(self, method: str, **kwargs):
+        if _chaos.ACTIVE and self.partition_node is not None:
+            act = self._partition_act(self.partition_send or "to_head")
+            if act is not None:
+                if act[0] == "drop":
+                    return  # one-way frame lost on the severed link
+                await asyncio.sleep(act[1])
         self._cw.write(_pack({"m": method, "a": kwargs}))
         await self._cw.maybe_drain()
 
@@ -548,6 +602,71 @@ class RpcClient:
                 raise
             self._reconnect()
             return self._call_once(method, timeout, kwargs)
+
+    # Per-attempt wait while retrying: long enough that a healthy server's
+    # slowest control RPC answers, short enough that a partition-dropped
+    # frame doesn't eat the whole retry budget on one attempt.
+    RETRY_ATTEMPT_TIMEOUT_S = 10.0
+
+    def call_retrying(self, method: str, timeout: float | None = None,
+                      req_id: str | None = None, idempotent: bool = False,
+                      budget_s: float | None = None, **kwargs) -> Any:
+        """Head-session-aware call: survives server crashes, restarts, and
+        partitions with full-jitter exponential backoff, capped by a total
+        deadline (``budget_s``, default config ``head_retry_budget_s``).
+
+        Safe-retry contract — a lost connection after the request was SENT
+        means it may have executed, so blind re-sends double-run
+        non-idempotent RPCs. This wrapper therefore retries sent/timed-out
+        attempts only when the caller declares them safe:
+
+        - ``req_id``: a client-stamped request id forwarded to the server,
+          whose WAL-backed dedup table turns the retry into exactly-once
+          (head mutations: register_actor, kv/fn puts, PG create/remove).
+        - ``idempotent=True``: the RPC is a pure read or naturally
+          idempotent (same-row register_worker, subscribe).
+
+        With neither, sent-failures surface exactly like :meth:`call`.
+        ``timeout`` bounds each ATTEMPT (default RETRY_ATTEMPT_TIMEOUT_S);
+        the budget bounds the whole retry loop."""
+        import random
+
+        from ray_tpu.utils.config import get_config
+
+        cfg = get_config()
+        if budget_s is None:
+            budget_s = cfg.head_retry_budget_s
+        if req_id is not None:
+            kwargs["req_id"] = req_id
+        retry_sent = idempotent or req_id is not None
+        deadline = time.monotonic() + budget_s
+        attempt = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            per_try = timeout if timeout is not None \
+                else self.RETRY_ATTEMPT_TIMEOUT_S
+            if attempt > 0:
+                per_try = min(per_try, max(0.05, remaining))
+            try:
+                return self._call_once(method, per_try, dict(kwargs))
+            except (RpcConnectionLost, TimeoutError, OSError) as e:
+                sent = not isinstance(e, RpcConnectionLost) or e.sent
+                if sent and not retry_sent:
+                    raise
+                if time.monotonic() >= deadline:
+                    raise
+                attempt += 1
+                # Full jitter: sleep in [0, cap), cap doubling from base to
+                # max — a head restart with hundreds of clients retrying
+                # must see staggered re-registration, not a stampede.
+                cap = min(cfg.head_retry_max_s,
+                          cfg.head_retry_base_s * (2 ** min(attempt, 16)))
+                time.sleep(random.random() *
+                           min(cap, max(0.0, deadline - time.monotonic())))
+                try:
+                    self._reconnect()
+                except Exception:  # noqa: BLE001 - still down: next attempt
+                    pass
 
     def notify(self, method: str, **kwargs) -> None:
         data = _pack({"m": method, "a": kwargs})

@@ -29,6 +29,7 @@ import asyncio
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Any
 
@@ -328,9 +329,12 @@ class ClusterRuntime:
             except Exception:
                 my_node = ""
         self.my_node_id = my_node
-        self.head.call("register_worker", worker_id=self.worker_id.hex(),
-                       host=self.addr[0], port=self.addr[1],
-                       node_id=my_node)
+        # Naturally idempotent (same row every time) → safe to retry
+        # through a head outage at process start.
+        self.head.call_retrying("register_worker", idempotent=True,
+                                worker_id=self.worker_id.hex(),
+                                host=self.addr[0], port=self.addr[1],
+                                node_id=my_node)
         self._reaper_task = self._io.spawn(self._lease_reaper())
         # Telemetry flusher: EVERY cluster process (driver and worker alike)
         # periodically pushes its metrics snapshot, new finished spans, and
@@ -344,7 +348,8 @@ class ClusterRuntime:
                          name="telemetry-flush").start()
         # Actor state invalidation via pubsub.
         self.head.aio.on_notify("pub", self._on_pub)
-        self.head.call("subscribe", channel="actor_events")
+        self.head.call_retrying("subscribe", idempotent=True,
+                                channel="actor_events")
 
         def _on_head_reconnect():
             # A restarted head rebuilt its tables from its snapshot; refresh
@@ -1687,7 +1692,8 @@ class ClusterRuntime:
         the first copy of a content id; re-exports are cheap no-ops."""
         if fn_id in self._exported_fns:
             return
-        self.head.call("fn_put", fn_id=fn_id, blob=fn_blob)
+        self.head.call_retrying("fn_put", req_id=uuid.uuid4().hex,
+                                fn_id=fn_id, blob=fn_blob)
         self._exported_fns.add(fn_id)
         observe_ctrl_fn("export", len(fn_blob))
 
@@ -1698,7 +1704,8 @@ class ClusterRuntime:
         exports). Bounded: a definition that never appears is an error on
         the task, not a hang."""
         for attempt in range(retries):
-            res = self.head.call("fn_get", fn_id=fn_id, timeout=10)
+            res = self.head.call_retrying("fn_get", idempotent=True,
+                                          timeout=10, fn_id=fn_id)
             blob = res.get("blob")
             if blob is not None:
                 observe_ctrl_fn("fetch", len(blob))
@@ -2181,6 +2188,11 @@ class ClusterRuntime:
         and the lease re-requested."""
         from ray_tpu.util import tracing
 
+        # One request id for the whole acquisition: a retry after the
+        # daemon connection died mid-reply replays the SAME id, and the
+        # daemon's lease dedup hands back the already-granted workers
+        # instead of leaking them and granting fresh ones.
+        req_id = uuid.uuid4().hex
         try:
             for _ in range(4):
                 try:
@@ -2194,7 +2206,7 @@ class ClusterRuntime:
                             "lease_workers", resources=ks.resources,
                             count=count, env_hash=ks.env_hash, timeout=None,
                             allow_spill=not pinned,
-                            owner=self.worker_id.hex())
+                            owner=self.worker_id.hex(), req_id=req_id)
                     hops = 0
                     while res.get("spill") and hops < 4:
                         daemon = await self._apeer(tuple(res["spill"]))
@@ -2206,7 +2218,8 @@ class ClusterRuntime:
                                                 env_hash=ks.env_hash,
                                                 timeout=None,
                                                 allow_spill=hops < 3,
-                                                owner=self.worker_id.hex())
+                                                owner=self.worker_id.hex(),
+                                                req_id=req_id)
                         hops += 1
                 except (RpcConnectionLost, OSError):
                     # The daemon died mid-lease (SIGKILL chaos): a
@@ -2247,6 +2260,13 @@ class ClusterRuntime:
                 if live:
                     ks.workers.extend(live)
                     return
+                # Every grant DOA: these leases were RECEIVED (and just
+                # returned) — the retry is a NEW request, so it needs a
+                # fresh id or the daemon's dedup would faithfully replay
+                # the same dead grants forever. The stable-id replay is
+                # only for attempts whose REPLY was lost (the except
+                # branch above keeps req_id across those).
+                req_id = uuid.uuid4().hex
                 await asyncio.sleep(0.1)  # every grant DOA: retry
             raise ValueError("granted workers repeatedly unreachable")
         except Exception as e:  # noqa: BLE001
@@ -2439,8 +2459,12 @@ class ClusterRuntime:
 
         spec.owner_id = self.worker_id
         strategy = spec.scheduling_strategy
-        res = self.head.call(
-            "register_actor",
+        # Retrying + req-id-stamped: a head crash between applying the
+        # registration and ACKing it (or a restart mid-call) answers the
+        # retry from the WAL-replayed dedup table — exactly-once, never
+        # "name taken" against our own first attempt.
+        res = self.head.call_retrying(
+            "register_actor", req_id=uuid.uuid4().hex,
             actor_id=spec.actor_id.hex(),
             spec_blob=cloudpickle.dumps(spec),
             resources=spec.resources,
@@ -2733,47 +2757,66 @@ class ClusterRuntime:
             self._fail_actor_queue(st, e)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
-        self.head.call("kill_actor", actor_id=actor_id.hex(), no_restart=no_restart)
+        self.head.call_retrying("kill_actor", idempotent=True,
+                                actor_id=actor_id.hex(),
+                                no_restart=no_restart)
 
     def get_named_actor(self, name: str, namespace: str = "default") -> ActorID | None:
-        res = self.head.call("get_named_actor", name=name, namespace=namespace)
+        res = self.head.call_retrying("get_named_actor", idempotent=True,
+                                      name=name, namespace=namespace)
         return ActorID.from_hex(res["actor_id"]) if res.get("actor_id") else None
 
     def actor_is_alive(self, actor_id: ActorID) -> bool:
-        info = self.head.call("get_actor_info", actor_id=actor_id.hex())
+        info = self.head.call_retrying("get_actor_info", idempotent=True,
+                                       actor_id=actor_id.hex())
         return bool(info and info["state"] == "ALIVE")
 
     # ------------------------------------------------------------------ placement groups
     def create_placement_group(self, pg_id, bundles, strategy, name=None,
                                labels=None) -> str | None:
-        res = self.head.call("create_placement_group", pg_id=pg_id.hex(),
-                             bundles=bundles, strategy=strategy, name=name)
+        res = self.head.call_retrying(
+            "create_placement_group", req_id=uuid.uuid4().hex,
+            pg_id=pg_id.hex(), bundles=bundles, strategy=strategy, name=name)
         # The head inlines the first placement attempt: CREATED here lets
         # ready() skip its first state poll entirely.
         return (res or {}).get("state")
 
     def remove_placement_group(self, pg_id) -> None:
-        self.head.call("remove_placement_group", pg_id=pg_id.hex())
+        self.head.call_retrying("remove_placement_group", idempotent=True,
+                                pg_id=pg_id.hex())
 
     def placement_group_state(self, pg_id) -> str:
-        return self.head.call("placement_group_state", pg_id=pg_id.hex())["state"]
+        return self.head.call_retrying("placement_group_state",
+                                       idempotent=True,
+                                       pg_id=pg_id.hex())["state"]
 
     # ------------------------------------------------------------------ KV
-    def kv_put(self, key: str, value: bytes, ns: str = "default") -> None:
-        self.head.call("kv_put", ns=ns, key=key, value=value)
+    def kv_put(self, key: str, value: bytes, ns: str = "default",
+               overwrite: bool = True) -> bool:
+        return bool(self.head.call_retrying(
+            "kv_put", req_id=uuid.uuid4().hex, ns=ns, key=key, value=value,
+            overwrite=overwrite).get("ok"))
 
     def kv_get(self, key: str, ns: str = "default") -> bytes | None:
-        return self.head.call("kv_get", ns=ns, key=key).get("value")
+        return self.head.call_retrying("kv_get", idempotent=True,
+                                       ns=ns, key=key).get("value")
 
     def kv_del(self, key: str, ns: str = "default") -> None:
-        self.head.call("kv_del", ns=ns, key=key)
+        self.head.call_retrying("kv_del", req_id=uuid.uuid4().hex,
+                                ns=ns, key=key)
 
     def kv_keys(self, prefix: str = "", ns: str = "default") -> list[str]:
-        return self.head.call("kv_keys", ns=ns, prefix=prefix)["keys"]
+        return self.head.call_retrying("kv_keys", idempotent=True,
+                                       ns=ns, prefix=prefix)["keys"]
 
     # ------------------------------------------------------------------ misc
+    def head_status(self) -> dict:
+        """Control-plane session facts (incarnation, uptime, restart
+        count, reconcile/fence odometers) for `ray_tpu status`."""
+        return self.head.call_retrying("head_status", idempotent=True)
+
     def state_snapshot(self) -> dict:
-        snap = self.head.call("state_snapshot")
+        snap = self.head.call_retrying("state_snapshot", idempotent=True)
         snap["objects"] = self.store.stats()
         return snap
 
@@ -2782,10 +2825,11 @@ class ClusterRuntime:
         return self.head.call("get_task_events", since=since, epoch=epoch)
 
     def cluster_resources(self) -> dict[str, float]:
-        return self.head.call("cluster_resources")
+        return self.head.call_retrying("cluster_resources", idempotent=True)
 
     def available_resources(self) -> dict[str, float]:
-        return self.head.call("available_resources")
+        return self.head.call_retrying("available_resources",
+                                       idempotent=True)
 
     def shutdown(self) -> None:
         if self._shutdown:

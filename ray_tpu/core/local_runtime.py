@@ -706,9 +706,14 @@ class LocalRuntime:
     # ------------------------------------------------------------------ KV
     # (parity with the cluster runtime's head-backed KV — reference:
     # gcs_kv_manager.cc internal KV; local mode keeps tables in-process)
-    def kv_put(self, key: str, value: bytes, ns: str = "default") -> None:
+    def kv_put(self, key: str, value: bytes, ns: str = "default",
+               overwrite: bool = True) -> bool:
         with self._lock:
-            self._kv.setdefault(ns, {})[key] = value
+            table = self._kv.setdefault(ns, {})
+            if not overwrite and key in table:
+                return False
+            table[key] = value
+            return True
 
     def kv_get(self, key: str, ns: str = "default") -> bytes | None:
         with self._lock:

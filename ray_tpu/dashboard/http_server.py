@@ -209,6 +209,10 @@ class DashboardServer:
                 max_age_s=float(p.get("max_age_s", 0.0))))
         self.add_route("GET", "/api/watchdog",
                        lambda p, b: state_api.watchdog_status())
+        # Control-plane session facts: incarnation, uptime, restart count,
+        # dedup/fence/reconcile odometers (head fault tolerance).
+        self.add_route("GET", "/api/head",
+                       lambda p, b: state_api.head_status())
 
         def cluster_status(p, b):
             from ray_tpu.core.worker import global_worker

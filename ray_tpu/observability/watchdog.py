@@ -401,6 +401,47 @@ class Watchdog:
             pass
         return summary
 
+    # -------------------------------------------------------------- events
+    def record_event(self, rule: str, reason: str,
+                     detail: dict | None = None) -> dict:
+        """Open a lightweight incident WITHOUT a detector trip — control-
+        plane lifecycle events (``head_restart``) that belong on the same
+        timeline as the anomalies they may explain. No series window, no
+        targeted profile; still counted in ``watchdog_incidents_total``
+        and dumped as a flight-recorder bundle."""
+        incident = {
+            "id": uuid.uuid4().hex[:12],
+            "ts": time.monotonic(),
+            "wall_ts": time.time(),
+            "rule": rule,
+            "kind": "control",
+            "reason": reason,
+            "value": None,
+            "baseline": None,
+            "series": None,
+            "implicated": dict(detail or {}),
+            "window": [],
+            "related": [],
+            "profile": {"status": "skipped: lifecycle event"},
+            "flight_record": None,
+            "assembly_s": 0.0,
+        }
+        try:
+            from ray_tpu.core import flight_recorder
+
+            incident["flight_record"] = flight_recorder.record(
+                "watchdog_incident", reason=reason,
+                extra={"incident_id": incident["id"], "rule": rule,
+                       "detail": dict(detail or {})})
+        except Exception:
+            pass
+        self.incidents.append(incident)
+        try:
+            _get_wd_metrics()["incidents"].inc(tags={"rule": rule})
+        except Exception:
+            pass
+        return incident
+
     # -------------------------------------------------------------- reads
     def list_incidents(self, since: float = 0.0, limit: int = 100,
                        incident_id: str | None = None) -> list[dict]:

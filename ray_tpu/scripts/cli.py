@@ -36,13 +36,30 @@ def _fmt_table(rows: list[dict], columns: list[str]) -> str:
 
 def cmd_status(args) -> int:
     api = _connect(args.address)
+    from ray_tpu.util.state import head_status, list_nodes
+
+    try:
+        hs = head_status()
+    except Exception:  # noqa: BLE001 - head facts are best-effort
+        hs = {}
+    if hs:
+        up = hs.get("uptime_s")
+        line = (f"Head: incarnation {hs.get('incarnation', '?')} "
+                f"(restarts {hs.get('restart_count', '?')})")
+        if isinstance(up, (int, float)):
+            line += f", up {up:.0f}s"
+        print(line)
+        if hs.get("fenced_registrations") or hs.get("wal_tail_dropped"):
+            print(f"  fenced registrations: "
+                  f"{hs.get('fenced_registrations', 0)}, torn WAL tail "
+                  f"records dropped: {hs.get('wal_tail_dropped', 0)}")
+        if hs.get("reconcile"):
+            print(f"  reconcile repairs: {hs['reconcile']}")
     total = api.cluster_resources()
     avail = api.available_resources()
     print("Cluster resources:")
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
-    from ray_tpu.util.state import list_nodes
-
     nodes = list_nodes()
     print(f"\nNodes ({len(nodes)}):")
     print(_fmt_table(nodes, ["node_id", "alive", "resources"]))
@@ -259,6 +276,11 @@ def cmd_chaos(args) -> int:
         common["after_s"] = args.after
     if args.count is not None:
         common["count"] = args.count
+    elif args.verb != "partition":
+        # Targeted kill/rpc drills are single events by default; a
+        # partition severs EVERY matched frame until healed, so it keeps
+        # the injector's unlimited default.
+        common["count"] = 1
     if args.prob is not None:
         common["prob"] = args.prob
     if args.at_step is not None:
@@ -284,6 +306,16 @@ def cmd_chaos(args) -> int:
         rules.append({"point": "daemon.tick", "action": "kill",
                       "match": {"node": _need(args.node, "--node")},
                       **common})
+    elif args.verb == "kill-head":
+        rules.append({"point": "head.tick", "action": "kill", **common})
+    elif args.verb == "partition":
+        rule = {"point": "partition",
+                "action": "drop" if args.drop else "delay",
+                "match": {"node": _need(args.node, "--node")},
+                "direction": args.direction, **common}
+        if not args.drop:
+            rule["delay_s"] = args.delay_s
+        rules.append(rule)
     elif args.verb == "rpc":
         action = "drop" if args.drop else "delay"
         rule = {"point": "rpc.server", "action": action,
@@ -567,11 +599,13 @@ def main(argv: list[str] | None = None) -> int:
     lint.add_argument("--verbose", action="store_true",
                       help="include per-rule timings in the summary")
     ch = sub.add_parser(
-        "chaos", help="fault injection: kill workers/slices/daemons, "
-                      "delay/drop RPCs (see ray_tpu/chaos/injector.py)")
+        "chaos", help="fault injection: kill workers/slices/daemons/the "
+                      "head, delay/drop RPCs, partition nodes from the "
+                      "head (see ray_tpu/chaos/injector.py)")
     ch.add_argument("verb", choices=["status", "clear", "install",
                                      "kill-worker", "kill-slice",
-                                     "kill-daemon", "rpc"])
+                                     "kill-daemon", "kill-head",
+                                     "partition", "rpc"])
     ch.add_argument("--file", default=None, help="JSON rule file")
     ch.add_argument("--rules", default=None, help="inline JSON rule list")
     ch.add_argument("--rank", type=int, default=None,
@@ -579,17 +613,22 @@ def main(argv: list[str] | None = None) -> int:
     ch.add_argument("--slice", type=int, default=None,
                     help="kill-slice: slice id to kill")
     ch.add_argument("--node", default=None,
-                    help="kill-daemon: node id regex")
+                    help="kill-daemon/partition: node id regex")
     ch.add_argument("--method", default=None,
                     help="rpc: RPC method regex to delay/drop")
+    ch.add_argument("--direction", default="both",
+                    choices=["both", "to_head", "from_head"],
+                    help="partition: which head⇄node direction to sever")
     ch.add_argument("--delay-s", type=float, default=0.1, dest="delay_s")
     ch.add_argument("--drop", action="store_true",
-                    help="rpc: drop matching requests instead of delaying")
+                    help="rpc/partition: drop matching frames instead of "
+                         "delaying")
     ch.add_argument("--at-step", type=int, default=None, dest="at_step")
     ch.add_argument("--after", type=float, default=None,
                     help="arm the rule this many seconds after install")
-    ch.add_argument("--count", type=int, default=1,
-                    help="max firings (-1 = unlimited; default 1)")
+    ch.add_argument("--count", type=int, default=None,
+                    help="max firings (-1 = unlimited; default 1, except "
+                         "partition which defaults unlimited)")
     ch.add_argument("--prob", type=float, default=None)
 
     from ray_tpu.scripts.start import add_parsers as _add_start_parsers

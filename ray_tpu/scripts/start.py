@@ -113,8 +113,11 @@ def serve_head(args) -> int:
     _write(os.path.join(temp, "head.pid"), str(os.getpid()))
     _write(os.path.join(temp, "head.addr"),
            f"{head.rpc.host}:{head.rpc.port}")
-    print(f"ray_tpu head serving at {head.rpc.host}:{head.rpc.port}",
-          flush=True)
+    restarted = (f" (incarnation {head.incarnation}, restart "
+                 f"#{head.restart_count} — state replayed from "
+                 f"{persist})" if head.restart_count else "")
+    print(f"ray_tpu head serving at {head.rpc.host}:{head.rpc.port}"
+          f"{restarted}", flush=True)
 
     def stop():
         io = EventLoopThread.get()

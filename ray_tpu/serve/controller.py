@@ -222,11 +222,26 @@ class ServeController:
     # ---- reconcile loop ----
 
     def _control_loop(self) -> None:
+        consecutive_conn_failures = 0
         while not self._shutdown.is_set():
             try:
                 self._reconcile_once()
-            except Exception:
-                traceback.print_exc()
+                consecutive_conn_failures = 0
+            except Exception as e:  # noqa: BLE001 - loop must survive all
+                from ray_tpu.core.cluster.protocol import RpcConnectionLost
+
+                if isinstance(e, RpcConnectionLost):
+                    # Head outage that outlived the runtime's retry
+                    # budget: keep the controller alive and back off —
+                    # replicas keep serving (the data plane is
+                    # router→replica direct), and reconciliation resumes
+                    # the moment the control plane answers again. A
+                    # traceback per reconcile tick would just flood logs.
+                    consecutive_conn_failures += 1
+                    self._shutdown.wait(
+                        min(2.0, 0.2 * consecutive_conn_failures))
+                else:
+                    traceback.print_exc()
             time.sleep(self._interval)
 
     def _reconcile_once(self) -> None:

@@ -340,8 +340,19 @@ class TrainController:
                     if isinstance(e, _GroupFailure):
                         pending_failure = e
                     else:
-                        pending_failure = _GroupFailure(
-                            "controller_error", str(e))
+                        # A pure head-connectivity failure that outlived
+                        # the retry wrapper's budget is an INFRASTRUCTURE
+                        # trigger, not a training failure — name it so the
+                        # restart record reads as "head outage", and the
+                        # headft bench can assert zero of these on a
+                        # bounded outage.
+                        from ray_tpu.core.cluster.protocol import (
+                            RpcConnectionLost)
+
+                        trigger = ("head_unreachable"
+                                   if isinstance(e, RpcConnectionLost)
+                                   else "controller_error")
+                        pending_failure = _GroupFailure(trigger, str(e))
                     # The single failure budget: restart_count consumes it on
                     # EVERY path (poll-observed failures raise _GroupFailure
                     # with budget > 0 left; setup/backend errors land here

@@ -347,6 +347,20 @@ def timeseries(name: str | None = None, source: str | None = None,
                              max_age_s=max_age_s).get("series", [])
 
 
+def head_status() -> dict:
+    """Control-plane session facts: head incarnation, boot id, uptime,
+    restart count, and the fault-tolerance odometers (dedup table size,
+    torn-WAL-tail drops, fenced registrations, reconcile repairs).
+    In-process runtimes have no separate head and report themselves."""
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "head_status")
+    if not hasattr(rt, "head_status"):
+        return {"incarnation": 1, "restart_count": 0,
+                "note": "in-process runtime (no separate head)"}
+    return rt.head_status()
+
+
 def watchdog_status() -> dict:
     """Watchdog health: rule list, store occupancy, incidents, cumulative
     eval seconds (duty-cycle numerator)."""

@@ -130,6 +130,31 @@ class Config:
     # > 0 trades a bounded durability window for fewer writes under churn.
     wal_group_commit_ms: float = 0.0
 
+    # --- head fault tolerance (crash-consistent control plane) ---
+    # Total wall budget one retrying head RPC (RpcClient.call_retrying)
+    # may spend riding out a head crash/restart/partition before the
+    # failure surfaces. This is what keeps RpcConnectionLost from
+    # propagating into drivers, the serve controller, and the train
+    # controller during a head outage shorter than the budget; mutations
+    # stay exactly-once across the retries via the req-id dedup table.
+    head_retry_budget_s: float = 30.0
+    # Retry backoff bounds: each attempt sleeps uniform in [0, cap) with
+    # cap doubling from base to max (full jitter — a restarted head with
+    # hundreds of clients must see staggered retries, not a stampede).
+    head_retry_base_s: float = 0.05
+    head_retry_max_s: float = 2.0
+    # Completed mutation request ids the head remembers (WAL-logged and
+    # snapshotted with the tables they guard) so a retry after
+    # crash-before-ACK is answered from the record instead of re-applied.
+    # Oldest evicted beyond the bound; a retry older than the eviction
+    # horizon falls back to the per-RPC natural-idempotence checks.
+    head_dedup_max: int = 4096
+    # Daemon heartbeat RPC timeout: bounds how long a partition-dropped
+    # heartbeat frame can stall the loop before the daemon treats the
+    # head as unreachable and enters its reconnect path. <= 0 disables
+    # the bound (pre-FT behavior: a dropped frame wedges the loop).
+    daemon_heartbeat_timeout_s: float = 5.0
+
     # --- collectives / multi-slice training ---
     # Cross-slice (DCN) wire format for hierarchical allreduce in multi-slice
     # collective groups ("none" | "bf16" | "int8"). "none" keeps the input
@@ -236,6 +261,13 @@ class Config:
     # RTPU_CHAOS env var (JSON list), RTPU_CHAOS_FILE, the `chaos` CLI verb,
     # or util.state.inject_chaos(); with this False every installed rule is
     # inert (a production cluster can carry a chaos schedule disarmed).
+    # Rule schema of record: ray_tpu/chaos/injector.py. Head-outage drills
+    # use two dedicated points: ``head.tick`` (action "kill" = abrupt
+    # control-plane death, no final flush — restart must replay the WAL)
+    # and ``partition`` (directional head⇄node frame drop/delay; rule keys
+    # ``match={"node": <regex>}`` and ``direction`` in
+    # "to_head" | "from_head" | "both"). CLI: `ray_tpu chaos kill-head` /
+    # `ray_tpu chaos partition --node <regex> [--direction D] [--drop]`.
     chaos_enabled: bool = True
 
     # --- train recovery ---

@@ -74,11 +74,43 @@ def test_rule_arming_probability_and_kill_modes(tmp_path):
     assert mark["attrs"]["rank"] == 2 and mark["ts"] <= time.time()
 
 
+def test_head_outage_rule_points():
+    """The head-outage drill points (PR: head fault tolerance): head.tick
+    kill consumes its budget like daemon.tick; partition rules carry a
+    direction and match by node regex without logging per-frame."""
+    injector.install([
+        {"point": "head.tick", "action": "kill", "count": 1},
+        {"point": "partition", "action": "drop",
+         "match": {"node": "^node-b"}, "direction": "from_head"},
+    ], replace=True)
+    rule = injector.decide("head.tick")
+    assert rule is not None and rule.action == "kill"
+    assert injector.decide("head.tick") is None  # budget spent
+    assert injector.partition_action("node-b7", "from_head") == \
+        ("drop", 0.0)
+    assert injector.partition_action("node-b7", "to_head") is None
+    assert injector.partition_action("node-a1", "from_head") is None
+    # many frames, ONE firing-log entry (a severed heartbeat stream must
+    # not flood the log)
+    for _ in range(10):
+        injector.partition_action("node-b7", "from_head")
+    assert len(injector.fired("partition")) == 1
+    # rule serialization round-trips the direction
+    d = rule.to_dict()
+    assert "direction" in d
+    assert injector.ChaosRule.from_dict(
+        {"point": "partition", "direction": "to_head"}).direction == \
+        "to_head"
+
+
 def test_env_schedule_and_unknown_keys():
     with pytest.raises(ValueError, match="unknown chaos rule keys"):
         injector.ChaosRule.from_dict({"point": "train.step", "bogus": 1})
     with pytest.raises(ValueError, match="unknown chaos point"):
         injector.ChaosRule.from_dict({"point": "nope"})
+    with pytest.raises(ValueError, match="direction"):
+        injector.ChaosRule.from_dict({"point": "partition",
+                                      "direction": "up"})
     os.environ["RTPU_CHAOS"] = json.dumps(
         [{"point": "train.step", "action": "kill", "match": {"rank": 7}}])
     injector.reset_for_tests()
