@@ -1,0 +1,7 @@
+"""Accelerator topology + capability tables (TPU generations, peak FLOPs)."""
+
+from ray_tpu.accelerators.flops import (  # noqa: F401
+    PEAK_FLOPS,
+    peak_flops,
+    resolve_peak_flops,
+)

@@ -1,0 +1,95 @@
+"""Peak-FLOPs registry: the MFU / goodput denominators per TPU generation.
+
+One table of public per-chip peak dense throughput numbers (bf16 and, where
+the generation has a native int8 path, int8), replacing the ad-hoc env-only
+lookup ``train/session.py`` used for the MFU gauge. Resolution order:
+
+1. ``RTPU_PEAK_FLOPS`` env override (operator knows best — e.g. a sparsity
+   or fp8 workload whose effective peak differs from the table);
+2. generation auto-detected from the already-initialized jax backend's
+   ``device_kind`` (never triggers a backend init);
+3. 0.0 — the MFU gauge stays unset rather than publishing a made-up ratio.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+# Per-chip peak dense FLOP/s (public spec-sheet numbers).
+PEAK_FLOPS: dict[str, dict[str, float]] = {
+    "v4": {"bf16": 275e12},
+    "v5e": {"bf16": 197e12, "int8": 394e12},
+    "v5p": {"bf16": 459e12, "int8": 918e12},
+    "v6e": {"bf16": 918e12, "int8": 1836e12},
+}
+
+# device_kind spellings seen across libtpu releases -> table key.
+_KIND_ALIASES = {
+    "v5litepod": "v5e",
+    "v5 lite": "v5e",
+    "v5lite": "v5e",
+    "v6 lite": "v6e",
+    "v6lite": "v6e",
+}
+
+_detected: str | None | bool = False  # False = not probed yet (cached)
+
+
+def peak_flops(generation: str, dtype: str = "bf16") -> float:
+    """Table lookup (alias-aware); 0.0 for unknown generation/dtype
+    combinations so callers can gate the MFU gauge on truthiness."""
+    key = generation.lower().strip()
+    key = _KIND_ALIASES.get(key, key)
+    return PEAK_FLOPS.get(key, {}).get(dtype) or 0.0
+
+
+def detect_generation() -> str | None:
+    """Generation key from the local jax backend's device_kind, cached
+    per process; None when no non-CPU backend is up (CPU dev rigs, or
+    probing would have had to initialize a backend)."""
+    global _detected
+    if _detected is not False:
+        return _detected
+    _detected = None
+    try:
+        from ray_tpu.profiling.memory import jax_backend_ready
+
+        if not jax_backend_ready():
+            return None
+        import jax
+
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        kind = (getattr(devices[0], "device_kind", "") or "").lower()
+        for alias, key in _KIND_ALIASES.items():
+            if alias in kind:
+                _detected = key
+                return _detected
+        m = re.search(r"v\d+[a-z]*", kind)
+        if m and m.group(0) in PEAK_FLOPS:
+            _detected = m.group(0)
+    except Exception:  # noqa: BLE001 - detection is best-effort
+        _detected = None
+    return _detected
+
+
+def resolve_peak_flops(dtype: str = "bf16") -> float:
+    """The per-device peak used by the train MFU gauge and the goodput
+    denominators (see resolution order in the module docstring)."""
+    env = os.environ.get("RTPU_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    gen = detect_generation()
+    if gen:
+        return peak_flops(gen, dtype) or 0.0
+    return 0.0
+
+
+def _reset_for_tests() -> None:
+    global _detected
+    _detected = False

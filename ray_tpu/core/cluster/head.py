@@ -14,6 +14,7 @@ reference optionally persists to Redis); this build keeps tables in memory.
 from __future__ import annotations
 
 import asyncio
+import os
 import pickle
 import struct
 import time
@@ -146,6 +147,12 @@ class HeadServer:
         self._dedup: "OrderedDict[str, Any]" = OrderedDict()
         self._fenced_registrations = 0
         self._reconcile_totals: dict[str, int] = {}
+        # Head-outage estimate for the goodput ledger: the freshest
+        # persisted-state mtime BEFORE this boot touches the files is the
+        # last instant the previous incarnation was provably alive —
+        # capture it ahead of _load_snapshot/_open_wal (opening the WAL
+        # for append rewrites the mtime).
+        self._down_since = self._persist_mtime() if persist_path else None
         if persist_path:
             self._load_snapshot()
             self._open_wal()
@@ -197,6 +204,30 @@ class HeadServer:
                 train_stats_fn=lambda: self.train_stats,
                 nodes_fn=lambda: self.nodes,
                 profile_fn=self._watchdog_profile)
+        # Goodput rollup store (observability/goodput.py): ingests the
+        # run-level event legs piggybacked on report_telemetry, rolls the
+        # fleet up from the train-stats rows above, exports goodput_*
+        # gauges, and runs the badput-over-threshold rule.
+        self.goodput = None
+        if get_config().goodput_enabled:
+            from ray_tpu.observability.goodput import GoodputStore
+
+            self.goodput = GoodputStore()
+
+    def _persist_mtime(self) -> float | None:
+        """Freshest mtime across the snapshot + WAL segments (the
+        previous incarnation's last observable write), None when nothing
+        persisted yet (first boot)."""
+        newest = None
+        for path in (self._persist_path, self._persist_path + ".wal",
+                     self._persist_path + ".wal.old"):
+            try:
+                ts = os.path.getmtime(path)
+            except OSError:
+                continue
+            if newest is None or ts > newest:
+                newest = ts
+        return newest
 
     # ------------------------------------------------------------------ wiring
     def _register_handlers(self):
@@ -237,6 +268,7 @@ class HeadServer:
         r("stack_cluster", self._stack_cluster)
         r("device_memory", self._device_memory)
         r("get_train_stats", self._get_train_stats)
+        r("get_goodput", self._get_goodput)
         r("cluster_load", self._cluster_load)
         r("create_placement_group", self._create_pg)
         r("remove_placement_group", self._remove_pg)
@@ -266,6 +298,21 @@ class HeadServer:
                     detail={"incarnation": self.incarnation,
                             "boot_id": self.boot_id,
                             "restart_count": self.restart_count})
+        if self.goodput is not None and self.restart_count > 0:
+            # Fleet-level head_outage badput: the gap between the previous
+            # incarnation's last persisted write and this boot. Workers
+            # keep stepping through a head outage, so this is stamped with
+            # run=None (fleet rollup only) rather than charged to a run.
+            outage = 0.0
+            if self._down_since is not None:
+                outage = max(0.0, self.started_ts - self._down_since)
+            self.goodput.stamp(
+                "head_outage", None, outage,
+                chips=float(max(1, len(self.nodes))),
+                start_ts=self._down_since,
+                detail={"incarnation": self.incarnation,
+                        "boot_id": self.boot_id,
+                        "restart_count": self.restart_count})
         return addr
 
     async def stop(self):
@@ -1579,7 +1626,8 @@ class HeadServer:
                                 events: list | None = None,
                                 dropped: int = 0,
                                 train_stats: dict | None = None,
-                                series: dict | None = None):
+                                series: dict | None = None,
+                                goodput: dict | None = None):
         """One batched push from a process's telemetry flusher: its metrics
         snapshot (replaces the previous one for this source), finished
         spans, drained task events, and the delta-encoded watchdog series
@@ -1627,6 +1675,14 @@ class HeadServer:
                 src = min(self.train_stats,
                           key=lambda s: self.train_stats[s]["ts"])
                 self.train_stats.pop(src, None)
+        if self.goodput is not None:
+            if goodput:
+                self.goodput.ingest(source, node_id, goodput)
+            if train_stats or goodput:
+                # Throttled internally (goodput_check_interval_s): rolls up
+                # the ledger, refreshes goodput_* gauges, and runs the
+                # badput-over-threshold rule against the watchdog.
+                self.goodput.maybe_check(self.train_stats, self.watchdog)
         return out
 
     def _evict_telemetry_source(self, source: str) -> None:
@@ -1680,6 +1736,18 @@ class HeadServer:
         if self.watchdog is None:
             return {"enabled": False}
         return self.watchdog.status()
+
+    async def _get_goodput(self, conn: ServerConnection,
+                           run: str | None = None):
+        """Fleet goodput rollup: every rank's phase ledger (riding the
+        train-stats rows) joined with the run-level badput events, rolled
+        into per-run and fleet goodput %, badput breakdown, and the serve
+        request-goodput leg (SLO-attained tokens/chip-second)."""
+        if self.goodput is None:
+            return {"enabled": False, "runs": {}, "fleet": {}, "serve": {}}
+        store = self.watchdog.store if self.watchdog is not None else None
+        return self.goodput.rollup(self.train_stats, run=run,
+                                   series_store=store)
 
     async def _watchdog_profile(self, node_id: str, seconds: float) -> dict:
         """Targeted capture for incident evidence: ONE node's daemon fans

@@ -916,6 +916,7 @@ class NodeDaemon:
             await asyncio.sleep(period if period > 0 else 0.5)
             if period <= 0:
                 continue  # telemetry push disabled
+            goodput_leg = None
             try:
                 spans, span_cursor = tracing.flush_new(span_cursor)
                 events = buf.drain_dicts()
@@ -926,17 +927,29 @@ class NodeDaemon:
 
                 sampler, series = _wd_sampler.collect_for_flush(
                     sampler, snapshot)
+                # Goodput events buffered in this process (e.g. a driver-
+                # hosted controller in local-cluster mode) ride the same
+                # push — requeued on failure, id-deduplicated head-side.
+                try:
+                    from ray_tpu.observability import goodput as _gp
+
+                    goodput_leg = _gp.collect_for_flush()
+                except Exception:
+                    pass
                 # Idle economy + keepalive (see the runtime flusher): skip
                 # unchanged pushes but stay inside the head's 60s window.
                 now = time.monotonic()
                 if not events and not spans and snapshot == last_snapshot \
-                        and series is None and now - last_sent < 20.0:
+                        and series is None and goodput_leg is None \
+                        and now - last_sent < 20.0:
                     continue
                 reply = await self._head.call(
                     "report_telemetry", source=source, node_id=self.node_id,
                     snapshot=snapshot, spans=spans, events=events,
-                    dropped=buf.dropped, series=series, timeout=10)
+                    dropped=buf.dropped, series=series,
+                    goodput=goodput_leg, timeout=10)
                 _wd_sampler.handle_flush_reply(sampler, reply)
+                goodput_leg = None  # delivered — don't requeue below
                 last_snapshot, last_sent = snapshot, now
             except Exception:
                 # Head unreachable: heartbeat loop handles reconnects;
@@ -947,6 +960,13 @@ class NodeDaemon:
                     _wd_sampler.handle_flush_failure(sampler)
                 except Exception:
                     pass
+                if goodput_leg:
+                    try:
+                        from ray_tpu.observability import goodput as _gp
+
+                        _gp.flush_failed(goodput_leg)
+                    except Exception:
+                        pass
 
     async def _chaos_node(self, conn, rules=None, clear=False):
         """Chaos plane leg: install/clear fault rules in this daemon and

@@ -387,6 +387,7 @@ class ClusterRuntime:
                 return
             if period <= 0:
                 continue  # telemetry push disabled
+            goodput_leg = None
             try:
                 # A node daemon co-hosted in this process (local-cluster /
                 # in-process test clusters) already reports this process's
@@ -418,6 +419,17 @@ class ClusterRuntime:
 
                 self._series_sampler, series = _wd_sampler.collect_for_flush(
                     self._series_sampler, snapshot)
+                # Goodput events (restart downtime etc.) buffered in this
+                # process piggyback the same push; requeued on failure and
+                # id-deduplicated head-side, so delivery is at-least-once
+                # with exactly-once accounting.
+                goodput_leg = None
+                try:
+                    from ray_tpu.observability import goodput as _gp
+
+                    goodput_leg = _gp.collect_for_flush()
+                except Exception:
+                    pass
                 # Idle-process economy: nothing new to report and the
                 # snapshot unchanged — skip the RPC, but keepalive well
                 # inside the head's 60s liveness window so the source
@@ -425,15 +437,16 @@ class ClusterRuntime:
                 now = time.monotonic()
                 if not events and not spans and snapshot == last_snapshot \
                         and train_stats is None and series is None \
-                        and now - last_sent < 20.0:
+                        and goodput_leg is None and now - last_sent < 20.0:
                     continue
                 reply = self.head.call(
                     "report_telemetry", source=source,
                     node_id=self.my_node_id, timeout=10,
                     snapshot=snapshot, spans=spans, events=events,
                     dropped=buf.dropped, train_stats=train_stats,
-                    series=series)
+                    series=series, goodput=goodput_leg)
                 _wd_sampler.handle_flush_reply(self._series_sampler, reply)
+                goodput_leg = None  # delivered — don't requeue below
                 last_snapshot, last_sent = snapshot, now
             except Exception:
                 # Head temporarily unreachable: events/spans drop (bounded
@@ -446,6 +459,15 @@ class ClusterRuntime:
                     _wd_sampler.handle_flush_failure(self._series_sampler)
                 except Exception:
                     pass
+                # Goodput events are NOT drop-tolerant (each is a whole
+                # outage's accounting): requeue for the next flush.
+                if goodput_leg:
+                    try:
+                        from ray_tpu.observability import goodput as _gp
+
+                        _gp.flush_failed(goodput_leg)
+                    except Exception:
+                        pass
 
     def get_telemetry(self) -> dict:
         """The head's per-node telemetry table (source -> node/snapshot)."""
@@ -485,6 +507,11 @@ class ClusterRuntime:
     def train_stats(self) -> dict:
         """The head's straggler table (per-rank step-time summaries)."""
         return self.head.call("get_train_stats")
+
+    def get_goodput(self, run: str | None = None) -> dict:
+        """The head's goodput rollup: per-run/fleet goodput % with full
+        badput breakdown in chip-seconds, plus serve request-goodput."""
+        return self.head.call("get_goodput", run=run)
 
     # ------------------------------------------------------------ watchdog
     def incidents(self, since: float = 0.0, limit: int = 100,

@@ -209,6 +209,10 @@ class DashboardServer:
                 max_age_s=float(p.get("max_age_s", 0.0))))
         self.add_route("GET", "/api/watchdog",
                        lambda p, b: state_api.watchdog_status())
+        # Goodput ledger rollup: per-run/fleet goodput % + badput
+        # breakdown in chip-seconds (?run=<name> narrows).
+        self.add_route("GET", "/api/goodput",
+                       lambda p, b: state_api.get_goodput(run=p.get("run")))
         # Control-plane session facts: incarnation, uptime, restart count,
         # dedup/fence/reconcile odometers (head fault tolerance).
         self.add_route("GET", "/api/head",

@@ -126,11 +126,21 @@ class DataIterator:
     def iter_block_refs(self) -> Iterator[tuple[Any, dict]]:
         import ray_tpu
 
+        # Input-stall accounting: the time between asking the coordinator
+        # for a block and having one in hand (polls + empty sleeps) is
+        # dataset wait, not consumer compute — stamped per fetch on the
+        # consuming thread's goodput ledger when one is active (a cheap
+        # thread-local read otherwise).
+        from ray_tpu.observability import goodput as _goodput
+
         while True:
+            t0 = time.perf_counter()
             status, payload = ray_tpu.get(
                 self._coord.get_next.remote(self._split)
             )
             if status == "block":
+                _goodput.add_active_pending(
+                    "input_wait", time.perf_counter() - t0)
                 yield payload, {}
             elif status == "done":
                 return
@@ -138,6 +148,8 @@ class DataIterator:
                 raise RuntimeError(f"streaming_split producer failed: {payload}")
             else:
                 time.sleep(0.01)
+                _goodput.add_active_pending(
+                    "input_wait", time.perf_counter() - t0)
 
     def iter_batches(self, *, batch_size: int | None = 256,
                      batch_format: str = "numpy",

@@ -10,7 +10,10 @@ detectors, anomaly-triggered evidence capture.
   warmup/debounce/cooldown;
 - :mod:`~ray_tpu.observability.watchdog` — the head loop that turns a trip
   into an incident (attribution + series window + flight record + targeted
-  profile under guardrails).
+  profile under guardrails);
+- :mod:`~ray_tpu.observability.goodput` — the goodput ledger: every rank's
+  wall clock classified into an exhaustive phase taxonomy, rolled up
+  head-side into goodput % / badput breakdown in chip-seconds.
 """
 
 from ray_tpu.observability.detectors import (  # noqa: F401
@@ -21,6 +24,12 @@ from ray_tpu.observability.detectors import (  # noqa: F401
     ThresholdRule,
     Trip,
     build_rules,
+)
+from ray_tpu.observability.goodput import (  # noqa: F401
+    GOOD_PHASE,
+    PHASES,
+    GoodputStore,
+    RankLedger,
 )
 from ray_tpu.observability.sampler import SeriesSampler  # noqa: F401
 from ray_tpu.observability.timeseries import (  # noqa: F401

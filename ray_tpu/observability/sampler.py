@@ -49,6 +49,7 @@ COUNTER_SERIES = (
     "serve_expired_total",
     "serve_retries_total",
     "serve_breaker_transitions_total",
+    "serve_slo_tokens_total",
     "train_restarts_total",
 )
 GAUGE_SERIES = (
@@ -143,6 +144,7 @@ class SeriesSampler:
         self._counter_live: set[int] = set()  # rate sids with nonzero last
         self._hist_last: dict[tuple[str, tuple], tuple] = {}
         self._last_ts: float | None = None
+        self._last_mono: float | None = None  # monotonic interval base
         self._rss_last = 0.0
         self._hbm_last: float | None = None
         self.spent_s = 0.0
@@ -225,9 +227,24 @@ class SeriesSampler:
                     pass
 
     def _collect(self, snapshot: dict, now: float | None) -> dict | None:
-        now = time.time() if now is None else float(now)
-        prev_ts, self._last_ts = self._last_ts, now
-        interval = (now - prev_ts) if prev_ts else 0.0
+        if now is None:
+            # Payload timestamps stay wall-clock (the head's store orders
+            # and ages by them), but the RATE DENOMINATOR comes from the
+            # monotonic clock: an NTP step between two flushes must not
+            # mint negative or wildly scaled counter/histogram rates
+            # (PR-14 already hit backwards-wall-clock trouble head-side).
+            now = time.time()
+            mono = time.monotonic()
+            prev_mono, self._last_mono = self._last_mono, mono
+            interval = (mono - prev_mono) if prev_mono is not None else 0.0
+            self._last_ts = now
+        else:
+            # Injected clock (tests drive intervals explicitly): derive
+            # the interval from the provided stamps, wall == mono.
+            now = float(now)
+            prev_ts, self._last_ts = self._last_ts, now
+            self._last_mono = None
+            interval = (now - prev_ts) if prev_ts is not None else 0.0
         defs: list = []
         samples: list = []
         for entry in (snapshot or {}).get("metrics", ()):

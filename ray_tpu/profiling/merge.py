@@ -54,24 +54,39 @@ def merge_chrome_trace(captures: list[dict],
     the two artifacts never drift visually)."""
     events: list[dict] = []
     seen_spans = set()
+    has_goodput = False
     for s in spans or []:
         sid = s.get("span_id")
         if sid in seen_spans:
             continue
         seen_spans.add(sid)
+        # Goodput phase chunks get their own lane, one row per (run, rank),
+        # so the badput breakdown reads as a horizontal timeline next to
+        # the sample tracks instead of drowning in the RPC span soup.
+        attrs = s.get("attributes") or {}
+        name = s.get("name", "")
+        if name.startswith("goodput."):
+            has_goodput = True
+            pid = "goodput"
+            tid = f"{attrs.get('run', '?')}/r{attrs.get('rank', '?')}"
+        else:
+            pid = "spans"
+            tid = (s.get("trace_id") or "")[:8]
         events.append({
-            "name": s.get("name", ""), "cat": f"span:{s.get('kind', '')}",
+            "name": name, "cat": f"span:{s.get('kind', '')}",
             "ph": "X", "ts": s.get("start_ts", 0.0) * 1e6,
             "dur": max(0.0, (s.get("end_ts", 0.0) -
                              s.get("start_ts", 0.0)) * 1e6),
-            "pid": "spans", "tid": (s.get("trace_id") or "")[:8],
+            "pid": pid, "tid": tid,
             "args": {"trace_id": s.get("trace_id"), "span_id": sid,
-                     "status": s.get("status"),
-                     **(s.get("attributes") or {})},
+                     "status": s.get("status"), **attrs},
         })
     if spans is not None:
         events.append({"name": "process_name", "ph": "M", "pid": "spans",
                        "args": {"name": "ray_tpu spans"}})
+    if has_goodput:
+        events.append({"name": "process_name", "ph": "M", "pid": "goodput",
+                       "args": {"name": "goodput phases"}})
 
     for cap in captures:
         if not cap or cap.get("error"):

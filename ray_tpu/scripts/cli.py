@@ -383,6 +383,54 @@ def cmd_incidents(args) -> int:
     return 0
 
 
+def cmd_goodput(args) -> int:
+    """Fleet goodput ledger: where every chip-second went. One row per
+    run (goodput %, chip-seconds, top badput phases), a fleet summary
+    line, and serve request-goodput per deployment. --run narrows to one
+    run; --json dumps the full rollup (phase_chip_s, events, residuals)."""
+    _connect(args.address)
+    from ray_tpu.util.state import get_goodput
+
+    rollup = get_goodput(run=args.run)
+    if args.json:
+        print(json.dumps(rollup, indent=2, default=str))
+        return 0
+    if not rollup.get("enabled", False):
+        print("goodput ledger disabled on this runtime "
+              "(set RTPU_GOODPUT_ENABLED=1 and use a cluster head)")
+        return 1
+    runs = rollup.get("runs", {})
+    table = []
+    for name, row in sorted(runs.items()):
+        bad = sorted((row.get("badput_chip_s") or {}).items(),
+                     key=lambda kv: kv[1], reverse=True)
+        top = ", ".join(f"{p} {s:.1f}s" for p, s in bad[:3] if s > 0)
+        table.append({
+            "run": name,
+            "ranks": row.get("ranks", 0),
+            "chip_s": f"{row.get('chip_seconds', 0.0):.1f}",
+            "goodput_pct": f"{row.get('goodput_pct', 0.0):.1f}",
+            "unattributed_s": f"{row.get('unattributed_s', 0.0):.1f}",
+            "top_badput": top or "-",
+        })
+    if table:
+        print(_fmt_table(table, ["run", "ranks", "chip_s", "goodput_pct",
+                                 "unattributed_s", "top_badput"]))
+    else:
+        print("no runs reporting")
+    fleet = rollup.get("fleet") or {}
+    if fleet:
+        print(f"\nfleet: {fleet.get('chip_seconds', 0.0):.1f} chip-s, "
+              f"goodput {fleet.get('goodput_pct', 0.0):.1f}%, "
+              f"unattributed {fleet.get('unattributed_s', 0.0):.1f}s")
+    serve = (rollup.get("serve") or {}).get("deployments") or {}
+    for dep, row in sorted(serve.items()):
+        print(f"serve/{dep}: {row.get('slo_tokens_per_s', 0.0):.1f} "
+              f"SLO-tokens/s over {row.get('replicas', 0)} replica(s) "
+              f"({row.get('request_goodput', 0.0):.1f}/replica)")
+    return 0
+
+
 def cmd_watch(args) -> int:
     """Live health line: poll the watchdog store + incident deque and
     print one compact status line per interval (new incidents are printed
@@ -572,6 +620,11 @@ def main(argv: list[str] | None = None) -> int:
                      help="only incidents after this unix timestamp")
     inc.add_argument("--limit", type=int, default=100)
     inc.add_argument("--json", action="store_true")
+    gdp = sub.add_parser(
+        "goodput", help="fleet goodput ledger: per-run and fleet goodput %% "
+                        "with the badput breakdown in chip-seconds")
+    gdp.add_argument("--run", default=None, help="narrow to one run name")
+    gdp.add_argument("--json", action="store_true")
     wt = sub.add_parser(
         "watch", help="live cluster-health line off the watchdog series "
                       "store (step time, serve p99, queue, sheds, "
@@ -643,7 +696,7 @@ def main(argv: list[str] | None = None) -> int:
             "flight-records": cmd_flight_records, "profile": cmd_profile,
             "stack": cmd_stack, "stragglers": cmd_stragglers,
             "chaos": cmd_chaos, "incidents": cmd_incidents,
-            "watch": cmd_watch, "lint": cmd_lint}
+            "goodput": cmd_goodput, "watch": cmd_watch, "lint": cmd_lint}
     return cmds[args.command](args)
 
 

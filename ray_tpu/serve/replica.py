@@ -60,6 +60,12 @@ def _get_replica_metrics():
                     "serve_replica_requests_total",
                     "requests handled by this replica",
                     tag_keys=("deployment", "replica")),
+                "slo_tokens": Counter(
+                    "serve_slo_tokens_total",
+                    "output tokens/chunks produced within the request "
+                    "deadline (SLO-attained work — the request-goodput "
+                    "numerator; shed/expired requests contribute none)",
+                    tag_keys=("deployment",)),
             }
         return _replica_metrics
 
@@ -113,6 +119,7 @@ class ServeReplica:
                 {**self._dep_tag, "where": "replica"}),
             "expired": self._sm["expired"].bound(
                 {**self._dep_tag, "where": "replica"}),
+            "slo_tokens": self._m["slo_tokens"].bound(self._dep_tag),
         }
         if user_config is not None:
             self.reconfigure(user_config)
@@ -224,6 +231,7 @@ class ServeReplica:
                 # Non-streaming: the full result IS the first output.
                 self._b["ttft"].observe(elapsed)
                 self._b["latency"].observe(elapsed)
+                self._count_slo_tokens(1, deadline)
             except Exception:
                 pass
             return result
@@ -258,18 +266,19 @@ class ServeReplica:
                         getattr(target, "__call__", None)):
                 yield {"streaming": True}
                 yield from self._instrumented_stream(
-                    target(*args, **kwargs), t0)
+                    target(*args, **kwargs), t0, deadline)
                 return
             result = target(*args, **kwargs)
             if inspect.isgenerator(result):
                 yield {"streaming": True}
-                yield from self._instrumented_stream(result, t0)
+                yield from self._instrumented_stream(result, t0, deadline)
                 return
             yield {"streaming": False}
             elapsed = time.perf_counter() - t0
             try:
                 self._b["ttft"].observe(elapsed)
                 self._b["latency"].observe(elapsed)
+                self._count_slo_tokens(1, deadline)
             except Exception:
                 pass
             yield result
@@ -277,10 +286,27 @@ class ServeReplica:
             _set_current_deadline(None)
             self._end_request()
 
-    def _instrumented_stream(self, gen, t0: float):
+    def _count_slo_tokens(self, n: int, deadline: float | None) -> None:
+        """Request-goodput numerator (PR-8 SLO counters): output produced
+        while the request's deadline is still attainable. Shed/expired
+        requests never reach here; chunks produced after the deadline
+        blew mid-stream are work nobody is waiting for, so they don't
+        count either."""
+        from ray_tpu.serve.resilience import expired as _deadline_expired
+
+        if _deadline_expired(deadline):
+            return
+        try:
+            self._b["slo_tokens"].inc(n)
+        except Exception:
+            pass
+
+    def _instrumented_stream(self, gen, t0: float,
+                             deadline: float | None = None):
         """TTFT on the first user chunk, TPOT on each inter-chunk gap, full
         latency at exhaustion — the streaming triple every serving
-        comparison quotes."""
+        comparison quotes. Each chunk counts toward the deployment's
+        SLO-attained tokens while the deadline holds."""
         last = None
         try:
             for chunk in gen:
@@ -292,6 +318,7 @@ class ServeReplica:
                         self._b["tpot"].observe(now - last)
                 except Exception:
                     pass
+                self._count_slo_tokens(1, deadline)
                 last = now
                 yield chunk
         finally:

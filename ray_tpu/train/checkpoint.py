@@ -101,9 +101,20 @@ class AsyncCheckpointWriter:
         self._lock = threading.Lock()
 
     def save(self, tree: Any, directory: str, step: int | None = None) -> str:
+        t0 = time.perf_counter()
         self.wait()  # barrier on (and surface errors from) the previous write
         host_tree = jax.tree.map(
             lambda x: jax.device_get(x) if hasattr(x, "shape") else x, tree)
+        # Goodput: the barrier + device_get above is the SYNC portion the
+        # train step actually pays for checkpointing (the orbax write runs
+        # behind); stamp it on the calling thread's ledger, if any.
+        try:
+            from ray_tpu.observability import goodput as _goodput
+
+            _goodput.add_active_pending(
+                "checkpoint", time.perf_counter() - t0)
+        except Exception:
+            pass
 
         def work():
             try:

@@ -131,6 +131,11 @@ class TrainController:
         self._m_restarts = m["restarts"]
         self._m_failures = m["failures"]
         self._m_world = m["world"]
+        # Goodput: an open restart-downtime window (stamped at the
+        # restart decision, closed by the first post-restart report) —
+        # the detection + tier + time-to-first-step seconds the ledger
+        # attributes to `restart_downtime`.
+        self._goodput_pending: dict | None = None
 
     def _cb(self, hook: str, *args) -> None:
         for cb in self._callbacks:
@@ -243,6 +248,18 @@ class TrainController:
         flight_recorder.record(
             "train_restart", reason=decision["trigger"],
             extra=decision)
+        if tier != "abort":
+            # Chips proxy: one chip per rank of the NEW world (exact on
+            # single-device-per-rank rigs; the rank ledgers carry real
+            # local device counts for their own phases).
+            self._goodput_pending = {
+                "start_ts": decision["detected_ts"],
+                "tier": tier,
+                "restart_index": restart_index,
+                "chips": float(world_after or 0),
+                "trigger": decision["trigger"],
+                "detection_latency_s": decision["detection_latency_s"],
+            }
 
     # --------------------------------------------------------------- run
     def run(self) -> Result:
@@ -391,6 +408,28 @@ class TrainController:
         last_ok = time.monotonic()
         while True:
             status = group.poll_status(timeout=60)
+            if status.reports and self._goodput_pending is not None:
+                # First post-restart report: the run is stepping again —
+                # close the downtime window [failure detected → now] and
+                # queue the event for this process's telemetry flush.
+                pg, self._goodput_pending = self._goodput_pending, None
+                try:
+                    from ray_tpu.observability import goodput as _goodput
+
+                    # Close at the earliest worker-stamped report instant
+                    # (session.report "ts"): downtime ends when a worker
+                    # stepped, not when this poll happened to observe it.
+                    end_ts = min((r.get("ts") for r in status.reports
+                                  if r.get("ts")), default=None) or time.time()
+                    _goodput.record_event(
+                        "restart_downtime", run=self._run_name,
+                        seconds=max(0.0, end_ts - pg["start_ts"]),
+                        chips=pg["chips"], start_ts=pg["start_ts"],
+                        detail={k: pg[k] for k in
+                                ("tier", "restart_index", "trigger",
+                                 "detection_latency_s")})
+                except Exception:  # noqa: BLE001 - never break the poll
+                    pass
             for rep in status.reports:
                 self.metrics_history.append(rep["metrics"])
                 if rep.get("rank", 0) == 0:

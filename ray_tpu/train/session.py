@@ -9,6 +9,7 @@ manager, SURVEY.md §3.4 step 4).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
@@ -44,6 +45,10 @@ class TrainContext:
     # filled by the worker harness
     dataset_shards: dict = field(default_factory=dict)  # name -> DataIterator
     _replica_writer: Any = None  # lazy ReplicaWriter (train/replica.py)
+    # Goodput RankLedger (observability/goodput.py), attached by
+    # set_context when the ledger gate is on; its snapshot rides this
+    # rank's train-stats row with every telemetry push.
+    _goodput: Any = None
     _reports: list[dict] = field(default_factory=list)
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
     _last_report_ts: float = 0.0  # monotonic ts of the previous report()
@@ -123,6 +128,17 @@ def set_context(ctx: TrainContext | None) -> None:
 
     prev = getattr(_local, "ctx", None)
     _local.ctx = ctx
+    # Goodput ledger lifecycle, BEFORE the final-row summarize below so a
+    # finishing run's row carries its closed (tail → idle) ledger.
+    try:
+        from ray_tpu.observability import goodput as _goodput
+
+        if prev is not None and prev is not ctx:
+            _goodput.detach(prev)
+        if ctx is not None and ctx is not prev and ctx._goodput is None:
+            _goodput.attach(ctx)
+    except Exception:
+        pass  # the ledger must never break context setup
     now_m = _time.monotonic()
     with _stats_lock:
         _prune_final_locked(now_m)
@@ -186,9 +202,13 @@ def _get_train_metrics():
 def _instrument_report(ctx: TrainContext, metrics: dict[str, Any]) -> None:
     """Derive step-time / tokens-per-sec / MFU gauges from a report.
     Recognized keys: ``tokens`` (or ``tokens_per_step``) per step, ``flops``
-    (or ``flops_per_step``) per step, ``peak_flops`` (else RTPU_PEAK_FLOPS
-    env), and direct ``tokens_per_s`` / ``mfu`` passthroughs."""
-    import os
+    (or ``flops_per_step``) per step, ``peak_flops`` (else the
+    accelerators/flops.py registry: RTPU_PEAK_FLOPS override or the
+    generation table keyed by the backend's device_kind), and direct
+    ``tokens_per_s`` / ``mfu`` passthroughs. Goodput keys (all optional,
+    seconds within this step): ``sync_time_s`` → collective_wait,
+    ``compute_time_s`` → step_compute (remainder → idle),
+    ``input_wait_s``, ``compile_time_s``, ``checkpoint_time_s``."""
     import time
 
     m = _get_train_metrics()
@@ -197,10 +217,10 @@ def _instrument_report(ctx: TrainContext, metrics: dict[str, Any]) -> None:
     now = time.monotonic()
     last, ctx._last_report_ts = ctx._last_report_ts, now
     step_time = (now - last) if last else 0.0
+    sync = metrics.get("sync_time_s")
+    compute = metrics.get("compute_time_s")
     if step_time > 0:
         m["step_time"].set(step_time, tags=rank)
-        sync = metrics.get("sync_time_s")
-        compute = metrics.get("compute_time_s")
         # _report_lock: the telemetry flusher snapshots this window from
         # another thread, and list(deque) raises if an append lands
         # mid-iteration once the window is full.
@@ -211,6 +231,17 @@ def _instrument_report(ctx: TrainContext, metrics: dict[str, Any]) -> None:
                 float(compute) if compute is not None else None,
             ))
             ctx._steps_total += 1
+    if ctx._goodput is not None:
+        # Close this report's ledger interval: explicit per-step keys
+        # merge with seconds the hooks (compile listener, checkpoint
+        # writer, replicate, input_wait) stamped since the last close.
+        ctx._goodput.close_interval(parts={
+            "collective_wait": sync,
+            "step_compute": compute,
+            "input_wait": metrics.get("input_wait_s"),
+            "compile": metrics.get("compile_time_s"),
+            "checkpoint": metrics.get("checkpoint_time_s"),
+        })
     if "tokens_per_s" in metrics:
         m["tokens_per_s"].set(float(metrics["tokens_per_s"]), tags=rank)
     elif step_time > 0:
@@ -221,8 +252,11 @@ def _instrument_report(ctx: TrainContext, metrics: dict[str, Any]) -> None:
         m["mfu"].set(float(metrics["mfu"]), tags=rank)
     elif step_time > 0:
         flops = metrics.get("flops", metrics.get("flops_per_step"))
-        peak = metrics.get("peak_flops") or \
-            float(os.environ.get("RTPU_PEAK_FLOPS", 0) or 0)
+        peak = metrics.get("peak_flops")
+        if flops and not peak:
+            from ray_tpu.accelerators.flops import resolve_peak_flops
+
+            peak = resolve_peak_flops()
         if flops and peak:
             m["mfu"].set(float(flops) / step_time / float(peak), tags=rank)
 
@@ -240,7 +274,11 @@ def report(metrics: dict[str, Any], checkpoint: str | None = None) -> None:
     except Exception:
         pass  # metrics must never fail a training step
     with ctx._report_lock:
-        ctx._reports.append({"metrics": dict(metrics), "checkpoint": checkpoint})
+        # "ts" is the worker-stamped report instant: the controller closes
+        # restart-downtime windows on it instead of its own observation
+        # time, so poll/RPC delivery lag never inflates the attribution.
+        ctx._reports.append({"metrics": dict(metrics), "checkpoint": checkpoint,
+                             "ts": time.time()})
 
 
 def _maybe_chaos(ctx: TrainContext, metrics: dict[str, Any]) -> None:
@@ -287,7 +325,17 @@ def replicate(state: Any, step: int) -> bool:
         ctx._replica_writer = ReplicaWriter(
             rep["run"], ctx.world_rank, ctx.world_size,
             int(rep.get("num_slices", ctx.num_slices)))
-    return ctx._replica_writer.put(state, step)
+    # The push itself is async; only the inline host snapshot + queue
+    # time is the step's replication cost — stamp it on the ledger.
+    import time as _time
+
+    t0 = _time.perf_counter()
+    try:
+        return ctx._replica_writer.put(state, step)
+    finally:
+        if ctx._goodput is not None:
+            ctx._goodput.add_pending(
+                "replication_push", _time.perf_counter() - t0)
 
 
 def drain_reports(ctx: TrainContext) -> list[dict]:
@@ -344,7 +392,7 @@ def _summarize_steps(ctx: TrainContext) -> dict | None:
         return (sum(v for _, v in pairs) / denom) if denom else None
 
     total = sum(ts)
-    return {
+    row = {
         "world_size": ctx.world_size,
         "steps": ctx._steps_total,
         "mean_step_s": total / n,
@@ -352,8 +400,18 @@ def _summarize_steps(ctx: TrainContext) -> dict | None:
         "deciles": deciles,
         "sync_share": share(syncs),
         "compute_share": share(computes),
+        "run": ctx.experiment_name,
         "ts": _time.time(),
     }
+    # Goodput piggyback: the rank's cumulative ledger snapshot rides the
+    # same row (no new RPC — the head's train-stats table carries it to
+    # the GoodputStore rollup).
+    if ctx._goodput is not None:
+        try:
+            row["goodput"] = ctx._goodput.snapshot()
+        except Exception:  # noqa: BLE001 - accounting never breaks stats
+            pass
+    return row
 
 
 def get_dataset_shard(name: str = "train"):

@@ -347,6 +347,22 @@ def timeseries(name: str | None = None, source: str | None = None,
                              max_age_s=max_age_s).get("series", [])
 
 
+def get_goodput(run: str | None = None) -> dict:
+    """Fleet goodput ledger rollup: per-run and fleet goodput % with the
+    badput breakdown in chip-seconds (compile, input_wait, collective_wait,
+    checkpoint, replication_push, restart_downtime, head_outage, idle),
+    unattributed residual, and the serve request-goodput leg. ``run``
+    filters the per-run section. In-process runtimes have no head rollup
+    and report disabled."""
+    global_worker.check_connected()
+    rt = global_worker.runtime
+    _reject_thin_client(rt, "goodput")
+    if not hasattr(rt, "get_goodput"):
+        return {"enabled": False, "runs": {}, "fleet": {}, "serve": {},
+                "note": "in-process runtime (no head rollup)"}
+    return rt.get_goodput(run=run)
+
+
 def head_status() -> dict:
     """Control-plane session facts: head incarnation, boot id, uptime,
     restart count, and the fault-tolerance odometers (dedup table size,

@@ -39,7 +39,34 @@ _enabled = False
 _ctx = threading.local()  # .trace_id, .span_id
 _spans: deque[Span] = deque(maxlen=100_000)
 _spans_total = 0  # monotone append count (flush cursor base)
+_dropped_metered = 0  # drops already exported to the registry counter
 _lock = threading.Lock()
+
+_drop_metrics = None
+_drop_metrics_lock = threading.Lock()
+
+
+def _get_drop_metrics():
+    """Lazy: the module must stay importable without the registry."""
+    global _drop_metrics
+    with _drop_metrics_lock:
+        if _drop_metrics is None:
+            from ray_tpu.util.metrics import Counter
+
+            _drop_metrics = {
+                "dropped": Counter(
+                    "tracing_spans_dropped",
+                    "finished spans silently discarded by this process's "
+                    "bounded span buffer (deque wraparound / clear) — "
+                    "nonzero means the timeline has holes"),
+            }
+        return _drop_metrics
+
+
+def dropped_spans() -> int:
+    """Spans this process has discarded (wraparound + clear), cumulative."""
+    with _lock:
+        return _spans_total - len(_spans)
 
 
 def enable_tracing() -> None:
@@ -120,6 +147,25 @@ def span(name: str, kind: str = "internal", attributes: dict | None = None,
             _spans_total += 1
 
 
+def record_span(name: str, start_ts: float, end_ts: float,
+                kind: str = "internal",
+                attributes: dict | None = None) -> None:
+    """Append an already-finished span (the goodput ledger lane: phase
+    intervals are classified after the fact, so there is no ``with``
+    block to wrap). No-op when tracing is off."""
+    if not _enabled:
+        return
+    s = Span(
+        trace_id=_new_id(16), span_id=_new_id(), parent_id=None, name=name,
+        kind=kind, start_ts=float(start_ts), end_ts=float(end_ts),
+        attributes=dict(attributes or {}),
+    )
+    global _spans_total
+    with _lock:
+        _spans.append(s)
+        _spans_total += 1
+
+
 @contextlib.contextmanager
 def task_span(name: str, trace_ctx: dict | None, kind: str = "worker",
               attributes: dict | None = None):
@@ -150,6 +196,7 @@ def flush_new(cursor: int, limit: int = 2000) -> tuple[list[dict], int]:
     (reference: task_event_buffer.h kMaxNumTaskEventsToFlush)."""
     import itertools
 
+    global _dropped_metered
     with _lock:
         # _spans_total is monotone across clear() (cleared spans count as
         # dropped), so a caller's cursor can never exceed it and there is
@@ -158,6 +205,16 @@ def flush_new(cursor: int, limit: int = 2000) -> tuple[list[dict], int]:
         start = max(0, min(cursor, _spans_total) - dropped)
         batch = list(itertools.islice(_spans, start, start + limit))
         new_cursor = dropped + start + len(batch)
+        new_drops, _dropped_metered = \
+            dropped - _dropped_metered, max(dropped, _dropped_metered)
+    if new_drops > 0:
+        # Surfaced on the flush path (every process with a telemetry
+        # flusher calls it) so /metrics shows span loss without adding a
+        # counter inc to the hot span-record path.
+        try:
+            _get_drop_metrics()["dropped"].inc(new_drops)
+        except Exception:  # noqa: BLE001 - visibility must not break flush
+            pass
     out = [{
         "trace_id": s.trace_id, "span_id": s.span_id,
         "parent_id": s.parent_id, "name": s.name, "kind": s.kind,

@@ -336,6 +336,27 @@ class Config:
     watchdog_capture_cooldown_s: float = 60.0
     watchdog_capture_budget: int = 20
 
+    # --- goodput ledger (ray_tpu/observability/goodput.py) ---
+    # Master gate: with this on, every live TrainContext carries a
+    # RankLedger classifying its wall clock into the goodput phase
+    # taxonomy (snapshots ride the existing train-stats telemetry rows),
+    # controllers/heads stamp restart/outage events onto the same pushes,
+    # and the head aggregates a per-run + fleet goodput rollup. Off = no
+    # ledgers, no event legs, no head store.
+    goodput_enabled: bool = True
+    # Badput-over-threshold watchdog rule: a run burning more than this
+    # percentage of its chip-seconds in ONE badput phase opens a
+    # `badput_over_threshold` incident with the ledger window attached.
+    goodput_badput_pct: float = 50.0
+    # No incident before the run has attributed at least this much wall
+    # time (init/compile dominate any run's first seconds by design).
+    goodput_badput_min_wall_s: float = 10.0
+    # Per-run cooldown between badput incidents.
+    goodput_badput_cooldown_s: float = 60.0
+    # Head-side rollup/gauge/incident-check cadence (piggybacked on
+    # telemetry ingest, throttled to at most once per this interval).
+    goodput_check_interval_s: float = 5.0
+
     # --- on-demand profiler (ray_tpu/profiling) ---
     # Python stack-sampler rate for `profile` captures. 100 Hz keeps the
     # measured overhead within the <=2% budget PERF_PROFILER.json tracks;
@@ -366,9 +387,10 @@ class Config:
     # has an entry here or a Config field.
     #   RTPU_USAGE_STATS_ENABLED (1): usage-stats collection master
     #     switch (usage/__init__.py); "0" disables.
-    #   RTPU_PEAK_FLOPS (backend-detected): per-device peak FLOP/s used
-    #     for the MFU metric when the backend can't be probed
-    #     (train/session.py).
+    #   RTPU_PEAK_FLOPS (backend-detected): per-device peak FLOP/s
+    #     override for the MFU/goodput denominators; without it the
+    #     generation table in accelerators/flops.py resolves from the
+    #     initialized backend's device_kind.
     #   RTPU_CONTAINER_RUNNER ("podman"): container runtime binary for
     #     runtime_env containers; tests point it at a stub
     #     (runtime_env/container.py).
