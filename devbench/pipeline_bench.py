@@ -1,0 +1,388 @@
+"""Compiled-graph pipeline bench: zero-RPC dataflow + pipelined execution.
+
+Emits PERF_PIPELINE.json:
+- per-hop channel latency + steps/sec for the KV (head round-trip) vs
+  direct (peer push) transports, and for a 1 MiB ndarray riding the
+  store-backed buffer path (same-host: pinned arena views),
+- control-plane RPCs per executed step, from the head's per-method inbound
+  frame odometer: ~0 for direct channels (the head KV is touched once at
+  compile for route exchange), vs the KV transport's put/get/del traffic,
+- pipelined-vs-synchronous throughput of a 4-stage sleepy pipeline as the
+  execute_async in-flight window deepens (fill/drain across steps),
+- a 4-stage MPMD toy-model training step under the GPipe schedule vs a
+  fully serial schedule (intra-step microbatch overlap), with the loss
+  trajectory asserting the math still trains.
+
+Gates (acceptance): direct beats KV per-hop >= 5x same-host; RPCs/step
+<= 0.5 on the direct path; window depth 4 >= 3x over synchronous; GPipe
+>= 3x over the serial schedule.
+
+Run: python devbench/pipeline_bench.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RTPU_WORKER_IDLE_TTL_S", "300")
+
+import numpy as np  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu.cluster_utils import Cluster  # noqa: E402
+from ray_tpu.core.worker import global_worker  # noqa: E402
+from ray_tpu.dag import InputNode  # noqa: E402
+from ray_tpu.utils.ids import JobID  # noqa: E402
+
+
+def pct(samples: list[float]) -> dict:
+    if not samples:
+        return {}
+    s = sorted(samples)
+
+    def at(q):
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    return {"n": len(s), "p50_ms": round(at(0.50) * 1e3, 3),
+            "p90_ms": round(at(0.90) * 1e3, 3),
+            "p99_ms": round(at(0.99) * 1e3, 3)}
+
+
+@ray_tpu.remote
+class Echo:
+    """Identity stage: isolates channel cost from compute."""
+
+    def f(self, x):
+        return x
+
+
+@ray_tpu.remote
+class SleepyStage:
+    """Fixed dwell per op — the portable stand-in for per-stage device
+    time on a one-core box (real compute cannot overlap across local
+    processes; sleep exhibits exactly the schedule overlap the pipeline
+    exploits)."""
+
+    def __init__(self, dwell_s: float):
+        self.dwell_s = dwell_s
+
+    def f(self, x):
+        time.sleep(self.dwell_s)
+        return x
+
+
+def _setup_cluster():
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=10)
+    rt = cluster.connect()
+    old = (global_worker.runtime, global_worker.worker_id,
+           global_worker.node_id, global_worker.mode, global_worker.job_id)
+    global_worker.runtime = rt
+    global_worker.worker_id = rt.worker_id
+    global_worker.node_id = rt.node_id
+    global_worker.job_id = JobID.from_random()
+    global_worker.mode = "cluster"
+    rt._daemon.call("prestart_workers", n=4, timeout=15)
+    return cluster, rt, old
+
+
+def _teardown(cluster, rt, old):
+    rt.shutdown()
+    cluster.shutdown()
+    (global_worker.runtime, global_worker.worker_id, global_worker.node_id,
+     global_worker.mode, global_worker.job_id) = old
+
+
+def _echo_dag(stages):
+    with InputNode() as inp:
+        return stages[1].f.bind(stages[0].f.bind(inp))
+
+
+def _kill(actors):
+    """Explicit kills, then a settle: letting handles leak to GC defers the
+    worker churn (kill + prestart replacement) into the NEXT phase's timed
+    region — on a one-core box that skews its latencies."""
+    for a in actors:
+        try:
+            ray_tpu.kill(a, no_restart=True)
+        except Exception:
+            pass
+    time.sleep(1.0)
+
+
+def _measure_steps(compiled, payload, n, timeout=60.0):
+    lat = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        compiled.execute(payload, timeout=timeout)
+        lat.append(time.perf_counter() - t0)
+    return lat
+
+
+def _phase_per_hop(stages, quick: bool) -> dict:
+    """KV vs direct per-hop latency on a 2-stage echo chain (3 hops:
+    driver->s1->s2->driver), plus the store-backed ndarray path. All
+    variants recompile on the SAME two actors: loops exit at teardown and
+    the next compile installs fresh schedules, with no actor churn between
+    timed regions."""
+    n = 30 if quick else 100
+    hops = 3
+    out = {}
+    # Direct variants run FIRST: the KV transport's per-step head traffic
+    # churns enough metrics/spans that the next periodic telemetry flush
+    # burns the one core for ~2s — a cost of the KV design, so the KV
+    # variant runs last and absorbs its own storm (plus a settle).
+    compiled = _echo_dag(stages).experimental_compile(_channel_kind="direct")
+    try:
+        _measure_steps(compiled, 1, 3)  # warm routes
+        lat = _measure_steps(compiled, 1, n)
+    finally:
+        compiled.teardown()
+    out["direct_small"] = {
+        **pct(lat),
+        "per_hop_p50_ms": round(pct(lat)["p50_ms"] / hops, 3),
+        "steps_per_s": round(n / sum(lat), 1),
+    }
+    # 1 MiB ndarray: above the inline threshold, so activations ride the
+    # object plane as store-backed buffers (node shm arena -> the reader
+    # maps a pinned view; no per-step serialization of the payload into
+    # control frames).
+    arr = np.ones((512, 512), np.float32)
+    compiled = _echo_dag(stages).experimental_compile(_channel_kind="direct")
+    try:
+        _measure_steps(compiled, arr, 3)
+        lat = _measure_steps(compiled, arr, max(10, n // 3))
+    finally:
+        compiled.teardown()
+    out["direct_ndarray_1mb"] = {
+        **pct(lat),
+        "per_hop_p50_ms": round(pct(lat)["p50_ms"] / hops, 3),
+        "steps_per_s": round(len(lat) / sum(lat), 1),
+    }
+    compiled = _echo_dag(stages).experimental_compile(_channel_kind="kv")
+    try:
+        _measure_steps(compiled, 1, 3)  # warm slots
+        lat = _measure_steps(compiled, 1, n)
+    finally:
+        compiled.teardown()
+    out["kv_small"] = {
+        **pct(lat),
+        "per_hop_p50_ms": round(pct(lat)["p50_ms"] / hops, 3),
+        "steps_per_s": round(n / sum(lat), 1),
+    }
+    time.sleep(2.5)  # KV metric-churn telemetry storm off the core
+    out["direct_vs_kv_per_hop"] = round(
+        out["kv_small"]["per_hop_p50_ms"]
+        / max(out["direct_small"]["per_hop_p50_ms"], 1e-6), 1)
+    return out
+
+
+def _phase_rpcs_per_step(stages, rt, quick: bool) -> dict:
+    """Head inbound frames per executed step, per method. The direct path
+    should add ~nothing (compile-time route exchange only); the KV path
+    pays puts/gets/deletes — and its reader busy-poll — per hop."""
+    n = 20 if quick else 50
+    out = {}
+    for kind in ("direct", "kv"):
+        compiled = _echo_dag(stages).experimental_compile(_channel_kind=kind)
+        try:
+            _measure_steps(compiled, 1, 3)
+            before = rt.head_rpc_counts()
+            futs = [compiled.execute_async(i) for i in range(n)]
+            for f in futs:
+                f.result(60)
+            after = rt.head_rpc_counts()
+        finally:
+            compiled.teardown()
+        delta = {k: after.get(k, 0) - before.get(k, 0)
+                 for k in set(after) | set(before)
+                 if after.get(k, 0) != before.get(k, 0)}
+        # Subtract our own probe (the post-window rpc_counts call is one
+        # inbound frame) and the periodic background frames — heartbeats
+        # and telemetry flushes are time-based, not per-step control plane
+        # (they show up in the breakdown regardless).
+        background = {"rpc_counts", "heartbeat", "report_telemetry"}
+        net = sum(v for k, v in delta.items() if k not in background)
+        out[kind] = {
+            "steps": n,
+            "head_frames_by_method": delta,
+            "rpcs_per_step": round(net / n, 3),
+        }
+        if kind == "kv":
+            time.sleep(2.5)  # the KV variant's telemetry storm, again
+    return out
+
+
+def _phase_window_pipelining(quick: bool) -> dict:
+    """4 sleepy stages chained; synchronous execute vs execute_async with
+    a deepening in-flight window. Depth d keeps d steps in the pipe, so
+    throughput approaches 1/stage-dwell instead of 1/(4*dwell)."""
+    dwell = 0.025
+    stages = [SleepyStage.remote(dwell) for _ in range(4)]
+    with InputNode() as inp:
+        node = inp
+        for s in stages:
+            node = s.f.bind(node)
+    sync_n = 8 if quick else 12
+    depths = (1, 4) if quick else (1, 2, 4, 8)
+    out = {"stage_dwell_ms": dwell * 1e3, "num_stages": 4}
+
+    compiled = node.experimental_compile()
+    try:
+        _measure_steps(compiled, 0, 2)
+        t0 = time.perf_counter()
+        for i in range(sync_n):
+            compiled.execute(i, timeout=60)
+        sync_sps = sync_n / (time.perf_counter() - t0)
+    finally:
+        compiled.teardown()
+    out["sync_steps_per_s"] = round(sync_sps, 2)
+
+    out["by_depth"] = {}
+    for depth in depths:
+        compiled = node.experimental_compile(_max_inflight=depth)
+        try:
+            _measure_steps(compiled, 0, 2)
+            n = max(12, 3 * depth)
+            t0 = time.perf_counter()
+            futs = [compiled.execute_async(i) for i in range(n)]
+            for f in futs:
+                f.result(60)
+            sps = n / (time.perf_counter() - t0)
+        finally:
+            compiled.teardown()
+        out["by_depth"][str(depth)] = {
+            "steps_per_s": round(sps, 2),
+            "speedup_vs_sync": round(sps / sync_sps, 2),
+        }
+    _kill(stages)
+    return out
+
+
+def _phase_mpmd_toy(quick: bool) -> dict:
+    """4-stage MPMD toy model, one optimizer step per execution. GPipe's
+    per-stage fill/drain order overlaps microbatches across stages; the
+    serial schedule (each microbatch's full forward+backward round trip
+    before the next) is the no-pipelining baseline on the SAME dag."""
+    from ray_tpu.dag.mpmd import MPMDPipeline, make_toy_stage_factory
+    from ray_tpu.dag.schedule import PipelineSchedule
+
+    class SerialSchedule(PipelineSchedule):
+        name = "serial"
+
+        def forward_rank(self, mb, stage, num_stages, num_microbatches):
+            return 1 + 2 * mb
+
+        def backward_rank(self, mb, stage, num_stages, num_microbatches):
+            return 2 + 2 * mb
+
+    P, M = 4, 24
+    dwell = 0.01
+    width = 16
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((M, width), dtype=np.float32)
+    t = rng.standard_normal((M, width), dtype=np.float32)
+    out = {"stages": P, "microbatches": M, "stage_dwell_ms": dwell * 1e3}
+    losses = []
+    for name, sched, steps in (("serial", SerialSchedule(), 1 if quick else 2),
+                               ("gpipe", "gpipe", 2 if quick else 3)):
+        pipe = MPMDPipeline(make_toy_stage_factory(width=width, sleep_s=dwell),
+                            num_stages=P, num_microbatches=M, schedule=sched)
+        try:
+            first = pipe.step(x, t, timeout=120)  # warm jits + routes
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                m = pipe.step(x, t, timeout=120)
+            wall = (time.perf_counter() - t0) / steps
+            if name == "gpipe":
+                losses = [first["loss"], m["loss"]]
+        finally:
+            pipe.shutdown()  # kills the stage actors too
+        time.sleep(1.0)  # settle: replacement-worker prestart off the core
+        out[name] = {"step_wall_s": round(wall, 3),
+                     "steps_measured": steps}
+    out["gpipe_speedup_vs_serial"] = round(
+        out["serial"]["step_wall_s"] / max(out["gpipe"]["step_wall_s"], 1e-9),
+        2)
+    out["loss_first"] = round(losses[0], 5)
+    out["loss_later"] = round(losses[1], 5)
+    out["loss_decreased"] = losses[1] < losses[0]
+    return out
+
+
+def run_bench(quick: bool = False, out_path: str | None = None) -> dict:
+    from ray_tpu.utils import config as config_mod
+
+    config_mod.set_config(config_mod.Config.load())
+    cluster, rt, old = _setup_cluster()
+    try:
+        echoes = [Echo.remote(), Echo.remote()]
+        per_hop = _phase_per_hop(echoes, quick)
+        rpcs = _phase_rpcs_per_step(echoes, rt, quick)
+        _kill(echoes)
+        window = _phase_window_pipelining(quick)
+        mpmd = _phase_mpmd_toy(quick)
+    finally:
+        _teardown(cluster, rt, old)
+
+    depth4 = window["by_depth"].get("4", {})
+    acceptance = {
+        "direct_beats_kv_5x_per_hop": per_hop["direct_vs_kv_per_hop"] >= 5.0,
+        "rpcs_per_step_near_zero": rpcs["direct"]["rpcs_per_step"] <= 0.5,
+        "pipelined_speedup_ge_3x_depth4":
+            depth4.get("speedup_vs_sync", 0.0) >= 3.0,
+        "mpmd_gpipe_speedup_ge_3x": mpmd["gpipe_speedup_vs_serial"] >= 3.0,
+        "mpmd_loss_decreases": mpmd["loss_decreased"],
+    }
+    report = {
+        "bench": "pipeline",
+        "quick": quick,
+        "phases": {
+            "per_hop": per_hop,
+            "rpcs_per_step": rpcs,
+            "window_pipelining": window,
+            "mpmd_toy": mpmd,
+        },
+        "acceptance": acceptance,
+        "provenance": {
+            "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "cpus": os.cpu_count(),
+            "loadavg": list(os.getloadavg()),
+            "box_note": (
+                "single host, one physical core: per-stage device dwell is "
+                "emulated with sleeps (compute cannot overlap across local "
+                "processes), so the speedups measure exactly what the "
+                "executor provides — schedule overlap. Channel latencies "
+                "and head-frame counts are real."),
+        },
+    }
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PERF_PIPELINE.json")
+    # Quick dryrun refreshes land under "quick_refresh", never overwriting
+    # full-run provenance (same contract as the other PERF files).
+    doc = report
+    if quick and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                existing = json.load(f)
+            if not existing.get("quick"):
+                existing["quick_refresh"] = report
+                doc = existing
+        except Exception:
+            pass
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return report
+
+
+if __name__ == "__main__":
+    rep = run_bench(quick="--quick" in sys.argv[1:])
+    print(json.dumps(rep, indent=2))
+    sys.exit(0 if all(rep["acceptance"].values()) else 1)

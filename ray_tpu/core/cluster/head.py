@@ -274,6 +274,7 @@ class HeadServer:
         r("remove_placement_group", self._remove_pg)
         r("placement_group_state", self._pg_state)
         r("head_status", self._head_status)
+        r("rpc_counts", self._rpc_counts)
         r("placement_fenced", self._placement_fenced)
         self.rpc.on_disconnect = self._on_disconnect
         self._daemon_clients: dict[str, Any] = {}
@@ -726,6 +727,14 @@ class HeadServer:
             "nodes_total": len(self.nodes),
             "actors": len(self.actors),
         }
+
+    async def _rpc_counts(self, conn: ServerConnection):
+        """Per-method inbound frame odometer of this head's RPC server.
+        Benches diff two snapshots to attribute control-plane load — e.g.
+        the compiled-graph bench proves direct channels stop issuing
+        ``kv_*`` traffic per step (this very call shows up in the delta, so
+        diff-takers subtract their own probes)."""
+        return dict(self.rpc.counts)
 
     # ------------------------------------------------------------------ pubsub
     # (reference: src/ray/pubsub long-poll channels; here: server-push over the

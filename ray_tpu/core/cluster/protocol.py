@@ -19,6 +19,7 @@ import socket
 import struct
 import threading
 import time
+from collections import Counter
 from typing import Any, Awaitable, Callable
 
 import msgpack
@@ -145,6 +146,12 @@ class RpcServer:
         self.host = host
         self.port = port
         self._handlers: dict[str, Callable[..., Awaitable[Any]]] = {}
+        # Per-method inbound frame odometer (multi-call frames count one per
+        # carried payload). Written only from serve() on the loop thread;
+        # readers take point-in-time snapshots — the compiled-graph bench
+        # diffs head counts across N steps to prove the direct-channel data
+        # plane issues ~0 control-plane RPCs per step.
+        self.counts: Counter = Counter()
         # Raw handlers: fn(conn, msg) invoked INLINE in the read loop — no
         # task spawn, no auto-reply. The handler owns correlation: it hands
         # the frame to an execution thread which packs the reply itself and
@@ -229,6 +236,11 @@ class ServerConnection:
             msg = await _read_frame(self.reader)
             if msg is None:
                 return
+            method = msg.get("m")
+            if method is not None:
+                calls = msg.get("c")
+                self.server.counts[method] += \
+                    len(calls) if calls is not None else 1
             if _chaos.ACTIVE:
                 # Fault-injection probe (rpc.server): a matching rule drops
                 # the request on the floor (caller sees a hang/timeout —

@@ -316,6 +316,12 @@ class ClusterRuntime:
         self.server.register("dump_stack", self._handle_dump_stack)
         self.server.register("memory_snapshot", self._handle_memory_snapshot)
         self.server.register("chaos_install", self._handle_chaos_install)
+        # Compiled-graph direct channels: peer writers push dataflow frames
+        # straight at the reader's server (ray_tpu/dag/direct.py). Raw
+        # dispatch (enqueue-only, reader thread acks); the dag import is
+        # deferred to the first frame so processes that never run a
+        # compiled graph don't pay the package import.
+        self.server.register_raw("dag_chan_push", self._handle_dag_chan_push)
         self.addr = self._io.run(self.server.start())
         # Workers learn their node from the forking daemon's env; a DRIVER
         # asks its attached daemon — without this, objects the driver holds
@@ -566,6 +572,14 @@ class ClusterRuntime:
         snap["worker_id"] = self.worker_id.hex()
         snap["node_id"] = self.my_node_id
         return snap
+
+    def _handle_dag_chan_push(self, conn, msg):
+        """Raw handler: compiled-graph direct-channel frame (data inline or
+        a store-backed ref). Enqueue for the local reader; the reader acks
+        after materializing (end-to-end channel backpressure)."""
+        from ray_tpu.dag.direct import handle_chan_push
+
+        handle_chan_push(conn, msg)
 
     async def _handle_chaos_install(self, conn, rules=None,
                                     clear: bool = False, **kw):
@@ -2841,6 +2855,11 @@ class ClusterRuntime:
         """Control-plane session facts (incarnation, uptime, restart
         count, reconcile/fence odometers) for `ray_tpu status`."""
         return self.head.call_retrying("head_status", idempotent=True)
+
+    def head_rpc_counts(self) -> dict:
+        """Per-method inbound frame counts at the head (control-plane RPC
+        attribution; diff two snapshots around a workload)."""
+        return self.head.call_retrying("rpc_counts", idempotent=True)
 
     def state_snapshot(self) -> dict:
         snap = self.head.call_retrying("state_snapshot", idempotent=True)

@@ -18,4 +18,27 @@ __all__ = [
     "Communicator",
     "register_accelerator_communicator",
     "get_accelerator_communicator",
+    "MPMDPipeline",
+    "StageProgram",
+    "build_pipeline_dag",
+    "make_llama_stage_factory",
+    "make_toy_stage_factory",
+    "PipelineSchedule",
+    "register_schedule",
+    "get_schedule",
 ]
+
+
+def __getattr__(name):
+    # Lazy: the MPMD module pulls in numpy/jax-adjacent code at import
+    # time; plain `import ray_tpu.dag` must stay light.
+    if name in ("MPMDPipeline", "StageProgram", "build_pipeline_dag",
+                "make_llama_stage_factory", "make_toy_stage_factory"):
+        from ray_tpu.dag import mpmd
+
+        return getattr(mpmd, name)
+    if name in ("PipelineSchedule", "register_schedule", "get_schedule"):
+        from ray_tpu.dag import schedule
+
+        return getattr(schedule, name)
+    raise AttributeError(name)

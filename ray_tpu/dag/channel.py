@@ -6,16 +6,19 @@ channels backed by C++ experimental_mutable_object_manager.cc,
 intra_process_channel.py for same-process readers): a channel is a named
 single-writer multi-reader slot carrying one value per execution step.
 
-Two transports:
+Three transports:
 - ``LocalChannel``: same-process queues (threaded local runtime). Pickling
   transfers only the name; deserialization re-attaches to the process-global
   registry, so actor threads and the driver share one instance.
 - ``StoreChannel``: versioned slots in the cluster KV. Works across any two
-  processes on any nodes; data moves without task scheduling but does pay a
-  KV round-trip (a node-local shared-memory fast path needs placement
-  knowledge the compiler doesn't have yet — reference cross-node channels
-  similarly fall back to raylet-pushed mutable objects,
-  node_manager.cc:748 HandlePushMutableObject).
+  processes on any nodes; data moves without task scheduling but pays a KV
+  round-trip per hop — kept as the fallback/baseline transport (select with
+  the ``dag_channel="kv"`` knob).
+- ``direct.DirectChannel`` (ray_tpu/dag/direct.py): the cluster default —
+  peer-to-peer push frames with store-backed buffers for large payloads;
+  the head is consulted once at compile time for route exchange, never per
+  step (reference cross-node channels similarly push mutable objects
+  raylet-to-raylet, node_manager.cc:748 HandlePushMutableObject).
 """
 
 from __future__ import annotations
@@ -46,7 +49,12 @@ def _lookup_local_channel(name: str) -> "LocalChannel":
 class LocalChannel:
     """Same-process channel: one bounded queue per reader."""
 
-    def __init__(self, name: str, num_readers: int = 1, maxsize: int = 16):
+    def __init__(self, name: str, num_readers: int = 1,
+                 maxsize: int | None = None):
+        if maxsize is None:
+            from ray_tpu.utils.config import get_config
+
+            maxsize = get_config().dag_channel_capacity
         self.name = name
         self._queues = [queue.Queue(maxsize=maxsize) for _ in range(num_readers)]
         self._closed = False
@@ -107,6 +115,11 @@ class StoreChannel:
         # One cursor per reader index: a single pickled instance can serve
         # several read sites of one process (distinct reader_index each).
         self._read_seq: dict[int, int] = {}
+        # Last PUBLISHED cursor per reader index: publishes are batched to
+        # one kv_put per _GC_EVERY reads (flushed when the close marker is
+        # observed), so multi-reader consumption stops costing one head RPC
+        # per read.
+        self._cursor_pub: dict[int, int] = {}
         self._runtime = None
 
     # Pickled into actors: only the identity travels; cursors and the runtime
@@ -119,6 +132,7 @@ class StoreChannel:
         self.num_readers = state["num_readers"]
         self._write_seq = 0
         self._read_seq = {}
+        self._cursor_pub = {}
         self._runtime = None
 
     def connect(self, runtime) -> "StoreChannel":
@@ -155,17 +169,26 @@ class StoreChannel:
         if bytes(blob) == _CLOSE:
             # Cursor stays on the marker: every subsequent read re-raises
             # immediately instead of polling a seq that will never arrive.
+            # Flush the batched cursor so the writer can reclaim everything
+            # this reader consumed before the marker.
+            self._flush_cursor(reader_index)
             raise ChannelClosed(self.name)
         self._read_seq[reader_index] = seq + 1
         value = serialization.deserialize(blob)
         if self.num_readers == 1:
             self._runtime.kv_del(key, ns="channels")
-        else:
-            # Publish this reader's cursor so the writer can GC slots every
-            # reader has passed.
-            self._runtime.kv_put(self._cursor_key(reader_index),
-                                 str(seq + 1).encode(), ns="channels")
+        elif (seq + 1) % self._GC_EVERY == 0:
+            # Batched cursor publish: one kv_put per _GC_EVERY reads (not
+            # per read) tells the writer which slots every reader passed.
+            self._flush_cursor(reader_index)
         return value
+
+    def _flush_cursor(self, reader_index: int) -> None:
+        cur = self._read_seq.get(reader_index, 0)
+        if self.num_readers > 1 and cur > self._cursor_pub.get(reader_index, 0):
+            self._runtime.kv_put(self._cursor_key(reader_index),
+                                 str(cur).encode(), ns="channels")
+            self._cursor_pub[reader_index] = cur
 
     def _gc(self) -> None:
         cursors = []
@@ -236,6 +259,11 @@ class DeviceChannel:
     def connect(self, runtime) -> "DeviceChannel":
         self.inner.connect(runtime)
         return self
+
+    def ensure_reader(self, reader_index: int = 0) -> None:
+        # Route publication passthrough for direct inner channels.
+        if hasattr(self.inner, "ensure_reader"):
+            self.inner.ensure_reader(reader_index)
 
     def write(self, value: Any) -> None:
         try:

@@ -11,6 +11,12 @@ collective nodes delegate to ``ray_tpu.collective`` groups, whose TPU path
 lowers to jax.lax collectives over ICI inside shard_map
 (ray_tpu/collective/xla_backend.py) and whose CPU test path uses the host
 backend — same insertion point as the reference's NCCL registration.
+
+Not to be confused with the *channel* transport: per-edge payload movement
+between stage actors (activations/grads) is DirectChannel
+(ray_tpu/dag/direct.py) riding the object plane, regardless of
+communicator. Communicators cover in-program collectives/p2p BETWEEN
+device meshes, the analogue of the reference's NCCL channel types.
 """
 
 from __future__ import annotations

@@ -9,13 +9,31 @@ RPC with channel reads/writes).
 
 Compilation here: walk the graph, allocate one channel per produced value
 (readers = consuming actors and/or the driver), install a loop in every
-participating actor via the ``__rtpu_call_fn__`` hook, and drive executions by
-writing the input channel and reading the terminal channels. Teardown closes
-the input channel; ChannelClosed unwinds every actor loop.
+participating actor via the ``__rtpu_call_fn__`` hook, and drive executions
+by writing the input channel and reading the terminal channels. In cluster
+mode channels default to the direct peer-to-peer transport
+(ray_tpu/dag/direct.py): the head KV is consulted once at compile time for
+route exchange, then every step's dataflow moves actor-to-actor with zero
+control-plane RPCs (``dag_channel="kv"`` selects the head-KV fallback).
+
+Execution is pipelined: ``execute_async()`` admits up to
+``dag_max_inflight`` invocations into the stage pipeline (backpressure
+blocks the submitter beyond that; per-hop channel capacity bounds each
+edge), and a completion thread retires them in FIFO order. ``execute()`` is
+the synchronous single-result wrapper. The first failure — an in-actor
+exception surfaced on the actor's error channel, or the real
+``ActorDiedError`` of a killed stage harvested from its loop ref — fails
+every in-flight execution and is cached: all subsequent executes re-raise
+it instead of timing out on a dead pipeline. Teardown closes the input
+channel, drains in-flight values so ack-gated writers can unwind, and
+force-closes every channel.
 """
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import deque
 from typing import Any
 
 from ray_tpu.dag.channel import ChannelClosed, LocalChannel, StoreChannel
@@ -64,9 +82,13 @@ def _actor_loop(instance, ops: list[dict], error_channel,
 
     rt = global_worker.runtime
     for op in ops:
-        for kind, chan, _ in op["reads"]:
+        for kind, chan, ridx in op["reads"]:
             if kind == "chan":
                 chan.connect(rt)
+                # Direct channels: attach + publish the route BEFORE any
+                # writer resolves it (the one compile-time KV write).
+                if hasattr(chan, "ensure_reader"):
+                    chan.ensure_reader(ridx)
         if op["write"] is not None:
             op["write"].connect(rt)
     error_channel.connect(rt)
@@ -133,34 +155,73 @@ def _actor_loop(instance, ops: list[dict], error_channel,
             return f"error: {e!r}"
 
 
+class _DagFailure(Exception):
+    """Internal: carries the root-cause exception to the completion loop."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
 class CompiledDAG:
     def __init__(self, root: DAGNode, *, _overlap_execution: bool = False,
-                 _device_channels: bool = False):
+                 _device_channels: bool = False,
+                 _channel_kind: str | None = None,
+                 _max_inflight: int | None = None,
+                 _channel_capacity: int | None = None):
         """``_overlap_execution`` turns on the overlapped schedule pass
         (reference: compiled_dag_node.py:2042) — channel reads post early
         on a transfer thread so inbound bytes move while earlier ops
         compute. ``_device_channels`` wraps every channel in DeviceChannel
         so jax arrays land on the reader's device (reference: the
-        accelerator channel registered via accelerator_context.py:222)."""
+        accelerator channel registered via accelerator_context.py:222).
+        ``_channel_kind`` overrides the ``dag_channel`` knob ("direct" |
+        "kv"; local mode always uses in-process queues); ``_max_inflight``
+        and ``_channel_capacity`` override the ``dag_max_inflight`` /
+        ``dag_channel_capacity`` knobs."""
         import ray_tpu
         from ray_tpu.core.worker import global_worker
+        from ray_tpu.utils.config import get_config
 
         import uuid
 
         ray_tpu.init(ignore_reinit_error=True)
+        cfg = get_config()
         self._root = root
         self._rt = global_worker.runtime
         self._local = global_worker.mode == "local"
         self._overlap = _overlap_execution
         self._device_channels = _device_channels
+        self._channel_kind = _channel_kind or cfg.dag_channel
+        self._max_inflight = max(1, _max_inflight or cfg.dag_max_inflight)
+        self._channel_capacity = _channel_capacity
         self._torn_down = False
         self._dag_id = uuid.uuid4().hex[:12]  # globally unique channel prefix
+        # Pipelined-execution state: a bounded admission window, the FIFO
+        # of in-flight futures, and the sticky first failure.
+        self._window = threading.BoundedSemaphore(self._max_inflight)
+        self._pending: deque = deque()
+        self._submit_lock = threading.Lock()
+        self._completer: threading.Thread | None = None
+        self._completer_lock = threading.Lock()
+        self._completer_stop = threading.Event()
+        self._work = threading.Event()
+        self._error: BaseException | None = None
+        self._error_msg: str | None = None
         self._compile()
 
     # ------------------------------------------------------------------ compile
     def _make_channel(self, name: str, num_readers: int):
-        chan = (LocalChannel(name, num_readers) if self._local
-                else StoreChannel(name, num_readers))
+        if self._local:
+            chan = LocalChannel(name, num_readers,
+                                maxsize=self._channel_capacity)
+        elif self._channel_kind == "kv":
+            chan = StoreChannel(name, num_readers)
+        else:
+            from ray_tpu.dag.direct import DirectChannel
+
+            chan = DirectChannel(name, num_readers,
+                                 capacity=self._channel_capacity)
         if self._device_channels:
             from ray_tpu.dag.communicator import (
                 get_accelerator_communicator,
@@ -249,6 +310,7 @@ class CompiledDAG:
                     "reads": reads,
                     "const_kwargs": const_kwargs,
                     "write": self._channels.get(node.node_id),
+                    "rank": getattr(node, "schedule_rank", None),
                 })
             elif isinstance(node, MultiOutputNode):
                 for up in node.outputs:
@@ -258,12 +320,37 @@ class CompiledDAG:
             self._output_plan.append(
                 (self._channels[terminal.node_id], claim(terminal.node_id)))
 
+        # Per-actor op ORDER defaults to the topological walk order, which
+        # serializes multi-microbatch graphs (a DFS chain interleaves each
+        # microbatch's forward with its backward). Nodes may carry a
+        # ``schedule_rank`` attribute to impose an explicit order — the MPMD
+        # builder (ray_tpu/dag/mpmd.py) uses it to emit GPipe / 1F1B
+        # per-stage schedules. Sorted only when EVERY op of the actor is
+        # ranked: a partial ranking cannot be checked for feasibility.
+        for key, ops in schedules.items():
+            if all(op["rank"] is not None for op in ops):
+                ops.sort(key=lambda op: op["rank"])
+
         # One error channel per actor: channels are single-writer, and a
         # shared one would interleave writers' sequence numbers.
         self._error_channels = {
             key: self._make_channel(f"dag{self._dag_id}/err/{key}", 1)
             for key in schedules
         }
+
+        # Driver attaches its reader ends FIRST (direct channels publish
+        # their routes here — the compile-time KV exchange), so no actor
+        # writer ever waits on a late driver registration.
+        for chan, ridx in self._output_plan:
+            chan.connect(self._rt)
+            if hasattr(chan, "ensure_reader"):
+                chan.ensure_reader(ridx)
+        for chan in self._error_channels.values():
+            chan.connect(self._rt)
+            if hasattr(chan, "ensure_reader"):
+                chan.ensure_reader(0)
+        self._in_chan = self._channels[self._input_node.node_id].connect(
+            self._rt)
 
         # Install the loops.
         self._loop_refs = []
@@ -272,56 +359,248 @@ class CompiledDAG:
             self._loop_refs.append(
                 handle._call_fn(_actor_loop, ops, self._error_channels[key],
                                 self._overlap))
-        for chan in self._error_channels.values():
-            chan.connect(self._rt)
-
-        # Driver connects its ends.
-        self._in_chan = self._channels[self._input_node.node_id].connect(self._rt)
-        for chan, _ in self._output_plan:
-            chan.connect(self._rt)
 
     # ------------------------------------------------------------------ execute
     def execute(self, *input_values, timeout: float | None = 60.0):
         """One synchronous execution through the compiled pipeline."""
+        import concurrent.futures as cf
+
+        fut = self.execute_async(*input_values)
+        try:
+            return fut.result(timeout)
+        except cf.TimeoutError:
+            raise TimeoutError(
+                f"compiled DAG execution timed out after {timeout}s"
+            ) from None
+
+    def execute_async(self, *input_values):
+        """Admit one execution into the pipeline and return its
+        ``concurrent.futures.Future``. Up to ``dag_max_inflight``
+        executions overlap across stages (GPipe-style fill); beyond that
+        the call blocks until the oldest retires (backpressure). Results
+        retire in submission order. The first stage failure fails every
+        in-flight future and is re-raised by all later submissions."""
+        import concurrent.futures as cf
+
         if self._torn_down:
             raise RuntimeError("compiled DAG has been torn down")
+        if self._error is not None:
+            raise self._error
+        while not self._window.acquire(timeout=0.1):
+            if self._error is not None:
+                raise self._error
+            if self._torn_down:
+                raise RuntimeError("compiled DAG has been torn down")
         value = input_values[0] if len(input_values) == 1 else input_values
-        self._in_chan.write(value)
-        outs = []
-        for chan, reader_idx in self._output_plan:
-            try:
-                outs.append(chan.read(reader_idx, timeout=timeout))
-            except (TimeoutError, ChannelClosed):
-                # A failed step closes its channels after reporting; surface
-                # the actor's error rather than the secondary symptom.
-                err = self._poll_error(timeout=0.5)
-                if err is not None:
-                    raise RuntimeError(
-                        f"compiled DAG execution failed: {err}") from None
-                raise
-        return outs if self._multi_output else outs[0]
+        fut: cf.Future = cf.Future()
+        try:
+            with self._submit_lock:
+                # Append BEFORE writing: the completion thread retires
+                # futures in FIFO order against the pipeline's FIFO
+                # outputs, so both sequences must be built under one lock.
+                self._pending.append(fut)
+                self._in_chan.write(value)
+        except BaseException as e:
+            with self._submit_lock:
+                try:
+                    self._pending.remove(fut)
+                except ValueError:
+                    pass
+            self._window.release()
+            err = self._check_failure(settle=1.0)
+            raise (err if err is not None else e)
+        self._work.set()
+        self._ensure_completer()
+        return fut
 
+    def _ensure_completer(self) -> None:
+        with self._completer_lock:
+            if self._completer is None or not self._completer.is_alive():
+                self._completer = threading.Thread(
+                    target=self._completer_main,
+                    name=f"dag-{self._dag_id}-completer", daemon=True)
+                self._completer.start()
+
+    def _completer_main(self) -> None:
+        """Retire in-flight executions in FIFO order: read the terminal
+        channels once per pending future, resolve it, free its window slot.
+        On any failure sign, harvest the ROOT cause (dead-actor loop refs
+        first, then error frames) and fail everything in flight."""
+        while not self._completer_stop.is_set():
+            if not self._pending:
+                self._work.wait(timeout=0.1)
+                self._work.clear()
+                continue
+            fut = self._pending[0]
+            try:
+                outs = []
+                for chan, reader_idx in self._output_plan:
+                    outs.append(self._read_output(chan, reader_idx))
+                if self._completer_stop.is_set():
+                    return
+                result = outs if self._multi_output else outs[0]
+                self._retire(fut, value=result)
+            except _DagFailure as e:
+                self._fail_inflight(e.cause)
+                return
+            except _CompleterStopped:
+                return
+            except BaseException as e:  # noqa: BLE001
+                self._fail_inflight(e)
+                return
+
+    def _read_output(self, chan, reader_idx: int):
+        while True:
+            try:
+                return chan.read(reader_idx, timeout=0.25)
+            except TimeoutError:
+                if self._completer_stop.is_set():
+                    raise _CompleterStopped() from None
+                err = self._check_failure()
+                if err is not None:
+                    raise _DagFailure(err) from None
+            except ChannelClosed:
+                # A failed stage closes its channels after reporting;
+                # surface the actor's own error, not the secondary symptom.
+                err = self._check_failure(settle=3.0)
+                if err is None:
+                    err = self._set_error(RuntimeError(
+                        "compiled DAG output channel closed"))
+                raise _DagFailure(err) from None
+
+    def _retire(self, fut, value=None, exc: BaseException | None = None):
+        with self._submit_lock:
+            try:
+                self._pending.remove(fut)
+            except ValueError:
+                pass
+        if exc is not None:
+            if not fut.done():
+                fut.set_exception(exc)
+        elif not fut.done():
+            fut.set_result(value)
+        self._window.release()
+
+    def _set_error(self, exc: BaseException) -> BaseException:
+        """First error wins — later failures are secondary symptoms.
+        Submitter and completer both race to publish."""
+        with self._submit_lock:
+            if self._error is None:
+                self._error = exc
+            return self._error
+
+    def _fail_inflight(self, cause: BaseException) -> None:
+        cause = self._set_error(cause)
+        while self._pending:
+            self._retire(self._pending[0], exc=cause)
+
+    # ------------------------------------------------------------------ errors
     def _poll_error(self, timeout: float = 0.001):
+        """First error frame reported by any actor loop. The frame is
+        consumed once and CACHED — every later poll (and every later
+        execute) sees the same first error instead of a secondary
+        timeout."""
+        if self._error_msg is not None:
+            return self._error_msg
         for chan in self._error_channels.values():
             try:
                 kind, msg = chan.read(0, timeout=timeout)
                 if kind == "error":
+                    with self._submit_lock:
+                        self._error_msg = msg
                     return msg
             except Exception:
                 continue
         return None
 
+    def _check_failure(self, settle: float = 0.0) -> BaseException | None:
+        """Root-cause harvest: a dead stage actor's loop ref raises the
+        real ``ActorDiedError`` (preferred over any secondary channel
+        symptom); an in-actor exception arrives as an error frame. With
+        ``settle`` > 0, poll for up to that long before giving up — death
+        notifications race the channel teardown cascade."""
+        import ray_tpu
+
+        if self._error is not None:
+            return self._error
+        deadline = time.monotonic() + settle
+        soft: str | None = None
+        while True:
+            for ref in list(self._loop_refs):
+                try:
+                    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+                except Exception:
+                    continue
+                if not ready:
+                    continue
+                try:
+                    res = ray_tpu.get(ref)
+                except BaseException as e:  # the real actor death
+                    return self._set_error(e)
+                if isinstance(res, str) and res.startswith("error:"):
+                    soft = res[len("error:"):].strip()
+            msg = self._poll_error(timeout=0.01)
+            if msg is None and soft is not None:
+                with self._submit_lock:
+                    self._error_msg = msg = soft
+            if msg is not None:
+                return self._set_error(RuntimeError(
+                    f"compiled DAG execution failed: {msg}"))
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.05)
+
     # ------------------------------------------------------------------ teardown
     def teardown(self):
-        """Close the input channel; each actor loop cascades the close to its
-        own output channels and exits."""
+        """Close the input channel; each actor loop cascades the close to
+        its own output channels and exits. In-flight executions are
+        drained (so ack-gated writers can unwind) and their futures fail
+        as torn down."""
         if self._torn_down:
             return
         self._torn_down = True
+        self._completer_stop.set()
+        self._work.set()
+        if self._completer is not None:
+            self._completer.join(timeout=5.0)
         try:
             self._in_chan.close()
         except Exception:
             pass
+        # Drain whatever the pipeline still produces: every consumed output
+        # acks its upstream writer, letting each stage reach (and cascade)
+        # the close marker instead of wedging on channel backpressure. A
+        # failed DAG skips the long drain — its loops already unwound (or
+        # died), so waiting out the deadline would just stall teardown.
+        deadline = time.monotonic() + (1.0 if self._error is not None
+                                       else 10.0)
+        open_outputs = set(range(len(self._output_plan)))
+        while open_outputs and time.monotonic() < deadline:
+            progressed = False
+            for i in list(open_outputs):
+                chan, reader_idx = self._output_plan[i]
+                try:
+                    chan.read(reader_idx, timeout=0.2)
+                    progressed = True
+                except TimeoutError:
+                    continue
+                except Exception:
+                    open_outputs.discard(i)
+            if not progressed and not open_outputs:
+                break
+        # Reclaim channel resources (registry entries locally; KV slots,
+        # route keys and receiver queues in cluster mode). Destroy BEFORE
+        # waiting on the loops: direct channels force-close every attached
+        # reader, which is what unwedges a loop blocked reading from a DEAD
+        # upstream stage (its writer will never send a close marker) — the
+        # healthy path already drained to quiescence above, so nothing is
+        # truncated.
+        for chan in list(self._channels.values()) + list(
+                self._error_channels.values()):
+            try:
+                chan.connect(self._rt).destroy()
+            except Exception:
+                pass
         # The loop results confirm shutdown (and surface loop errors in tests).
         import ray_tpu
 
@@ -330,17 +609,19 @@ class CompiledDAG:
                          timeout=10.0)
         except Exception:
             pass
-        # Reclaim channel resources (registry entries locally; KV slots and
-        # cursors in cluster mode) now that every loop has exited.
-        for chan in list(self._channels.values()) + list(
-                self._error_channels.values()):
-            try:
-                chan.connect(self._rt).destroy()
-            except Exception:
-                pass
+        # Fail anything still in flight with the cached root cause if one
+        # exists, else as torn down.
+        exc = self._error or RuntimeError(
+            "compiled DAG torn down with executions in flight")
+        while self._pending:
+            self._retire(self._pending[0], exc=exc)
 
     def __del__(self):
         try:
             self.teardown()
         except Exception:
             pass
+
+
+class _CompleterStopped(Exception):
+    pass

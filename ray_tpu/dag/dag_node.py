@@ -21,6 +21,13 @@ _node_counter = itertools.count()
 class DAGNode:
     """Base: a lazily-bound computation with upstream dependencies."""
 
+    # Optional per-actor execution order. When EVERY op bound to an actor
+    # carries a rank, CompiledDAG._compile sorts that actor's op list by it
+    # (ties broken by graph walk order); otherwise walk order stands. This
+    # is how pipeline schedules (ray_tpu/dag/schedule.py) interleave
+    # microbatch forwards/backwards instead of running chains serially.
+    schedule_rank: int | None = None
+
     def __init__(self):
         self.node_id = next(_node_counter)
 

@@ -1,0 +1,395 @@
+"""DirectChannel: peer-to-peer compiled-graph dataflow off the head.
+
+The head-KV channel (`channel.StoreChannel`) pays two control-plane RPCs
+per hop per step and busy-polls the head for arrival. This transport moves
+every payload peer-to-peer over the same push-frame path direct actor calls
+ride (reference: the experimental_mutable_object_manager transport behind
+python/ray/experimental/channel/ — writers push into the reader's local
+store, readers block locally):
+
+- **Route exchange once, at compile time.** Each reader publishes
+  ``dagchan/<name>/<idx>`` → (worker, host, port, node) to the head KV when
+  it first attaches; the writer resolves each route once and caches it for
+  the channel's lifetime. After warmup the steady state issues ZERO head
+  RPCs per step.
+- **Data plane.** Small payloads ride inline in a ``dag_chan_push`` frame
+  to the reader's own RPC server (every cluster process runs one). Large
+  payloads — activations/grads — are placed in the object plane as
+  store-backed buffers (node shm arena beyond the threshold) and the frame
+  carries only the ref: same-host readers map a pinned arena view
+  (zero-copy), cross-host readers pull ranges over the native transfer
+  plane. The ndarray fast path of ``serialization.serialize_parts`` means
+  array payloads are never pickled byte-by-byte on the hot path.
+- **Backpressure.** The reader acks a frame only after its ``read()``
+  dequeued AND materialized the value; the writer keeps at most
+  ``capacity`` writes unacked and blocks on the oldest beyond that. A dead
+  reader process fails the pending acks (``RpcConnectionLost``), which
+  surfaces as ``ChannelClosed`` at the writer instead of a silent wedge.
+
+Known limitation (shared with StoreChannel): in a fan-in schedule where one
+input closes while a peer writer is ack-blocked mid-write, that writer
+unwedges only when its reader process exits or the DAG is destroyed
+(``destroy()`` force-closes every registered reader peer-to-peer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from ray_tpu.dag.channel import ChannelClosed
+from ray_tpu.utils import serialization
+from ray_tpu.utils.config import get_config
+
+_ROUTE_NS = "channels"
+
+
+class _Receiver:
+    """Per-(channel, reader-index) inbound frame queue of this process.
+
+    Unbounded on purpose: the io loop's enqueue must never block (writer
+    windows — not queue depth — bound memory: at most ``capacity`` unacked
+    frames per writer exist at once)."""
+
+    __slots__ = ("queue",)
+
+    def __init__(self):
+        self.queue: queue.Queue = queue.Queue()
+
+
+_receivers: dict[tuple[str, int], _Receiver] = {}
+_recv_lock = threading.Lock()
+
+
+def _receiver(name: str, idx: int) -> _Receiver:
+    with _recv_lock:
+        r = _receivers.get((name, idx))
+        if r is None:
+            r = _receivers[(name, idx)] = _Receiver()
+        return r
+
+
+def _drop_receivers(name: str) -> None:
+    with _recv_lock:
+        for key in [k for k in _receivers if k[0] == name]:
+            _receivers.pop(key, None)
+
+
+def handle_chan_push(conn, msg: dict) -> None:
+    """Raw RPC handler (io-loop inline, registered on every cluster
+    process's server): enqueue the frame for the local reader thread. The
+    reply is NOT sent here — the reader acks from ``read()`` after
+    materializing, which is what makes writer-side capacity into real
+    end-to-end backpressure."""
+    a = msg.get("a") or {}
+    rid = msg.get("i")
+    ack = None
+    if rid is not None:
+        loop = asyncio.get_running_loop()
+        from ray_tpu.core.cluster.protocol import pack_reply
+
+        def ack(err: str | None = None, *, _rid=rid, _conn=conn, _loop=loop):
+            frame = pack_reply(_rid, True) if err is None else \
+                pack_reply(_rid, err=err)
+            _loop.call_soon_threadsafe(_conn.post, frame)
+
+    _receiver(a["chan"], a.get("ridx", 0)).queue.put((a, ack))
+
+
+class DirectChannel:
+    """Single-writer multi-reader channel over direct push frames.
+
+    Pickles by identity (name + shape); cursors, routes, and the runtime
+    binding are per-process, exactly like StoreChannel."""
+
+    def __init__(self, name: str, num_readers: int = 1,
+                 capacity: int | None = None,
+                 inline_max: int | None = None):
+        cfg = get_config()
+        self.name = name
+        self.num_readers = num_readers
+        self.capacity = capacity if capacity is not None \
+            else cfg.dag_channel_capacity
+        self.inline_max = inline_max if inline_max is not None \
+            else cfg.dag_inline_max_bytes
+        self._init_state()
+
+    def _init_state(self):
+        self._runtime = None
+        self._routes: dict[int, tuple] = {}  # ridx -> (worker, host, port)
+        self._outstanding: deque = deque()  # (ack cf-futures, held ref)
+        self._write_seq = 0
+        self._registered: set[int] = set()
+        self._closed_local = False
+
+    def __getstate__(self):
+        return {"name": self.name, "num_readers": self.num_readers,
+                "capacity": self.capacity, "inline_max": self.inline_max}
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._init_state()
+
+    def connect(self, runtime) -> "DirectChannel":
+        if self._runtime is None:
+            self._runtime = runtime
+        return self
+
+    # ---------------------------------------------------------------- routes
+    def _route_key(self, reader_index: int) -> str:
+        return f"dagchan/{self.name}/{reader_index}"
+
+    def ensure_reader(self, reader_index: int = 0) -> None:
+        """Attach this process as the channel's ``reader_index`` reader:
+        create the local frame queue FIRST, then publish the route (the one
+        compile-time head write) — any frame that finds the route finds the
+        queue."""
+        if reader_index in self._registered:
+            return
+        assert self._runtime is not None, "channel not connected"
+        rt = self._runtime
+        _receiver(self.name, reader_index)
+        route = [rt.worker_id.hex(), rt.addr[0], rt.addr[1],
+                 getattr(rt, "my_node_id", "") or ""]
+        rt.kv_put(self._route_key(reader_index),
+                  json.dumps(route).encode(), ns=_ROUTE_NS)
+        self._registered.add(reader_index)
+
+    def _resolve_route(self, reader_index: int,
+                       timeout: float | None = 60.0) -> tuple | None:
+        """Writer-side route lookup, cached for the channel's lifetime.
+        Polls the KV until the reader has attached (compile/warmup time
+        only — never on the per-step path)."""
+        route = self._routes.get(reader_index)
+        if route is not None:
+            return route
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            raw = self._runtime.kv_get(self._route_key(reader_index),
+                                       ns=_ROUTE_NS)
+            if raw is not None:
+                route = tuple(json.loads(bytes(raw)))
+                if route[0] != self._runtime.worker_id.hex():
+                    # Warm the peer connection NOW: with the client cached,
+                    # every later send's coroutine runs to its frame write
+                    # without suspending, so wire order == write() order
+                    # (racing first-sends could otherwise land on two
+                    # different connections and reorder). The ROUTE timeout
+                    # does not govern this step: timeout=0 means "don't
+                    # wait for a reader that never attached", but once the
+                    # route exists the connect must get a real budget (a
+                    # zero-budget connect would silently drop force-close
+                    # frames at destroy time).
+                    self._runtime._io.run(
+                        self._runtime._apeer((route[1], route[2])),
+                        timeout=None if timeout is None
+                        else max(timeout, 5.0))
+                self._routes[reader_index] = route
+                return route
+            if deadline is not None and time.monotonic() > deadline:
+                return None
+            time.sleep(0.005)
+
+    # ---------------------------------------------------------------- write
+    def _send(self, route: tuple, payload: dict):
+        """Ship one frame to a reader; returns a concurrent future that
+        resolves when the reader ACKS (has read + materialized the value).
+        Same-process readers bypass the wire entirely."""
+        rt = self._runtime
+        import concurrent.futures as cf
+
+        if route[0] == rt.worker_id.hex():
+            fut: cf.Future = cf.Future()
+
+            def ack(err: str | None = None):
+                if err is None:
+                    fut.set_result(True)
+                else:
+                    fut.set_exception(ChannelClosed(
+                        f"{self.name}: reader failed: {err}"))
+
+            _receiver(self.name, payload.get("ridx", 0)).queue.put(
+                (payload, ack))
+            return fut
+
+        addr = (route[1], route[2])
+
+        async def go():
+            cli = await rt._apeer(addr)
+            return await cli.call_nowait("dag_chan_push", **payload)
+
+        return asyncio.run_coroutine_threadsafe(go(), rt._io.loop)
+
+    def write(self, value: Any) -> None:
+        assert self._runtime is not None, "channel not connected"
+        if self._closed_local:
+            raise ChannelClosed(self.name)
+        rt = self._runtime
+        parts = serialization.serialize_parts(value)
+        total = sum(len(p) for p in parts)
+        payload: dict = {"chan": self.name, "seq": self._write_seq}
+        ref = None
+        if total <= self.inline_max:
+            payload["data"] = b"".join(bytes(p) for p in parts)
+        else:
+            # Store-backed buffer: bytes land once in the object plane
+            # (node arena when large); the frame carries the ref plus our
+            # own address so the reader never resolves us through the head.
+            from ray_tpu.core.object_ref import ObjectRef
+            from ray_tpu.utils.ids import ObjectID
+
+            oid = ObjectID.for_put(rt.worker_id)
+            rt._store_blob(oid, parts, rt.worker_id)
+            rt.refs.add_owned(oid, rt.worker_id, local_refs=1)
+            ref = ObjectRef.counted(oid, rt.worker_id)
+            payload.update(oid=oid.hex(), owner=rt.worker_id.hex(),
+                           whost=rt.addr[0], wport=rt.addr[1],
+                           wnode=getattr(rt, "my_node_id", "") or "")
+        futs = []
+        for ridx in range(self.num_readers):
+            route = self._resolve_route(ridx)
+            if route is None:
+                raise TimeoutError(
+                    f"channel {self.name}: reader {ridx} never attached")
+            futs.append(self._send(route, dict(payload, ridx=ridx)))
+        # The held ref keeps the store-backed buffer alive until every
+        # reader acked; dropped when the entry drains off the window.
+        self._outstanding.append((futs, ref))
+        self._write_seq += 1
+        while len(self._outstanding) > self.capacity:
+            self._drain_oldest()
+
+    def _drain_oldest(self) -> None:
+        import concurrent.futures as cf
+
+        futs, _ref = self._outstanding.popleft()
+        for f in futs:
+            while True:
+                try:
+                    f.result(timeout=0.5)
+                    break
+                except (cf.TimeoutError, TimeoutError):
+                    continue  # backpressure stall: reader still busy
+                except ChannelClosed:
+                    raise
+                except Exception as e:  # conn lost / reader errored
+                    raise ChannelClosed(
+                        f"{self.name}: reader gone: {e!r}") from e
+
+    def flush(self, timeout: float | None = None) -> None:
+        """Block until every outstanding write is acked (bench/test hook)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._outstanding:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} flush")
+            self._drain_oldest()
+
+    # ---------------------------------------------------------------- read
+    def read(self, reader_index: int = 0,
+             timeout: float | None = None) -> Any:
+        assert self._runtime is not None, "channel not connected"
+        self.ensure_reader(reader_index)
+        q = _receiver(self.name, reader_index).queue
+        try:
+            a, ack = q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(f"channel {self.name}") from None
+        if a.get("close"):
+            # Re-enqueue so every subsequent read re-raises immediately.
+            q.put((a, None))
+            if ack is not None:
+                ack()
+            raise ChannelClosed(self.name)
+        try:
+            value = self._materialize(a)
+        except BaseException as e:
+            if ack is not None:
+                ack(err=repr(e))
+            raise
+        if ack is not None:
+            ack()
+        return value
+
+    def _materialize(self, a: dict) -> Any:
+        data = a.get("data")
+        if data is not None:
+            return serialization.deserialize(data)
+        rt = self._runtime
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.utils.ids import ObjectID, WorkerID
+
+        oid = ObjectID.from_hex(a["oid"])
+        # Same-host fast path: pinned arena view, zero copies, zero RPCs.
+        blob = rt._local_blob(oid, as_view=True)
+        if blob is not None:
+            return serialization.deserialize(blob)
+        # Cross-host: seed the worker directory from the frame's route info
+        # so the borrower pull targets the writer directly (transfer-plane
+        # range pulls) without a head resolve.
+        owner_hex = a["owner"]
+        if a.get("whost"):
+            rt._worker_dir_cache[owner_hex] = (
+                time.monotonic(), (a["whost"], a["wport"]),
+                a.get("wnode", ""))
+        ref = ObjectRef(oid, WorkerID.from_hex(owner_hex))
+        return rt.get([ref])[0]
+
+    # ---------------------------------------------------------------- close
+    def _send_close(self, reader_index: int, route_timeout: float) -> None:
+        """Unacked close marker (notify frame): a reader whose loop already
+        exited would never ack, and teardown must not wait on it."""
+        try:
+            route = self._resolve_route(reader_index, timeout=route_timeout)
+        except Exception:
+            route = None
+        if route is None:
+            return
+        payload = {"chan": self.name, "ridx": reader_index, "close": True}
+        rt = self._runtime
+        if route[0] == rt.worker_id.hex():
+            _receiver(self.name, reader_index).queue.put((payload, None))
+            return
+        addr = (route[1], route[2])
+
+        async def go():
+            cli = await rt._apeer(addr)
+            await cli.notify("dag_chan_push", **payload)
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                go(), rt._io.loop).result(timeout=5.0)
+        except Exception:
+            pass  # peer gone: its loops are dead anyway
+
+    def close(self) -> None:
+        """Writer-side close: FIFO close marker to every attached reader
+        (queued behind any unread data frames, exactly like the KV
+        channel's append-only marker)."""
+        if self._closed_local:
+            return
+        self._closed_local = True
+        for ridx in range(self.num_readers):
+            self._send_close(ridx, route_timeout=2.0)
+
+    def destroy(self) -> None:
+        """Teardown: force-close every reader that ever attached (unblocks
+        loops wedged on a dead upstream), then reclaim the route keys and
+        this process's receiver queues."""
+        rt = self._runtime
+        if rt is None:
+            return
+        self._closed_local = True
+        for ridx in range(self.num_readers):
+            self._send_close(ridx, route_timeout=0.0)
+        self._outstanding.clear()
+        for key in rt.kv_keys(prefix=f"dagchan/{self.name}/", ns=_ROUTE_NS):
+            rt.kv_del(key, ns=_ROUTE_NS)
+        _drop_receivers(self.name)
+
+
+__all__ = ["DirectChannel", "handle_chan_push"]

@@ -1,0 +1,517 @@
+"""MPMD pipeline parallelism over compiled graphs.
+
+The SPMD pipeline (ray_tpu/parallel/pipeline.py) compiles the WHOLE
+pipeline into one program and moves activations with ``lax.ppermute`` over
+a mesh axis — right when every stage lives in one jit on one mesh. This
+module is the complementary MPMD form (reference: the pipeline-parallel
+examples built on compiled graphs — each stage its own actor + its own
+compiled program, activations flowing over channels): stage k is an actor
+owning its parameter shard and TWO jitted programs (forward, backward);
+one ``CompiledDAG.execute()`` is one optimizer step over
+``num_microbatches`` microbatches. The per-stage op order (GPipe fill/
+drain by default, 1F1B selectable — ray_tpu/dag/schedule.py) is stamped
+onto the DAG as ``schedule_rank``, and the microbatch overlap falls out of
+the static schedules: stage k runs microbatch m's forward while stage k+1
+runs m-1's.
+
+Numerics are EXACTLY the SPMD pipeline's (tests/test_mpmd.py proves loss
+parity): grads accumulate per microbatch as d(nll_sum), are normalized
+once by the step's total token count at apply time (linearity — matches
+normalizing inside the grad), and each stage applies its own optimizer
+partition (per-leaf transforms like adamw make the partitioned update
+identical to the full one). Embeddings belong to stage 0 and
+final-norm/lm-head to the last stage, which is exactly where the SPMD
+psum leaves their gradients.
+
+Payloads cross stages as (activation, targets) tuples of host ndarrays:
+channels carry ndarrays zero-copy (store-backed buffers / arena views in
+cluster mode), and targets ride along to the last stage instead of taking
+a second driver route.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import numpy as np
+
+from ray_tpu.dag.dag_node import InputNode, MultiOutputNode
+from ray_tpu.dag.schedule import PipelineSchedule, get_schedule
+
+
+class StageProgram:
+    """What one pipeline stage computes. Built ON the stage actor by a
+    picklable factory ``factory(stage_index, num_stages) -> StageProgram``.
+
+    Non-last stages implement ``forward``/``backward``; the last stage
+    implements ``loss_forward`` (loss + its backward fused — the loss
+    gradient seeds there, so a separate backward op would just stash and
+    reload the residual)."""
+
+    def init_params(self) -> Any:
+        raise NotImplementedError
+
+    def optimizer(self):
+        import optax
+
+        return optax.adamw(3e-4, weight_decay=0.1)
+
+    def forward(self, params, x) -> tuple[Any, Any]:
+        """x -> (y, residual). The residual is whatever backward needs —
+        storing the stage INPUT and rematerializing in backward keeps the
+        channel payloads activation-sized."""
+        raise NotImplementedError
+
+    def loss_forward(self, params, x, targets) -> tuple[float, Any, Any]:
+        """Last stage: -> (loss_sum, param_grads, dx). Unnormalized sum —
+        the framework divides by the step's token count at apply."""
+        raise NotImplementedError
+
+    def backward(self, params, residual, dy) -> tuple[Any, Any]:
+        """-> (param_grads, dx); dx may be None on the first stage."""
+        raise NotImplementedError
+
+    def count(self, x, targets) -> int:
+        """This microbatch's contribution to the loss normalizer."""
+        return int(np.size(targets))
+
+
+class _PipelineStage:
+    """Actor framework around a StageProgram: microbatch slicing, residual
+    stash, gradient accumulation, optimizer apply. One compiled-DAG
+    execution runs ingest → M forwards → M backwards → apply, in the
+    schedule's order."""
+
+    def __init__(self, factory, stage_index: int, num_stages: int,
+                 num_microbatches: int):
+        self.stage = stage_index
+        self.num_stages = num_stages
+        self.M = num_microbatches
+        self.is_first = stage_index == 0
+        self.is_last = stage_index == num_stages - 1
+        self.program = factory(stage_index, num_stages)
+        self.params = self.program.init_params()
+        self.opt = self.program.optimizer()
+        self.opt_state = self.opt.init(self.params)
+        self._resid: dict[int, Any] = {}
+        self._gacc = None
+        self._loss_sum = 0.0
+        self._count = 0
+        self._step = 0
+        self._mb_x: list | None = None
+        self._mb_t: list | None = None
+
+    # -- schedule ops -------------------------------------------------------
+    def ingest(self, batch):
+        x, targets = batch
+        if np.shape(x)[0] % self.M:
+            raise ValueError(
+                f"batch dim {np.shape(x)[0]} must divide "
+                f"num_microbatches={self.M}")
+        self._mb_x = np.split(np.asarray(x), self.M)
+        self._mb_t = np.split(np.asarray(targets), self.M)
+        return self._step  # tiny marker fanned out to the forward ops
+
+    def forward(self, payload, mb: int):
+        if self.is_first:
+            x, tgt = self._mb_x[mb], self._mb_t[mb]
+        else:
+            x, tgt = payload
+        y, resid = self.program.forward(self.params, x)
+        self._resid[mb] = resid
+        self._count += self.program.count(x, tgt)
+        return (np.asarray(y), tgt)
+
+    def forward_loss(self, payload, mb: int):
+        x, tgt = payload
+        loss_sum, grads, dx = self.program.loss_forward(self.params, x, tgt)
+        self._accumulate(grads)
+        self._loss_sum += float(loss_sum)
+        self._count += self.program.count(x, tgt)
+        return np.asarray(dx)
+
+    def backward(self, dy, mb: int):
+        resid = self._resid.pop(mb)
+        grads, dx = self.program.backward(self.params, resid, dy)
+        self._accumulate(grads)
+        # First stage ends the chain: a tiny marker instead of a dx nobody
+        # consumes (the driver reads it to anchor the microbatch chains).
+        return mb if dx is None else np.asarray(dx)
+
+    def apply_grads(self, _trigger):
+        import jax
+        import optax
+
+        norm = float(max(self._count, 1))
+        grads = jax.tree.map(lambda g: g / norm, self._gacc)
+        updates, self.opt_state = self.opt.update(grads, self.opt_state,
+                                                  self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        self._step += 1
+        metrics = {
+            "stage": self.stage,
+            "step": self._step,
+            "tokens": self._count,
+            "loss": (self._loss_sum / norm) if self.is_last else None,
+        }
+        self._gacc = None
+        self._loss_sum = 0.0
+        self._count = 0
+        self._resid.clear()
+        return metrics
+
+    def _accumulate(self, grads):
+        import jax
+        import jax.numpy as jnp
+
+        if self._gacc is None:
+            self._gacc = grads
+        else:
+            self._gacc = jax.tree.map(jnp.add, self._gacc, grads)
+
+
+def build_pipeline_dag(stage_handles: list, num_microbatches: int,
+                       schedule: str | PipelineSchedule = "gpipe"):
+    """Unroll one training step (M microbatch chains, forward then
+    backward, then per-stage apply) into a DAG over ``_PipelineStage``
+    actors, with per-stage op order stamped as ``schedule_rank``."""
+    P = len(stage_handles)
+    M = num_microbatches
+    if P < 2:
+        raise ValueError("MPMD pipelines need at least 2 stages "
+                         "(use train/spmd.py for a single program)")
+    sched = get_schedule(schedule) if isinstance(schedule, str) else schedule
+
+    with InputNode() as inp:
+        ingest = stage_handles[0].ingest.bind(inp)
+        ingest.schedule_rank = 0
+        anchors = []  # first-stage backward markers: chain endpoints
+        last_op = [None] * P  # highest-ranked data op per stage
+        for mb in range(M):
+            # forward chain: stage 0 reads the ingest marker, later stages
+            # read (activation, targets) from the previous stage.
+            prev = ingest
+            for s in range(P - 1):
+                node = stage_handles[s].forward.bind(prev, mb)
+                node.schedule_rank = sched.forward_rank(mb, s, P, M)
+                prev = node
+            node = stage_handles[P - 1].forward_loss.bind(prev, mb)
+            node.schedule_rank = sched.forward_rank(mb, P - 1, P, M)
+            last_op[P - 1] = node
+            # backward chain: dx flows back down to stage 0.
+            dy = node
+            for s in range(P - 2, -1, -1):
+                bnode = stage_handles[s].backward.bind(dy, mb)
+                bnode.schedule_rank = sched.backward_rank(mb, s, P, M)
+                last_op[s] = bnode
+                dy = bnode
+            anchors.append(dy)
+        applies = []
+        for s in range(P):
+            # The read dependency just anchors apply into the graph; the
+            # rank (sorted last) is what actually orders it after every
+            # forward/backward of this stage.
+            anode = stage_handles[s].apply_grads.bind(last_op[s])
+            anode.schedule_rank = sched.apply_rank(s, P, M)
+            applies.append(anode)
+        # Chains 0..M-2 end at unread first-stage markers; routing them to
+        # the driver makes every node reachable from the root. (Chain M-1's
+        # marker is apply_0's trigger and already reachable.)
+        return MultiOutputNode(anchors[:-1] + applies)
+
+
+class MPMDPipeline:
+    """Driver-facing wrapper: stage actors + the compiled step DAG.
+
+    ``step()`` runs one synchronous optimizer step; ``step_async()``
+    returns a future so the driver can keep ``dag_max_inflight`` steps in
+    flight (fill/drain across steps composes with the intra-step microbatch
+    overlap). ``compile_kwargs`` pass through to ``experimental_compile``
+    (e.g. ``_channel_kind="kv"`` or ``_max_inflight``)."""
+
+    def __init__(self, stage_factory: Callable, num_stages: int,
+                 num_microbatches: int, *,
+                 schedule: str | PipelineSchedule = "gpipe",
+                 actor_options: dict | None = None,
+                 **compile_kwargs):
+        import ray_tpu
+
+        actor_cls = ray_tpu.remote(_PipelineStage)
+        if actor_options:
+            actor_cls = actor_cls.options(**actor_options)
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.stages = [
+            actor_cls.remote(stage_factory, i, num_stages, num_microbatches)
+            for i in range(num_stages)
+        ]
+        self._dag = build_pipeline_dag(self.stages, num_microbatches,
+                                       schedule)
+        self.compiled = self._dag.experimental_compile(**compile_kwargs)
+
+    def step(self, x, targets, timeout: float | None = 120.0) -> dict:
+        raw = self.compiled.execute((np.asarray(x), np.asarray(targets)),
+                                    timeout=timeout)
+        return self.parse_result(raw)
+
+    def step_async(self, x, targets):
+        return self.compiled.execute_async(
+            (np.asarray(x), np.asarray(targets)))
+
+    def parse_result(self, raw: list) -> dict:
+        stage_metrics = raw[-self.num_stages:]
+        last = stage_metrics[-1]
+        return {"loss": last["loss"], "step": last["step"],
+                "stage_metrics": stage_metrics}
+
+    def shutdown(self, kill_stages: bool = True) -> None:
+        """Tear down the compiled DAG and (by default) the stage actors the
+        pipeline spawned. Explicit kills beat leaking the handles to GC:
+        the deferred worker churn lands in whatever runs next."""
+        self.compiled.teardown()
+        if kill_stages:
+            import ray_tpu
+
+            for stage in self.stages:
+                try:
+                    ray_tpu.kill(stage, no_restart=True)
+                except Exception:
+                    pass
+
+
+# --------------------------------------------------------------------------
+# Llama stage programs: the SPMD pipeline's exact math, partitioned MPMD.
+# --------------------------------------------------------------------------
+
+class LlamaStageProgram(StageProgram):
+    """One pipeline stage of the llama model (models/llama.py), bitwise-
+    faithful to parallel/pipeline.py's stage_loss: stage 0 owns
+    embed_tokens + its layer slice, the last stage owns its slice +
+    final_norm + lm_head — the same placement the SPMD psum reduces
+    shared-param grads to (embed cotangents only arise on rank 0's inject,
+    head/final-norm cotangents only on the last rank's valid loss).
+    Backward rematerializes from the stashed stage INPUT (jax.vjp of the
+    jitted stage program)."""
+
+    def __init__(self, cfg, stage_index: int, num_stages: int,
+                 attn_impl: str = "blockwise", seed: int = 0,
+                 optimizer_factory: Callable | None = None):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ray_tpu.models.llama import (
+            _layer,
+            init_params,
+            rms_norm,
+            rope_frequencies,
+        )
+
+        if cfg.tie_embeddings:
+            raise ValueError(
+                "MPMD stages need untied embeddings (embed on stage 0, head "
+                "on the last stage); tied weights would need a cross-stage "
+                "grad exchange")
+        if cfg.num_layers % num_stages:
+            raise ValueError("num_layers must divide num_stages")
+        self.cfg = cfg
+        self.is_first = stage_index == 0
+        self.is_last = stage_index == num_stages - 1
+        self._opt_factory = optimizer_factory
+        per = cfg.num_layers // num_stages
+        lo = stage_index * per
+        self._slice = (lo, lo + per)
+        self._seed = seed
+        inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                    cfg.rope_scaling)
+
+        def run_layers(layers, x):
+            positions = jnp.arange(x.shape[1])
+
+            def body(x, lp):
+                return _layer(cfg, x, lp, inv_freq, positions,
+                              attn_impl, None), None
+
+            out, _ = lax.scan(body, x, layers)
+            return out
+
+        if self.is_first:
+            def apply_fn(p, tokens):
+                return run_layers(p["layers"], p["embed_tokens"][tokens])
+        else:
+            def apply_fn(p, x):
+                return run_layers(p["layers"], x)
+
+        if self.is_last:
+            def nll_sum(p, x, targets):
+                h = run_layers(p["layers"], x)
+                xn = rms_norm(h, p["final_norm"], cfg.norm_eps)
+                logits = jnp.einsum("bsh,hv->bsv", xn, p["lm_head"],
+                                    preferred_element_type=jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, targets[..., None], axis=-1)[..., 0]
+                return nll.sum()
+
+            self._loss_fwd = jax.jit(
+                jax.value_and_grad(nll_sum, argnums=(0, 1)))
+        else:
+            self._fwd = jax.jit(apply_fn)
+            if self.is_first:
+                def bwd_fn(p, tokens, dy):
+                    _, vjp = jax.vjp(lambda pp: apply_fn(pp, tokens), p)
+                    return vjp(dy)[0]
+
+                self._bwd = jax.jit(bwd_fn)
+            else:
+                def bwd_fn(p, x, dy):
+                    _, vjp = jax.vjp(apply_fn, p, x)
+                    return vjp(dy)
+
+                self._bwd = jax.jit(bwd_fn)
+
+    def init_params(self):
+        import jax
+
+        from ray_tpu.models.llama import init_params
+
+        # Full init on every stage, then slice: deterministic and identical
+        # to the SPMD init without a cross-stage broadcast (tiny configs;
+        # checkpoint loading would replace this for real sizes).
+        full = init_params(self.cfg, jax.random.PRNGKey(self._seed))
+        lo, hi = self._slice
+        p = {"layers": jax.tree.map(lambda a: a[lo:hi], full["layers"])}
+        if self.is_first:
+            p["embed_tokens"] = full["embed_tokens"]
+        if self.is_last:
+            p["final_norm"] = full["final_norm"]
+            p["lm_head"] = full["lm_head"]
+        return p
+
+    def optimizer(self):
+        if self._opt_factory is not None:
+            return self._opt_factory()
+        return super().optimizer()
+
+    def forward(self, params, x):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        return self._fwd(params, x), x
+
+    def loss_forward(self, params, x, targets):
+        import jax.numpy as jnp
+
+        loss, (gp, gx) = self._loss_fwd(params, jnp.asarray(x),
+                                        jnp.asarray(targets))
+        return float(loss), gp, gx
+
+    def backward(self, params, residual, dy):
+        import jax.numpy as jnp
+
+        dy = jnp.asarray(dy)
+        if self.is_first:
+            return self._bwd(params, jnp.asarray(residual), dy), None
+        gp, gx = self._bwd(params, jnp.asarray(residual), dy)
+        return gp, gx
+
+
+def _llama_stage(cfg, attn_impl, seed, optimizer_factory, stage_index,
+                 num_stages):
+    return LlamaStageProgram(cfg, stage_index, num_stages,
+                             attn_impl=attn_impl, seed=seed,
+                             optimizer_factory=optimizer_factory)
+
+
+def make_llama_stage_factory(cfg, attn_impl: str = "blockwise",
+                             seed: int = 0,
+                             optimizer_factory: Callable | None = None):
+    """Picklable ``factory(stage_index, num_stages)`` for MPMDPipeline."""
+    return partial(_llama_stage, cfg, attn_impl, seed, optimizer_factory)
+
+
+# --------------------------------------------------------------------------
+# Toy stage program: small jitted matmul stages for benches/tests. On a
+# CPU-only box pure compute cannot overlap across actors (one physical
+# core), so ``sleep_s`` emulates per-stage device dwell — the pipelining
+# win the bench measures is schedule overlap, which sleep exhibits exactly.
+# --------------------------------------------------------------------------
+
+class ToyStageProgram(StageProgram):
+    def __init__(self, stage_index: int, num_stages: int, width: int = 32,
+                 sleep_s: float = 0.0, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.is_first = stage_index == 0
+        self.is_last = stage_index == num_stages - 1
+        self._sleep = sleep_s
+        self._width = width
+        self._seed = seed + stage_index
+
+        def apply_fn(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        if self.is_last:
+            def loss_fn(p, x, targets):
+                y = apply_fn(p, x)
+                return 0.5 * jnp.sum((y - targets) ** 2)
+
+            self._loss_fwd = jax.jit(
+                jax.value_and_grad(loss_fn, argnums=(0, 1)))
+        else:
+            self._fwd = jax.jit(apply_fn)
+
+            def bwd_fn(p, x, dy):
+                _, vjp = jax.vjp(apply_fn, p, x)
+                return vjp(dy)
+
+            self._bwd = jax.jit(bwd_fn)
+
+    def init_params(self):
+        import jax
+        import jax.numpy as jnp
+
+        w = jax.random.normal(jax.random.PRNGKey(self._seed),
+                              (self._width, self._width), jnp.float32)
+        return {"w": w / np.sqrt(self._width)}
+
+    def forward(self, params, x):
+        import time
+
+        import jax.numpy as jnp
+
+        if self._sleep:
+            time.sleep(self._sleep)
+        x = jnp.asarray(x)
+        return self._fwd(params, x), x
+
+    def loss_forward(self, params, x, targets):
+        import time
+
+        import jax.numpy as jnp
+
+        if self._sleep:
+            time.sleep(self._sleep)
+        loss, (gp, gx) = self._loss_fwd(params, jnp.asarray(x),
+                                        jnp.asarray(targets))
+        return float(loss), gp, gx
+
+    def backward(self, params, residual, dy):
+        import time
+
+        import jax.numpy as jnp
+
+        if self._sleep:
+            time.sleep(self._sleep)
+        gp, gx = self._bwd(params, jnp.asarray(residual), jnp.asarray(dy))
+        return gp, (None if self.is_first else gx)
+
+    def count(self, x, targets):
+        return int(np.shape(targets)[0])
+
+
+def make_toy_stage_factory(width: int = 32, sleep_s: float = 0.0,
+                           seed: int = 0):
+    return partial(ToyStageProgram, width=width, sleep_s=sleep_s, seed=seed)

@@ -1,0 +1,109 @@
+"""Pipeline schedules: per-stage op orderings for the MPMD executor.
+
+A compiled DAG runs each actor's op list strictly in order, once per
+execution — so for a multi-microbatch training step the per-stage ORDER of
+forward/backward ops IS the pipeline schedule (reference: the execution
+schedules of compiled_dag_node.py:2002 _build_execution_schedule; the
+GPipe/1F1B distinction in PP literature). The MPMD builder
+(ray_tpu/dag/mpmd.py) asks a schedule for integer ranks and stamps them
+onto the DAG nodes as ``schedule_rank``; CompiledDAG._compile sorts each
+actor's ops by rank.
+
+Rank layout per stage (one training step): rank 0 is the ingest op (stage
+0 only), then forwards/backwards interleaved per the schedule, then the
+optimizer apply last. A schedule is FEASIBLE iff, walking all stages'
+op lists in any global interleaving consistent with the per-stage orders,
+every op's upstream value has already been produced — both schedules here
+are classical and feasible by construction.
+"""
+
+from __future__ import annotations
+
+
+class PipelineSchedule:
+    """Rank assignment for one stage's ops within a training step."""
+
+    name: str = "base"
+
+    def forward_rank(self, mb: int, stage: int, num_stages: int,
+                     num_microbatches: int) -> int:
+        raise NotImplementedError
+
+    def backward_rank(self, mb: int, stage: int, num_stages: int,
+                      num_microbatches: int) -> int:
+        raise NotImplementedError
+
+    def apply_rank(self, stage: int, num_stages: int,
+                   num_microbatches: int) -> int:
+        # After every forward and backward of the step.
+        return 1 + 2 * num_microbatches + 1
+
+
+class GPipeSchedule(PipelineSchedule):
+    """Fill/drain: all forwards in microbatch order, then all backwards.
+
+    Maximum intra-step overlap across stages (stage k runs forward of
+    microbatch m while stage k+1 runs m-1); peak residual stash is all
+    ``num_microbatches`` activations."""
+
+    name = "gpipe"
+
+    def forward_rank(self, mb, stage, num_stages, num_microbatches):
+        return 1 + mb
+
+    def backward_rank(self, mb, stage, num_stages, num_microbatches):
+        return 1 + num_microbatches + mb
+
+    def apply_rank(self, stage, num_stages, num_microbatches):
+        return 1 + 2 * num_microbatches
+
+
+class OneFOneBSchedule(PipelineSchedule):
+    """1F1B: warm up with ``num_stages - stage`` forwards, then alternate
+    backward/forward, then drain the remaining backwards. Same math as
+    GPipe (the step still applies once, after all microbatches), but the
+    residual stash peaks at the warmup depth instead of the full
+    microbatch count."""
+
+    name = "1f1b"
+
+    def _warmup(self, stage, num_stages, num_microbatches):
+        return min(num_microbatches, num_stages - stage)
+
+    def forward_rank(self, mb, stage, num_stages, num_microbatches):
+        w = self._warmup(stage, num_stages, num_microbatches)
+        if mb < w:
+            return 1 + mb
+        # Steady state: forward of microbatch w+j follows backward j.
+        return 1 + w + 2 * (mb - w) + 1
+
+    def backward_rank(self, mb, stage, num_stages, num_microbatches):
+        w = self._warmup(stage, num_stages, num_microbatches)
+        if mb < num_microbatches - w:
+            return 1 + w + 2 * mb
+        # Drain: the last w backwards run after all forwards are done.
+        return 1 + w + 2 * (num_microbatches - w) + (
+            mb - (num_microbatches - w))
+
+    def apply_rank(self, stage, num_stages, num_microbatches):
+        return 1 + 2 * num_microbatches + 1
+
+
+_SCHEDULES: dict[str, PipelineSchedule] = {}
+
+
+def register_schedule(schedule: PipelineSchedule) -> None:
+    _SCHEDULES[schedule.name] = schedule
+
+
+def get_schedule(name: str) -> PipelineSchedule:
+    try:
+        return _SCHEDULES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown pipeline schedule {name!r}; "
+            f"registered: {sorted(_SCHEDULES)}") from None
+
+
+register_schedule(GPipeSchedule())
+register_schedule(OneFOneBSchedule())

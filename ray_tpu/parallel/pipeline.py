@@ -13,6 +13,11 @@ stage actors, no p2p runtime.
 Schedule: M microbatches, P stages, M + P - 1 ticks. At tick t, stage k
 processes microbatch t - k (garbage flows through the bubble ticks and is
 masked out of the loss). Loss is computed on the last stage and psum'd.
+
+The complementary MPMD form — each stage its own actor with its own jitted
+programs, activations over compiled-graph channels, for models too big for
+one slice/program — is ``ray_tpu/dag/mpmd.py``; tests/test_mpmd.py pins the
+two to loss parity on identical batches.
 """
 
 from __future__ import annotations

@@ -80,6 +80,27 @@ class Config:
     # (e.g. when benchmarking the transfer plane itself).
     transfer_same_host_arena: bool = True
 
+    # --- compiled graphs (ray_tpu/dag) ---
+    # Channel transport for compiled DAGs in cluster mode: "direct" moves
+    # payloads peer-to-peer over the actor push-frame path (head KV touched
+    # once at compile time for route exchange, never per step); "kv" is the
+    # head-KV fallback channel (every hop costs kv_put/kv_get head RPCs).
+    # Local mode always uses in-process queues regardless of this knob.
+    dag_channel: str = "direct"
+    # Bounded execute_async() window: executions admitted into the pipeline
+    # before the oldest completes (pipeline fill depth; backpressure blocks
+    # the submitter beyond it).
+    dag_max_inflight: int = 8
+    # Per-channel capacity in unacked in-flight values: a direct-channel
+    # writer blocks once this many writes are unacknowledged by a reader
+    # (per-hop backpressure); also the queue bound of local channels.
+    dag_channel_capacity: int = 16
+    # Direct-channel payloads at or under this many serialized bytes ride
+    # inline in the push frame; larger ones (activations/grads) become
+    # store-backed buffers — same-host readers map them as pinned arena
+    # views, cross-host readers pull them over the transfer plane.
+    dag_inline_max_bytes: int = 64 * 1024
+
     # --- control plane ---
     health_check_period_s: float = 1.0
     # Failure-detection fast path (sub-minute recovery): how often the node

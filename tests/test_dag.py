@@ -436,3 +436,371 @@ def test_device_channel_no_inband_sentinel(rt_start):
     out = chan.read(0, timeout=5)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
     chan.inner.destroy()
+
+
+@pytest.mark.dag
+class TestStoreChannelCursorBatching:
+    def test_cursor_publish_batched(self, rt_start, monkeypatch):
+        """Multi-reader cursor publishes are batched: one kv_put per
+        _GC_EVERY reads (plus a flush at close), not one per read."""
+        from ray_tpu.core.worker import global_worker
+
+        rt = global_worker.runtime
+        w = StoreChannel("curbatch", num_readers=2).connect(rt)
+        r = StoreChannel("curbatch", num_readers=2).connect(rt)
+        cursor_puts = []
+        orig_put = rt.kv_put
+
+        def counting_put(key, value, **kw):
+            if key.startswith("chancur/curbatch/"):
+                cursor_puts.append((key, value))
+            return orig_put(key, value, **kw)
+
+        monkeypatch.setattr(rt, "kv_put", counting_put)
+        n = 20
+        assert n > StoreChannel._GC_EVERY
+        for i in range(n):
+            w.write(i)
+        w.close()
+        for i in range(n):
+            assert r.read(0, timeout=5) == i
+        # 20 reads crossed the _GC_EVERY=16 boundary once: exactly one
+        # batched publish so far, NOT twenty.
+        assert len(cursor_puts) == 1
+        assert int(cursor_puts[0][1]) == StoreChannel._GC_EVERY
+        with pytest.raises(ChannelClosed):
+            r.read(0, timeout=5)
+        # The close marker flushed the remaining batch.
+        assert len(cursor_puts) == 2
+        assert int(cursor_puts[1][1]) == n
+        w.destroy()
+
+    def test_writer_gc_reclaims_consumed_slots(self, rt_start):
+        """The writer's periodic GC deletes slots below every reader's
+        published cursor."""
+        from ray_tpu.core.worker import global_worker
+
+        rt = global_worker.runtime
+        w = StoreChannel("curgc", num_readers=2).connect(rt)
+        r0 = StoreChannel("curgc", num_readers=2).connect(rt)
+        r1 = StoreChannel("curgc", num_readers=2).connect(rt)
+        per = StoreChannel._GC_EVERY
+        for i in range(per):
+            w.write(i)
+        for i in range(per):
+            assert r0.read(0, timeout=5) == i
+            assert r1.read(1, timeout=5) == i
+        # Both cursors published at 16; the writer's next GC-boundary
+        # write reclaims every consumed slot.
+        for i in range(per):
+            w.write(per + i)
+        assert rt.kv_get("chan/curgc/0", ns="channels") is None
+        assert rt.kv_get(f"chan/curgc/{per - 1}", ns="channels") is None
+        assert rt.kv_get(f"chan/curgc/{per}", ns="channels") is not None
+        w.destroy()
+
+
+@pytest.mark.dag
+class TestDirectChannel:
+    """DirectChannel unit semantics (cluster runtime: routes live in the
+    KV, large payloads in the object plane)."""
+
+    def _cluster(self):
+        import ray_tpu
+
+        ray_tpu.shutdown()
+        ray_tpu.init(address="local-cluster", num_cpus=2)
+
+    def test_roundtrip_inline_and_store_backed(self):
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.dag.direct import DirectChannel
+
+        self._cluster()
+        try:
+            rt = global_worker.runtime
+            ch = DirectChannel("dct1").connect(rt)
+            ch.ensure_reader(0)
+            ch.write({"k": 1})
+            assert ch.read(0, timeout=10) == {"k": 1}
+            # 1 MiB ndarray: exceeds inline_max, rides the object plane
+            # as a store-backed buffer; the reader maps it locally.
+            arr = np.arange(512 * 512, dtype=np.float32).reshape(512, 512)
+            assert arr.nbytes > ch.inline_max
+            ch.write(arr)
+            out = ch.read(0, timeout=10)
+            np.testing.assert_array_equal(out, arr)
+            ch.destroy()
+        finally:
+            ray_tpu.shutdown()
+
+    def test_capacity_backpressure(self):
+        import threading
+        import time
+
+        import ray_tpu
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.dag.direct import DirectChannel
+
+        self._cluster()
+        try:
+            rt = global_worker.runtime
+            ch = DirectChannel("dct2", capacity=2).connect(rt)
+            ch.ensure_reader(0)
+            ch.write(0)
+            ch.write(1)
+            blocked = threading.Event()
+            done = threading.Event()
+
+            def third():
+                blocked.set()
+                ch.write(2)  # over capacity: blocks until a read acks
+                done.set()
+
+            t = threading.Thread(target=third, daemon=True)
+            t.start()
+            blocked.wait(5)
+            time.sleep(0.3)
+            assert not done.is_set(), "write over capacity did not block"
+            assert ch.read(0, timeout=10) == 0  # ack frees the window
+            assert done.wait(5), "acked write stayed blocked"
+            assert ch.read(0, timeout=10) == 1
+            assert ch.read(0, timeout=10) == 2
+            ch.destroy()
+        finally:
+            ray_tpu.shutdown()
+
+    def test_close_marker_and_destroy_cleanup(self):
+        import ray_tpu
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.dag.direct import _ROUTE_NS, DirectChannel
+
+        self._cluster()
+        try:
+            rt = global_worker.runtime
+            ch = DirectChannel("dct3").connect(rt)
+            ch.ensure_reader(0)
+            ch.write("last")
+            ch.close()
+            assert ch.read(0, timeout=10) == "last"  # FIFO: data first
+            with pytest.raises(ChannelClosed):
+                ch.read(0, timeout=10)
+            with pytest.raises(ChannelClosed):  # sticky
+                ch.read(0, timeout=10)
+            assert rt.kv_keys(prefix="dagchan/dct3/", ns=_ROUTE_NS)
+            ch.destroy()
+            assert not rt.kv_keys(prefix="dagchan/dct3/", ns=_ROUTE_NS)
+        finally:
+            ray_tpu.shutdown()
+
+
+@pytest.mark.dag
+class TestScheduleRank:
+    def test_rank_overrides_walk_order(self, rt_start, monkeypatch):
+        """Nodes carrying schedule_rank reorder an actor's op list; the
+        walk (DFS) order rules when any op is unranked. Observed through
+        the order of output-channel writes (local-mode channels are
+        process-shared; actor closures are not)."""
+        rt = rt_start
+        for ranked, expect in ((True, ["second", "first"]),
+                               (False, ["first", "second"])):
+            writes = []
+            orig_write = LocalChannel.write
+
+            def traced_write(self, value, _orig=orig_write, _w=writes):
+                if value in ("first", "second"):
+                    _w.append(value)
+                return _orig(self, value)
+
+            monkeypatch.setattr(LocalChannel, "write", traced_write)
+
+            @rt.remote
+            class A:
+                def first(self, x):
+                    return "first"
+
+                def second(self, x):
+                    return "second"
+
+            a = A.remote()
+            with InputNode() as inp:
+                n1 = a.first.bind(inp)
+                n2 = a.second.bind(inp)
+                if ranked:
+                    n1.schedule_rank = 2
+                    n2.schedule_rank = 1
+                dag = MultiOutputNode([n1, n2])
+            compiled = dag.experimental_compile()
+            try:
+                # Output ORDER follows the MultiOutputNode regardless of
+                # the execution order the ranks impose.
+                assert compiled.execute(7, timeout=10) == ["first", "second"]
+            finally:
+                compiled.teardown()
+            monkeypatch.undo()
+            assert writes == expect, f"ranked={ranked}: {writes}"
+
+
+@pytest.mark.dag
+class TestPipelinedExecution:
+    def test_execute_async_window_blocks_at_max_inflight(self, rt_start):
+        """The submission window admits exactly _max_inflight executions;
+        the next submit blocks until one retires. (Closures don't survive
+        cloudpickle into the actor, so the stage is gated by a generous
+        sleep instead of a shared event.)"""
+        import threading
+
+        rt = rt_start
+
+        @rt.remote
+        class Slow:
+            def f(self, x):
+                import time as _t
+
+                _t.sleep(1.0)
+                return x + 1
+
+        s = Slow.remote()
+        with InputNode() as inp:
+            dag = s.f.bind(inp)
+        compiled = dag.experimental_compile(_max_inflight=2)
+        try:
+            f0 = compiled.execute_async(0)
+            f1 = compiled.execute_async(1)
+            submitted = threading.Event()
+            third_result = []
+
+            def third():
+                submitted.set()
+                third_result.append(compiled.execute_async(2))
+
+            t = threading.Thread(target=third, daemon=True)
+            t.start()
+            assert submitted.wait(5)
+            # The first execution needs ~1s to retire; a submit attempted
+            # well before that must still be parked on the window.
+            t.join(0.4)
+            assert t.is_alive(), "3rd submit should block at window=2"
+            t.join(30)
+            assert not t.is_alive(), "3rd submit never admitted"
+            assert f0.result(30) == 1 and f1.result(30) == 2
+            assert third_result[0].result(30) == 3
+        finally:
+            compiled.teardown()
+
+    def test_pipelined_results_ordered_and_correct(self, rt_start):
+        rt = rt_start
+
+        @rt.remote
+        class S:
+            def f(self, x):
+                return x * 10
+
+        s = S.remote()
+        with InputNode() as inp:
+            dag = s.f.bind(inp)
+        compiled = dag.experimental_compile(_max_inflight=4)
+        try:
+            futs = [compiled.execute_async(i) for i in range(16)]
+            assert [f.result(30) for f in futs] == [i * 10 for i in range(16)]
+        finally:
+            compiled.teardown()
+
+    def test_error_fails_inflight_in_order_and_sticky(self, rt_start):
+        """An op raising mid-window: earlier executions retire with their
+        results, the failing and later ones get the error, and the DAG
+        stays failed (sticky) for subsequent submits."""
+        rt = rt_start
+
+        @rt.remote
+        class Bomb:
+            def f(self, x):
+                if x == 1:
+                    raise ValueError("boom-in-window")
+                return x
+
+        b = Bomb.remote()
+        with InputNode() as inp:
+            dag = b.f.bind(inp)
+        compiled = dag.experimental_compile(_max_inflight=3)
+        try:
+            futs = [compiled.execute_async(i) for i in range(3)]
+            assert futs[0].result(30) == 0
+            for f in futs[1:]:
+                with pytest.raises(RuntimeError, match="boom-in-window"):
+                    f.result(30)
+            with pytest.raises(RuntimeError, match="boom-in-window"):
+                compiled.execute_async(3)
+        finally:
+            compiled.teardown()
+
+    def test_teardown_fails_inflight(self, rt_start):
+        rt = rt_start
+
+        @rt.remote
+        class Slow:
+            def f(self, x):
+                import time as _t
+
+                _t.sleep(0.4)
+                return x
+
+        s = Slow.remote()
+        with InputNode() as inp:
+            dag = s.f.bind(inp)
+        compiled = dag.experimental_compile(_max_inflight=2)
+        futs = [compiled.execute_async(i) for i in range(2)]
+        compiled.teardown()
+        for f in futs:
+            try:
+                f.result(0)  # retired before teardown: fine
+            except RuntimeError as e:
+                assert "torn down" in str(e) or "failed" in str(e)
+        with pytest.raises(RuntimeError):
+            compiled.execute_async(9)
+
+
+@pytest.mark.dag
+class TestKillStageDrill:
+    def test_dead_stage_fails_inflight_with_actor_error(self):
+        """Chaos drill: killing a stage actor mid-window surfaces the REAL
+        actor death (ActorDiedError), not a channel timeout, on every
+        in-flight future; the DAG stays failed; teardown is prompt."""
+        import time
+
+        import ray_tpu
+        from ray_tpu.core.exceptions import ActorDiedError
+
+        ray_tpu.shutdown()
+        ray_tpu.init(address="local-cluster", num_cpus=2)
+        try:
+            @ray_tpu.remote
+            class Stage:
+                def f(self, x):
+                    time.sleep(0.25)
+                    return x + 1
+
+            s1, s2 = Stage.remote(), Stage.remote()
+            with InputNode() as inp:
+                dag = s2.f.bind(s1.f.bind(inp))
+            compiled = dag.experimental_compile(_max_inflight=4)
+            try:
+                futs = [compiled.execute_async(i) for i in range(4)]
+                ray_tpu.kill(s1, no_restart=True)
+                errors = 0
+                for f in futs:
+                    try:
+                        f.result(30)
+                    except ActorDiedError:
+                        errors += 1
+                assert errors > 0, "no in-flight execution saw the death"
+                with pytest.raises(ActorDiedError):
+                    compiled.execute(99, timeout=10)
+                t0 = time.monotonic()
+            finally:
+                compiled.teardown()
+            assert time.monotonic() - t0 < 8.0, "teardown dragged on a dead DAG"
+        finally:
+            ray_tpu.shutdown()
