@@ -11,6 +11,19 @@ pure JAX (jit-compiled, mesh-shardable) instead of torch.
 
 from ray_tpu.rl.env import CartPoleEnv, PendulumEnv, VectorEnv, make_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.vec_env import (
+    AutoResetWrapper,
+    VecCartPole,
+    VecCatch,
+    VecGridWorld,
+    batch_reset,
+    batch_step,
+    is_jax_env,
+    make_jax_env,
+    register_jax_env,
+)
+from ray_tpu.rl.anakin import AnakinPPO
+from ray_tpu.rl.sebulba import SebulbaPPO, SebulbaRunner
 from ray_tpu.rl.appo import APPO, APPOConfig
 from ray_tpu.rl.bc import BC, BCConfig
 from ray_tpu.rl.connectors import (
@@ -42,6 +55,10 @@ from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
 __all__ = [
     "CartPoleEnv", "PendulumEnv", "VectorEnv", "make_env",
     "EnvRunner", "EnvRunnerGroup",
+    "AutoResetWrapper", "VecCartPole", "VecCatch", "VecGridWorld",
+    "batch_reset", "batch_step", "is_jax_env", "make_jax_env",
+    "register_jax_env",
+    "AnakinPPO", "SebulbaPPO", "SebulbaRunner",
     "PPO", "PPOConfig",
     "SAC", "SACConfig",
     "DQN", "DQNConfig",

@@ -272,12 +272,18 @@ class DreamerConfig:
     batch_seqs: int = 16
     horizon: int = 10
     det: int = 64
-    latent: int = 16
+    # Latent kept SMALL and free bits tight: on low-dim control the
+    # stochastic latent is mostly noise the actor's advantage signal has
+    # to fight through (_img_step's mean-latent rationale); 16 dims at
+    # 1.0 free bits left dreamed CartPole trajectories too diffuse to
+    # beat the random-policy return on this jax's RNG — 8 dims at 0.3
+    # learns (test_dreamer_learns_cartpole_from_imagination).
+    latent: int = 8
     hidden: int = 64
     lr: float = 3e-4
     gamma: float = 0.99
     lam: float = 0.95
-    free_bits: float = 1.0
+    free_bits: float = 0.3
     ent_coef: float = 1e-2
     buffer_size: int = 50_000
     env_steps_per_iter: int = 500

@@ -169,15 +169,23 @@ class EnvRunnerGroup:
         ref = ray_tpu.put(params)  # one broadcast object, not N copies
         out, dead = [], []
         live = []
-        for i, a in enumerate(self.actors):
-            try:  # a dead runner must not sink the whole step
-                ray_tpu.get(a.set_weights.remote(ref), timeout=120)
+        # Submit-then-gather: every RPC is in flight before the first
+        # get, so N runners cost one round-trip latency, not N (the
+        # serialized per-actor get was pure Python overhead in the A/B
+        # against the vectorized paths). Gets stay per-actor so a dead
+        # runner still doesn't sink the whole step.
+        weight_refs = [(i, a, a.set_weights.remote(ref))
+                       for i, a in enumerate(self.actors)]
+        for i, a, r in weight_refs:
+            try:
+                ray_tpu.get(r, timeout=120)
                 live.append((i, a))
             except ray_tpu.ActorDiedError:
                 dead.append(i)
-        for i, a in live:
+        sample_refs = [(i, a.sample.remote()) for i, a in live]
+        for i, r in sample_refs:
             try:
-                out.append(ray_tpu.get(a.sample.remote(), timeout=120))
+                out.append(ray_tpu.get(r, timeout=120))
             except ray_tpu.ActorDiedError:
                 dead.append(i)
         # Fault tolerance: replace dead runners; the surviving sample set

@@ -159,6 +159,7 @@ class IMPALA(Trainable):
             # per runner; each in-flight ref is tagged with the version its
             # behaviour policy came from.
             host = jax.tree.map(np.asarray, self.params)
+            self._host_params, self._host_version = host, self.weight_version
             ray_tpu.get([a.set_weights.remote(host) for a in self._actors],
                         timeout=300)
             self._inflight = {
@@ -244,8 +245,13 @@ class IMPALA(Trainable):
                     self._dropped_stale += 1
                 # Continuation: fresh weights to THIS runner only, then its
                 # next rollout starts — no barrier with the other runners.
-                host = jax.tree.map(np.asarray, self.params)
-                actor.set_weights.remote(host)
+                # Host conversion is cached per weight version: consuming K
+                # rollouts at one version costs one device->host transfer,
+                # not K.
+                if self._host_version != self.weight_version:
+                    self._host_params = jax.tree.map(np.asarray, self.params)
+                    self._host_version = self.weight_version
+                actor.set_weights.remote(self._host_params)
                 self._inflight[actor.sample.remote()] = (
                     actor, self.weight_version)
         self._return_window = self._return_window[-100:]

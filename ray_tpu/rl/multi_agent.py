@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rl.ppo import _act, compute_gae, init_policy, ppo_update
+from ray_tpu.rl.ppo import (_act, compute_gae_jit, init_policy,
+                            ppo_update)
 from ray_tpu.tune.trainable import Trainable
 
 
@@ -91,13 +92,20 @@ class ChaseGame(MultiAgentEnv):
 
     Ring of ``size`` cells; actions {left, stay, right}. Capture (any
     predator on the prey's cell): predators +5, prey -5, episode ends.
-    Per step: predators -0.05 (time pressure), prey +0.05 (survival)."""
+    Per step: predators -0.05 (time pressure), prey +0.05 (survival).
+
+    The ring must be large enough that random predators DON'T stumble
+    into captures within a few steps — on size 12 a random-policy
+    predator already returned ~4.6 of the ~4.95 ceiling, leaving no
+    learnable headroom (the root cause of the long-skipped predator-gain
+    test); at 20 cells random play mostly times out (~1.7 return) and
+    directed pursuit is something the policy has to learn."""
 
     agent_ids = ("pred0", "pred1", "prey")
     observation_size = 5
     num_actions = 3
 
-    def __init__(self, size: int = 12, horizon: int = 64, seed: int = 0):
+    def __init__(self, size: int = 20, horizon: int = 64, seed: int = 0):
         self.size = size
         self.horizon = horizon
         self._rng = np.random.default_rng(seed)
@@ -328,7 +336,7 @@ class MultiAgentPPO(Trainable):
         static = (cfg.clip, cfg.vf_coef, cfg.ent_coef, cfg.num_minibatches,
                   cfg.num_epochs)
         for pid, s in sample.items():
-            adv, ret = compute_gae(
+            adv, ret = compute_gae_jit(
                 jnp.asarray(s["rewards"]), jnp.asarray(s["values"]),
                 jnp.asarray(s["dones"]), jnp.asarray(s["last_values"]),
                 cfg.gamma, cfg.gae_lambda)

@@ -88,6 +88,13 @@ def compute_gae(rewards, values, dones, last_values, gamma, lam):
     return advantages, advantages + values
 
 
+# Host-side callers (legacy PPO step, the Sebulba learner) go through the
+# jitted entry: the reverse scan traced eagerly costs ~0.5 ms/step in op
+# dispatch, which at unroll 64+ dominates the whole update. Anakin calls
+# the raw function from inside its own fused program.
+compute_gae_jit = jax.jit(compute_gae, static_argnums=(4, 5))
+
+
 @partial(jax.jit, static_argnums=(0, 1))
 def ppo_update(optimizer, cfg_static, params, opt_state, batch, seed):
     """One epoch set of minibatched clipped-PPO updates.
@@ -144,6 +151,18 @@ class PPOConfig:
     num_env_runners: int = 0          # 0 = inline rollouts
     num_envs_per_runner: int = 8
     rollout_len: int = 128
+    # --- Podracer fast paths (rl/anakin.py, rl/sebulba.py) -------------
+    # vectorized=True routes envs with a JAX implementation (rl/vec_env)
+    # to the fused Anakin program (num_env_runners == 0) or the Sebulba
+    # streaming actors (num_env_runners > 0); Python-only envs fall back
+    # to the EnvRunnerGroup path below. Knob registry: utils/config.py
+    # ("RL vectorized Podracer paths").
+    vectorized: bool = False
+    num_envs: int = 0                 # total vectorized envs (0 = derive
+    #                                   from num_envs_per_runner x runners)
+    unroll_len: int = 0               # scan length (0 = rollout_len)
+    sebulba_staleness: int = 2        # drop blocks older than this many
+    #                                   weight versions
     lr: float = 3e-4
     gamma: float = 0.99
     gae_lambda: float = 0.95
@@ -171,6 +190,23 @@ class PPO(Trainable):
         cfg = config.get("ppo_config") or PPOConfig(
             **{k: v for k, v in config.items() if k in PPOConfig.__dataclass_fields__})
         self.cfg = cfg
+        # Podracer dispatch: vectorized + JAX env -> fused Anakin program
+        # (colocated) or Sebulba streaming actors (distributed); anything
+        # else keeps the EnvRunnerGroup path as the fallback.
+        self._engine = None
+        if cfg.vectorized:
+            from ray_tpu.rl.vec_env import is_jax_env
+
+            if is_jax_env(cfg.env):
+                if cfg.num_env_runners > 0:
+                    from ray_tpu.rl.sebulba import SebulbaPPO
+
+                    self._engine = SebulbaPPO(cfg)
+                else:
+                    from ray_tpu.rl.anakin import AnakinPPO
+
+                    self._engine = AnakinPPO(cfg)
+                return
         probe = make_env(cfg.env, seed=cfg.seed)
         obs_size, num_actions = probe.observation_size, probe.num_actions
         if cfg.connector_factory is not None:
@@ -196,11 +232,13 @@ class PPO(Trainable):
         self._return_window: list[float] = []
 
     def step(self) -> dict:
+        if self._engine is not None:
+            return self._engine.step()
         cfg = self.cfg
         samples = self.runners.sample(self.params)
         advs, rets, flats = [], [], []
         for s in samples:
-            adv, ret = compute_gae(
+            adv, ret = compute_gae_jit(
                 jnp.asarray(s["rewards"]), jnp.asarray(s["values"]),
                 jnp.asarray(s["dones"]), jnp.asarray(s["last_values"]),
                 cfg.gamma, cfg.gae_lambda)
@@ -229,6 +267,9 @@ class PPO(Trainable):
         }
 
     def save_checkpoint(self) -> Any:
+        if self._engine is not None:
+            return {"params": self._engine.host_params(),
+                    "iteration": self.iteration, "connector_state": {}}
         return {"params": jax.tree.map(np.asarray, self.params),
                 "iteration": self.iteration,
                 # A policy trained behind a running normalizer is only
@@ -236,10 +277,18 @@ class PPO(Trainable):
                 "connector_state": self.runners.connector_state()}
 
     def load_checkpoint(self, checkpoint: Any) -> None:
-        self.params = jax.tree.map(jnp.asarray, checkpoint["params"])
         self.iteration = checkpoint["iteration"]
+        if self._engine is not None:
+            self._engine.set_params(checkpoint["params"])
+            return
+        self.params = jax.tree.map(jnp.asarray, checkpoint["params"])
         self.runners.set_connector_state(
             checkpoint.get("connector_state", {}))
 
     def cleanup(self) -> None:
+        if self._engine is not None:
+            shutdown = getattr(self._engine, "shutdown", None)
+            if shutdown is not None:
+                shutdown()
+            return
         self.runners.shutdown()

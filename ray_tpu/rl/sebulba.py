@@ -1,0 +1,277 @@
+"""Sebulba: CPU actor nodes streaming trajectory blocks to the learner.
+
+The second Podracer shape (PAPERS.md): when envs cannot share a chip with
+the learner (too many, or CPU-bound), dedicated actor nodes run batched
+env steps and STREAM fixed-shape trajectory blocks to the learner through
+the object plane. The decoupling is what buys throughput — the learner
+never waits for a specific actor, actors never wait for the learner:
+
+- ``SebulbaRunner`` actors hold ``num_envs_per_runner`` vectorized JAX
+  envs and the jitted rollout from rl/anakin.py; each ``collect()``
+  returns a small payload whose big arrays are ``ray_tpu.put`` store
+  refs (zero-copy ndarrays, the llm/pd.py hand-off pattern), so the
+  actor->learner frame stays tiny and the bytes move lazily.
+- Submission is ``.remote()`` — the PR-3 control-plane fast path
+  (raw-dispatched push_actor_call frames, call_nowait underneath), so
+  keeping every runner busy costs the learner no round-trips.
+- A learner-side prefetch THREAD waits on in-flight collects, batch-gets
+  ready blocks into host memory, resubmits the runner (pushing fresh
+  weights first when the learner has advanced), and feeds a bounded
+  ``queue.Queue`` — the staleness window (``cfg.sebulba_staleness``
+  weight versions) is enforced at consume time, and the bounded queue is
+  the backpressure that keeps memory flat when actors outrun the
+  learner.
+
+Learner-side shared state is exactly the shape rtlint R1/R3 exist for:
+``_latest_weights`` (written by ``step()``, read by the prefetch thread)
+sits behind ``_lock``; block hand-off rides the thread-safe queue; the
+in-flight map is touched only by the prefetch thread after start().
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.devtools.annotations import guarded_by
+from ray_tpu.rl.anakin import make_rollout_fn
+from ray_tpu.rl.ppo import (compute_gae_jit, init_policy, mlp_apply,
+                            ppo_update)
+from ray_tpu.rl.vec_env import make_jax_env
+
+
+class SebulbaRunner:
+    """One actor node: N vectorized JAX envs + the current policy, all
+    stepping inside one jitted scan per ``collect()``."""
+
+    def __init__(self, env_name: str, num_envs: int, unroll_len: int,
+                 hidden: int, seed: int, params_seed: int):
+        env = make_jax_env(env_name)
+        self.env = env
+        self.num_envs = num_envs
+        apply_pi = lambda p, o: mlp_apply(p["pi"], o)
+        self._apply_vf = jax.jit(lambda p, o: mlp_apply(p["vf"], o)[..., 0])
+        self._rollout = jax.jit(
+            make_rollout_fn(env, apply_pi,
+                            lambda p, o: mlp_apply(p["vf"], o)[..., 0],
+                            unroll_len))
+        key = jax.random.PRNGKey(seed)
+        key, ke = jax.random.split(key)
+        self._env_states, self._obs = jax.vmap(env.reset)(
+            jax.random.split(ke, num_envs))
+        self._ep_ret = jnp.zeros((num_envs,))
+        self._key = key
+        # Actors also act before the first weight push lands — from the
+        # learner's own init seed, so the version-0 behavior policy (and
+        # the logp it stamps into blocks) is exactly the learner's.
+        self.params = init_policy(jax.random.PRNGKey(params_seed),
+                                  env.observation_size, env.num_actions,
+                                  hidden)
+        self.version = 0
+
+    def set_weights(self, params, version: int) -> None:
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.version = version
+
+    def collect(self) -> dict:
+        """One fixed-shape [T, N, ...] trajectory block. Big arrays go
+        through the object plane as store-backed refs; the returned
+        payload itself stays small."""
+        import ray_tpu
+
+        (self._env_states, self._obs, self._ep_ret, self._key), traj, \
+            ep_stats = self._rollout(self.params, self._env_states,
+                                     self._obs, self._ep_ret, self._key)
+        last_values = self._apply_vf(self.params, self._obs)
+        refs = {k: ray_tpu.put(np.asarray(v)) for k, v in traj.items()}
+        return {
+            "version": self.version,
+            "refs": refs,
+            "last_values": np.asarray(last_values),
+            "ep_ret_sum": float(ep_stats["ret_sum"]),
+            "ep_count": float(ep_stats["count"]),
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+@guarded_by("_lock", "_latest_weights", "_pushed_version")
+class SebulbaPPO:
+    """Learner driving a fleet of SebulbaRunner actors; rl/ppo.py's PPO
+    delegates here for ``vectorized=True`` + ``num_env_runners > 0``."""
+
+    def __init__(self, cfg):
+        import ray_tpu
+
+        self.cfg = cfg
+        self.unroll_len = cfg.unroll_len or cfg.rollout_len
+        self.rollouts_per_step = int(
+            cfg.extra.get("rollouts_per_step", cfg.num_env_runners))
+        env = make_jax_env(cfg.env)
+        self.params = init_policy(jax.random.PRNGKey(cfg.seed),
+                                  env.observation_size, env.num_actions,
+                                  cfg.hidden)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.weight_version = 0
+        self.dropped_stale = 0
+        self._return_window: list[float] = []
+
+        RunnerActor = ray_tpu.remote(SebulbaRunner)
+        self._actors = [
+            RunnerActor.options(num_cpus=0).remote(
+                cfg.env, cfg.num_envs_per_runner, self.unroll_len,
+                cfg.hidden, cfg.seed + 1000 * i + 17, cfg.seed)
+            for i in range(cfg.num_env_runners)]
+        ray_tpu.get([a.ping.remote() for a in self._actors], timeout=120)
+
+        self._lock = threading.Lock()
+        self._latest_weights = (None, 0)   # (weights ref, version)
+        self._pushed_version = [0] * len(self._actors)
+        # Bounded hand-off: depth 2 per runner ~= double buffering; when
+        # the learner lags, the prefetch thread blocks here and ready
+        # blocks wait in the store instead of accumulating on the heap.
+        self._queue: queue.Queue = queue.Queue(
+            maxsize=2 * max(1, len(self._actors)))
+        self._stop = threading.Event()
+        # In-flight map is prefetch-thread-owned after start (initial
+        # submission happens before the thread exists).
+        self._inflight = {a.collect.remote(): i
+                          for i, a in enumerate(self._actors)}
+        self._prefetch = threading.Thread(
+            target=self._prefetch_loop, daemon=True,
+            name="sebulba-prefetch")
+        self._prefetch.start()
+
+    # -- prefetch thread --------------------------------------------------
+    def _prefetch_loop(self) -> None:
+        import ray_tpu
+
+        while not self._stop.is_set():
+            try:
+                ready, _ = ray_tpu.wait(list(self._inflight),
+                                        num_returns=1, timeout=0.2)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                continue
+            if not ready:
+                continue
+            ref = ready[0]
+            idx = self._inflight.pop(ref)
+            try:
+                payload = ray_tpu.get(ref, timeout=60)
+                # ONE batched materialize for the whole block (llm/pd.py
+                # pattern), not a get per array.
+                names = list(payload["refs"])
+                arrays = ray_tpu.get([payload["refs"][n] for n in names],
+                                     timeout=60)
+            except ray_tpu.ActorDiedError:
+                continue  # runner fleet is fixed-size; drop its slot
+            block = dict(zip(names, arrays))
+            block["last_values"] = payload["last_values"]
+            item = {"version": payload["version"], "block": block,
+                    "ep_ret_sum": payload["ep_ret_sum"],
+                    "ep_count": payload["ep_count"]}
+            actor = self._actors[idx]
+            with self._lock:
+                w_ref, w_ver = self._latest_weights
+                need_push = w_ref is not None and \
+                    self._pushed_version[idx] < w_ver
+                if need_push:
+                    self._pushed_version[idx] = w_ver
+            if need_push:
+                # Fire-and-forget: .remote() rides the push-frame fast
+                # path; actor mailbox FIFO means the next collect() uses
+                # these weights.
+                actor.set_weights.remote(w_ref, w_ver)
+            self._inflight[actor.collect.remote()] = idx
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(item, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+
+    # -- learner ----------------------------------------------------------
+    def step(self) -> dict:
+        import ray_tpu
+
+        cfg = self.cfg
+        blocks = []
+        attempts = 0
+        while len(blocks) < self.rollouts_per_step:
+            item = self._queue.get(timeout=120)
+            attempts += 1
+            if self.weight_version - item["version"] > cfg.sebulba_staleness:
+                self.dropped_stale += 1
+                if attempts > 20 * self.rollouts_per_step:
+                    raise RuntimeError("sebulba: only stale blocks arriving")
+                continue
+            blocks.append(item)
+        # Introspection hook (tests assert the staleness bound on what was
+        # actually consumed, not just on the drop counter).
+        self.last_consumed_versions = [b["version"] for b in blocks]
+        flats = []
+        for item in blocks:
+            b = item["block"]
+            adv, ret = compute_gae_jit(
+                jnp.asarray(b["rewards"]), jnp.asarray(b["values"]),
+                jnp.asarray(b["dones"]), jnp.asarray(b["last_values"]),
+                cfg.gamma, cfg.gae_lambda)
+            flats.append({
+                "obs": b["obs"].reshape(-1, b["obs"].shape[-1]),
+                "actions": b["actions"].reshape(-1),
+                "logp": b["logp"].reshape(-1),
+                "advantages": np.asarray(adv).reshape(-1),
+                "returns": np.asarray(ret).reshape(-1),
+            })
+            if item["ep_count"]:
+                self._return_window.append(
+                    item["ep_ret_sum"] / item["ep_count"])
+        batch = {k: jnp.asarray(np.concatenate([f[k] for f in flats]))
+                 for k in flats[0]}
+        static = (cfg.clip, cfg.vf_coef, cfg.ent_coef, cfg.num_minibatches,
+                  cfg.num_epochs)
+        self.params, self.opt_state, stats = ppo_update(
+            self.optimizer, static, self.params, self.opt_state, batch,
+            cfg.seed + self.weight_version)
+        self.weight_version += 1
+        host = jax.tree.map(np.asarray, self.params)
+        w_ref = ray_tpu.put(host)   # one broadcast object for the fleet
+        with self._lock:
+            self._latest_weights = (w_ref, self.weight_version)
+        self._return_window = self._return_window[-100:]
+        mean_ret = (float(np.mean(self._return_window))
+                    if self._return_window else 0.0)
+        return {
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled": int(batch["obs"].shape[0]),
+            "weight_version": self.weight_version,
+            "dropped_stale": self.dropped_stale,
+            **{k: float(v) for k, v in stats.items()},
+        }
+
+    # -- checkpoint plumbing ----------------------------------------------
+    def host_params(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_params(self, params) -> None:
+        self.params = jax.tree.map(jnp.asarray, params)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        self._stop.set()
+        self._prefetch.join(timeout=5)
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

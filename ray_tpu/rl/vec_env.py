@@ -1,0 +1,222 @@
+"""Vectorized pure-JAX environments: the Podracer env substrate.
+
+"Podracer architectures for scalable Reinforcement Learning" (PAPERS.md)
+gets its throughput from environments that live ON the accelerator: the
+whole rollout is one XLA program, so envs must be pure functions over
+explicit state rather than Python objects with hidden mutation. The
+protocol here is the single-env one —
+
+    reset(key)         -> (state, obs)
+    step(state, action) -> (state, obs, reward, done)
+
+— where ``state`` is a pytree of scalars/small arrays with NO batch
+dimension and a ``"key"`` leaf for any randomness the env needs.
+``jax.vmap`` adds the batch axis (thousands of envs), ``jax.lax.scan``
+adds time, and ``jax.pmap`` adds devices; see rl/anakin.py for the full
+stack. ``AutoResetWrapper`` folds episode boundaries into ``step`` so the
+scanned rollout never leaves XLA: on ``done`` the returned state/obs are a
+fresh episode's (the terminal reward and ``done=True`` are still reported
+for that transition — GAE masks the bootstrap on ``done`` exactly as with
+the Python ``VectorEnv``).
+
+One deliberate divergence from the Python path: time-limit truncation is
+folded into ``done`` (no bootstrap-through-truncation), the standard
+fixed-shape compromise of Anakin-style training.
+
+Registered alongside — not replacing — the Python envs in rl/env.py:
+``make_jax_env("CartPole-v1")`` and ``make_env("CartPole-v1")`` are the
+same task, so PPO(vectorized=True) can fall back to the EnvRunner path
+for names only the Python registry knows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class VecCartPole:
+    """CartPole-v1 as pure JAX: constants identical to env.CartPoleEnv
+    (which mirrors gym's CartPole-v1), so trajectories match the Python
+    env step-for-step up to float32-vs-float64 drift."""
+
+    GRAVITY = 9.8
+    CART_M = 1.0
+    POLE_M = 0.1
+    POLE_L = 0.5  # half-length
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * 2 * jnp.pi / 360
+    X_LIMIT = 2.4
+
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 500):
+        self.max_steps = max_steps
+
+    def reset(self, key):
+        key, sub = jax.random.split(key)
+        phys = jax.random.uniform(sub, (4,), jnp.float32, -0.05, 0.05)
+        state = {"phys": phys, "steps": jnp.int32(0), "key": key}
+        return state, phys
+
+    def step(self, state, action):
+        x, x_dot, th, th_dot = state["phys"]
+        force = jnp.where(action == 1, self.FORCE, -self.FORCE)
+        total_m = self.CART_M + self.POLE_M
+        pm_l = self.POLE_M * self.POLE_L
+        cos, sin = jnp.cos(th), jnp.sin(th)
+        temp = (force + pm_l * th_dot**2 * sin) / total_m
+        th_acc = (self.GRAVITY * sin - cos * temp) / (
+            self.POLE_L * (4.0 / 3.0 - self.POLE_M * cos**2 / total_m))
+        x_acc = temp - pm_l * th_acc * cos / total_m
+        x = x + self.DT * x_dot
+        x_dot = x_dot + self.DT * x_acc
+        th = th + self.DT * th_dot
+        th_dot = th_dot + self.DT * th_acc
+        phys = jnp.stack([x, x_dot, th, th_dot]).astype(jnp.float32)
+        steps = state["steps"] + 1
+        terminated = (jnp.abs(x) > self.X_LIMIT) | (
+            jnp.abs(th) > self.THETA_LIMIT)
+        done = terminated | (steps >= self.max_steps)
+        state = {"phys": phys, "steps": steps, "key": state["key"]}
+        return state, phys, jnp.float32(1.0), done
+
+
+class VecCatch:
+    """bsuite-style Catch: a ball falls one row per step down a
+    rows x cols board; move the paddle on the bottom row to catch it.
+    Reward +1/-1 on the final row, 0 otherwise; episode length = rows-1."""
+
+    ROWS = 10
+    COLS = 5
+
+    observation_size = ROWS * COLS
+    num_actions = 3  # left / stay / right
+
+    def _obs(self, state):
+        board = jnp.zeros((self.ROWS, self.COLS), jnp.float32)
+        board = board.at[state["ball_y"], state["ball_x"]].set(1.0)
+        board = board.at[self.ROWS - 1, state["paddle_x"]].set(1.0)
+        return board.reshape(-1)
+
+    def reset(self, key):
+        key, sub = jax.random.split(key)
+        state = {
+            "ball_x": jax.random.randint(sub, (), 0, self.COLS),
+            "ball_y": jnp.int32(0),
+            "paddle_x": jnp.int32(self.COLS // 2),
+            "key": key,
+        }
+        return state, self._obs(state)
+
+    def step(self, state, action):
+        paddle = jnp.clip(state["paddle_x"] + action - 1, 0, self.COLS - 1)
+        ball_y = state["ball_y"] + 1
+        done = ball_y >= self.ROWS - 1
+        reward = jnp.where(
+            done, jnp.where(state["ball_x"] == paddle, 1.0, -1.0),
+            0.0).astype(jnp.float32)
+        state = {"ball_x": state["ball_x"], "ball_y": ball_y,
+                 "paddle_x": paddle, "key": state["key"]}
+        return state, self._obs(state), reward, done
+
+
+class VecGridWorld:
+    """Empty-room navigation: start top-left, goal bottom-right; 4 moves,
+    -0.01 per step, +1 at the goal, truncates at max_steps. Obs is the
+    one-hot agent position."""
+
+    SIZE = 5
+
+    observation_size = SIZE * SIZE
+    num_actions = 4  # up / down / left / right
+
+    def __init__(self, max_steps: int = 40):
+        self.max_steps = max_steps
+
+    def _obs(self, state):
+        flat = state["row"] * self.SIZE + state["col"]
+        return jax.nn.one_hot(flat, self.SIZE * self.SIZE,
+                              dtype=jnp.float32)
+
+    def reset(self, key):
+        key, _ = jax.random.split(key)  # keep key-threading uniform
+        state = {"row": jnp.int32(0), "col": jnp.int32(0),
+                 "steps": jnp.int32(0), "key": key}
+        return state, self._obs(state)
+
+    def step(self, state, action):
+        drow = jnp.where(action == 0, -1, jnp.where(action == 1, 1, 0))
+        dcol = jnp.where(action == 2, -1, jnp.where(action == 3, 1, 0))
+        row = jnp.clip(state["row"] + drow, 0, self.SIZE - 1)
+        col = jnp.clip(state["col"] + dcol, 0, self.SIZE - 1)
+        steps = state["steps"] + 1
+        at_goal = (row == self.SIZE - 1) & (col == self.SIZE - 1)
+        reward = jnp.where(at_goal, 1.0, -0.01).astype(jnp.float32)
+        done = at_goal | (steps >= self.max_steps)
+        state = {"row": row, "col": col, "steps": steps,
+                 "key": state["key"]}
+        return state, self._obs(state), reward, done
+
+
+class AutoResetWrapper:
+    """Folds episode boundaries into ``step`` so scanned rollouts stay
+    inside XLA: on ``done`` the NEXT state/obs are a fresh episode's,
+    drawn with a key split off the state's ``"key"`` leaf, while the
+    terminal reward and ``done=True`` still describe the finished
+    transition (the learner masks its bootstrap on ``done``)."""
+
+    def __init__(self, env):
+        self.env = env
+        self.observation_size = env.observation_size
+        self.num_actions = env.num_actions
+
+    def reset(self, key):
+        return self.env.reset(key)
+
+    def step(self, state, action):
+        state, obs, reward, done = self.env.step(state, action)
+        key, sub = jax.random.split(state["key"])
+        state = dict(state, key=key)
+        reset_state, reset_obs = self.env.reset(sub)
+        state = jax.tree.map(lambda r, s: jnp.where(done, r, s),
+                             reset_state, state)
+        obs = jnp.where(done, reset_obs, obs)
+        return state, obs, reward, done
+
+
+_JAX_ENV_REGISTRY = {
+    "CartPole-v1": VecCartPole,
+    "Catch-v0": VecCatch,
+    "GridWorld-v0": VecGridWorld,
+}
+
+
+def register_jax_env(name: str, ctor) -> None:
+    _JAX_ENV_REGISTRY[name] = ctor
+
+
+def is_jax_env(name: str) -> bool:
+    return name in _JAX_ENV_REGISTRY
+
+
+def make_jax_env(name: str, *, auto_reset: bool = True, **kwargs):
+    try:
+        env = _JAX_ENV_REGISTRY[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown JAX env {name!r}; register_jax_env() it first "
+            "(Python-only envs run through the EnvRunner path)")
+    return AutoResetWrapper(env) if auto_reset else env
+
+
+def batch_reset(env, key, num_envs: int):
+    """vmap'd reset: (states, obs) with a leading [num_envs] axis."""
+    return jax.vmap(env.reset)(jax.random.split(key, num_envs))
+
+
+def batch_step(env, states, actions):
+    """vmap'd step over batched states/actions."""
+    return jax.vmap(env.step)(states, actions)

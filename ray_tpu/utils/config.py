@@ -429,6 +429,25 @@ class Config:
     #   RTPU_SHM_NAME (internal): shared-memory arena name workers map
     #     for the same-host zero-copy object plane.
 
+    # --- RL vectorized Podracer paths (registry of record) ---
+    # The vectorized-RL knobs live on rl/ppo.py's PPOConfig rather than
+    # here (they are per-algorithm, not per-process), but this block is
+    # their registry of record for rtlint R5 and discoverability:
+    #   PPOConfig.vectorized (False): route JAX-implemented envs
+    #     (rl/vec_env.py registry) to the fused Anakin program
+    #     (num_env_runners == 0) or Sebulba streaming actors
+    #     (num_env_runners > 0); Python-only envs keep the EnvRunner path.
+    #   PPOConfig.num_envs (0): total vectorized envs; 0 derives
+    #     num_envs_per_runner x max(1, num_env_runners).
+    #   PPOConfig.unroll_len (0): scan unroll length per rollout block;
+    #     0 falls back to rollout_len.
+    #   PPOConfig.sebulba_staleness (2): learner drops trajectory blocks
+    #     older than this many weight versions (consume-time check).
+    #   RTPU_RL_NUM_ENVS / RTPU_RL_UNROLL_LEN / RTPU_RL_ANAKIN_DEVICES
+    #     (bench-only): geometry overrides read by devbench/rl_bench.py,
+    #     not by the library (Anakin itself takes the device count via
+    #     PPOConfig.extra["anakin_devices"]).
+
     # --- tpu ---
     tpu_visible_chips_env: str = "TPU_VISIBLE_CHIPS"
     tpu_premapped_buffer_bytes: int = 0  # 0 = library default

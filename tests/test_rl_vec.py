@@ -1,0 +1,339 @@
+"""Vectorized RL substrate (rl/vec_env, rl/anakin, rl/sebulba): env
+protocol semantics under vmap, scan-unroll invariants, cross-path parity
+with the Python envs, and the Sebulba streaming contract over the object
+plane. (Reference test model: Podracer appendix invariants + rllib env
+checker semantics.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.rl.vec_env import (
+    AutoResetWrapper,
+    VecCartPole,
+    VecCatch,
+    VecGridWorld,
+    batch_reset,
+    batch_step,
+    is_jax_env,
+    make_jax_env,
+)
+
+
+def test_registry_and_protocol_surface():
+    assert is_jax_env("CartPole-v1") and is_jax_env("Catch-v0")
+    assert is_jax_env("GridWorld-v0") and not is_jax_env("NoSuchEnv-v9")
+    with pytest.raises(ValueError, match="register_jax_env"):
+        make_jax_env("NoSuchEnv-v9")
+    env = make_jax_env("CartPole-v1")
+    assert isinstance(env, AutoResetWrapper)
+    assert env.observation_size == 4 and env.num_actions == 2
+    raw = make_jax_env("CartPole-v1", auto_reset=False)
+    assert isinstance(raw, VecCartPole)
+
+
+def test_vmap_reset_and_step_shapes():
+    """The protocol's batch semantics come from vmap alone: single-env
+    pytrees in, [N]-batched pytrees out, for every env in the suite."""
+    for name, obs_size in [("CartPole-v1", 4), ("Catch-v0", 50),
+                           ("GridWorld-v0", 25)]:
+        env = make_jax_env(name)
+        states, obs = batch_reset(env, jax.random.PRNGKey(0), 7)
+        assert obs.shape == (7, obs_size) and obs.dtype == jnp.float32
+        actions = jnp.zeros((7,), jnp.int32)
+        states, obs2, rew, done = batch_step(env, states, actions)
+        assert obs2.shape == (7, obs_size)
+        assert rew.shape == (7,) and rew.dtype == jnp.float32
+        assert done.shape == (7,) and done.dtype == jnp.bool_
+
+
+def test_autoreset_done_yields_fresh_state():
+    """On done, the wrapper's NEXT state/obs are a fresh episode's while
+    the terminal transition keeps its reward and done=True — and distinct
+    envs reset to distinct episodes (per-env keys under vmap)."""
+    env = make_jax_env("Catch-v0")  # fixed episode length: ROWS-1 steps
+    n = 5
+    states, obs = batch_reset(env, jax.random.PRNGKey(1), n)
+    for t in range(VecCatch.ROWS - 1):
+        states, obs, rew, done = batch_step(
+            env, states, jnp.ones((n,), jnp.int32))
+    assert bool(done.all())                   # episode boundary reported
+    assert np.all(np.abs(np.asarray(rew)) == 1.0)  # terminal reward kept
+    # ...but the state/obs already belong to the NEXT episode:
+    assert np.all(np.asarray(states["ball_y"]) == 0)
+    assert np.all(np.asarray(obs[:, -VecCatch.COLS:]).sum(-1) == 1)
+    # rewards are masked outside the terminal row (no leakage across the
+    # auto-reset boundary)
+    states, obs, rew, done = batch_step(env, states,
+                                        jnp.ones((n,), jnp.int32))
+    assert not bool(done.any()) and np.all(np.asarray(rew) == 0.0)
+
+
+def test_autoreset_preserves_nondone_envs():
+    """jnp.where select: only the done env is replaced."""
+    raw = VecCartPole()
+    env = AutoResetWrapper(raw)
+    states, _ = batch_reset(env, jax.random.PRNGKey(2), 2)
+    # Force env 0 to the brink: tilt past the 12deg limit so any action
+    # terminates it; env 1 stays balanced at reset.
+    phys = np.asarray(states["phys"]).copy()
+    phys[0] = [0.0, 0.0, 0.3, 2.0]  # theta well past THETA_LIMIT
+    states = dict(states, phys=jnp.asarray(phys))
+    new_states, obs, rew, done = batch_step(
+        env, states, jnp.zeros((2,), jnp.int32))
+    assert bool(done[0]) and not bool(done[1])
+    assert int(new_states["steps"][0]) == 0      # env 0: fresh episode
+    assert int(new_states["steps"][1]) == 1      # env 1: advanced
+    assert np.all(np.abs(np.asarray(obs[0])) <= 0.05)  # reset-range obs
+    assert float(rew[0]) == 1.0                  # terminal reward kept
+
+
+def test_gridworld_reaches_goal_reward():
+    env = make_jax_env("GridWorld-v0", auto_reset=False)
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    total = 0.0
+    for a in [1, 1, 1, 1, 3, 3, 3, 3]:  # down x4 then right x4 on 5x5
+        state, obs, rew, done = env.step(state, jnp.int32(a))
+        total += float(rew)
+    assert bool(done) and total == pytest.approx(1.0 - 0.07)
+
+
+def test_scan_unroll_shape_invariants():
+    """make_rollout_fn: scan(T) x vmap(N) produces [T, N, ...] blocks
+    with matching dtypes and scalar episode stats — the fixed-shape
+    contract both Anakin and Sebulba build on."""
+    from ray_tpu.rl.anakin import make_rollout_fn
+    from ray_tpu.rl.ppo import init_policy, mlp_apply
+
+    env = make_jax_env("CartPole-v1")
+    T, N = 11, 3
+    params = init_policy(jax.random.PRNGKey(0), env.observation_size,
+                         env.num_actions, hidden=16)
+    rollout = jax.jit(make_rollout_fn(
+        env, lambda p, o: mlp_apply(p["pi"], o),
+        lambda p, o: mlp_apply(p["vf"], o)[..., 0], T))
+    states, obs = batch_reset(env, jax.random.PRNGKey(1), N)
+    carry, traj, ep_stats = rollout(params, states, obs,
+                                    jnp.zeros((N,)), jax.random.PRNGKey(2))
+    assert traj["obs"].shape == (T, N, 4)
+    assert traj["actions"].shape == (T, N)
+    for k in ("logp", "values", "rewards"):
+        assert traj[k].shape == (T, N) and traj[k].dtype == jnp.float32
+    assert traj["dones"].shape == (T, N) and traj["dones"].dtype == jnp.bool_
+    assert ep_stats["ret_sum"].shape == () and ep_stats["count"].shape == ()
+    # carry round-trips: a second rollout continues from the first
+    _, traj2, _ = rollout(params, carry[0], carry[1], carry[2], carry[3])
+    assert traj2["obs"].shape == (T, N, 4)
+    # CartPole rewards 1 every step; obs at t=0 equals the input obs
+    assert np.all(np.asarray(traj["rewards"]) == 1.0)
+    np.testing.assert_array_equal(np.asarray(traj["obs"][0]),
+                                  np.asarray(obs))
+
+
+def test_jax_cartpole_parity_with_python_env():
+    """Anakin-vs-EnvRunner substrate parity: from identical initial
+    states under the same deterministic policy, the pure-JAX CartPole
+    reproduces the Python CartPoleEnv's episodes — same returns (up to a
+    float32-drift step at the termination boundary) because the physics
+    constants are the same numbers."""
+    from ray_tpu.rl.env import CartPoleEnv
+    from ray_tpu.rl.ppo import init_policy, mlp_apply
+
+    params = init_policy(jax.random.PRNGKey(42), 4, 2, hidden=16)
+
+    def greedy(obs):
+        return int(np.argmax(np.asarray(mlp_apply(params["pi"],
+                                                  jnp.asarray(obs)))))
+
+    jenv = VecCartPole()
+    for seed in range(4):
+        penv = CartPoleEnv(seed=seed)
+        obs0 = penv.reset().astype(np.float32)
+        # Inject the SAME initial state into the JAX env.
+        state = {"phys": jnp.asarray(obs0), "steps": jnp.int32(0),
+                 "key": jax.random.PRNGKey(0)}
+        p_ret = j_ret = 0.0
+        obs = obs0
+        for _ in range(300):
+            obs, r, term, trunc = penv.step(greedy(obs))
+            p_ret += r
+            if term or trunc:
+                break
+        jobs = obs0
+        for _ in range(300):
+            state, jobs, r, done = jenv.step(state, jnp.int32(greedy(jobs)))
+            j_ret += float(r)
+            if bool(done):
+                break
+        assert abs(p_ret - j_ret) <= 2.0, (seed, p_ret, j_ret)
+
+
+def test_anakin_learning_and_checkpoint():
+    """The fused vmap x scan x pmap program learns (return strictly
+    improves over a few fused calls) and reports EnvRunner-compatible
+    metrics; checkpoints round-trip through the replicated params."""
+    from ray_tpu.rl import PPOConfig
+
+    cfg = PPOConfig(vectorized=True, num_envs=16, unroll_len=64,
+                    num_minibatches=4, seed=0,
+                    extra={"iters_per_step": 4})
+    algo = cfg.build()
+    try:
+        first = algo.train_step()
+        assert first["num_env_steps_sampled"] == 4 * 16 * 64
+        assert {"episode_return_mean", "policy_loss", "vf_loss",
+                "entropy"} <= set(first)
+        best = 0.0
+        for _ in range(6):
+            m = algo.train_step()
+            best = max(best, m["episode_return_mean"])
+        assert best > first["episode_return_mean"] + 10, (first, best)
+        ckpt = algo.save_checkpoint()
+        algo.load_checkpoint(ckpt)
+        leaves = jax.tree.leaves(ckpt["params"])
+        assert all(isinstance(x, np.ndarray) for x in leaves)
+    finally:
+        algo.cleanup()
+
+
+def test_anakin_shards_envs_across_devices(cpu_mesh_devices):
+    """pmap axis: envs divide across the virtual device mesh and the
+    update pmeans grads, so per-device params stay in lockstep."""
+    from ray_tpu.rl import PPOConfig
+    from ray_tpu.rl.anakin import pick_num_devices
+
+    assert pick_num_devices(16) == 8      # 8 virtual CPU devices
+    assert pick_num_devices(12) == 6      # must divide num_envs
+    assert pick_num_devices(7) == 7
+    assert pick_num_devices(16, requested=2) == 2
+    cfg = PPOConfig(vectorized=True, num_envs=16, unroll_len=32,
+                    num_minibatches=2, seed=3)
+    algo = cfg.build()
+    try:
+        eng = algo._engine
+        assert eng.num_devices == 8 and eng.n_local == 2
+        algo.train_step()
+        w = np.asarray(jax.tree.leaves(eng.params)[0])
+        assert w.shape[0] == 8
+        for d in range(1, 8):  # replicas identical after pmean'd updates
+            np.testing.assert_allclose(w[0], w[d], rtol=1e-6)
+    finally:
+        algo.cleanup()
+
+
+def test_vectorized_falls_back_to_envrunner_for_python_envs():
+    """vectorized=True must not strand Python-only envs: Pendulum has no
+    JAX implementation, so PPO keeps the EnvRunnerGroup path."""
+    from ray_tpu.rl import PPOConfig
+    from ray_tpu.rl.env import register_env
+
+    class TinyEnv:
+        observation_size = 2
+        num_actions = 2
+
+        def __init__(self, seed=0):
+            self._t = 0
+
+        def reset(self):
+            self._t = 0
+            return np.zeros(2, np.float32)
+
+        def step(self, action):
+            self._t += 1
+            return (np.zeros(2, np.float32), 1.0, False, self._t >= 8)
+
+    register_env("TinyPyEnv-v0", TinyEnv)
+    algo = None
+    try:
+        from ray_tpu.rl import PPO
+
+        algo = PPOConfig(env="TinyPyEnv-v0", vectorized=True,
+                         num_envs_per_runner=2, rollout_len=16,
+                         num_minibatches=2, seed=0).build()
+        assert algo._engine is None and algo.runners is not None
+        m = algo.train_step()
+        assert m["num_env_steps_sampled"] == 2 * 16
+    finally:
+        if algo is not None:
+            algo.cleanup()
+
+
+@pytest.mark.rl
+def test_sebulba_trajectory_block_roundtrip(rt_start):
+    """A SebulbaRunner actor's collect() payload crosses the object
+    plane as store-backed refs: small inline payload, one batched get
+    materializes the fixed-shape [T, N, ...] block, and consecutive
+    blocks keep the shape (the learner's no-recompile contract)."""
+    import ray_tpu
+    from ray_tpu.rl.sebulba import SebulbaRunner
+
+    Runner = ray_tpu.remote(SebulbaRunner)
+    actor = Runner.options(num_cpus=0).remote("CartPole-v1", 4, 16, 32,
+                                              123, 0)
+    try:
+        for _ in range(2):  # fixed shapes across consecutive collects
+            payload = ray_tpu.get(actor.collect.remote(), timeout=120)
+            assert payload["version"] == 0
+            names = list(payload["refs"])
+            arrays = ray_tpu.get([payload["refs"][n] for n in names],
+                                 timeout=60)
+            block = dict(zip(names, arrays))
+            assert block["obs"].shape == (16, 4, 4)
+            assert block["obs"].dtype == np.float32
+            assert block["actions"].shape == (16, 4)
+            assert block["rewards"].shape == (16, 4)
+            assert np.all(block["rewards"] == 1.0)  # CartPole
+            assert np.isfinite(block["logp"]).all()
+            assert payload["last_values"].shape == (4,)
+    finally:
+        ray_tpu.kill(actor)
+
+
+@pytest.mark.rl
+def test_sebulba_staleness_window_bound(rt_start):
+    """Every block the learner consumes is within cfg.sebulba_staleness
+    weight versions of the learner's clock; older blocks are dropped and
+    counted, never trained on."""
+    from ray_tpu.rl import PPOConfig
+
+    cfg = PPOConfig(vectorized=True, num_env_runners=2,
+                    num_envs_per_runner=4, unroll_len=16,
+                    num_minibatches=2, sebulba_staleness=1, seed=0)
+    algo = cfg.build()
+    try:
+        eng = algo._engine
+        for _ in range(5):
+            m = algo.train_step()
+            version_at_consume = eng.weight_version - 1
+            for v in eng.last_consumed_versions:
+                assert version_at_consume - v <= cfg.sebulba_staleness, (
+                    version_at_consume, eng.last_consumed_versions)
+        assert m["weight_version"] == 5
+        assert m["num_env_steps_sampled"] == 2 * 4 * 16
+        assert "dropped_stale" in m
+        ckpt = algo.save_checkpoint()
+        algo.load_checkpoint(ckpt)
+    finally:
+        algo.cleanup()
+
+
+@pytest.mark.rl
+def test_sebulba_learns_cartpole(rt_start):
+    """End-to-end streaming learning signal: a few Sebulba steps move the
+    return above the untrained baseline (full solve is rl_bench's job)."""
+    from ray_tpu.rl import PPOConfig
+
+    cfg = PPOConfig(vectorized=True, num_env_runners=2,
+                    num_envs_per_runner=8, unroll_len=64,
+                    num_minibatches=4, seed=0)
+    algo = cfg.build()
+    try:
+        first = algo.train_step()
+        best = 0.0
+        for _ in range(10):
+            best = max(best,
+                       algo.train_step()["episode_return_mean"])
+        assert best > first["episode_return_mean"] + 5, (first, best)
+    finally:
+        algo.cleanup()
