@@ -1,0 +1,690 @@
+"""Thousand-node control-plane scale bench: simulated fleets vs the head.
+
+Stands up SIMULATED fleets (core/cluster/sim_fleet.py — real NodeDaemons
+over the real RPC stack, fake inventories, one timer wheel, no forked
+workers) against a real head on this box and measures where the head's
+fast paths saturate, BEFORE and AFTER the scale optimizations:
+
+- ``before``: full-map heartbeats every beat, linear ``_pick_node``/
+  ``_assign_bundles`` scans, per-event-per-subscriber pubsub.
+- ``after``: delta heartbeats (changed keys only), indexed scheduling
+  (CPU-free heap + label inverted index + free-sum cache), coalesced
+  pubsub fan-out (one batched notify per subscriber per window).
+
+Phases:
+
+- ``registration`` — cold-register storms at each fleet size: wall time,
+  nodes/s, failures.
+- ``heartbeat`` — steady-state beat ingest across fleet sizes with 20%
+  of nodes churning availability each period; reports head heartbeat
+  duty (handler-seconds per wall-second), per-beat cost, beat loss,
+  wheel lag, head loop lag. The knee is the duty-derived capacity
+  ``nodes / duty`` — the fleet size one head-core could sustain at this
+  beat rate. A PR-6 chaos drill (daemon.tick kill rules) fires mid-run
+  at the largest AFTER fleet; recovery (head declares deaths, keeps
+  answering, survivors keep beating) is gated.
+- ``placement`` — actor-placement storms (register_actor →
+  place_actor → actor_ready round trips against sim daemons) and PG
+  churn (create/ready-poll/remove with real 2PC prepare/commit);
+  reports head microseconds per placement op from the per-method RPC
+  ledger.
+- ``fanout`` — N subscriber connections × M events through the pubsub
+  plane; delivery wall time and completeness.
+- ``autoscaler`` — pending lease demands injected on K daemons;
+  convergence = demand burst → visible in the head's ``cluster_load``
+  aggregation (bounded by one beat period).
+- ``ingest`` — streaming-split throughput with the bounded per-consumer
+  prefetch, fast and deliberately-slow consumers; stall/empty-poll
+  counters and the queue bound are checked. (Sim nodes carry no data
+  plane; this phase prices the ingest backpressure machinery itself.)
+
+Run: python devbench/scale_bench.py [--quick]
+Writes PERF_SCALE.json (quick runs refresh under ``quick_refresh``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fresh_config(**env):
+    from ray_tpu.utils import config as config_mod
+
+    for k, v in env.items():
+        os.environ[k] = str(v)
+    config_mod.set_config(config_mod.Config.load())
+
+
+def _mode_env(mode: str) -> dict:
+    on = mode == "after"
+    return {
+        "RTPU_DELTA_HEARTBEAT_ENABLED": 1 if on else 0,
+        "RTPU_INDEXED_SCHEDULER_ENABLED": 1 if on else 0,
+        "RTPU_PUBSUB_BATCH_WINDOW_S": 0.005 if on else 0,
+        "RTPU_HEAD_METRICS_PERIOD_S": 0.25,
+    }
+
+
+def _io():
+    from ray_tpu.core.cluster.protocol import EventLoopThread
+
+    return EventLoopThread.get()
+
+
+def _wait(pred, timeout: float, desc: str) -> float:
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"timed out waiting for {desc}")
+        time.sleep(0.02)
+    return time.monotonic() - t0
+
+
+async def _rpc_stats(head) -> dict:
+    return {m: list(v) for m, v in head.rpc.stats.items()}
+
+
+def _handler_seconds(stats: dict, methods=None) -> float:
+    return sum(v[1] for m, v in stats.items()
+               if methods is None or m in methods)
+
+
+# Synthetic inventory width: production nodes advertise far more than
+# CPU/TPU (memory, object store, PG-derived bundle keys); full-map
+# heartbeats pay for every key every beat, deltas only for changed ones.
+_EXTRA_KEYS = {f"bundle_slot_{i}": 1.0 for i in range(24)}
+_EXTRA_KEYS.update({"memory": 64.0e9, "object_store_memory": 16.0e9})
+
+
+def _start_cluster(n_nodes: int, hb_period: float, **env):
+    """Fresh head + sim fleet under a fresh config.
+
+    The head gets its OWN loop thread (not the process io-loop singleton
+    the daemons and drivers share): everything head-side — frame decode,
+    dispatch, handlers, reply encode, health/publish loops — then runs on
+    one dedicated thread, so ``time.thread_time()`` on that thread is the
+    head's exact CPU bill. Handler-only timing (rpc.stats) misses the
+    msgpack decode of N full resource maps per period, which is most of
+    what delta heartbeats delete.
+
+    Returns (head, head_io, fleet).
+    """
+    _fresh_config(**env)
+    from ray_tpu.core.cluster.head import HeadServer
+    from ray_tpu.core.cluster.protocol import EventLoopThread
+    from ray_tpu.core.cluster.sim_fleet import SimFleet
+
+    head_io = EventLoopThread()
+    head = HeadServer("127.0.0.1", 0)
+    head_io.run(head.start())
+    fleet = SimFleet.launch(head.rpc.host, head.rpc.port, n_nodes=n_nodes,
+                            heartbeat_period_s=hb_period,
+                            extra_resources=_EXTRA_KEYS)
+    return head, head_io, fleet
+
+
+def _stop_cluster(head, head_io, fleet):
+    fleet.shutdown()
+    head_io.run(head.stop(), timeout=60)
+    head_io.loop.call_soon_threadsafe(head_io.loop.stop)
+    head_io._thread.join(timeout=10)
+
+
+async def _head_cpu_s() -> float:
+    """CPU seconds consumed by the calling thread — run on the head's
+    loop thread, this is the head's total control-plane cost."""
+    return time.thread_time()
+
+
+async def _churn_loop(fleet, period_s: float, stop: asyncio.Event):
+    """Mutate 20% of the fleet's availability each period — realistic
+    steady-state (some nodes busy) so AFTER-mode deltas are non-empty."""
+    tick = 0
+    while not stop.is_set():
+        tick += 1
+        for d in fleet.daemons[::5]:
+            d.available["CPU"] = d.resources["CPU"] - float(tick % 4)
+        try:
+            await asyncio.wait_for(stop.wait(), period_s)
+        except asyncio.TimeoutError:
+            pass
+
+
+def _phase_heartbeat(counts, hb_period: float, window_s: float,
+                     mode: str, chaos_at_max: bool) -> dict:
+    points = []
+    chaos = None
+    for n in counts:
+        head, head_io, fleet = _start_cluster(n, hb_period, **_mode_env(mode))
+        io = _io()
+        try:
+            _wait(lambda: fleet.wheel.fired >= len(fleet.daemons),
+                  30, "first full beat round")
+            # Membership convergence must finish BEFORE the window: each
+            # daemon's first sent beat (idle-skip defers it past the idle
+            # gap in after mode) pulls the full O(n) peers map once.
+            # Measuring that one-time O(n^2) boot storm inside the window
+            # would bill steady-state sync for convergence cost — and
+            # only in after mode, since before-mode daemons beat (and
+            # converge) immediately, before the window opens.
+            _wait(lambda: fleet.hb_stats()["sent"] >= len(fleet.daemons),
+                  60, "peers-map convergence")
+            stop_evt = io.run(_make_event())
+            churn = io.spawn(_churn_loop(fleet, hb_period, stop_evt))
+            s0 = head_io.run(_rpc_stats(head))
+            cpu0 = head_io.run(_head_cpu_s())
+            hb0 = fleet.hb_stats()
+            fired0 = fleet.wheel.fired
+            t0 = time.monotonic()
+            time.sleep(window_s)
+            s1 = head_io.run(_rpc_stats(head))
+            cpu1 = head_io.run(_head_cpu_s())
+            hb1 = fleet.hb_stats()
+            fired1 = fleet.wheel.fired
+            wall = time.monotonic() - t0
+            io.run(_set_event(stop_evt))
+            churn.result(timeout=10)
+            beats = hb1["sent"] - hb0["sent"]
+            hb_calls = s1.get("heartbeat", [0, 0, 0])[0] - \
+                s0.get("heartbeat", [0, 0, 0])[0]
+            hb_secs = s1.get("heartbeat", [0, 0, 0])[1] - \
+                s0.get("heartbeat", [0, 0, 0])[1]
+            duty = (cpu1 - cpu0) / wall
+            loss = (hb1["failed"] - hb0["failed"]) / max(1, beats)
+            # Wheel-delivery normalization: on this shared single core the
+            # wheel itself can fall behind at the biggest counts, so the
+            # head only saw fire_ratio of the load a real fleet (with its
+            # own cores) would impose. Scale the capacity extrapolation by
+            # it — deflating the saturated points rather than letting an
+            # under-driven baseline inflate its own capacity. Skipped idle
+            # beats are NOT missing load (the fire happened; the daemon
+            # chose to send nothing), so the after-mode accounting is
+            # untouched at counts the wheel keeps pace with.
+            nominal_fires = wall * len(fleet.daemons) / hb_period
+            fire_ratio = min(1.0, (fired1 - fired0) / max(1.0, nominal_fires))
+            point = {
+                "nodes": len(fleet.daemons),
+                "beats": beats,
+                "beat_rate_hz": round(beats / wall, 1),
+                "head_hb_calls": hb_calls,
+                "head_duty": round(duty, 4),
+                "handler_us_per_beat": round(
+                    1e6 * hb_secs / max(1, hb_calls), 1),
+                "head_us_per_beat": round(
+                    1e6 * (cpu1 - cpu0) / max(1, beats), 1),
+                "loss_rate": round(loss, 5),
+                "wheel_max_lag_s": fleet.hb_stats()["wheel_max_lag_s"],
+                "head_loop_lag_max_s": round(head.loop_lag_max_s, 4),
+                "wheel_fire_ratio": round(fire_ratio, 4),
+                "capacity_nodes_per_core": (
+                    round(fire_ratio * len(fleet.daemons) / duty)
+                    if duty > 0 else None),
+                "wire": {k: hb1[k] - hb0[k]
+                         for k in ("full", "delta", "empty", "skipped",
+                                   "resync")},
+            }
+            points.append(point)
+            if chaos_at_max and n == max(counts):
+                chaos = _chaos_drill(head, head_io, fleet, hb_period)
+        finally:
+            _stop_cluster(head, head_io, fleet)
+    return {"mode": mode, "hb_period_s": hb_period, "points": points,
+            **({"chaos": chaos} if chaos else {})}
+
+
+async def _make_event() -> asyncio.Event:
+    return asyncio.Event()
+
+
+async def _set_event(evt: asyncio.Event):
+    evt.set()
+
+
+def _chaos_drill(head, head_io, fleet, hb_period: float) -> dict:
+    """PR-6 chaos ride-along: daemon.tick kill rules take out ~5% of the
+    fleet mid-run; the head must declare exactly those nodes dead and
+    keep answering (no wedge), survivors keep beating at <1% loss."""
+    from ray_tpu.chaos import injector
+
+    n = len(fleet.daemons)
+    kill_n = max(3, n // 20)
+    victims = {d.node_id for d in fleet.daemons[:kill_n]}
+    pattern = "|".join(sorted(victims))
+    injector.reset_for_tests()
+    injector.install([{"point": "daemon.tick", "action": "kill",
+                       "match": {"node": f"^({pattern})$"},
+                       "count": kill_n, "mark": None}])
+    hb0 = fleet.hb_stats()
+    t0 = time.monotonic()
+
+    async def _alive_count():
+        return sum(1 for i in head.nodes.values() if i.alive)
+
+    try:
+        declare_s = _wait(lambda: head_io.run(_alive_count()) <= n - kill_n,
+                          30 + 10 * hb_period,
+                          "head to declare chaos-killed nodes dead")
+    except TimeoutError:
+        declare_s = None
+    finally:
+        injector.reset_for_tests()
+    # Head responsive after the kills?
+    status = head_io.run(head._head_status(None), timeout=10)
+    hb1 = fleet.hb_stats()
+    survivor_beats = hb1["sent"] - hb0["sent"]
+    survivor_fail = hb1["failed"] - hb0["failed"]
+    return {
+        "killed": kill_n,
+        "declared_dead_s": (round(declare_s, 2)
+                            if declare_s is not None else None),
+        "head_responsive": bool(status.get("boot_id")),
+        "head_loop_lag_max_s": round(head.loop_lag_max_s, 4),
+        "survivor_loss_rate": round(
+            survivor_fail / max(1, survivor_beats), 5),
+        "wall_s": round(time.monotonic() - t0, 2),
+        "recovered": declare_s is not None and bool(status.get("boot_id")),
+    }
+
+
+async def _actor_storm(head, n_actors: int, conc: int) -> dict:
+    from ray_tpu.core.cluster.protocol import AsyncRpcClient
+
+    cli = AsyncRpcClient(head.rpc.host, head.rpc.port)
+    await cli.connect()
+    loop = asyncio.get_running_loop()
+    sem = asyncio.Semaphore(conc)
+    run = uuid.uuid4().hex[:6]
+
+    async def one(i):
+        async with sem:
+            r = await cli.call(
+                "register_actor", actor_id=f"bench-{run}-{i}", spec_blob=b"",
+                resources={"CPU": 1.0}, name=None, namespace="bench",
+                max_restarts=0, req_id=f"bench-{run}-{i}", timeout=60)
+            return bool(r.get("ok"))
+
+    t0 = loop.time()
+    oks = await asyncio.gather(*[one(i) for i in range(n_actors)])
+    placed = sum(oks)
+    # Wait until the placements fully round-trip (daemon ACKs actor_ready),
+    # polling through the parts-scoped state API (which this also exercises
+    # at fleet scale — the poll must not pay for the node table).
+    deadline = loop.time() + 60
+    alive = 0
+    while loop.time() < deadline:
+        snap = await cli.call("state_snapshot", parts=["actors"], timeout=30)
+        alive = sum(1 for aid, a in (snap.get("actors") or {}).items()
+                    if aid.startswith(f"bench-{run}-")
+                    and a["state"] == "ALIVE")
+        if alive >= placed:
+            break
+        await asyncio.sleep(0.05)
+    wall = loop.time() - t0
+    await cli.close()
+    return {"requested": n_actors, "placed": placed, "alive": alive,
+            "wall_s": round(wall, 3),
+            "actors_per_s": round(placed / wall, 1)}
+
+
+async def _pg_churn(head, rounds: int, bundles_per: int, conc: int) -> dict:
+    from ray_tpu.core.cluster.protocol import AsyncRpcClient
+
+    cli = AsyncRpcClient(head.rpc.host, head.rpc.port)
+    await cli.connect()
+    loop = asyncio.get_running_loop()
+    sem = asyncio.Semaphore(conc)
+    run = uuid.uuid4().hex[:6]
+    created = removed = 0
+
+    async def one(i):
+        nonlocal created, removed
+        pg_id = f"bench-pg-{run}-{i}"
+        async with sem:
+            r = await cli.call(
+                "create_placement_group", pg_id=pg_id,
+                bundles=[{"CPU": 1.0}] * bundles_per, strategy="PACK",
+                req_id=pg_id, timeout=60)
+            if not r.get("ok"):
+                return
+            for _ in range(400):
+                st = await cli.call("placement_group_state", pg_id=pg_id,
+                                    timeout=30)
+                if st.get("state") == "CREATED":
+                    created += 1
+                    break
+                await asyncio.sleep(0.02)
+            await cli.call("remove_placement_group", pg_id=pg_id, timeout=30)
+            removed += 1
+
+    t0 = loop.time()
+    await asyncio.gather(*[one(i) for i in range(rounds)])
+    wall = loop.time() - t0
+    await cli.close()
+    return {"rounds": rounds, "created": created, "removed": removed,
+            "bundles_per": bundles_per, "wall_s": round(wall, 3),
+            "pgs_per_s": round(created / wall, 1)}
+
+
+def _phase_placement(n_nodes: int, n_actors: int, pg_rounds: int,
+                     mode: str) -> dict:
+    head, head_io, fleet = _start_cluster(n_nodes, 1.0, **_mode_env(mode))
+    io = _io()
+    try:
+        s0 = head_io.run(_rpc_stats(head))
+        actors = io.run(_actor_storm(head, n_actors, conc=24), timeout=300)
+        s1 = head_io.run(_rpc_stats(head))
+        pgs = io.run(_pg_churn(head, pg_rounds, bundles_per=4, conc=8),
+                     timeout=300)
+        s2 = head_io.run(_rpc_stats(head))
+        actor_secs = _handler_seconds(
+            s1, {"register_actor", "actor_ready"}) - _handler_seconds(
+            s0, {"register_actor", "actor_ready"})
+        pg_secs = _handler_seconds(
+            s2, {"create_placement_group", "placement_group_state",
+                 "remove_placement_group"}) - _handler_seconds(
+            s1, {"create_placement_group", "placement_group_state",
+                 "remove_placement_group"})
+        return {
+            "mode": mode, "nodes": len(fleet.daemons),
+            "actor_storm": actors,
+            "head_us_per_actor": round(
+                1e6 * actor_secs / max(1, actors["placed"]), 1),
+            "pg_churn": pgs,
+            "head_us_per_pg": round(1e6 * pg_secs / max(1, pgs["created"]),
+                                    1),
+        }
+    finally:
+        _stop_cluster(head, head_io, fleet)
+
+
+async def _fanout(head, head_io, n_subs: int, n_events: int) -> dict:
+    from ray_tpu.core.cluster.protocol import AsyncRpcClient
+
+    loop = asyncio.get_running_loop()
+    received = [0]
+    clients = []
+
+    def on_pub(**kw):
+        received[0] += 1
+
+    def on_batch(events=None, **kw):
+        received[0] += len(events or [])
+
+    for _ in range(n_subs):
+        c = AsyncRpcClient(head.rpc.host, head.rpc.port)
+        await c.connect()
+        c.on_notify("pub", on_pub)
+        c.on_notify("pub_batch", on_batch)
+        await c.call("subscribe", channel="bench-fan")
+        clients.append(c)
+    expected = n_subs * n_events
+
+    def _pub(seq):
+        # publish() touches head connections — must run on the HEAD's loop.
+        return asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+            head.publish("bench-fan", seq=seq), head_io.loop))
+
+    t0 = loop.time()
+    for e in range(n_events):
+        await _pub(e)
+    publish_wall = loop.time() - t0
+    deadline = loop.time() + 60
+    while received[0] < expected and loop.time() < deadline:
+        await asyncio.sleep(0.01)
+    deliver_wall = loop.time() - t0
+    for c in clients:
+        await c.close()
+    return {"subscribers": n_subs, "events": n_events,
+            "delivered": received[0], "expected": expected,
+            "publish_wall_s": round(publish_wall, 3),
+            "deliver_wall_s": round(deliver_wall, 3),
+            "notifications_per_s": round(received[0] / deliver_wall, 0)}
+
+
+def _phase_fanout(n_subs: int, n_events: int, mode: str) -> dict:
+    head, head_io, fleet = _start_cluster(5, 1.0, **_mode_env(mode))
+    try:
+        out = _io().run(_fanout(head, head_io, n_subs, n_events),
+                        timeout=180)
+        out["mode"] = mode
+        return out
+    finally:
+        _stop_cluster(head, head_io, fleet)
+
+
+async def _inject_demands(fleet, k: int) -> int:
+    from ray_tpu.core.cluster.node_daemon import _PendingLease
+
+    loop = asyncio.get_running_loop()
+    for d in fleet.daemons[:k]:
+        fut = loop.create_future()
+        d._pending.append(_PendingLease({"TPU": 8.0}, fut, "", "", count=2))
+    return k
+
+
+def _phase_autoscaler(n_nodes: int, k_demand: int, hb_period: float) -> dict:
+    head, head_io, fleet = _start_cluster(n_nodes, hb_period,
+                                          **_mode_env("after"))
+    io = _io()
+    try:
+        _wait(lambda: fleet.wheel.fired >= len(fleet.daemons),
+              30, "first beat round")
+        io.run(_inject_demands(fleet, k_demand))
+        t0 = time.monotonic()
+
+        def visible():
+            load = head_io.run(head._cluster_load(None))
+            return len(load["pending_demands"]) >= 2 * k_demand
+
+        converge_s = _wait(visible, 30 + 4 * hb_period,
+                           "demand burst visible in cluster_load")
+        return {"nodes": len(fleet.daemons), "demand_nodes": k_demand,
+                "demands": 2 * k_demand,
+                "convergence_s": round(converge_s, 3),
+                "hb_period_s": hb_period,
+                "within_two_beats": converge_s <= 2 * hb_period + 1.0}
+    finally:
+        _stop_cluster(head, head_io, fleet)
+
+
+def _phase_ingest(quick: bool) -> dict:
+    from ray_tpu.data.iterator import SplitCoordinator
+
+    _fresh_config(RTPU_DATA_SPLIT_PREFETCH_BLOCKS=4)
+    blocks = 240 if quick else 800
+    results = {}
+    for n_consumers, slow_one in ((2, False), (8, True)):
+        class _DS:
+            def iter_block_refs(self):
+                for i in range(blocks):
+                    yield (i, {})
+
+        coord = SplitCoordinator(_DS(), n=n_consumers, equal=False)
+        got = [0] * n_consumers
+        max_q = [0]
+
+        def consume(split, slow):
+            while True:
+                with coord._lock:
+                    max_q[0] = max(max_q[0],
+                                   max(len(q) for q in coord._queues))
+                status, _ = coord.get_next(split)
+                if status == "done":
+                    return
+                if status == "block":
+                    got[split] += 1
+                    if slow:
+                        time.sleep(0.002)
+                elif status == "empty":
+                    time.sleep(0.0005)
+
+        threads = [threading.Thread(
+            target=consume, args=(i, slow_one and i == 0), daemon=True)
+            for i in range(n_consumers)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.monotonic() - t0
+        results[f"consumers_{n_consumers}"] = {
+            "blocks": sum(got), "wall_s": round(wall, 3),
+            "blocks_per_s": round(sum(got) / wall, 0),
+            "producer_stalls": coord.stalls,
+            "consumer_empty_polls": coord.empty_polls,
+            "max_queue_depth": max_q[0],
+            "prefetch_bound": 4,
+            "bounded": max_q[0] <= 4,
+        }
+    return results
+
+
+def _phase_registration(counts, hb_period: float) -> dict:
+    points = []
+    for n in counts:
+        head, head_io, fleet = _start_cluster(n, hb_period,
+                                              **_mode_env("after"))
+        try:
+            points.append({
+                "nodes": len(fleet.daemons),
+                "failures": fleet.register_failures,
+                "wall_s": round(fleet.register_wall_s, 3),
+                "registrations_per_s": round(
+                    len(fleet.daemons) / max(1e-9, fleet.register_wall_s)),
+            })
+        finally:
+            _stop_cluster(head, head_io, fleet)
+    return {"points": points}
+
+
+def _knee(points, duty_limit=0.5, loss_limit=0.01):
+    """First swept fleet size where the head left its comfort zone, or
+    None when the whole sweep stayed inside it."""
+    for p in points:
+        if p["head_duty"] > duty_limit or p["loss_rate"] > loss_limit:
+            return p["nodes"]
+    return None
+
+
+def run_bench(quick: bool = False, out_path: str | None = None) -> dict:
+    if quick:
+        hb_counts, hb_period, window = [60, 150], 0.25, 4.0
+        pl_nodes, n_actors, pg_rounds = 80, 60, 12
+        subs, events = 40, 40
+        as_nodes, as_k = 80, 20
+    else:
+        hb_counts, hb_period, window = [100, 250, 500, 750], 0.5, 8.0
+        pl_nodes, n_actors, pg_rounds = 500, 150, 30
+        subs, events = 150, 100
+        as_nodes, as_k = 300, 50
+
+    reg = _phase_registration(hb_counts, 1.0)
+    hb = {m: _phase_heartbeat(hb_counts, hb_period, window, m,
+                              chaos_at_max=(m == "after"))
+          for m in ("before", "after")}
+    pl = {m: _phase_placement(pl_nodes, n_actors, pg_rounds, m)
+          for m in ("before", "after")}
+    fan = {m: _phase_fanout(subs, events, m) for m in ("before", "after")}
+    autos = _phase_autoscaler(as_nodes, as_k, hb_period)
+    ingest = _phase_ingest(quick)
+
+    def _cap(mode):
+        pts = hb[mode]["points"]
+        caps = [p["capacity_nodes_per_core"] for p in pts
+                if p["capacity_nodes_per_core"]]
+        return max(caps) if caps else None
+
+    cap_before, cap_after = _cap("before"), _cap("after")
+    hb_ratio = (cap_after / cap_before
+                if cap_before and cap_after else None)
+    pl_ratio = (pl["before"]["head_us_per_actor"] /
+                pl["after"]["head_us_per_actor"]
+                if pl["after"]["head_us_per_actor"] else None)
+    chaos = hb["after"].get("chaos") or {}
+    after_top = hb["after"]["points"][-1]
+    acceptance = {
+        "sim_fleet_500_nodes": max(p["nodes"]
+                                   for p in hb["after"]["points"]) >= (
+                                       500 if not quick else 100),
+        "heartbeat_capacity_2x": hb_ratio is not None and hb_ratio >= 2.0,
+        "placement_head_cost_2x": pl_ratio is not None and pl_ratio >= 2.0,
+        "heartbeat_loss_under_1pct": after_top["loss_rate"] < 0.01,
+        "chaos_kills_recovered_no_wedge": bool(chaos.get("recovered")),
+        "fanout_no_loss_batched": (fan["after"]["delivered"] ==
+                                   fan["after"]["expected"]),
+        "autoscaler_converged": bool(autos["within_two_beats"]),
+        "ingest_prefetch_bounded": all(
+            v["bounded"] for v in ingest.values()),
+    }
+    report = {
+        "bench": "scale",
+        "quick": quick,
+        "phases": {
+            "registration": reg,
+            "heartbeat": hb,
+            "placement": pl,
+            "fanout": fan,
+            "autoscaler": autos,
+            "ingest": ingest,
+        },
+        "knees": {
+            "heartbeat_duty_knee_nodes": {
+                m: _knee(hb[m]["points"]) for m in ("before", "after")},
+            "heartbeat_capacity_nodes_per_core": {
+                "before": cap_before, "after": cap_after,
+                "ratio": round(hb_ratio, 2) if hb_ratio else None},
+            "placement_head_us_per_actor": {
+                "before": pl["before"]["head_us_per_actor"],
+                "after": pl["after"]["head_us_per_actor"],
+                "ratio": round(pl_ratio, 2) if pl_ratio else None},
+            "fanout_deliver_wall_s": {
+                m: fan[m]["deliver_wall_s"] for m in ("before", "after")},
+        },
+        "acceptance": acceptance,
+        "provenance": {
+            "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "cpus": os.cpu_count(),
+            "loadavg": list(os.getloadavg()),
+            "box_note": (
+                "single host, one core: head + sim daemons + drivers share "
+                "one process (daemons on the io-loop thread, real RPC over "
+                "loopback). Head cost is measured from the per-method "
+                "handler-time ledger (protocol.RpcServer.stats), so the "
+                "duty/capacity numbers isolate the head's share of the "
+                "core. capacity_nodes_per_core extrapolates the fleet one "
+                "head-core sustains at this beat rate and inventory width "
+                "(26 resource keys + 20% availability churn). Sim nodes "
+                "have no data plane, so the ingest phase prices the "
+                "bounded-prefetch machinery locally, not cross-node."),
+        },
+    }
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PERF_SCALE.json")
+    doc = report
+    if quick and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                existing = json.load(f)
+            if not existing.get("quick"):
+                existing["quick_refresh"] = report
+                doc = existing
+        except Exception:
+            pass
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return report
+
+
+if __name__ == "__main__":
+    rep = run_bench(quick="--quick" in sys.argv[1:])
+    print(json.dumps(rep, indent=2))

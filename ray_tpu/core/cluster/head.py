@@ -14,6 +14,7 @@ reference optionally persists to Redis); this build keeps tables in memory.
 from __future__ import annotations
 
 import asyncio
+import heapq
 import os
 import pickle
 import struct
@@ -188,7 +189,35 @@ class HeadServer:
         self.fn_stats: dict[str, int] = {
             "puts": 0, "dup_puts": 0, "gets": 0, "misses": 0}
         self._subs: dict[str, set[ServerConnection]] = {}  # channel -> conns
+        # Coalesced pubsub fan-out (pubsub_batch_window_s): events buffer
+        # per subscriber connection and one flush task ships them as a
+        # single ``pub_batch`` notify per connection per window — one
+        # write per subscriber per window instead of one per event.
+        self._pub_buf: dict[ServerConnection, list] = {}
+        self._pub_flush_task: asyncio.Task | None = None
         self._node_conns: dict[str, ServerConnection] = {}
+        # Scheduler fast path (thousand-node head): indexed views of the
+        # node table so placement and bundle assignment stop linearly
+        # scanning self.nodes per decision. ``_cpu_heap`` is a LAZY max-heap
+        # of (-effective_cpu, node_id); ``_cpu_free`` holds each node's
+        # current key, so stale heap entries (superseded key, dead node)
+        # are detected and discarded at pop time. ``_free_sum`` caches
+        # sum(available.values()) for _assign_bundles' PACK ordering;
+        # ``_label_index`` is the inverted (key, value) -> node_ids map
+        # behind label-constrained placement. All maintained by
+        # _sched_touch at every mutation site; reads are gated by
+        # indexed_scheduler_enabled (linear scan kept for parity tests).
+        self._cpu_heap: list[tuple[float, str]] = []
+        self._cpu_free: dict[str, float] = {}
+        self._free_sum: dict[str, float] = {}
+        self._label_index: dict[tuple[str, str], set[str]] = {}
+        # Head self-metrics (saturation diagnosis at fleet scale): event
+        # loop lag sampled by _self_metrics_loop, plus per-RPC-method
+        # rate/latency computed from rpc.counts/rpc.stats deltas.
+        self.loop_lag_s = 0.0
+        self.loop_lag_max_s = 0.0
+        self._rpc_rates: dict[str, dict] = {}
+        self._self_metrics_task: asyncio.Task | None = None
         self._register_handlers()
         self._health_task: asyncio.Task | None = None
         self.placement_groups = None  # attached by placement_group module
@@ -283,6 +312,9 @@ class HeadServer:
         addr = await self.rpc.start()
         loop = asyncio.get_running_loop()
         self._health_task = loop.create_task(self._health_loop())
+        if get_config().head_metrics_period_s > 0:
+            self._self_metrics_task = loop.create_task(
+                self._self_metrics_loop())
         if self._persist_path:
             self._persist_task = loop.create_task(self._persist_loop())
         if self.watchdog is not None:
@@ -322,6 +354,10 @@ class HeadServer:
             self.watchdog.stop()
         if self._health_task:
             self._health_task.cancel()
+        if self._self_metrics_task:
+            self._self_metrics_task.cancel()
+        if self._pub_flush_task is not None:
+            self._pub_flush_task.cancel()
         if self._persist_task:
             self._persist_task.cancel()
             if self._write_fut is not None:
@@ -693,7 +729,8 @@ class HeadServer:
                 self.watchdog.stop()
             except Exception:
                 pass
-        for t in (self._health_task, self._persist_task):
+        for t in (self._health_task, self._persist_task,
+                  self._self_metrics_task, self._pub_flush_task):
             if t is not None and t is not asyncio.current_task():
                 t.cancel()
         self._wal_buf.clear()  # un-ACKed records: lost, as in a crash
@@ -726,7 +763,59 @@ class HeadServer:
             "nodes_alive": sum(1 for n in self.nodes.values() if n.alive),
             "nodes_total": len(self.nodes),
             "actors": len(self.actors),
+            # Saturation self-metrics (see _self_metrics_loop): how far
+            # behind the event loop is running, and which RPC methods are
+            # eating it (rate + mean/max handler latency over the last
+            # sample window).
+            "loop_lag_s": round(self.loop_lag_s, 6),
+            "loop_lag_max_s": round(self.loop_lag_max_s, 6),
+            "rpc": dict(self._rpc_rates),
         }
+
+    async def _self_metrics_loop(self):
+        """Head saturation self-observation: samples event-loop lag (the
+        gap between when a timer should fire and when it actually does —
+        the first thing that degrades when the head saturates) and turns
+        rpc.counts/rpc.stats deltas into per-method rate + latency. Lag
+        lands in the watchdog series store as ``head_loop_lag_s`` so the
+        scale bench (and incident timelines) can chart it; the per-method
+        table is served from _head_status for `ray_tpu status`."""
+        loop = asyncio.get_running_loop()
+        period = get_config().head_metrics_period_s
+        prev_counts: dict[str, int] = dict(self.rpc.counts)
+        prev_stats = {m: list(s) for m, s in self.rpc.stats.items()}
+        prev_t = loop.time()
+        while True:
+            target = loop.time() + period
+            await asyncio.sleep(period)
+            now = loop.time()
+            self.loop_lag_s = max(0.0, now - target)
+            if self.loop_lag_s > self.loop_lag_max_s:
+                self.loop_lag_max_s = self.loop_lag_s
+            window = max(1e-9, now - prev_t)
+            prev_t = now
+            rates: dict[str, dict] = {}
+            counts = dict(self.rpc.counts)
+            stats = {m: list(s) for m, s in self.rpc.stats.items()}
+            for m, c in counts.items():
+                dc = c - prev_counts.get(m, 0)
+                if dc <= 0:
+                    continue
+                row = {"rate_hz": round(dc / window, 3)}
+                st, pv = stats.get(m), prev_stats.get(m, [0, 0.0, 0.0])
+                if st is not None and st[0] > pv[0]:
+                    row["mean_ms"] = round(
+                        (st[1] - pv[1]) / (st[0] - pv[0]) * 1000.0, 3)
+                    row["max_ms"] = round(st[2] * 1000.0, 3)
+                rates[m] = row
+            prev_counts, prev_stats = counts, stats
+            self._rpc_rates = rates
+            if self.watchdog is not None:
+                try:
+                    self.watchdog.store.append(
+                        "head", "head_loop_lag_s", {}, self.loop_lag_s)
+                except Exception:
+                    pass
 
     async def _rpc_counts(self, conn: ServerConnection):
         """Per-method inbound frame odometer of this head's RPC server.
@@ -744,18 +833,51 @@ class HeadServer:
         return True
 
     async def publish(self, channel: str, **payload):
-        dead = []
-        for conn in self._subs.get(channel, ()):  # snapshot-free: set is small
-            try:
-                await conn.notify("pub", channel=channel, payload=payload)
-            except Exception:
-                dead.append(conn)
-        for c in dead:
-            self._subs.get(channel, set()).discard(c)
+        subs = self._subs.get(channel)
+        if not subs:
+            return
+        window = get_config().pubsub_batch_window_s
+        if window <= 0:
+            # Unbatched path: one awaited notify per subscriber per event.
+            dead = []
+            for conn in list(subs):
+                try:
+                    await conn.notify("pub", channel=channel, payload=payload)
+                except Exception:
+                    dead.append(conn)
+            for c in dead:
+                subs.discard(c)
+            return
+        # Coalesced fan-out: buffer per subscriber; ONE flush task per
+        # window ships each connection's events as a single ``pub_batch``
+        # notify, connections in parallel. An event burst (lease storm
+        # killing a node → n actor_events) costs each subscriber one
+        # write instead of one per event — and the head's loop one
+        # gather instead of n serial drains.
+        for conn in subs:
+            self._pub_buf.setdefault(conn, []).append(
+                {"channel": channel, "payload": payload})
+        if self._pub_flush_task is None or self._pub_flush_task.done():
+            self._pub_flush_task = spawn_task(self._pub_flush(window))
+
+    async def _pub_flush(self, window: float):
+        await asyncio.sleep(window)
+        buf, self._pub_buf = self._pub_buf, {}
+        if not buf:
+            return
+        conns = list(buf)
+        results = await asyncio.gather(
+            *(c.notify("pub_batch", events=buf[c]) for c in conns),
+            return_exceptions=True)
+        for conn, res in zip(conns, results):
+            if isinstance(res, BaseException):
+                for subs in self._subs.values():
+                    subs.discard(conn)
 
     def _on_disconnect(self, conn: ServerConnection):
         for subs in self._subs.values():
             subs.discard(conn)
+        self._pub_buf.pop(conn, None)
         node_id = conn.meta.get("node_id")
         if node_id and self._node_conns.get(node_id) is conn:
             # Node daemon connection dropped: mark suspect; health loop decides.
@@ -829,8 +951,21 @@ class HeadServer:
             info.available = dict(state["available"])
         self.nodes[node_id] = info
         conn.meta["node_id"] = node_id
+        # Delta-heartbeat base: a registration carrying the daemon's live
+        # ``available`` IS the full sync — later delta beats on this conn
+        # apply against it. Without one, the first delta gets a resync.
+        conn.meta["hb_synced"] = bool(state and state.get("available")
+                                      is not None)
         self._node_conns[node_id] = conn
         self._membership_version += 1
+        if prev is not None and prev.labels != info.labels:
+            for k, v in prev.labels.items():
+                s = self._label_index.get((k, v))
+                if s is not None:
+                    s.discard(node_id)
+        for k, v in info.labels.items():
+            self._label_index.setdefault((k, v), set()).add(node_id)
+        self._sched_touch(info)
         reconcile = None
         if state is not None:
             reconcile = await self._reconcile_node(conn, node_id, state)
@@ -951,10 +1086,28 @@ class HeadServer:
                     self._reconcile_totals.get(k, 0) + v
         return summary
 
-    async def _heartbeat(self, conn: ServerConnection, node_id: str, available: dict,
+    async def _heartbeat(self, conn: ServerConnection, node_id: str,
+                         available: dict | None = None,
                          resources: dict | None = None,
                          pending_demands: list | None = None,
-                         peers_version: int = -1):
+                         peers_version: int = -1,
+                         available_delta: dict | None = None,
+                         available_removed: list | None = None,
+                         demands_unchanged: bool = False):
+        """Node liveness + resource-view sync. Two wire forms (reference:
+        ray_syncer.h ships resource-view DELTAS, not snapshots):
+
+        - **full**: ``available`` is the complete map — replaces the view
+          and marks this connection synced.
+        - **delta**: ``available`` is None; ``available_delta`` carries
+          only keys whose value changed and ``available_removed`` keys
+          that vanished (both usually empty — an idle node's beat is just
+          the liveness stamp). A delta against a connection that never
+          shipped a full map (head restarted mid-stream and the register
+          predates the delta base) gets ``resync`` back: the daemon's
+          next beat is full. At fleet scale this turns the per-period
+          heartbeat storm from O(nodes x resource keys) payload into
+          O(changed keys)."""
         info = self.nodes.get(node_id)
         if info is None or not info.alive or \
                 self._node_conns.get(node_id) is not conn:
@@ -970,11 +1123,26 @@ class HeadServer:
             # fence and the reconcile payload, so route the daemon there.
             return {"ok": False, "reregister": True}
         info.last_heartbeat = time.monotonic()
-        info.available = available
+        if available is not None:
+            info.available = available
+            conn.meta["hb_synced"] = True
+        elif not conn.meta.get("hb_synced"):
+            # Delta with no base on this head: don't guess — ask for a
+            # full map and leave the (stale but internally consistent)
+            # registered view in place until it lands.
+            return {"ok": True, "resync": True,
+                    "membership_version": self._membership_version}
+        else:
+            if available_delta:
+                info.available.update(available_delta)
+            for k in available_removed or ():
+                info.available.pop(k, None)
         info.optimistic.clear()
         if resources is not None:
             info.resources = resources  # totals change as PG bundles commit
-        info.pending_demands = pending_demands or []
+        if not demands_unchanged:
+            info.pending_demands = pending_demands or []
+        self._sched_touch(info)
         # Membership piggyback, VERSIONED: daemons seed their peer-gossip
         # rings from this (the head stays the membership authority; VIEW
         # dissemination rides daemon-to-daemon gossip — reference:
@@ -995,22 +1163,56 @@ class HeadServer:
         info = self.nodes.get(node_id)
         if info:
             info.alive = False
+            self._sched_touch(info)
             self._drop_daemon_client(node_id)
             self._membership_version += 1
             await self.publish("node_events", event="removed", node_id=node_id)
         return {"ok": True}
 
-    async def _list_nodes(self, conn: ServerConnection):
-        return {
-            nid: {
+    async def _list_nodes(self, conn: ServerConnection,
+                          summary: bool = False,
+                          alive_only: bool = False,
+                          labels: dict | None = None,
+                          limit: int = 0):
+        """Node listing. The default (no kwargs) keeps the full O(cluster)
+        per-node payload for existing callers; at fleet size the state
+        API/CLI pass ``summary=True`` (aggregate counts + resource totals,
+        no per-node rows — O(1) payload at 1000 nodes) or filter with
+        ``alive_only``/``labels``/``limit`` so a dashboard poll stops
+        shipping the whole node table."""
+        if summary:
+            totals: dict[str, float] = {}
+            avail: dict[str, float] = {}
+            n_alive = 0
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                n_alive += 1
+                for k, v in n.resources.items():
+                    totals[k] = totals.get(k, 0.0) + v
+                for k, v in n.available.items():
+                    avail[k] = avail.get(k, 0.0) + v
+            return {"summary": {
+                "nodes_total": len(self.nodes), "nodes_alive": n_alive,
+                "resources": totals, "available": avail,
+            }}
+        out = {}
+        for nid, n in self.nodes.items():
+            if alive_only and not n.alive:
+                continue
+            if labels and any(n.labels.get(k) != v
+                              for k, v in labels.items()):
+                continue
+            out[nid] = {
                 "addr": list(n.addr), "resources": n.resources,
                 "available": n.available, "alive": n.alive, "labels": n.labels,
                 "transfer_addr": (list(n.transfer_addr)
                                   if n.transfer_addr else None),
                 "object_plane": n.object_plane,
             }
-            for nid, n in self.nodes.items()
-        }
+            if limit and len(out) >= limit:
+                break
+        return out
 
     async def _health_loop(self):
         # reference: GcsHealthCheckManager periodic pings; here heartbeat ages.
@@ -1041,6 +1243,7 @@ class HeadServer:
         if info is None or not info.alive:
             return
         info.alive = False
+        self._sched_touch(info)
         self._drop_daemon_client(node_id)
         self._membership_version += 1
         await self.publish("node_events", event="died", node_id=node_id)
@@ -1131,12 +1334,105 @@ class HeadServer:
                 "ok": False, "error": "no feasible node for actor resources"})
         return self._dedup_put(req_id, {"ok": True})
 
+    def _sched_touch(self, info: NodeInfo) -> None:
+        """Refresh a node's scheduler-index entries after ANY mutation of
+        its available/optimistic/alive state (register, heartbeat,
+        optimistic hold, drain, death). O(log n): the heap is lazy — a
+        changed key pushes a fresh entry and the superseded one is
+        detected (key mismatch vs _cpu_free) and discarded at pop time.
+        Unchanged keys push nothing, so the idle-fleet heartbeat storm —
+        the common case at 1000 nodes — costs the index two dict reads."""
+        nid = info.node_id
+        if not info.alive:
+            self._cpu_free.pop(nid, None)
+            self._free_sum.pop(nid, None)
+            return
+        self._free_sum[nid] = sum(info.available.values())
+        key = info.effective("CPU")
+        if self._cpu_free.get(nid) == key:
+            return
+        self._cpu_free[nid] = key
+        heapq.heappush(self._cpu_heap, (-key, nid))
+        if len(self._cpu_heap) > 4 * len(self._cpu_free) + 64:
+            # Compact: rebuild from live keys once stale entries dominate.
+            self._cpu_heap = [(-v, n) for n, v in self._cpu_free.items()]
+            heapq.heapify(self._cpu_heap)
+
     def _pick_node(self, resources: dict[str, float], node_affinity: str | None = None,
                    labels: dict | None = None) -> NodeInfo | None:
         # Least-loaded feasible node (reference default is hybrid pack/spread;
         # actors spread by load — gcs_actor_scheduler picks via cluster view).
+        # Three indexed paths replace the full node-table scan (the linear
+        # walk survives below as the parity oracle + config fallback):
+        # affinity is a dict hit, labels intersect the inverted index, and
+        # the general case walks the (-effective CPU, node_id) heap — the
+        # EXACT order the linear version sorts by — so the first node that
+        # passes the ready check is the same node the scan would pick.
+        if not get_config().indexed_scheduler_enabled:
+            return self._pick_node_linear(resources, node_affinity, labels)
+        if node_affinity:
+            n = self.nodes.get(node_affinity)
+            if (n is None or not n.alive
+                    or (labels and any(n.labels.get(k) != v
+                                       for k, v in labels.items()))
+                    or not all(n.resources.get(k, 0.0) >= v
+                               for k, v in resources.items())):
+                return None
+            return n
+        if labels:
+            cands: set[str] | None = None
+            for k, v in labels.items():
+                s = self._label_index.get((k, v))
+                if not s:
+                    return None
+                cands = set(s) if cands is None else cands & s
+                if not cands:
+                    return None
+            return self._pick_node_linear(resources, None, labels,
+                                          node_ids=cands)
+        heap = self._cpu_heap
+        popped: list[tuple[float, str]] = []
+        best_feasible: NodeInfo | None = None
+        found: NodeInfo | None = None
+        while heap:
+            entry = heapq.heappop(heap)
+            key, nid = entry
+            if self._cpu_free.get(nid) != -key:
+                continue  # stale: superseded key or dead node — drop it
+            popped.append(entry)
+            n = self.nodes.get(nid)
+            if n is None or not n.alive:
+                continue
+            if not all(n.resources.get(k, 0.0) >= v
+                       for k, v in resources.items()):
+                continue
+            if best_feasible is None:
+                best_feasible = n
+            # Prefer nodes that can host the actor NOW — picking by totals
+            # alone stacks same-resource actors onto one node while its
+            # twin sits idle (the daemon would park the extra actor in its
+            # wait-for-resources loop). "Now" includes the optimistic holds
+            # of placements already issued this heartbeat window.
+            if all(n.effective(k) >= v for k, v in resources.items()):
+                found = n
+                break
+        for entry in popped:
+            heapq.heappush(heap, entry)
+        return found or best_feasible
+
+    def _pick_node_linear(self, resources: dict[str, float],
+                          node_affinity: str | None = None,
+                          labels: dict | None = None,
+                          node_ids: set[str] | None = None) -> NodeInfo | None:
+        """The original full-scan picker. Still load-bearing: the indexed
+        path routes label-constrained picks here over the (small) inverted
+        -index candidate set, the config kill-switch falls back to it, and
+        test_scale proves indexed-vs-linear parity against it."""
+        nodes = (self.nodes[nid] for nid in node_ids
+                 if nid in self.nodes) if node_ids is not None \
+            else self.nodes.values()
         ready, feasible = [], []
-        for n in self.nodes.values():
+        for n in nodes:
             if not n.alive:
                 continue
             if node_affinity and n.node_id != node_affinity:
@@ -1148,17 +1444,12 @@ class HeadServer:
                 continue
             free = sum(n.effective(k) for k in ("CPU",))
             feasible.append((-free, n.node_id, n))
-            # Prefer nodes that can host the actor NOW — picking by totals
-            # alone stacks same-resource actors onto one node while its
-            # twin sits idle (the daemon would park the extra actor in its
-            # wait-for-resources loop). "Now" includes the optimistic holds
-            # of placements already issued this heartbeat window.
             if all(n.effective(k) >= v for k, v in resources.items()):
                 ready.append((-free, n.node_id, n))
         pool = ready or feasible
         if not pool:
             return None
-        pool.sort()
+        pool.sort(key=lambda t: (t[0], t[1]))
         return pool[0][2]
 
     async def _schedule_actor(self, info: ActorInfo) -> bool:
@@ -1170,34 +1461,54 @@ class HeadServer:
         # single most-free node.
         placement = dict(info.resources) if any(info.resources.values()) \
             else {"CPU": 1.0}
-        node = self._pick_node(placement, info.node_affinity,
-                               info.labels)
-        if node is None and info.node_affinity and info.affinity_soft:
-            # Soft affinity: target gone/infeasible → default placement.
-            node = self._pick_node(placement, None, info.labels)
-        if node is None:
-            return False
-        info.node_id = node.node_id
-        conn = self._node_conns.get(node.node_id)
-        if conn is None:
-            return False
-        # Optimistic per-resource hold: back-to-back placements must not
-        # all see the same node as free. Never mutates ``available``
-        # (truthful resource views matter to the elastic/autoscaler
-        # policies); the next heartbeat replaces it with daemon truth.
-        for k, v in placement.items():
-            node.optimistic[k] = node.optimistic.get(k, 0.0) + v
-        # Ask the node daemon to place the actor in a fresh/pooled worker
-        # (reference: GcsActorScheduler leases a worker from the raylet).
-        # head_boot rides along so a daemon that has since registered with
-        # a NEWER head can fence a stale head's placement instead of
-        # double-allocating the worker.
-        await conn.notify(
-            "place_actor", actor_id=info.actor_id, spec_blob=info.spec_blob,
-            resources=info.resources, env_json=info.env_json,
-            head_boot=self.boot_id,
-        )
-        return True
+        while True:
+            node = self._pick_node(placement, info.node_affinity,
+                                   info.labels)
+            if node is None and info.node_affinity and info.affinity_soft:
+                # Soft affinity: target gone/infeasible → default placement.
+                node = self._pick_node(placement, None, info.labels)
+            if node is None:
+                return False
+            conn = self._node_conns.get(node.node_id)
+            if conn is None:
+                # Registered-but-connectionless: the socket dropped and the
+                # disconnect fast path hasn't flipped ``alive`` yet. Run
+                # the one death sequence now (idempotent) and re-pick —
+                # failing the registration while feasible nodes remain
+                # would mark the actor DEAD over a transient race.
+                await self._declare_node_dead(node.node_id)
+                continue
+            info.node_id = node.node_id
+            # Optimistic per-resource hold: back-to-back placements must not
+            # all see the same node as free. Never mutates ``available``
+            # (truthful resource views matter to the elastic/autoscaler
+            # policies); the next heartbeat replaces it with daemon truth.
+            for k, v in placement.items():
+                node.optimistic[k] = node.optimistic.get(k, 0.0) + v
+            self._sched_touch(node)
+            # Ask the node daemon to place the actor in a fresh/pooled worker
+            # (reference: GcsActorScheduler leases a worker from the raylet).
+            # head_boot rides along so a daemon that has since registered with
+            # a NEWER head can fence a stale head's placement instead of
+            # double-allocating the worker.
+            try:
+                await conn.notify(
+                    "place_actor", actor_id=info.actor_id,
+                    spec_blob=info.spec_blob, resources=info.resources,
+                    env_json=info.env_json, head_boot=self.boot_id,
+                )
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                # The daemon died between the pick and the push (chaos
+                # kill / crash race): a failed write is the same positive
+                # death evidence the disconnect fast path acts on. Unpin
+                # FIRST so _fail_actors_on_node doesn't burn a restart on
+                # an actor that never reached the node, then re-pick —
+                # the caller must never see a transport error for a
+                # placement the head can still satisfy elsewhere.
+                info.node_id = None
+                await self._declare_node_dead(node.node_id)
+                continue
+            return True
 
     async def _actor_ready(self, conn: ServerConnection, actor_id: str, worker_id: str,
                            host: str, port: int):
@@ -1335,20 +1646,42 @@ class HeadServer:
                 pass
 
     def _assign_bundles(self, bundles: list[dict], strategy: str) -> list[str] | None:
-        """bundle index → node_id, honoring the strategy; None if infeasible."""
+        """bundle index → node_id, honoring the strategy; None if infeasible.
+
+        Fleet-scale shape: the old version copied every alive node's
+        available dict up front — O(nodes x keys) allocation per attempt,
+        and _schedule_pg retries this in a loop. Now reads go straight to
+        the NodeInfo maps with a lazy per-call overlay that only
+        materializes for nodes a bundle actually landed on, and the PACK
+        ordering reuses the _sched_touch-maintained _free_sum cache.
+        Iteration stays in self.nodes order (the stable-sort tie-break
+        the old dict build inherited), so assignments are bit-identical."""
         alive = [n for n in self.nodes.values() if n.alive]
-        free = {n.node_id: dict(n.available) for n in alive}
+        avail = {n.node_id: n.available for n in alive}
+        overlay: dict[str, dict] = {}
+
+        def _get(nid, k):
+            d = overlay.get(nid)
+            if d is not None and k in d:
+                return d[k]
+            return avail[nid].get(k, 0.0)
 
         def fits(nid, b):
-            return all(free[nid].get(k, 0.0) >= v for k, v in b.items())
+            return all(_get(nid, k) >= v for k, v in b.items())
 
         def take(nid, b):
+            d = overlay.setdefault(nid, {})
             for k, v in b.items():
-                free[nid][k] = free[nid].get(k, 0.0) - v
+                d[k] = _get(nid, k) - v
 
+        def free_sum(nid):
+            s = self._free_sum.get(nid)
+            return s if s is not None else sum(avail[nid].values())
+
+        free = avail  # candidate ids, self.nodes iteration order
         assignment: list[str] = []
         if strategy in ("PACK", "STRICT_PACK"):
-            order = sorted(free, key=lambda nid: -sum(free[nid].values()))
+            order = sorted(free, key=lambda nid: -free_sum(nid))
             for b in bundles:
                 if strategy == "STRICT_PACK" and assignment:
                     cands = [assignment[0]]
@@ -1852,9 +2185,50 @@ class HeadServer:
             if row["ts"] >= cutoff
         }}
 
-    async def _state_snapshot(self, conn: ServerConnection):
+    async def _state_snapshot(self, conn: ServerConnection,
+                              parts: list | None = None):
         """Whole-cluster view for the state API (reference: the GCS tables
-        behind python/ray/util/state/api.py list_nodes/list_actors/...)."""
+        behind python/ray/util/state/api.py list_nodes/list_actors/...).
+        ``parts`` names the tables to build (["nodes"], ["actors"], ...);
+        None keeps the full dump — at 1000 nodes a list_actors call must
+        not pay for serializing the node table it throws away."""
+        want = set(parts) if parts else None
+        out: dict[str, dict] = {}
+        if want is not None:
+            if "nodes" in want:
+                out["nodes"] = {
+                    nid: {
+                        "alive": n.alive, "resources": n.resources,
+                        "available": n.available, "labels": n.labels,
+                        "addr": list(n.addr),
+                        "transfer_addr": (list(n.transfer_addr)
+                                          if n.transfer_addr else None),
+                    }
+                    for nid, n in self.nodes.items()
+                }
+            if "actors" in want:
+                out["actors"] = {
+                    aid: {
+                        "state": a.state, "name": a.name,
+                        "namespace": a.namespace,
+                        "node_id": a.node_id, "resources": a.resources,
+                        "restarts": a.restarts_used,
+                        "death_reason": a.death_reason,
+                    }
+                    for aid, a in self.actors.items()
+                }
+            if "placement_groups" in want:
+                out["placement_groups"] = {
+                    pid: {"state": pg["state"], "strategy": pg["strategy"],
+                          "bundles": pg["bundles"], "name": pg.get("name")}
+                    for pid, pg in self.pgs.items()
+                }
+            if "workers" in want:
+                out["workers"] = {
+                    wid: {"addr": [row[0], row[1]]}
+                    for wid, row in self.workers.items()
+                }
+            return out
         return {
             "nodes": {
                 nid: {

@@ -139,6 +139,7 @@ class NodeDaemon:
         labels: dict[str, str] | None = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        sim: bool = False,
     ):
         self.node_id = node_id
         self.head_addr = (head_host, head_port)
@@ -146,6 +147,25 @@ class NodeDaemon:
         self.resources = dict(resources)
         self.available = dict(resources)
         self.labels = labels or {}
+        # Simulated-fleet mode (core/cluster/sim_fleet.py): a REAL daemon
+        # over the real RPC stack — registration, heartbeats, leases, 2PC
+        # bundles all genuine — but with a fake device inventory, no
+        # forked worker processes (leases grant synthetic in-process
+        # workers), no shm arena/transfer server, and no per-daemon timer
+        # tasks (the fleet's single timer wheel drives _heartbeat_once).
+        # That is what lets one dev box stand up 500-1000 registered
+        # nodes against one head and measure where it saturates.
+        self.sim = sim
+        # Delta heartbeats (head._heartbeat's wire contract): base view as
+        # last acknowledged by the head, or None-ish flags forcing a full
+        # send. _hb_stats feeds the scale bench's heartbeat-loss gate.
+        self._hb_synced = False
+        self._hb_force_full = True
+        self._hb_last_avail: dict = {}
+        self._hb_last_demands: list | None = None
+        self._hb_last_sent = 0.0  # monotonic ts of the last beat on the wire
+        self._hb_stats = {"sent": 0, "full": 0, "delta": 0, "empty": 0,
+                          "skipped": 0, "failed": 0, "resync": 0}
         self.workers: dict[str, WorkerProc] = {}  # keyed by worker_id
         self._unregistered: list[WorkerProc] = []  # forked, not yet registered
         # env_hash -> consecutive boot failures of its container workers
@@ -216,16 +236,18 @@ class NodeDaemon:
         # by name via RTPU_SHM_NAME.
         self.shm_name: str | None = None
         self._shm = None
-        try:
-            from ray_tpu.core.shm_store import SharedMemoryStore
+        if not sim:  # sim daemons: no data plane — 1000 arenas would
+            try:     # exhaust /dev/shm long before the head saturates
+                from ray_tpu.core.shm_store import SharedMemoryStore
 
-            name = f"rtpu_{self.node_id[:16]}"
-            self._shm = SharedMemoryStore(
-                name, capacity_bytes=get_config().object_store_memory_bytes,
-                create=True)
-            self.shm_name = name
-        except Exception:
-            self._shm = None  # native build unavailable; RPC-only transfers
+                name = f"rtpu_{self.node_id[:16]}"
+                self._shm = SharedMemoryStore(
+                    name,
+                    capacity_bytes=get_config().object_store_memory_bytes,
+                    create=True)
+                self.shm_name = name
+            except Exception:
+                self._shm = None  # native build unavailable; RPC-only
         # Native transfer data plane over the arena (src/transfer/
         # transfer.cc): serves object bytes to pulling nodes with zero
         # Python in the byte path (reference: object_manager data plane).
@@ -352,6 +374,15 @@ class NodeDaemon:
         await self._head.connect()
         await self._register_with_head(self._head)
         loop = asyncio.get_running_loop()
+        if self.sim:
+            # No per-daemon timers: at 1000 daemons per process, 6 loop
+            # tasks each is 6000 always-armed timers before any work
+            # happens. The SimFleet timer wheel calls _heartbeat_once on
+            # the fleet's schedule instead; reap/death-watch/memory loops
+            # watch real child processes sim daemons never fork, and
+            # telemetry/gossip are the fleet's to drive when a bench
+            # wants them.
+            return addr
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._reap_loop()))
         if get_config().worker_death_poll_s > 0:
@@ -1011,73 +1042,145 @@ class NodeDaemon:
             pass
 
     async def _heartbeat_loop(self):
+        cfg = get_config()
+        while True:
+            if not await self._heartbeat_once():
+                return
+            await asyncio.sleep(cfg.health_check_period_s / 2)
+
+    async def _heartbeat_once(self) -> bool:
+        """One heartbeat round: chaos probe, delta-or-full beat, reply
+        absorption (peers map, resync, reregister). Returns False when
+        this daemon must stop beating (fenced or chaos-killed). Factored
+        out of _heartbeat_loop so the sim fleet's timer wheel can drive
+        a thousand daemons without a timer task per daemon — chaos kill
+        probes included, so drills fire under the wheel too."""
         from ray_tpu.chaos import injector as _chaos
 
         cfg = get_config()
+        if self._fenced:
+            # Superseded incarnation: a newer daemon owns this node id.
+            # Heartbeating on would fight it for the registration.
+            return False
+        if _chaos.ACTIVE:
+            rule = _chaos.decide("daemon.tick", node=self.node_id)
+            if rule is not None and rule.action == "kill":
+                _chaos.write_mark(rule, "daemon.tick",
+                                  {"node": self.node_id})
+                await self._chaos_die()
+                return False
         # Heartbeat RPC timeout: a partition-DROPPED frame produces no
         # connection error — without a bound the await would wedge this
         # loop forever and the daemon would never enter its reconnect
         # path even after the partition healed.
         hb_timeout = cfg.daemon_heartbeat_timeout_s
-        while True:
-            if self._fenced:
-                # Superseded incarnation: a newer daemon owns this node id.
-                # Heartbeating on would fight it for the registration.
-                return
-            if _chaos.ACTIVE:
-                rule = _chaos.decide("daemon.tick", node=self.node_id)
-                if rule is not None and rule.action == "kill":
-                    _chaos.write_mark(rule, "daemon.tick",
-                                      {"node": self.node_id})
-                    await self._chaos_die()
-                    return
-            try:
-                res = await self._head.call(
-                    "heartbeat", node_id=self.node_id,
-                    timeout=hb_timeout if hb_timeout > 0 else None,
-                    available=self.available, resources=self.resources,
-                    # Pending lease demands feed the autoscaler (reference:
-                    # raylet reports resource load to GcsResourceManager for
-                    # GcsAutoscalerStateManager). Batched requests count one
-                    # demand per REMAINING grant.
-                    pending_demands=[r.resources for r in self._pending
-                                     if not r.fut.done()
-                                     for _ in range(max(1, r.remaining))],
-                    peers_version=self._gossip_peers_version)
-                if res.get("reregister"):
-                    # The head answered but doesn't know us: it restarted
-                    # (nodes aren't snapshotted — membership is rebuilt
-                    # from live daemons). Re-register on THIS connection
-                    # with the full reconcile payload.
-                    await self._register_with_head(self._head)
-                    if self._fenced:
-                        return
-                else:
-                    self._mark_head_connected(True)
-                # Authoritative membership for the gossip ring (view data
-                # itself travels daemon-to-daemon, not through the head):
-                # wholesale replacement prunes dead/drained nodes from the
-                # ring AND evicts their stale view entries.
-                if "peers" in res:
-                    self._gossip_peers = {
-                        nid: tuple(addr)
-                        for nid, addr in (res["peers"] or {}).items()}
-                    self._gossip_peers_version = res.get(
-                        "membership_version", -1)
-                    for nid in list(self._gossip_view):
-                        if nid not in self._gossip_peers:
-                            self._gossip_view.pop(nid, None)
-                if self._failed_actor_notify:
-                    await self._drain_actor_failures()
-            except (OSError, RpcError, asyncio.TimeoutError, TimeoutError):
-                # Head down/restarted/partitioned: reconnect and
-                # re-register so a restarted control plane rebuilds its
-                # node view (reference: raylet HandleNotifyGCSRestart,
-                # node_manager.cc:1050). Narrow on connection-shaped
-                # failures — a programming error in the try block must
-                # surface, not be eaten as "head down".
-                await self._reconnect_head()
-            await asyncio.sleep(cfg.health_check_period_s / 2)
+        # Pending lease demands feed the autoscaler (reference: raylet
+        # reports resource load to GcsResourceManager for
+        # GcsAutoscalerStateManager). Batched requests count one demand
+        # per REMAINING grant.
+        demands = [r.resources for r in self._pending
+                   if not r.fut.done()
+                   for _ in range(max(1, r.remaining))]
+        sent_avail = dict(self.available)
+        kw: dict = {}
+        if cfg.delta_heartbeat_enabled and self._hb_synced \
+                and not self._hb_force_full:
+            # Delta form (head._heartbeat): only changed/removed keys ride
+            # the wire; an idle node's beat is just the liveness stamp.
+            delta = {k: v for k, v in sent_avail.items()
+                     if self._hb_last_avail.get(k) != v}
+            removed = [k for k in self._hb_last_avail
+                       if k not in sent_avail]
+            demands_same = (self._hb_last_demands is not None
+                            and demands == self._hb_last_demands)
+            if not delta and not removed and demands_same:
+                # Nothing changed -> nothing to sync (reference:
+                # ray_syncer versioned snapshots — an unchanged view
+                # sends no message at all; liveness rides a far cheaper
+                # cadence). The per-beat cost at fleet scale is the RPC
+                # ENVELOPE, not the payload, so the only way an idle
+                # 1000-node fleet stops billing the head 2N RPCs per
+                # period is to not send. Liveness still needs beats
+                # under the head's death threshold (period x
+                # failure_threshold); keeping >=3 beats per threshold
+                # window leaves the same detection latency with a 3x
+                # margin against loss. Any local change (or forced
+                # full/resync) bypasses this and beats immediately.
+                idle_gap = (cfg.health_check_period_s *
+                            cfg.health_check_failure_threshold / 3.0)
+                if time.monotonic() - self._hb_last_sent < idle_gap:
+                    self._hb_stats["skipped"] += 1
+                    return True
+            if delta:
+                kw["available_delta"] = delta
+            if removed:
+                kw["available_removed"] = removed
+            if demands_same:
+                kw["demands_unchanged"] = True
+            else:
+                kw["pending_demands"] = demands
+            wire = "delta" if (delta or removed) else "empty"
+        else:
+            kw["available"] = sent_avail
+            kw["resources"] = self.resources
+            kw["pending_demands"] = demands
+            wire = "full"
+        self._hb_stats["sent"] += 1
+        try:
+            res = await self._head.call(
+                "heartbeat", node_id=self.node_id,
+                timeout=hb_timeout if hb_timeout > 0 else None,
+                peers_version=self._gossip_peers_version, **kw)
+            if res.get("reregister"):
+                # The head answered but doesn't know us: it restarted
+                # (nodes aren't snapshotted — membership is rebuilt
+                # from live daemons). Re-register on THIS connection
+                # with the full reconcile payload.
+                await self._register_with_head(self._head)
+                if self._fenced:
+                    return False
+                return True
+            self._mark_head_connected(True)
+            self._hb_stats[wire] += 1
+            self._hb_last_sent = time.monotonic()
+            if res.get("resync"):
+                # The head has no delta base on this connection (restart
+                # raced the register): it stamped liveness but dropped
+                # the delta — next beat ships the full map.
+                self._hb_stats["resync"] += 1
+                self._hb_force_full = True
+            else:
+                if wire == "full":
+                    self._hb_synced = True
+                    self._hb_force_full = False
+                self._hb_last_avail = sent_avail
+                self._hb_last_demands = demands
+            # Authoritative membership for the gossip ring (view data
+            # itself travels daemon-to-daemon, not through the head):
+            # wholesale replacement prunes dead/drained nodes from the
+            # ring AND evicts their stale view entries.
+            if "peers" in res:
+                self._gossip_peers = {
+                    nid: tuple(addr)
+                    for nid, addr in (res["peers"] or {}).items()}
+                self._gossip_peers_version = res.get(
+                    "membership_version", -1)
+                for nid in list(self._gossip_view):
+                    if nid not in self._gossip_peers:
+                        self._gossip_view.pop(nid, None)
+            if self._failed_actor_notify:
+                await self._drain_actor_failures()
+        except (OSError, RpcError, asyncio.TimeoutError, TimeoutError):
+            # Head down/restarted/partitioned: reconnect and
+            # re-register so a restarted control plane rebuilds its
+            # node view (reference: raylet HandleNotifyGCSRestart,
+            # node_manager.cc:1050). Narrow on connection-shaped
+            # failures — a programming error in the try block must
+            # surface, not be eaten as "head down".
+            self._hb_stats["failed"] += 1
+            self._hb_force_full = True
+            await self._reconnect_head()
+        return True
 
     # ---------------------------------------------------------------- gossip
     # Peer resource-view dissemination (reference: src/ray/ray_syncer/
@@ -1241,6 +1344,7 @@ class NodeDaemon:
         adopts the head's session identity from the reply. Returns False
         (and stands the daemon down) when the head fenced this daemon as
         a stale incarnation."""
+        state = self._register_state()
         res = await client.call(
             "register_node", node_id=self.node_id, host=self.rpc.host,
             port=self.rpc.port, resources=self.resources,
@@ -1248,7 +1352,7 @@ class NodeDaemon:
             transfer_addr=(list(self.transfer_addr)
                            if self.transfer_addr else None),
             object_plane=self._object_plane_info(),
-            epoch=self._epoch, state=self._register_state(),
+            epoch=self._epoch, state=state,
             timeout=get_config().daemon_heartbeat_timeout_s or None)
         if isinstance(res, dict) and res.get("fenced"):
             # A newer incarnation of this node id owns the registration:
@@ -1269,6 +1373,15 @@ class NodeDaemon:
             self._head_boot_id = res.get("boot_id") or self._head_boot_id
             self._head_incarnation = int(
                 res.get("incarnation") or self._head_incarnation)
+        # The register payload carried the full available map: the head
+        # marked this connection delta-synced, so subsequent heartbeats
+        # may ship deltas against exactly what we just sent. The head
+        # seeds pending_demands=[] at registration.
+        self._hb_synced = True
+        self._hb_force_full = False
+        self._hb_last_avail = dict(state.get("available") or {})
+        self._hb_last_demands = []
+        self._hb_last_sent = time.monotonic()
         self._mark_head_connected(True)
         return True
 
@@ -1534,7 +1647,15 @@ class NodeDaemon:
         container = container_spec(req.env_hash)
         w = self._idle_worker(req.env_hash, exact_only=container is not None)
         if w is None:
-            return False
+            if not self.sim:
+                return False
+            # Sim daemon: leases exercise the head's scheduling path, not
+            # real execution — fabricate a process-less worker record so
+            # grants/returns and resource accounting behave exactly as on
+            # a real node without forking anything.
+            w = WorkerProc(worker_id=uuid.uuid4().hex, proc=None,
+                           addr=(self.rpc.host, self.rpc.port))
+            self.workers[w.worker_id] = w
         lease_id = uuid.uuid4().hex
         w.lease_id = lease_id
         if req.env_hash:
@@ -1584,6 +1705,11 @@ class NodeDaemon:
             if self._fits(req.resources):
                 unmet.append(req)  # workers, not resources, are the gap
         self._pending = still
+        if self.sim:
+            # Sim daemons fabricate workers in _grant_to — anything still
+            # unmet here is genuinely resource-starved; forking would
+            # defeat the whole point of the simulation.
+            return
         # Fork only the DEFICIT beyond workers already starting: one fork per
         # unmatched request per grant pass compounds into a fork storm (each
         # registration re-runs this pass) — a Python worker boot costs ~1 s
@@ -1714,6 +1840,11 @@ class NodeDaemon:
         a head round trip)."""
         from ray_tpu.core.cluster.protocol import spawn_task
 
+        # This out-of-band full beat races the periodic loop; RPC ordering
+        # between the two isn't guaranteed, so the loop's delta base may no
+        # longer match what the head holds. Force its next beat full.
+        self._hb_force_full = True
+
         async def push():
             try:
                 await self._head.call("heartbeat", node_id=self.node_id,
@@ -1838,6 +1969,26 @@ class NodeDaemon:
                                           reason="timed out waiting for resources",
                                           timeout=10)
                     return
+            if self.sim:
+                # Sim placement: the full control loop (head pick ->
+                # place_actor -> actor_ready -> ALIVE) runs for real, but
+                # there is no process to boot — fabricate the dedicated
+                # worker and ACK readiness at the daemon's own address.
+                w = WorkerProc(worker_id=uuid.uuid4().hex, proc=None,
+                               addr=(self.rpc.host, self.rpc.port))
+                self.workers[w.worker_id] = w
+                w.actor_id = actor_id
+                w.resources = dict(resources)
+                self._take_resources(resources)
+                self._actor_workers[actor_id] = w
+                try:
+                    await self._head.call("actor_ready", actor_id=actor_id,
+                                          worker_id=w.worker_id,
+                                          host=w.addr[0], port=w.addr[1],
+                                          timeout=10)
+                except Exception:  # noqa: BLE001 - un-ACKed-grant window
+                    pass
+                return
             # Actors get a pristine worker: the creation spec's runtime_env
             # is applied by init_actor, and the worker is dedicated until
             # death. A container env instead forks a worker INSIDE the image

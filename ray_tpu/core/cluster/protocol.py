@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import itertools
+import logging
 import socket
 import struct
 import threading
@@ -26,6 +27,8 @@ import msgpack
 
 from ray_tpu.devtools.annotations import loop_confined
 from ray_tpu.chaos import injector as _chaos
+
+logger = logging.getLogger("ray_tpu.rpc")
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
@@ -152,6 +155,12 @@ class RpcServer:
         # diffs head counts across N steps to prove the direct-channel data
         # plane issues ~0 control-plane RPCs per step.
         self.counts: Counter = Counter()
+        # Per-method handler-latency odometer: method -> [calls, total_s,
+        # max_s], recorded around the awaited handler in _dispatch (raw
+        # handlers skip it — their work happens off-loop). The head's
+        # self-metrics loop diffs snapshots of this into the per-method
+        # rate/latency table `ray_tpu status` shows.
+        self.stats: dict[str, list] = {}
         # Raw handlers: fn(conn, msg) invoked INLINE in the read loop — no
         # task spawn, no auto-reply. The handler owns correlation: it hands
         # the frame to an execution thread which packs the reply itself and
@@ -278,13 +287,25 @@ class ServerConnection:
         if fn is None:
             await self._reply(rid, err=f"no such method: {method}")
             return
+        t0 = time.perf_counter()
         try:
             result = await fn(self, **msg.get("a", {}))
-            if rid is not None:
-                await self._reply(rid, ok=result)
+            err = None
         except Exception as e:  # noqa: BLE001
-            if rid is not None:
-                await self._reply(rid, err=f"{type(e).__name__}: {e}")
+            result, err = None, f"{type(e).__name__}: {e}"
+        dt = time.perf_counter() - t0
+        st = self.server.stats.get(method)
+        if st is None:
+            st = self.server.stats[method] = [0, 0.0, 0.0]
+        st[0] += 1
+        st[1] += dt
+        if dt > st[2]:
+            st[2] = dt
+        if rid is not None:
+            if err is not None:
+                await self._reply(rid, err=err)
+            else:
+                await self._reply(rid, ok=result)
 
     async def _reply(self, rid, ok=None, err=None):
         hook = self.server.pre_reply
@@ -388,7 +409,17 @@ class AsyncRpcClient:
             elif "m" in msg:
                 fn = self._notify_handlers.get(msg["m"])
                 if fn is not None:
-                    spawn_task(fn(**msg.get("a", {})))
+                    # Sync handlers run inline; only coroutines get a task.
+                    # A handler exception must not kill the read loop — that
+                    # silently drops every later notify AND strands every
+                    # in-flight call on this connection.
+                    try:
+                        res = fn(**msg.get("a", {}))
+                        if asyncio.iscoroutine(res):
+                            spawn_task(res)
+                    except Exception:  # noqa: BLE001 - handler bug, log it
+                        logger.exception("notify handler %r failed",
+                                         msg["m"])
 
     def _fail_all(self, exc: Exception):
         self._closed = True

@@ -352,8 +352,10 @@ class ClusterRuntime:
         self._series_sampler = None  # lazy watchdog SeriesSampler
         threading.Thread(target=self._telemetry_flusher, daemon=True,
                          name="telemetry-flush").start()
-        # Actor state invalidation via pubsub.
+        # Actor state invalidation via pubsub (single events or the head's
+        # window-coalesced batches — both land in _on_pub).
         self.head.aio.on_notify("pub", self._on_pub)
+        self.head.aio.on_notify("pub_batch", self._on_pub_batch)
         self.head.call_retrying("subscribe", idempotent=True,
                                 channel="actor_events")
 
@@ -1025,6 +1027,13 @@ class ClusterRuntime:
         self._replicas.pop(object_id, None)
         ok = self._recover_object(object_id)
         return {"ok": ok, "state": "recovering" if ok else "lost"}
+
+    async def _on_pub_batch(self, events: list):
+        """Coalesced pubsub delivery: the head's batched fan-out ships one
+        ``pub_batch`` notify carrying every event buffered for this
+        subscriber in the window (head.publish)."""
+        for ev in events or ():
+            await self._on_pub(ev.get("channel"), ev.get("payload") or {})
 
     async def _on_pub(self, channel: str, payload: dict):
         if channel == "actor_events":
@@ -2861,10 +2870,21 @@ class ClusterRuntime:
         attribution; diff two snapshots around a workload)."""
         return self.head.call_retrying("rpc_counts", idempotent=True)
 
-    def state_snapshot(self) -> dict:
-        snap = self.head.call_retrying("state_snapshot", idempotent=True)
-        snap["objects"] = self.store.stats()
+    def state_snapshot(self, parts: list | None = None) -> dict:
+        """``parts`` names the head tables to fetch (["nodes"], ["actors"],
+        ...) so a single-entity state-API listing stops shipping the whole
+        cluster dump; None keeps the full snapshot."""
+        snap = self.head.call_retrying("state_snapshot", idempotent=True,
+                                       parts=parts)
+        if parts is None or "objects" in parts:
+            snap["objects"] = self.store.stats()
         return snap
+
+    def node_summary(self) -> dict:
+        """O(1)-payload node aggregate (count/alive/resource totals) —
+        the fleet-size-safe alternative to a full list_nodes."""
+        return self.head.call_retrying(
+            "list_nodes", idempotent=True, summary=True)["summary"]
 
     def task_events(self, since: int = 0, epoch: str = "") -> dict:
         """Cluster-wide task events newer than the ``since`` cursor."""

@@ -1,0 +1,286 @@
+"""Simulated fleet: hundreds of real node daemons in one process.
+
+Scale harness for the control plane (reference: Ray's `fake_multi_node`
+test utilities, python/ray/autoscaler/_private/fake_multi_node/ — many
+raylets faked on one box to exercise GCS-side behavior without a real
+cluster). Every daemon here is a REAL :class:`NodeDaemon` speaking the
+real RPC protocol to a real head — registration, delta heartbeats,
+leases, 2PC bundles, fencing are all the production code paths — but:
+
+- the device inventory is fabricated from a geometry string ("v5e-8" →
+  8 TPU chips + CPUs, labeled so placement/affinity tests can target it);
+- ``sim=True`` strips the per-node cost that makes a thousand daemons
+  impossible in one process: no shm arena (1000 arenas would exhaust
+  /dev/shm), no forked workers (leases grant synthetic in-process
+  records), no per-daemon timer tasks;
+- one :class:`TimerWheel` drives every daemon's ``_heartbeat_once`` on a
+  shared schedule with phases spread across the period, so 1000 nodes
+  cost one timer task instead of 6000.
+
+What the harness measures is therefore the HEAD: where its heartbeat
+ingest, scheduling scans, and pubsub fan-out saturate as node count
+grows (devbench/scale_bench.py sweeps this and records the knees).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import logging
+import uuid
+
+from ray_tpu.core.cluster.node_daemon import NodeDaemon
+from ray_tpu.core.cluster.protocol import EventLoopThread
+from ray_tpu.devtools.annotations import loop_confined
+from ray_tpu.utils.config import get_config
+
+logger = logging.getLogger(__name__)
+
+# Chips per host for known accelerator generations (geometry "<gen>-<N>"
+# may name any chip count; this only seeds the CPU guess below).
+_CPUS_PER_CHIP = 14.0  # v5e host: 112 vCPU / 8 chips
+
+
+def parse_geometry(geometry: str) -> tuple[dict[str, float], dict[str, str]]:
+    """``"v5e-8"`` → per-node resource totals + placement labels.
+
+    The resource map is what a real daemon on such a host would register:
+    TPU chips plus a proportional CPU count (fractional-CPU tasks and PG
+    bundles need headroom to pack against). Labels carry the accelerator
+    generation and topology so label-affinity scheduling is exercisable
+    against the sim fleet, plus ``sim: "1"`` so operators can tell fake
+    capacity from real in ``list_nodes``/status output.
+    """
+    gen, _, chips_s = geometry.rpartition("-")
+    try:
+        chips = float(chips_s)
+    except ValueError:
+        gen, chips = geometry, 0.0
+    if not gen:
+        gen, chips = geometry, 0.0
+    resources = {"CPU": max(1.0, chips * _CPUS_PER_CHIP)}
+    if chips > 0:
+        resources["TPU"] = chips
+    labels = {"accelerator": gen, "topology": geometry, "sim": "1"}
+    return resources, labels
+
+
+@loop_confined
+class TimerWheel:
+    """One timer task multiplexing periodic callbacks for N daemons.
+
+    Each daemon gets a stable phase offset so beats spread uniformly
+    across the period instead of arriving as an N-wide thundering herd
+    every period (which would measure burst absorption, not steady-state
+    ingest). Rescheduling is anchored at ``due + period``, not
+    ``now + period``, so phases don't drift when a beat runs late.
+    Concurrent beats are bounded by a semaphore: a slow head makes beats
+    queue here (visibly, as wheel lag) rather than stacking unbounded
+    tasks in the loop.
+    """
+
+    def __init__(self, period_s: float, concurrency: int = 64):
+        self.period_s = period_s
+        self._sem = asyncio.Semaphore(concurrency)
+        self._heap: list[tuple[float, int, NodeDaemon]] = []
+        self._seq = 0
+        self._dead: set[int] = set()  # seq of entries to drop at pop
+        self._seq_of: dict[str, int] = {}  # node_id -> live seq
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+        self.fired = 0
+        self.max_lag_s = 0.0  # worst (now - due) observed at dispatch
+
+    def add(self, daemon: NodeDaemon, phase_s: float) -> None:
+        loop = asyncio.get_running_loop()
+        self._seq += 1
+        self._seq_of[daemon.node_id] = self._seq
+        heapq.heappush(self._heap, (loop.time() + phase_s, self._seq, daemon))
+
+    def remove(self, node_id: str) -> None:
+        seq = self._seq_of.pop(node_id, None)
+        if seq is not None:
+            self._dead.add(seq)
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._stopped:
+            if not self._heap:
+                await asyncio.sleep(self.period_s / 4 or 0.05)
+                continue
+            due, seq, daemon = self._heap[0]
+            now = loop.time()
+            if due > now:
+                await asyncio.sleep(min(due - now, self.period_s))
+                continue
+            heapq.heappop(self._heap)
+            if seq in self._dead:
+                self._dead.discard(seq)
+                continue
+            self.max_lag_s = max(self.max_lag_s, now - due)
+            loop.create_task(self._fire(daemon, seq))
+            heapq.heappush(self._heap, (due + self.period_s, seq, daemon))
+
+    async def _fire(self, daemon: NodeDaemon, seq: int) -> None:
+        async with self._sem:
+            if self._stopped or seq in self._dead:
+                return
+            self.fired += 1
+            try:
+                alive = await daemon._heartbeat_once()
+            except Exception:  # noqa: BLE001 - a bug must not kill the wheel
+                logger.exception("sim heartbeat failed for %s",
+                                 daemon.node_id[:12])
+                return
+            if not alive:
+                # Fenced or chaos-killed: the daemon stood down — stop
+                # beating for it (exactly what a dead real daemon does).
+                self.remove(daemon.node_id)
+
+
+@loop_confined
+class SimFleet:
+    """N sim daemons registered against one head, driven by one wheel.
+
+    Async API for use on an existing loop (the bench), plus sync
+    wrappers (``launch``/``shutdown``) over the process io-loop thread
+    for scripts and tests — the wrappers only construct and delegate
+    via ``EventLoopThread.run``, so all state mutation stays on the
+    io loop (hence ``@loop_confined``).
+    """
+
+    def __init__(self, head_host: str, head_port: int,
+                 n_nodes: int | None = None, geometry: str | None = None,
+                 heartbeat_period_s: float | None = None,
+                 register_concurrency: int = 32,
+                 node_prefix: str = "sim",
+                 extra_resources: dict[str, float] | None = None):
+        cfg = get_config()
+        self.head_addr = (head_host, head_port)
+        self.n_nodes = int(n_nodes if n_nodes is not None
+                           else cfg.sim_fleet_nodes)
+        self.geometry = geometry or cfg.sim_fleet_geometry
+        self.resources, self.labels = parse_geometry(self.geometry)
+        # Production inventories carry more than CPU/TPU (memory,
+        # object_store_memory, PG-bundle-derived keys); benches pass
+        # extras so full-vs-delta heartbeat costs are measured against a
+        # realistic map width, not a 2-key toy.
+        self.resources.update(extra_resources or {})
+        period = (heartbeat_period_s if heartbeat_period_s is not None
+                  else cfg.health_check_period_s / 2)
+        self.wheel = TimerWheel(max(period, 0.01))
+        self._register_concurrency = max(1, register_concurrency)
+        self._prefix = node_prefix
+        self.daemons: list[NodeDaemon] = []
+        self.register_failures = 0
+        self.register_wall_s = 0.0
+
+    # ------------------------------------------------------------ async
+    async def start(self) -> "SimFleet":
+        """Registration storm: boot all daemons with bounded concurrency
+        (each boot is a real TCP connect + register_node round trip; the
+        bound keeps the storm from exhausting ephemeral sockets faster
+        than the head can accept) then arm the heartbeat wheel with
+        phases spread across the period."""
+        loop = asyncio.get_running_loop()
+        sem = asyncio.Semaphore(self._register_concurrency)
+        run_id = uuid.uuid4().hex[:6]
+
+        async def boot(i: int) -> NodeDaemon | None:
+            node_id = f"{self._prefix}-{run_id}-{i:04d}"
+            d = NodeDaemon(self.head_addr[0], self.head_addr[1], node_id,
+                           dict(self.resources), dict(self.labels), sim=True)
+            async with sem:
+                try:
+                    await d.start()
+                except Exception:  # noqa: BLE001 - counted, bench gates on it
+                    self.register_failures += 1
+                    try:
+                        await d.rpc.stop()
+                    except Exception:
+                        pass
+                    return None
+            return d
+
+        t0 = loop.time()
+        results = await asyncio.gather(*[boot(i) for i in range(self.n_nodes)])
+        self.register_wall_s = loop.time() - t0
+        self.daemons = [d for d in results if d is not None]
+        for i, d in enumerate(self.daemons):
+            phase = (i / max(1, len(self.daemons))) * self.wheel.period_s
+            self.wheel.add(d, phase)
+        self.wheel.start()
+        return self
+
+    async def stop(self) -> None:
+        await self.wheel.stop()
+        for d in self.daemons:
+            try:
+                await d.stop()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            # NodeDaemon.stop leaves the head client open (real daemons
+            # die with their process); 1000 leaked sockets matter here.
+            if d._head is not None:
+                try:
+                    await d._head.close()
+                except Exception:
+                    pass
+        self.daemons = []
+
+    async def kill(self, count: int, stride: int = 7) -> list[str]:
+        """Chaos helper: hard-kill ``count`` daemons (same death as the
+        injector's ``daemon.tick`` kill — sockets drop, no dereg). The
+        stride spreads the kills across the fleet instead of taking a
+        contiguous block. Returns killed node ids."""
+        killed: list[str] = []
+        alive = [d for d in self.daemons if not d._fenced]
+        for j in range(min(count, len(alive))):
+            d = alive[(j * stride) % len(alive)]
+            if d.node_id in killed:
+                continue
+            self.wheel.remove(d.node_id)
+            try:
+                await d._chaos_die()
+            except Exception:  # noqa: BLE001
+                pass
+            killed.append(d.node_id)
+        return killed
+
+    def hb_stats(self) -> dict:
+        """Fleet-aggregate heartbeat wire stats (feeds the bench's
+        heartbeat-loss gate and the delta-vs-full byte accounting)."""
+        agg = {"sent": 0, "full": 0, "delta": 0, "empty": 0,
+               "skipped": 0, "failed": 0, "resync": 0}
+        for d in self.daemons:
+            for k in agg:
+                agg[k] += d._hb_stats.get(k, 0)
+        agg["nodes"] = len(self.daemons)
+        agg["loss_rate"] = (agg["failed"] / agg["sent"]) if agg["sent"] else 0.0
+        agg["wheel_fired"] = self.wheel.fired
+        agg["wheel_max_lag_s"] = round(self.wheel.max_lag_s, 6)
+        return agg
+
+    # ------------------------------------------------------------- sync
+    @classmethod
+    def launch(cls, head_host: str, head_port: int, **kw) -> "SimFleet":
+        """Sync wrapper: build + start on the process io-loop thread."""
+        fleet = cls(head_host, head_port, **kw)
+        EventLoopThread.get().run(fleet.start(), timeout=300)
+        return fleet
+
+    def shutdown(self) -> None:
+        EventLoopThread.get().run(self.stop(), timeout=120)

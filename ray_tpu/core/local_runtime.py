@@ -728,10 +728,20 @@ class LocalRuntime:
             return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
 
     # ------------------------------------------------------------------ misc
-    def state_snapshot(self) -> dict:
+    def node_summary(self) -> dict:
+        """Single-node aggregate matching the cluster runtime's shape."""
+        return {
+            "nodes_total": 1, "nodes_alive": 1,
+            "resources": self.resources.totals(),
+            "available": self.resources.available(),
+        }
+
+    def state_snapshot(self, parts: list | None = None) -> dict:
         """Cluster-state view for the state API (reference: the GCS-backed
         sources behind python/ray/util/state/api.py — GcsTaskManager for tasks,
-        actor/node/PG tables for the rest)."""
+        actor/node/PG tables for the rest). ``parts`` is accepted for
+        interface parity with the cluster runtime; the local tables are
+        small enough that the full dict is always built."""
         with self._lock:
             actors = {
                 aid.hex(): {

@@ -19,6 +19,25 @@ from ray_tpu.data.context import DataContext
 from ray_tpu.data.datasource import ReadTask
 from ray_tpu.data.plan import AllToAll, FusedMapStage, InputData, LimitOp, Read
 
+_exec_metrics_cache: dict | None = None
+
+
+def _exec_metrics() -> dict:
+    """Lazy federated counters for streaming-executor backpressure — created
+    once per process (re-instantiating a same-named Counter would re-register
+    and orphan the prior series)."""
+    global _exec_metrics_cache
+    if _exec_metrics_cache is None:
+        from ray_tpu.util.metrics import Counter
+
+        _exec_metrics_cache = {
+            "backpressure": Counter(
+                "data_stage_backpressure",
+                "streaming stage launches blocked by the output-buffer budget",
+                ("stage",)),
+        }
+    return _exec_metrics_cache
+
 
 def _run_block_fn(block_fn, block: Block):
     out = block_fn(block)
@@ -108,6 +127,16 @@ class _StageExec:
             self.byte_budget = min(self.byte_budget, max(share, 1 << 20))
         self.input_queue: collections.deque = collections.deque()
         self.upstream_done = False
+        # Backpressure accounting: one stall per transition into the
+        # budget-blocked state (input waiting but output buffers full), not
+        # one per scheduler tick — the federated counter then reads as
+        # "how often did this stage hit its budget", not loop frequency.
+        self.backpressure_stalls = 0
+        self._bp_blocked = False
+        try:
+            self._metrics = _exec_metrics()
+        except Exception:
+            self._metrics = None
         # meta_ref -> (block_ref, actor_index|None, seq)
         self.in_flight: dict = {}
         self.outputs: collections.deque = collections.deque()
@@ -185,13 +214,25 @@ class _StageExec:
         # would bypass the budgets entirely.
         n_buffered = len(self.outputs) + len(self._pending_out)
         if n_buffered >= self.ctx.max_output_blocks_buffered:
+            self._note_backpressure()
             return False
         buffered = sum(m.get("size_bytes", 0) for _, m in self.outputs)
         buffered += sum(m.get("size_bytes", 0)
                         for _, m in self._pending_out.values())
         if buffered >= self.byte_budget:
+            self._note_backpressure()
             return False  # byte budget (reference: ResourceManager)
+        self._bp_blocked = False
         return True
+
+    def _note_backpressure(self) -> None:
+        if self._bp_blocked:
+            return
+        self._bp_blocked = True
+        self.backpressure_stalls += 1
+        if self._metrics is not None:
+            self._metrics["backpressure"].inc(
+                tags={"stage": self.stage.label})
 
     def launch(self) -> None:
         self._autoscale_pool()

@@ -15,6 +15,29 @@ import numpy as np
 
 from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
 
+_split_metrics_cache: dict | None = None
+
+
+def _split_metrics() -> dict:
+    """Lazy federated counters for streaming_split backpressure — created
+    once per process (re-instantiating a same-named Counter would re-register
+    and orphan the prior series)."""
+    global _split_metrics_cache
+    if _split_metrics_cache is None:
+        from ray_tpu.util.metrics import Counter
+
+        _split_metrics_cache = {
+            "stall": Counter(
+                "data_split_stall",
+                "streaming_split producer stalls on a full per-split queue",
+                ("split",)),
+            "empty": Counter(
+                "data_split_empty_poll",
+                "streaming_split consumer polls that found an empty queue",
+                ("split",)),
+        }
+    return _split_metrics_cache
+
 
 def batches_from_refs(
     refs_iter: Iterator[tuple[Any, dict]],
@@ -60,9 +83,17 @@ def batches_from_refs(
 
 class SplitCoordinator:
     """Actor: runs the dataset's executor once, round-robins output blocks
-    into n bounded per-split queues. Consumers poll get_next(i)."""
+    into n bounded per-split queues. Consumers poll get_next(i).
 
-    MAX_QUEUED_PER_SPLIT = 8
+    The per-split queue bound (``data_split_prefetch_blocks``) is the
+    ingest-side backpressure: a slow consumer stalls the producer thread
+    (and through it the whole streaming executor's launch budget) instead
+    of buffering the dataset unboundedly. Stalls are counted in the
+    federated ``data_split_stall`` metric; consumer-side empty polls in
+    ``data_split_empty_poll`` — together they say whether an ingest phase
+    is producer-bound or consumer-bound."""
+
+    MAX_QUEUED_PER_SPLIT = 8  # fallback when config is unavailable
 
     def __init__(self, dataset, n: int, equal: bool):
         self._n = n
@@ -72,6 +103,18 @@ class SplitCoordinator:
         self._done = False
         self._error: str | None = None
         self._epoch_datasets = dataset
+        self.stalls = 0       # producer waits on a full split queue
+        self.empty_polls = 0  # consumer polls that found nothing queued
+        try:
+            from ray_tpu.utils.config import get_config
+
+            self._prefetch = max(1, int(get_config().data_split_prefetch_blocks))
+        except Exception:
+            self._prefetch = self.MAX_QUEUED_PER_SPLIT
+        try:
+            self._metrics = _split_metrics()
+        except Exception:
+            self._metrics = None
         self._thread = threading.Thread(
             target=self._run, args=(dataset,), daemon=True
         )
@@ -82,11 +125,18 @@ class SplitCoordinator:
             i = 0
             for ref, meta in dataset.iter_block_refs():
                 # backpressure: wait while the target queue is full
+                stalled = False
                 while True:
                     with self._lock:
-                        if len(self._queues[i % self._n]) < self.MAX_QUEUED_PER_SPLIT:
+                        if len(self._queues[i % self._n]) < self._prefetch:
                             self._queues[i % self._n].append((ref, meta))
                             break
+                    if not stalled:
+                        stalled = True
+                        self.stalls += 1
+                        if self._metrics is not None:
+                            self._metrics["stall"].inc(
+                                tags={"split": str(i % self._n)})
                     time.sleep(0.01)
                 i += 1
         except Exception as e:  # surfaced to all consumers
@@ -109,6 +159,9 @@ class SplitCoordinator:
                     ref, meta = self._queues[split].popleft()
                     return ("block", ref)
             return ("done", None)
+        self.empty_polls += 1
+        if self._metrics is not None:
+            self._metrics["empty"].inc(tags={"split": str(split)})
         return ("empty", None)
 
     def ping(self) -> bool:

@@ -34,9 +34,12 @@ def _fmt_table(rows: list[dict], columns: list[str]) -> str:
     return "\n".join(out)
 
 
+NODE_TABLE_CAP = 50  # past this, status prints the summary aggregate only
+
+
 def cmd_status(args) -> int:
     api = _connect(args.address)
-    from ray_tpu.util.state import head_status, list_nodes
+    from ray_tpu.util.state import head_status, list_nodes, node_summary
 
     try:
         hs = head_status()
@@ -49,6 +52,18 @@ def cmd_status(args) -> int:
         if isinstance(up, (int, float)):
             line += f", up {up:.0f}s"
         print(line)
+        lag = hs.get("loop_lag_s")
+        if isinstance(lag, (int, float)):
+            print(f"  head loop lag: {lag * 1000:.1f}ms "
+                  f"(max {hs.get('loop_lag_max_s', 0.0) * 1000:.1f}ms)")
+        rpc = hs.get("rpc") or {}
+        if rpc:
+            top = sorted(rpc.items(),
+                         key=lambda kv: -kv[1].get("rate_hz", 0.0))[:5]
+            print("  busiest RPCs: " + ", ".join(
+                f"{m} {row.get('rate_hz', 0.0):g}/s"
+                + (f" ({row['mean_ms']:g}ms)" if "mean_ms" in row else "")
+                for m, row in top))
         if hs.get("fenced_registrations") or hs.get("wal_tail_dropped"):
             print(f"  fenced registrations: "
                   f"{hs.get('fenced_registrations', 0)}, torn WAL tail "
@@ -60,6 +75,19 @@ def cmd_status(args) -> int:
     print("Cluster resources:")
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+    n_total = hs.get("nodes_total")
+    if isinstance(n_total, int) and n_total > NODE_TABLE_CAP:
+        # Fleet scale: the O(cluster) node table would drown the terminal
+        # (and the head would pay to serialize it) — aggregate instead.
+        try:
+            s = node_summary()
+            print(f"\nNodes: {s.get('nodes_alive', '?')} alive "
+                  f"/ {s.get('nodes_total', '?')} total "
+                  f"(table suppressed past {NODE_TABLE_CAP} nodes; "
+                  f"use `ray_tpu list nodes`)")
+            return 0
+        except Exception:  # noqa: BLE001 - fall through to the table
+            pass
     nodes = list_nodes()
     print(f"\nNodes ({len(nodes)}):")
     print(_fmt_table(nodes, ["node_id", "alive", "resources"]))

@@ -176,6 +176,47 @@ class Config:
     # the bound (pre-FT behavior: a dropped frame wedges the loop).
     daemon_heartbeat_timeout_s: float = 5.0
 
+    # --- fleet scale (thousand-node head fast path) ---
+    # Delta heartbeats (ray_syncer's design, extending the PR-9 sid-table
+    # telemetry scheme to the resource plane): after a full sync at
+    # registration, a daemon ships only CHANGED availability keys per
+    # heartbeat — or an empty beat when nothing moved — instead of its full
+    # available/resources/demands maps every period. The head replies
+    # ``resync`` (and daemons fall back to full maps) whenever it lacks a
+    # baseline; head restarts resync through the existing re-register
+    # path. 0 restores full-map heartbeats (the scale bench's "before").
+    delta_heartbeat_enabled: bool = True
+    # Indexed scheduling state: _pick_node walks a lazily-maintained
+    # max-heap over effective CPU (plus a label inverted index and O(1)
+    # affinity lookup) and _assign_bundles reads cached free-sums with
+    # lazy per-node copies, instead of linearly scanning + deep-copying
+    # the whole node table per placement/lease. 0 restores the linear
+    # scans (kept as the parity reference in tests/test_scale.py).
+    indexed_scheduler_enabled: bool = True
+    # Pubsub fan-out coalescing window: publishes buffered this long are
+    # batched into ONE pub_batch frame per subscriber connection, sent
+    # concurrently — instead of one awaited notify per subscriber per
+    # event. <= 0 restores immediate per-event, per-subscriber sends.
+    pubsub_batch_window_s: float = 0.005
+    # Head self-metrics cadence: the event-loop lag gauge
+    # (head_loop_lag_s) and the per-RPC-method rate/latency series riding
+    # the rpc.counts table are sampled this often into the watchdog store
+    # and surfaced by head_status / `ray_tpu status`. <= 0 disables.
+    head_metrics_period_s: float = 0.5
+    # Simulated fleet (core/cluster/sim_fleet.py): default node count the
+    # harness stands up when none is given, and the fake TPU inventory
+    # each simulated node registers ("<kind>-<chips>", e.g. "v5e-8" →
+    # resources {CPU, TPU: 8} + accelerator/topology labels).
+    sim_fleet_nodes: int = 100
+    sim_fleet_geometry: str = "v5e-8"
+    # Streaming-split ingest backpressure: per-consumer prefetch bound —
+    # blocks a SplitCoordinator may queue ahead of each consumer before
+    # its producer thread stalls. Stalls/drains are counted in the
+    # federated ``data_split_stall`` / ``data_split_empty_poll`` metrics
+    # so the scale bench's ingest phase measures throughput instead of
+    # unbounded buffering.
+    data_split_prefetch_blocks: int = 8
+
     # --- collectives / multi-slice training ---
     # Cross-slice (DCN) wire format for hierarchical allreduce in multi-slice
     # collective groups ("none" | "bf16" | "int8"). "none" keeps the input

@@ -1,0 +1,514 @@
+"""Fleet-scale control plane: sim fleet, delta sync, indexed scheduling.
+
+Four legs of the thousand-node harness (devbench/scale_bench.py sweeps
+the same machinery to its knees; these tests pin the correctness
+contracts at tier-1 size):
+
+- **sim-fleet lifecycle**: dozens of REAL :class:`NodeDaemon` instances
+  (``sim=True`` — no shm arena, no forked workers) register against a
+  real head over the real RPC stack, one TimerWheel drives their beats,
+  the summary/filtered ``list_nodes`` forms see them, and shutdown is
+  clean.
+- **delta-sync round trip**: full-on-register → delta → removed keys →
+  idle skip (no RPC at all) → forced liveness beat → resync when the
+  head loses its base — including a full head restart on the same port.
+- **indexed-vs-linear parity**: the heap/label-index ``_pick_node`` must
+  return exactly what the full-scan oracle returns over randomized
+  inventories, mutations, optimistic holds, affinity and label
+  constraints.
+- **chaos kill during a lease/actor storm**: daemons die mid-placement
+  (one via the injector's ``daemon.tick`` probe, the rest via the fleet
+  chaos helper); the head declares them dead, stays responsive, strands
+  no actor in a non-terminal state, and still schedules new work.
+"""
+
+import asyncio
+import os
+import random
+import time
+import uuid
+
+import pytest
+
+from ray_tpu.chaos import injector
+from ray_tpu.core.cluster.head import HeadServer, NodeInfo
+from ray_tpu.core.cluster.node_daemon import NodeDaemon
+from ray_tpu.core.cluster.protocol import AsyncRpcClient, EventLoopThread
+from ray_tpu.core.cluster.sim_fleet import SimFleet, TimerWheel, parse_geometry
+from ray_tpu.utils.config import Config, get_config, set_config
+
+pytestmark = pytest.mark.scale
+
+
+# ----------------------------------------------------------------- plumbing
+@pytest.fixture(autouse=True)
+def _chaos_reset():
+    injector.reset_for_tests()
+    yield
+    os.environ.pop("RTPU_CHAOS", None)
+    injector.reset_for_tests()
+
+
+@pytest.fixture
+def fast_beats():
+    """Shrink the health-check period so delta/liveness behavior (idle
+    gap = period * threshold / 3) is observable in test time."""
+    old = os.environ.get("RTPU_HEALTH_CHECK_PERIOD_S")
+    os.environ["RTPU_HEALTH_CHECK_PERIOD_S"] = "0.2"
+    set_config(Config.load())
+    yield get_config()
+    if old is None:
+        os.environ.pop("RTPU_HEALTH_CHECK_PERIOD_S", None)
+    else:
+        os.environ["RTPU_HEALTH_CHECK_PERIOD_S"] = old
+    set_config(Config.load())
+
+
+class FakeConn:
+    """Stand-in ServerConnection for direct head-handler calls."""
+
+    def __init__(self):
+        self.meta = {}
+        self.notifies = []
+
+    async def notify(self, method, **kw):
+        self.notifies.append((method, kw))
+
+
+def _io() -> EventLoopThread:
+    return EventLoopThread.get()
+
+
+def _poll(predicate, timeout: float = 15.0, interval: float = 0.02,
+          desc: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while True:
+        value = predicate()
+        if value:
+            return value
+        assert time.monotonic() < deadline, f"timed out waiting for {desc}"
+        time.sleep(interval)
+
+
+def _start_head(tmp_path, name="head.db", port=0):
+    head = HeadServer("127.0.0.1", port, persist_path=str(tmp_path / name))
+    _, bound = _io().run(head.start())
+    return head, bound
+
+
+def _stop_head(head):
+    _io().run(head.stop())
+
+
+def _head_view(head, node_id):
+    """(available copy, last_heartbeat) read ON the head's loop — head
+    state is single-threaded by design; tests must not race it."""
+    async def peek():
+        n = head.nodes[node_id]
+        return dict(n.available), n.last_heartbeat
+    return _io().run(peek())
+
+
+async def _close_daemon(d):
+    await d.stop()
+    if d._head is not None:
+        try:
+            await d._head.close()
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------- sim-fleet lifecycle
+def test_sim_fleet_lifecycle(tmp_path):
+    head, port = _start_head(tmp_path)
+    fleet = None
+    try:
+        fleet = SimFleet.launch("127.0.0.1", port, n_nodes=24,
+                                heartbeat_period_s=0.05)
+        assert fleet.register_failures == 0
+        assert len(fleet.daemons) == 24
+
+        per_node, labels = parse_geometry(fleet.geometry)
+        assert labels["sim"] == "1"
+        summ = _io().run(head._list_nodes(None, summary=True))["summary"]
+        assert summ["nodes_total"] == 24 and summ["nodes_alive"] == 24
+        assert summ["resources"]["TPU"] == per_node["TPU"] * 24
+        assert summ["resources"]["CPU"] == per_node["CPU"] * 24
+
+        # Filtered + capped listing keeps the per-node row shape.
+        rows = _io().run(head._list_nodes(None, labels={"sim": "1"},
+                                          alive_only=True, limit=5))
+        assert len(rows) == 5
+        assert all(r["labels"]["topology"] == fleet.geometry
+                   for r in rows.values())
+        assert not _io().run(head._list_nodes(None, labels={"sim": "0"}))
+
+        # The wheel actually beats every daemon, and nothing is lost:
+        # registration seeded the delta base, so idle beats ride the
+        # empty/skipped wire — never full, never failed.
+        _poll(lambda: fleet.wheel.fired >= 48, desc="two wheel revolutions")
+        st = fleet.hb_stats()
+        assert st["failed"] == 0 and st["resync"] == 0 and st["full"] == 0
+    finally:
+        if fleet is not None:
+            fleet.shutdown()
+        _stop_head(head)
+    assert fleet.daemons == []
+
+
+def test_timer_wheel_remove_and_dead_daemon_unschedules():
+    """Wheel bookkeeping: removed entries never fire again, and a daemon
+    whose beat reports death (fenced/killed) is dropped from rotation."""
+    async def scenario():
+        wheel = TimerWheel(0.02)
+
+        class Beater:
+            def __init__(self, node_id, alive=True):
+                self.node_id, self.alive, self.beats = node_id, alive, 0
+
+            async def _heartbeat_once(self):
+                self.beats += 1
+                return self.alive
+
+        live, doomed = Beater("live"), Beater("doomed", alive=False)
+        wheel.add(live, 0.0)
+        wheel.add(doomed, 0.0)
+        wheel.start()
+        try:
+            for _ in range(200):
+                if live.beats >= 5 and doomed.beats:
+                    break
+                await asyncio.sleep(0.01)
+            assert live.beats >= 5
+            assert doomed.beats == 1, "dead daemon must leave the rotation"
+            wheel.remove("live")
+            frozen = live.beats
+            await asyncio.sleep(0.1)
+            assert live.beats <= frozen + 1, "removed entry kept firing"
+        finally:
+            await wheel.stop()
+
+    _io().run(scenario())
+
+
+# ------------------------------------------------------ delta-sync round trip
+def test_delta_sync_round_trip(tmp_path, fast_beats):
+    head, port = _start_head(tmp_path)
+    d = NodeDaemon("127.0.0.1", port, "deltanode",
+                   {"CPU": 8.0, "TPU": 4.0, "memory": 1024.0}, sim=True)
+    io = _io()
+    try:
+        io.run(d.start())
+        # Registration ships the live inventory: it IS the full sync.
+        assert d._hb_synced and not d._hb_force_full
+        avail, _ = _head_view(head, "deltanode")
+        assert avail == {"CPU": 8.0, "TPU": 4.0, "memory": 1024.0}
+
+        # 1) Changed + removed keys ride one delta beat.
+        async def mutate_and_beat():
+            d.available["CPU"] -= 3.0
+            d.available.pop("memory")
+            return await d._heartbeat_once()
+        assert io.run(mutate_and_beat())
+        assert d._hb_stats["delta"] == 1 and d._hb_stats["full"] == 0
+        avail, _ = _head_view(head, "deltanode")
+        assert avail == {"CPU": 5.0, "TPU": 4.0}
+
+        # 2) An unchanged view inside the idle gap sends NOTHING (the
+        # ray_syncer contract: no change, no message).
+        sent_before = d._hb_stats["sent"] if "sent" in d._hb_stats else None
+        assert io.run(d._heartbeat_once())
+        assert d._hb_stats["skipped"] == 1
+        if sent_before is not None:
+            assert d._hb_stats["sent"] == sent_before
+
+        # 3) ...but liveness still flows: past the gap the beat goes out
+        # as an empty delta and stamps last_heartbeat on the head.
+        _, hb_before = _head_view(head, "deltanode")
+        d._hb_last_sent = 0.0
+        assert io.run(d._heartbeat_once())
+        assert d._hb_stats["empty"] == 1
+        _, hb_after = _head_view(head, "deltanode")
+        assert hb_after > hb_before
+
+        # 4) Head loses the base (restart-mid-stream surrogate): the next
+        # delta gets resync — the head must NOT apply it against a view
+        # it never fully received.
+        async def drop_base():
+            head._node_conns["deltanode"].meta["hb_synced"] = False
+        io.run(drop_base())
+
+        async def mutate_and_beat2():
+            d.available["CPU"] = 1.0
+            return await d._heartbeat_once()
+        assert io.run(mutate_and_beat2())
+        assert d._hb_stats["resync"] == 1 and d._hb_force_full
+        avail, _ = _head_view(head, "deltanode")
+        assert avail["CPU"] == 5.0, "head must keep the stale-but-consistent view"
+
+        # 5) The forced full beat converges the views and re-arms deltas.
+        assert io.run(d._heartbeat_once())
+        assert d._hb_stats["full"] == 1 and d._hb_synced
+        assert not d._hb_force_full
+        avail, _ = _head_view(head, "deltanode")
+        assert avail == {"CPU": 1.0, "TPU": 4.0}
+    finally:
+        io.run(_close_daemon(d))
+        _stop_head(head)
+
+
+def test_head_restart_resync(tmp_path, fast_beats):
+    """Kill the head, boot a replacement on the same port: the daemon's
+    beats ride out the outage (failed → reconnect → full re-register)
+    and the NEW head converges on daemon truth, not registration-time
+    fiction."""
+    head, port = _start_head(tmp_path, name="h1.db")
+    d = NodeDaemon("127.0.0.1", port, "restartnode",
+                   {"CPU": 8.0, "TPU": 4.0}, sim=True)
+    io = _io()
+    head2 = None
+    try:
+        io.run(d.start())
+        # Resources moved while the head was up; then the head dies.
+        async def consume():
+            d.available["CPU"] = 2.5
+            return await d._heartbeat_once()
+        assert io.run(consume())
+        _stop_head(head)
+
+        head2 = HeadServer("127.0.0.1", port,
+                           persist_path=str(tmp_path / "h2.db"))
+        _io().run(head2.start())
+
+        # Drive beats until the daemon has re-registered with the new
+        # head. The first beat(s) fail on the dead conn (counted, full
+        # forced), _reconnect_head runs the real registration path.
+        def beaten():
+            ok = io.run(d._heartbeat_once())
+            assert ok, "daemon must survive a head outage"
+            return ("restartnode" in io.run(_alive_ids(head2))
+                    and d._hb_synced)
+
+        async def _alive_ids(h):
+            return [nid for nid, n in h.nodes.items() if n.alive]
+        _poll(beaten, timeout=20.0, interval=0.05, desc="re-registration")
+
+        assert d._hb_stats["failed"] >= 1
+        avail, _ = _head_view(head2, "restartnode")
+        assert avail["CPU"] == 2.5, "replacement head must see daemon truth"
+
+        # And the delta stream is re-armed against the new head.
+        async def mutate_and_beat():
+            d.available["CPU"] = 7.0
+            d._hb_last_sent = 0.0
+            return await d._heartbeat_once()
+        assert io.run(mutate_and_beat())
+        avail, _ = _head_view(head2, "restartnode")
+        assert avail["CPU"] == 7.0
+    finally:
+        io.run(_close_daemon(d))
+        if head2 is not None:
+            _stop_head(head2)
+
+
+# -------------------------------------------------- indexed-vs-linear parity
+def _seed_random_nodes(head, rng, n):
+    gens = ["v5e", "v6e", "cpuonly"]
+    node_ids = []
+
+    async def seed():
+        for i in range(n):
+            res = {"CPU": float(rng.randint(1, 64))}
+            if rng.random() < 0.7:
+                res["TPU"] = float(rng.choice([4, 8]))
+            labels = {"accelerator": rng.choice(gens)}
+            if rng.random() < 0.3:
+                labels["pool"] = rng.choice(["a", "b"])
+            nid = f"n{i:03d}"
+            r = await head._register_node(FakeConn(), nid, "127.0.0.1",
+                                          7000 + i, res, labels=labels,
+                                          epoch=float(i + 1))
+            assert r["ok"]
+            node_ids.append(nid)
+    asyncio.run(seed())
+    return node_ids
+
+
+def test_indexed_linear_parity_randomized(tmp_path):
+    """The indexed picker (heap + label inverted index + affinity dict
+    hit) must agree with the full-scan oracle on EVERY randomized
+    inventory/demand pair, across availability mutations, optimistic
+    holds, label churn, and node deaths — all applied through the
+    _sched_touch contract."""
+    assert get_config().indexed_scheduler_enabled
+    rng = random.Random(0xF1EE7)
+    head = HeadServer("127.0.0.1", 0, persist_path=str(tmp_path / "p.db"))
+    node_ids = _seed_random_nodes(head, rng, 40)
+
+    gens = ["v5e", "v6e", "cpuonly", "ghost"]
+    for trial in range(400):
+        # Mutate a handful of nodes the way heartbeats/placement would.
+        for nid in rng.sample(node_ids, 6):
+            n = head.nodes[nid]
+            n.available["CPU"] = float(rng.randint(0, int(n.resources["CPU"])))
+            if "TPU" in n.resources and rng.random() < 0.3:
+                n.available["TPU"] = float(
+                    rng.randint(0, int(n.resources["TPU"])))
+            if rng.random() < 0.15:
+                n.optimistic["CPU"] = float(rng.randint(0, 4))
+            elif n.optimistic:
+                n.optimistic.clear()
+            if rng.random() < 0.08:
+                n.alive = not n.alive
+            head._sched_touch(n)
+
+        res = {"CPU": float(rng.randint(0, 16))}
+        if rng.random() < 0.4:
+            res["TPU"] = float(rng.choice([4.0, 8.0]))
+        affinity = rng.choice(node_ids) if rng.random() < 0.15 else None
+        labels = None
+        if rng.random() < 0.35:
+            labels = {"accelerator": rng.choice(gens)}
+            if rng.random() < 0.25:
+                labels["pool"] = rng.choice(["a", "b", "c"])
+
+        fast = head._pick_node(res, affinity, labels)
+        slow = head._pick_node_linear(res, affinity, labels)
+        assert (fast.node_id if fast else None) == \
+            (slow.node_id if slow else None), (
+                f"trial {trial}: indexed={fast and fast.node_id} "
+                f"linear={slow and slow.node_id} for res={res} "
+                f"affinity={affinity} labels={labels}")
+
+
+def test_assign_bundles_valid_and_strategy_correct(tmp_path):
+    """_assign_bundles over the index caches: assignments must fit real
+    availability, honor strategy semantics, and be deterministic."""
+    rng = random.Random(31337)
+    head = HeadServer("127.0.0.1", 0, persist_path=str(tmp_path / "b.db"))
+    node_ids = _seed_random_nodes(head, rng, 12)
+    for nid in node_ids:  # drain some nodes so feasibility is non-trivial
+        n = head.nodes[nid]
+        n.available["CPU"] = float(rng.randint(0, int(n.resources["CPU"])))
+        head._sched_touch(n)
+
+    bundles = [{"CPU": 2.0} for _ in range(5)] + [{"CPU": 1.0, "TPU": 4.0}]
+    for strategy in ("PACK", "SPREAD", "STRICT_SPREAD", "STRICT_PACK"):
+        asg = head._assign_bundles(list(bundles), strategy)
+        assert asg == head._assign_bundles(list(bundles), strategy)
+        if asg is None:
+            continue
+        assert len(asg) == len(bundles)
+        # Every node's total take fits its availability.
+        take: dict[str, dict[str, float]] = {}
+        for nid, b in zip(asg, bundles):
+            t = take.setdefault(nid, {})
+            for k, v in b.items():
+                t[k] = t.get(k, 0.0) + v
+        for nid, t in take.items():
+            n = head.nodes[nid]
+            assert n.alive
+            for k, v in t.items():
+                assert n.available.get(k, 0.0) >= v, \
+                    f"{strategy}: {nid} over-packed on {k}"
+        if strategy == "STRICT_SPREAD":
+            assert len(set(asg)) == len(bundles)
+        if strategy == "STRICT_PACK":
+            assert len(set(asg)) == 1
+    # Infeasible demand answers None, not a bogus assignment.
+    assert head._assign_bundles([{"CPU": 1e9}], "PACK") is None
+
+
+# --------------------------------------------- chaos kill during lease storm
+def test_chaos_kill_during_actor_storm(tmp_path, fast_beats):
+    """Daemons die mid actor-placement storm — one through the injector's
+    daemon.tick probe (the production chaos path), three via the fleet
+    helper. The head must declare all four dead, leave no actor stuck in
+    a non-terminal state, answer control RPCs throughout, and still
+    place NEW work on the survivors."""
+    head, port = _start_head(tmp_path)
+    fleet = None
+    io = _io()
+    client = None
+    try:
+        fleet = SimFleet.launch("127.0.0.1", port, n_nodes=16,
+                                heartbeat_period_s=0.05)
+        # Victim index 1: fleet.kill(3, stride=5) below takes indices
+        # 0/5/10, so the injector victim stays distinct — four deaths.
+        victim = fleet.daemons[1].node_id
+        injector.install([{"point": "daemon.tick", "action": "kill",
+                           "match": {"node": f"^{victim}$"}, "count": 1}])
+
+        async def connect():
+            cl = AsyncRpcClient("127.0.0.1", port)
+            await cl.connect()
+            return cl
+        client = io.run(connect())
+
+        n_actors = 36
+        ids = [uuid.uuid4().hex for _ in range(n_actors)]
+
+        async def storm():
+            for i, aid in enumerate(ids):
+                r = await client.call(
+                    "register_actor", actor_id=aid, spec_blob=b"",
+                    resources={"CPU": 1.0}, name=None, namespace="default",
+                    max_restarts=2, req_id=f"scale-storm-{i}")
+                assert r["ok"]
+        io.run(storm())
+
+        # Kill three more daemons while placements are in flight.
+        killed = io.run(fleet.kill(3, stride=5))
+        assert victim not in killed
+
+        # Head declares all four dead (conn-drop fast path + the
+        # injector victim once its next wheel tick fires the probe).
+        async def alive_count():
+            return sum(1 for n in head.nodes.values() if n.alive)
+        _poll(lambda: io.run(alive_count()) == 12, timeout=20.0,
+              desc="4 chaos-killed nodes declared dead")
+
+        # Head keeps answering control RPCs while bodies are still warm.
+        status = io.run(client.call("head_status"))
+        assert status
+
+        # No actor may wedge: every one of the 36 ends ALIVE or DEAD
+        # (restarts off dead nodes included), none PENDING/RESTARTING.
+        def states():
+            snap = io.run(client.call("state_snapshot", parts=["actors"]))
+            rows = [a for a in snap["actors"].values()]
+            got = [a["state"] for a in rows]
+            return got if (len(got) == n_actors
+                           and all(s in ("ALIVE", "DEAD") for s in got)) \
+                else None
+        got = _poll(states, timeout=30.0, interval=0.1,
+                    desc="all actors terminal after chaos")
+        assert got.count("ALIVE") >= n_actors - 4, (
+            "survivor capacity dwarfs the storm; restarts must have "
+            f"rescheduled the orphans, got {got.count('ALIVE')} ALIVE")
+
+        # Survivors keep beating at zero loss after the drill...
+        stats = fleet.hb_stats()
+        assert stats["sent"] == 0 or stats["loss_rate"] < 0.01
+
+        # ...and the head still schedules NEW work (no wedge): a PG
+        # created after the kills must reach CREATED.
+        async def pg_round():
+            r = await client.call("create_placement_group", pg_id="chaospg",
+                                  bundles=[{"CPU": 1.0}] * 4,
+                                  strategy="SPREAD", req_id="scale-chaos-pg")
+            assert r["ok"]
+            for _ in range(200):
+                st = await client.call("placement_group_state",
+                                       pg_id="chaospg")
+                if st.get("state") == "CREATED":
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+        assert io.run(pg_round()), "post-chaos PG never reached CREATED"
+    finally:
+        if client is not None:
+            io.run(client.close())
+        if fleet is not None:
+            fleet.shutdown()
+        _stop_head(head)
