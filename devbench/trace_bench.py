@@ -1,0 +1,453 @@
+"""Request-tracing cost + tail-capture bench → PERF_TRACE.json.
+
+Three claims, each measured on the in-process runtime:
+
+- **overhead** — closed-loop handle round trips (``handle.remote() →
+  result()``) through one shared router in three arms: tracing compiled
+  off (``disable_tracing``: every helper is a no-op and the hot paths
+  keep their nullcontext fast path), tracing on at the default head
+  sampling rate (Config.trace_sample_rate), and tracing on sampling
+  everything. The gated arms serve a representative handler (~1ms of
+  calibrated CPU work — the denominator a production request actually
+  has; a no-op echo handler measures the router, not the tracing tax a
+  user pays) and are compared on CPU-per-request, the stable metric on
+  a saturated shared box. The no-op echo is still measured and reported
+  as the absolute fixed cost per request in µs — the worst-case
+  microbench number. Gates: the default-sampling arm within 10% of the
+  off arm (CPU-per-request, representative handler); the echo off arm
+  within noise of the PERF_ROUTER e2e baseline (the added code compiled
+  off must cost ≈ nothing). The pure routing-decision loop is also
+  measured for a direct PERF_ROUTER decide comparison — tracing never
+  touches it.
+- **tail capture** — head sampling set to 0 (pure tail sampling), the
+  deployment's latency window primed with fast traffic, then
+  chaos-delayed stragglers injected: every straggler's trace must be
+  retroactively kept (promoted from the tail ring) — 100% capture.
+- **waterfall** — one slow request traced across the three planes that
+  serve it (caller handle/router, replica pool thread, LLM engine
+  scheduler loop — separate processes in cluster mode, separate
+  execution contexts here; the context rides metadata either way),
+  reconstructed through the same assembly the ``ray_tpu trace`` CLI
+  uses: the TTFT phase breakdown (queue → prefill → decode) under the
+  request root, rendered and embedded in the report.
+
+Run: python devbench/trace_bench.py [--quick]   → PERF_TRACE.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+from _test_util import load_factor as _load_factor  # noqa: E402 - one
+# load-factor policy for every timing gate in the repo
+
+NUM_REPLICAS = 4
+STRAGGLERS = 5
+WORK_TARGET_S = 0.001  # representative handler: ~1ms of real CPU work
+
+
+def _spin(iters: int) -> float:
+    x = 1.0001
+    for _ in range(iters):
+        x = x * 1.0000001 + 1e-9
+    return x
+
+
+def _calibrate_work(target_s: float = WORK_TARGET_S) -> int:
+    """Iterations of _spin that burn ~target_s of CPU on this box."""
+    iters = 4000
+    while True:
+        t0 = time.process_time()
+        _spin(iters)
+        dt = time.process_time() - t0
+        if dt >= target_s * 0.5 or iters >= 512_000:
+            return max(1000, int(iters * target_s / max(dt, 1e-9)))
+        iters *= 2
+
+
+def _deploy(sample_rate, name="TraceBenchEcho", sleep_key=None,
+            work_iters=0):
+    from ray_tpu import serve
+
+    @serve.deployment(name=name, num_replicas=NUM_REPLICAS,
+                      max_ongoing_requests=1_000_000,
+                      max_queued_requests=-1,
+                      trace_sample_rate=sample_rate)
+    class Echo:
+        def __call__(self, x):
+            if work_iters:
+                _spin(work_iters)
+            if sleep_key is not None and isinstance(x, str) \
+                    and x.startswith(sleep_key):
+                time.sleep(0.25)  # chaos-delayed straggler
+            return x
+
+    # One app per deployment: redeploying an app name tears down the
+    # deployments the previous call created, and the overhead arms must
+    # coexist in one runtime.
+    return serve.run(Echo.bind(), name=f"trace-bench-{name}",
+                     route_prefix=None)
+
+
+def _measure_e2e(handle, clients: int, seconds: float) -> tuple:
+    """Closed-loop drive → (wall rps, CPU µs per request).
+
+    process_time() counts every thread — caller, router, replica pool —
+    so CPU-per-request is the full-path cost and does not swing with
+    scheduler luck the way wall-clock rps does on a saturated box.
+    """
+    stop = time.monotonic() + seconds
+    counts = [0] * clients
+
+    def client(k):
+        while time.monotonic() < stop:
+            handle.remote(k).result(timeout=30)
+            counts[k] += 1
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(clients)]
+    c0 = time.process_time()
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    cpu = time.process_time() - c0
+    n = sum(counts)
+    return (n / wall if wall else 0.0,
+            (cpu / n) * 1e6 if n else 0.0)
+
+
+def _measure_decide(router, reps, seconds: float) -> float:
+    t0 = time.perf_counter()
+    n = 0
+    deadline = t0 + seconds
+    while time.perf_counter() < deadline:
+        for _ in range(100):
+            with router._lock:
+                chosen = router._choose_locked(reps)
+                rid = chosen.replica_id
+                router._inflight[rid] = router._inflight.get(rid, 0) + 1
+            router._release(rid)
+        n += 100
+    return n / (time.perf_counter() - t0)
+
+
+def _interleave(handles, arms, slices, slice_dur) -> dict:
+    """Many short rotated slices, median per arm: box load drifts over
+    the run and GC/flush bursts land at random, so one long round per
+    arm measures whichever arm drew the quiet slot. Rotating the order
+    every slice gives each arm every position, gc.collect() before a
+    slice stops one arm paying the previous arm's allocation debt, and
+    the median shrugs off the spiky slices."""
+    import gc
+
+    from ray_tpu.util import tracing
+
+    samples: dict[str, list[tuple]] = {a: [] for a, _, _ in arms}
+    for r in range(slices):
+        rotated = arms[r % len(arms):] + arms[:r % len(arms)]
+        for arm, _, enabled in rotated:
+            (tracing.enable_tracing if enabled
+             else tracing.disable_tracing)()
+            gc.collect()
+            samples[arm].append(_measure_e2e(handles[arm], 4, slice_dur))
+            tracing.clear()  # bound buffers between slices
+    out = {}
+    for arm, _, _ in arms:
+        rps = sorted(v[0] for v in samples[arm])
+        cpu = sorted(v[1] for v in samples[arm])
+        out[arm] = {"e2e_rps": round(rps[len(rps) // 2], 1),
+                    "cpu_us_per_req": round(cpu[len(cpu) // 2], 1)}
+    return out
+
+
+def _overhead_arms(dur: float, rounds: int = 3) -> dict:
+    """One warmed runtime, the three arms interleaved round-robin: on a
+    small shared box, two separately-built runtimes differ by more than
+    the tracing overhead being measured — only an interleaved comparison
+    isolates the tracing cost."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.util import tracing
+
+    arms = (("off", None, False),
+            ("default", None, True),   # Config.trace_sample_rate
+            ("full", 1.0, True))
+    echo_arms = (("off", None, False), ("default", None, True))
+    out: dict = {}
+    ray_tpu.shutdown()
+    ray_tpu.init()
+    try:
+        tracing.clear()
+        work_iters = _calibrate_work()
+        handles, echo_handles = {}, {}
+        for arm, sample_rate, _ in arms:
+            tracing.disable_tracing()
+            handles[arm] = _deploy(sample_rate,
+                                   name=f"TraceBenchWork_{arm}",
+                                   work_iters=work_iters)
+            for i in range(30):  # prime caches, reaper, replica pools
+                handles[arm].remote(i).result(timeout=30)
+        for arm, sample_rate, _ in echo_arms:
+            tracing.disable_tracing()
+            echo_handles[arm] = _deploy(sample_rate,
+                                        name=f"TraceBenchEcho_{arm}")
+            for i in range(100):
+                echo_handles[arm].remote(i).result(timeout=30)
+        slices = rounds * 4
+        slice_dur = dur * rounds / slices
+        arms_out = _interleave(handles, arms, slices, slice_dur)
+        out.update(arms_out)
+        # Echo microbench: a no-op handler isolates the absolute fixed
+        # tracing cost per request — reported in µs, not gated as a
+        # percentage (the denominator is synthetic).
+        echo_out = _interleave(echo_handles, echo_arms, slices, slice_dur)
+        out["echo_fixed_cost"] = {
+            "off_e2e_rps": echo_out["off"]["e2e_rps"],
+            "off_cpu_us_per_req": echo_out["off"]["cpu_us_per_req"],
+            "default_cpu_us_per_req":
+                echo_out["default"]["cpu_us_per_req"],
+            "tracing_cost_us_per_req": round(
+                echo_out["default"]["cpu_us_per_req"]
+                - echo_out["off"]["cpu_us_per_req"], 1),
+        }
+        out["work_iters"] = work_iters
+        tracing.disable_tracing()
+        router = echo_handles["off"]._ensure_router()
+        out["decide_rps"] = round(
+            _measure_decide(router, router._get_replicas(), dur), 1)
+        serve.shutdown()
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        tracing.disable_tracing()
+        tracing.clear()
+        ray_tpu.shutdown()
+    return out
+
+
+def _tail_capture(dur_prime: int) -> dict:
+    """Pure tail sampling + injected stragglers: 100% of the delayed
+    requests must be retroactively kept."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.util import tracing
+
+    ray_tpu.shutdown()
+    ray_tpu.init()
+    try:
+        tracing.clear()
+        tracing.enable_tracing()
+        handle = _deploy(0.0, name="TraceBenchTail", sleep_key="slow")
+        # Prime the deployment's rolling-p99 latency window past its
+        # min-sample floor with fast traffic.
+        for i in range(dur_prime):
+            handle.remote(i).result(timeout=30)
+        straggler_tids = []
+        for i in range(STRAGGLERS):
+            resp = handle.remote(f"slow{i}")
+            straggler_tids.append(resp._span.trace_id)
+            resp.result(timeout=30)
+        kept = {s.trace_id for s in tracing.spans()}
+        captured = sum(1 for t in straggler_tids if t in kept)
+        keep_reasons = sorted({
+            ev.get("reason") for s in tracing.spans()
+            if s.trace_id in straggler_tids
+            for ev in s.events if ev.get("name") == "tail_keep"})
+        serve.shutdown()
+        return {"stragglers": STRAGGLERS, "captured": captured,
+                "capture_rate": captured / STRAGGLERS,
+                "keep_reasons": keep_reasons,
+                "tail_stats": tracing.tail_stats()}
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        tracing.disable_tracing()
+        tracing.clear()
+        ray_tpu.shutdown()
+
+
+def _waterfall() -> dict:
+    """One slow traced request across the serve planes, its LLM TTFT
+    phase breakdown stamped by the engine scheduler loop, reconstructed
+    the way ``ray_tpu trace <id>`` does it."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig, LLMEngine, SamplingParams
+    from ray_tpu.util import tracing
+
+    ray_tpu.shutdown()
+    ray_tpu.init()
+    try:
+        tracing.clear()
+        tracing.enable_tracing()
+
+        @serve.deployment(name="TraceBenchLLM", trace_sample_rate=1.0)
+        class Gen:
+            def __init__(self):
+                self.eng = LLMEngine(LLMConfig(model="tiny",
+                                               max_num_seqs=2,
+                                               max_seq_len=64))
+
+            def __call__(self, prompt):
+                time.sleep(0.05)  # the "slow request" under diagnosis
+                out = self.eng.generate(list(range(8)),
+                                        SamplingParams(max_tokens=4))
+                return len(out.token_ids)
+
+        handle = serve.run(Gen.bind(), name="trace-wf", route_prefix=None)
+        resp = handle.remote("hello")
+        tid = resp._span.trace_id
+        ntok = resp.result(timeout=60)
+        assert ntok == 4, ntok
+        spans = sorted((s for s in tracing.spans() if s.trace_id == tid),
+                       key=lambda s: s.start_ts)
+        names = [s.name for s in spans]
+        t0 = min(s.start_ts for s in spans)
+        lines = [f"{s.name:<28} {(s.start_ts - t0) * 1e3:8.1f}ms "
+                 f"+{max(0.0, s.end_ts - s.start_ts) * 1e3:.1f}ms"
+                 for s in spans]
+        serve.shutdown()
+        phases = {"root": any(n.startswith("serve.request.") for n in names),
+                  "router_attempt": any(n.startswith("serve.attempt.")
+                                        for n in names),
+                  "replica": any("handle_request" in n for n in names),
+                  "engine_queue": "engine.queue" in names,
+                  "engine_prefill": "engine.prefill" in names,
+                  "engine_decode": "engine.decode" in names}
+        return {"trace_id": tid, "num_spans": len(spans),
+                "phases": phases,
+                "reconstructed": all(phases.values()),
+                "waterfall": lines}
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        tracing.disable_tracing()
+        tracing.clear()
+        ray_tpu.shutdown()
+
+
+def run_bench(quick: bool = False, out_path: str | None = None) -> dict:
+    dur = 1.0 if quick else 3.0
+    arms = _overhead_arms(dur)
+    tail = _tail_capture(dur_prime=80 if quick else 150)
+    wf = _waterfall()
+
+    lf = _load_factor()
+    off, dflt, full = (arms[a]["cpu_us_per_req"]
+                       for a in ("off", "default", "full"))
+    # Noise floors widen with the box's load factor, like every timing
+    # gate in this repo; the 10% overhead budget itself does not.
+    # Overhead = extra CPU per request on the representative handler.
+    overhead_default = (dflt - off) / off if off else 0.0
+    overhead_full = (full - off) / off if off else 0.0
+    echo = arms.get("echo_fixed_cost", {})
+
+    baseline = {}
+    base_path = os.path.join(_REPO, "PERF_ROUTER.json")
+    if os.path.exists(base_path):
+        try:
+            with open(base_path) as f:
+                base = json.load(f)
+            rates = (base.get("quick_refresh") or base).get("rates", {})
+            baseline = {"e2e_rps": rates.get("e2e_rps"),
+                        "decide_rps": rates.get("decide_rps")}
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _within_noise(ours, theirs):
+        if not theirs:
+            return None  # no baseline on disk: nothing to compare
+        # Half the baseline, load-factor-relaxed: catches a hot path
+        # pricing itself out, tolerates different-day box noise.
+        return ours >= theirs / (2.0 * lf)
+
+    report = {
+        "bench": "request_tracing",
+        "quick": quick,
+        "config": {"num_replicas": NUM_REPLICAS, "duration_s": dur,
+                   "e2e_clients": 4, "stragglers": STRAGGLERS},
+        "arms": arms,
+        "overhead": {
+            "default_sampling_pct": round(100 * overhead_default, 2),
+            "full_sampling_pct": round(100 * overhead_full, 2),
+            "echo_fixed_cost_us_per_req":
+                echo.get("tracing_cost_us_per_req"),
+        },
+        "tail_capture": tail,
+        "waterfall": wf,
+        "baseline_perf_router": baseline,
+        "acceptance": {
+            "default_sampling_within_10pct": overhead_default <= 0.10,
+            "off_arm_within_noise_of_perf_router":
+                _within_noise(echo.get("off_e2e_rps", 0.0),
+                              baseline.get("e2e_rps")),
+            "decide_within_noise_of_perf_router":
+                _within_noise(arms.get("decide_rps", 0.0),
+                              baseline.get("decide_rps")),
+            "tail_capture_100pct": tail["capture_rate"] == 1.0,
+            "ttft_waterfall_reconstructed": wf["reconstructed"],
+            "load_factor": round(lf, 2),
+        },
+        "provenance": {
+            "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "cpus": os.cpu_count(),
+            "loadavg": list(os.getloadavg()),
+            "box_note": (
+                "in-process runtime on a small CPU box. Overhead arms = "
+                "closed-loop handle.remote().result() through one shared "
+                "router, 4 clients, 4 replicas each doing ~1ms of "
+                "calibrated CPU work (the denominator a production "
+                "request actually has), compared on CPU-per-request "
+                "(process_time over all threads / requests — stable on "
+                "a saturated box where wall-clock rps swings with "
+                "scheduler luck). The off arm has tracing disabled (the "
+                "compiled-off fast path); default samples at "
+                "Config.trace_sample_rate with the tail ring live; full "
+                "records every request. echo_fixed_cost isolates the "
+                "absolute per-request tracing cost in µs against a no-op "
+                "handler — a worst-case microbench, reported, not gated "
+                "as a percentage. Tail capture: head sampling 0, "
+                "rolling-p99 window primed with fast traffic, then 0.25s "
+                "chaos-delayed stragglers — every one must be "
+                "retroactively kept. Waterfall: serve handle → router → "
+                "replica → tiny LLM engine, TTFT phases stamped by the "
+                "engine scheduler thread onto the request trace."),
+        },
+    }
+    out_path = out_path or os.path.join(_REPO, "PERF_TRACE.json")
+    doc = report
+    if quick and os.path.exists(out_path):
+        # Namespaced quick refresh: never overwrite full-run provenance.
+        try:
+            with open(out_path) as f:
+                existing = json.load(f)
+            if not existing.get("quick"):
+                existing["quick_refresh"] = report
+                doc = existing
+        except Exception:  # noqa: BLE001
+            pass
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return report
+
+
+if __name__ == "__main__":
+    rep = run_bench(quick="--quick" in sys.argv[1:])
+    print(json.dumps(rep, indent=2))

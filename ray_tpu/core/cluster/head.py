@@ -29,6 +29,7 @@ from ray_tpu.chaos import injector as _chaos
 from ray_tpu.devtools.annotations import loop_confined
 from ray_tpu.core.cluster.protocol import RpcServer, ServerConnection, spawn_task
 from ray_tpu.core.fn_registry import FN_NS
+from ray_tpu.util import tracing
 from ray_tpu.utils.config import get_config
 
 # WAL record header: payload length + CRC32 of the payload. The CRC is what
@@ -180,6 +181,18 @@ class HeadServer:
         # a federated export with a node_id label per series.
         self.telemetry: dict[str, dict] = {}  # source -> {node_id, ts, snapshot}
         self.spans: deque = deque(maxlen=50_000)
+        # Tail-sampling keep gossip: trace ids any process promoted from its
+        # tail ring (ended slow / shed / errored / breaker-implicated),
+        # versioned so each reporter pulls only the ids minted since its
+        # cursor. Bounded — an id that falls off the deque was gossiped for
+        # its whole useful life (tail rings expire in ~trace_tail_ttl_s).
+        self._keeps: deque = deque(maxlen=4096)  # (seq, trace_id)
+        self._keep_seq = 0
+        self._keep_ids: set[str] = set()  # dedup across reporters
+        # Recent exemplar trace ids per (metric, deployment) tag, harvested
+        # from reporter snapshots — the watchdog attaches these to serve
+        # incidents so a tripped SLO rule links straight to kept traces.
+        self._exemplars: dict[tuple, list] = {}
         # Per-worker train step-time/sync-time summaries (straggler
         # attribution): source -> {node_id, ts, stats: {rank: {...}}},
         # streamed inside the same report_telemetry pushes.
@@ -232,7 +245,8 @@ class HeadServer:
             self.watchdog = Watchdog(
                 train_stats_fn=lambda: self.train_stats,
                 nodes_fn=lambda: self.nodes,
-                profile_fn=self._watchdog_profile)
+                profile_fn=self._watchdog_profile,
+                exemplars_fn=self.exemplar_traces)
         # Goodput rollup store (observability/goodput.py): ingests the
         # run-level event legs piggybacked on report_telemetry, rolls the
         # fleet up from the train-stats rows above, exports goodput_*
@@ -1969,7 +1983,9 @@ class HeadServer:
                                 dropped: int = 0,
                                 train_stats: dict | None = None,
                                 series: dict | None = None,
-                                goodput: dict | None = None):
+                                goodput: dict | None = None,
+                                keeps: list | None = None,
+                                keep_cursor: int = 0):
         """One batched push from a process's telemetry flusher: its metrics
         snapshot (replaces the previous one for this source), finished
         spans, drained task events, and the delta-encoded watchdog series
@@ -1978,8 +1994,30 @@ class HeadServer:
         cumulative dropped-event count, surfaced per source in the
         get_telemetry table. The reply carries ``series_resync`` when the
         watchdog store doesn't know a referenced series id (head restart /
-        source eviction) — the reporter re-declares on its next flush."""
+        source eviction) — the reporter re-declares on its next flush.
+
+        Tail-sampling keep gossip rides the same push: ``keeps`` lists trace
+        ids this reporter promoted from its tail ring, and the reply returns
+        every cluster-wide kept id minted since the reporter's
+        ``keep_cursor`` (plus the new cursor), so a trace kept on one node
+        retroactively promotes its spans buffered on every other node — no
+        dedicated RPC."""
         out = {"ok": True}
+        for k in keeps or ():
+            tid = k.get("trace_id") if isinstance(k, dict) else k
+            if tid and tid not in self._keep_ids:
+                self._keep_seq += 1
+                self._keeps.append((self._keep_seq, tid))
+                self._keep_ids.add(tid)
+                while len(self._keep_ids) > 2 * self._keeps.maxlen:
+                    self._keep_ids.clear()
+                    self._keep_ids.update(t for _, t in self._keeps)
+        if self._keeps and keep_cursor < self._keep_seq:
+            out["keeps"] = [t for seq, t in self._keeps if seq > keep_cursor]
+            out["keep_cursor"] = self._keep_seq
+            # Promote matching spans already buffered in the head's own
+            # tail ring (e.g. handed straight to head-process tracing).
+            tracing.apply_keeps(out["keeps"])
         if series and self.watchdog is not None:
             if self.watchdog.ingest(source, node_id, series):
                 out["series_resync"] = True
@@ -1988,6 +2026,7 @@ class HeadServer:
                 "node_id": node_id, "ts": time.time(),
                 "snapshot": snapshot, "dropped": int(dropped),
             }
+            self._harvest_exemplars(snapshot)
             # Bounded: a churny cluster must not grow this forever. Evict
             # DEAD sources first (silent past the liveness window — they've
             # already fallen out of the export); only shed live reporters
@@ -2026,6 +2065,48 @@ class HeadServer:
                 # badput-over-threshold rule against the watchdog.
                 self.goodput.maybe_check(self.train_stats, self.watchdog)
         return out
+
+    def _harvest_exemplars(self, snapshot: dict) -> None:
+        """Pull histogram exemplars out of a reporter snapshot into the
+        (metric, deployment) -> [(trace_id, value, ts), ...] stash the
+        watchdog reads when assembling serve incidents. Newest-N per key,
+        same bound as one process's ring; stale keys age out wholesale at a
+        soft cap (exemplars are a hint, not a ledger)."""
+        for entry in snapshot.get("metrics", ()):
+            rows = entry.get("exemplars")
+            if not rows:
+                continue
+            tag_keys = entry.get("tag_keys") or []
+            try:
+                dep_i = tag_keys.index("deployment")
+            except ValueError:
+                dep_i = -1
+            for series_key, exs in rows:
+                dep = series_key[dep_i] if 0 <= dep_i < len(series_key) \
+                    else ""
+                key = (entry["name"], dep)
+                merged = self._exemplars.get(key, []) + [list(e) for e in exs]
+                merged.sort(key=lambda e: e[2] if len(e) > 2 else 0.0)
+                self._exemplars[key] = merged[-8:]
+        if len(self._exemplars) > 1024:
+            for key in sorted(self._exemplars,
+                              key=lambda k: self._exemplars[k][-1][2]
+                              if self._exemplars[k] else 0.0)[:256]:
+                self._exemplars.pop(key, None)
+
+    def exemplar_traces(self, metric: str = "",
+                        deployment: str = "") -> list:
+        """Recent exemplar rows for the watchdog: filter by metric prefix
+        and/or deployment; each row is (trace_id, value, ts), newest last."""
+        rows = []
+        for (name, dep), exs in self._exemplars.items():
+            if metric and not name.startswith(metric):
+                continue
+            if deployment and dep != deployment:
+                continue
+            rows.extend(exs)
+        rows.sort(key=lambda e: e[2] if len(e) > 2 else 0.0)
+        return rows[-8:]
 
     def _evict_telemetry_source(self, source: str) -> None:
         """Shed one reporter from the snapshot table AND its watchdog

@@ -938,6 +938,7 @@ class NodeDaemon:
 
         buf = global_event_buffer()
         span_cursor = 0
+        keep_cursor = 0  # head keep-gossip high-water mark
         source = f"{self.node_id}:{os.getpid()}"
         last_snapshot: dict | None = None
         last_sent = 0.0
@@ -967,20 +968,33 @@ class NodeDaemon:
                     goodput_leg = _gp.collect_for_flush()
                 except Exception:
                     pass
+                # Tail-sampling keep gossip (see the runtime flusher).
+                keeps = tracing.drain_keeps()
                 # Idle economy + keepalive (see the runtime flusher): skip
                 # unchanged pushes but stay inside the head's 60s window.
                 now = time.monotonic()
                 if not events and not spans and snapshot == last_snapshot \
                         and series is None and goodput_leg is None \
-                        and now - last_sent < 20.0:
+                        and not keeps and now - last_sent < 20.0:
                     continue
-                reply = await self._head.call(
-                    "report_telemetry", source=source, node_id=self.node_id,
-                    snapshot=snapshot, spans=spans, events=events,
-                    dropped=buf.dropped, series=series,
-                    goodput=goodput_leg, timeout=10)
+                try:
+                    reply = await self._head.call(
+                        "report_telemetry", source=source,
+                        node_id=self.node_id,
+                        snapshot=snapshot, spans=spans, events=events,
+                        dropped=buf.dropped, series=series,
+                        goodput=goodput_leg, keeps=keeps,
+                        keep_cursor=keep_cursor, timeout=10)
+                except Exception:
+                    if keeps:
+                        tracing.requeue_keeps(keeps)
+                    raise
                 _wd_sampler.handle_flush_reply(sampler, reply)
                 goodput_leg = None  # delivered — don't requeue below
+                if isinstance(reply, dict):
+                    tracing.apply_keeps(reply.get("keeps") or ())
+                    keep_cursor = int(reply.get("keep_cursor",
+                                                keep_cursor))
                 last_snapshot, last_sent = snapshot, now
             except Exception:
                 # Head unreachable: heartbeat loop handles reconnects;

@@ -388,6 +388,7 @@ class ClusterRuntime:
         source = f"{self.my_node_id or 'driver'}:{os.getpid()}"
         last_snapshot: dict | None = None
         last_sent = 0.0
+        keep_cursor = 0  # head keep-gossip high-water mark
         while not self._stop_flush.is_set():
             period = get_config().telemetry_flush_interval_s
             self._stop_flush.wait(period if period > 0 else 0.5)
@@ -438,6 +439,12 @@ class ClusterRuntime:
                     goodput_leg = _gp.collect_for_flush()
                 except Exception:
                     pass
+                # Tail-sampling keeps piggyback the same push (no new
+                # RPC): locally-decided keeps ship up, and the head's
+                # reply gossips back every keep decided anywhere since
+                # our cursor so fragments of a kept trace held HERE get
+                # promoted too.
+                keeps = tracing.drain_keeps()
                 # Idle-process economy: nothing new to report and the
                 # snapshot unchanged — skip the RPC, but keepalive well
                 # inside the head's 60s liveness window so the source
@@ -445,16 +452,30 @@ class ClusterRuntime:
                 now = time.monotonic()
                 if not events and not spans and snapshot == last_snapshot \
                         and train_stats is None and series is None \
-                        and goodput_leg is None and now - last_sent < 20.0:
+                        and goodput_leg is None and not keeps \
+                        and now - last_sent < 20.0:
                     continue
-                reply = self.head.call(
-                    "report_telemetry", source=source,
-                    node_id=self.my_node_id, timeout=10,
-                    snapshot=snapshot, spans=spans, events=events,
-                    dropped=buf.dropped, train_stats=train_stats,
-                    series=series, goodput=goodput_leg)
+                try:
+                    reply = self.head.call(
+                        "report_telemetry", source=source,
+                        node_id=self.my_node_id, timeout=10,
+                        snapshot=snapshot, spans=spans, events=events,
+                        dropped=buf.dropped, train_stats=train_stats,
+                        series=series, goodput=goodput_leg,
+                        keeps=keeps, keep_cursor=keep_cursor)
+                except Exception:
+                    # Head outage with keeps drained: requeue them — the
+                    # trace stays promotable (partial) once the head
+                    # returns, instead of silently losing the verdict.
+                    if keeps:
+                        tracing.requeue_keeps(keeps)
+                    raise
                 _wd_sampler.handle_flush_reply(self._series_sampler, reply)
                 goodput_leg = None  # delivered — don't requeue below
+                if isinstance(reply, dict):
+                    tracing.apply_keeps(reply.get("keeps") or ())
+                    keep_cursor = int(reply.get("keep_cursor",
+                                                keep_cursor))
                 last_snapshot, last_sent = snapshot, now
             except Exception:
                 # Head temporarily unreachable: events/spans drop (bounded
@@ -1546,39 +1567,60 @@ class ClusterRuntime:
         if not sources:
             return None
         try:
+            import contextlib
+
             from ray_tpu.core import transfer
+            from ray_tpu.util import tracing
 
             oid = ref.id.binary()
-            if self.shm is not None:
-                if self.shm.contains(oid):
+            # Range-pull span only when a request trace is live on this
+            # thread (a traced get() inside a serve/DAG request): the
+            # cross-host KV or activation fetch shows up as a phase of
+            # THAT request's waterfall. Untraced pulls pay nothing.
+            span_cm = (tracing.span("transfer.pull", kind="client",
+                                    attributes={"object": ref.id.hex()[:16],
+                                                "sources": len(sources)})
+                       if tracing.current_context() is not None
+                       else contextlib.nullcontext())
+            with span_cm as tspan:
+                if self.shm is not None:
+                    if self.shm.contains(oid):
+                        return self.shm.get_view(oid)
+                    try:
+                        total = transfer.pull_to_store(self.shm.name, oid,
+                                                       sources)
+                    except transfer.ObjectInFlight:
+                        # A same-node puller beat us to it: ride its
+                        # transfer.
+                        view = self._await_local_seal(ref)
+                        if view is not None:
+                            return view
+                        # Foreign pull aborted: one fresh attempt of our
+                        # own.
+                        total = transfer.pull_to_store(self.shm.name, oid,
+                                                       sources)
+                    if total is None:
+                        return None
+                    if tspan is not None:
+                        tspan.attributes["bytes"] = int(total)
+                    # Sealing into the arena bypasses store.on_seal — wake
+                    # concurrent wait()ers on this ref like the RPC path
+                    # does.
+                    self._notify_waiters()
+                    # Pinned view, not bytes: get() deserializes straight
+                    # out of the arena (large arrays zero-copy) instead of
+                    # paying an arena->bytes traversal plus a deserialize
+                    # copy.
                     return self.shm.get_view(oid)
-                try:
-                    total = transfer.pull_to_store(self.shm.name, oid,
-                                                   sources)
-                except transfer.ObjectInFlight:
-                    # A same-node puller beat us to it: ride its transfer.
-                    view = self._await_local_seal(ref)
-                    if view is not None:
-                        return view
-                    # Foreign pull aborted: one fresh attempt of our own.
-                    total = transfer.pull_to_store(self.shm.name, oid,
-                                                   sources)
-                if total is None:
-                    return None
-                # Sealing into the arena bypasses store.on_seal — wake
-                # concurrent wait()ers on this ref like the RPC path does.
-                self._notify_waiters()
-                # Pinned view, not bytes: get() deserializes straight out
-                # of the arena (large arrays zero-copy) instead of paying
-                # an arena->bytes traversal plus a deserialize copy.
-                return self.shm.get_view(oid)
-            data = transfer.fetch_to_buffer(ref.id.binary(), sources)
-            if data is not None:
-                # Cache like the RPC chunk path does, or every re-get of
-                # this ref re-transfers the whole object.
-                self.store.put(ref.id, data, ref.owner_id)
-                self._notify_waiters()
-            return data
+                data = transfer.fetch_to_buffer(ref.id.binary(), sources)
+                if data is not None:
+                    if tspan is not None:
+                        tspan.attributes["bytes"] = len(data)
+                    # Cache like the RPC chunk path does, or every re-get
+                    # of this ref re-transfers the whole object.
+                    self.store.put(ref.id, data, ref.owner_id)
+                    self._notify_waiters()
+                return data
         except Exception:  # noqa: BLE001 - any native failure -> RPC path
             return None
 

@@ -43,6 +43,7 @@ from ray_tpu.dag.dag_node import (
     InputNode,
     MultiOutputNode,
 )
+from ray_tpu.util import tracing
 
 _DRIVER = "__driver__"
 
@@ -399,7 +400,16 @@ class CompiledDAG:
                 # futures in FIFO order against the pipeline's FIFO
                 # outputs, so both sequences must be built under one lock.
                 self._pending.append(fut)
-                self._in_chan.write(value)
+                # Span around the input write: the channel injects the
+                # context into its push frame, so the first hop (and every
+                # downstream hop, each re-injecting at its own write)
+                # parents this execution's dataflow under one trace.
+                if tracing.tracing_enabled():
+                    with tracing.span(f"dag.execute.{self._dag_id}",
+                                      kind="client"):
+                        self._in_chan.write(value)
+                else:
+                    self._in_chan.write(value)
         except BaseException as e:
             with self._submit_lock:
                 try:

@@ -43,6 +43,7 @@ from collections import deque
 from typing import Any
 
 from ray_tpu.dag.channel import ChannelClosed
+from ray_tpu.util import tracing
 from ray_tpu.utils import serialization
 from ray_tpu.utils.config import get_config
 
@@ -233,6 +234,17 @@ class DirectChannel:
         parts = serialization.serialize_parts(value)
         total = sum(len(p) for p in parts)
         payload: dict = {"chan": self.name, "seq": self._write_seq}
+        # Trace context rides INSIDE the existing push frame (no new RPC,
+        # no extra frame): the reader's hop span parents under this
+        # write's span, so a DAG step is one trace across processes.
+        tspan = None
+        if tracing.current_context() is not None:
+            tspan = tracing.start_span(
+                f"dag.push.{self.name}", kind="client",
+                attributes={"seq": self._write_seq, "bytes": total,
+                            "inline": total <= self.inline_max})
+            payload["trace"] = tracing.ctx_for(tspan,
+                                               tracing.current_sampled())
         ref = None
         if total <= self.inline_max:
             payload["data"] = b"".join(bytes(p) for p in parts)
@@ -251,12 +263,16 @@ class DirectChannel:
                            whost=rt.addr[0], wport=rt.addr[1],
                            wnode=getattr(rt, "my_node_id", "") or "")
         futs = []
-        for ridx in range(self.num_readers):
-            route = self._resolve_route(ridx)
-            if route is None:
-                raise TimeoutError(
-                    f"channel {self.name}: reader {ridx} never attached")
-            futs.append(self._send(route, dict(payload, ridx=ridx)))
+        try:
+            for ridx in range(self.num_readers):
+                route = self._resolve_route(ridx)
+                if route is None:
+                    raise TimeoutError(
+                        f"channel {self.name}: reader {ridx} never attached")
+                futs.append(self._send(route, dict(payload, ridx=ridx)))
+        finally:
+            if tspan is not None:
+                tracing.finish_span(tspan, tracing.current_sampled())
         # The held ref keeps the store-backed buffer alive until every
         # reader acked; dropped when the entry drains off the window.
         self._outstanding.append((futs, ref))
@@ -305,6 +321,7 @@ class DirectChannel:
             if ack is not None:
                 ack()
             raise ChannelClosed(self.name)
+        t_deq = time.time()
         try:
             value = self._materialize(a)
         except BaseException as e:
@@ -313,6 +330,23 @@ class DirectChannel:
             raise
         if ack is not None:
             ack()
+        tctx = a.get("trace")
+        if tctx is not None:
+            # Reader hop span: dequeue → materialized, parented under the
+            # writer's push span via the context the frame carried. The
+            # reading thread then ADOPTS the context: a DAG actor loop's
+            # downstream write re-injects it, chaining the next hop onto
+            # the same trace across any number of stages.
+            s = tracing.record_span(
+                f"dag.recv.{self.name}", t_deq, time.time(), kind="worker",
+                attributes={"seq": a.get("seq", -1),
+                            "inline": "data" in a,
+                            "reader_index": reader_index},
+                ctx=tctx)
+            tracing.adopt(tracing.ctx_for(s, tctx.get("sampled"))
+                          if s is not None else tctx)
+        else:
+            tracing.adopt(None)  # untraced frame: don't inherit the last
         return value
 
     def _materialize(self, a: dict) -> Any:

@@ -113,19 +113,38 @@ class DashboardServer:
 
         def traces(p, b):
             # Local spans plus, in cluster mode, every node's spans flushed
-            # to the head (deduped — this process's spans also reach the
-            # head via its own telemetry flusher).
+            # to the head. Deduped on (trace_id, span_id) — span ids are
+            # minted per process, so two processes CAN collide on span_id
+            # alone while a span replayed via both the local buffer and the
+            # head must still collapse to one row. ?trace_id=<id> narrows
+            # to one request's spans (the `ray_tpu trace` CLI's source);
+            # ?exemplars=1 returns the histogram exemplar index instead —
+            # the metrics→traces entry point.
             from ray_tpu.core.worker import global_worker
 
-            by_id = {s["span_id"]: s for s in tracing.export()}
+            if p.get("exemplars"):
+                out = []
+                for m in metrics.registry().snapshot().get("metrics", ()):
+                    if m.get("exemplars"):
+                        out.append({"metric": m["name"],
+                                    "tag_keys": m.get("tag_keys", []),
+                                    "exemplars": m["exemplars"]})
+                return out
+            want = p.get("trace_id")
+            by_id = {(s.get("trace_id"), s["span_id"]): s
+                     for s in tracing.export()}
             rt = global_worker.runtime
             if rt is not None and hasattr(rt, "cluster_spans"):
                 try:
                     for s in rt.cluster_spans():
-                        by_id.setdefault(s.get("span_id"), s)
+                        by_id.setdefault(
+                            (s.get("trace_id"), s.get("span_id")), s)
                 except Exception:
                     pass  # head unreachable: local view still useful
-            return list(by_id.values())
+            rows = list(by_id.values())
+            if want:
+                rows = [s for s in rows if s.get("trace_id") == want]
+            return rows
 
         self.add_route("GET", "/api/traces", traces)
 

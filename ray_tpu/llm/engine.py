@@ -45,6 +45,7 @@ from jax import lax
 
 from ray_tpu.devtools.annotations import guarded_by
 from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.util import tracing
 from ray_tpu.llm.tokenizer import get_tokenizer
 from ray_tpu.models.llama import LlamaConfig, init_params
 from ray_tpu.ops.norms import rms_norm
@@ -604,6 +605,15 @@ class GenerationRequest:
     spec_disabled: bool = False  # excluded from speculation (see _spec_decode)
     arrival_seq: int = 0  # admission order; blocked-KV preemption evicts newest
     prefill_gen: int = 0  # bumped on preemption: stale deferred fetches no-op
+    # Request tracing: the submitter's propagated context (None = untraced)
+    # plus the phase timestamps the scheduler thread stamps engine spans
+    # from (engine.queue / engine.prefill / engine.decode — the TTFT
+    # breakdown). kv_imported marks a P/D hand-off continuation.
+    trace_ctx: dict | None = None
+    submit_ts: float = 0.0
+    admit_ts: float = 0.0
+    first_token_ts: float = 0.0
+    kv_imported: bool = False
 
 
 @dataclass
@@ -769,6 +779,12 @@ class LLMEngine:
             request_id=uuid.uuid4().hex[:12], prompt_ids=ids,
             sampling=sampling,
             stream_queue=queue.Queue() if stream else None)
+        # Capture the submitter's trace context while its thread-local is
+        # live: the scheduler thread stamps the engine phase spans onto
+        # the REQUEST's trace from a thread that never entered it.
+        req.trace_ctx = tracing.inject() if tracing.current_context() \
+            else None
+        req.submit_ts = time.time()
         with self._submit_lock:
             self._arrival_seq += 1
             req.arrival_seq = self._arrival_seq
@@ -807,6 +823,9 @@ class LLMEngine:
         req = GenerationRequest(
             request_id=uuid.uuid4().hex[:12], prompt_ids=ids,
             sampling=replace(sampling, max_tokens=1), hold_slot=True)
+        req.trace_ctx = tracing.inject() if tracing.current_context() \
+            else None
+        req.submit_ts = time.time()
         with self._submit_lock:
             self._requests[req.request_id] = req
         self._waiting.put(req)
@@ -894,6 +913,10 @@ class LLMEngine:
         req.preloaded = (np.asarray(payload["kv_k"]),
                          np.asarray(payload["kv_v"]),
                          int(payload["first_token"]))
+        req.trace_ctx = tracing.inject() if tracing.current_context() \
+            else None
+        req.submit_ts = time.time()
+        req.kv_imported = True
         with self._submit_lock:
             self._requests[req.request_id] = req
         self._waiting.put(req)
@@ -1120,6 +1143,7 @@ class LLMEngine:
                 req = self._next_waiting()
             except queue.Empty:
                 break
+            req.admit_ts = time.time()
             if req.preloaded is not None:
                 slot = self._take_slot()
                 try:
@@ -1842,6 +1866,24 @@ class LLMEngine:
 
     def _emit(self, req: GenerationRequest, token: int) -> None:
         req.out_tokens.append(token)
+        if len(req.out_tokens) == 1 and req.trace_ctx is not None:
+            # First token: stamp the TTFT phase breakdown onto the
+            # request's trace — queue wait (submit→admit) and the prefill
+            # (or P/D KV import) interval ending at this emission.
+            now = req.first_token_ts = time.time()
+            if req.admit_ts and req.submit_ts:
+                tracing.record_span(
+                    "engine.queue", req.submit_ts, req.admit_ts,
+                    ctx=req.trace_ctx,
+                    attributes={"request_id": req.request_id})
+            tracing.record_span(
+                "engine.kv_import" if req.kv_imported
+                else "engine.prefill",
+                req.admit_ts or req.submit_ts or now, now,
+                ctx=req.trace_ctx,
+                attributes={"request_id": req.request_id,
+                            "prompt_tokens": len(req.prompt_ids),
+                            "prefix_adopted": req.prefilled_len})
         if req.stream_queue is not None:
             req.stream_queue.put(token)
         eos = {self.tokenizer.eos_id, *req.sampling.stop_token_ids}
@@ -1865,6 +1907,13 @@ class LLMEngine:
 
     def _finish(self, req: GenerationRequest, reason: str) -> None:
         req.finish_reason = reason
+        if req.trace_ctx is not None and req.first_token_ts:
+            tracing.record_span(
+                "engine.decode", req.first_token_ts, time.time(),
+                ctx=req.trace_ctx,
+                attributes={"request_id": req.request_id,
+                            "tokens": len(req.out_tokens),
+                            "finish_reason": reason})
         for slot, r in self._slots.items():
             if r is req:
                 req.last_slot = slot

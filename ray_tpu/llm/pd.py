@@ -30,6 +30,7 @@ from ray_tpu import serve
 from ray_tpu.llm.config import LLMConfig
 from ray_tpu.llm.engine import LLMEngine
 from ray_tpu.llm.serving import _sampling_from
+from ray_tpu.util import tracing
 
 _kv_metrics = None
 _kv_metrics_lock = threading.Lock()
@@ -99,18 +100,23 @@ def export_kv_payload(payload: dict, mode: str) -> dict:
             f"'inline'")
     mtr = kv_bound(mode)
     nbytes = payload["kv_k"].nbytes + payload["kv_v"].nbytes
-    if mode == "store":
-        out = dict(payload)
-        kv_k, kv_v = out.pop("kv_k"), out.pop("kv_v")
-        out["kv_ref_k"] = ray_tpu.put(kv_k)
-        out["kv_ref_v"] = ray_tpu.put(kv_v)
+    # KV hand-off phase span: nests under the prefill replica's worker
+    # span (same thread), so the trace shows how long the export side of
+    # the P/D hop took and over which transport.
+    with tracing.span("llm.kv_export",
+                      attributes={"path": mode, "bytes": nbytes}):
+        if mode == "store":
+            out = dict(payload)
+            kv_k, kv_v = out.pop("kv_k"), out.pop("kv_v")
+            out["kv_ref_k"] = ray_tpu.put(kv_k)
+            out["kv_ref_v"] = ray_tpu.put(kv_v)
+            mtr["bytes"].inc(nbytes)
+            mtr["handoffs"].inc()
+            return out
         mtr["bytes"].inc(nbytes)
+        mtr["serialized"].inc(nbytes)  # will ride the handle call pickled
         mtr["handoffs"].inc()
-        return out
-    mtr["bytes"].inc(nbytes)
-    mtr["serialized"].inc(nbytes)  # will ride the handle call pickled
-    mtr["handoffs"].inc()
-    return payload
+        return payload
 
 
 def resolve_kv_payload(payload: dict) -> dict:
@@ -123,8 +129,12 @@ def resolve_kv_payload(payload: dict) -> dict:
     out = dict(payload)
     # One batched get: cross-host, the two transfer-plane pulls overlap
     # instead of serializing two multi-MB fetches on the TTFT path.
-    out["kv_k"], out["kv_v"] = ray_tpu.get(
-        [out.pop("kv_ref_k"), out.pop("kv_ref_v")])
+    with tracing.span("llm.kv_resolve", attributes={"path": "store"}) as s:
+        out["kv_k"], out["kv_v"] = ray_tpu.get(
+            [out.pop("kv_ref_k"), out.pop("kv_ref_v")])
+        if s is not None:
+            s.attributes["bytes"] = \
+                out["kv_k"].nbytes + out["kv_v"].nbytes
     return out
 
 

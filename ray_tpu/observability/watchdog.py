@@ -83,7 +83,7 @@ class Watchdog:
 
     def __init__(self, train_stats_fn=None, nodes_fn=None, profile_fn=None,
                  cfg=None, rules: list[Rule] | None = None,
-                 store: SeriesStore | None = None):
+                 store: SeriesStore | None = None, exemplars_fn=None):
         cfg = cfg or get_config()
         self.cfg = cfg
         self.store = store or SeriesStore(
@@ -93,6 +93,10 @@ class Watchdog:
         self._train_stats_fn = train_stats_fn or (lambda: {})
         self._nodes_fn = nodes_fn or (lambda: {})
         self._profile_fn = profile_fn
+        # exemplars_fn(metric, deployment) -> [(trace_id, value, ts)]: the
+        # head's SLO-exemplar stash, linking a tripped serve rule straight
+        # to kept traces. Optional — incidents omit the field without it.
+        self._exemplars_fn = exemplars_fn
         self.incidents: deque = deque(maxlen=cfg.watchdog_max_incidents)
         self._pending: deque = deque()
         self._hb_last: dict[str, float] = {}
@@ -261,6 +265,19 @@ class Watchdog:
         incident["window"] = self.store.window(key, seconds=120.0,
                                                max_points=240)
         incident["related"] = self._related(trip)
+        if self._exemplars_fn is not None:
+            # Metrics→traces: recent exemplar trace ids for the tripped
+            # metric (scoped to its deployment tag when present) — each id
+            # resolves via ``ray_tpu trace <id>`` / /api/traces.
+            try:
+                rows = self._exemplars_fn(
+                    key.name, key.tag_dict().get("deployment", "")) or []
+                if rows:
+                    incident["exemplar_traces"] = [
+                        {"trace_id": r[0], "value": r[1], "ts": r[2]}
+                        for r in rows[-4:]]
+            except Exception:
+                pass  # exemplars are a hint — never block assembly
         self._spend(time.perf_counter() - t0)
 
         # Flight record: head-side bundle carrying the incident context

@@ -56,7 +56,9 @@ def merge_chrome_trace(captures: list[dict],
     seen_spans = set()
     has_goodput = False
     for s in spans or []:
-        sid = s.get("span_id")
+        # Span ids are minted per process: dedup on (trace_id, span_id) so
+        # a cross-process collision can't swallow someone else's row.
+        sid = (s.get("trace_id"), s.get("span_id"))
         if sid in seen_spans:
             continue
         seen_spans.add(sid)

@@ -134,13 +134,15 @@ def cmd_timeline(args) -> int:
     if events is None:
         raw = global_worker.runtime.task_events()["events"]
         events = chrome_trace([TaskEvent(**e) for e in raw])
-    # Spans (local + cluster-flushed, deduped) as their own rows.
-    by_id = {s["span_id"]: s for s in tracing.export()}
+    # Spans (local + cluster-flushed) as their own rows, deduped on
+    # (trace_id, span_id) — span ids are per-process, so cross-process
+    # collisions on span_id alone must not swallow rows.
+    by_id = {(s.get("trace_id"), s["span_id"]): s for s in tracing.export()}
     rt = global_worker.runtime
     if rt is not None and hasattr(rt, "cluster_spans"):
         try:
             for s in rt.cluster_spans():
-                by_id.setdefault(s.get("span_id"), s)
+                by_id.setdefault((s.get("trace_id"), s.get("span_id")), s)
         except Exception:
             pass
     for s in by_id.values():
@@ -159,6 +161,94 @@ def cmd_timeline(args) -> int:
     with open(args.out, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     print(f"wrote {len(events)} trace events to {args.out}")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Waterfall of ONE request's spans across every process that touched
+    it (handle root, router attempt, replica, engine phases, DAG hops,
+    transfer pulls), assembled from the local buffer + head-flushed spans.
+    --out additionally writes a chrome://tracing file scoped to the trace."""
+    _connect(args.address)
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.util import tracing
+
+    want = args.trace_id
+    by_id = {(s.get("trace_id"), s["span_id"]): s for s in tracing.export()}
+    rt = global_worker.runtime
+    if rt is not None and hasattr(rt, "cluster_spans"):
+        try:
+            for s in rt.cluster_spans():
+                by_id.setdefault((s.get("trace_id"), s.get("span_id")), s)
+        except Exception:
+            pass  # head unreachable: local spans still render
+    spans = [s for s in by_id.values()
+             if s.get("trace_id", "").startswith(want)]
+    if not spans:
+        print(f"no spans for trace {want!r} (sampled out, expired from "
+              "the buffer, or not flushed yet)")
+        return 1
+    spans.sort(key=lambda s: s.get("start_ts", 0.0))
+    tid = spans[0]["trace_id"]
+    t0 = min(s["start_ts"] for s in spans)
+    t_end = max(s.get("end_ts") or s["start_ts"] for s in spans)
+    total = max(t_end - t0, 1e-9)
+    if args.json:
+        print(json.dumps(spans, indent=2, default=str))
+        return 0
+    # Parent-chain indentation; orphan parents (span not captured — e.g. a
+    # process that never flushed) render at depth 0, so a partial trace
+    # still lays out.
+    ids = {s["span_id"] for s in spans}
+    depth: dict[str, int] = {}
+
+    def _depth(s) -> int:
+        d, seen = 0, set()
+        cur = s
+        while cur.get("parent_id") in ids and cur["span_id"] not in seen:
+            seen.add(cur["span_id"])
+            d += 1
+            cur = next(x for x in spans
+                       if x["span_id"] == cur["parent_id"])
+        return d
+
+    for s in spans:
+        depth[s["span_id"]] = _depth(s)
+    width = 40
+    print(f"trace {tid}  ({len(spans)} spans, "
+          f"{total * 1e3:.1f} ms end-to-end)")
+    for s in spans:
+        start = s["start_ts"] - t0
+        dur = max(0.0, (s.get("end_ts") or s["start_ts"]) - s["start_ts"])
+        lo = int(start / total * width)
+        hi = max(lo + 1, int((start + dur) / total * width))
+        bar = " " * lo + "#" * (hi - lo) + " " * (width - hi)
+        name = "  " * depth[s["span_id"]] + s["name"]
+        status = "" if s.get("status") == "OK" else f"  [{s['status']}]"
+        print(f"  {name:<36.36} |{bar}| {start * 1e3:7.1f}ms "
+              f"+{dur * 1e3:.1f}ms{status}")
+        for ev in s.get("events") or []:
+            extras = {k: v for k, v in ev.items() if k not in ("name", "ts")}
+            ets = (float(ev.get("ts", s["start_ts"])) - t0) * 1e3
+            print(f"  {'  ' * depth[s['span_id']]}  · {ev.get('name')}"
+                  f" @{ets:.1f}ms"
+                  + (f" {extras}" if extras else ""))
+    if args.out:
+        events = [{
+            "name": s["name"], "cat": f"span:{s.get('kind', 'internal')}",
+            "ph": "X", "ts": s["start_ts"] * 1e6,
+            "dur": max(0.0, ((s.get("end_ts") or s["start_ts"])
+                             - s["start_ts"]) * 1e6),
+            "pid": "trace", "tid": s.get("kind", "internal"),
+            "args": {"trace_id": tid, "span_id": s["span_id"],
+                     "status": s.get("status", ""),
+                     **(s.get("attributes") or {})},
+        } for s in spans]
+        events.append({"name": "process_name", "ph": "M", "pid": "trace",
+                       "args": {"name": f"trace {tid[:16]}"}})
+        with open(args.out, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        print(f"wrote {len(events)} trace events to {args.out}")
     return 0
 
 
@@ -613,6 +703,14 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("resource", choices=["tasks"])
     tp = sub.add_parser("timeline")
     tp.add_argument("--out", default="timeline.json")
+    tr = sub.add_parser(
+        "trace", help="waterfall of one request's spans across every "
+                      "process (handle/router/replica/engine/DAG/transfer)")
+    tr.add_argument("trace_id", help="trace id (or unique prefix) — from "
+                                     "an SLO exemplar, incident, or log")
+    tr.add_argument("--out", default=None,
+                    help="also write a chrome://tracing JSON for this trace")
+    tr.add_argument("--json", action="store_true")
     fp = sub.add_parser("flight-records")
     fp.add_argument("--get", default=None, help="dump one bundle by name")
     fp.add_argument("--kind", default=None,
@@ -720,7 +818,8 @@ def main(argv: list[str] | None = None) -> int:
     if hasattr(args, "_fn"):  # start/stop/serve-* carry their handler
         return args._fn(args)
     cmds = {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
-            "timeline": cmd_timeline, "logs": cmd_logs, "memory": cmd_memory,
+            "timeline": cmd_timeline, "trace": cmd_trace,
+            "logs": cmd_logs, "memory": cmd_memory,
             "flight-records": cmd_flight_records, "profile": cmd_profile,
             "stack": cmd_stack, "stragglers": cmd_stragglers,
             "chaos": cmd_chaos, "incidents": cmd_incidents,

@@ -12,9 +12,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from functools import wraps
 from typing import Any, Callable
+
+from ray_tpu.util import tracing
 
 
 class _BatchQueue:
@@ -31,12 +34,17 @@ class _BatchQueue:
         # The request's deadline rides along (thread-local, stamped by the
         # replica before the user method ran): the batch loop sheds items
         # that expire while queued instead of spending a batch slot on
-        # them.
+        # them. The trace context is captured HERE too — batching fans
+        # many requests into ONE execution, so each item's batch span must
+        # parent to its own request's trace, not to whichever request
+        # happened to trigger the batch (captured per-item while the
+        # caller's thread-local context is still live).
         from ray_tpu.serve.resilience import current_deadline, current_deployment
 
         fut: Future = Future()
+        ctx = tracing.inject() if tracing.current_context() else None
         self.q.put((instance, item, fut, current_deadline(),
-                    current_deployment()))
+                    current_deployment(), ctx, time.time()))
         with self._lock:
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -87,6 +95,7 @@ class _BatchQueue:
             instance = batch[0][0]
             items = [b[1] for b in batch]
             futs = [b[2] for b in batch]
+            status = "OK"
             try:
                 results = (self.fn(instance, items) if instance is not None
                            else self.fn(items))
@@ -97,9 +106,22 @@ class _BatchQueue:
                 for f, r in zip(futs, results):
                     f.set_result(r)
             except BaseException as e:  # noqa: BLE001
+                status = f"ERROR: {type(e).__name__}"
                 for f in futs:
                     if not f.done():
                         f.set_exception(e)
+            # One batch execution, many requests: each item with a
+            # propagated context gets its own span (queue wait + execute)
+            # parented under ITS request's trace — the batch loop thread
+            # never entered any of them, so the context rides explicitly.
+            end = time.time()
+            for entry in batch:
+                if entry[5] is not None:
+                    tracing.record_span(
+                        "serve.batch_item", entry[6], end,
+                        attributes={"batch_size": len(items),
+                                    "status": status},
+                        ctx=entry[5])
 
 
 def batch(_fn: Callable | None = None, *, max_batch_size: int = 8,

@@ -64,6 +64,11 @@ class DeploymentConfig:
     # → blacklist with half-open recovery probes).
     circuit_breaker: CircuitBreakerConfig = field(
         default_factory=CircuitBreakerConfig)
+    # Head-sampling rate for request tracing, per deployment: fraction of
+    # requests whose trace is recorded up front (the rest ride the tail
+    # ring, promotable retroactively). None inherits the cluster default
+    # (Config.trace_sample_rate).
+    trace_sample_rate: float | None = None
 
     def resilience_settings(self) -> ResilienceSettings:
         """The router-facing view of these knobs (published with every
@@ -72,7 +77,8 @@ class DeploymentConfig:
             request_timeout_s=self.request_timeout_s,
             max_queued_requests=self.max_queued_requests,
             retry=self.retry_policy,
-            breaker=self.circuit_breaker)
+            breaker=self.circuit_breaker,
+            trace_sample_rate=self.trace_sample_rate)
 
     # resources per replica
     ray_actor_options: dict = field(default_factory=dict)

@@ -129,7 +129,8 @@ def deployment(_func_or_class: Callable | None = None, *,
                max_queued_requests: int = 256,
                replica_queue_slack: int = 8,
                retry_policy: RetryPolicy | dict | None = None,
-               circuit_breaker: CircuitBreakerConfig | dict | None = None):
+               circuit_breaker: CircuitBreakerConfig | dict | None = None,
+               trace_sample_rate: float | None = None):
     """``@serve.deployment`` (reference: serve/api.py deployment decorator).
 
     Resilience knobs (full semantics on DeploymentConfig /
@@ -137,7 +138,9 @@ def deployment(_func_or_class: Callable | None = None, *,
     per-request budget, ``max_queued_requests`` bounds the router queue
     (shed with Overloaded beyond it), ``replica_queue_slack`` bounds
     replica-side admission, ``retry_policy`` configures assignment retries
-    and tail hedging, ``circuit_breaker`` the per-replica blacklist."""
+    and tail hedging, ``circuit_breaker`` the per-replica blacklist,
+    ``trace_sample_rate`` the deployment's request-tracing head-sampling
+    rate (None = cluster default Config.trace_sample_rate)."""
 
     def deco(func_or_class: Callable) -> Deployment:
         if placement_group_bundles is not None or \
@@ -169,6 +172,7 @@ def deployment(_func_or_class: Callable | None = None, *,
             replica_queue_slack=replica_queue_slack,
             retry_policy=rp,
             circuit_breaker=cb,
+            trace_sample_rate=trace_sample_rate,
         )
         return Deployment(func_or_class,
                           name or func_or_class.__name__, cfg)

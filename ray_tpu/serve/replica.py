@@ -19,6 +19,7 @@ from ray_tpu.serve.resilience import (
     _set_current_deadline,
 )
 from ray_tpu.devtools.annotations import guarded_by
+from ray_tpu.util import tracing
 from ray_tpu.utils import serialization
 
 _replica_metrics = None
@@ -228,9 +229,12 @@ class ServeReplica:
             result = target(*args, **kwargs)
             elapsed = time.perf_counter() - t0
             try:
-                # Non-streaming: the full result IS the first output.
-                self._b["ttft"].observe(elapsed)
-                self._b["latency"].observe(elapsed)
+                # Non-streaming: the full result IS the first output. The
+                # live trace id rides along as an SLO exemplar, linking
+                # the histogram bucket back to the request's trace.
+                tid = tracing.current_trace_id()
+                self._b["ttft"].observe(elapsed, exemplar=tid)
+                self._b["latency"].observe(elapsed, exemplar=tid)
                 self._count_slo_tokens(1, deadline)
             except Exception:
                 pass
@@ -254,6 +258,9 @@ class ServeReplica:
         deadline = kwargs.pop(DEADLINE_KEY, None)
         self._begin_request(deadline)
         _set_current_deadline(deadline, self.deployment_name)
+        # Exemplar trace id captured NOW: the generator body runs after
+        # the submitting worker span has left the thread-local context.
+        tid = tracing.current_trace_id()
         t0 = time.perf_counter()
         try:
             self._chaos_probe(method_name)
@@ -266,18 +273,19 @@ class ServeReplica:
                         getattr(target, "__call__", None)):
                 yield {"streaming": True}
                 yield from self._instrumented_stream(
-                    target(*args, **kwargs), t0, deadline)
+                    target(*args, **kwargs), t0, deadline, tid)
                 return
             result = target(*args, **kwargs)
             if inspect.isgenerator(result):
                 yield {"streaming": True}
-                yield from self._instrumented_stream(result, t0, deadline)
+                yield from self._instrumented_stream(result, t0, deadline,
+                                                     tid)
                 return
             yield {"streaming": False}
             elapsed = time.perf_counter() - t0
             try:
-                self._b["ttft"].observe(elapsed)
-                self._b["latency"].observe(elapsed)
+                self._b["ttft"].observe(elapsed, exemplar=tid)
+                self._b["latency"].observe(elapsed, exemplar=tid)
                 self._count_slo_tokens(1, deadline)
             except Exception:
                 pass
@@ -302,7 +310,8 @@ class ServeReplica:
             pass
 
     def _instrumented_stream(self, gen, t0: float,
-                             deadline: float | None = None):
+                             deadline: float | None = None,
+                             exemplar: str | None = None):
         """TTFT on the first user chunk, TPOT on each inter-chunk gap, full
         latency at exhaustion — the streaming triple every serving
         comparison quotes. Each chunk counts toward the deployment's
@@ -313,9 +322,10 @@ class ServeReplica:
                 now = time.perf_counter()
                 try:
                     if last is None:
-                        self._b["ttft"].observe(now - t0)
+                        self._b["ttft"].observe(now - t0, exemplar=exemplar)
                     else:
-                        self._b["tpot"].observe(now - last)
+                        self._b["tpot"].observe(now - last,
+                                                exemplar=exemplar)
                 except Exception:
                     pass
                 self._count_slo_tokens(1, deadline)
@@ -323,7 +333,8 @@ class ServeReplica:
                 yield chunk
         finally:
             try:
-                self._b["latency"].observe(time.perf_counter() - t0)
+                self._b["latency"].observe(time.perf_counter() - t0,
+                                           exemplar=exemplar)
             except Exception:
                 pass
 

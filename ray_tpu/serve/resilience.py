@@ -266,6 +266,12 @@ class CircuitBreaker:
         self._lock = threading.Lock()
         self._replicas: dict[str, _ReplicaBreaker] = {}
         self._fleet_latencies: deque[float] = deque(maxlen=_LATENCY_WINDOW * 4)
+        # Sticky "has ANY breaker ever opened" flag, read without the lock:
+        # per-request probes (the tracing tail-keep verdict checks every
+        # tried replica) skip the lock entirely in the healthy steady
+        # state. Racy by design — a trip concurrent with the read is
+        # visible to the next request.
+        self._open_seen = False
 
     def _get(self, replica_id: str) -> _ReplicaBreaker:
         rb = self._replicas.get(replica_id)
@@ -314,6 +320,8 @@ class CircuitBreaker:
                 rb.probes_out -= 1
 
     def is_open(self, replica_id: str) -> bool:
+        if not self._open_seen:
+            return False
         with self._lock:
             rb = self._replicas.get(replica_id)
             if rb is None:
@@ -392,6 +400,7 @@ class CircuitBreaker:
         return None
 
     def _open_locked(self, replica_id: str, rb: _ReplicaBreaker) -> None:
+        self._open_seen = True
         rb.state = _OPEN
         rb.open_until = time.monotonic() + self.config.open_s
         rb.probes_out = 0
@@ -478,12 +487,17 @@ class ResilienceSettings:
     max_queued_requests: int = 256
     retry: RetryPolicy = field(default_factory=RetryPolicy)
     breaker: CircuitBreakerConfig = field(default_factory=CircuitBreakerConfig)
+    # Head-sampling rate for request traces on this deployment; None
+    # falls back to Config.trace_sample_rate. Inert until the tracing
+    # master gate (tracing.enable_tracing) is on.
+    trace_sample_rate: float | None = None
 
     def to_dict(self) -> dict:
         return {"request_timeout_s": self.request_timeout_s,
                 "max_queued_requests": self.max_queued_requests,
                 "retry": self.retry.to_dict(),
-                "breaker": self.breaker.to_dict()}
+                "breaker": self.breaker.to_dict(),
+                "trace_sample_rate": self.trace_sample_rate}
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "ResilienceSettings":
@@ -492,4 +506,5 @@ class ResilienceSettings:
         return cls(request_timeout_s=d.get("request_timeout_s", 30.0),
                    max_queued_requests=d.get("max_queued_requests", 256),
                    retry=RetryPolicy.from_dict(d.get("retry")),
-                   breaker=CircuitBreakerConfig.from_dict(d.get("breaker")))
+                   breaker=CircuitBreakerConfig.from_dict(d.get("breaker")),
+                   trace_sample_rate=d.get("trace_sample_rate"))

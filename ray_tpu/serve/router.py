@@ -250,6 +250,9 @@ class Router:
         from ray_tpu.utils.config import get_config
 
         self._deployment = deployment_name
+        # Span names interned once — these are stamped per request.
+        self._trace_req_name = f"serve.request.{deployment_name}"
+        self._trace_att_name = f"serve.attempt.{deployment_name}"
         self._get_replicas = get_replicas
         self._inflight: dict[str, int] = {}  # replica_id -> local in-flight
         self._lock = threading.Lock()
@@ -352,7 +355,9 @@ class Router:
                        deadline: float | None = None,
                        exclude: set[str] | frozenset[str] | None = None,
                        no_park: bool = False,
-                       prefix_hashes: tuple | None = None):
+                       prefix_hashes: tuple | None = None,
+                       trace_ctx: dict | None = None,
+                       trace_attrs: dict | None = None):
         """Pick a replica, submit, and return ``(result, replica_id)``
         where result is the ObjectRef (or ``(gen, on_done)`` when
         streaming). One attempt — retry/hedge loops live in the handle,
@@ -375,7 +380,13 @@ class Router:
         sleep-poll — but only ``settings.max_queued_requests`` callers may
         park: beyond that, :class:`Overloaded` sheds the request
         immediately (admission control, reference: serve's
-        max_queued_requests handle option)."""
+        max_queued_requests handle option).
+
+        ``trace_ctx`` (a tracing propagation dict) parents this attempt
+        under the handle's request-root span; routing decisions that end
+        the attempt (shed, expiry, replica vanished) are stamped onto the
+        trace as zero-duration point spans, and ``trace_attrs`` (attempt
+        number, hedge flag) land on the attempt span."""
         t_enter = time.time()
         if deadline is None:
             budget = timeout if timeout is not None \
@@ -398,6 +409,8 @@ class Router:
                         # instead of a full-budget park that also occupies
                         # an admission slot (a 0.5s retry-after shed must
                         # not become a 30s stall on a 1-replica app).
+                        self._trace_point(trace_ctx, "router.shed",
+                                          reason="exhausted")
                         raise Overloaded(
                             f"{self._deployment!r}: every replica already "
                             f"tried by this request", retry_after_s=0.5,
@@ -413,6 +426,9 @@ class Router:
                     remaining = deadline - time.time()
                     if remaining <= 0:
                         self._m_expired_router.inc()
+                        self._trace_point(trace_ctx, "router.expired",
+                                          waited_s=round(
+                                              time.time() - t_enter, 6))
                         raise DeadlineExceeded(
                             f"no available replica for {self._deployment!r} "
                             f"within the request budget "
@@ -434,6 +450,8 @@ class Router:
                             # Bounded router queue: shed instead of joining
                             # an unbounded wait (the client owns backoff).
                             self._m_shed_router.inc()
+                            self._trace_point(trace_ctx, "router.shed",
+                                              reason="queue_full")
                             raise Overloaded(
                                 f"{self._deployment!r} router queue full "
                                 f"({cap} waiting)",
@@ -449,7 +467,10 @@ class Router:
                 if parked:
                     self._waiting -= 1
                     self._m_queue_depth.set(self._waiting)
-        self._m_queue_wait.observe(time.time() - t_enter)
+        wait_s = time.time() - t_enter
+        self._m_queue_wait.observe(
+            wait_s, exemplar=trace_ctx.get("trace_id") if trace_ctx
+            else None)
         self._m_requests.inc()
 
         # Propagate the budget: the replica drops the request if it expires
@@ -481,21 +502,48 @@ class Router:
             if is_probe:
                 self.breaker.cancel_probe(rid)
             self.breaker.record_failure(rid)
+            self._trace_point(trace_ctx, "router.never_sent", replica=rid)
             raise ActorDiedError(
                 rid, f"replica {rid} vanished before submit: {e!r}",
                 never_sent=True) from e
         # Client span around submission: inject() rides the TaskSpec, so
-        # the replica's execution shows up as a child of serve.request —
-        # one trace across processes. Skipped entirely (nullcontext) when
-        # tracing is off: span setup was measurable at router hot-path
-        # rates.
-        traced = tracing.tracing_enabled()
+        # the replica's execution shows up as a child of this span — one
+        # trace across processes. When the handle propagated a request-root
+        # context (trace_ctx), this becomes the per-ATTEMPT span (retries
+        # and hedges each get their own, numbered via trace_attrs) nested
+        # under serve.request.<dep>; standalone callers keep the old
+        # request-named root. Skipped entirely (nullcontext) when tracing
+        # is off: span setup was measurable at router hot-path rates.
+        traced = tracing.tracing_enabled() or trace_ctx is not None
+        # Unsampled FIRST attempts propagate the context without
+        # materializing the attempt span: it would cover only the submit
+        # call and duplicate the root's attributes, and at production RPS
+        # the skipped Span + id mint + tail-ring insert is the single
+        # biggest per-request tracing cost. Retries, hedges, breaker
+        # probes, and head-sampled traces keep their numbered attempt
+        # spans; the handle stamps the chosen replica onto the root.
+        if (trace_ctx is not None and not is_probe
+                and (not trace_attrs or trace_attrs.get("attempt", 1) == 1)
+                and "sampled" in trace_ctx
+                and tracing._coerce_sampled(trace_ctx["sampled"]) is False):
+            span = tracing.propagate_only(trace_ctx)
+        elif traced:
+            name = (self._trace_att_name if trace_ctx is not None
+                    else self._trace_req_name)
+            attrs = {"method": method_name, "replica": rid}
+            if trace_attrs:
+                attrs.update(trace_attrs)
+            if is_probe:
+                attrs["breaker_probe"] = True
+            if wait_s > 0.001:
+                attrs["queue_wait_s"] = round(wait_s, 6)
+            if stream:
+                attrs["stream"] = "true"
+            span = tracing.span(name, kind="client", attributes=attrs,
+                                ctx=trace_ctx)
+        else:
+            span = contextlib.nullcontext()
         if stream:
-            span = tracing.span(
-                f"serve.request.{self._deployment}", kind="client",
-                attributes={"method": method_name, "replica": rid,
-                            "stream": "true"}) if traced \
-                else contextlib.nullcontext()
             try:
                 with span:
                     gen = handle.handle_request_streaming.options(
@@ -521,10 +569,6 @@ class Router:
                         self.breaker.cancel_probe(rid)
 
             return (gen, on_stream_done), rid
-        span = tracing.span(
-            f"serve.request.{self._deployment}", kind="client",
-            attributes={"method": method_name, "replica": rid}) if traced \
-            else contextlib.nullcontext()
         try:
             with span:
                 ref = handle.handle_request.remote(method_name, args, kwargs)
@@ -534,6 +578,17 @@ class Router:
 
         self._get_reaper().add(ref, rid, time.perf_counter(), is_probe)
         return ref, rid
+
+    def _trace_point(self, trace_ctx: dict | None, name: str,
+                     **attrs) -> None:
+        """Zero-duration span stamping a routing decision (shed, expiry,
+        vanished replica) onto the request's trace. No-op without a
+        propagated context — untraced hot-path requests pay nothing."""
+        if trace_ctx is None:
+            return
+        now = time.time()
+        tracing.record_span(name, now, now, attributes=attrs,
+                            ctx=trace_ctx)
 
     def _submit_failed(self, rid: str, is_probe: bool) -> None:
         self._actors.pop(rid, None)  # handle may be bound to a corpse

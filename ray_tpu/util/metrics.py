@@ -19,6 +19,8 @@ every series labeled with its ``node_id``.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Sequence
 
 from ray_tpu.devtools.annotations import guarded_by
@@ -26,6 +28,22 @@ from ray_tpu.devtools.annotations import guarded_by
 _DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
 )
+
+_exemplar_n: int | None = None
+
+
+def _exemplar_count() -> int:
+    """Exemplars kept per histogram series (Config metrics_exemplar_count),
+    cached once — read lazily so the module imports without a runtime."""
+    global _exemplar_n
+    if _exemplar_n is None:
+        try:
+            from ray_tpu.utils.config import get_config
+
+            _exemplar_n = max(0, int(get_config().metrics_exemplar_count))
+        except Exception:  # noqa: BLE001 - config not importable yet
+            _exemplar_n = 4
+    return _exemplar_n
 
 
 @guarded_by("_lock", "_series")
@@ -92,8 +110,8 @@ class _BoundGauge(_BoundSeries):
 
 
 class _BoundHistogram(_BoundSeries):
-    def observe(self, value: float):
-        self._m._observe_key(self._key, value)
+    def observe(self, value: float, exemplar: str | None = None):
+        self._m._observe_key(self._key, value, exemplar)
 
 
 class Counter(Metric):
@@ -148,11 +166,16 @@ class Histogram(Metric):
         self.boundaries = bounds
         self._buckets: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
+        # Recent (trace_id, value, ts) per series — the metrics→traces
+        # link: a TTFT bucket names the traces that landed in it.
+        self._exemplars: dict[tuple, deque] = {}
 
-    def observe(self, value: float, tags: dict[str, str] | None = None):
-        self._observe_key(self._series_key(tags), value)
+    def observe(self, value: float, tags: dict[str, str] | None = None,
+                exemplar: str | None = None):
+        self._observe_key(self._series_key(tags), value, exemplar)
 
-    def _observe_key(self, key: tuple, value: float):
+    def _observe_key(self, key: tuple, value: float,
+                     exemplar: str | None = None):
         with self._lock:
             buckets = self._buckets.setdefault(key, [0] * (len(self.boundaries) + 1))
             idx = len(self.boundaries)
@@ -163,6 +186,13 @@ class Histogram(Metric):
             buckets[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._series[key] = self._series.get(key, 0.0) + 1  # observation count
+            if exemplar:
+                n = _exemplar_count()
+                if n:
+                    ring = self._exemplars.get(key)
+                    if ring is None:
+                        ring = self._exemplars[key] = deque(maxlen=n)
+                    ring.append((exemplar, float(value), time.time()))
 
     def bound(self, tags: dict[str, str] | None = None) -> _BoundHistogram:
         return _BoundHistogram(self, self._series_key(tags))
@@ -173,6 +203,8 @@ class Histogram(Metric):
                 {k: list(v) for k, v in self._buckets.items()},
                 dict(self._sums),
                 dict(self._series),
+                {k: [list(e) for e in v]
+                 for k, v in self._exemplars.items() if v},
             )
 
 
@@ -205,12 +237,18 @@ class MetricsRegistry:
                 "desc": m.description, "tag_keys": list(m.tag_keys),
             }
             if isinstance(m, Histogram):
-                buckets, sums, counts = m._hist_points()
+                buckets, sums, counts, exemplars = m._hist_points()
                 entry["boundaries"] = [float(b) for b in m.boundaries]
                 entry["buckets"] = [[list(k), list(v)]
                                     for k, v in buckets.items()]
                 entry["sums"] = [[list(k), v] for k, v in sums.items()]
                 entry["counts"] = [[list(k), v] for k, v in counts.items()]
+                if exemplars:
+                    # JSON surfaces only (/api/metrics, /api/traces, the
+                    # watchdog) — the Prometheus text exposition is
+                    # deliberately untouched.
+                    entry["exemplars"] = [[list(k), v]
+                                          for k, v in exemplars.items()]
             else:
                 entry["points"] = [[list(k), v]
                                    for k, v in m._points().items()]
@@ -256,6 +294,19 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
                         else:
                             idx[k] = idx[k] + v
                     have[field] = [[list(k), v] for k, v in idx.items()]
+                if entry.get("exemplars"):
+                    # Concat per series, keep the newest N by timestamp —
+                    # same bound as one process's ring.
+                    n = _exemplar_count() or 4
+                    idx = {tuple(k): list(v)
+                           for k, v in have.get("exemplars", [])}
+                    for k, v in entry["exemplars"]:
+                        k = tuple(k)
+                        rows = idx.get(k, []) + list(v)
+                        rows.sort(key=lambda e: e[2] if len(e) > 2 else 0.0)
+                        idx[k] = rows[-n:]
+                    have["exemplars"] = [[list(k), v]
+                                         for k, v in idx.items()]
             else:
                 idx = {tuple(k): v for k, v in have.get("points", [])}
                 for k, v in entry.get("points", []):

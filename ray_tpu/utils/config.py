@@ -353,6 +353,32 @@ class Config:
     # the push entirely).
     telemetry_flush_interval_s: float = 0.5
 
+    # --- request tracing (ray_tpu/util/tracing.py) ---
+    # Head-sampling rate for serve ingress requests: the DeploymentHandle
+    # draws one verdict per request and every downstream span (router,
+    # replica, batcher, engine, DAG/KV hops) inherits it. Per-deployment
+    # override: @serve.deployment(trace_sample_rate=...) rides the same
+    # ResilienceSettings snapshot the other data-plane knobs use. Only
+    # meaningful once tracing.enable_tracing() turned the master gate on.
+    trace_sample_rate: float = 0.01
+    # Tail-sampling ring bounds: spans of UNsampled traces are ringed per
+    # trace_id (promotable by a retroactive keep when the request ends
+    # slow / shed / expired / errored / breaker-implicated) instead of
+    # discarded. Distinct traces held, spans kept per trace, and the ring
+    # TTL — all per process; past any bound the oldest die unkept.
+    trace_tail_traces: int = 512
+    trace_tail_spans_per_trace: int = 64
+    trace_tail_ttl_s: float = 30.0
+    # "Ended slow" keep verdict: rolling per-deployment latency window —
+    # sample count and the minimum history before the p99 gate judges
+    # (no verdicts off a cold window).
+    trace_slow_window: int = 512
+    trace_slow_min_samples: int = 64
+    # Recent exemplar (trace_id, value) pairs each histogram SERIES keeps
+    # so TTFT/TPOT/latency buckets link back to traces (/api/metrics,
+    # /api/traces, watchdog incident bundles). 0 disables exemplars.
+    metrics_exemplar_count: int = 4
+
     # --- health watchdog (ray_tpu/observability) ---
     # Master gate: with this on, every process's telemetry flusher derives
     # delta-encoded samples for the hot-path series (train step/tokens/MFU,
